@@ -1,0 +1,30 @@
+"""ddlb_tpu: TPU-native distributed deep-learning benchmark framework.
+
+Brand-new framework with the capabilities of samnordmann/ddlb
+(/root/reference), rebuilt TPU-first: ``jax.distributed`` + device meshes
+with ``shard_map`` collectives over ICI/DCN instead of mpirun/NCCL/UCC,
+GSPMD and Pallas overlap kernels instead of nvFuser/TransformerEngine.
+Public API is lazily exported like the reference package root
+(/root/reference/ddlb/__init__.py:5-30).
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "PrimitiveBenchmarkRunner": ("ddlb_tpu.benchmark", "PrimitiveBenchmarkRunner"),
+    "Runtime": ("ddlb_tpu.runtime", "Runtime"),
+    "enable_simulation": ("ddlb_tpu.runtime", "enable_simulation"),
+    "TPColumnwise": ("ddlb_tpu.primitives.tp_columnwise.base", "TPColumnwise"),
+    "TPRowwise": ("ddlb_tpu.primitives.tp_rowwise.base", "TPRowwise"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

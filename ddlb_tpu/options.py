@@ -1,0 +1,113 @@
+"""Option parsing and scoped-environment utilities shared by all primitives.
+
+Unifies the two byte-identical copies the reference keeps at
+/root/reference/ddlb/primitives/TPColumnwise/utils.py:9-132 and
+/root/reference/ddlb/primitives/TPRowwise/utils.py:9-132 (SURVEY.md notes the
+duplication explicitly) into one module.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional
+
+# Keys consumed by the benchmark layer, silently ignored by primitives
+# (reference BENCHMARK_OPTIONS, TPColumnwise/utils.py:34-40).
+BENCHMARK_OPTIONS = {"implementation"}
+
+
+class OptionsManager:
+    """Validate per-implementation options against a declared schema.
+
+    Schema contract (reference TPColumnwise/utils.py:34-108): an
+    implementation class declares ``DEFAULT_OPTIONS`` (name -> default) and
+    ``ALLOWED_VALUES`` (name -> list of allowed values, or a 2-tuple
+    ``(min, max)`` numeric range where ``None`` means unbounded). Unknown
+    option names and out-of-range values raise ``ValueError``.
+    """
+
+    def __init__(
+        self,
+        defaults: Mapping[str, Any],
+        allowed: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self.defaults = dict(defaults)
+        self.allowed = dict(allowed or {})
+        self.options: Dict[str, Any] = dict(self.defaults)
+
+    def parse(self, overrides: Mapping[str, Any]) -> Dict[str, Any]:
+        for key, value in overrides.items():
+            if key in BENCHMARK_OPTIONS:
+                continue
+            if key not in self.defaults:
+                raise ValueError(
+                    f"Unknown option '{key}'. Valid options: "
+                    f"{sorted(self.defaults)}"
+                )
+            self._check_allowed(key, value)
+            self.options[key] = value
+        return self.options
+
+    def _check_allowed(self, key: str, value: Any) -> None:
+        spec = self.allowed.get(key)
+        if spec is None:
+            return
+        if isinstance(spec, tuple) and len(spec) == 2:
+            lo, hi = spec
+            if not isinstance(value, (int, float)) or isinstance(value, bool):
+                raise ValueError(
+                    f"Option '{key}' expects a number in range {spec}, "
+                    f"got {value!r}"
+                )
+            if (lo is not None and value < lo) or (hi is not None and value > hi):
+                raise ValueError(
+                    f"Option '{key}'={value!r} outside allowed range {spec}"
+                )
+            return
+        if value not in spec:
+            raise ValueError(
+                f"Option '{key}'={value!r} not in allowed values {list(spec)}"
+            )
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.options.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return self.options[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self.options
+
+
+class EnvVarGuard:
+    """RAII-style scoped environment mutation.
+
+    Reference analogue: TPColumnwise/utils.py:9-31. Usable as a context
+    manager (preferred) or relying on ``__del__`` like the reference.
+    """
+
+    def __init__(self, values: Mapping[str, str]) -> None:
+        self._saved: Dict[str, Optional[str]] = {}
+        for key, value in values.items():
+            self._saved[key] = os.environ.get(key)
+            os.environ[key] = value
+
+    def restore(self) -> None:
+        for key, old in self._saved.items():
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        self._saved = {}
+
+    def __enter__(self) -> "EnvVarGuard":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.restore()
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing dependent
+        try:
+            self.restore()
+        except Exception:
+            pass

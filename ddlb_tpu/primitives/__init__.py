@@ -1,0 +1,29 @@
+"""Primitive package: lazy re-exports.
+
+Mirrors the reference's module-``__getattr__`` lazy-export pattern
+(/root/reference/ddlb/primitives/__init__.py:19-26) so importing the
+package never triggers backend imports.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.registry import (  # noqa: F401
+    ALLOWED_PRIMITIVES,
+    implementation_names,
+    load_impl_class,
+)
+
+_LAZY = {
+    "Primitive": ("ddlb_tpu.primitives.base", "Primitive"),
+    "TPColumnwise": ("ddlb_tpu.primitives.tp_columnwise.base", "TPColumnwise"),
+    "TPRowwise": ("ddlb_tpu.primitives.tp_rowwise.base", "TPRowwise"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,168 @@
+"""Primitive contract: seeded operand construction, sharding, validation.
+
+TPU-native re-design of the reference's per-primitive ABCs
+(/root/reference/ddlb/primitives/TPColumnwise/tp_columnwise.py:13-162 and
+TPRowwise/tp_rowwise.py:13-184). The contract is identical —
+``__init__(m, n, k, dtype, seed, **options)`` / ``run() -> Array`` /
+``validate(result)`` / ``get_inputs()`` with class-level
+``DEFAULT_OPTIONS`` / ``ALLOWED_VALUES`` — but operands are JAX global
+arrays laid out by ``NamedSharding`` over a device mesh instead of per-rank
+torch CUDA tensors, so one process drives all local chips and the same code
+spans multi-host pods.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ddlb_tpu.options import OptionsManager
+from ddlb_tpu.runtime import Runtime
+
+# Reference dtype map: tp_columnwise.py:63-70. bfloat16 is the canonical
+# half precision on TPU (SURVEY.md risk register); float16 kept for parity.
+DTYPE_NAMES = ("float32", "float64", "float16", "bfloat16", "int32", "int64")
+
+
+def jnp_dtype(name: str):
+    import jax.numpy as jnp
+
+    table = {
+        "float32": jnp.float32,
+        "float64": jnp.float64,
+        "float16": jnp.float16,
+        "bfloat16": jnp.bfloat16,
+        "int32": jnp.int32,
+        "int64": jnp.int64,
+    }
+    if name not in table:
+        raise ValueError(f"Unsupported dtype '{name}'. Supported: {DTYPE_NAMES}")
+    return table[name]
+
+
+def validation_atol(dtype: str, k: int) -> float:
+    """Reference tolerance rule: rtol=0, atol=(1e-3 half / 1e-4 else)*k
+    (tp_columnwise.py:150-162)."""
+    base = 1e-3 if dtype in ("float16", "bfloat16") else 1e-4
+    return base * k
+
+
+class Primitive(ABC):
+    """Base for all benchmarkable primitives."""
+
+    #: option schema discovered reflectively by the runner
+    #: (reference ddlb/benchmark.py:76-77, 107-110)
+    DEFAULT_OPTIONS: Dict[str, Any] = {}
+    ALLOWED_VALUES: Dict[str, Any] = {}
+
+    def __init__(
+        self,
+        m: int,
+        n: int,
+        k: int,
+        dtype: str = "bfloat16",
+        seed: int = 42,
+        mesh: Optional[Any] = None,
+        **options: Any,
+    ) -> None:
+        self.m, self.n, self.k = int(m), int(n), int(k)
+        self.dtype = dtype
+        self.seed = int(seed)
+        self.runtime = Runtime()
+        self.mesh = mesh if mesh is not None else self.runtime.mesh(("tp",))
+        self.num_partitions = int(np.prod(list(self.mesh.shape.values())))
+        self._options_manager = OptionsManager(self.DEFAULT_OPTIONS, self.ALLOWED_VALUES)
+        self.options = self._options_manager.parse(options)
+        self._check_shapes()
+        self._input_setup()
+
+    # -- hooks ---------------------------------------------------------------
+
+    def _check_shapes(self) -> None:
+        """Shape-divisibility constraints; overridden per primitive."""
+
+    @abstractmethod
+    def _input_setup(self) -> None:
+        """Construct and shard operands."""
+
+    @abstractmethod
+    def run(self):
+        """Execute one iteration; returns the (possibly sharded) result array."""
+
+    @abstractmethod
+    def validate(self, result) -> bool:
+        """Compare against the single-device reference product."""
+
+    # -- operand construction ------------------------------------------------
+
+    def _host_operands(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Seeded uniform [-1, 1] operands, built identically on every host.
+
+        Reference: seeded CPU construction then per-rank slicing
+        (tp_columnwise.py:104-124). Determinism across processes is what
+        makes multi-host validation possible without gathering inputs
+        (SURVEY.md section 4 item 2).
+        """
+        rng = np.random.default_rng(self.seed)
+        gen_dtype = np.float64 if self.dtype == "float64" else np.float32
+        a = (rng.uniform(-1.0, 1.0, (self.m, self.k))).astype(gen_dtype)
+        b = (rng.uniform(-1.0, 1.0, (self.k, self.n))).astype(gen_dtype)
+        if self.dtype in ("int32", "int64"):
+            # Small integers keep the product exactly representable.
+            a = np.rint(a * 3).astype(self.dtype)
+            b = np.rint(b * 3).astype(self.dtype)
+        return a, b
+
+    def _device_put(self, host_array: np.ndarray, spec):
+        """Place a host array as a global sharded array on the mesh."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        arr = jax.device_put(host_array, NamedSharding(self.mesh, spec))
+        if self.dtype not in ("int32", "int64", "float64"):
+            arr = arr.astype(jnp_dtype(self.dtype))
+        return jax.block_until_ready(arr)
+
+    # -- validation ----------------------------------------------------------
+
+    def _expected_full(self) -> np.ndarray:
+        """Single-device reference product in float32/float64 accumulation
+        (reference computes on CPU, tp_columnwise.py:148)."""
+        a, b = self._host_operands()
+        acc = np.float64 if self.dtype == "float64" else np.float32
+        return a.astype(acc) @ b.astype(acc)
+
+    def _compare_global(self, result, expected: np.ndarray) -> bool:
+        """Compare every addressable shard of a global result against the
+        matching slice of ``expected``.
+
+        Subsumes both reference paths: full comparison for replicated
+        outputs (tp_columnwise.py:137-162) and the per-rank row-slice for
+        sequence-sharded outputs (tp_rowwise.py:166-170) — the shard index
+        selects the slice.
+        """
+        atol = validation_atol(self.dtype, self.k)
+        ok = True
+        for shard in result.addressable_shards:
+            got = np.asarray(shard.data, dtype=expected.dtype)
+            want = expected[shard.index]
+            if not np.allclose(got, want, rtol=0.0, atol=atol):
+                max_err = float(np.max(np.abs(got - want))) if got.size else 0.0
+                print(
+                    f"[ddlb_tpu] validation FAILED for {type(self).__name__} "
+                    f"shard {shard.index}: max|err|={max_err:.3e} > atol={atol:.3e}"
+                )
+                ok = False
+        return ok
+
+    def get_inputs(self):
+        """Return the sharded device operands (reference ``get_inputs``)."""
+        return self.a, self.b
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(m={self.m}, n={self.n}, k={self.k}, "
+            f"dtype={self.dtype}, partitions={self.num_partitions})"
+        )

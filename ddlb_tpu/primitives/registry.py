@@ -1,0 +1,94 @@
+"""String -> implementation-class resolution for the benchmark worker.
+
+Reference analogue: the inline class map at
+/root/reference/ddlb/benchmark.py:41-67 plus ``_load_impl_class``. Kept as
+its own module so the CLI, runner and tests share one source of truth, and
+imports stay lazy (reference lazy-import pattern,
+/root/reference/ddlb/primitives/TPColumnwise/__init__.py:16-39) so optional
+heavy backends only load when requested.
+
+Implementation-name mapping from the reference's CUDA backends to the TPU
+build (SURVEY.md section 2.4):
+- ``compute_only``  -> same role (roofline bounds)
+- ``pytorch``       -> ``jax_spmd``   (explicit collectives, the baseline)
+- ``jax``           -> ``xla_gspmd``  (compiler-driven GSPMD)
+- ``fuser``         -> ``overlap``    (chunked / ring comm-compute pipelines)
+- ``transformer_engine`` -> covered by ``xla_gspmd`` (XLA latency-hiding
+  scheduler is the vendor-tuned slot) and ``pallas`` (hand kernels)
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Tuple, Type
+
+ALLOWED_PRIMITIVES = ("tp_columnwise", "tp_rowwise")
+
+_REGISTRY = {
+    "tp_columnwise": {
+        "compute_only": (
+            "ddlb_tpu.primitives.tp_columnwise.compute_only",
+            "ComputeOnlyTPColumnwise",
+        ),
+        "jax_spmd": (
+            "ddlb_tpu.primitives.tp_columnwise.jax_spmd",
+            "JaxSPMDTPColumnwise",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.tp_columnwise.xla_gspmd",
+            "XLAGSPMDTPColumnwise",
+        ),
+        "overlap": (
+            "ddlb_tpu.primitives.tp_columnwise.overlap",
+            "OverlapTPColumnwise",
+        ),
+    },
+    "tp_rowwise": {
+        "compute_only": (
+            "ddlb_tpu.primitives.tp_rowwise.compute_only",
+            "ComputeOnlyTPRowwise",
+        ),
+        "jax_spmd": (
+            "ddlb_tpu.primitives.tp_rowwise.jax_spmd",
+            "JaxSPMDTPRowwise",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.tp_rowwise.xla_gspmd",
+            "XLAGSPMDTPRowwise",
+        ),
+        "overlap": (
+            "ddlb_tpu.primitives.tp_rowwise.overlap",
+            "OverlapTPRowwise",
+        ),
+    },
+}
+
+
+def implementation_names(primitive: str) -> Tuple[str, ...]:
+    _check_primitive(primitive)
+    return tuple(_REGISTRY[primitive])
+
+
+def load_impl_class(primitive: str, name: str) -> Type:
+    """Resolve ``(primitive, implementation-name)`` to its class.
+
+    Reference analogue: ``_load_impl_class`` (ddlb/benchmark.py:41-75).
+    """
+    _check_primitive(primitive)
+    table = _REGISTRY[primitive]
+    if name not in table:
+        raise ValueError(
+            f"Unknown implementation '{name}' for {primitive}. "
+            f"Available: {sorted(table)}"
+        )
+    module_name, class_name = table[name]
+    module = importlib.import_module(module_name)
+    return getattr(module, class_name)
+
+
+def _check_primitive(primitive: str) -> None:
+    if primitive not in ALLOWED_PRIMITIVES:
+        # reference ALLOWED_PRIMITIVES check, ddlb/benchmark.py:267
+        raise ValueError(
+            f"Unknown primitive '{primitive}'. Allowed: {ALLOWED_PRIMITIVES}"
+        )

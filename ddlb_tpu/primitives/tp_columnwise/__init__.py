@@ -1,0 +1,33 @@
+"""TPColumnwise (AG+GEMM) implementations, lazily exported
+(reference pattern: TPColumnwise/__init__.py:28-39)."""
+
+from __future__ import annotations
+
+_LAZY = {
+    "TPColumnwise": ("ddlb_tpu.primitives.tp_columnwise.base", "TPColumnwise"),
+    "ComputeOnlyTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.compute_only",
+        "ComputeOnlyTPColumnwise",
+    ),
+    "JaxSPMDTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.jax_spmd",
+        "JaxSPMDTPColumnwise",
+    ),
+    "XLAGSPMDTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.xla_gspmd",
+        "XLAGSPMDTPColumnwise",
+    ),
+    "OverlapTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.overlap",
+        "OverlapTPColumnwise",
+    ),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

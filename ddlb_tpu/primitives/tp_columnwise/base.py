@@ -1,0 +1,40 @@
+"""TPColumnwise: all-gather + GEMM tensor-parallel primitive.
+
+Semantics (reference /root/reference/ddlb/primitives/TPColumnwise/
+tp_columnwise.py:13-162): A is row-sharded ``[m/d, k]`` per partition, B is
+replicated ``[k, n]``, and the result is the full ``[m, n]`` product, with
+``m % d == 0``. In the TPU build A is one global ``[m, k]`` array with
+``PartitionSpec('tp', None)`` over the mesh and B is replicated, so the
+partitioning is carried by the sharding system instead of manual slicing.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class TPColumnwise(Primitive):
+    """ABC for AG+GEMM implementations."""
+
+    primitive_name = "tp_columnwise"
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.m % d != 0:
+            # reference constraint tp_columnwise.py:53-56
+            raise ValueError(f"m={self.m} must be divisible by partitions={d}")
+
+    def _input_setup(self) -> None:
+        a_host, b_host = self._host_operands()
+        self.a = self._device_put(a_host, P("tp", None))   # [m, k] row-sharded
+        self.b = self._device_put(b_host, P(None, None))   # [k, n] replicated
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        return self._compare_global(result, self._expected_full())

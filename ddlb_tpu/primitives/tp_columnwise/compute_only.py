@@ -1,0 +1,56 @@
+"""Compute-only roofline implementations (no communication).
+
+Reference: /root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55.
+``size='sharded'`` runs only the local ``[m/d, k] @ [k, n]`` GEMM (lower
+bound: pure compute share of one partition, validation skipped exactly as in
+the reference), ``size='unsharded'`` runs the full product on one device
+(single-chip roofline upper bound).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+
+
+class ComputeOnlyTPColumnwise(TPColumnwise):
+    DEFAULT_OPTIONS = {"size": "sharded"}
+    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def _input_setup(self) -> None:
+        a_host, b_host = self._host_operands()
+        if self.options["size"] == "sharded":
+            # Local shard only, as seen by partition 0.
+            a_host = a_host[: self.m // self.num_partitions]
+        device = self.runtime.local_devices[0]
+        dt = jnp_dtype(self.dtype)
+        self.a = jax.device_put(jnp.asarray(a_host).astype(dt), device)
+        self.b = jax.device_put(jnp.asarray(b_host).astype(dt), device)
+        self._fn = jax.jit(jnp.matmul)
+        jax.block_until_ready((self.a, self.b))
+
+    def run(self):
+        return self._fn(self.a, self.b)
+
+    def validate(self, result) -> bool:
+        if self.options["size"] == "sharded":
+            # Partial-shape result; reference skips validation here
+            # (compute_only.py:47-55).
+            return True
+        import numpy as np
+
+        result = jax.block_until_ready(result)
+        expected = self._expected_full()
+        from ddlb_tpu.primitives.base import validation_atol
+
+        return bool(
+            np.allclose(
+                np.asarray(result, dtype=expected.dtype),
+                expected,
+                rtol=0.0,
+                atol=validation_atol(self.dtype, self.k),
+            )
+        )

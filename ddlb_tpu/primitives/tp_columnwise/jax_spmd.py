@@ -1,0 +1,56 @@
+"""Explicit-collective AG+GEMM via ``shard_map``.
+
+The TPU-native analogue of the reference's baseline PyTorch implementation
+(/root/reference/ddlb/primitives/TPColumnwise/pytorch.py:13-104): the
+collective is written out explicitly (``jax.lax.all_gather`` over the
+``'tp'`` mesh axis — ICI on a real pod) rather than left to the compiler.
+
+Options mirror pytorch.py:32-45:
+- ``order='AG_before'``: all-gather A then compute the full GEMM on every
+  partition (pytorch.py:94-97).
+- ``order='AG_after'``: compute the local ``[m/d, n]`` GEMM then all-gather
+  the outputs (pytorch.py:99-104).
+The reference's ``backend`` axis (nccl/ucc/...) has no TPU analogue — the
+transport is always XLA collectives over ICI/DCN (SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+
+
+class JaxSPMDTPColumnwise(TPColumnwise):
+    DEFAULT_OPTIONS = {"order": "AG_before"}
+    ALLOWED_VALUES = {"order": ["AG_before", "AG_after"]}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        order = self.options["order"]
+
+        if order == "AG_before":
+
+            def step(a_shard, b):
+                a_full = jax.lax.all_gather(a_shard, "tp", axis=0, tiled=True)
+                return a_full @ b
+
+        else:  # AG_after
+
+            def step(a_shard, b):
+                partial = a_shard @ b  # [m/d, n]
+                return jax.lax.all_gather(partial, "tp", axis=0, tiled=True)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None), P(None, None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )
+
+    def run(self):
+        return self._fn(self.a, self.b)

@@ -1,0 +1,37 @@
+"""Compiler-driven AG+GEMM: GSPMD chooses and schedules the collectives.
+
+Fills two reference slots at once (SURVEY.md section 2.5): the reference's
+own JAX comparator (/root/reference/ddlb/primitives/TPColumnwise/
+jax_tp.py:43-76 — jit with in/out shardings, XLA inserts the all-gather) and
+the "vendor-optimized overlap" slot held by TransformerEngine userbuffers
+(TPColumnwise/transformer_engine.py:51-72): on TPU the vendor-tuned path is
+XLA's latency-hiding scheduler + async collectives (collective-matmul),
+which overlap the gather with GEMM tiles automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+
+
+class XLAGSPMDTPColumnwise(TPColumnwise):
+    DEFAULT_OPTIONS = {}
+    ALLOWED_VALUES = {}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        self._fn = jax.jit(
+            jnp.matmul,
+            in_shardings=(
+                NamedSharding(self.mesh, P("tp", None)),
+                NamedSharding(self.mesh, P(None, None)),
+            ),
+            out_shardings=NamedSharding(self.mesh, P(None, None)),
+        )
+
+    def run(self):
+        return self._fn(self.a, self.b)

@@ -1,0 +1,33 @@
+"""TPRowwise (GEMM+RS) implementations, lazily exported
+(reference pattern: TPRowwise/__init__.py:24-31)."""
+
+from __future__ import annotations
+
+_LAZY = {
+    "TPRowwise": ("ddlb_tpu.primitives.tp_rowwise.base", "TPRowwise"),
+    "ComputeOnlyTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.compute_only",
+        "ComputeOnlyTPRowwise",
+    ),
+    "JaxSPMDTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.jax_spmd",
+        "JaxSPMDTPRowwise",
+    ),
+    "XLAGSPMDTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.xla_gspmd",
+        "XLAGSPMDTPRowwise",
+    ),
+    "OverlapTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.overlap",
+        "OverlapTPRowwise",
+    ),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

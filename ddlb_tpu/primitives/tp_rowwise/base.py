@@ -1,0 +1,49 @@
+"""TPRowwise: GEMM + reduce-scatter (sequence-parallel) primitive.
+
+Semantics (reference /root/reference/ddlb/primitives/TPRowwise/
+tp_rowwise.py:13-184): A is K-column-sharded ``[m, k/d]``, B is
+K-row-sharded ``[k/d, n]``; each partition computes a partial product and a
+reduce-scatter sums partials while sharding output rows, yielding
+``[m/d, n]`` per partition — the sequence dimension M ends up sharded,
+which is exactly sequence parallelism. Constraints ``k % d == 0`` and
+``m % d == 0`` (tp_rowwise.py:57-66).
+
+In the TPU build the output is a single global ``[m, n]`` array with
+``PartitionSpec('tp', None)`` — the per-partition ``[m/d, n]`` shard of the
+reference is the addressable shard of that global array.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class TPRowwise(Primitive):
+    """ABC for GEMM+RS implementations."""
+
+    primitive_name = "tp_rowwise"
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.k % d != 0:
+            raise ValueError(f"k={self.k} must be divisible by partitions={d}")
+        if self.m % d != 0:
+            raise ValueError(f"m={self.m} must be divisible by partitions={d}")
+
+    def _input_setup(self) -> None:
+        a_host, b_host = self._host_operands()
+        self.a = self._device_put(a_host, P(None, "tp"))   # [m, k] col-sharded
+        self.b = self._device_put(b_host, P("tp", None))   # [k, n] row-sharded
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        # _compare_global slices the expected product by shard index, which
+        # reproduces the reference's per-rank row-slice check
+        # (tp_rowwise.py:166-170) for the row-sharded global output.
+        return self._compare_global(result, self._expected_full())

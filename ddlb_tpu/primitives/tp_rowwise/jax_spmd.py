@@ -1,0 +1,43 @@
+"""Explicit-collective GEMM+RS (sequence parallel) via ``shard_map``.
+
+TPU-native analogue of the reference's PyTorch implementation
+(/root/reference/ddlb/primitives/TPRowwise/pytorch.py:13-85): local partial
+GEMM then an explicit reduce-scatter — here ``jax.lax.psum_scatter`` over
+the ``'tp'`` mesh axis, which XLA lowers to a reduce-scatter over ICI. The
+output rows end up sharded along M: this is the sequence-parallel layout
+(tp_rowwise.py:13-27).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+
+
+class JaxSPMDTPRowwise(TPRowwise):
+    DEFAULT_OPTIONS = {}
+    ALLOWED_VALUES = {}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+
+        def step(a_shard, b_shard):
+            partial = a_shard @ b_shard  # [m, n] partial sums
+            return jax.lax.psum_scatter(
+                partial, "tp", scatter_dimension=0, tiled=True
+            )  # [m/d, n]
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )
+
+    def run(self):
+        return self._fn(self.a, self.b)

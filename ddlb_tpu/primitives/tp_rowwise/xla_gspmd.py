@@ -1,0 +1,46 @@
+"""Compiler-driven GEMM+RS: GSPMD inserts the reduce-scatter.
+
+The tp_rowwise counterpart of the columnwise GSPMD comparator — the
+reference has no JAX implementation for tp_rowwise at all (worker class
+map, /root/reference/ddlb/benchmark.py:51-55), so this is beyond parity.
+Requesting a row-sharded output from a K-contracted product forces GSPMD to
+lower the cross-partition sum to reduce-scatter; XLA's latency-hiding
+scheduler overlaps it with GEMM tiles (the TE ring-exchange analogue,
+/root/reference/ddlb/primitives/TPRowwise/transformer_engine.py:51-64).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+
+
+class XLAGSPMDTPRowwise(TPRowwise):
+    DEFAULT_OPTIONS = {}
+    ALLOWED_VALUES = {}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+
+        out = NamedSharding(self.mesh, P("tp", None))
+
+        def product(a, b):
+            # Contracting dim is sharded: the output sharding choice is what
+            # tells GSPMD to emit reduce-scatter (P('tp') rows) rather than
+            # all-reduce (replicated).
+            return jnp.matmul(a, b, out_sharding=out)
+
+        self._fn = jax.jit(
+            product,
+            in_shardings=(
+                NamedSharding(self.mesh, P(None, "tp")),
+                NamedSharding(self.mesh, P("tp", None)),
+            ),
+            out_shardings=NamedSharding(self.mesh, P("tp", None)),
+        )
+
+    def run(self):
+        return self._fn(self.a, self.b)

@@ -1,0 +1,148 @@
+"""Distributed runtime bootstrap: devices, mesh, and cross-host barrier.
+
+TPU-native replacement for the reference's ``Communicator`` singleton
+(/root/reference/ddlb/communicator.py:36-81). Where the reference parses
+launcher env vars, binds a CUDA device per rank and wraps
+``torch.distributed.barrier``, this runtime:
+
+- optionally initializes ``jax.distributed`` (coordinator + process id from
+  ``ddlb_tpu.envs``) for multi-host TPU pods — the analogue of the TCP
+  rendezvous at /root/reference/ddlb/primitives/TPColumnwise/pytorch.py:53-59,
+  done once per process instead of once per implementation because the TPU
+  runtime owns all local chips for the process lifetime;
+- exposes the global device list and builds ``jax.sharding.Mesh`` instances
+  (device binding is implicit: XLA addresses all local chips);
+- implements ``barrier()`` as a tiny all-device ``psum`` +
+  ``block_until_ready`` — the reference's dummy-allreduce trick
+  (/root/reference/ddlb/benchmark.py:133-137) expressed in XLA collectives;
+- supports a CPU-simulation mode (``enable_simulation``) with N virtual host
+  devices, the testing capability SURVEY.md section 4 identifies as missing
+  upstream.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence, Tuple
+
+from ddlb_tpu import envs
+
+_SIM_FLAG = "--xla_force_host_platform_device_count"
+
+
+def enable_simulation(num_devices: int) -> None:
+    """Force the CPU platform with ``num_devices`` virtual devices.
+
+    Must run before the first JAX backend use in the process (XLA clients are
+    created lazily on first device query). Safe to call repeatedly with the
+    same count.
+    """
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _SIM_FLAG not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {_SIM_FLAG}={num_devices}".strip()
+    jax.config.update("jax_platforms", "cpu")
+
+
+class Runtime:
+    """Process-wide singleton (reference Communicator.__new__, communicator.py:36-43)."""
+
+    _instance: Optional["Runtime"] = None
+    _lock = threading.Lock()
+
+    def __new__(cls) -> "Runtime":
+        with cls._lock:
+            if cls._instance is None:
+                inst = super().__new__(cls)
+                inst._initialize()
+                cls._instance = inst
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop the singleton (test helper; no reference analogue)."""
+        with cls._lock:
+            cls._instance = None
+
+    def _initialize(self) -> None:
+        sim = envs.get_sim_device_count()
+        if sim > 0:
+            enable_simulation(sim)
+
+        import jax
+
+        self.process_id = envs.get_process_id()
+        self.num_processes = envs.get_num_processes()
+        self._distributed = False
+        if self.num_processes > 1 and not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=envs.get_coordinator_address(),
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+            self._distributed = True
+
+        self.devices = tuple(jax.devices())
+        self.local_devices = tuple(jax.local_devices())
+        self.num_devices = len(self.devices)
+        self.platform = self.devices[0].platform if self.devices else "none"
+
+    # -- mesh construction ---------------------------------------------------
+
+    def mesh(
+        self,
+        axis_names: Sequence[str] = ("tp",),
+        shape: Optional[Tuple[int, ...]] = None,
+    ):
+        """Build a ``jax.sharding.Mesh`` over all global devices.
+
+        Defaults to a 1-D ``('tp',)`` mesh spanning every device — the
+        reference's single tensor-parallel process group
+        (/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:43-45).
+        """
+        import jax
+
+        if shape is None:
+            shape = (self.num_devices,) if len(axis_names) == 1 else None
+        if shape is None:
+            raise ValueError("shape required for multi-axis meshes")
+        return jax.make_mesh(shape, tuple(axis_names), devices=self.devices)
+
+    # -- synchronization -----------------------------------------------------
+
+    def barrier(self) -> None:
+        """Cross-device/-host barrier.
+
+        A one-element replicated ``psum`` over every device followed by
+        ``block_until_ready`` — the XLA-native form of the reference's dummy
+        NCCL allreduce + ``cuda.synchronize``
+        (/root/reference/ddlb/benchmark.py:133-137,
+        /root/reference/ddlb/communicator.py:65-74).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh(("_barrier",))
+        ones = jax.device_put(
+            jnp.ones((self.num_devices,), jnp.int32),
+            NamedSharding(mesh, P("_barrier")),
+        )
+
+        def _sum(x):
+            return jax.shard_map(
+                lambda v: jax.lax.psum(v, "_barrier"),
+                mesh=mesh,
+                in_specs=P("_barrier"),
+                out_specs=P(),
+            )(x)
+
+        jax.jit(_sum)(ones).block_until_ready()
+
+    def __repr__(self) -> str:
+        return (
+            f"Runtime(process={self.process_id}/{self.num_processes}, "
+            f"devices={self.num_devices}, platform={self.platform})"
+        )

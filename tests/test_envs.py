@@ -1,0 +1,57 @@
+"""Env fallback-chain behavior (reference /root/reference/ddlb/envs.py:12-82)."""
+
+from ddlb_tpu import envs
+
+
+def test_defaults(monkeypatch):
+    for var in (
+        "DDLB_TPU_PROCESS_ID",
+        "CLOUD_TPU_TASK_ID",
+        "TPU_WORKER_ID",
+        "OMPI_COMM_WORLD_RANK",
+        "SLURM_PROCID",
+        "PMI_RANK",
+        "DDLB_TPU_NUM_PROCESSES",
+        "OMPI_COMM_WORLD_SIZE",
+        "SLURM_NTASKS",
+        "PMI_SIZE",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert envs.get_process_id() == 0
+    assert envs.get_num_processes() == 1
+    assert envs.get_local_process_id() == 0
+
+
+def test_explicit_override_wins(monkeypatch):
+    monkeypatch.setenv("SLURM_PROCID", "3")
+    monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "1")
+    assert envs.get_process_id() == 1
+
+
+def test_launcher_fallback_order(monkeypatch):
+    monkeypatch.delenv("DDLB_TPU_PROCESS_ID", raising=False)
+    monkeypatch.delenv("CLOUD_TPU_TASK_ID", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "5")
+    monkeypatch.setenv("SLURM_PROCID", "7")
+    assert envs.get_process_id() == 5
+
+
+def test_coordinator_address(monkeypatch):
+    monkeypatch.delenv("DDLB_TPU_COORD_ADDR", raising=False)
+    monkeypatch.delenv("JAX_COORD_ADDR", raising=False)
+    monkeypatch.delenv("DDLB_TPU_MASTER_ADDR", raising=False)
+    monkeypatch.delenv("DDLB_TPU_MASTER_PORT", raising=False)
+    assert envs.get_coordinator_address() == "127.0.0.1:12355"
+    monkeypatch.setenv("DDLB_TPU_MASTER_ADDR", "10.0.0.1")
+    monkeypatch.setenv("DDLB_TPU_MASTER_PORT", "999")
+    assert envs.get_coordinator_address() == "10.0.0.1:999"
+    monkeypatch.setenv("JAX_COORD_ADDR", "host:1234")
+    assert envs.get_coordinator_address() == "host:1234"
+    monkeypatch.setenv("DDLB_TPU_COORD_ADDR", "other:1")
+    assert envs.get_coordinator_address() == "other:1"
+
+
+def test_sim_device_count(monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_SIM_DEVICES", "16")
+    assert envs.get_sim_device_count() == 16

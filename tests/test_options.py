@@ -1,0 +1,68 @@
+"""OptionsManager / EnvVarGuard behavior.
+
+Codifies the reference's runtime option-validation semantics
+(/root/reference/ddlb/primitives/TPColumnwise/utils.py:34-132) as tests the
+reference never had (SURVEY.md section 4).
+"""
+
+import os
+
+import pytest
+
+from ddlb_tpu.options import BENCHMARK_OPTIONS, EnvVarGuard, OptionsManager
+
+
+def test_defaults_returned_without_overrides():
+    om = OptionsManager({"order": "AG_before", "s": 8})
+    assert om.parse({}) == {"order": "AG_before", "s": 8}
+
+
+def test_override_and_get():
+    om = OptionsManager({"order": "AG_before"}, {"order": ["AG_before", "AG_after"]})
+    opts = om.parse({"order": "AG_after"})
+    assert opts["order"] == "AG_after"
+    assert om.get("order") == "AG_after"
+    assert om["order"] == "AG_after"
+    assert "order" in om
+
+
+def test_unknown_option_rejected():
+    om = OptionsManager({"order": "AG_before"})
+    with pytest.raises(ValueError, match="Unknown option"):
+        om.parse({"oops": 1})
+
+
+def test_disallowed_value_rejected():
+    om = OptionsManager({"order": "AG_before"}, {"order": ["AG_before", "AG_after"]})
+    with pytest.raises(ValueError, match="not in allowed values"):
+        om.parse({"order": "bogus"})
+
+
+def test_numeric_range():
+    om = OptionsManager({"s": 8}, {"s": (1, None)})
+    assert om.parse({"s": 4})["s"] == 4
+    with pytest.raises(ValueError, match="outside allowed range"):
+        om.parse({"s": 0})
+
+
+def test_range_rejects_non_numeric():
+    om = OptionsManager({"s": 8}, {"s": (1, None)})
+    with pytest.raises(ValueError, match="expects a number"):
+        om.parse({"s": "four"})
+
+
+def test_benchmark_options_filtered():
+    om = OptionsManager({"order": "AG_before"})
+    opts = om.parse({"implementation": "whatever"})
+    assert "implementation" not in opts
+    assert "implementation" in BENCHMARK_OPTIONS
+
+
+def test_env_var_guard_restores():
+    os.environ["DDLB_TPU_TEST_GUARD"] = "before"
+    with EnvVarGuard({"DDLB_TPU_TEST_GUARD": "inside", "DDLB_TPU_TEST_NEW": "x"}):
+        assert os.environ["DDLB_TPU_TEST_GUARD"] == "inside"
+        assert os.environ["DDLB_TPU_TEST_NEW"] == "x"
+    assert os.environ["DDLB_TPU_TEST_GUARD"] == "before"
+    assert "DDLB_TPU_TEST_NEW" not in os.environ
+    del os.environ["DDLB_TPU_TEST_GUARD"]
