@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Headline benchmark: one JSON line for the driver.
+
+Runs the framework's own measurement path (benchmark_worker) on the real
+chip(s). With one chip it measures the canonical-shape bf16 GEMM roofline
+(compute_only unsharded, the reference's single-device upper bound,
+/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55) at the
+reference's canonical 8192^3 (scripts/config.json:3-7, bf16 on TPU);
+with multiple chips it measures the real tp_columnwise AG+GEMM.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+ratio reported is measured TFLOPS / chip peak bf16 TFLOPS (v5e: 197) —
+i.e. MXU roofline fraction, higher is better.
+"""
+
+import json
+import sys
+
+V5E_PEAK_BF16_TFLOPS = 197.0
+
+
+def main() -> None:
+    import jax
+
+    n_dev = len(jax.devices())
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    m = n = k = 8192
+    if n_dev > 1:
+        base_impl, options, label = "jax_spmd", {"order": "AG_before"}, "tp_columnwise_ag_gemm"
+    else:
+        base_impl, options, label = "compute_only", {"size": "unsharded"}, "tp_columnwise_gemm_roofline"
+
+    row = benchmark_worker(
+        {
+            "primitive": "tp_columnwise",
+            "impl_id": f"{base_impl}_bench",
+            "base_implementation": base_impl,
+            "options": options,
+            "m": m,
+            "n": n,
+            "k": k,
+            "dtype": "bfloat16",
+            "num_iterations": 20,
+            "num_warmups": 5,
+            "validate": False,  # timed path only; correctness is pytest's job
+            "time_measurement_backend": "device_loop",
+            "barrier_at_each_iteration": False,
+            "profile_dir": None,
+        }
+    )
+    if "error" in row:
+        print(json.dumps({"metric": label, "error": row["error"]}))
+        sys.exit(1)
+
+    tflops = row["Throughput (TFLOPS)"]
+    print(
+        json.dumps(
+            {
+                "metric": f"{label}_{m}x{k}x{n}_bf16",
+                "value": round(tflops, 2),
+                "unit": "TFLOPS",
+                "vs_baseline": round(tflops / (V5E_PEAK_BF16_TFLOPS * n_dev), 4),
+                "mean_ms": round(row["mean time (ms)"], 4),
+                "world_size": row["world_size"],
+                "platform": row["platform"],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,369 @@
+"""Benchmark runner: per-implementation measurement, sweep orchestration, CSV.
+
+TPU-native rebuild of the reference runner (/root/reference/ddlb/
+benchmark.py:19-425). Same measurement methodology — warmup, optional
+profiler window, timing loop with selectable backend and per-iteration
+barrier, cross-process MAX-reduce of times, TFLOPS = 2mnk/1e9/ms, soft
+validation, incremental CSV, bar-chart plotting — with TPU-shaped
+mechanics:
+
+- timing backends are ``host_clock`` (perf_counter + completion fence,
+  the analogue of the reference's cpu_clock + cuda.synchronize,
+  benchmark.py:161-186) and ``device_loop`` (the cuda_event analogue done
+  the XLA way: the N-iteration loop compiled into one device program with
+  differential two-window overhead cancellation — see utils/timing.py);
+- the profiler window wraps ``jax.profiler`` instead of cudaProfilerApi
+  (benchmark.py:87-104; SURVEY.md section 5 "tracing");
+- per-implementation isolation: the reference spawns a child process per
+  implementation (benchmark.py:336-370) because CUDA backends poison each
+  other; the TPU runtime owns its chips for the process lifetime, so the
+  default is in-process with ``jax.clear_caches()`` between implementations,
+  and ``isolation='subprocess'`` restores full process isolation where the
+  platform allows it (CPU simulation, one-process-per-host pods).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES, load_impl_class
+from ddlb_tpu.utils.timing import fence, measure_device_loop
+
+TIMING_BACKENDS = ("host_clock", "device_loop")
+
+
+# ---------------------------------------------------------------------------
+# Worker: one implementation, one shape (reference _benchmark_worker_entry,
+# benchmark.py:19-256)
+# ---------------------------------------------------------------------------
+
+
+def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Measure one implementation; returns one result row."""
+    import jax
+
+    primitive = config["primitive"]
+    impl_id = config["impl_id"]
+    base_impl = config["base_implementation"]
+    options = dict(config.get("options", {}))
+    m, n, k = config["m"], config["n"], config["k"]
+    dtype = config.get("dtype", "bfloat16")
+    num_iterations = config.get("num_iterations", 50)
+    num_warmups = config.get("num_warmups", 5)
+    timing_backend = config.get("time_measurement_backend", "host_clock")
+    barrier_each = config.get("barrier_at_each_iteration", True)
+    do_validate = config.get("validate", True)
+    profile_dir = config.get("profile_dir")
+
+    if timing_backend not in TIMING_BACKENDS:
+        raise ValueError(
+            f"Unknown timing backend '{timing_backend}'. "
+            f"Allowed: {TIMING_BACKENDS}"
+        )
+
+    from ddlb_tpu.runtime import Runtime
+
+    runtime = Runtime()
+    error: Optional[str] = None
+    result = None
+    impl = None
+    option_repr = _format_options(options)
+    try:
+        impl_class = load_impl_class(primitive, base_impl)
+        # option merge: DEFAULT_OPTIONS ∪ overrides (reference
+        # benchmark.py:76-77); crash isolation covers construction too —
+        # a bad option or OOM becomes a row, not an aborted sweep
+        # (reference per-impl child process, benchmark.py:336-370).
+        impl = impl_class(m, n, k, dtype=dtype, **options)
+        option_repr = _format_options(impl.options)
+
+        # warmup (reference benchmark.py:84-85)
+        for _ in range(num_warmups):
+            result = impl.run()
+        fence(result)
+
+        # profiler window (reference cudaProfilerStart/Stop window,
+        # benchmark.py:87-104 -> jax.profiler trace for xprof/tensorboard)
+        if profile_dir:
+            with jax.profiler.trace(profile_dir):
+                for _ in range(5):
+                    result = impl.run()
+                fence(result)
+            # re-warm after tracing overhead (reference benchmark.py:121-122)
+            for _ in range(num_warmups):
+                result = impl.run()
+            fence(result)
+
+        times_ms = _timing_loop(
+            impl, runtime, num_iterations, timing_backend, barrier_each
+        )
+        times_ms = _max_reduce_across_processes(times_ms, runtime)
+
+        valid = True
+        if do_validate:
+            result = impl.run()
+            fence(result)
+            valid = bool(impl.validate(result))
+            if not valid:
+                # soft failure: recorded, not fatal (reference
+                # benchmark.py:242-245)
+                print(f"[ddlb_tpu] WARNING: validation failed for {impl_id}")
+    except Exception as exc:  # crash isolation: report as a row
+        error = f"{type(exc).__name__}: {exc}"
+        times_ms = np.array([float("nan")])
+        valid = False
+
+    # TFLOPS = 2*m*n*k / 1e9 / time_ms (reference benchmark.py:209-214)
+    flop_scale = 2.0 * m * n * k / 1e9
+    tflops = flop_scale / times_ms
+
+    row = {
+        "implementation": impl_id,
+        "mean time (ms)": float(np.mean(times_ms)),
+        "std time (ms)": float(np.std(times_ms)),
+        "min time (ms)": float(np.min(times_ms)),
+        "max time (ms)": float(np.max(times_ms)),
+        "m": m,
+        "n": n,
+        "k": k,
+        "dtype": dtype,
+        "Throughput (TFLOPS)": float(np.mean(tflops)),
+        "Throughput std (TFLOPS)": float(np.std(tflops)),
+        "world_size": runtime.num_devices,
+        "num_processes": runtime.num_processes,
+        "hostname": socket.gethostname(),
+        "platform": runtime.platform,
+        "time_measurement_backend": timing_backend,
+        "barrier_at_each_iteration": barrier_each,
+        "option": option_repr,
+        "valid": valid,
+    }
+    if error:
+        row["error"] = error
+    del impl, result
+    return row
+
+
+def _timing_loop(impl, runtime, num_iterations, backend, barrier_each):
+    """The measured region (reference hot loop, benchmark.py:124-188)."""
+    times = np.empty(num_iterations, dtype=np.float64)
+    if backend == "host_clock" and barrier_each:
+        # per-iteration: barrier, then time one run to completion
+        # (reference cpu_clock+barrier, benchmark.py:161-172)
+        for i in range(num_iterations):
+            runtime.barrier()
+            t0 = time.perf_counter()
+            fence(impl.run())
+            times[i] = (time.perf_counter() - t0) * 1e3
+        return times
+    if backend == "host_clock":
+        # sync once, run N iterations back to back, sync, divide
+        # (reference cpu_clock no-barrier, benchmark.py:173-186)
+        runtime.barrier()
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(num_iterations):
+            out = impl.run()
+        fence(out)
+        times[:] = (time.perf_counter() - t0) * 1e3 / num_iterations
+        return times
+    # device_loop: the CUDA-event analogue done the XLA way — the whole
+    # N-iteration loop compiles into one device program and a differential
+    # two-window measurement cancels dispatch/fence overhead (see
+    # utils/timing.py). The barrier flag is irrelevant: iterations are
+    # device-side chained.
+    fn, args = impl.timed_call()
+    runtime.barrier()
+    times[:] = measure_device_loop(fn, args, num_iterations)
+    return times
+
+
+def _max_reduce_across_processes(times_ms: np.ndarray, runtime) -> np.ndarray:
+    """Reported time is the slowest process's (reference all_reduce(MAX),
+    benchmark.py:190-204)."""
+    if runtime.num_processes <= 1:
+        return times_ms
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(times_ms)
+    return np.max(gathered, axis=0)
+
+
+def _format_options(options: Dict[str, Any]) -> str:
+    return ";".join(f"{k}={v}" for k, v in sorted(options.items())) or "-"
+
+
+def _subprocess_worker(config, queue):  # pragma: no cover - child process
+    queue.put(benchmark_worker(config))
+
+
+# ---------------------------------------------------------------------------
+# Runner (reference PrimitiveBenchmarkRunner, benchmark.py:264-425)
+# ---------------------------------------------------------------------------
+
+
+class PrimitiveBenchmarkRunner:
+    """Run one (primitive, shape) across many implementations."""
+
+    ALLOWED_PRIMITIVES = set(ALLOWED_PRIMITIVES)
+
+    def __init__(
+        self,
+        primitive: str,
+        m: int,
+        n: int,
+        k: int,
+        implementations: Dict[str, Dict[str, Any]],
+        dtype: str = "bfloat16",
+        num_iterations: int = 50,
+        num_warmups: int = 5,
+        validate: bool = True,
+        time_measurement_backend: str = "host_clock",
+        barrier_at_each_iteration: bool = True,
+        output_csv: Optional[str] = None,
+        profile_dir: Optional[str] = None,
+        isolation: str = "none",
+        progress: bool = True,
+    ) -> None:
+        if primitive not in self.ALLOWED_PRIMITIVES:
+            raise ValueError(
+                f"Unknown primitive '{primitive}'. "
+                f"Allowed: {sorted(self.ALLOWED_PRIMITIVES)}"
+            )
+        if isolation not in ("none", "subprocess"):
+            raise ValueError("isolation must be 'none' or 'subprocess'")
+        self.primitive = primitive
+        self.m, self.n, self.k = m, n, k
+        self.implementations = implementations
+        self.dtype = dtype
+        self.num_iterations = num_iterations
+        self.num_warmups = num_warmups
+        self.validate = validate
+        self.time_measurement_backend = time_measurement_backend
+        self.barrier_at_each_iteration = barrier_at_each_iteration
+        self.output_csv = output_csv
+        self.profile_dir = profile_dir
+        self.isolation = isolation
+        self.progress = progress
+
+    def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
+        spec = dict(spec)
+        base_impl = spec.pop("implementation", impl_id.rsplit("_", 1)[0])
+        return {
+            "primitive": self.primitive,
+            "impl_id": impl_id,
+            "base_implementation": base_impl,
+            "options": spec,
+            "m": self.m,
+            "n": self.n,
+            "k": self.k,
+            "dtype": self.dtype,
+            "num_iterations": self.num_iterations,
+            "num_warmups": self.num_warmups,
+            "validate": self.validate,
+            "time_measurement_backend": self.time_measurement_backend,
+            "barrier_at_each_iteration": self.barrier_at_each_iteration,
+            "profile_dir": self.profile_dir,
+        }
+
+    def run(self):
+        """Benchmark every implementation; returns a pandas DataFrame."""
+        import pandas as pd
+
+        from ddlb_tpu.envs import get_process_id
+
+        is_primary = get_process_id() == 0
+        items = list(self.implementations.items())
+        iterator = items
+        if self.progress and is_primary:
+            try:
+                from tqdm import tqdm
+
+                iterator = tqdm(items, desc=f"{self.primitive} impls")
+            except ImportError:  # pragma: no cover
+                pass
+
+        rows: List[Dict[str, Any]] = []
+        for impl_id, spec in iterator:
+            config = self._worker_config(impl_id, spec)
+            row = self._run_one(config)
+            rows.append(row)
+            if is_primary:
+                print(pd.DataFrame([row]).to_string(index=False))
+                if self.output_csv:
+                    # incremental append so a crash loses one row at most
+                    # (reference benchmark.py:375-384)
+                    self._append_csv(row)
+        return pd.DataFrame(rows)
+
+    def _run_one(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        if self.isolation == "subprocess":
+            # full per-implementation process isolation (reference
+            # spawn-per-impl, benchmark.py:336-370)
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            queue = ctx.SimpleQueue()
+            proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
+            proc.start()
+            row = queue.get()
+            proc.join()
+            return row
+        import jax
+
+        row = benchmark_worker(config)
+        jax.clear_caches()  # avoid cross-impl compilation-cache coupling
+        return row
+
+    def _append_csv(self, row: Dict[str, Any]) -> None:
+        import pandas as pd
+
+        path = self.output_csv
+        assert path is not None
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        header = not os.path.exists(path)
+        pd.DataFrame([row]).to_csv(path, mode="a", header=header, index=False)
+
+    # -- plotting (reference plot_results, benchmark.py:391-425) -------------
+
+    @staticmethod
+    def plot_results(df, output_path: str, metric: str = "mean time (ms)"):
+        """Bar chart with error bars per implementation/option."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        labels = [
+            f"{r['implementation']}\n{r['option']}" for _, r in df.iterrows()
+        ]
+        values = df[metric]
+        err = (
+            df["std time (ms)"]
+            if metric == "mean time (ms)" and "std time (ms)" in df
+            else None
+        )
+        fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(labels)), 5))
+        ax.bar(range(len(labels)), values, yerr=err, capsize=3)
+        ax.set_xticks(range(len(labels)))
+        ax.set_xticklabels(labels, rotation=30, ha="right", fontsize=8)
+        ax.set_ylabel(metric)
+        row0 = df.iloc[0]
+        ax.set_title(
+            f"{row0.get('m')}x{row0.get('k')}x{row0.get('n')} "
+            f"{row0.get('dtype')} world={row0.get('world_size')}"
+        )
+        fig.tight_layout()
+        directory = os.path.dirname(output_path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        fig.savefig(output_path, dpi=120)
+        plt.close(fig)
+        return output_path

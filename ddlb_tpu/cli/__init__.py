@@ -1,0 +1,9 @@
+"""CLI package (reference re-export pattern, ddlb/cli/__init__.py:3-5)."""
+
+from ddlb_tpu.cli.benchmark import (  # noqa: F401
+    generate_config_combinations,
+    load_config,
+    main,
+    parse_impl_spec,
+    run_benchmark,
+)

@@ -1,0 +1,284 @@
+"""CLI / config expansion: the three front doors of the framework.
+
+Rebuild of /root/reference/ddlb/cli/benchmark.py:14-320 — JSON config,
+``name;k=v,v`` impl-spec flags, and programmatic dict all normalize into one
+config that is cartesian-expanded over per-implementation option lists and
+over (m, n, k) shape lists. Differences from the reference:
+
+- both primitives are accepted from the flag CLI (the reference restricts
+  ``choices=["tp_columnwise"]`` at cli/benchmark.py:232 even though its JSON
+  path supports tp_rowwise — SURVEY.md section 3.3 flags this as a bug);
+- a ``--sim N`` flag enables the N-device CPU simulation before JAX boots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import time
+from typing import Any, Dict, List, Tuple
+
+# ---------------------------------------------------------------------------
+# Impl-spec parsing (reference cli/benchmark.py:14-83)
+# ---------------------------------------------------------------------------
+
+
+def _infer_scalar(text: str) -> Any:
+    """'true'/'false' -> bool, then int, then float, else str."""
+    low = text.strip().lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(text)
+    except ValueError:
+        pass
+    try:
+        return float(text)
+    except ValueError:
+        pass
+    return text.strip()
+
+
+def _parse_value_list(text: str) -> List[Any]:
+    return [_infer_scalar(v) for v in text.split(",") if v.strip() != ""]
+
+
+def _parse_int_list(values: List[str]) -> List[int]:
+    out: List[int] = []
+    for v in values:
+        out.extend(int(x) for x in str(v).split(",") if x.strip() != "")
+    return out
+
+
+def parse_impl_spec(spec: str) -> Tuple[str, Dict[str, List[Any]]]:
+    """``'overlap;algorithm=coll_pipeline,p2p_pipeline;s=4'`` ->
+    ``('overlap', {'algorithm': [...], 's': [4]})``."""
+    parts = [p for p in spec.split(";") if p.strip() != ""]
+    if not parts:
+        raise ValueError(f"Empty implementation spec: {spec!r}")
+    name = parts[0].strip()
+    options: Dict[str, List[Any]] = {}
+    for part in parts[1:]:
+        if "=" not in part:
+            raise ValueError(
+                f"Bad option {part!r} in spec {spec!r} (expected key=value[,value])"
+            )
+        key, _, value = part.partition("=")
+        options[key.strip()] = _parse_value_list(value)
+    return name, options
+
+
+# ---------------------------------------------------------------------------
+# Cartesian expansion (reference generate_config_combinations,
+# cli/benchmark.py:85-118, and impl_id assignment, :166-177)
+# ---------------------------------------------------------------------------
+
+
+def generate_config_combinations(
+    implementations: Dict[str, List[Dict[str, Any]]],
+) -> Dict[str, List[Dict[str, Any]]]:
+    """Expand list-valued options into the cartesian product per block."""
+    expanded: Dict[str, List[Dict[str, Any]]] = {}
+    for impl_name, blocks in implementations.items():
+        expanded[impl_name] = []
+        for block in blocks:
+            list_params = {k: v for k, v in block.items() if isinstance(v, list)}
+            if not list_params:
+                expanded[impl_name].append(dict(block))
+                continue
+            keys = list(list_params)
+            for combo in itertools.product(*(list_params[k] for k in keys)):
+                cfg = dict(block)
+                cfg.update(zip(keys, combo))
+                expanded[impl_name].append(cfg)
+    return expanded
+
+
+def assign_impl_ids(
+    expanded: Dict[str, List[Dict[str, Any]]],
+) -> Dict[str, Dict[str, Any]]:
+    """``{name: [cfg, ...]}`` -> ``{f'{name}_{i}': cfg + implementation key}``."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, configs in expanded.items():
+        for i, cfg in enumerate(configs):
+            cfg = dict(cfg)
+            cfg["implementation"] = name
+            out[f"{name}_{i}"] = cfg
+    return out
+
+
+# ---------------------------------------------------------------------------
+# run_benchmark (reference cli/benchmark.py:120-223)
+# ---------------------------------------------------------------------------
+
+
+def _normalize(config: Dict[str, Any]) -> Dict[str, Any]:
+    return dict(config.get("benchmark", config))
+
+
+def _as_list(value) -> List[int]:
+    return [int(v) for v in (value if isinstance(value, list) else [value])]
+
+
+def run_benchmark(config: Dict[str, Any]):
+    """Run the full sweep described by ``config``; returns a DataFrame."""
+    import pandas as pd
+
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+    from ddlb_tpu.envs import get_process_id
+
+    cfg = _normalize(config)
+    primitive = cfg.get("primitive", "tp_columnwise")
+    dtype = cfg.get("dtype", "bfloat16")
+    sim = cfg.get("sim")
+    if sim:
+        from ddlb_tpu.runtime import enable_simulation
+
+        enable_simulation(int(sim))
+
+    expanded = generate_config_combinations(cfg.get("implementations", {}))
+    impl_map = assign_impl_ids(expanded)
+    if not impl_map:
+        raise ValueError("Config contains no implementations")
+
+    ms, ns, ks = _as_list(cfg.get("m", 8192)), _as_list(cfg.get("n", 8192)), _as_list(cfg.get("k", 8192))
+    shapes = list(itertools.product(ms, ns, ks))
+
+    # CSV path with {timestamp} token and shape-derived default
+    # (reference cli/benchmark.py:179-188)
+    timestamp = time.strftime("%Y%m%d_%H%M%S")
+    output_csv = cfg.get("output_csv")
+    if output_csv is None:
+        m0, n0, k0 = shapes[0]
+        output_csv = (
+            f"results/{primitive}_{m0}x{k0}x{n0}_{dtype}_{timestamp}.csv"
+        )
+    output_csv = output_csv.replace("{timestamp}", timestamp)
+
+    frames = []
+    for m, n, k in shapes:
+        runner = PrimitiveBenchmarkRunner(
+            primitive=primitive,
+            m=m,
+            n=n,
+            k=k,
+            implementations=impl_map,
+            dtype=dtype,
+            num_iterations=cfg.get("num_iterations", 50),
+            num_warmups=cfg.get("num_warmups", 5),
+            validate=cfg.get("validate", True),
+            time_measurement_backend=cfg.get(
+                "time_measurement_backend", "host_clock"
+            ),
+            barrier_at_each_iteration=cfg.get("barrier_at_each_iteration", True),
+            output_csv=output_csv,
+            profile_dir=cfg.get("profile_dir"),
+            isolation=cfg.get("isolation", "none"),
+            progress=cfg.get("progress", True),
+        )
+        frames.append(runner.run())
+
+    df = pd.concat(frames, ignore_index=True)
+    if get_process_id() == 0:
+        # final aggregated table, fixed column order
+        # (reference cli/benchmark.py:214-223)
+        columns = [
+            "implementation",
+            "option",
+            "m",
+            "n",
+            "k",
+            "dtype",
+            "mean time (ms)",
+            "std time (ms)",
+            "Throughput (TFLOPS)",
+            "world_size",
+            "valid",
+        ]
+        print("\n=== Benchmark results ===")
+        print(df[[c for c in columns if c in df]].to_string(index=False))
+        print(f"\nResults written to {output_csv}")
+    return df
+
+
+# ---------------------------------------------------------------------------
+# argparse entry (reference cli/benchmark.py:226-320)
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> None:
+    from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+
+    parser = argparse.ArgumentParser(
+        description="TPU-native tensor-parallel GEMM primitive benchmark"
+    )
+    parser.add_argument(
+        "--primitive",
+        default="tp_columnwise",
+        choices=list(ALLOWED_PRIMITIVES),  # both allowed (reference bug fixed)
+    )
+    parser.add_argument(
+        "--impl",
+        action="append",
+        default=None,
+        metavar="NAME[;OPT=V1,V2...]",
+        help="implementation spec; repeatable",
+    )
+    parser.add_argument("-m", action="append", default=None)
+    parser.add_argument("-n", action="append", default=None)
+    parser.add_argument("-k", action="append", default=None)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--num-iterations", type=int, default=50)
+    parser.add_argument("--num-warmups", type=int, default=5)
+    parser.add_argument("--no-validate", action="store_true")
+    parser.add_argument(
+        "--timing", default="host_clock", choices=["host_clock", "device_loop"]
+    )
+    parser.add_argument("--no-barrier", action="store_true")
+    parser.add_argument("--csv", default=None, help="output CSV ({timestamp} token)")
+    parser.add_argument("--profile-dir", default=None)
+    parser.add_argument(
+        "--isolation", default="none", choices=["none", "subprocess"]
+    )
+    parser.add_argument(
+        "--sim", type=int, default=None, metavar="N",
+        help="run on an N-device CPU simulation",
+    )
+    args = parser.parse_args(argv)
+
+    impl_specs = args.impl or ["jax_spmd"]
+    implementations: Dict[str, List[Dict[str, Any]]] = {}
+    for spec in impl_specs:
+        name, options = parse_impl_spec(spec)
+        implementations.setdefault(name, []).append(options)
+
+    config = {
+        "primitive": args.primitive,
+        "m": _parse_int_list(args.m or ["1024"]),
+        "n": _parse_int_list(args.n or ["1024"]),
+        "k": _parse_int_list(args.k or ["1024"]),
+        "dtype": args.dtype,
+        "num_iterations": args.num_iterations,
+        "num_warmups": args.num_warmups,
+        "validate": not args.no_validate,
+        "time_measurement_backend": args.timing,
+        "barrier_at_each_iteration": not args.no_barrier,
+        "implementations": implementations,
+        "output_csv": args.csv,
+        "profile_dir": args.profile_dir,
+        "isolation": args.isolation,
+        "sim": args.sim,
+    }
+    run_benchmark(config)
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+if __name__ == "__main__":
+    main()

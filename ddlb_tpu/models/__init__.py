@@ -1,0 +1,9 @@
+"""Model compositions built from the framework's parallel primitives."""
+
+from ddlb_tpu.models.tp_mlp import (  # noqa: F401
+    example_batch,
+    init_params,
+    make_train_step,
+    mlp_block,
+    mlp_forward,
+)

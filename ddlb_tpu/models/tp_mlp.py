@@ -1,0 +1,163 @@
+"""Flagship model: Megatron-style tensor-parallel MLP block.
+
+The reference has no model at all — its workloads are bare GEMM primitives
+(SURVEY.md section 2.5). This module shows the framework's two primitives
+composed into the structure they exist to accelerate: the sequence-parallel
+transformer MLP, where the up-projection is exactly ``tp_columnwise``
+(all-gather the sequence-sharded activations, GEMM against a column-sharded
+weight) and the down-projection is exactly ``tp_rowwise`` (GEMM against a
+row-sharded weight, reduce-scatter back to sequence-sharded) — the pairing
+the reference frames via TransformerEngine's ``sequence_parallel=True``
+Linear layers (/root/reference/ddlb/primitives/TPColumnwise/
+transformer_engine.py:58-72, TPRowwise/transformer_engine.py:66-81).
+
+Two forms are provided:
+
+- ``mlp_block`` — explicit ``shard_map`` body (mirrors the jax_spmd
+  primitive implementations);
+- ``train_step`` — GSPMD form over a (dp, tp) mesh with sequence-parallel
+  activation shardings, differentiable end to end, used by the multi-chip
+  dry run.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_params(
+    d_model: int, d_ff: int, dtype=jnp.bfloat16, seed: int = 0
+) -> Dict[str, Any]:
+    """Seeded host-side parameter construction (deterministic across hosts,
+    like the primitive operands)."""
+    rng = np.random.default_rng(seed)
+    scale1 = (2.0 / d_model) ** 0.5
+    scale2 = (2.0 / d_ff) ** 0.5
+    return {
+        "w1": jnp.asarray(
+            rng.normal(0.0, scale1, (d_model, d_ff)), dtype=dtype
+        ),
+        "w2": jnp.asarray(
+            rng.normal(0.0, scale2, (d_ff, d_model)), dtype=dtype
+        ),
+    }
+
+
+def mlp_forward(x, w1, w2):
+    """Single-device reference forward: ``gelu(x @ w1) @ w2``."""
+    h = jax.nn.gelu(
+        jnp.matmul(x, w1, preferred_element_type=jnp.float32).astype(x.dtype)
+    )
+    return jnp.matmul(h, w2, preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def mlp_block(mesh, axis_name: str = "tp"):
+    """Explicit sequence-parallel MLP as a ``shard_map``-able function.
+
+    Input/output activations are sequence-sharded over ``axis_name``; w1 is
+    column-sharded, w2 row-sharded. Internally: all-gather (the
+    tp_columnwise pattern) -> GEMM -> gelu -> GEMM -> psum_scatter (the
+    tp_rowwise pattern).
+    """
+
+    def block(x_local, w1_local, w2_local):
+        x_full = jax.lax.all_gather(x_local, axis_name, axis=0, tiled=True)
+        h = jax.nn.gelu(
+            jnp.matmul(
+                x_full, w1_local, preferred_element_type=jnp.float32
+            ).astype(x_local.dtype)
+        )
+        y_partial = jnp.matmul(h, w2_local, preferred_element_type=jnp.float32)
+        y = jax.lax.psum_scatter(
+            y_partial, axis_name, scatter_dimension=0, tiled=True
+        )
+        return y.astype(x_local.dtype)
+
+    return jax.shard_map(
+        block,
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(None, axis_name), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+        check_vma=False,
+    )
+
+
+def make_train_step(mesh, learning_rate: float = 1e-3):
+    """Full GSPMD training step over a ``(dp, tp)`` mesh.
+
+    Layouts: batch data-parallel over ``dp``; the sequence dimension of
+    activations sharded over ``tp`` outside the matmuls (sequence
+    parallelism); w1/w2 tensor-parallel. GSPMD inserts the
+    all-gather/reduce-scatter pair in forward and the mirrored pair plus
+    gradient all-reduces in backward.
+    """
+    import optax
+
+    optimizer = optax.sgd(learning_rate)
+
+    # GSPMD implicit propagation: rebuild the mesh with Auto axis types
+    # (JAX 0.9 defaults to Explicit sharding-in-types, which would demand
+    # per-op out_shardings through the whole train step).
+    from jax.sharding import AxisType, Mesh
+
+    mesh = Mesh(
+        mesh.devices,
+        mesh.axis_names,
+        axis_types=(AxisType.Auto,) * len(mesh.axis_names),
+    )
+
+    x_sharding = NamedSharding(mesh, P("dp", "tp", None))
+    w1_sharding = NamedSharding(mesh, P(None, "tp"))
+    w2_sharding = NamedSharding(mesh, P("tp", None))
+
+    def loss_fn(params, x, target):
+        h = jax.nn.gelu(
+            jnp.matmul(
+                x, params["w1"], preferred_element_type=jnp.float32
+            ).astype(x.dtype)
+        )
+        out = jnp.matmul(h, params["w2"], preferred_element_type=jnp.float32)
+        # sequence-parallel activations: keep the output sequence-sharded
+        out = jax.lax.with_sharding_constraint(
+            out.astype(x.dtype), x_sharding
+        )
+        return jnp.mean(jnp.square(out.astype(jnp.float32) - target))
+
+    @partial(
+        jax.jit,
+        in_shardings=(
+            {"w1": w1_sharding, "w2": w2_sharding},
+            None,
+            x_sharding,
+            x_sharding,
+        ),
+        donate_argnums=(0, 1),
+    )
+    def train_step(params, opt_state, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, target)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    return train_step, init_opt_state, (x_sharding, w1_sharding, w2_sharding)
+
+
+def example_batch(
+    batch: int, seq: int, d_model: int, dtype=jnp.bfloat16, seed: int = 1
+) -> Tuple[jax.Array, jax.Array]:
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(0, 1, (batch, seq, d_model)), dtype=dtype)
+    t = jnp.asarray(
+        rng.normal(0, 1, (batch, seq, d_model)), dtype=jnp.float32
+    )
+    return x, t

@@ -85,11 +85,17 @@ class Primitive(ABC):
 
     @abstractmethod
     def _input_setup(self) -> None:
-        """Construct and shard operands."""
+        """Construct and shard operands; must set ``self.a``, ``self.b`` and
+        the jitted step ``self._fn``."""
 
-    @abstractmethod
     def run(self):
         """Execute one iteration; returns the (possibly sharded) result array."""
+        return self._fn(self.a, self.b)
+
+    def timed_call(self):
+        """(fn, args) pair for the on-device measured loop
+        (``utils.timing.make_timed_loop``)."""
+        return self._fn, (self.a, self.b)
 
     @abstractmethod
     def validate(self, result) -> bool:

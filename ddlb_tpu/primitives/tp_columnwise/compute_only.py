@@ -32,9 +32,6 @@ class ComputeOnlyTPColumnwise(TPColumnwise):
         self._fn = jax.jit(jnp.matmul)
         jax.block_until_ready((self.a, self.b))
 
-    def run(self):
-        return self._fn(self.a, self.b)
-
     def validate(self, result) -> bool:
         if self.options["size"] == "sharded":
             # Partial-shape result; reference skips validation here

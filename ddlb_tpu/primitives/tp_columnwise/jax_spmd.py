@@ -52,5 +52,3 @@ class JaxSPMDTPColumnwise(TPColumnwise):
             )
         )
 
-    def run(self):
-        return self._fn(self.a, self.b)

@@ -33,5 +33,3 @@ class XLAGSPMDTPColumnwise(TPColumnwise):
             out_shardings=NamedSharding(self.mesh, P(None, None)),
         )
 
-    def run(self):
-        return self._fn(self.a, self.b)

@@ -33,9 +33,6 @@ class ComputeOnlyTPRowwise(TPRowwise):
         self._fn = jax.jit(jnp.matmul)
         jax.block_until_ready((self.a, self.b))
 
-    def run(self):
-        return self._fn(self.a, self.b)
-
     def validate(self, result) -> bool:
         if self.options["size"] == "sharded":
             return True

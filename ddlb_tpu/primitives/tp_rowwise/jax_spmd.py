@@ -39,5 +39,3 @@ class JaxSPMDTPRowwise(TPRowwise):
             )
         )
 
-    def run(self):
-        return self._fn(self.a, self.b)

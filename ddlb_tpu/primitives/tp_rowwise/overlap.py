@@ -1,0 +1,201 @@
+"""Comm/compute-overlap pipelines for GEMM+RS (the nvFuser slot).
+
+TPU-native re-creation of the reference's tp_rowwise nvFuser algorithms
+(/root/reference/ddlb/primitives/TPRowwise/fuser.py:15-169) as ``shard_map``
+programs — see the tp_columnwise overlap module docstring for the design
+stance. The sequence (M) dimension is what gets tiled, so these pipelines
+are exactly the reference's long-context mechanism (SURVEY.md section 5,
+"long-context / sequence parallelism").
+
+- ``default``: one partial GEMM + one ``psum_scatter``
+  (MatmulRsFusion, fuser.py:15-60).
+- ``coll_pipeline``: s stages; stage i GEMMs the stage's row-slab of the
+  partial product and reduce-scatters it while the next stage's GEMM runs
+  (MatmulRsCollectiveBasedPipelineFusion, fuser.py:62-114).
+- ``p2p_pipeline``: ring reduce-scatter — partial sums of each output chunk
+  travel the ring, each device adding its local contribution, overlapped
+  with the next chunk's GEMM; the number of ring steps is the world size,
+  matching the reference forcing ``s = world_size`` for p2p
+  (fuser.py:256-258). ``direction='bidirectional'`` runs both ring
+  directions with half-chunks (TPU torus improvement, no reference
+  analogue).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+
+
+def _accum_dtypes(operand_dtype):
+    """(accumulator, wire) dtypes for the ring partial sums.
+
+    Floating operands accumulate in float32 — matching the MXU's native
+    accumulation — while the ring wire stays in the operand dtype so the
+    communicated volume matches the reference's ring exchange. Integer
+    operands are exact and stay put.
+    """
+    if jnp.issubdtype(operand_dtype, jnp.integer):
+        return jnp.int32, operand_dtype
+    return jnp.float32, operand_dtype
+
+
+class OverlapTPRowwise(TPRowwise):
+    DEFAULT_OPTIONS = {
+        "algorithm": "coll_pipeline",
+        "s": 8,
+        "direction": "unidirectional",
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["default", "coll_pipeline", "p2p_pipeline"],
+        "s": (1, None),
+        "direction": ["unidirectional", "bidirectional"],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        d = self.num_partitions
+        algo = self.options["algorithm"]
+        if algo == "coll_pipeline" and self.m % (d * self.options["s"]) != 0:
+            raise ValueError(
+                f"m={self.m} must be divisible by partitions*s="
+                f"{d * self.options['s']} for coll_pipeline"
+            )
+        if (
+            algo == "p2p_pipeline"
+            and self.options["direction"] == "bidirectional"
+            and self.m % (2 * d) != 0
+        ):
+            raise ValueError(
+                f"m={self.m} must be divisible by 2*partitions={2 * d} "
+                f"for bidirectional p2p_pipeline"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        algo = self.options["algorithm"]
+        build = {
+            "default": self._build_default,
+            "coll_pipeline": self._build_coll_pipeline,
+            "p2p_pipeline": self._build_p2p_pipeline,
+        }[algo]
+        self._fn = jax.jit(
+            jax.shard_map(
+                build(),
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )
+
+    # -- algorithms ----------------------------------------------------------
+
+    def _build_default(self):
+        def step(a_shard, b_shard):
+            partial = a_shard @ b_shard
+            return jax.lax.psum_scatter(
+                partial, "tp", scatter_dimension=0, tiled=True
+            )
+
+        return step
+
+    def _build_coll_pipeline(self):
+        d = self.num_partitions
+        s = self.options["s"]
+        b_rows = self.m // (d * s)
+        kd = self.k // d
+
+        def step(a_shard, b_shard):
+            # a_shard: [m, k/d]. Stage i needs the rows that will land as
+            # local stage-i rows on every rank: view [d, s, b_rows, k/d].
+            chunks = a_shard.reshape(d, s, b_rows, kd)
+            outs = []
+            for i in range(s):
+                slab = chunks[:, i].reshape(d * b_rows, kd)
+                partial = slab @ b_shard  # [d*b_rows, n] partial sums
+                outs.append(
+                    jax.lax.psum_scatter(
+                        partial, "tp", scatter_dimension=0, tiled=True
+                    )
+                )  # [b_rows, n] — this rank's stage-i rows, fully reduced
+            # local row order is stage-major: [s, b_rows, n] -> [m/d, n]
+            return jnp.stack(outs).reshape(self.m // d, self.n)
+
+        return step
+
+    def _build_p2p_pipeline(self):
+        if self.options["direction"] == "bidirectional":
+            return self._build_p2p_bidirectional()
+        d = self.num_partitions
+        b_rows = self.m // d
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+
+        def step(a_shard, b_shard):
+            my = jax.lax.axis_index("tp")
+            acc_t, wire_t = _accum_dtypes(a_shard.dtype)
+            acc = jnp.zeros((b_rows, self.n), acc_t)
+            for t in range(d):
+                # chunk schedule c_t = (my + d - 1 - t) mod d makes the
+                # accumulator that each device holds at the END be its own
+                # output chunk, fully reduced after d ring steps.
+                c = (my + d - 1 - t) % d
+                rows = jax.lax.dynamic_slice_in_dim(
+                    a_shard, c * b_rows, b_rows, axis=0
+                )
+                acc = acc + jnp.matmul(
+                    rows, b_shard, preferred_element_type=acc_t
+                )
+                if t + 1 < d:
+                    # pass partial sums onward while the next GEMM runs;
+                    # wire stays in the operand dtype (comm-volume parity
+                    # with the reference ring), accumulation stays f32 as
+                    # on the MXU.
+                    acc = jax.lax.ppermute(
+                        acc.astype(wire_t), "tp", perm=fwd
+                    ).astype(acc_t)
+            return acc.astype(a_shard.dtype)
+
+        return step
+
+    def _build_p2p_bidirectional(self):
+        d = self.num_partitions
+        b_rows = self.m // d
+        half = b_rows // 2
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+        bwd = [(i, (i - 1) % d) for i in range(d)]
+
+        def step(a_shard, b_shard):
+            my = jax.lax.axis_index("tp")
+            acc_t, wire_t = _accum_dtypes(a_shard.dtype)
+            acc_f = jnp.zeros((half, self.n), acc_t)
+            acc_r = jnp.zeros((half, self.n), acc_t)
+            for t in range(d):
+                cf = (my + d - 1 - t) % d  # forward-ring chunk schedule
+                cr = (my + t + 1) % d      # backward-ring chunk schedule
+                rows_f = jax.lax.dynamic_slice_in_dim(
+                    a_shard, cf * b_rows, half, axis=0
+                )
+                rows_r = jax.lax.dynamic_slice_in_dim(
+                    a_shard, cr * b_rows + half, half, axis=0
+                )
+                acc_f = acc_f + jnp.matmul(
+                    rows_f, b_shard, preferred_element_type=acc_t
+                )
+                acc_r = acc_r + jnp.matmul(
+                    rows_r, b_shard, preferred_element_type=acc_t
+                )
+                if t + 1 < d:
+                    acc_f = jax.lax.ppermute(
+                        acc_f.astype(wire_t), "tp", perm=fwd
+                    ).astype(acc_t)
+                    acc_r = jax.lax.ppermute(
+                        acc_r.astype(wire_t), "tp", perm=bwd
+                    ).astype(acc_t)
+            return jnp.concatenate([acc_f, acc_r], axis=0).astype(a_shard.dtype)
+
+        return step
+

@@ -42,5 +42,3 @@ class XLAGSPMDTPRowwise(TPRowwise):
             out_shardings=NamedSharding(self.mesh, P("tp", None)),
         )
 
-    def run(self):
-        return self._fn(self.a, self.b)

@@ -1,0 +1,117 @@
+"""Timing subsystem: completion fences and on-device measured loops.
+
+The reference measures with CUDA events or perf_counter + device sync
+(/root/reference/ddlb/benchmark.py:124-188). TPU equivalents:
+
+- ``fence``: force device completion. ``jax.block_until_ready`` alone is
+  not trustworthy on every PJRT plugin (remote/experimental platforms can
+  return before execution finishes), so the fence additionally fetches one
+  element per addressable shard — a few-byte transfer that cannot complete
+  before the producing executable does.
+- ``make_timed_loop``: the CUDA-event analogue done the XLA way — compile
+  the N-iteration measurement loop into ONE device program
+  (``lax.fori_loop``), with a deliberate cross-iteration data dependency so
+  the compiler cannot hoist the op out of the loop, and read a single
+  scalar out. Two windows (N and N/4) give a differential per-iteration
+  time that cancels dispatch, fence, and RPC overhead entirely — this is
+  what makes measurements stable even over a high-jitter remote relay.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+
+def fence(tree: Any) -> None:
+    """Block until every array in ``tree`` has actually been produced."""
+    import jax
+
+    jax.block_until_ready(tree)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "addressable_shards"):
+            continue
+        for shard in leaf.addressable_shards:
+            data = shard.data
+            first = data[(0,) * data.ndim] if data.ndim else data
+            np.asarray(first)  # tiny host fetch = real completion proof
+
+
+def make_timed_loop(fn: Callable, args: Tuple, num_iterations: int):
+    """Compile ``num_iterations`` dependent invocations of ``fn(*args)`` into
+    one jitted program returning a scalar.
+
+    The first argument gets one element perturbed by (0 x the previous
+    iteration's checksum) each step — numerically a no-op, but an explicit
+    data dependency that defeats loop-invariant code motion, so XLA really
+    executes N iterations.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    first, rest = args[0], tuple(args[1:])
+
+    def consume(leaf, i):
+        """Scalar depending on ``leaf``, read at a loop-variant position.
+
+        A static-index consume lets XLA narrow the producing dot
+        (slice-of-dot -> dot-of-slice) and a full reduction adds a read
+        pass per iteration; a dynamic index defeats both (verified against
+        a chained-GEMM ground truth on hardware). Sharded dims are kept
+        whole (explicit sharding forbids size-1 slices across a mesh axis);
+        the closing reduction over that thin sliver auto-inserts the tiny
+        collective.
+        """
+        try:
+            spec = tuple(jax.typeof(leaf).sharding.spec)
+        except Exception:
+            spec = (None,) * leaf.ndim
+        spec = tuple(spec) + (None,) * (leaf.ndim - len(spec))
+        starts = tuple(
+            jnp.int32(0) if spec[d] is not None else i % leaf.shape[d]
+            for d in range(leaf.ndim)
+        )
+        sizes = tuple(
+            leaf.shape[d] if spec[d] is not None else 1
+            for d in range(leaf.ndim)
+        )
+        sliver = jax.lax.dynamic_slice(leaf, starts, sizes)
+        return jnp.sum(sliver, dtype=jnp.float32).reshape(())
+
+    def timed(first_arg, *rest_args):
+        def body(i, a):
+            out = fn(a, *rest_args)
+            s = consume(jax.tree_util.tree_leaves(out)[0], i)
+            # Poison: numerically zero (<=1e-38, flushes in every dtype)
+            # but not provably zero, so the compiler cannot fold it away
+            # and every iteration depends on the previous one's output.
+            eps = jnp.minimum(jnp.abs(s), jnp.float32(1e-30)) * jnp.float32(1e-8)
+            return a + eps.astype(a.dtype)
+        a = jax.lax.fori_loop(0, num_iterations, body, first_arg)
+        return consume(jax.tree_util.tree_leaves(a)[0], jnp.int32(0))
+
+    return jax.jit(timed), (first,) + rest
+
+
+def measure_device_loop(
+    fn: Callable, args: Tuple, num_iterations: int
+) -> float:
+    """Differential two-window measurement; returns ms per iteration."""
+    small = max(1, num_iterations // 4)
+    if small == num_iterations:
+        small = 0
+    loop_big, call_args = make_timed_loop(fn, args, num_iterations)
+    t_small = 0.0
+    if small:
+        loop_small, _ = make_timed_loop(fn, args, small)
+        float(loop_small(*call_args))  # warm compile
+        t0 = time.perf_counter()
+        float(loop_small(*call_args))
+        t_small = time.perf_counter() - t0
+    float(loop_big(*call_args))  # warm compile
+    t0 = time.perf_counter()
+    float(loop_big(*call_args))
+    t_big = time.perf_counter() - t0
+    return (t_big - t_small) * 1e3 / (num_iterations - small)

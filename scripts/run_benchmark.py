@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""JSON-config entry point (reference /root/reference/scripts/run_benchmark.py:10-32).
+
+Usage:
+    python scripts/run_benchmark.py [config.json]
+
+On a TPU pod, launch one process per host (the reference's ``mpirun -np N``
+becomes the pod runtime or SLURM starting N host processes; ``ddlb_tpu``
+reads the same env fallback chains).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ddlb_tpu.cli import load_config, run_benchmark
+
+
+def main() -> None:
+    config_path = (
+        sys.argv[1]
+        if len(sys.argv) > 1
+        else os.path.join(os.path.dirname(__file__), "config.json")
+    )
+    run_benchmark(load_config(config_path))
+
+
+if __name__ == "__main__":
+    main()

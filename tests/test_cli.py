@@ -1,0 +1,67 @@
+"""CLI parsing and config expansion (reference cli/benchmark.py:14-118).
+
+The reference has no tests for any of this (SURVEY.md section 4); these
+codify the spec-parsing and cartesian-expansion semantics.
+"""
+
+import pytest
+
+from ddlb_tpu.cli.benchmark import (
+    _infer_scalar,
+    assign_impl_ids,
+    generate_config_combinations,
+    parse_impl_spec,
+)
+
+
+def test_infer_scalar():
+    assert _infer_scalar("true") is True
+    assert _infer_scalar("False") is False
+    assert _infer_scalar("42") == 42
+    assert _infer_scalar("2.5") == 2.5
+    assert _infer_scalar("AG_before") == "AG_before"
+
+
+def test_parse_impl_spec():
+    name, opts = parse_impl_spec("overlap;algorithm=coll_pipeline,p2p_pipeline;s=4")
+    assert name == "overlap"
+    assert opts == {"algorithm": ["coll_pipeline", "p2p_pipeline"], "s": [4]}
+
+
+def test_parse_impl_spec_no_options():
+    name, opts = parse_impl_spec("jax_spmd")
+    assert name == "jax_spmd"
+    assert opts == {}
+
+
+def test_parse_impl_spec_bad_option():
+    with pytest.raises(ValueError, match="expected key=value"):
+        parse_impl_spec("overlap;algorithm")
+
+
+def test_generate_config_combinations():
+    expanded = generate_config_combinations(
+        {
+            "overlap": [
+                {"algorithm": ["coll_pipeline"], "s": [2, 4]},
+                {"algorithm": ["p2p_pipeline"]},
+            ],
+            "jax_spmd": [{}],
+        }
+    )
+    assert len(expanded["overlap"]) == 3
+    assert {"algorithm": "coll_pipeline", "s": 2} in expanded["overlap"]
+    assert {"algorithm": "coll_pipeline", "s": 4} in expanded["overlap"]
+    assert {"algorithm": "p2p_pipeline"} in expanded["overlap"]
+    assert expanded["jax_spmd"] == [{}]
+
+
+def test_assign_impl_ids():
+    impl_map = assign_impl_ids(
+        {"jax_spmd": [{"order": "AG_before"}, {"order": "AG_after"}]}
+    )
+    assert set(impl_map) == {"jax_spmd_0", "jax_spmd_1"}
+    assert impl_map["jax_spmd_1"] == {
+        "order": "AG_after",
+        "implementation": "jax_spmd",
+    }

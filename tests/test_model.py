@@ -1,0 +1,81 @@
+"""Flagship TP-MLP model: shard_map block vs single-device forward, and the
+full GSPMD train step on a (dp, tp) mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def test_mlp_block_matches_reference():
+    from ddlb_tpu.models.tp_mlp import init_params, mlp_block, mlp_forward
+
+    mesh = jax.make_mesh((8,), ("tp",))
+    d_model, d_ff, seq = 64, 128, 64
+    params = init_params(d_model, d_ff, dtype=jnp.float32)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(0, 1, (seq, d_model)), dtype=jnp.float32)
+
+    block = jax.jit(mlp_block(mesh))
+    y = block(x, params["w1"], params["w2"])
+    y_ref = mlp_forward(x, params["w1"], params["w2"])
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=0, atol=1e-4
+    )
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 4), (1, 8), (4, 2)])
+def test_train_step_runs_and_descends(dp, tp):
+    from ddlb_tpu.models.tp_mlp import (
+        example_batch,
+        init_params,
+        make_train_step,
+    )
+
+    mesh = jax.make_mesh((dp, tp), ("dp", "tp"))
+    d_model, d_ff = 32, 64
+    train_step, init_opt, (x_sh, w1_sh, w2_sh) = make_train_step(
+        mesh, learning_rate=0.1
+    )
+    params = init_params(d_model, d_ff, dtype=jnp.float32)
+    params = {
+        "w1": jax.device_put(params["w1"], w1_sh),
+        "w2": jax.device_put(params["w2"], w2_sh),
+    }
+    opt_state = init_opt(params)
+    x, t = example_batch(2 * dp, 8 * tp, d_model, dtype=jnp.float32)
+    x = jax.device_put(x, x_sh)
+    t = jax.device_put(t, x_sh)
+
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = train_step(params, opt_state, x, t)
+        x, t = jax.device_put(x, x_sh), jax.device_put(t, x_sh)  # donated
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # SGD descends on the toy objective
+
+
+def test_graft_entry_single_chip():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fn, args = mod.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == args[0].shape
+
+
+def test_graft_dryrun_multichip():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)

@@ -1,0 +1,62 @@
+"""Overlap-pipeline correctness on the 8-device CPU mesh.
+
+These are the re-creations of the reference's nvFuser pipeline algorithms
+(/root/reference/ddlb/primitives/TPColumnwise/fuser.py:59-146,
+TPRowwise/fuser.py:62-169); chunk-reassembly order is the risky part
+(SURVEY.md section 7 step 7), so validation runs for every algorithm,
+stage count, and ring direction.
+"""
+
+import pytest
+
+from ddlb_tpu.primitives.registry import load_impl_class
+
+M, N, K = 256, 64, 96  # m % (8*4) == 0, k % 8 == 0
+
+
+@pytest.mark.parametrize("algorithm", ["default", "coll_pipeline", "p2p_pipeline"])
+@pytest.mark.parametrize("primitive", ["tp_columnwise", "tp_rowwise"])
+def test_algorithms_validate(primitive, algorithm):
+    cls = load_impl_class(primitive, "overlap")
+    impl = cls(M, N, K, dtype="float32", algorithm=algorithm, s=4)
+    result = impl.run()
+    assert result.shape == (M, N)
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("s", [1, 2, 4])
+@pytest.mark.parametrize("primitive", ["tp_columnwise", "tp_rowwise"])
+def test_coll_pipeline_stage_counts(primitive, s):
+    cls = load_impl_class(primitive, "overlap")
+    impl = cls(M, N, K, dtype="float32", algorithm="coll_pipeline", s=s)
+    assert impl.validate(impl.run())
+
+
+@pytest.mark.parametrize("primitive", ["tp_columnwise", "tp_rowwise"])
+def test_p2p_bidirectional(primitive):
+    cls = load_impl_class(primitive, "overlap")
+    impl = cls(
+        M, N, K, dtype="float32",
+        algorithm="p2p_pipeline", direction="bidirectional",
+    )
+    assert impl.validate(impl.run())
+
+
+def test_bf16_pipelines():
+    for primitive in ("tp_columnwise", "tp_rowwise"):
+        cls = load_impl_class(primitive, "overlap")
+        impl = cls(M, N, K, dtype="bfloat16", algorithm="p2p_pipeline")
+        assert impl.validate(impl.run())
+
+
+def test_coll_pipeline_divisibility():
+    cls = load_impl_class("tp_columnwise", "overlap")
+    # m=256 not divisible by d*s = 8*48
+    with pytest.raises(ValueError, match="divisible by partitions\\*s"):
+        cls(M, N, K, algorithm="coll_pipeline", s=48)
+
+
+def test_stage_count_range():
+    cls = load_impl_class("tp_columnwise", "overlap")
+    with pytest.raises(ValueError, match="outside allowed range"):
+        cls(M, N, K, algorithm="coll_pipeline", s=0)

@@ -1,0 +1,188 @@
+"""End-to-end sweep runner tests on the CPU mesh.
+
+Exercises the full reference call stack 3.1/3.2 (SURVEY.md section 3):
+config -> expansion -> runner -> worker -> timing -> validation -> CSV.
+"""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+
+from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner, benchmark_worker
+from ddlb_tpu.cli.benchmark import run_benchmark
+
+SHAPE = dict(m=128, n=32, k=64)
+
+
+def _worker_config(**over):
+    cfg = {
+        "primitive": "tp_columnwise",
+        "impl_id": "jax_spmd_0",
+        "base_implementation": "jax_spmd",
+        "options": {},
+        "dtype": "float32",
+        "num_iterations": 3,
+        "num_warmups": 1,
+        "validate": True,
+        "time_measurement_backend": "host_clock",
+        "barrier_at_each_iteration": True,
+        "profile_dir": None,
+        **SHAPE,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_worker_row_schema():
+    row = benchmark_worker(_worker_config())
+    for col in (
+        "implementation",
+        "mean time (ms)",
+        "std time (ms)",
+        "min time (ms)",
+        "max time (ms)",
+        "m",
+        "n",
+        "k",
+        "dtype",
+        "Throughput (TFLOPS)",
+        "world_size",
+        "hostname",
+        "time_measurement_backend",
+        "barrier_at_each_iteration",
+        "option",
+        "valid",
+    ):
+        assert col in row, col
+    assert row["valid"] is True
+    assert row["mean time (ms)"] > 0
+    assert row["Throughput (TFLOPS)"] > 0
+    assert row["world_size"] == 8
+
+
+@pytest.mark.parametrize("backend", ["host_clock", "device_loop"])
+@pytest.mark.parametrize("barrier", [True, False])
+def test_timing_backends(backend, barrier):
+    row = benchmark_worker(
+        _worker_config(
+            time_measurement_backend=backend, barrier_at_each_iteration=barrier
+        )
+    )
+    assert row["mean time (ms)"] > 0
+
+
+def test_worker_crash_becomes_row():
+    row = benchmark_worker(_worker_config(options={"order": "bogus"}))
+    assert row["valid"] is False
+    assert "error" in row
+
+
+def test_unknown_timing_backend():
+    with pytest.raises(ValueError, match="timing backend"):
+        benchmark_worker(_worker_config(time_measurement_backend="cuda_event"))
+
+
+def test_runner_csv_and_dataframe(tmp_path):
+    csv = str(tmp_path / "out.csv")
+    runner = PrimitiveBenchmarkRunner(
+        "tp_rowwise",
+        implementations={
+            "jax_spmd_0": {"implementation": "jax_spmd"},
+            "overlap_0": {"implementation": "overlap", "algorithm": "p2p_pipeline"},
+        },
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    df = runner.run()
+    assert len(df) == 2
+    assert df["valid"].all()
+    on_disk = pd.read_csv(csv)
+    assert len(on_disk) == 2  # incremental append, one row per impl
+
+
+def test_runner_rejects_unknown_primitive():
+    with pytest.raises(ValueError, match="Unknown primitive"):
+        PrimitiveBenchmarkRunner(
+            "tp_diagonal", implementations={}, **SHAPE
+        )
+
+
+def test_run_benchmark_config_sweep(tmp_path):
+    csv = str(tmp_path / "sweep_{timestamp}.csv")
+    config = {
+        "benchmark": {
+            "primitive": "tp_columnwise",
+            "m": [128],
+            "n": [32, 64],
+            "k": [64],
+            "dtype": "float32",
+            "num_iterations": 2,
+            "num_warmups": 1,
+            "validate": True,
+            "implementations": {
+                "jax_spmd": [{"order": ["AG_before", "AG_after"]}],
+            },
+            "output_csv": csv,
+            "progress": False,
+        }
+    }
+    df = run_benchmark(config)
+    # 2 shapes x 2 option combos
+    assert len(df) == 4
+    assert df["valid"].all()
+    written = [f for f in os.listdir(tmp_path) if f.endswith(".csv")]
+    assert len(written) == 1
+    assert "{timestamp}" not in written[0]
+
+
+def test_plot_results(tmp_path):
+    df = pd.DataFrame(
+        [
+            {
+                "implementation": "jax_spmd_0",
+                "option": "order=AG_before",
+                "mean time (ms)": 1.0,
+                "std time (ms)": 0.1,
+                "m": 128,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "world_size": 8,
+            }
+        ]
+    )
+    path = PrimitiveBenchmarkRunner.plot_results(df, str(tmp_path / "plot.png"))
+    assert os.path.exists(path)
+
+
+def test_json_script_entry(tmp_path):
+    """scripts/run_benchmark.py end-to-end with a JSON file."""
+    config_path = tmp_path / "cfg.json"
+    config_path.write_text(
+        json.dumps(
+            {
+                "benchmark": {
+                    "primitive": "tp_rowwise",
+                    "m": [128],
+                    "n": [32],
+                    "k": [64],
+                    "dtype": "float32",
+                    "num_iterations": 2,
+                    "num_warmups": 1,
+                    "implementations": {"jax_spmd": [{}]},
+                    "output_csv": str(tmp_path / "r.csv"),
+                    "progress": False,
+                }
+            }
+        )
+    )
+    from ddlb_tpu.cli import load_config
+
+    df = run_benchmark(load_config(str(config_path)))
+    assert len(df) == 1 and df["valid"].all()
