@@ -1,0 +1,3 @@
+"""Hand-written Pallas TPU kernels (the framework's native-code layer)."""
+
+from ddlb_tpu.ops.matmul import matmul  # noqa: F401

@@ -1,0 +1,429 @@
+"""Hand-written ring collective-matmul kernels (RDMA + MXU in one program).
+
+This is the kernel-level re-creation of the reference's nvFuser P2P
+pipelines (/root/reference/ddlb/primitives/TPColumnwise/fuser.py:102-146,
+TPRowwise/fuser.py:116-169): where nvFuser overlaps NCCL/symmetric-memory
+P2P copies with GEMM chunks on CUDA streams, these kernels drive the ICI
+directly with ``pltpu.make_async_remote_copy`` while the MXU computes the
+chunk currently held — communication and compute overlap inside ONE Pallas
+program, no XLA scheduler involved (pallas_guide.md "Patterns: Ring
+Collectives" + "Async Remote DMA").
+
+Layout (inside ``shard_map`` over a 1-D ``axis_name`` ring of d devices):
+
+- ``ring_ag_matmul``: A row-shard ``[m/d, k]`` circulates clockwise through
+  a double-buffered HBM scratch; at step t a device holds chunk
+  ``(my - t) % d``, GEMMs it into the matching output rows via an inner
+  ``emit_pipeline`` (HBM->VMEM tile pipeline), and has already launched the
+  RDMA forwarding it — the AG+GEMM overlap.
+- ``ring_matmul_rs``: partial-sum accumulators circulate instead: at step t
+  a device GEMMs the A rows of chunk ``(my + d - 1 - t) % d`` and adds them
+  into the accumulator just received, then forwards it; after d steps each
+  device holds its own fully-reduced output chunk — the GEMM+RS overlap.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _neighbor_barrier(axis_name: str, d: int) -> None:
+    """Block until both ring neighbors reached this point
+    (pallas_guide.md "Local Barrier Between Neighbors")."""
+    my = jax.lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+    for nb in ((my - 1) % d, (my + 1) % d):
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id=nb,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+    pltpu.semaphore_wait(barrier, 2)
+
+
+def _gemm_pipeline(a_hbm, b_hbm, o_hbm, *, nsteps, bn, bk, acc_ref,
+                   interpret=False):
+    """Inner tiled GEMM ``o = a @ b`` between HBM refs with a VMEM f32
+    accumulator; grid is (n-tiles, k-tiles), k innermost."""
+    m_loc = a_hbm.shape[0]
+
+    if interpret:
+        # emit_pipeline needs a real TPU generation; the interpreter can
+        # read refs wholesale, so compute directly.
+        o_hbm[...] = jnp.dot(
+            a_hbm[...], b_hbm[...], preferred_element_type=jnp.float32
+        ).astype(o_hbm.dtype)
+        return
+
+    def inner(a_ref, b_ref, o_ref):
+        s = pl.program_id(1)
+
+        @pl.when(s == 0)
+        def _zero():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        acc_ref[:] += jnp.dot(
+            a_ref[:], b_ref[:], preferred_element_type=jnp.float32
+        )
+
+        @pl.when(s == nsteps - 1)
+        def _flush():
+            o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+    pltpu.emit_pipeline(
+        inner,
+        grid=(o_hbm.shape[1] // bn, nsteps),
+        in_specs=[
+            pl.BlockSpec((m_loc, bk), lambda j, s: (0, s)),
+            pl.BlockSpec((bk, bn), lambda j, s: (s, j)),
+        ],
+        out_specs=[pl.BlockSpec((m_loc, bn), lambda j, s: (0, j))],
+    )(a_hbm, b_hbm, o_hbm)
+
+
+# ---------------------------------------------------------------------------
+# AG + GEMM ring
+# ---------------------------------------------------------------------------
+
+
+def _ag_matmul_kernel(
+    a_hbm, b_hbm, buf_in, o_hbm, comm_buf, send_sem, recv_sem, copy_sem,
+    credit_sem, acc_ref,
+    *, axis_name: str, d: int, bn: int, bk: int, interpret: bool = False,
+):
+    del buf_in  # aliased with comm_buf (scratch in HBM cannot be allocated
+    # by this toolchain, so the ring buffer is an input/output-aliased pair)
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, d)
+    m_loc, k = a_hbm.shape
+    nsteps = k // bk
+
+    # seed slot 0 with the local shard, then make sure every neighbor's
+    # buffer is seeded before anyone RDMAs into it
+    cp = pltpu.make_async_copy(a_hbm, comm_buf.at[0], copy_sem)
+    cp.start()
+    cp.wait()
+    _neighbor_barrier(axis_name, d)
+
+    left = jax.lax.rem(my - 1 + d, d)
+
+    def step(t, _):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
+
+        @pl.when(t < d - 1)
+        def _send():
+            # Buffer-reuse hazard: our comm_buf[nxt] is the target of this
+            # send on the RIGHT neighbor; it may still be reading it for its
+            # own step t-1 send. A credit from the right neighbor certifies
+            # the target slot is free (first two sends hit fresh buffers).
+            @pl.when(t >= 1)
+            def _credit_gate():
+                pltpu.semaphore_wait(credit_sem, 1)
+
+            # forward the chunk we hold while we GEMM it below
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nxt],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        chunk = jax.lax.rem(my - t + d, d)
+        _gemm_pipeline(
+            comm_buf.at[slot],
+            b_hbm,
+            o_hbm.at[pl.ds(chunk * m_loc, m_loc), :],
+            nsteps=nsteps,
+            bn=bn,
+            bk=bk,
+            acc_ref=acc_ref,
+            interpret=interpret,
+        )
+
+        @pl.when(t < d - 1)
+        def _wait():
+            # next chunk arrived; once our outgoing send has fully read
+            # comm_buf[slot], tell the left neighbor the slot is free
+            pltpu.make_async_copy(
+                comm_buf.at[nxt], comm_buf.at[nxt], recv_sem.at[nxt]
+            ).wait()
+            pltpu.make_async_copy(
+                comm_buf.at[slot], comm_buf.at[slot], send_sem.at[slot]
+            ).wait()
+            pltpu.semaphore_signal(
+                credit_sem,
+                inc=1,
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        return 0
+
+    jax.lax.fori_loop(0, d, step, 0)
+    if d >= 2:
+        # one credit is produced but never consumed (the last send needs no
+        # gate); drain it so the semaphore exits clean
+        pltpu.semaphore_wait(credit_sem, 1)
+
+
+def ring_ag_matmul(
+    a_shard,
+    b,
+    *,
+    axis_name: str = "tp",
+    axis_size: int,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+    collective_id: int = 1,
+):
+    """All-gather + GEMM with kernel-level RDMA/compute overlap.
+
+    Call inside ``shard_map``: ``a_shard [m/d, k]``, ``b [k, n]`` ->
+    ``[m, n]`` (the full product, like order=AG_before).
+    """
+    m_loc, k = a_shard.shape
+    n = b.shape[1]
+    bn, bk = min(block_n, n), min(block_k, k)
+    if n % bn or k % bk:
+        raise ValueError(f"(n={n}, k={k}) not divisible by ({bn}, {bk})")
+    # interpret mode cannot reference ANY/HBM directly nor allocate
+    # ANY-space scratch; its VMEM is unbounded, so everything parks in VMEM
+    # when emulating
+    space = pltpu.VMEM if interpret else pltpu.ANY
+    kernel = functools.partial(
+        _ag_matmul_kernel, axis_name=axis_name, d=axis_size, bn=bn, bk=bk,
+        interpret=bool(interpret),
+    )
+    buf_init = jnp.zeros((2, m_loc, k), a_shard.dtype)
+    out, _ = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc * axis_size, n), a_shard.dtype),
+            jax.ShapeDtypeStruct((2, m_loc, k), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ),
+        # ring double buffer rides as input 2 aliased to output 1
+        input_output_aliases={2: 1},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),            # send
+            pltpu.SemaphoreType.DMA((2,)),            # recv
+            pltpu.SemaphoreType.DMA,                  # local seed copy
+            pltpu.SemaphoreType.REGULAR,              # buffer-free credits
+            pltpu.VMEM((m_loc, bn), jnp.float32),     # GEMM accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(a_shard, b, buf_init)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GEMM + reduce-scatter ring
+# ---------------------------------------------------------------------------
+
+
+def _matmul_rs_kernel(
+    a_hbm, b_hbm, acc_in, part_in, o_hbm, acc_buf, partial_buf, send_sem,
+    recv_sem, copy_sem, credit_sem, acc_ref,
+    *, axis_name: str, d: int, bn: int, bk: int, interpret: bool = False,
+):
+    del acc_in, part_in  # aliased with acc_buf / partial_buf (HBM scratch
+    # cannot be allocated by this toolchain)
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my - 1 + d, d)
+    m, kd = a_hbm.shape
+    m_loc = m // d
+    nsteps = kd // bk
+    n = o_hbm.shape[1]
+
+    _neighbor_barrier(axis_name, d)
+
+    def step(t, _):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
+        # chunk schedule: after d steps each device's accumulator holds its
+        # own chunk, fully reduced (same schedule as the shard_map ring in
+        # primitives/tp_rowwise/overlap.py)
+        chunk = jax.lax.rem(my + d - 1 - t, d)
+
+        # 1. partial = A[chunk rows] @ B — overlaps the inbound acc RDMA
+        #    and our still-in-flight send from step t-1
+        _gemm_pipeline(
+            a_hbm.at[pl.ds(chunk * m_loc, m_loc), :],
+            b_hbm,
+            partial_buf,
+            nsteps=nsteps,
+            bn=bn,
+            bk=bk,
+            acc_ref=acc_ref,
+            interpret=interpret,
+        )
+
+        # 2. retire the previous send (it read acc_buf[nxt]) and tell the
+        #    left neighbor that buffer may be overwritten
+        @pl.when(t >= 1)
+        def _retire():
+            pltpu.make_async_copy(
+                acc_buf.at[nxt], acc_buf.at[nxt], send_sem.at[nxt]
+            ).wait()
+            pltpu.semaphore_signal(
+                credit_sem,
+                inc=1,
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        # 3. the travelling accumulator for this step has landed in
+        #    acc_buf[slot]
+        @pl.when(t >= 1)
+        def _recv():
+            pltpu.make_async_copy(
+                acc_buf.at[slot], acc_buf.at[slot], recv_sem.at[slot]
+            ).wait()
+
+        # 4. fold the partial into it (first step initializes)
+        if interpret:
+            acc_buf[slot] = jnp.where(
+                t == 0, partial_buf[...], partial_buf[...] + acc_buf[slot]
+            )
+        else:
+
+            def add_body(p_ref, a_in_ref, o_ref):
+                @pl.when(t == 0)
+                def _init():
+                    o_ref[:] = p_ref[:]
+
+                @pl.when(t > 0)
+                def _add():
+                    o_ref[:] = p_ref[:] + a_in_ref[:]
+
+            pltpu.emit_pipeline(
+                add_body,
+                grid=(n // bn,),
+                in_specs=[
+                    pl.BlockSpec((m_loc, bn), lambda j: (0, j)),
+                    pl.BlockSpec((m_loc, bn), lambda j: (0, j)),
+                ],
+                out_specs=[pl.BlockSpec((m_loc, bn), lambda j: (0, j))],
+            )(partial_buf, acc_buf.at[slot], acc_buf.at[slot])
+
+        # 5. forward the partial sums; the next iteration's GEMM overlaps
+        #    this transfer
+        @pl.when(t < d - 1)
+        def _send():
+            @pl.when(t >= 1)
+            def _credit_gate():
+                pltpu.semaphore_wait(credit_sem, 1)
+
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=acc_buf.at[slot],
+                dst_ref=acc_buf.at[nxt],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        # 6. last step: the accumulator is this device's finished chunk
+        @pl.when(t == d - 1)
+        def _flush():
+            cp = pltpu.make_async_copy(acc_buf.at[slot], o_hbm, copy_sem)
+            cp.start()
+            cp.wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, d, step, 0)
+    if d >= 2:
+        pltpu.semaphore_wait(credit_sem, 1)
+
+
+def ring_matmul_rs(
+    a_shard,
+    b_shard,
+    *,
+    axis_name: str = "tp",
+    axis_size: int,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+    collective_id: int = 2,
+):
+    """GEMM + reduce-scatter with kernel-level RDMA/compute overlap.
+
+    Call inside ``shard_map``: ``a_shard [m, k/d]``, ``b_shard [k/d, n]`` ->
+    ``[m/d, n]`` (this device's fully-reduced output rows).
+    """
+    m, kd = a_shard.shape
+    n = b_shard.shape[1]
+    if m % axis_size:
+        raise ValueError(f"m={m} not divisible by axis_size={axis_size}")
+    m_loc = m // axis_size
+    bn, bk = min(block_n, n), min(block_k, kd)
+    if n % bn or kd % bk:
+        raise ValueError(f"(n={n}, k/d={kd}) not divisible by ({bn}, {bk})")
+    space = pltpu.VMEM if interpret else pltpu.ANY
+    kernel = functools.partial(
+        _matmul_rs_kernel, axis_name=axis_name, d=axis_size, bn=bn, bk=bk,
+        interpret=bool(interpret),
+    )
+    acc_init = jnp.zeros((2, m_loc, n), a_shard.dtype)
+    part_init = jnp.zeros((m_loc, n), a_shard.dtype)
+    out, _, _ = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc, n), a_shard.dtype),
+            jax.ShapeDtypeStruct((2, m_loc, n), a_shard.dtype),
+            jax.ShapeDtypeStruct((m_loc, n), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ),
+        # travelling accumulators and the partial-product buffer ride as
+        # inputs 2/3 aliased to outputs 1/2
+        input_output_aliases={2: 1, 3: 2},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),            # send
+            pltpu.SemaphoreType.DMA((2,)),            # recv
+            pltpu.SemaphoreType.DMA,                  # output flush copy
+            pltpu.SemaphoreType.REGULAR,              # buffer-free credits
+            pltpu.VMEM((m_loc, bn), jnp.float32),     # GEMM accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(a_shard, b_shard, acc_init, part_init)
+    return out

@@ -34,6 +34,8 @@ class OptionsManager:
         self.defaults = dict(defaults)
         self.allowed = dict(allowed or {})
         self.options: Dict[str, Any] = dict(self.defaults)
+        #: option names the caller explicitly set (vs. defaulted)
+        self.overridden: set = set()
 
     def parse(self, overrides: Mapping[str, Any]) -> Dict[str, Any]:
         for key, value in overrides.items():
@@ -46,6 +48,7 @@ class OptionsManager:
                 )
             self._check_allowed(key, value)
             self.options[key] = value
+            self.overridden.add(key)
         return self.options
 
     def _check_allowed(self, key: str, value: Any) -> None:

@@ -42,6 +42,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.tp_columnwise.overlap",
             "OverlapTPColumnwise",
         ),
+        "pallas": (
+            "ddlb_tpu.primitives.tp_columnwise.pallas_impl",
+            "PallasTPColumnwise",
+        ),
     },
     "tp_rowwise": {
         "compute_only": (
@@ -59,6 +63,10 @@ _REGISTRY = {
         "overlap": (
             "ddlb_tpu.primitives.tp_rowwise.overlap",
             "OverlapTPRowwise",
+        ),
+        "pallas": (
+            "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
+            "PallasTPRowwise",
         ),
     },
 }

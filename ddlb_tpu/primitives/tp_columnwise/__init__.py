@@ -21,6 +21,10 @@ _LAZY = {
         "ddlb_tpu.primitives.tp_columnwise.overlap",
         "OverlapTPColumnwise",
     ),
+    "PallasTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.pallas_impl",
+        "PallasTPColumnwise",
+    ),
 }
 
 

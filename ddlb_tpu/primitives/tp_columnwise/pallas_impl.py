@@ -1,0 +1,121 @@
+"""AG+GEMM with hand-written Pallas kernels as the compute/comm path.
+
+Occupies the reference's "hand-tuned native kernel" slot (nvFuser /
+TransformerEngine userbuffers, SURVEY.md section 2.4), with two algorithms:
+
+- ``xla_collective``: explicit ``jax.lax.all_gather`` + the framework's
+  Pallas MXU GEMM (``ddlb_tpu.ops.matmul``) — measured faster than XLA's
+  stock matmul at the canonical 8192^3 bf16 shape on v5e.
+- ``ring_rdma``: the whole primitive as ONE Pallas program
+  (``ddlb_tpu.ops.collective_matmul.ring_ag_matmul``) — chunks circulate
+  the ring via ``make_async_remote_copy`` while the MXU computes, the
+  kernel-level re-creation of nvFuser's p2p_pipeline
+  (/root/reference/ddlb/primitives/TPColumnwise/fuser.py:102-146).
+
+Off-TPU both run in Pallas interpret mode (the ring via the distributed
+TPU interpreter, which emulates RDMA/semaphores and can check for data
+races via ``detect_races=true`` — a sanitizer the reference lacks,
+SURVEY.md section 5 "race detection: none").
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.collective_matmul import ring_ag_matmul
+from ddlb_tpu.ops.matmul import matmul
+from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+
+
+class PallasTPColumnwise(TPColumnwise):
+    DEFAULT_OPTIONS = {
+        "algorithm": "xla_collective",
+        "order": "AG_before",
+        "block_m": 512,
+        "block_n": 512,
+        "block_k": 1024,
+        "detect_races": False,
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["xla_collective", "ring_rdma"],
+        "order": ["AG_before", "AG_after"],
+        "block_m": (128, None),
+        "block_n": (128, None),
+        "block_k": (128, None),
+        "detect_races": [True, False],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        # reject explicitly-set options the chosen algorithm ignores, so a
+        # sweep cannot record identical runs under distinct labels
+        overridden = self._options_manager.overridden
+        if self.options["algorithm"] == "ring_rdma":
+            dead = {"order", "block_m"} & overridden
+        else:
+            dead = {"detect_races"} & overridden
+        if dead:
+            raise ValueError(
+                f"Option(s) {sorted(dead)} have no effect with "
+                f"algorithm={self.options['algorithm']!r}"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        on_tpu = self.runtime.platform == "tpu"
+        opts = self.options
+
+        if opts["algorithm"] == "ring_rdma":
+            interpret = False
+            if not on_tpu:
+                from jax.experimental.pallas import tpu as pltpu
+
+                interpret = pltpu.InterpretParams(
+                    detect_races=bool(opts["detect_races"])
+                )
+            d = self.num_partitions
+
+            def step(a_shard, b):
+                return ring_ag_matmul(
+                    a_shard,
+                    b,
+                    axis_size=d,
+                    block_n=min(opts["block_n"], self.n),
+                    block_k=min(opts["block_k"], self.k),
+                    interpret=interpret,
+                )
+
+        else:
+            blocks = dict(
+                block_m=opts["block_m"],
+                block_n=opts["block_n"],
+                block_k=opts["block_k"],
+                interpret=not on_tpu,
+            )
+
+            if opts["order"] == "AG_before":
+
+                def step(a_shard, b):
+                    a_full = jax.lax.all_gather(
+                        a_shard, "tp", axis=0, tiled=True
+                    )
+                    return matmul(a_full, b, **blocks)
+
+            else:
+
+                def step(a_shard, b):
+                    partial = matmul(a_shard, b, **blocks)
+                    return jax.lax.all_gather(
+                        partial, "tp", axis=0, tiled=True
+                    )
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None), P(None, None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )

@@ -21,6 +21,10 @@ _LAZY = {
         "ddlb_tpu.primitives.tp_rowwise.overlap",
         "OverlapTPRowwise",
     ),
+    "PallasTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
+        "PallasTPRowwise",
+    ),
 }
 
 

@@ -1,0 +1,100 @@
+"""GEMM+RS with hand-written Pallas kernels as the compute/comm path.
+
+tp_rowwise counterpart of the columnwise Pallas implementation (see that
+module's docstring):
+
+- ``xla_collective``: Pallas MXU GEMM + explicit ``psum_scatter``;
+- ``ring_rdma``: the whole GEMM+reduce-scatter as one Pallas program
+  (``ddlb_tpu.ops.collective_matmul.ring_matmul_rs``) — travelling
+  partial-sum accumulators over ``make_async_remote_copy``, the kernel
+  re-creation of nvFuser's rowwise p2p_pipeline
+  (/root/reference/ddlb/primitives/TPRowwise/fuser.py:116-169).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.collective_matmul import ring_matmul_rs
+from ddlb_tpu.ops.matmul import matmul
+from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+
+
+class PallasTPRowwise(TPRowwise):
+    DEFAULT_OPTIONS = {
+        "algorithm": "xla_collective",
+        "block_m": 512,
+        "block_n": 512,
+        "block_k": 1024,
+        "detect_races": False,
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["xla_collective", "ring_rdma"],
+        "block_m": (128, None),
+        "block_n": (128, None),
+        "block_k": (128, None),
+        "detect_races": [True, False],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        overridden = self._options_manager.overridden
+        if self.options["algorithm"] == "ring_rdma":
+            dead = {"block_m"} & overridden
+        else:
+            dead = {"detect_races"} & overridden
+        if dead:
+            raise ValueError(
+                f"Option(s) {sorted(dead)} have no effect with "
+                f"algorithm={self.options['algorithm']!r}"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        on_tpu = self.runtime.platform == "tpu"
+        opts = self.options
+
+        if opts["algorithm"] == "ring_rdma":
+            interpret = False
+            if not on_tpu:
+                from jax.experimental.pallas import tpu as pltpu
+
+                interpret = pltpu.InterpretParams(
+                    detect_races=bool(opts["detect_races"])
+                )
+            d = self.num_partitions
+
+            def step(a_shard, b_shard):
+                return ring_matmul_rs(
+                    a_shard,
+                    b_shard,
+                    axis_size=d,
+                    block_n=min(opts["block_n"], self.n),
+                    block_k=min(opts["block_k"], self.k // d),
+                    interpret=interpret,
+                )
+
+        else:
+            blocks = dict(
+                block_m=opts["block_m"],
+                block_n=opts["block_n"],
+                block_k=opts["block_k"],
+                interpret=not on_tpu,
+            )
+
+            def step(a_shard, b_shard):
+                partial = matmul(a_shard, b_shard, **blocks)
+                return jax.lax.psum_scatter(
+                    partial, "tp", scatter_dimension=0, tiled=True
+                )
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )
