@@ -118,9 +118,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         times_ms = np.array([float("nan")])
         valid = False
 
-    # TFLOPS = 2*m*n*k / 1e9 / time_ms (reference benchmark.py:209-214)
-    flop_scale = 2.0 * m * n * k / 1e9
-    tflops = flop_scale / times_ms
+    # TFLOPS = flops / 1e9 / time_ms; GEMM primitives use the reference's
+    # 2*m*n*k (benchmark.py:209-214), attention primitives override flops()
+    flop_count = impl.flops() if impl is not None else 2.0 * m * n * k
+    tflops = flop_count / 1e9 / times_ms
 
     row = {
         "implementation": impl_id,

@@ -58,7 +58,8 @@ def matmul(
     """
     m, k = a.shape
     k2, n = b.shape
-    assert k == k2, f"contraction mismatch: {a.shape} @ {b.shape}"
+    if k != k2:
+        raise ValueError(f"contraction mismatch: {a.shape} @ {b.shape}")
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     if m % bm or n % bn or k % bk:
         raise ValueError(

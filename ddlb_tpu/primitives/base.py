@@ -88,14 +88,25 @@ class Primitive(ABC):
         """Construct and shard operands; must set ``self.a``, ``self.b`` and
         the jitted step ``self._fn``."""
 
+    @property
+    def _call_args(self):
+        """Operand tuple for ``self._fn`` (override for non-GEMM arities)."""
+        return (self.a, self.b)
+
     def run(self):
         """Execute one iteration; returns the (possibly sharded) result array."""
-        return self._fn(self.a, self.b)
+        return self._fn(*self._call_args)
 
     def timed_call(self):
         """(fn, args) pair for the on-device measured loop
         (``utils.timing.make_timed_loop``)."""
-        return self._fn, (self.a, self.b)
+        return self._fn, self._call_args
+
+    def flops(self) -> float:
+        """FLOP count of one iteration, for throughput reporting
+        (reference TFLOPS formula 2*m*n*k, ddlb/benchmark.py:209-214;
+        attention-family primitives override)."""
+        return 2.0 * self.m * self.n * self.k
 
     @abstractmethod
     def validate(self, result) -> bool:

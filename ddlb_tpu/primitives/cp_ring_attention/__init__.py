@@ -1,0 +1,31 @@
+"""Context-parallel ring attention implementations, lazily exported."""
+
+from __future__ import annotations
+
+_LAZY = {
+    "CPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.base",
+        "CPRingAttention",
+    ),
+    "RingCPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.ring",
+        "RingCPRingAttention",
+    ),
+    "AllGatherCPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.allgather",
+        "AllGatherCPRingAttention",
+    ),
+    "ComputeOnlyCPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.compute_only",
+        "ComputeOnlyCPRingAttention",
+    ),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,46 @@
+"""All-gather comparator for context-parallel attention.
+
+K and V are gathered whole before any math — the attention counterpart of
+the AG_before GEMM baseline (TPColumnwise jax_spmd): simple, bandwidth-
+hungry, and the yardstick the ring implementation must beat once sequence
+lengths stop fitting comfortably. Scores for the local query block against
+the full sequence are materialized ``[h, m/d, m]``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.cp_ring_attention.base import (
+    CPRingAttention,
+    causal_attention,
+)
+
+
+class AllGatherCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {}
+    ALLOWED_VALUES = {}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        s_loc = self.m // self.num_partitions
+        scale = 1.0 / (self.k ** 0.5)
+
+        def step(q, k, v):
+            my = jax.lax.axis_index("tp")
+            k_full = jax.lax.all_gather(k, "tp", axis=0, tiled=True)
+            v_full = jax.lax.all_gather(v, "tp", axis=0, tiled=True)
+            return causal_attention(
+                q, k_full, v_full, scale, row_offset=my * s_loc
+            )
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None, None),) * 3,
+                out_specs=P("tp", None, None),
+                check_vma=False,
+            )
+        )

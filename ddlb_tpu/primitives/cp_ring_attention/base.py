@@ -1,0 +1,148 @@
+"""CPRingAttention: context-parallel causal self-attention primitive.
+
+No reference analogue — the reference has no attention operator at all and
+its long-context story stops at sequence-parallel GEMMs (SURVEY.md section
+2.5: "the abstraction supports [a ring-attention/CP primitive] as a natural
+new member of the primitive family"). This family makes long-context
+scaling first-class: the sequence dimension is sharded over the ``'tp'``
+mesh axis and implementations differ in how the KV blocks reach the query
+blocks (ring ppermute with online softmax, all-gather comparator, local
+roofline).
+
+Shape mapping onto the ``(m, n, k)`` contract:
+
+- ``m``: sequence length (sharded dimension)
+- ``n``: model width = num_heads * head_dim
+- ``k``: head_dim  (so num_heads = n // k)
+
+Operands are Q, K, V of shape ``[m, h, k]`` seeded uniform [-1, 1] like the
+GEMM operands (tp_columnwise.py:104-124 idiom). Causal attention costs
+``4 * m^2 * n`` FLOPs un-masked (QK^T and PV at 2*m^2*n each); the causal
+half is kept in the count like flash-attention convention reports it — the
+``flops()`` override uses ``4 * m * m * n / 2``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive
+
+#: additive mask sentinel shared by every implementation (large-negative
+#: rather than -inf so masked-row maxima stay finite)
+NEG_INF = -1e30
+
+
+def causal_attention(q, k, v, scale, row_offset=0):
+    """Masked softmax attention in jnp, queries at ``row_offset`` within the
+    global sequence — the single source of the math used by the
+    compute_only and allgather implementations (the ring implementation
+    re-derives it in online form)."""
+    import jax
+    import jax.numpy as jnp
+
+    qh = q.transpose(1, 0, 2).astype(jnp.float32) * scale
+    kh = k.transpose(1, 0, 2).astype(jnp.float32)
+    vh = v.transpose(1, 0, 2).astype(jnp.float32)
+    s = jnp.einsum("hqd,hkd->hqk", qh, kh)
+    n_q, n_k = s.shape[1], s.shape[2]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
+    s = jnp.where(((row_offset + rows) >= cols)[None], s, NEG_INF)
+    s = s - s.max(-1, keepdims=True)
+    p = jnp.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    return jnp.einsum("hqk,hkd->hqd", p, vh).transpose(1, 0, 2).astype(q.dtype)
+
+
+class CPRingAttention(Primitive):
+    """ABC for context-parallel causal attention implementations."""
+
+    primitive_name = "cp_ring_attention"
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.m % d != 0:
+            raise ValueError(f"m={self.m} must be divisible by partitions={d}")
+        if self.n % self.k != 0:
+            raise ValueError(
+                f"n={self.n} (model width) must be divisible by k={self.k} "
+                f"(head_dim)"
+            )
+        if self.dtype in ("int32", "int64"):
+            raise ValueError("attention requires a floating dtype")
+
+    @property
+    def num_heads(self) -> int:
+        return self.n // self.k
+
+    def flops(self) -> float:
+        # 2*m^2*n for QK^T + 2*m^2*n for PV, halved by the causal mask
+        return 2.0 * self.m * self.m * self.n
+
+    def _host_qkv(self):
+        rng = np.random.default_rng(self.seed)
+        shape = (self.m, self.num_heads, self.k)
+        gen = np.float32
+        q = rng.uniform(-1, 1, shape).astype(gen)
+        k = rng.uniform(-1, 1, shape).astype(gen)
+        v = rng.uniform(-1, 1, shape).astype(gen)
+        return q, k, v
+
+    def _input_setup(self) -> None:
+        q, k, v = self._host_qkv()
+        spec = P("tp", None, None)  # sequence-sharded
+        self.q = self._device_put(q, spec)
+        self.kv_k = self._device_put(k, spec)
+        self.kv_v = self._device_put(v, spec)
+
+    @property
+    def _call_args(self):
+        return (self.q, self.kv_k, self.kv_v)
+
+    def get_inputs(self):
+        return self.q, self.kv_k, self.kv_v
+
+    def _expected_full(self) -> np.ndarray:
+        """Single-device causal softmax attention oracle in float32.
+
+        Computed per head and per query-row block so the peak temporary is
+        ``[block, m]`` rather than the full ``[h, m, m]`` score matrix
+        (8.6 GB per copy at the shipped seq=16384 sweep shape).
+        """
+        q, k, v = self._host_qkv()
+        if self.dtype in ("float16", "bfloat16"):
+            # round-trip operands through the low precision the device saw
+            import jax.numpy as jnp
+
+            cast = jnp.float16 if self.dtype == "float16" else jnp.bfloat16
+            q = np.asarray(jnp.asarray(q, cast), np.float32)
+            k = np.asarray(jnp.asarray(k, cast), np.float32)
+            v = np.asarray(jnp.asarray(v, cast), np.float32)
+        m, h = self.m, self.num_heads
+        scale = 1.0 / np.sqrt(self.k)
+        out = np.empty((m, h, self.k), np.float32)
+        block = max(1, min(m, (1 << 24) // max(m, 1)))  # ~64 MB scores
+        cols = np.arange(m)
+        for head in range(h):
+            kh = k[:, head, :]  # [m, dh]
+            vh = v[:, head, :]
+            for r0 in range(0, m, block):
+                r1 = min(r0 + block, m)
+                scores = (q[r0:r1, head, :] @ kh.T) * scale  # [blk, m]
+                mask = (r0 + np.arange(r1 - r0))[:, None] >= cols[None, :]
+                scores = np.where(mask, scores, -np.inf)
+                scores -= scores.max(axis=-1, keepdims=True)
+                p = np.exp(scores)
+                p /= p.sum(axis=-1, keepdims=True)
+                out[r0:r1, head, :] = p @ vh
+        return out
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        return self._compare_global(result, self._expected_full())

@@ -1,0 +1,57 @@
+"""Compute-only roofline for context-parallel attention (no communication).
+
+Same role as the GEMM compute_only implementations
+(/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55):
+``unsharded`` runs full causal attention on one device (upper bound),
+``sharded`` runs only the diagonal block — local Q against local K/V —
+(lower bound: one partition's compute share; validation skipped, the
+off-diagonal context is missing by construction).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.cp_ring_attention.base import (
+    CPRingAttention,
+    causal_attention,
+)
+
+
+class ComputeOnlyCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {"size": "sharded"}
+    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def _input_setup(self) -> None:
+        q, k, v = self._host_qkv()
+        if self.options["size"] == "sharded":
+            s_loc = self.m // self.num_partitions
+            q, k, v = q[:s_loc], k[:s_loc], v[:s_loc]
+        device = self.runtime.local_devices[0]
+        dt = jnp_dtype(self.dtype)
+        self.q = jax.device_put(jnp.asarray(q).astype(dt), device)
+        self.kv_k = jax.device_put(jnp.asarray(k).astype(dt), device)
+        self.kv_v = jax.device_put(jnp.asarray(v).astype(dt), device)
+        scale = 1.0 / (self.k ** 0.5)
+        self._fn = jax.jit(lambda q, k, v: causal_attention(q, k, v, scale))
+        jax.block_until_ready((self.q, self.kv_k, self.kv_v))
+
+    def validate(self, result) -> bool:
+        if self.options["size"] == "sharded":
+            return True
+        import numpy as np
+
+        from ddlb_tpu.primitives.base import validation_atol
+
+        result = jax.block_until_ready(result)
+        expected = self._expected_full()
+        return bool(
+            np.allclose(
+                np.asarray(result, dtype=np.float32),
+                expected,
+                rtol=0.0,
+                atol=validation_atol(self.dtype, self.k),
+            )
+        )

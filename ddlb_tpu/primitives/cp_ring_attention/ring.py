@@ -1,0 +1,102 @@
+"""Ring attention: KV blocks circulate, softmax accumulates online.
+
+The context-parallel analogue of the GEMM p2p pipelines
+(primitives/*/overlap.py): Q stays put (sequence-sharded), K/V blocks hop
+the ring via ``ppermute`` while each device folds the arriving block into a
+running flash-attention-style (max, sum, output) accumulator — so the
+KV transfer for step t+1 overlaps the attention math of step t, and no
+device ever materializes the full sequence. This is the standard
+ring-attention construction (Liu et al.) expressed as a ``shard_map``
+program; XLA lowers the hops to ICI collective-permutes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.cp_ring_attention.base import (
+    NEG_INF as _NEG,
+    CPRingAttention,
+)
+
+
+class RingCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {"skip_masked_blocks": True}
+    ALLOWED_VALUES = {"skip_masked_blocks": [True, False]}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        d = self.num_partitions
+        s_loc = self.m // d
+        h, dh = self.num_heads, self.k
+        scale = 1.0 / (dh ** 0.5)
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+        skip = self.options["skip_masked_blocks"]
+
+        def step(q, k, v):
+            # [s_loc, h, dh] -> [h, s_loc, dh]
+            qh = q.transpose(1, 0, 2).astype(jnp.float32) * scale
+            k_cur = k.transpose(1, 0, 2)
+            v_cur = v.transpose(1, 0, 2)
+            my = jax.lax.axis_index("tp")
+
+            o = jnp.zeros((h, s_loc, dh), jnp.float32)
+            m_run = jnp.full((h, s_loc), _NEG, jnp.float32)
+            l_run = jnp.zeros((h, s_loc), jnp.float32)
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+            for t in range(d):
+                kv_idx = (my - t) % d
+
+                def fold(carry, k_blk=k_cur, v_blk=v_cur, kv_idx=kv_idx):
+                    o, m_run, l_run = carry
+                    s = jnp.einsum(
+                        "hqd,hkd->hqk",
+                        qh,
+                        k_blk.astype(jnp.float32),
+                    )
+                    # causal mask on GLOBAL positions: query my*s_loc+r may
+                    # see key kv_idx*s_loc+c iff it is not in the future
+                    mask = (my * s_loc + rows) >= (kv_idx * s_loc + cols)
+                    s = jnp.where(mask[None], s, _NEG)
+                    m_new = jnp.maximum(m_run, s.max(-1))
+                    alpha = jnp.exp(m_run - m_new)
+                    p = jnp.exp(s - m_new[..., None])
+                    l_new = l_run * alpha + p.sum(-1)
+                    o_new = o * alpha[..., None] + jnp.einsum(
+                        "hqk,hkd->hqd", p, v_blk.astype(jnp.float32)
+                    )
+                    return o_new, m_new, l_new
+
+                if skip:
+                    # blocks strictly in the future are fully masked; skip
+                    # their matmuls entirely (the causal-half FLOP saving)
+                    o, m_run, l_run = jax.lax.cond(
+                        kv_idx <= my,
+                        fold,
+                        lambda c: c,
+                        (o, m_run, l_run),
+                    )
+                else:
+                    o, m_run, l_run = fold((o, m_run, l_run))
+
+                if t + 1 < d:
+                    # next KV block travels while this one is processed
+                    k_cur = jax.lax.ppermute(k_cur, "tp", perm=fwd)
+                    v_cur = jax.lax.ppermute(v_cur, "tp", perm=fwd)
+
+            out = o / l_run[..., None]
+            return out.transpose(1, 0, 2).astype(q.dtype)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None, None),) * 3,
+                out_specs=P("tp", None, None),
+                check_vma=False,
+            )
+        )

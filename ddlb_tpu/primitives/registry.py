@@ -22,7 +22,7 @@ from __future__ import annotations
 import importlib
 from typing import Tuple, Type
 
-ALLOWED_PRIMITIVES = ("tp_columnwise", "tp_rowwise")
+ALLOWED_PRIMITIVES = ("tp_columnwise", "tp_rowwise", "cp_ring_attention")
 
 _REGISTRY = {
     "tp_columnwise": {
@@ -67,6 +67,23 @@ _REGISTRY = {
         "pallas": (
             "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
             "PallasTPRowwise",
+        ),
+    },
+    # context-parallel attention: no reference analogue (SURVEY.md section
+    # 2.5 — the reference has no attention op); the natural extension of
+    # the primitive family for first-class long-context scaling
+    "cp_ring_attention": {
+        "compute_only": (
+            "ddlb_tpu.primitives.cp_ring_attention.compute_only",
+            "ComputeOnlyCPRingAttention",
+        ),
+        "ring": (
+            "ddlb_tpu.primitives.cp_ring_attention.ring",
+            "RingCPRingAttention",
+        ),
+        "allgather": (
+            "ddlb_tpu.primitives.cp_ring_attention.allgather",
+            "AllGatherCPRingAttention",
         ),
     },
 }

@@ -1,0 +1,95 @@
+"""Context-parallel ring attention validates against full causal attention.
+
+This family has no reference analogue (the reference has no attention op,
+SURVEY.md section 2.5); validation is against a single-device numpy
+softmax-attention oracle, same spirit as the GEMM primitives' runtime
+validation.
+"""
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.primitives.registry import load_impl_class
+
+M, N, K = 128, 64, 16  # seq=128, 4 heads x head_dim=16
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("skip", [True, False])
+def test_ring(dtype, skip):
+    cls = load_impl_class("cp_ring_attention", "ring")
+    impl = cls(M, N, K, dtype=dtype, skip_masked_blocks=skip)
+    result = impl.run()
+    assert result.shape == (M, N // K, K)
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_allgather(dtype):
+    cls = load_impl_class("cp_ring_attention", "allgather")
+    impl = cls(M, N, K, dtype=dtype)
+    result = impl.run()
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("size", ["sharded", "unsharded"])
+def test_compute_only(size):
+    cls = load_impl_class("cp_ring_attention", "compute_only")
+    impl = cls(M, N, K, dtype="float32", size=size)
+    result = impl.run()
+    assert impl.validate(result)
+    rows = M if size == "unsharded" else M // impl.num_partitions
+    assert result.shape == (rows, N // K, K)
+
+
+def test_flops_override():
+    cls = load_impl_class("cp_ring_attention", "ring")
+    impl = cls(M, N, K, dtype="float32")
+    assert impl.flops() == 2.0 * M * M * N  # causal half of 4*m^2*n
+
+
+def test_shape_constraints():
+    cls = load_impl_class("cp_ring_attention", "ring")
+    with pytest.raises(ValueError, match="divisible by partitions"):
+        cls(M + 1, N, K)
+    with pytest.raises(ValueError, match="model width"):
+        cls(M, 65, K)
+    with pytest.raises(ValueError, match="floating"):
+        cls(M, N, K, dtype="int32")
+
+
+def test_ring_matches_allgather_exactly_fp32():
+    ring = load_impl_class("cp_ring_attention", "ring")(M, N, K, dtype="float32")
+    ag = load_impl_class("cp_ring_attention", "allgather")(M, N, K, dtype="float32")
+    r1 = np.asarray(ring.run(), np.float32)
+    r2 = np.asarray(ag.run(), np.float32)
+    np.testing.assert_allclose(r1, r2, rtol=0, atol=1e-5)
+
+
+def test_runner_integration(tmp_path):
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    runner = PrimitiveBenchmarkRunner(
+        "cp_ring_attention",
+        m=M,
+        n=N,
+        k=K,
+        implementations={
+            "ring_0": {"implementation": "ring"},
+            "allgather_0": {"implementation": "allgather"},
+        },
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=str(tmp_path / "attn.csv"),
+        progress=False,
+    )
+    df = runner.run()
+    assert len(df) == 2
+    assert df["valid"].all()
+    # attention flops (2*m^2*n), not the GEMM 2*m*n*k
+    expect_gflops = 2.0 * M * M * N / 1e9
+    row = df.iloc[0]
+    assert abs(
+        row["Throughput (TFLOPS)"] * row["mean time (ms)"] - expect_gflops
+    ) / expect_gflops < 0.05

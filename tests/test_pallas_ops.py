@@ -30,7 +30,7 @@ def test_pallas_matmul_shape_errors():
     b = jnp.zeros((64, 64), jnp.float32)
     with pytest.raises(ValueError, match="not divisible"):
         matmul(a, b, block_m=64, interpret=True)
-    with pytest.raises(AssertionError, match="contraction mismatch"):
+    with pytest.raises(ValueError, match="contraction mismatch"):
         matmul(jnp.zeros((64, 32)), jnp.zeros((64, 64)), interpret=True)
 
 
