@@ -1,0 +1,55 @@
+"""Context-parallel attention with the Pallas flash kernel as compute.
+
+K/V are gathered across the mesh (XLA all-gather over ICI) and the local
+query shard runs the hand-written flash kernel
+(``ddlb_tpu.ops.flash_attention``) with the shard's global ``row_offset``
+(a runtime scalar, so one compiled kernel serves every mesh position)
+driving the causal mask. Compared to the einsum ``allgather``
+implementation this never materializes ``[h, q, kv]`` scores in HBM —
+measured ~12x faster at seq=8192 on v5e (174 vs 14.7 TFLOPS).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.flash_attention import flash_attention
+from ddlb_tpu.primitives.cp_ring_attention.base import CPRingAttention
+
+
+class FlashCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {"block_q": 1024, "block_kv": 1024}
+    ALLOWED_VALUES = {"block_q": (8, None), "block_kv": (8, None)}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        s_loc = self.m // self.num_partitions
+        scale = 1.0 / (self.k ** 0.5)
+        interpret = self.runtime.platform != "tpu"
+        opts = self.options
+
+        def step(q, k, v):
+            my = jax.lax.axis_index("tp")
+            k_full = jax.lax.all_gather(k, "tp", axis=0, tiled=True)
+            v_full = jax.lax.all_gather(v, "tp", axis=0, tiled=True)
+            return flash_attention(
+                q,
+                k_full,
+                v_full,
+                scale=scale,
+                row_offset=my * s_loc,
+                block_q=opts["block_q"],
+                block_kv=opts["block_kv"],
+                interpret=interpret,
+            )
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None, None),) * 3,
+                out_specs=P("tp", None, None),
+                check_vma=False,
+            )
+        )

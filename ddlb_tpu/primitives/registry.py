@@ -85,6 +85,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.cp_ring_attention.allgather",
             "AllGatherCPRingAttention",
         ),
+        "flash": (
+            "ddlb_tpu.primitives.cp_ring_attention.flash",
+            "FlashCPRingAttention",
+        ),
     },
 }
 
