@@ -19,6 +19,14 @@ _LAZY = {
         "ddlb_tpu.primitives.cp_ring_attention.compute_only",
         "ComputeOnlyCPRingAttention",
     ),
+    "UlyssesCPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.ulysses",
+        "UlyssesCPRingAttention",
+    ),
+    "FlashCPRingAttention": (
+        "ddlb_tpu.primitives.cp_ring_attention.flash",
+        "FlashCPRingAttention",
+    ),
 }
 
 

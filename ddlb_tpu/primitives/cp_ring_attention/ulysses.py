@@ -1,0 +1,98 @@
+"""Ulysses-style context parallelism: all-to-all heads <-> sequence.
+
+The second standard CP construction next to the KV ring (no reference
+analogue — the reference has no attention op, SURVEY.md section 2.5). Where
+ring attention keeps Q resident and circulates K/V blocks, Ulysses
+re-shards: an all-to-all converts the sequence-sharded ``[m/d, h, dh]``
+Q/K/V into head-sharded ``[m, h/d, dh]`` tensors, every device runs plain
+full-sequence causal attention over its own heads, and a second all-to-all
+restores sequence sharding. Attention math is embarrassingly parallel over
+heads, so the only communication is the two all-to-alls — ``O(m·n/d)``
+bytes each, vs the ring's ``O(m·n)`` total KV traffic — at the price of
+requiring ``num_heads % d == 0``. On TPU the all-to-all lowers to one XLA
+collective riding every ICI link at once.
+
+Compute options: ``einsum`` (the shared ``causal_attention`` math) or
+``flash`` (the Pallas flash kernel over the full local sequence,
+interpreted off-TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.cp_ring_attention.base import (
+    CPRingAttention,
+    causal_attention,
+)
+
+
+class UlyssesCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {"compute": "einsum", "block_q": 1024, "block_kv": 1024}
+    ALLOWED_VALUES = {
+        "compute": ["einsum", "flash"],
+        "block_q": (8, None),
+        "block_kv": (8, None),
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        d = self.num_partitions
+        if self.num_heads % d != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} must be divisible by "
+                f"partitions={d} for ulysses"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        scale = 1.0 / (self.k ** 0.5)
+        opts = self.options
+        use_flash = opts["compute"] == "flash"
+        interpret = self.runtime.platform != "tpu"
+        if use_flash:
+            from ddlb_tpu.ops.flash_attention import flash_attention
+
+        def seq_to_heads(x):
+            # [m/d, h, dh] -> [m, h/d, dh]: head shards scatter, sequence
+            # shards gather
+            return jax.lax.all_to_all(
+                x, "tp", split_axis=1, concat_axis=0, tiled=True
+            )
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(
+                x, "tp", split_axis=0, concat_axis=1, tiled=True
+            )
+
+        def step(q, k, v):
+            q_h = seq_to_heads(q)
+            k_h = seq_to_heads(k)
+            v_h = seq_to_heads(v)
+            # full sequence is local now: ordinary causal attention,
+            # row_offset 0
+            if use_flash:
+                out = flash_attention(
+                    q_h,
+                    k_h,
+                    v_h,
+                    scale=scale,
+                    row_offset=0,
+                    block_q=opts["block_q"],
+                    block_kv=opts["block_kv"],
+                    interpret=interpret,
+                )
+            else:
+                out = causal_attention(q_h, k_h, v_h, scale)
+            return heads_to_seq(out)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None, None),) * 3,
+                out_specs=P("tp", None, None),
+                check_vma=False,
+            )
+        )

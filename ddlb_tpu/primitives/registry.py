@@ -89,6 +89,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.cp_ring_attention.flash",
             "FlashCPRingAttention",
         ),
+        "ulysses": (
+            "ddlb_tpu.primitives.cp_ring_attention.ulysses",
+            "UlyssesCPRingAttention",
+        ),
     },
 }
 

@@ -131,3 +131,36 @@ def test_flash_kernel_direct_interpret():
         np.testing.assert_allclose(
             out[:, head], p @ v[:, head], rtol=0, atol=1e-5
         )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_ulysses(dtype):
+    n = 8 * K  # 8 heads, one per simulated device
+    cls = load_impl_class("cp_ring_attention", "ulysses")
+    impl = cls(M, n, K, dtype=dtype)
+    result = impl.run()
+    assert result.shape == (M, n // K, K)
+    assert impl.validate(result)
+
+
+def test_ulysses_flash_compute():
+    cls = load_impl_class("cp_ring_attention", "ulysses")
+    impl = cls(M, 8 * K, K, dtype="float32", compute="flash",
+               block_q=16, block_kv=16)
+    result = impl.run()
+    assert impl.validate(result)
+
+
+def test_ulysses_head_constraint():
+    cls = load_impl_class("cp_ring_attention", "ulysses")
+    with pytest.raises(ValueError, match="num_heads"):
+        cls(M, 3 * K, K)  # 3 heads over 8 devices
+
+
+def test_ulysses_matches_allgather_exactly_fp32():
+    n = 8 * K  # 8 heads so the all-to-all divides evenly
+    uly = load_impl_class("cp_ring_attention", "ulysses")(M, n, K, dtype="float32")
+    ag = load_impl_class("cp_ring_attention", "allgather")(M, n, K, dtype="float32")
+    r1 = np.asarray(uly.run(), np.float32)
+    r2 = np.asarray(ag.run(), np.float32)
+    np.testing.assert_allclose(r1, r2, rtol=0, atol=1e-5)
