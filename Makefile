@@ -1,0 +1,26 @@
+# ddlb-tpu developer targets (the reference ships an empty Makefile even
+# though its CONTRIBUTING.md references `make lint`; this one is real).
+
+PYTHON ?= python
+
+.PHONY: test native bench lint clean
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# build the native host-runtime library explicitly (it also builds lazily
+# on first import of ddlb_tpu.native)
+native:
+	$(PYTHON) -c "from ddlb_tpu.native.build import build; p = build(force=True); print(p or 'build failed'); raise SystemExit(0 if p else 1)"
+
+bench:
+	$(PYTHON) bench.py
+
+lint:
+	$(PYTHON) -m pyflakes ddlb_tpu tests bench.py __graft_entry__.py 2>/dev/null \
+		|| $(PYTHON) -m flake8 --max-line-length=100 ddlb_tpu tests \
+		|| true
+
+clean:
+	rm -f ddlb_tpu/native/_host_runtime.so
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

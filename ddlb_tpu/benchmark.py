@@ -26,11 +26,11 @@ from __future__ import annotations
 
 import os
 import socket
-import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ddlb_tpu.native import now_ns, robust_stats
 from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES, load_impl_class
 from ddlb_tpu.utils.timing import fence, measure_device_loop
 
@@ -123,12 +123,18 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     flop_count = impl.flops() if impl is not None else 2.0 * m * n * k
     tflops = flop_count / 1e9 / times_ms
 
+    # native robust statistics (ddlb_tpu/native/host_runtime.cpp); median
+    # and p95 are jitter-resistant additions over the reference's four.
+    # Error rows carry NaN times -> all-NaN stats by the native contract.
+    stats = robust_stats(times_ms)
     row = {
         "implementation": impl_id,
-        "mean time (ms)": float(np.mean(times_ms)),
-        "std time (ms)": float(np.std(times_ms)),
-        "min time (ms)": float(np.min(times_ms)),
-        "max time (ms)": float(np.max(times_ms)),
+        "mean time (ms)": stats["mean"],
+        "std time (ms)": stats["std"],
+        "min time (ms)": stats["min"],
+        "max time (ms)": stats["max"],
+        "median time (ms)": stats["median"],
+        "p95 time (ms)": stats["p95"],
         "m": m,
         "n": n,
         "k": k,
@@ -158,20 +164,20 @@ def _timing_loop(impl, runtime, num_iterations, backend, barrier_each):
         # (reference cpu_clock+barrier, benchmark.py:161-172)
         for i in range(num_iterations):
             runtime.barrier()
-            t0 = time.perf_counter()
+            t0 = now_ns()
             fence(impl.run())
-            times[i] = (time.perf_counter() - t0) * 1e3
+            times[i] = (now_ns() - t0) * 1e-6
         return times
     if backend == "host_clock":
         # sync once, run N iterations back to back, sync, divide
         # (reference cpu_clock no-barrier, benchmark.py:173-186)
         runtime.barrier()
-        t0 = time.perf_counter()
+        t0 = now_ns()
         out = None
         for _ in range(num_iterations):
             out = impl.run()
         fence(out)
-        times[:] = (time.perf_counter() - t0) * 1e3 / num_iterations
+        times[:] = (now_ns() - t0) * 1e-6 / num_iterations
         return times
     # device_loop: the CUDA-event analogue done the XLA way — the whole
     # N-iteration loop compiles into one device program and a differential
@@ -329,8 +335,16 @@ class PrimitiveBenchmarkRunner:
         directory = os.path.dirname(path)
         if directory:
             os.makedirs(directory, exist_ok=True)
-        header = not os.path.exists(path)
-        pd.DataFrame([row]).to_csv(path, mode="a", header=header, index=False)
+        frame = pd.DataFrame([row])
+        if os.path.exists(path):
+            # align to the existing header so appends to CSVs written by an
+            # older schema stay parseable (extra keys dropped, missing NaN)
+            existing = pd.read_csv(path, nrows=0).columns.tolist()
+            frame.reindex(columns=existing).to_csv(
+                path, mode="a", header=False, index=False
+            )
+        else:
+            frame.to_csv(path, mode="a", header=True, index=False)
 
     # -- plotting (reference plot_results, benchmark.py:391-425) -------------
 

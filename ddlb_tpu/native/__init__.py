@@ -1,0 +1,167 @@
+"""ctypes bindings for the native host-runtime, with Python fallbacks.
+
+The in-repo native layer (see ``host_runtime.cpp`` for the design note):
+pipeline schedule planning shared by the shard_map overlap pipelines and
+the Pallas ring kernels, a monotonic nanosecond clock for the timing
+subsystem, and robust statistics for the benchmark rows. Every entry point
+has a numpy fallback with identical semantics, so the framework works
+without a C++ toolchain — ``available()`` reports which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+RING_KINDS = {"ag_fwd": 0, "ag_bwd": 1, "rs_fwd": 2, "rs_bwd": 3}
+STAT_NAMES = ("mean", "std", "min", "max", "median", "p05", "p95", "mad")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DDLB_TPU_NO_NATIVE"):
+        return None
+    from ddlb_tpu.native.build import build
+
+    path = build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ddlb_now_ns.restype = ctypes.c_int64
+        lib.ddlb_now_ns.argtypes = []
+        lib.ddlb_ring_schedule.restype = ctypes.c_int32
+        lib.ddlb_ring_schedule.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ddlb_coll_pipeline_row_map.restype = ctypes.c_int32
+        lib.ddlb_coll_pipeline_row_map.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.ddlb_robust_stats.restype = ctypes.c_int32
+        lib.ddlb_robust_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+    except OSError:
+        return None
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the compiled library is loaded (vs Python fallbacks)."""
+    return _load() is not None
+
+
+def now_ns() -> int:
+    """Monotonic nanosecond timestamp."""
+    lib = _load()
+    if lib is not None:
+        return int(lib.ddlb_now_ns())
+    import time
+
+    return time.perf_counter_ns()
+
+
+def ring_schedule(d: int, kind: str = "ag_fwd") -> np.ndarray:
+    """``[d, d]`` int32 table: entry ``[rank, t]`` is the chunk id that
+    ``rank`` processes at ring step ``t`` (conventions in host_runtime.cpp).
+    """
+    if kind not in RING_KINDS:
+        raise ValueError(f"unknown ring kind '{kind}'; valid: {sorted(RING_KINDS)}")
+    if d <= 0:
+        raise ValueError(f"d must be positive, got {d}")
+    lib = _load()
+    if lib is not None:
+        out = np.empty((d, d), np.int32)
+        rc = lib.ddlb_ring_schedule(
+            d, RING_KINDS[kind],
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:  # pragma: no cover - args validated above
+            raise RuntimeError(f"ddlb_ring_schedule failed: {rc}")
+        return out
+    r = np.arange(d, dtype=np.int64)[:, None]
+    t = np.arange(d, dtype=np.int64)[None, :]
+    table = {
+        "ag_fwd": r - t,
+        "ag_bwd": r + t,
+        "rs_fwd": r + d - 1 - t,
+        "rs_bwd": r + t + 1,
+    }[kind]
+    return np.asarray(np.mod(table, d), np.int32)
+
+
+def coll_pipeline_row_map(m: int, d: int, s: int) -> np.ndarray:
+    """``[m]`` int32 map from stage-major concatenated output rows to global
+    row indices (the reference's host-side ``[s,d,b,n] -> [d,s,b,n]``
+    reassembly, /root/reference/ddlb/primitives/TPColumnwise/fuser.py:271-279,
+    as an explicit permutation).
+
+    This is the planner's specification of the reassembly; the on-device
+    coll_pipeline keeps the equivalent reshape/transpose because a
+    constant-index row gather measured ~19% slower than the transpose copy
+    on v5e (8192x8192) — the permutation form is for host-side consumers
+    and kernel authors, and the test suite pins the two forms equal.
+    """
+    if m <= 0 or d <= 0 or s <= 0 or m % (d * s) != 0:
+        raise ValueError(f"m={m} must be a positive multiple of d*s={d * s}")
+    lib = _load()
+    if lib is not None:
+        out = np.empty(m, np.int32)
+        rc = lib.ddlb_coll_pipeline_row_map(
+            m, d, s, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if rc != 0:  # pragma: no cover - args validated above
+            raise RuntimeError(f"ddlb_coll_pipeline_row_map failed: {rc}")
+        return out
+    b = m // (d * s)
+    idx = np.arange(m, dtype=np.int32).reshape(d, s, b)  # global rank-major
+    return idx.transpose(1, 0, 2).reshape(m).astype(np.int32)
+
+
+def robust_stats(xs) -> Dict[str, float]:
+    """Mean/std(pop)/min/max/median/p05/p95/MAD of a 1-D sample.
+
+    A sample containing any non-finite value yields all-NaN stats on both
+    the native and fallback paths (sorting NaNs is undefined in C++, so the
+    contract is pinned here rather than left to diverge).
+    """
+    arr = np.ascontiguousarray(np.asarray(xs, np.float64).ravel())
+    if arr.size == 0:
+        raise ValueError("robust_stats needs a non-empty sample")
+    if not np.all(np.isfinite(arr)):
+        return {name: float("nan") for name in STAT_NAMES}
+    lib = _load()
+    if lib is not None:
+        out = np.empty(8, np.float64)
+        rc = lib.ddlb_robust_stats(
+            arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        if rc != 0:  # pragma: no cover - args validated above
+            raise RuntimeError(f"ddlb_robust_stats failed: {rc}")
+        return dict(zip(STAT_NAMES, out.tolist()))
+    med = float(np.median(arr))
+    return {
+        "mean": float(np.mean(arr)),
+        "std": float(np.std(arr)),
+        "min": float(np.min(arr)),
+        "max": float(np.max(arr)),
+        "median": med,
+        "p05": float(np.percentile(arr, 5)),
+        "p95": float(np.percentile(arr, 95)),
+        "mad": float(np.median(np.abs(arr - med))),
+    }

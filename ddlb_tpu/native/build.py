@@ -1,0 +1,53 @@
+"""Lazy g++ build of the native host-runtime shared library.
+
+The library is compiled on first use (or via ``make native``) and cached
+next to the source; a stale or missing compiler simply means the pure-
+Python fallbacks in ``ddlb_tpu.native`` take over. Set
+``DDLB_TPU_NO_NATIVE=1`` to force the fallbacks (used by tests to cover
+both paths).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_DIR, "host_runtime.cpp")
+LIBRARY = os.path.join(_DIR, "_host_runtime.so")
+
+_lock = threading.Lock()
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Return the path to the built library, or None if unavailable."""
+    if os.environ.get("DDLB_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if not os.path.exists(SOURCE):
+            # source missing (e.g. prebuilt-.so-only distribution): use the
+            # cached library if there is one, otherwise fall back
+            return LIBRARY if os.path.exists(LIBRARY) else None
+        if (
+            not force
+            and os.path.exists(LIBRARY)
+            and os.path.getmtime(LIBRARY) >= os.path.getmtime(SOURCE)
+        ):
+            return LIBRARY
+        cxx = os.environ.get("CXX", "g++")
+        tmp = f"{LIBRARY}.{os.getpid()}.tmp"
+        cmd = [
+            cxx, "-O3", "-std=c++17", "-shared", "-fPIC", SOURCE, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, LIBRARY)  # atomic: concurrent builders race safely
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return LIBRARY

@@ -1,0 +1,129 @@
+// Native host-runtime for ddlb_tpu: pipeline schedule planning, monotonic
+// timing, and robust statistics.
+//
+// This is the framework's in-repo native layer. The reference keeps all of
+// its native capability in dependencies (NCCL, nvFuser's C++
+// MultiDeviceExecutor, TransformerEngine — SURVEY.md section 2.4,
+// /root/reference/ddlb/primitives/TPColumnwise/fuser.py:247-257): the
+// executor's HOST side plans which chunk each rank processes at each
+// pipeline step and how staged outputs reassemble. Here that planner is
+// this translation unit; the DEVICE side of the same pipelines is the
+// Pallas kernel layer (ddlb_tpu/ops/). Exposed as a plain C ABI consumed
+// via ctypes (ddlb_tpu/native/__init__.py).
+//
+// Schedule conventions (shared with the shard_map pipelines in
+// ddlb_tpu/primitives/*/overlap.py and the ring kernels in
+// ddlb_tpu/ops/collective_matmul.py):
+//   ag_fwd: after t forward ring hops a device holds A-chunk (rank - t) mod d
+//   ag_bwd: backward ring, chunk (rank + t) mod d
+//   rs_fwd: accumulator schedule (rank + d - 1 - t) mod d, so after d steps
+//           each device ends holding its own fully-reduced output chunk
+//   rs_bwd: the backward half of the bidirectional reduce-scatter ring,
+//           chunk (rank + t + 1) mod d
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <ctime>
+#include <vector>
+
+extern "C" {
+
+enum DdlbRingKind : int32_t {
+  DDLB_RING_AG_FWD = 0,
+  DDLB_RING_AG_BWD = 1,
+  DDLB_RING_RS_FWD = 2,
+  DDLB_RING_RS_BWD = 3,
+};
+
+// Monotonic nanosecond clock (CLOCK_MONOTONIC_RAW is immune to NTP slew).
+int64_t ddlb_now_ns() {
+  timespec ts;
+#ifdef CLOCK_MONOTONIC_RAW
+  clock_gettime(CLOCK_MONOTONIC_RAW, &ts);
+#else
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+#endif
+  return static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+}
+
+// Fill out[d*d] with out[rank*d + t] = chunk id processed by `rank` at ring
+// step `t`. Returns 0 on success.
+int32_t ddlb_ring_schedule(int32_t d, int32_t kind, int32_t* out) {
+  if (d <= 0 || out == nullptr) return -1;
+  for (int32_t r = 0; r < d; ++r) {
+    for (int32_t t = 0; t < d; ++t) {
+      int64_t c;
+      switch (kind) {
+        case DDLB_RING_AG_FWD: c = r - t; break;
+        case DDLB_RING_AG_BWD: c = r + t; break;
+        case DDLB_RING_RS_FWD: c = r + d - 1 - t; break;
+        case DDLB_RING_RS_BWD: c = r + t + 1; break;
+        default: return -2;
+      }
+      c %= d;
+      if (c < 0) c += d;
+      out[r * d + t] = static_cast<int32_t>(c);
+    }
+  }
+  return 0;
+}
+
+// coll_pipeline reassembly map: stage outputs concatenate stage-major
+// ([s, d, rows_per_block, n]) but the global result is rank-major
+// ([d, s, rows_per_block, n]). out[j] = global row index of concat-order
+// row j; m must be divisible by d*s. Returns 0 on success.
+int32_t ddlb_coll_pipeline_row_map(int32_t m, int32_t d, int32_t s,
+                                   int32_t* out) {
+  if (m <= 0 || d <= 0 || s <= 0 || out == nullptr) return -1;
+  if (m % (d * s) != 0) return -3;
+  const int32_t b = m / (d * s);
+  int32_t j = 0;
+  for (int32_t stage = 0; stage < s; ++stage)
+    for (int32_t rank = 0; rank < d; ++rank)
+      for (int32_t row = 0; row < b; ++row, ++j)
+        out[j] = rank * (s * b) + stage * b + row;
+  return 0;
+}
+
+// Robust statistics over xs[n] into out[8]:
+//   {mean, std(pop), min, max, median, p05, p95, mad}
+// Percentiles use numpy's default linear interpolation on the sorted
+// sample; mad is the median absolute deviation from the median.
+int32_t ddlb_robust_stats(const double* xs, int32_t n, double* out) {
+  if (xs == nullptr || n <= 0 || out == nullptr) return -1;
+  std::vector<double> v(xs, xs + n);
+  std::sort(v.begin(), v.end());
+
+  double sum = 0.0;
+  for (double x : v) sum += x;
+  const double mean = sum / n;
+  double var = 0.0;
+  for (double x : v) var += (x - mean) * (x - mean);
+  var /= n;
+
+  auto percentile = [&](const std::vector<double>& sorted, double q) {
+    const double pos = q * (sorted.size() - 1);
+    const size_t lo = static_cast<size_t>(pos);
+    const size_t hi = std::min(lo + 1, sorted.size() - 1);
+    const double frac = pos - static_cast<double>(lo);
+    return sorted[lo] * (1.0 - frac) + sorted[hi] * frac;
+  };
+
+  const double median = percentile(v, 0.5);
+  std::vector<double> dev(v.size());
+  for (size_t i = 0; i < v.size(); ++i) dev[i] = std::fabs(v[i] - median);
+  std::sort(dev.begin(), dev.end());
+
+  out[0] = mean;
+  out[1] = std::sqrt(var);
+  out[2] = v.front();
+  out[3] = v.back();
+  out[4] = median;
+  out[5] = percentile(v, 0.05);
+  out[6] = percentile(v, 0.95);
+  out[7] = percentile(dev, 0.5);
+  return 0;
+}
+
+}  // extern "C"

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu import native
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
 
 
@@ -124,17 +125,19 @@ class OverlapTPColumnwise(TPColumnwise):
         d = self.num_partitions
         b_rows = self.m // d
         fwd = [(i, (i + 1) % d) for i in range(d)]
+        # chunk schedule from the native planner: sched[rank, t] is the
+        # chunk a rank holds after t forward hops ((rank - t) mod d)
+        sched = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
 
         def step(a_shard, b):
             my = jax.lax.axis_index("tp")
+            my_sched = sched[my]
             out = jnp.zeros((d, b_rows, self.n), a_shard.dtype)
             buf = a_shard
             for t in range(d):
-                # after t forward hops, this device holds chunk (my - t).
-                chunk_id = (my - t) % d
                 tile = buf @ b
                 out = jax.lax.dynamic_update_slice_in_dim(
-                    out, tile[None], chunk_id, axis=0
+                    out, tile[None], my_sched[t], axis=0
                 )
                 if t + 1 < d:
                     # send current chunk onward while the next GEMM runs
@@ -149,17 +152,20 @@ class OverlapTPColumnwise(TPColumnwise):
         half = b_rows // 2
         fwd = [(i, (i + 1) % d) for i in range(d)]
         bwd = [(i, (i - 1) % d) for i in range(d)]
+        sched_f = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
+        sched_r = jnp.asarray(native.ring_schedule(d, "ag_bwd"))
 
         def step(a_shard, b):
             my = jax.lax.axis_index("tp")
+            my_f, my_r = sched_f[my], sched_r[my]
             # halves travel opposite ring directions -> both ICI link
             # directions carry traffic every step.
             buf_f = a_shard[:half]
             buf_r = a_shard[half:]
             out = jnp.zeros((d, 2, half, self.n), a_shard.dtype)
             for t in range(d):
-                cf = (my - t) % d  # chunk id held by the forward buffer
-                cr = (my + t) % d  # chunk id held by the backward buffer
+                cf = my_f[t]  # chunk id held by the forward buffer
+                cr = my_r[t]  # chunk id held by the backward buffer
                 tile_f = buf_f @ b
                 tile_r = buf_r @ b
                 out = jax.lax.dynamic_update_slice(

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu import native
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 
 
@@ -133,16 +134,18 @@ class OverlapTPRowwise(TPRowwise):
         d = self.num_partitions
         b_rows = self.m // d
         fwd = [(i, (i + 1) % d) for i in range(d)]
+        # native-planner accumulator schedule (rank + d - 1 - t) mod d:
+        # the accumulator each device holds at the END is its own output
+        # chunk, fully reduced after d ring steps.
+        sched = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
 
         def step(a_shard, b_shard):
             my = jax.lax.axis_index("tp")
+            my_sched = sched[my]
             acc_t, wire_t = _accum_dtypes(a_shard.dtype)
             acc = jnp.zeros((b_rows, self.n), acc_t)
             for t in range(d):
-                # chunk schedule c_t = (my + d - 1 - t) mod d makes the
-                # accumulator that each device holds at the END be its own
-                # output chunk, fully reduced after d ring steps.
-                c = (my + d - 1 - t) % d
+                c = my_sched[t]
                 rows = jax.lax.dynamic_slice_in_dim(
                     a_shard, c * b_rows, b_rows, axis=0
                 )
@@ -167,15 +170,18 @@ class OverlapTPRowwise(TPRowwise):
         half = b_rows // 2
         fwd = [(i, (i + 1) % d) for i in range(d)]
         bwd = [(i, (i - 1) % d) for i in range(d)]
+        sched_f = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
+        sched_r = jnp.asarray(native.ring_schedule(d, "rs_bwd"))
 
         def step(a_shard, b_shard):
             my = jax.lax.axis_index("tp")
+            my_f, my_r = sched_f[my], sched_r[my]
             acc_t, wire_t = _accum_dtypes(a_shard.dtype)
             acc_f = jnp.zeros((half, self.n), acc_t)
             acc_r = jnp.zeros((half, self.n), acc_t)
             for t in range(d):
-                cf = (my + d - 1 - t) % d  # forward-ring chunk schedule
-                cr = (my + t + 1) % d      # backward-ring chunk schedule
+                cf = my_f[t]  # forward-ring chunk schedule
+                cr = my_r[t]  # backward-ring chunk schedule
                 rows_f = jax.lax.dynamic_slice_in_dim(
                     a_shard, cf * b_rows, half, axis=0
                 )

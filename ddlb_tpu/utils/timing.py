@@ -19,10 +19,16 @@ The reference measures with CUDA events or perf_counter + device sync
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Tuple
 
 import numpy as np
+
+from ddlb_tpu.native import now_ns
+
+
+def _now_s() -> float:
+    """Monotonic seconds from the native clock (perf_counter fallback)."""
+    return now_ns() * 1e-9
 
 
 def fence(tree: Any) -> None:
@@ -107,13 +113,13 @@ def measure_device_loop(
     if small:
         loop_small, _ = make_timed_loop(fn, args, small)
         float(loop_small(*call_args))  # warm compile
-        t0 = time.perf_counter()
+        t0 = _now_s()
         float(loop_small(*call_args))
-        t_small = time.perf_counter() - t0
+        t_small = _now_s() - t0
     float(loop_big(*call_args))  # warm compile
-    t0 = time.perf_counter()
+    t0 = _now_s()
     float(loop_big(*call_args))
-    t_big = time.perf_counter() - t0
+    t_big = _now_s() - t0
     per_iter = (t_big - t_small) * 1e3 / (num_iterations - small)
     if per_iter <= 0.0:
         # host-noise underflow (t_small window hit a jitter spike); fall

@@ -1,0 +1,167 @@
+"""Native host-runtime layer: C++ planner/stats vs Python fallbacks.
+
+The compiled library and the numpy fallbacks must agree exactly — the
+suite compares them directly and also re-derives the schedule conventions
+the overlap pipelines and Pallas ring kernels rely on.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddlb_tpu import native
+
+
+def _py_reference_schedule(d, kind):
+    out = np.empty((d, d), np.int32)
+    for r in range(d):
+        for t in range(d):
+            out[r, t] = {
+                "ag_fwd": (r - t) % d,
+                "ag_bwd": (r + t) % d,
+                "rs_fwd": (r + d - 1 - t) % d,
+                "rs_bwd": (r + t + 1) % d,
+            }[kind]
+    return out
+
+
+@pytest.mark.parametrize("d", [1, 2, 3, 4, 8])
+@pytest.mark.parametrize("kind", sorted(native.RING_KINDS))
+def test_ring_schedule(d, kind):
+    table = native.ring_schedule(d, kind)
+    np.testing.assert_array_equal(table, _py_reference_schedule(d, kind))
+    # each rank touches every chunk exactly once
+    for r in range(d):
+        assert sorted(table[r]) == list(range(d))
+
+
+def test_ring_schedule_rs_ends_on_own_chunk():
+    # the reduce-scatter schedule must leave rank r holding chunk r
+    for d in (2, 4, 8):
+        table = native.ring_schedule(d, "rs_fwd")
+        np.testing.assert_array_equal(table[:, d - 1], np.arange(d))
+
+
+def test_ring_schedule_bad_args():
+    with pytest.raises(ValueError, match="ring kind"):
+        native.ring_schedule(4, "sideways")
+    with pytest.raises(ValueError, match="positive"):
+        native.ring_schedule(0)
+
+
+@pytest.mark.parametrize("m,d,s", [(12, 2, 3), (64, 4, 4), (8, 8, 1), (6, 1, 3)])
+def test_coll_pipeline_row_map(m, d, s):
+    perm = native.coll_pipeline_row_map(m, d, s)
+    b = m // (d * s)
+    # definition: concat-order j = (stage*d + rank)*b + row maps to global
+    # row rank*(s*b) + stage*b + row — i.e. the [s,d,b] -> [d,s,b] transpose
+    expect = (
+        np.arange(m, dtype=np.int32).reshape(d, s, b).transpose(1, 0, 2).ravel()
+    )
+    np.testing.assert_array_equal(perm, expect)
+    assert sorted(perm) == list(range(m))
+
+
+def test_coll_pipeline_row_map_matches_overlap_reassembly():
+    # the on-device reassembly in tp_columnwise/overlap.py coll_pipeline is
+    # reshape(s, d, b, n).transpose(1, 0, 2, 3): applying the planner's
+    # permutation to concat-order rows must reproduce it
+    m, d, s, n = 24, 2, 3, 5
+    b = m // (d * s)
+    rows = np.random.default_rng(0).normal(size=(m, n))
+    via_transpose = (
+        rows.reshape(s, d, b, n).transpose(1, 0, 2, 3).reshape(m, n)
+    )
+    perm = native.coll_pipeline_row_map(m, d, s)
+    via_perm = np.empty_like(rows)
+    via_perm[perm] = rows
+    np.testing.assert_array_equal(via_perm, via_transpose)
+
+
+def test_coll_pipeline_row_map_bad_args():
+    with pytest.raises(ValueError, match="multiple"):
+        native.coll_pipeline_row_map(10, 2, 3)
+
+
+def test_robust_stats_matches_numpy():
+    rng = np.random.default_rng(7)
+    xs = rng.lognormal(0, 1, 501)
+    s = native.robust_stats(xs)
+    med = np.median(xs)
+    np.testing.assert_allclose(s["mean"], np.mean(xs), rtol=1e-12)
+    np.testing.assert_allclose(s["std"], np.std(xs), rtol=1e-12)
+    np.testing.assert_allclose(s["min"], np.min(xs))
+    np.testing.assert_allclose(s["max"], np.max(xs))
+    np.testing.assert_allclose(s["median"], med, rtol=1e-12)
+    np.testing.assert_allclose(s["p05"], np.percentile(xs, 5), rtol=1e-12)
+    np.testing.assert_allclose(s["p95"], np.percentile(xs, 95), rtol=1e-12)
+    np.testing.assert_allclose(
+        s["mad"], np.median(np.abs(xs - med)), rtol=1e-12
+    )
+
+
+def test_robust_stats_single_sample():
+    s = native.robust_stats([2.5])
+    assert s["mean"] == s["median"] == s["min"] == s["max"] == 2.5
+    assert s["std"] == s["mad"] == 0.0
+
+
+def test_robust_stats_empty():
+    with pytest.raises(ValueError, match="non-empty"):
+        native.robust_stats([])
+
+
+def test_now_ns_monotonic():
+    a = native.now_ns()
+    b = native.now_ns()
+    assert b >= a
+    assert b - a < 10**9  # two calls within a second
+
+
+def test_fallback_parity():
+    """Pure-Python fallbacks (DDLB_TPU_NO_NATIVE=1) agree with the library."""
+    code = """
+import numpy as np
+from ddlb_tpu import native
+assert not native.available()
+print(native.ring_schedule(4, "rs_fwd").tolist())
+print(native.coll_pipeline_row_map(12, 2, 3).tolist())
+s = native.robust_stats([3.0, 1.0, 2.0, 10.0, 4.0])
+print([round(s[k], 9) for k in native.STAT_NAMES])
+print(native.now_ns() > 0)
+"""
+    env = dict(os.environ, DDLB_TPU_NO_NATIVE="1")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, check=True,
+    ).stdout.strip().splitlines()
+    assert out[0] == str(native.ring_schedule(4, "rs_fwd").tolist())
+    assert out[1] == str(native.coll_pipeline_row_map(12, 2, 3).tolist())
+    s = native.robust_stats([3.0, 1.0, 2.0, 10.0, 4.0])
+    assert out[2] == str([round(s[k], 9) for k in native.STAT_NAMES])
+    assert out[3] == "True"
+
+
+@pytest.mark.skipif(
+    bool(os.environ.get("DDLB_TPU_NO_NATIVE")) or shutil.which("g++") is None,
+    reason="native path disabled or no C++ toolchain (fallbacks are supported)",
+)
+def test_library_actually_built():
+    """With a toolchain present the native path must be live."""
+    assert native.available()
+    from ddlb_tpu.native.build import LIBRARY
+
+    assert os.path.exists(LIBRARY)
+
+
+def test_robust_stats_nonfinite_is_all_nan():
+    # pinned contract: both native and fallback paths return all-NaN for a
+    # sample containing any non-finite value (C++ sort of NaNs is UB)
+    s = native.robust_stats([1.0, float("nan"), 2.0])
+    assert all(np.isnan(v) for v in s.values())
+    s = native.robust_stats([float("inf")])
+    assert all(np.isnan(v) for v in s.values())

@@ -186,3 +186,31 @@ def test_json_script_entry(tmp_path):
 
     df = run_benchmark(load_config(str(config_path)))
     assert len(df) == 1 and df["valid"].all()
+
+
+def test_csv_append_aligns_to_existing_header(tmp_path):
+    """Appends to a CSV written under an older schema stay parseable."""
+    import pandas as pd
+
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    path = tmp_path / "old.csv"
+    pd.DataFrame(
+        [{"implementation": "legacy", "mean time (ms)": 1.0, "valid": True}]
+    ).to_csv(path, index=False)
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        m=64,
+        n=32,
+        k=64,
+        implementations={"compute_only_0": {"implementation": "compute_only"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=str(path),
+        progress=False,
+    )
+    runner.run()
+    df = pd.read_csv(path)  # must parse cleanly with the ORIGINAL columns
+    assert list(df.columns) == ["implementation", "mean time (ms)", "valid"]
+    assert len(df) == 2
