@@ -16,10 +16,14 @@ native:
 bench:
 	$(PYTHON) bench.py
 
+# pyflakes when installed; otherwise a strict syntax check. Failures fail.
 lint:
-	$(PYTHON) -m pyflakes ddlb_tpu tests bench.py __graft_entry__.py 2>/dev/null \
-		|| $(PYTHON) -m flake8 --max-line-length=100 ddlb_tpu tests \
-		|| true
+	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
+		$(PYTHON) -m pyflakes ddlb_tpu tests bench.py __graft_entry__.py; \
+	else \
+		echo "pyflakes not installed; running syntax check only"; \
+		$(PYTHON) -m compileall -q ddlb_tpu tests bench.py __graft_entry__.py; \
+	fi
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

@@ -149,9 +149,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         "barrier_at_each_iteration": barrier_each,
         "option": option_repr,
         "valid": valid,
+        # always present so the CSV header (fixed by the first row written)
+        # has the column when a later implementation crashes
+        "error": error or "",
     }
-    if error:
-        row["error"] = error
     del impl, result
     return row
 
@@ -336,7 +337,7 @@ class PrimitiveBenchmarkRunner:
         if directory:
             os.makedirs(directory, exist_ok=True)
         frame = pd.DataFrame([row])
-        if os.path.exists(path):
+        if os.path.exists(path) and os.path.getsize(path) > 0:
             # align to the existing header so appends to CSVs written by an
             # older schema stay parseable (extra keys dropped, missing NaN)
             existing = pd.read_csv(path, nrows=0).columns.tolist()

@@ -53,7 +53,9 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
             ctypes.POINTER(ctypes.c_double),
         ]
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale cached .so built from an older source
+        # revision missing a symbol — fall back to the numpy path
         return None
     _lib = lib
     return _lib

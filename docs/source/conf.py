@@ -1,0 +1,17 @@
+"""Sphinx configuration for ddlb-tpu (reference skeleton: docs/source/conf.py:1-25)."""
+
+project = "ddlb-tpu"
+copyright = "2026, ddlb-tpu contributors"
+author = "ddlb-tpu contributors"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = "alabaster"
