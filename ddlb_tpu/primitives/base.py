@@ -42,6 +42,21 @@ def jnp_dtype(name: str):
     return table[name]
 
 
+def accum_wire_dtypes(operand_dtype):
+    """(accumulator, wire) dtypes for ring partial sums.
+
+    Floating operands accumulate in float32 — matching the MXU's native
+    accumulation — while the ring wire stays in the operand dtype so the
+    communicated volume matches the reference's ring exchange. Integer
+    operands are exact and stay put.
+    """
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(operand_dtype, jnp.integer):
+        return jnp.int32, operand_dtype
+    return jnp.float32, operand_dtype
+
+
 def validation_atol(dtype: str, k: int) -> float:
     """Reference tolerance rule: rtol=0, atol=(1e-3 half / 1e-4 else)*k
     (tp_columnwise.py:150-162)."""
@@ -182,4 +197,51 @@ class Primitive(ABC):
         return (
             f"{type(self).__name__}(m={self.m}, n={self.n}, k={self.k}, "
             f"dtype={self.dtype}, partitions={self.num_partitions})"
+        )
+
+
+class ComputeOnlyKSharded:
+    """Shared compute-only roofline for the k-contracted families
+    (tp_rowwise, dp_allreduce), which have identical operand layouts:
+    ``sharded`` times one partition's partial GEMM ``[m, k/d] @ [k/d, n]``
+    (validation skipped — partial sums are not the answer), ``unsharded``
+    the full product on one device.
+
+    Mixin: subclasses combine it with their family ABC
+    (reference compute_only, TPColumnwise/compute_only.py:8-55).
+    """
+
+    DEFAULT_OPTIONS = {"size": "sharded"}
+    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def _input_setup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        a_host, b_host = self._host_operands()
+        if self.options["size"] == "sharded":
+            kd = self.k // self.num_partitions
+            a_host = a_host[:, :kd]
+            b_host = b_host[:kd]
+        device = self.runtime.local_devices[0]
+        dt = jnp_dtype(self.dtype)
+        self.a = jax.device_put(jnp.asarray(a_host).astype(dt), device)
+        self.b = jax.device_put(jnp.asarray(b_host).astype(dt), device)
+        self._fn = jax.jit(jnp.matmul)
+        jax.block_until_ready((self.a, self.b))
+
+    def validate(self, result) -> bool:
+        if self.options["size"] == "sharded":
+            return True
+        import jax
+
+        result = jax.block_until_ready(result)
+        expected = self._expected_full()
+        return bool(
+            np.allclose(
+                np.asarray(result, dtype=expected.dtype),
+                expected,
+                rtol=0.0,
+                atol=validation_atol(self.dtype, self.k),
+            )
         )

@@ -1,0 +1,5 @@
+"""Data-parallel gradient GEMM + all-reduce primitive family."""
+
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+__all__ = ["DPAllReduce"]

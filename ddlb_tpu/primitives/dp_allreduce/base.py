@@ -1,0 +1,56 @@
+"""DPAllReduce: data-parallel gradient GEMM + all-reduce primitive.
+
+No reference analogue — SURVEY.md section 2.5 lists data parallelism among
+the strategies absent from the reference (ALLOWED_PRIMITIVES is exactly the
+two TP GEMMs, /root/reference/ddlb/benchmark.py:267). This family makes the
+DP gradient step a first-class benchmarkable primitive, completing the
+collective trio: AG+GEMM (tp_columnwise), GEMM+RS (tp_rowwise), GEMM+AR
+(dp_allreduce).
+
+Semantics: the canonical data-parallel weight-gradient computation
+``dW = X^T dY`` contracted over the *batch* dimension, which is the sharded
+one. Mapped onto the ``(m, n, k)`` contract exactly like tp_rowwise's
+operand layout (tp_rowwise.py:112-140): A ``[m, k]`` column-sharded
+``[m, k/d]`` (each replica's activation slice), B ``[k, n]`` row-sharded
+``[k/d, n]`` (each replica's output-grad slice); each replica computes the
+partial gradient ``A_i @ B_i`` and an all-reduce sums partials, yielding
+the full ``[m, n]`` gradient **replicated** on every replica — the layout
+an optimizer step needs. Constraint ``k % d == 0``.
+
+Validation: the replicated output is compared shard-by-shard against the
+full single-device product; the reference atol rule ``(1e-3 half/1e-4)*k``
+(tp_columnwise.py:150-162) already covers the cross-replica summation
+because k *is* the full contraction length, split across replicas.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class DPAllReduce(Primitive):
+    """ABC for data-parallel GEMM+AR implementations."""
+
+    primitive_name = "dp_allreduce"
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.k % d != 0:
+            raise ValueError(f"k={self.k} must be divisible by partitions={d}")
+
+    def _input_setup(self) -> None:
+        a_host, b_host = self._host_operands()
+        self.a = self._device_put(a_host, P(None, "tp"))   # [m, k] col-sharded
+        self.b = self._device_put(b_host, P("tp", None))   # [k, n] row-sharded
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        # Replicated output: every addressable shard's index is the full
+        # slice, so each device's copy is checked against the whole product.
+        return self._compare_global(result, self._expected_full())

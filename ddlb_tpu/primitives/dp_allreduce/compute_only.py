@@ -1,0 +1,15 @@
+"""Compute-only roofline for GEMM+AR (no communication).
+
+Shared k-sharded roofline logic lives in
+``ddlb_tpu.primitives.base.ComputeOnlyKSharded`` (reference compute_only,
+/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55).
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.base import ComputeOnlyKSharded
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+
+class ComputeOnlyDPAllReduce(ComputeOnlyKSharded, DPAllReduce):
+    pass

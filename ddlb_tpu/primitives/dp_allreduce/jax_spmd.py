@@ -1,0 +1,60 @@
+"""Explicit-collective GEMM+AR via ``shard_map``.
+
+The DP analogue of the reference's PyTorch implementations (explicit
+collective after a local GEMM, /root/reference/ddlb/primitives/TPRowwise/
+pytorch.py:70-85): local partial-gradient GEMM then an explicit all-reduce.
+
+``strategy`` selects the collective decomposition:
+
+- ``all_reduce``: one ``jax.lax.psum`` — XLA lowers to its fused
+  all-reduce over ICI.
+- ``rs_ag``: ``psum_scatter`` then ``all_gather`` — the classic
+  bandwidth-optimal two-phase ring decomposition, exposed separately so the
+  sweep can compare it against the fused collective.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+
+class JaxSPMDDPAllReduce(DPAllReduce):
+    DEFAULT_OPTIONS = {"strategy": "all_reduce"}
+    ALLOWED_VALUES = {"strategy": ["all_reduce", "rs_ag"]}
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if (
+            self.options["strategy"] == "rs_ag"
+            and self.m % self.num_partitions != 0
+        ):
+            raise ValueError(
+                f"m={self.m} must be divisible by partitions="
+                f"{self.num_partitions} for strategy=rs_ag"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        strategy = self.options["strategy"]
+
+        def step(a_shard, b_shard):
+            partial = a_shard @ b_shard  # [m, n] partial gradient
+            if strategy == "all_reduce":
+                return jax.lax.psum(partial, "tp")
+            shard = jax.lax.psum_scatter(
+                partial, "tp", scatter_dimension=0, tiled=True
+            )  # [m/d, n] reduced rows
+            return jax.lax.all_gather(shard, "tp", axis=0, tiled=True)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )

@@ -1,0 +1,205 @@
+"""Comm/compute-overlap pipelines for GEMM+AR (the nvFuser slot, DP member).
+
+The data-parallel counterpart of the tp_columnwise / tp_rowwise overlap
+modules (reference nvFuser algorithms,
+/root/reference/ddlb/primitives/TPRowwise/fuser.py:15-169) — here the
+overlapped collective is a full all-reduce of the gradient:
+
+- ``default``: one partial GEMM + one ``psum``.
+- ``coll_pipeline``: M tiled into ``s`` stages; stage i GEMMs an
+  ``[m/s, k/d]`` slab and all-reduces its gradient tile while stage i+1's
+  GEMM runs (constraint ``m % s == 0``).
+- ``p2p_pipeline``: true ring all-reduce — a reduce-scatter phase whose d
+  ring steps each overlap a per-chunk GEMM with the partial-sum
+  ``ppermute`` (exactly the tp_rowwise ring), then an all-gather phase
+  circulating the finished chunks d-1 more hops (constraint
+  ``m % partitions == 0``). ``direction='bidirectional'`` runs both ring
+  directions with half-chunks, using both ICI link directions of the torus
+  (TPU-first improvement, no reference analogue).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu import native
+from ddlb_tpu.primitives.base import accum_wire_dtypes
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+
+class OverlapDPAllReduce(DPAllReduce):
+    DEFAULT_OPTIONS = {
+        "algorithm": "coll_pipeline",
+        "s": 8,
+        "direction": "unidirectional",
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["default", "coll_pipeline", "p2p_pipeline"],
+        "s": (1, None),
+        "direction": ["unidirectional", "bidirectional"],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        d = self.num_partitions
+        algo = self.options["algorithm"]
+        if algo == "coll_pipeline" and self.m % self.options["s"] != 0:
+            raise ValueError(
+                f"m={self.m} must be divisible by s={self.options['s']} "
+                f"for coll_pipeline"
+            )
+        if algo == "p2p_pipeline":
+            need = (
+                2 * d if self.options["direction"] == "bidirectional" else d
+            )
+            if self.m % need != 0:
+                raise ValueError(
+                    f"m={self.m} must be divisible by {need} for "
+                    f"p2p_pipeline ({self.options['direction']})"
+                )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        algo = self.options["algorithm"]
+        build = {
+            "default": self._build_default,
+            "coll_pipeline": self._build_coll_pipeline,
+            "p2p_pipeline": self._build_p2p_pipeline,
+        }[algo]
+        self._fn = jax.jit(
+            jax.shard_map(
+                build(),
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )
+
+    # -- algorithms ----------------------------------------------------------
+
+    def _build_default(self):
+        def step(a_shard, b_shard):
+            return jax.lax.psum(a_shard @ b_shard, "tp")
+
+        return step
+
+    def _build_coll_pipeline(self):
+        s = self.options["s"]
+        rows = self.m // s
+
+        def step(a_shard, b_shard):
+            # a_shard: [m, k/d]; stage i's slab produces the stage's row
+            # block of the gradient, all-reduced while stage i+1 GEMMs.
+            tiles = []
+            for i in range(s):
+                slab = jax.lax.dynamic_slice_in_dim(
+                    a_shard, i * rows, rows, axis=0
+                )
+                tiles.append(jax.lax.psum(slab @ b_shard, "tp"))
+            return jnp.concatenate(tiles, axis=0)
+
+        return step
+
+    def _build_p2p_pipeline(self):
+        if self.options["direction"] == "bidirectional":
+            return self._build_p2p_bidirectional()
+        d = self.num_partitions
+        b_rows = self.m // d
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+        # RS phase schedule (rank + d - 1 - t) mod d: each device ends the
+        # d GEMM+hop steps holding its own chunk (index = rank) fully
+        # reduced; AG phase schedule (rank - t) mod d tracks the chunk a
+        # device holds after t forward hops.
+        sched_rs = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
+        sched_ag = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
+
+        def step(a_shard, b_shard):
+            my = jax.lax.axis_index("tp")
+            my_rs, my_ag = sched_rs[my], sched_ag[my]
+            acc_t, wire_t = accum_wire_dtypes(a_shard.dtype)
+            # phase 1: ring reduce-scatter, per-chunk GEMMs overlapped with
+            # the partial-sum hops
+            acc = jnp.zeros((b_rows, self.n), acc_t)
+            for t in range(d):
+                c = my_rs[t]
+                rows = jax.lax.dynamic_slice_in_dim(
+                    a_shard, c * b_rows, b_rows, axis=0
+                )
+                acc = acc + jnp.matmul(
+                    rows, b_shard, preferred_element_type=acc_t
+                )
+                if t + 1 < d:
+                    acc = jax.lax.ppermute(
+                        acc.astype(wire_t), "tp", perm=fwd
+                    ).astype(acc_t)
+            # phase 2: ring all-gather of the finished chunks
+            buf = acc.astype(a_shard.dtype)
+            out = jnp.zeros((d, b_rows, self.n), a_shard.dtype)
+            for t in range(d):
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, buf[None], my_ag[t], axis=0
+                )
+                if t + 1 < d:
+                    buf = jax.lax.ppermute(buf, "tp", perm=fwd)
+            return out.reshape(self.m, self.n)
+
+        return step
+
+    def _build_p2p_bidirectional(self):
+        d = self.num_partitions
+        b_rows = self.m // d
+        half = b_rows // 2
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+        bwd = [(i, (i - 1) % d) for i in range(d)]
+        rs_f = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
+        rs_r = jnp.asarray(native.ring_schedule(d, "rs_bwd"))
+        ag_f = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
+        ag_r = jnp.asarray(native.ring_schedule(d, "ag_bwd"))
+
+        def step(a_shard, b_shard):
+            my = jax.lax.axis_index("tp")
+            acc_t, wire_t = accum_wire_dtypes(a_shard.dtype)
+            # front halves ride the forward ring, back halves the backward
+            # ring: both ICI link directions busy every step
+            acc_f = jnp.zeros((half, self.n), acc_t)
+            acc_r = jnp.zeros((half, self.n), acc_t)
+            for t in range(d):
+                cf, cr = rs_f[my][t], rs_r[my][t]
+                rows_f = jax.lax.dynamic_slice_in_dim(
+                    a_shard, cf * b_rows, half, axis=0
+                )
+                rows_r = jax.lax.dynamic_slice_in_dim(
+                    a_shard, cr * b_rows + half, half, axis=0
+                )
+                acc_f = acc_f + jnp.matmul(
+                    rows_f, b_shard, preferred_element_type=acc_t
+                )
+                acc_r = acc_r + jnp.matmul(
+                    rows_r, b_shard, preferred_element_type=acc_t
+                )
+                if t + 1 < d:
+                    acc_f = jax.lax.ppermute(
+                        acc_f.astype(wire_t), "tp", perm=fwd
+                    ).astype(acc_t)
+                    acc_r = jax.lax.ppermute(
+                        acc_r.astype(wire_t), "tp", perm=bwd
+                    ).astype(acc_t)
+            buf_f = acc_f.astype(a_shard.dtype)
+            buf_r = acc_r.astype(a_shard.dtype)
+            out = jnp.zeros((d, 2, half, self.n), a_shard.dtype)
+            for t in range(d):
+                out = jax.lax.dynamic_update_slice(
+                    out, buf_f[None, None], (ag_f[my][t], 0, 0, 0)
+                )
+                out = jax.lax.dynamic_update_slice(
+                    out, buf_r[None, None], (ag_r[my][t], 1, 0, 0)
+                )
+                if t + 1 < d:
+                    buf_f = jax.lax.ppermute(buf_f, "tp", perm=fwd)
+                    buf_r = jax.lax.ppermute(buf_r, "tp", perm=bwd)
+            return out.reshape(self.m, self.n)
+
+        return step

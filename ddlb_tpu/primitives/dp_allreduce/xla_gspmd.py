@@ -1,0 +1,40 @@
+"""Compiler-driven GEMM+AR: GSPMD inserts the all-reduce.
+
+The DP member of the GSPMD comparator slot (reference JAX implementation,
+/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:60-76): requesting a
+replicated output from a product whose contracting dimension is sharded
+forces GSPMD to lower the cross-partition sum to all-reduce, scheduled by
+XLA's latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+
+class XLAGSPMDDPAllReduce(DPAllReduce):
+    DEFAULT_OPTIONS = {}
+    ALLOWED_VALUES = {}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+
+        out = NamedSharding(self.mesh, P(None, None))
+
+        def product(a, b):
+            # Replicated output sharding over a sharded contraction tells
+            # GSPMD to emit all-reduce (vs reduce-scatter for P('tp')).
+            return jnp.matmul(a, b, out_sharding=out)
+
+        self._fn = jax.jit(
+            product,
+            in_shardings=(
+                NamedSharding(self.mesh, P(None, "tp")),
+                NamedSharding(self.mesh, P("tp", None)),
+            ),
+            out_shardings=out,
+        )

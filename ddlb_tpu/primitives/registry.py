@@ -22,7 +22,12 @@ from __future__ import annotations
 import importlib
 from typing import Tuple, Type
 
-ALLOWED_PRIMITIVES = ("tp_columnwise", "tp_rowwise", "cp_ring_attention")
+ALLOWED_PRIMITIVES = (
+    "tp_columnwise",
+    "tp_rowwise",
+    "dp_allreduce",
+    "cp_ring_attention",
+)
 
 _REGISTRY = {
     "tp_columnwise": {
@@ -67,6 +72,27 @@ _REGISTRY = {
         "pallas": (
             "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
             "PallasTPRowwise",
+        ),
+    },
+    # data-parallel gradient GEMM + all-reduce: no reference analogue
+    # (SURVEY.md section 2.5 lists DP among the absent strategies);
+    # completes the collective trio AG+GEMM / GEMM+RS / GEMM+AR
+    "dp_allreduce": {
+        "compute_only": (
+            "ddlb_tpu.primitives.dp_allreduce.compute_only",
+            "ComputeOnlyDPAllReduce",
+        ),
+        "jax_spmd": (
+            "ddlb_tpu.primitives.dp_allreduce.jax_spmd",
+            "JaxSPMDDPAllReduce",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.dp_allreduce.xla_gspmd",
+            "XLAGSPMDDPAllReduce",
+        ),
+        "overlap": (
+            "ddlb_tpu.primitives.dp_allreduce.overlap",
+            "OverlapDPAllReduce",
         ),
     },
     # context-parallel attention: no reference analogue (SURVEY.md section

@@ -1,52 +1,16 @@
 """Compute-only roofline for GEMM+RS (no communication).
 
 The reference ships no compute_only for tp_rowwise (worker class map,
-/root/reference/ddlb/benchmark.py:51-55) — this is a beyond-parity addition
-mirroring /root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55:
-``sharded`` times the local partial GEMM ``[m, k/d] @ [k/d, n]`` (validation
-skipped — partial sums are not the answer), ``unsharded`` the full product.
+/root/reference/ddlb/benchmark.py:51-55) — this is a beyond-parity addition.
+Shared k-sharded roofline logic lives in
+``ddlb_tpu.primitives.base.ComputeOnlyKSharded``.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-
-from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.base import ComputeOnlyKSharded
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 
 
-class ComputeOnlyTPRowwise(TPRowwise):
-    DEFAULT_OPTIONS = {"size": "sharded"}
-    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
-
-    def _input_setup(self) -> None:
-        a_host, b_host = self._host_operands()
-        if self.options["size"] == "sharded":
-            kd = self.k // self.num_partitions
-            a_host = a_host[:, :kd]
-            b_host = b_host[:kd]
-        device = self.runtime.local_devices[0]
-        dt = jnp_dtype(self.dtype)
-        self.a = jax.device_put(jnp.asarray(a_host).astype(dt), device)
-        self.b = jax.device_put(jnp.asarray(b_host).astype(dt), device)
-        self._fn = jax.jit(jnp.matmul)
-        jax.block_until_ready((self.a, self.b))
-
-    def validate(self, result) -> bool:
-        if self.options["size"] == "sharded":
-            return True
-        import numpy as np
-
-        from ddlb_tpu.primitives.base import validation_atol
-
-        result = jax.block_until_ready(result)
-        expected = self._expected_full()
-        return bool(
-            np.allclose(
-                np.asarray(result, dtype=expected.dtype),
-                expected,
-                rtol=0.0,
-                atol=validation_atol(self.dtype, self.k),
-            )
-        )
+class ComputeOnlyTPRowwise(ComputeOnlyKSharded, TPRowwise):
+    pass

@@ -28,20 +28,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import native
+from ddlb_tpu.primitives.base import accum_wire_dtypes as _accum_dtypes
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
-
-
-def _accum_dtypes(operand_dtype):
-    """(accumulator, wire) dtypes for the ring partial sums.
-
-    Floating operands accumulate in float32 — matching the MXU's native
-    accumulation — while the ring wire stays in the operand dtype so the
-    communicated volume matches the reference's ring exchange. Integer
-    operands are exact and stay put.
-    """
-    if jnp.issubdtype(operand_dtype, jnp.integer):
-        return jnp.int32, operand_dtype
-    return jnp.float32, operand_dtype
 
 
 class OverlapTPRowwise(TPRowwise):
