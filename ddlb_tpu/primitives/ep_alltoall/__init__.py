@@ -1,0 +1,6 @@
+"""Expert-parallel all-to-all primitive family (no reference analogue —
+SURVEY.md section 2.5 lists EP among the absent strategies)."""
+
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+__all__ = ["EPAllToAll"]

@@ -1,0 +1,101 @@
+"""EPAllToAll: expert-parallel dispatch/GEMM/combine primitive.
+
+No reference analogue — SURVEY.md section 2.5 lists expert parallelism
+among the strategies absent from the reference (ALLOWED_PRIMITIVES is
+exactly the two TP GEMMs, /root/reference/ddlb/benchmark.py:267). This
+family makes the MoE communication pattern a first-class benchmarkable
+primitive: tokens are exchanged between partitions by an all-to-all, each
+partition's resident expert applies its GEMM, and a mirrored all-to-all
+returns outputs to the owning partition — the third collective shape
+(all-to-all) after the reference's all-gather (tp_columnwise) and
+reduce-scatter (tp_rowwise).
+
+Semantics (capacity-balanced deterministic routing, the standard MoE
+microbenchmark configuration): with ``d`` partitions there are ``d``
+experts, expert ``e`` resident on partition ``e`` with weight ``W_e`` of
+shape ``[k, n]``. The token matrix A ``[m, k]`` is row-sharded ``[m/d, k]``;
+each partition's tokens are split into ``d`` contiguous groups of
+``m/d**2`` tokens and group ``e`` is routed to expert ``e``. Output is the
+token-order-preserving ``[m, n]``, row-sharded ``[m/d, n]``. Constraint
+``m % d**2 == 0``.
+
+Validation: every output row equals ``a[t] @ W_route(t)``; the expected
+full product is the blocked einsum ``out[p, e] = A[p, e] @ W[e]`` over the
+``[d, d, m/d**2, k]`` reshape, compared shard-by-shard with the reference
+tolerance rule (tp_columnwise.py:150-162).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class EPAllToAll(Primitive):
+    """ABC for expert-parallel all-to-all + expert-GEMM implementations."""
+
+    primitive_name = "ep_alltoall"
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.m % (d * d) != 0:
+            raise ValueError(
+                f"m={self.m} must be divisible by partitions^2={d * d} "
+                f"(d contiguous token groups per partition)"
+            )
+
+    @property
+    def group_tokens(self) -> int:
+        """Tokens per (partition, expert) routing group."""
+        d = self.num_partitions
+        return self.m // (d * d)
+
+    def _host_tokens_experts(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Seeded tokens ``[m, k]`` and expert weights ``[d, k, n]``, built
+        identically on every host (the determinism that makes multi-host
+        validation possible without gathering inputs, SURVEY.md section 4
+        item 2)."""
+        rng = np.random.default_rng(self.seed)
+        gen = np.float64 if self.dtype == "float64" else np.float32
+        a = rng.uniform(-1.0, 1.0, (self.m, self.k)).astype(gen)
+        w = rng.uniform(
+            -1.0, 1.0, (self.num_partitions, self.k, self.n)
+        ).astype(gen)
+        if self.dtype in ("int32", "int64"):
+            a = np.rint(a * 3).astype(self.dtype)
+            w = np.rint(w * 3).astype(self.dtype)
+        return a, w
+
+    def _input_setup(self) -> None:
+        a_host, w_host = self._host_tokens_experts()
+        self.a = self._device_put(a_host, P("tp", None))       # [m, k] rows
+        self.w = self._device_put(w_host, P("tp", None, None)) # expert e on p=e
+
+    @property
+    def _call_args(self):
+        return (self.a, self.w)
+
+    def get_inputs(self):
+        return self.a, self.w
+
+    def _expected_full(self) -> np.ndarray:
+        """Single-device routed product: group ``e`` of every partition's
+        tokens through expert ``e``."""
+        a, w = self._host_tokens_experts()
+        acc = np.float64 if self.dtype == "float64" else np.float32
+        d, g = self.num_partitions, self.group_tokens
+        a4 = a.reshape(d, d, g, self.k).astype(acc)
+        out = np.einsum("pegk,ekn->pegn", a4, w.astype(acc))
+        return out.reshape(self.m, self.n)
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        return self._compare_global(result, self._expected_full())

@@ -1,0 +1,56 @@
+"""Compute-only rooflines for the expert-parallel primitive.
+
+Reference role: upper/lower bounds with no communication
+(/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55).
+
+- ``sharded``: one partition's expert GEMM ``[m/d, k] @ [k, n]`` on a
+  single device — the lower bound (validation skipped, a lone expert's
+  output is not the routed answer).
+- ``unsharded``: the full routed product on one device — the single-device
+  upper-bound comparator, validated against the oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.primitives.base import ComputeOnlyKSharded, jnp_dtype
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+
+class ComputeOnlyEPAllToAll(ComputeOnlyKSharded, EPAllToAll):
+    """Mixin supplies the size option schema and the skip-sharded /
+    full-product validate; only the operand layout (tokens + per-expert
+    weights) is EP-specific."""
+
+    def _input_setup(self) -> None:
+        a_host, w_host = self._host_tokens_experts()
+        d, g = self.num_partitions, self.group_tokens
+        device = self.runtime.local_devices[0]
+        dt = jnp_dtype(self.dtype)
+        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        if self.options["size"] == "sharded":
+            md = self.m // d
+            self.a = jax.device_put(jnp.asarray(a_host[:md]).astype(dt), device)
+            self.w = jax.device_put(jnp.asarray(w_host[0]).astype(dt), device)
+            self._fn = jax.jit(
+                lambda a, w: jnp.matmul(a, w, preferred_element_type=acc).astype(
+                    a.dtype
+                )
+            )
+        else:
+            a4 = a_host.reshape(d, d, g, self.k)
+            self.a = jax.device_put(jnp.asarray(a4).astype(dt), device)
+            self.w = jax.device_put(jnp.asarray(w_host).astype(dt), device)
+
+            def routed(a4, w):
+                # operands upcast to the accumulator dtype rather than a
+                # mixed-precision dot: the CPU-sim backend has no
+                # bf16 x bf16 = f32 batched-dot kernel, and on TPU XLA
+                # folds the casts into the MXU's native f32 accumulation
+                out = jnp.einsum("pegk,ekn->pegn", a4.astype(acc), w.astype(acc))
+                return out.astype(a4.dtype).reshape(self.m, self.n)
+
+            self._fn = jax.jit(routed)
+        jax.block_until_ready((self.a, self.w))

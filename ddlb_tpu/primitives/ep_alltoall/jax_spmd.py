@@ -1,0 +1,54 @@
+"""Explicit-collective MoE dispatch/combine via ``shard_map``.
+
+The EP analogue of the reference's PyTorch implementations (explicit
+collectives around a local GEMM, /root/reference/ddlb/primitives/
+TPColumnwise/pytorch.py:85-104): ``lax.all_to_all`` dispatch, resident
+expert GEMM, mirrored ``lax.all_to_all`` combine. On TPU both exchanges
+lower to XLA's all-to-all over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+
+class JaxSPMDEPAllToAll(EPAllToAll):
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        d, g = self.num_partitions, self.group_tokens
+        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+
+        def step(a_loc, w_loc):
+            # a_loc: [m/d, k] this partition's tokens; w_loc: [1, k, n] the
+            # resident expert. Group e of every partition rides the
+            # all-to-all to expert e; block s of the received tensor is the
+            # group sent by source partition s.
+            x = a_loc.reshape(d, g, self.k)
+            x = jax.lax.all_to_all(
+                x, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            y = jnp.matmul(
+                x.reshape(d * g, self.k), w_loc[0], preferred_element_type=acc
+            )
+            y = y.astype(a_loc.dtype).reshape(d, g, self.n)
+            # mirrored exchange returns block s to source s; block e of the
+            # result is my group e's expert output, so the flat reshape
+            # restores token order.
+            y = jax.lax.all_to_all(
+                y, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            return y.reshape(d * g, self.n)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None), P("tp", None, None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )

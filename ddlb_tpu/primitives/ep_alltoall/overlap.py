@@ -1,0 +1,93 @@
+"""Comm/compute-overlap pipelines for MoE dispatch/combine (nvFuser slot).
+
+The EP member of the overlap family (reference nvFuser pipeline algorithms,
+/root/reference/ddlb/primitives/TPColumnwise/fuser.py:59-146):
+
+- ``default``: one dispatch all-to-all, one expert GEMM, one combine
+  all-to-all (same schedule as jax_spmd, baseline for the pipelines).
+- ``coll_pipeline``: each routing group is split into ``s`` chunks; chunk
+  i's combine all-to-all and chunk i+1's dispatch all-to-all run while
+  chunk i's expert GEMM executes — XLA's async collectives overlap the
+  exchanges with the MXU work. Constraint ``m % (d^2 * s) == 0``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+
+class OverlapEPAllToAll(EPAllToAll):
+    DEFAULT_OPTIONS = {"algorithm": "coll_pipeline", "s": 4}
+    ALLOWED_VALUES = {
+        "algorithm": ["default", "coll_pipeline"],
+        "s": (1, None),
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        d, s = self.num_partitions, self.options["s"]
+        if (
+            self.options["algorithm"] == "coll_pipeline"
+            and self.m % (d * d * s) != 0
+        ):
+            raise ValueError(
+                f"m={self.m} must be divisible by d^2*s={d * d * s} for "
+                f"coll_pipeline"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        d = self.num_partitions
+        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+
+        def a2a(t):
+            return jax.lax.all_to_all(
+                t, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+
+        if self.options["algorithm"] == "default":
+            g = self.group_tokens
+
+            def step(a_loc, w_loc):
+                x = a2a(a_loc.reshape(d, g, self.k))
+                y = jnp.matmul(
+                    x.reshape(d * g, self.k),
+                    w_loc[0],
+                    preferred_element_type=acc,
+                )
+                y = a2a(y.astype(a_loc.dtype).reshape(d, g, self.n))
+                return y.reshape(d * g, self.n)
+
+        else:
+            s = self.options["s"]
+            gc = self.m // (d * d * s)  # tokens per chunk per group
+
+            def step(a_loc, w_loc):
+                # [dst group, chunk, token, k]
+                x = a_loc.reshape(d, s, gc, self.k)
+                outs = []
+                for i in range(s):
+                    xi = a2a(x[:, i])  # [src, gc, k]
+                    yi = jnp.matmul(
+                        xi.reshape(d * gc, self.k),
+                        w_loc[0],
+                        preferred_element_type=acc,
+                    )
+                    yi = yi.astype(a_loc.dtype).reshape(d, gc, self.n)
+                    outs.append(a2a(yi))
+                out = jnp.stack(outs, axis=1)  # [group, chunk, gc, n]
+                return out.reshape(d * s * gc, self.n)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None), P("tp", None, None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )

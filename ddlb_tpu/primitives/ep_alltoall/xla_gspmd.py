@@ -1,0 +1,63 @@
+"""Compiler-driven MoE dispatch/combine (GSPMD slot).
+
+The EP analogue of the reference's JAX implementation
+(/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:60-76): the routed
+product is written as resharded transposes + einsum under ``jit`` with
+sharding constraints, and XLA's SPMD partitioner chooses the collectives
+(all-to-all for the src<->expert transpose) and their schedule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+
+class XLAGSPMDEPAllToAll(EPAllToAll):
+    def _input_setup(self) -> None:
+        # GSPMD implicit propagation needs Auto axes (JAX 0.9 defaults to
+        # Explicit sharding-in-types, which rejects mid-function
+        # with_sharding_constraint); operands must live on the same mesh.
+        self.mesh = Mesh(
+            self.mesh.devices,
+            self.mesh.axis_names,
+            axis_types=(AxisType.Auto,) * len(self.mesh.axis_names),
+        )
+        super()._input_setup()
+        d, g = self.num_partitions, self.group_tokens
+        mesh = self.mesh
+        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
+
+        @partial(
+            jax.jit,
+            in_shardings=(sh("tp", None), sh("tp", None, None)),
+            out_shardings=sh("tp", None),
+        )
+        def step(a, w):
+            # [src, expert, token, k], src-sharded
+            x = a.reshape(d, d, g, self.k)
+            x = jax.lax.with_sharding_constraint(
+                x, sh("tp", None, None, None)
+            )
+            # expert-major transpose: resharding axis 0 src->expert is the
+            # dispatch all-to-all, inserted by the partitioner
+            xe = jnp.transpose(x, (1, 0, 2, 3))
+            xe = jax.lax.with_sharding_constraint(
+                xe, sh("tp", None, None, None)
+            )
+            y = jnp.einsum("esgk,ekn->esgn", xe, w, preferred_element_type=acc)
+            y = y.astype(a.dtype)
+            # src-major transpose back = the combine all-to-all
+            ys = jnp.transpose(y, (1, 0, 2, 3))
+            ys = jax.lax.with_sharding_constraint(
+                ys, sh("tp", None, None, None)
+            )
+            return ys.reshape(self.m, self.n)
+
+        self._fn = step

@@ -27,6 +27,7 @@ ALLOWED_PRIMITIVES = (
     "tp_rowwise",
     "dp_allreduce",
     "cp_ring_attention",
+    "ep_alltoall",
 )
 
 _REGISTRY = {
@@ -118,6 +119,27 @@ _REGISTRY = {
         "ulysses": (
             "ddlb_tpu.primitives.cp_ring_attention.ulysses",
             "UlyssesCPRingAttention",
+        ),
+    },
+    # expert-parallel MoE dispatch/combine: no reference analogue
+    # (SURVEY.md section 2.5 lists EP among the absent strategies);
+    # completes the collective-shape set with all-to-all
+    "ep_alltoall": {
+        "compute_only": (
+            "ddlb_tpu.primitives.ep_alltoall.compute_only",
+            "ComputeOnlyEPAllToAll",
+        ),
+        "jax_spmd": (
+            "ddlb_tpu.primitives.ep_alltoall.jax_spmd",
+            "JaxSPMDEPAllToAll",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.ep_alltoall.xla_gspmd",
+            "XLAGSPMDEPAllToAll",
+        ),
+        "overlap": (
+            "ddlb_tpu.primitives.ep_alltoall.overlap",
+            "OverlapEPAllToAll",
         ),
     },
 }
