@@ -102,16 +102,9 @@ def make_train_step(mesh, learning_rate: float = 1e-3):
 
     optimizer = optax.sgd(learning_rate)
 
-    # GSPMD implicit propagation: rebuild the mesh with Auto axis types
-    # (JAX 0.9 defaults to Explicit sharding-in-types, which would demand
-    # per-op out_shardings through the whole train step).
-    from jax.sharding import AxisType, Mesh
+    from ddlb_tpu.runtime import as_auto_mesh
 
-    mesh = Mesh(
-        mesh.devices,
-        mesh.axis_names,
-        axis_types=(AxisType.Auto,) * len(mesh.axis_names),
-    )
+    mesh = as_auto_mesh(mesh)
 
     x_sharding = NamedSharding(mesh, P("dp", "tp", None))
     w1_sharding = NamedSharding(mesh, P(None, "tp"))

@@ -57,6 +57,12 @@ def accum_wire_dtypes(operand_dtype):
     return jnp.float32, operand_dtype
 
 
+def acc_dtype(dtype_name: str):
+    """GEMM accumulator dtype for a *named* operand dtype — the string-keyed
+    form of ``accum_wire_dtypes``, kept as one source of truth."""
+    return accum_wire_dtypes(jnp_dtype(dtype_name))[0]
+
+
 def validation_atol(dtype: str, k: int) -> float:
     """Reference tolerance rule: rtol=0, atol=(1e-3 half / 1e-4 else)*k
     (tp_columnwise.py:150-162)."""
@@ -166,16 +172,20 @@ class Primitive(ABC):
         acc = np.float64 if self.dtype == "float64" else np.float32
         return a.astype(acc) @ b.astype(acc)
 
-    def _compare_global(self, result, expected: np.ndarray) -> bool:
+    def _compare_global(
+        self, result, expected: np.ndarray, atol: Optional[float] = None
+    ) -> bool:
         """Compare every addressable shard of a global result against the
         matching slice of ``expected``.
 
         Subsumes both reference paths: full comparison for replicated
         outputs (tp_columnwise.py:137-162) and the per-rank row-slice for
         sequence-sharded outputs (tp_rowwise.py:166-170) — the shard index
-        selects the slice.
+        selects the slice. ``atol`` overrides the reference rule for
+        primitives with deeper accumulation chains (pp_pipeline).
         """
-        atol = validation_atol(self.dtype, self.k)
+        if atol is None:
+            atol = validation_atol(self.dtype, self.k)
         ok = True
         for shard in result.addressable_shards:
             got = np.asarray(shard.data, dtype=expected.dtype)

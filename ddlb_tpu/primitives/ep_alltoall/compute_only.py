@@ -15,7 +15,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ddlb_tpu.primitives.base import ComputeOnlyKSharded, jnp_dtype
+from ddlb_tpu.primitives.base import ComputeOnlyKSharded, acc_dtype, jnp_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 
 
@@ -29,7 +29,7 @@ class ComputeOnlyEPAllToAll(ComputeOnlyKSharded, EPAllToAll):
         d, g = self.num_partitions, self.group_tokens
         device = self.runtime.local_devices[0]
         dt = jnp_dtype(self.dtype)
-        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        acc = acc_dtype(self.dtype)
         if self.options["size"] == "sharded":
             md = self.m // d
             self.a = jax.device_put(jnp.asarray(a_host[:md]).astype(dt), device)

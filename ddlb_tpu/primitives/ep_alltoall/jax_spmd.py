@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 
 
@@ -20,7 +21,7 @@ class JaxSPMDEPAllToAll(EPAllToAll):
     def _input_setup(self) -> None:
         super()._input_setup()
         d, g = self.num_partitions, self.group_tokens
-        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        acc = acc_dtype(self.dtype)
 
         def step(a_loc, w_loc):
             # a_loc: [m/d, k] this partition's tokens; w_loc: [1, k, n] the

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 
 
@@ -42,7 +43,7 @@ class OverlapEPAllToAll(EPAllToAll):
     def _input_setup(self) -> None:
         super()._input_setup()
         d = self.num_partitions
-        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        acc = acc_dtype(self.dtype)
 
         def a2a(t):
             return jax.lax.all_to_all(

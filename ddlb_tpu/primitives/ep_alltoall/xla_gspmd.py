@@ -13,25 +13,20 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import AxisType, Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+from ddlb_tpu.runtime import as_auto_mesh
 
 
 class XLAGSPMDEPAllToAll(EPAllToAll):
     def _input_setup(self) -> None:
-        # GSPMD implicit propagation needs Auto axes (JAX 0.9 defaults to
-        # Explicit sharding-in-types, which rejects mid-function
-        # with_sharding_constraint); operands must live on the same mesh.
-        self.mesh = Mesh(
-            self.mesh.devices,
-            self.mesh.axis_names,
-            axis_types=(AxisType.Auto,) * len(self.mesh.axis_names),
-        )
+        self.mesh = as_auto_mesh(self.mesh)
         super()._input_setup()
         d, g = self.num_partitions, self.group_tokens
         mesh = self.mesh
-        acc = jnp.int32 if self.dtype in ("int32", "int64") else jnp.float32
+        acc = acc_dtype(self.dtype)
         sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
 
         @partial(

@@ -1,0 +1,6 @@
+"""Pipeline-parallel staged-GEMM primitive family (no reference analogue —
+SURVEY.md section 2.5 lists PP among the absent strategies)."""
+
+from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+
+__all__ = ["PPPipeline"]

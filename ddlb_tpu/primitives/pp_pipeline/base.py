@@ -1,0 +1,117 @@
+"""PPPipeline: pipeline-parallel staged-GEMM primitive.
+
+No reference analogue — SURVEY.md section 2.5 lists pipeline parallelism
+among the strategies absent from the reference (ALLOWED_PRIMITIVES is
+exactly the two TP GEMMs, /root/reference/ddlb/benchmark.py:267). This
+family makes the PP activation-passing pattern a first-class benchmarkable
+primitive: a chain of ``d`` stage GEMMs with stage ``p``'s weight resident
+on partition ``p``, activations hopping stage-to-stage over ``ppermute``
+(one ICI neighbor hop — the sharding that makes PP cheap on a torus), and
+the microbatch count ``mb`` sweepable so the GPipe bubble
+``(mb + d - 1) / mb`` is directly measurable.
+
+Semantics: ``y = x @ W_0 @ W_1 @ ... @ W_{d-1}`` with x ``[m, k]``
+replicated (the chain enters at stage 0; deterministic seeded construction
+makes replication free), stage weights ``W [d, k, n]`` requiring
+``k == n`` so stages compose, and the output ``[m, n]`` returned
+replicated — the broadcast from the last stage is part of the measured
+schedule, exactly as tp_columnwise's all-gather is part of its
+measurement. Weights are scaled by ``sqrt(3/k)`` so activations stay O(1)
+through the chain (unit-variance propagation); without it a d-deep chain
+of uniform[-1,1] GEMMs grows as ``k^(d/2)`` and drowns the tolerance rule.
+
+FLOPs: ``2*m*k*n*d`` (d chained GEMMs). Validation tolerance: the chain is
+numerically a depth-``d`` composition, so the reference atol rule
+(tp_columnwise.py:150-162) is scaled by ``d``:
+``atol = (1e-3 half / 1e-4) * k * d``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive, validation_atol
+
+
+class PPPipeline(Primitive):
+    """ABC for pipeline-parallel staged-GEMM implementations."""
+
+    primitive_name = "pp_pipeline"
+
+    def _check_shapes(self) -> None:
+        if self.k != self.n:
+            raise ValueError(
+                f"pp_pipeline stages compose: k={self.k} must equal n={self.n}"
+            )
+        if self.dtype in ("int32", "int64"):
+            raise ValueError(
+                "pp_pipeline requires a floating dtype (scaled stage weights)"
+            )
+
+    @property
+    def num_stages(self) -> int:
+        return self.num_partitions
+
+    def flops(self) -> float:
+        return 2.0 * self.m * self.k * self.n * self.num_stages
+
+    def _host_chain_operands(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Seeded tokens ``[m, k]`` and stage weights ``[d, k, n]`` scaled
+        for unit-variance propagation, built identically on every host."""
+        rng = np.random.default_rng(self.seed)
+        gen = np.float64 if self.dtype == "float64" else np.float32
+        a = rng.uniform(-1.0, 1.0, (self.m, self.k)).astype(gen)
+        scale = np.sqrt(3.0 / self.k).astype(gen)
+        w = (
+            rng.uniform(-1.0, 1.0, (self.num_stages, self.k, self.n)) * scale
+        ).astype(gen)
+        return a, w
+
+    def _input_setup(self) -> None:
+        a_host, w_host = self._host_chain_operands()
+        self.a = self._device_put(a_host, P(None, None))       # replicated
+        self.w = self._device_put(w_host, P("tp", None, None)) # stage p on p
+
+    @property
+    def _call_args(self):
+        return (self.a, self.w)
+
+    def get_inputs(self):
+        return self.a, self.w
+
+    def _expected_full(self) -> np.ndarray:
+        """Single-device chain product in float32/float64 accumulation,
+        operands round-tripped through the device's low precision."""
+        a, w = self._host_chain_operands()
+        acc = np.float64 if self.dtype == "float64" else np.float32
+        if self.dtype in ("float16", "bfloat16"):
+            import jax.numpy as jnp
+
+            cast = jnp.float16 if self.dtype == "float16" else jnp.bfloat16
+            a = np.asarray(jnp.asarray(a, cast), acc)
+            w = np.asarray(jnp.asarray(w, cast), acc)
+        y = a.astype(acc)
+        for j in range(self.num_stages):
+            y = y @ w[j].astype(acc)
+            if self.dtype in ("float16", "bfloat16"):
+                import jax.numpy as jnp
+
+                cast = jnp.float16 if self.dtype == "float16" else jnp.bfloat16
+                y = np.asarray(jnp.asarray(y, cast), acc)
+        return y
+
+    def _atol(self) -> float:
+        return validation_atol(self.dtype, self.k) * self.num_stages
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        return self._compare_global(
+            result, self._expected_full(), atol=self._atol()
+        )

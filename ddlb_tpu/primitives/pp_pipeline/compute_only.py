@@ -1,0 +1,50 @@
+"""Compute-only rooflines for the pipeline primitive.
+
+Reference role: upper/lower bounds with no communication
+(/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55).
+
+- ``sharded``: one stage's GEMM ``[m, k] @ [k, n]`` on a single device —
+  1/d of the chain, the per-tick lower bound (validation skipped).
+- ``unsharded``: the full d-stage chain on one device — the single-device
+  upper-bound comparator, validated against the chain oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+
+
+class ComputeOnlyPPPipeline(PPPipeline):
+    DEFAULT_OPTIONS = {"size": "sharded"}
+    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def _input_setup(self) -> None:
+        a_host, w_host = self._host_chain_operands()
+        device = self.runtime.local_devices[0]
+        dt = jnp_dtype(self.dtype)
+        self.a = jax.device_put(jnp.asarray(a_host).astype(dt), device)
+        if self.options["size"] == "sharded":
+            self.w = jax.device_put(jnp.asarray(w_host[:1]).astype(dt), device)
+        else:
+            self.w = jax.device_put(jnp.asarray(w_host).astype(dt), device)
+        stages = int(self.w.shape[0])
+
+        def chain(a, w):
+            y = a
+            for j in range(stages):
+                y = jnp.matmul(
+                    y, w[j], preferred_element_type=jnp.float32
+                ).astype(a.dtype)
+            return y
+
+        self._fn = jax.jit(chain)
+        jax.block_until_ready((self.a, self.w))
+
+    def validate(self, result) -> bool:
+        if self.options["size"] == "sharded":
+            return True  # single-stage partial, not the chain
+        return super().validate(result)

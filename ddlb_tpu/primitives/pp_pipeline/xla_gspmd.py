@@ -1,0 +1,48 @@
+"""Compiler-driven staged chain (GSPMD slot).
+
+The PP analogue of the reference's JAX comparator
+(/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:60-76): the chain
+is written as d plain matmuls against slices of the stage-sharded weight
+stack under ``jit``, and the SPMD partitioner chooses how each resident
+stage weight reaches the replicated activations (in practice a broadcast
+per stage — the "weight-gathered pipeline" schedule, the upper-bound
+comparator for activation-passing schedules on interconnects where weight
+movement is cheaper than the bubble).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+from ddlb_tpu.runtime import as_auto_mesh
+
+
+class XLAGSPMDPPPipeline(PPPipeline):
+    def _input_setup(self) -> None:
+        self.mesh = as_auto_mesh(self.mesh)
+        super()._input_setup()
+        d = self.num_stages
+        dt = jnp_dtype(self.dtype)
+        mesh = self.mesh
+        sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
+
+        @partial(
+            jax.jit,
+            in_shardings=(sh(None, None), sh("tp", None, None)),
+            out_shardings=sh(None, None),
+        )
+        def step(a, w):
+            y = a
+            for j in range(d):
+                y = jnp.matmul(
+                    y, w[j], preferred_element_type=jnp.float32
+                ).astype(dt)
+            return y
+
+        self._fn = step

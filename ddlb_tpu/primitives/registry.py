@@ -28,6 +28,7 @@ ALLOWED_PRIMITIVES = (
     "dp_allreduce",
     "cp_ring_attention",
     "ep_alltoall",
+    "pp_pipeline",
 )
 
 _REGISTRY = {
@@ -140,6 +141,23 @@ _REGISTRY = {
         "overlap": (
             "ddlb_tpu.primitives.ep_alltoall.overlap",
             "OverlapEPAllToAll",
+        ),
+    },
+    # pipeline-parallel staged GEMM chain: no reference analogue
+    # (SURVEY.md section 2.5 lists PP among the absent strategies);
+    # GPipe microbatch schedule with a measurable bubble
+    "pp_pipeline": {
+        "compute_only": (
+            "ddlb_tpu.primitives.pp_pipeline.compute_only",
+            "ComputeOnlyPPPipeline",
+        ),
+        "jax_spmd": (
+            "ddlb_tpu.primitives.pp_pipeline.jax_spmd",
+            "JaxSPMDPPPipeline",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.pp_pipeline.xla_gspmd",
+            "XLAGSPMDPPPipeline",
         ),
     },
 }

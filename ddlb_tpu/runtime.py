@@ -146,3 +146,17 @@ class Runtime:
             f"Runtime(process={self.process_id}/{self.num_processes}, "
             f"devices={self.num_devices}, platform={self.platform})"
         )
+
+
+def as_auto_mesh(mesh):
+    """Rebuild a mesh with all axes in ``Auto`` mode for GSPMD implicit
+    propagation (JAX 0.9 defaults to Explicit sharding-in-types, which
+    rejects mid-function ``with_sharding_constraint``); operands and jit
+    shardings must then use this mesh consistently."""
+    from jax.sharding import AxisType, Mesh
+
+    return Mesh(
+        mesh.devices,
+        mesh.axis_names,
+        axis_types=(AxisType.Auto,) * len(mesh.axis_names),
+    )
