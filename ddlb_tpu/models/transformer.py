@@ -1,0 +1,365 @@
+"""Flagship model: MoE transformer LM over a (dp, tp, pp) mesh.
+
+The reference has no model at all — its workloads are bare GEMM primitives
+(SURVEY.md section 2.5). This module composes every primitive family the
+framework benchmarks into the training step they exist to accelerate, all
+five parallelism axes at once:
+
+- **dp**: batch sharded over the ``dp`` mesh axis; gradient all-reduce is
+  the ``dp_allreduce`` pattern (inserted by autodiff through the psums).
+- **tp + sp**: Megatron-style sequence-parallel attention/MLP — activations
+  sequence-sharded over ``tp`` outside the matmuls; the QKV projection is
+  the ``tp_columnwise`` pattern (all-gather + column-sharded GEMM), the
+  output projection the ``tp_rowwise`` pattern (row-sharded GEMM +
+  psum_scatter).
+- **ep**: MoE FFN with one expert resident per ``tp`` coordinate, balanced
+  block routing over mirrored ``lax.all_to_all`` — the ``ep_alltoall``
+  pattern.
+- **pp**: layers split into stages resident per ``pp`` coordinate,
+  GPipe-microbatched with activations hopping neighbor-to-neighbor over
+  ``ppermute`` — the ``pp_pipeline`` pattern (loss is a scalar, so the
+  drain is a trivial psum instead of the ring drain).
+- long-context attention itself is head-parallel over ``tp`` after the
+  sequence all-gather (the ``cp_ring_attention`` family benchmarks the
+  ring alternative).
+
+Everything is hand-scheduled manual SPMD under one ``shard_map`` — the
+whole train step (forward, backward through every collective, optimizer)
+jits to a single XLA program per device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+LN_EPS = 1e-6
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 64
+    d_model: int = 32
+    n_heads: int = 4
+    d_ff: int = 64
+    layers_per_stage: int = 1
+    microbatches: int = 2
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(
+    cfg: TransformerConfig, pp: int, n_experts: int, seed: int = 0
+) -> Dict[str, jax.Array]:
+    """Seeded host-side parameters, stage-stacked on a leading ``pp`` axis
+    (deterministic across hosts, like the primitive operands)."""
+    rng = np.random.default_rng(seed)
+    D, F, L, V = cfg.d_model, cfg.d_ff, cfg.layers_per_stage, cfg.vocab
+
+    def normal(shape, scale):
+        return jnp.asarray(rng.normal(0.0, scale, shape), cfg.dtype)
+
+    s_in = (1.0 / D) ** 0.5
+    s_ff = (1.0 / F) ** 0.5
+    return {
+        "embed": normal((V, D), 1.0),
+        # leading 3 = Q/K/V so a tp column-shard is per-projection heads,
+        # not a contiguous slice across the fused [D, 3D] layout
+        "w_qkv": normal((pp, L, 3, D, D), s_in),
+        "w_o": normal((pp, L, D, D), s_in),
+        "moe_w1": normal((pp, L, n_experts, D, F), s_in),
+        "moe_w2": normal((pp, L, n_experts, F, D), s_ff),
+        "ln1": jnp.ones((pp, L, D), cfg.dtype),
+        "ln2": jnp.ones((pp, L, D), cfg.dtype),
+        "ln_f": jnp.ones((D,), cfg.dtype),
+        "head": normal((D, V), s_in),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    """PartitionSpecs: stage axis on ``pp``; QKV columns / output-proj rows
+    / experts on ``tp``; embedding, head and norms replicated."""
+    return {
+        "embed": P(None, None),
+        "w_qkv": P("pp", None, None, None, "tp"),
+        "w_o": P("pp", None, "tp", None),
+        "moe_w1": P("pp", None, "tp", None, None),
+        "moe_w2": P("pp", None, "tp", None, None),
+        "ln1": P("pp", None, None),
+        "ln2": P("pp", None, None),
+        "ln_f": P(None),
+        "head": P(None, None),
+    }
+
+
+def _rms_norm(x, scale):
+    h = x.astype(jnp.float32)
+    h = h * jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True) + LN_EPS)
+    return (h * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _causal_attention(q, k, v):
+    """[b, S, h, dh] f32 causal softmax attention (full gathered sequence,
+    local heads)."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s * scale
+    S = s.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    s = jnp.where((rows >= cols)[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _ce_loss(logits, targets):
+    """Mean token cross-entropy in f32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
+    """Full manual-SPMD training step over a ``('dp', 'tp', 'pp')`` mesh.
+
+    Returns ``(train_step, init_opt_state, shardings)`` where
+    ``train_step(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)`` is jitted end to end and ``shardings`` maps
+    param names plus ``'data'`` to ``NamedSharding``s for ``device_put``.
+    """
+    import optax
+
+    optimizer = optax.adamw(learning_rate)
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    pp = mesh.shape["pp"]
+    mb = cfg.microbatches
+    L = cfg.layers_per_stage
+    specs = param_specs(cfg)
+
+    def stage_fn(x, sp):
+        """Apply this stage's L transformer blocks to a local activation
+        slab ``[b, S/tp, d_model]``; tp/sp/ep collectives inside."""
+        b, s_loc, D = x.shape
+        h_heads = cfg.n_heads // tp
+        for l in range(L):
+            # -- attention (tp_columnwise -> heads-local -> tp_rowwise) --
+            h = _rms_norm(x, sp["ln1"][0, l])
+            h_full = jax.lax.all_gather(h, "tp", axis=1, tiled=True)
+            wq = sp["w_qkv"][0, l]  # [3, D, D/tp]: local heads per projection
+            q, k, v = (
+                jnp.matmul(
+                    h_full, wq[i], preferred_element_type=jnp.float32
+                ).astype(x.dtype)
+                for i in range(3)
+            )
+            S = q.shape[1]
+            shape = (b, S, h_heads, cfg.head_dim)
+            attn = _causal_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape)
+            ).reshape(b, S, -1)  # [b, S, D/tp]
+            part = jnp.matmul(
+                attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
+            )  # [b, S, D] partial over tp
+            y = jax.lax.psum_scatter(
+                part, "tp", scatter_dimension=1, tiled=True
+            ).astype(x.dtype)
+            x = x + y
+            # -- MoE FFN (ep_alltoall over the tp axis) --
+            h = _rms_norm(x, sp["ln2"][0, l])
+            T = b * s_loc
+            t3 = h.reshape(tp, T // tp, D)  # balanced block routing
+            t3 = jax.lax.all_to_all(
+                t3, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            u = jax.nn.gelu(
+                jnp.matmul(
+                    t3.reshape(T, D),
+                    sp["moe_w1"][0, l, 0],
+                    preferred_element_type=jnp.float32,
+                )
+            ).astype(x.dtype)
+            u = jnp.matmul(
+                u, sp["moe_w2"][0, l, 0], preferred_element_type=jnp.float32
+            ).astype(x.dtype)
+            u = jax.lax.all_to_all(
+                u.reshape(tp, T // tp, D),
+                "tp",
+                split_axis=0,
+                concat_axis=0,
+                tiled=True,
+            )
+            x = x + u.reshape(b, s_loc, D)
+        return x
+
+    stage_fn = jax.checkpoint(stage_fn)  # PP-standard per-stage remat
+
+    def loss_body(params, tokens, targets):
+        """shard_map body. tokens/targets: [B/dp, S] int32 (dp-sharded,
+        replicated over tp and pp)."""
+        p_tp = jax.lax.axis_index("tp")
+        p_pp = jax.lax.axis_index("pp")
+        B_loc, S = tokens.shape
+        s_loc = S // tp
+        b_mb = B_loc // mb
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def embed_mb(i):
+            tok = jax.lax.dynamic_slice_in_dim(tokens, i * b_mb, b_mb, 0)
+            tok = jax.lax.dynamic_slice_in_dim(tok, p_tp * s_loc, s_loc, 1)
+            return params["embed"][tok]  # [b_mb, S/tp, D]
+
+        def tail_loss(y, i):
+            """Last-stage head + CE on microbatch i's local slab."""
+            h = _rms_norm(y, params["ln_f"])
+            logits = jnp.matmul(
+                h, params["head"], preferred_element_type=jnp.float32
+            )
+            tgt = jax.lax.dynamic_slice_in_dim(targets, i * b_mb, b_mb, 0)
+            tgt = jax.lax.dynamic_slice_in_dim(tgt, p_tp * s_loc, s_loc, 1)
+            return _ce_loss(logits, tgt)
+
+        buf = jnp.zeros((b_mb, s_loc, cfg.d_model), cfg.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+        for t in range(mb + pp - 1):
+            if t < mb:
+                x_in = jnp.where(p_pp == 0, embed_mb(t), buf)
+            else:
+                x_in = buf
+            y = stage_fn(x_in, params)
+            fin = t - (pp - 1)
+            if 0 <= fin < mb:
+                loss_acc = loss_acc + jnp.where(
+                    p_pp == pp - 1, tail_loss(y, fin), 0.0
+                )
+            if t + 1 < mb + pp - 1:
+                buf = jax.lax.ppermute(y, "pp", perm=fwd)
+        # scalar reductions: surface the loss everywhere (pp), average the
+        # equal-sized token blocks (dp batch shards, tp sequence shards)
+        loss = jax.lax.psum(loss_acc / mb, "pp")
+        loss = jax.lax.psum(loss, "dp") / dp
+        loss = jax.lax.psum(loss, "tp") / tp
+        return loss
+
+    pspecs = {k: specs[k] for k in specs}
+    loss_fn = jax.shard_map(
+        loss_body,
+        mesh=mesh,
+        in_specs=(pspecs, P("dp", None), P("dp", None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    shardings["data"] = NamedSharding(mesh, P("dp", None))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    return train_step, init_opt_state, shardings
+
+
+# -- single-device oracle ----------------------------------------------------
+
+
+def reference_loss(
+    params, tokens, targets, cfg: TransformerConfig, tp: int, dp: int = 1
+) -> jax.Array:
+    """Single-device oracle reproducing the distributed math exactly.
+
+    Attention and norms are per-batch-row, but the MoE block routing
+    couples tokens within one (dp rank, microbatch, tp seq-shard) slab —
+    so the oracle forwards each ``B // (dp * microbatches)``-row chunk
+    independently, grouping tokens per seq shard exactly as the tp ranks
+    do, and averages the chunk cross-entropies (equal-sized chunks make
+    that the distributed psum-averaged loss)."""
+    B, S = tokens.shape
+    b_mb = B // (dp * cfg.microbatches)
+    s_loc = S // tp
+    D = cfg.d_model
+    pp, L = params["w_qkv"].shape[:2]
+    losses = []
+    for c0 in range(0, B, b_mb):
+        x = params["embed"][tokens[c0 : c0 + b_mb]]  # [b_mb, S, D]
+        for st in range(pp):
+            for l in range(L):
+                h = _rms_norm(x, params["ln1"][st, l])
+                q, k, v = (
+                    jnp.matmul(
+                        h,
+                        params["w_qkv"][st, l, i],
+                        preferred_element_type=jnp.float32,
+                    ).astype(x.dtype)
+                    for i in range(3)
+                )
+                shape = (b_mb, S, cfg.n_heads, cfg.head_dim)
+                attn = _causal_attention(
+                    q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                ).reshape(b_mb, S, D)
+                x = x + jnp.matmul(
+                    attn, params["w_o"][st, l], preferred_element_type=jnp.float32
+                ).astype(x.dtype)
+                h = _rms_norm(x, params["ln2"][st, l])
+                # per-seq-shard balanced block routing, as the tp ranks do
+                u = jnp.zeros_like(h)
+                T = b_mb * s_loc
+                g = T // tp
+                for j in range(tp):
+                    blk = h[:, j * s_loc : (j + 1) * s_loc].reshape(T, D)
+                    out_blk = jnp.zeros((T, D), x.dtype)
+                    for e in range(tp):
+                        grp = blk[e * g : (e + 1) * g]
+                        z = jax.nn.gelu(
+                            jnp.matmul(
+                                grp,
+                                params["moe_w1"][st, l, e],
+                                preferred_element_type=jnp.float32,
+                            )
+                        ).astype(x.dtype)
+                        z = jnp.matmul(
+                            z,
+                            params["moe_w2"][st, l, e],
+                            preferred_element_type=jnp.float32,
+                        ).astype(x.dtype)
+                        out_blk = jax.lax.dynamic_update_slice(
+                            out_blk, z, (e * g, 0)
+                        )
+                    u = jax.lax.dynamic_update_slice(
+                        u, out_blk.reshape(b_mb, s_loc, D), (0, j * s_loc, 0)
+                    )
+                x = x + u
+        h = _rms_norm(x, params["ln_f"])
+        logits = jnp.matmul(h, params["head"], preferred_element_type=jnp.float32)
+        losses.append(_ce_loss(logits, targets[c0 : c0 + b_mb]))
+    return jnp.mean(jnp.stack(losses))
+
+
+def example_tokens(
+    batch: int, seq: int, vocab: int, seed: int = 1
+) -> Tuple[jax.Array, jax.Array]:
+    """Random token stream; targets are next-token shifted."""
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, (batch, seq + 1))
+    return (
+        jnp.asarray(toks[:, :-1], jnp.int32),
+        jnp.asarray(toks[:, 1:], jnp.int32),
+    )

@@ -67,7 +67,8 @@ def test_graft_entry_single_chip():
     spec.loader.exec_module(mod)
     fn, args = mod.entry()
     out = jax.jit(fn)(*args)
-    assert out.shape == args[0].shape
+    assert out.shape == ()  # forward+loss on the flagship transformer
+    assert np.isfinite(float(out))
 
 
 def test_graft_dryrun_multichip():
