@@ -121,40 +121,69 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     # TFLOPS = flops / 1e9 / time_ms; GEMM primitives use the reference's
     # 2*m*n*k (benchmark.py:209-214), attention primitives override flops()
     flop_count = impl.flops() if impl is not None else 2.0 * m * n * k
-    tflops = flop_count / 1e9 / times_ms
+    row = make_result_row(
+        config,
+        times_ms=times_ms,
+        flop_count=flop_count,
+        option_repr=option_repr,
+        valid=valid,
+        error=error or "",
+        world_size=runtime.num_devices,
+        num_processes=runtime.num_processes,
+        platform=runtime.platform,
+    )
+    del impl, result
+    return row
 
-    # native robust statistics (ddlb_tpu/native/host_runtime.cpp); median
-    # and p95 are jitter-resistant additions over the reference's four.
-    # Error rows carry NaN times -> all-NaN stats by the native contract.
+
+def make_result_row(
+    config: Dict[str, Any],
+    times_ms: np.ndarray,
+    flop_count: float,
+    option_repr: str,
+    valid: bool,
+    error: str,
+    world_size: int,
+    num_processes: int,
+    platform: str,
+) -> Dict[str, Any]:
+    """The one result-row schema, shared by measured, crashed and
+    timed-out workers so the CSV columns cannot drift apart.
+
+    Statistics come from the native host-runtime
+    (ddlb_tpu/native/host_runtime.cpp); median and p95 are
+    jitter-resistant additions over the reference's four. Error rows
+    carry NaN times -> all-NaN stats by the native contract.
+    """
+    tflops = flop_count / 1e9 / times_ms
     stats = robust_stats(times_ms)
-    row = {
-        "implementation": impl_id,
+    return {
+        "implementation": config["impl_id"],
+        "primitive": config["primitive"],
         "mean time (ms)": stats["mean"],
         "std time (ms)": stats["std"],
         "min time (ms)": stats["min"],
         "max time (ms)": stats["max"],
         "median time (ms)": stats["median"],
         "p95 time (ms)": stats["p95"],
-        "m": m,
-        "n": n,
-        "k": k,
-        "dtype": dtype,
+        "m": config["m"],
+        "n": config["n"],
+        "k": config["k"],
+        "dtype": config["dtype"],
         "Throughput (TFLOPS)": float(np.mean(tflops)),
         "Throughput std (TFLOPS)": float(np.std(tflops)),
-        "world_size": runtime.num_devices,
-        "num_processes": runtime.num_processes,
+        "world_size": world_size,
+        "num_processes": num_processes,
         "hostname": socket.gethostname(),
-        "platform": runtime.platform,
-        "time_measurement_backend": timing_backend,
-        "barrier_at_each_iteration": barrier_each,
+        "platform": platform,
+        "time_measurement_backend": config["time_measurement_backend"],
+        "barrier_at_each_iteration": config["barrier_at_each_iteration"],
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
         # has the column when a later implementation crashes
-        "error": error or "",
+        "error": error,
     }
-    del impl, result
-    return row
 
 
 def _timing_loop(impl, runtime, num_iterations, backend, barrier_each):
@@ -237,6 +266,8 @@ class PrimitiveBenchmarkRunner:
         profile_dir: Optional[str] = None,
         isolation: str = "none",
         progress: bool = True,
+        worker_timeout: Optional[float] = None,
+        resume: bool = False,
     ) -> None:
         if primitive not in self.ALLOWED_PRIMITIVES:
             raise ValueError(
@@ -245,6 +276,10 @@ class PrimitiveBenchmarkRunner:
             )
         if isolation not in ("none", "subprocess"):
             raise ValueError("isolation must be 'none' or 'subprocess'")
+        if worker_timeout is not None and isolation != "subprocess":
+            # only a separate process can be killed mid-collective; the
+            # in-process path has no safe preemption point
+            raise ValueError("worker_timeout requires isolation='subprocess'")
         self.primitive = primitive
         self.m, self.n, self.k = m, n, k
         self.implementations = implementations
@@ -258,6 +293,8 @@ class PrimitiveBenchmarkRunner:
         self.profile_dir = profile_dir
         self.isolation = isolation
         self.progress = progress
+        self.worker_timeout = worker_timeout
+        self.resume = resume
 
     def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
         spec = dict(spec)
@@ -283,9 +320,14 @@ class PrimitiveBenchmarkRunner:
         """Benchmark every implementation; returns a pandas DataFrame."""
         import pandas as pd
 
-        from ddlb_tpu.envs import get_process_id
+        from ddlb_tpu.envs import get_num_processes, get_process_id
 
         is_primary = get_process_id() == 0
+        if self.resume and get_num_processes() > 1:
+            # the skip decision reads the primary's CSV; without a shared
+            # view every process could skip differently and deadlock the
+            # collective world
+            raise ValueError("resume is single-process only")
         items = list(self.implementations.items())
         iterator = items
         if self.progress and is_primary:
@@ -296,8 +338,17 @@ class PrimitiveBenchmarkRunner:
             except ImportError:  # pragma: no cover
                 pass
 
+        done = self._completed_rows() if self.resume else set()
         rows: List[Dict[str, Any]] = []
         for impl_id, spec in iterator:
+            if self._resume_key(impl_id, spec) in done:
+                # checkpoint/resume: the incremental CSV is the resumable
+                # artifact (SURVEY.md section 5) — rows already recorded
+                # for this (impl, shape, dtype) are skipped, so an
+                # interrupted sweep restarts where it stopped
+                if is_primary:
+                    print(f"[ddlb_tpu] resume: skipping {impl_id} (in CSV)")
+                continue
             config = self._worker_config(impl_id, spec)
             row = self._run_one(config)
             rows.append(row)
@@ -309,24 +360,120 @@ class PrimitiveBenchmarkRunner:
                     self._append_csv(row)
         return pd.DataFrame(rows)
 
+    def _resume_key(self, impl_id: str, spec: Dict[str, Any]):
+        """Identity of one benchmark config, independent of the positional
+        ``impl_id`` numbering (which renumbers when the sweep is edited):
+        base implementation name + fully-merged option repr + shape/dtype.
+        Matches the ``option`` column the worker records (defaults merged
+        by OptionsManager)."""
+        spec = dict(spec)
+        base = spec.pop("implementation", impl_id.rsplit("_", 1)[0])
+        try:
+            cls = load_impl_class(self.primitive, base)
+            merged = {**cls.DEFAULT_OPTIONS, **spec}
+        except Exception:
+            merged = spec
+        return (
+            self.primitive,
+            base,
+            _format_options(merged),
+            self.m,
+            self.n,
+            self.k,
+            self.dtype,
+        )
+
+    def _completed_rows(self) -> set:
+        """Keys already recorded in the output CSV (resume support).
+
+        Crashed/timed-out rows (non-empty ``error``) do NOT count as
+        completed — a transient failure is retried on resume; recorded
+        measurements (including soft validation failures) are not.
+        """
+        import pandas as pd
+
+        path = self.output_csv
+        if not path or not os.path.exists(path) or os.path.getsize(path) == 0:
+            return set()
+        df = pd.read_csv(path)
+        needed = {"implementation", "primitive", "option", "m", "n", "k", "dtype"}
+        if not needed.issubset(df.columns):
+            raise ValueError(
+                f"cannot resume from {path}: it predates resume support "
+                f"(missing columns {sorted(needed - set(df.columns))}); "
+                f"start a fresh CSV or add the columns"
+            )
+        if "error" in df.columns:
+            df = df[df["error"].isna() | (df["error"].astype(str) == "")]
+        return {
+            (
+                r["primitive"],
+                str(r["implementation"]).rsplit("_", 1)[0],
+                r["option"],
+                int(r["m"]),
+                int(r["n"]),
+                int(r["k"]),
+                r["dtype"],
+            )
+            for _, r in df.iterrows()
+        }
+
     def _run_one(self, config: Dict[str, Any]) -> Dict[str, Any]:
         if self.isolation == "subprocess":
             # full per-implementation process isolation (reference
             # spawn-per-impl, benchmark.py:336-370)
             import multiprocessing as mp
+            import queue as queue_mod
 
             ctx = mp.get_context("spawn")
-            queue = ctx.SimpleQueue()
+            queue = ctx.Queue()
             proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
             proc.start()
-            row = queue.get()
-            proc.join()
+            try:
+                # failure detection: the reference blocks forever on a hung
+                # child (queue.get with no timeout, benchmark.py:369 —
+                # SURVEY.md section 5 "no retries, no timeouts"); a bounded
+                # wait turns a deadlocked backend into an error row
+                row = queue.get(timeout=self.worker_timeout)
+            except queue_mod.Empty:
+                proc.kill()
+                proc.join()
+                return self._timeout_row(config)
+            # a child can also hang in interpreter teardown (runtime/atexit
+            # finalizers) after delivering its row — bound the join too
+            proc.join(self.worker_timeout)
+            if proc.is_alive():
+                proc.kill()
+                proc.join()
             return row
         import jax
 
         row = benchmark_worker(config)
         jax.clear_caches()  # avoid cross-impl compilation-cache coupling
         return row
+
+    def _timeout_row(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Error row for a worker that exceeded ``worker_timeout`` — the
+        same schema as measured rows via ``make_result_row``. Deliberately
+        JAX-free: in subprocess mode the parent must never touch the
+        accelerator (reference 'no CUDA init in parent',
+        cli/benchmark.py:126)."""
+        from ddlb_tpu.envs import get_num_processes
+
+        return make_result_row(
+            config,
+            times_ms=np.array([float("nan")]),
+            flop_count=2.0 * config["m"] * config["n"] * config["k"],
+            option_repr=_format_options(config.get("options", {})),
+            valid=False,
+            error=(
+                f"TimeoutError: worker exceeded {self.worker_timeout}s "
+                f"(killed)"
+            ),
+            world_size=-1,  # unknown: the worker died before reporting
+            num_processes=get_num_processes(),
+            platform="unknown",
+        )
 
     def _append_csv(self, row: Dict[str, Any]) -> None:
         import pandas as pd

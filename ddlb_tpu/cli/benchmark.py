@@ -116,7 +116,22 @@ def assign_impl_ids(
 
 
 def _normalize(config: Dict[str, Any]) -> Dict[str, Any]:
-    return dict(config.get("benchmark", config))
+    cfg = dict(config.get("benchmark", config))
+    impls = cfg.get("implementations")
+    if isinstance(impls, list):
+        # JSON list form [{"name": n, ...opts}, ...] (the shipped
+        # scripts/config_*.json shape) -> canonical {name: [opts, ...]}
+        as_dict: Dict[str, List[Dict[str, Any]]] = {}
+        for block in impls:
+            block = dict(block)
+            name = block.pop("name", None)
+            if not name:
+                raise ValueError(
+                    f"implementation list entries need a 'name': {block!r}"
+                )
+            as_dict.setdefault(name, []).append(block)
+        cfg["implementations"] = as_dict
+    return cfg
 
 
 def _as_list(value) -> List[int]:
@@ -151,6 +166,12 @@ def run_benchmark(config: Dict[str, Any]):
     # (reference cli/benchmark.py:179-188)
     timestamp = time.strftime("%Y%m%d_%H%M%S")
     output_csv = cfg.get("output_csv")
+    if cfg.get("resume") and (output_csv is None or "{timestamp}" in output_csv):
+        # a per-run path can never contain previous rows: resuming against
+        # it would silently re-run everything while scattering results
+        raise ValueError(
+            "resume requires a fixed output_csv path (no {timestamp} token)"
+        )
     if output_csv is None:
         m0, n0, k0 = shapes[0]
         output_csv = (
@@ -178,6 +199,8 @@ def run_benchmark(config: Dict[str, Any]):
             profile_dir=cfg.get("profile_dir"),
             isolation=cfg.get("isolation", "none"),
             progress=cfg.get("progress", True),
+            worker_timeout=cfg.get("worker_timeout"),
+            resume=cfg.get("resume", False),
         )
         frames.append(runner.run())
 
@@ -247,6 +270,16 @@ def main(argv=None) -> None:
         "--sim", type=int, default=None, metavar="N",
         help="run on an N-device CPU simulation",
     )
+    parser.add_argument(
+        "--worker-timeout", type=float, default=None, metavar="SECONDS",
+        help="kill a hung worker after this many seconds and record an "
+        "error row (requires --isolation subprocess)",
+    )
+    parser.add_argument(
+        "--resume", action="store_true",
+        help="skip (impl, shape, dtype) rows already present in --csv "
+        "(give a fixed path, not a {timestamp} one)",
+    )
     args = parser.parse_args(argv)
 
     impl_specs = args.impl or ["jax_spmd"]
@@ -271,6 +304,8 @@ def main(argv=None) -> None:
         "profile_dir": args.profile_dir,
         "isolation": args.isolation,
         "sim": args.sim,
+        "worker_timeout": args.worker_timeout,
+        "resume": args.resume,
     }
     run_benchmark(config)
 

@@ -154,6 +154,13 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
         slab ``[b, S/tp, d_model]``; tp/sp/ep collectives inside."""
         b, s_loc, D = x.shape
         h_heads = cfg.n_heads // tp
+        if sp["moe_w1"].shape[2] != 1:
+            # the body indexes the single resident expert ([0, l, 0]);
+            # more experts per rank would silently use only every tp-th one
+            raise ValueError(
+                f"n_experts must equal tp={tp} (one resident expert per "
+                f"rank); got {sp['moe_w1'].shape[2] * tp}"
+            )
         for l in range(L):
             # -- attention (tp_columnwise -> heads-local -> tp_rowwise) --
             h = _rms_norm(x, sp["ln1"][0, l])
@@ -212,6 +219,19 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
         p_tp = jax.lax.axis_index("tp")
         p_pp = jax.lax.axis_index("pp")
         B_loc, S = tokens.shape
+        # static-shape contract, checked at trace time: silent truncation
+        # here would diverge from the oracle instead of failing fast
+        if B_loc % mb != 0:
+            raise ValueError(
+                f"per-dp-rank batch {B_loc} not divisible by "
+                f"microbatches={mb}"
+            )
+        if S % tp != 0:
+            raise ValueError(f"sequence {S} not divisible by tp={tp}")
+        if cfg.n_heads % tp != 0:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} not divisible by tp={tp}"
+            )
         s_loc = S // tp
         b_mb = B_loc // mb
         fwd = [(i, (i + 1) % pp) for i in range(pp)]
@@ -253,11 +273,10 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
         loss = jax.lax.psum(loss, "tp") / tp
         return loss
 
-    pspecs = {k: specs[k] for k in specs}
     loss_fn = jax.shard_map(
         loss_body,
         mesh=mesh,
-        in_specs=(pspecs, P("dp", None), P("dp", None)),
+        in_specs=(specs, P("dp", None), P("dp", None)),
         out_specs=P(),
         check_vma=False,
     )

@@ -65,3 +65,50 @@ def test_assign_impl_ids():
         "order": "AG_after",
         "implementation": "jax_spmd",
     }
+
+
+def test_shipped_configs_parse_and_expand():
+    """Every scripts/config_*.json (the JSON list format) normalizes into
+    the canonical dict form and expands to at least one impl_id —
+    regression for the list-format crash."""
+    import glob
+
+    from ddlb_tpu.cli.benchmark import _normalize
+
+    paths = sorted(glob.glob("scripts/config*.json"))
+    assert paths, "no shipped configs found"
+    for path in paths:
+        import json
+
+        with open(path) as f:
+            cfg = _normalize(json.load(f))
+        assert isinstance(cfg["implementations"], dict), path
+        impl_map = assign_impl_ids(
+            generate_config_combinations(cfg["implementations"])
+        )
+        assert impl_map, path
+        for spec in impl_map.values():
+            assert "implementation" in spec
+
+
+def test_list_format_config_runs_end_to_end(tmp_path):
+    from ddlb_tpu.cli.benchmark import run_benchmark
+
+    cfg = {
+        "benchmark": {
+            "primitive": "ep_alltoall",
+            "m": [128], "n": [32], "k": [64],
+            "dtype": "float32",
+            "num_iterations": 1,
+            "num_warmups": 0,
+            "progress": False,
+            "output_csv": str(tmp_path / "r.csv"),
+            "implementations": [
+                {"name": "jax_spmd"},
+                {"name": "overlap", "algorithm": "coll_pipeline", "s": [1, 2]},
+            ],
+        }
+    }
+    df = run_benchmark(cfg)
+    assert list(df["implementation"]) == ["jax_spmd_0", "overlap_0", "overlap_1"]
+    assert df["valid"].all()

@@ -1,0 +1,234 @@
+"""Failure detection (hung-worker timeout) and sweep resume-from-CSV.
+
+Both close gaps SURVEY.md section 5 identifies in the reference: a hung
+child blocks ``queue.get`` forever (benchmark.py:369, "no retries, no
+timeouts"), and the incremental CSV is the only resumable artifact but
+nothing consumes it.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+SHAPE = dict(m=128, n=32, k=64)
+
+
+def test_worker_timeout_requires_subprocess():
+    with pytest.raises(ValueError, match="subprocess"):
+        PrimitiveBenchmarkRunner(
+            "tp_columnwise",
+            implementations={"jax_spmd_0": {}},
+            worker_timeout=5.0,
+            **SHAPE,
+        )
+
+
+def test_resume_refused_multiprocess(monkeypatch, tmp_path):
+    monkeypatch.setenv("DDLB_TPU_NUM_PROCESSES", "2")
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={"jax_spmd_0": {}},
+        resume=True,
+        output_csv=str(tmp_path / "r.csv"),
+        **SHAPE,
+    )
+    with pytest.raises(ValueError, match="single-process"):
+        runner.run()
+
+
+def test_resume_skips_completed_rows(tmp_path):
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    df1 = PrimitiveBenchmarkRunner("tp_columnwise", **common).run()
+    assert len(df1) == 1
+
+    # second run adds an implementation; the recorded one is skipped
+    common["implementations"] = {
+        "jax_spmd_0": {"implementation": "jax_spmd"},
+        "compute_only_0": {"implementation": "compute_only"},
+    }
+    df2 = PrimitiveBenchmarkRunner(
+        "tp_columnwise", resume=True, **common
+    ).run()
+    assert list(df2["implementation"]) == ["compute_only_0"]
+
+    import pandas as pd
+
+    full = pd.read_csv(csv)
+    assert sorted(full["implementation"]) == ["compute_only_0", "jax_spmd_0"]
+
+    # a third resume run with nothing new is a no-op
+    df3 = PrimitiveBenchmarkRunner(
+        "tp_columnwise", resume=True, **common
+    ).run()
+    assert len(df3) == 0
+
+
+def test_resume_retries_error_rows(tmp_path):
+    """A crashed/timed-out row (non-empty error) is retried on resume."""
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    # bogus option -> crash-isolation error row
+    PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={
+            "jax_spmd_0": {"implementation": "jax_spmd", "bogus": 1}
+        },
+        **common,
+    ).run()
+    df = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        resume=True,
+        **common,
+    ).run()
+    assert len(df) == 1  # retried, not skipped
+    assert df.iloc[0]["error"] == ""
+
+
+def test_resume_distinguishes_primitives(tmp_path):
+    """Primitives sharing one CSV do not false-skip each other."""
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        m=128, n=32, k=64,
+    )
+    PrimitiveBenchmarkRunner("tp_columnwise", **common).run()
+    df = PrimitiveBenchmarkRunner("tp_rowwise", resume=True, **common).run()
+    assert len(df) == 1  # same impl/shape/dtype, different primitive
+
+
+def test_cli_resume_requires_fixed_csv():
+    from ddlb_tpu.cli.benchmark import run_benchmark
+
+    cfg = {
+        "benchmark": {
+            "primitive": "tp_columnwise",
+            "m": [128], "n": [32], "k": [64],
+            "implementations": [{"name": "jax_spmd"}],
+            "resume": True,
+            "output_csv": "results/x_{timestamp}.csv",
+        }
+    }
+    with pytest.raises(ValueError, match="fixed output_csv"):
+        run_benchmark(cfg)
+
+
+def test_resume_widened_option_sweep(tmp_path):
+    """Editing the sweep renumbers impl_ids; resume must match by options,
+    not position: only the genuinely new config runs."""
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={
+            "jax_spmd_0": {"implementation": "jax_spmd", "order": "AG_before"},
+        },
+        **common,
+    ).run()
+    # widened sweep: AG_after now takes slot 0
+    df = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={
+            "jax_spmd_0": {"implementation": "jax_spmd", "order": "AG_after"},
+            "jax_spmd_1": {"implementation": "jax_spmd", "order": "AG_before"},
+        },
+        resume=True,
+        **common,
+    ).run()
+    assert len(df) == 1
+    assert df.iloc[0]["option"] == "order=AG_after"
+
+
+def test_resume_legacy_csv_rejected(tmp_path):
+    import pandas as pd
+
+    path = tmp_path / "legacy.csv"
+    pd.DataFrame(
+        [{"implementation": "jax_spmd_0", "m": 128, "n": 32, "k": 64,
+          "dtype": "float32"}]
+    ).to_csv(path, index=False)
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        resume=True,
+        output_csv=str(path),
+        **SHAPE,
+    )
+    with pytest.raises(ValueError, match="predates resume"):
+        runner.run()
+
+
+def test_resume_different_shape_not_skipped(tmp_path):
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+    )
+    PrimitiveBenchmarkRunner("tp_columnwise", **SHAPE, **common).run()
+    df = PrimitiveBenchmarkRunner(
+        "tp_columnwise", m=256, n=32, k=64, resume=True, **common
+    ).run()
+    assert len(df) == 1  # same impl, new shape -> runs
+
+
+@pytest.mark.slow
+def test_hung_worker_killed(tmp_path):
+    """A worker spinning far past the timeout becomes an error row instead
+    of blocking the sweep forever."""
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={
+            "compute_only_0": {"implementation": "compute_only"},
+        },
+        dtype="float32",
+        # ~10M barriered host-clock iterations ~ hours of work: guaranteed
+        # to trip the timeout no matter how slow child startup is
+        num_iterations=10_000_000,
+        num_warmups=0,
+        isolation="subprocess",
+        worker_timeout=25.0,
+        progress=False,
+        output_csv=str(tmp_path / "t.csv"),
+        **SHAPE,
+    )
+    df = runner.run()
+    assert len(df) == 1
+    row = df.iloc[0]
+    assert row["valid"] == False  # noqa: E712
+    assert "TimeoutError" in row["error"]
+    assert np.isnan(row["mean time (ms)"])
