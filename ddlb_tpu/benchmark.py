@@ -160,6 +160,9 @@ def make_result_row(
     return {
         "implementation": config["impl_id"],
         "primitive": config["primitive"],
+        "base_implementation": config.get(
+            "base_implementation", config["impl_id"]
+        ),
         "mean time (ms)": stats["mean"],
         "std time (ms)": stats["std"],
         "min time (ms)": stats["min"],
@@ -169,15 +172,22 @@ def make_result_row(
         "m": config["m"],
         "n": config["n"],
         "k": config["k"],
-        "dtype": config["dtype"],
+        # defaults mirror benchmark_worker's config.get defaults — rows
+        # must build even for minimal configs (crash isolation narrows
+        # otherwise)
+        "dtype": config.get("dtype", "bfloat16"),
         "Throughput (TFLOPS)": float(np.mean(tflops)),
         "Throughput std (TFLOPS)": float(np.std(tflops)),
         "world_size": world_size,
         "num_processes": num_processes,
         "hostname": socket.gethostname(),
         "platform": platform,
-        "time_measurement_backend": config["time_measurement_backend"],
-        "barrier_at_each_iteration": config["barrier_at_each_iteration"],
+        "time_measurement_backend": config.get(
+            "time_measurement_backend", "host_clock"
+        ),
+        "barrier_at_each_iteration": config.get(
+            "barrier_at_each_iteration", True
+        ),
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
@@ -381,7 +391,25 @@ class PrimitiveBenchmarkRunner:
             self.n,
             self.k,
             self.dtype,
+            self._known_world_size(),
         )
+
+    def _known_world_size(self):
+        """Device count for the resume key, obtained without touching the
+        accelerator from the parent when isolation is 'subprocess': the
+        sim env var when set, jax.devices() otherwise (in-process mode
+        already owns the backend). Returns None when it cannot be known
+        safely — the world_size component is then not compared."""
+        from ddlb_tpu.envs import get_sim_device_count
+
+        sim = get_sim_device_count()
+        if sim > 0:
+            return sim
+        if self.isolation == "subprocess":
+            return None
+        import jax
+
+        return len(jax.devices())
 
     def _completed_rows(self) -> set:
         """Keys already recorded in the output CSV (resume support).
@@ -396,7 +424,17 @@ class PrimitiveBenchmarkRunner:
         if not path or not os.path.exists(path) or os.path.getsize(path) == 0:
             return set()
         df = pd.read_csv(path)
-        needed = {"implementation", "primitive", "option", "m", "n", "k", "dtype"}
+        needed = {
+            "implementation",
+            "primitive",
+            "base_implementation",
+            "option",
+            "world_size",
+            "m",
+            "n",
+            "k",
+            "dtype",
+        }
         if not needed.issubset(df.columns):
             raise ValueError(
                 f"cannot resume from {path}: it predates resume support "
@@ -405,18 +443,23 @@ class PrimitiveBenchmarkRunner:
             )
         if "error" in df.columns:
             df = df[df["error"].isna() | (df["error"].astype(str) == "")]
-        return {
-            (
-                r["primitive"],
-                str(r["implementation"]).rsplit("_", 1)[0],
-                r["option"],
-                int(r["m"]),
-                int(r["n"]),
-                int(r["k"]),
-                r["dtype"],
+        world = self._known_world_size()
+        keys = set()
+        for _, r in df.iterrows():
+            row_world = int(r["world_size"]) if world is not None else world
+            keys.add(
+                (
+                    r["primitive"],
+                    r["base_implementation"],
+                    r["option"],
+                    int(r["m"]),
+                    int(r["n"]),
+                    int(r["k"]),
+                    r["dtype"],
+                    row_world,
+                )
             )
-            for _, r in df.iterrows()
-        }
+        return keys
 
     def _run_one(self, config: Dict[str, Any]) -> Dict[str, Any]:
         if self.isolation == "subprocess":
@@ -425,20 +468,47 @@ class PrimitiveBenchmarkRunner:
             import multiprocessing as mp
             import queue as queue_mod
 
+            import time as time_mod
+
             ctx = mp.get_context("spawn")
             queue = ctx.Queue()
             proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
             proc.start()
-            try:
-                # failure detection: the reference blocks forever on a hung
-                # child (queue.get with no timeout, benchmark.py:369 —
-                # SURVEY.md section 5 "no retries, no timeouts"); a bounded
-                # wait turns a deadlocked backend into an error row
-                row = queue.get(timeout=self.worker_timeout)
-            except queue_mod.Empty:
-                proc.kill()
-                proc.join()
-                return self._timeout_row(config)
+            # failure detection: the reference blocks forever on a hung
+            # child (queue.get with no timeout, benchmark.py:369 —
+            # SURVEY.md section 5 "no retries, no timeouts"). Poll in
+            # short slices so a child that DIES without posting a row
+            # (segfault, OOM-kill) is reported immediately as a crash, and
+            # one that HANGS is killed at worker_timeout.
+            deadline = (
+                time_mod.monotonic() + self.worker_timeout
+                if self.worker_timeout
+                else None
+            )
+            row = None
+            while row is None:
+                try:
+                    row = queue.get(timeout=1.0)
+                except queue_mod.Empty:
+                    if not proc.is_alive():
+                        # died; drain once in case the row raced the exit
+                        try:
+                            row = queue.get(timeout=1.0)
+                        except queue_mod.Empty:
+                            return self._error_row(
+                                config,
+                                f"WorkerDied: exit code {proc.exitcode} "
+                                f"with no result",
+                            )
+                        break
+                    if deadline and time_mod.monotonic() > deadline:
+                        proc.kill()
+                        proc.join()
+                        return self._error_row(
+                            config,
+                            f"TimeoutError: worker exceeded "
+                            f"{self.worker_timeout}s (killed)",
+                        )
             # a child can also hang in interpreter teardown (runtime/atexit
             # finalizers) after delivering its row — bound the join too
             proc.join(self.worker_timeout)
@@ -452,12 +522,11 @@ class PrimitiveBenchmarkRunner:
         jax.clear_caches()  # avoid cross-impl compilation-cache coupling
         return row
 
-    def _timeout_row(self, config: Dict[str, Any]) -> Dict[str, Any]:
-        """Error row for a worker that exceeded ``worker_timeout`` — the
-        same schema as measured rows via ``make_result_row``. Deliberately
-        JAX-free: in subprocess mode the parent must never touch the
-        accelerator (reference 'no CUDA init in parent',
-        cli/benchmark.py:126)."""
+    def _error_row(self, config: Dict[str, Any], error: str) -> Dict[str, Any]:
+        """Error row for a worker that hung or died — the same schema as
+        measured rows via ``make_result_row``. Deliberately JAX-free: in
+        subprocess mode the parent must never touch the accelerator
+        (reference 'no CUDA init in parent', cli/benchmark.py:126)."""
         from ddlb_tpu.envs import get_num_processes
 
         return make_result_row(
@@ -466,10 +535,7 @@ class PrimitiveBenchmarkRunner:
             flop_count=2.0 * config["m"] * config["n"] * config["k"],
             option_repr=_format_options(config.get("options", {})),
             valid=False,
-            error=(
-                f"TimeoutError: worker exceeded {self.worker_timeout}s "
-                f"(killed)"
-            ),
+            error=error,
             world_size=-1,  # unknown: the worker died before reporting
             num_processes=get_num_processes(),
             platform="unknown",

@@ -277,8 +277,10 @@ def main(argv=None) -> None:
     )
     parser.add_argument(
         "--resume", action="store_true",
-        help="skip (impl, shape, dtype) rows already present in --csv "
-        "(give a fixed path, not a {timestamp} one)",
+        help="skip configs already recorded in --csv, keyed by primitive "
+        "+ implementation + merged options + shape + dtype + world size; "
+        "crashed rows are retried (give --csv a fixed path, not a "
+        "{timestamp} one)",
     )
     args = parser.parse_args(argv)
 

@@ -72,10 +72,12 @@ def test_shipped_configs_parse_and_expand():
     the canonical dict form and expands to at least one impl_id —
     regression for the list-format crash."""
     import glob
+    import os
 
     from ddlb_tpu.cli.benchmark import _normalize
 
-    paths = sorted(glob.glob("scripts/config*.json"))
+    scripts_dir = os.path.join(os.path.dirname(__file__), "..", "scripts")
+    paths = sorted(glob.glob(os.path.join(scripts_dir, "config*.json")))
     assert paths, "no shipped configs found"
     for path in paths:
         import json
