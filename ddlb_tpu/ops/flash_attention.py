@@ -25,6 +25,34 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _online_softmax_update(
+    q_blk, k_blk, v_blk, m_prev, l_prev, acc_prev,
+    *, scale, q_start, k_start, block_q, block_kv,
+):
+    """One causal score tile folded into the (m, l, acc) recurrence — the
+    single source of the numerically delicate flash update, shared by the
+    one-shot and carried-accumulator kernels."""
+    q = q_blk.astype(jnp.float32) * scale
+    k = k_blk.astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_q, block_kv]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    mask = (q_start + rows) >= (k_start + cols)
+    s = jnp.where(mask, s, NEG_INF)
+
+    m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_prev * alpha + p.sum(-1, keepdims=True)
+    acc_new = acc_prev * alpha + jnp.dot(
+        p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
 def _flash_kernel(
     off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale: float, block_q: int, block_kv: int,
@@ -48,31 +76,148 @@ def _flash_kernel(
 
     @pl.when(q_start + block_q - 1 >= k_start)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_kv]
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-        mask = (q_start + rows) >= (k_start + cols)
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_prev = m_ref[:]
-        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_ref[:] = l_ref[:] * alpha + p.sum(-1, keepdims=True)
-        m_ref[:] = m_new
-        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p, v_ref[0].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+        m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
+            q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
+            scale=scale, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_kv=block_kv,
         )
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _flush():
         o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _flash_chunk_kernel(
+    offs_ref, q_ref, k_ref, v_ref, acc_in_ref, m_in_ref, l_in_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale: float, block_q: int, block_kv: int,
+):
+    """One KV chunk folded into a carried (acc, m, l) accumulator.
+
+    Same online-softmax math as ``_flash_kernel`` but the accumulator
+    state enters and leaves as arrays instead of being created/normalized
+    in-kernel — the building block of ring attention, where the chunks
+    arrive one ``ppermute`` hop at a time. The output block mapping
+    ignores the kv grid dim, so the out refs stay resident across the
+    inner iterations and accumulate in place.
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    row_offset = offs_ref[0]  # shard's first global query row
+    col_offset = offs_ref[1]  # chunk's first global key row
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[0] = acc_in_ref[0]
+        m_ref[0] = m_in_ref[0]
+        l_ref[0] = l_in_ref[0]
+
+    q_start = row_offset + qi * block_q
+    k_start = col_offset + kj * block_kv
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _compute():
+        m_ref[0], l_ref[0], acc_ref[0] = _online_softmax_update(
+            q_ref[0], k_ref[0], v_ref[0], m_ref[0], l_ref[0], acc_ref[0],
+            scale=scale, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_kv=block_kv,
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+)
+def flash_attention_chunk(
+    q,
+    k,
+    v,
+    carry,
+    *,
+    scale: float,
+    row_offset,
+    col_offset,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    interpret: bool = False,
+):
+    """Fold one KV chunk into a flash accumulator (ring-attention step).
+
+    ``q``: [sq, h, dh]; ``k``/``v``: [skv, h, dh] — the chunk whose global
+    key rows start at ``col_offset`` (a runtime scalar, like
+    ``row_offset``). ``carry`` is ``(acc, m, l)`` with head-major shapes
+    ``[h, sq, dh]``, ``[h, sq, 1]``, ``[h, sq, 1]`` (f32), as produced by
+    ``init_flash_carry``. Returns the updated carry; normalize with
+    ``finalize_flash_carry`` after the last chunk.
+    """
+    acc, m_run, l_run = carry
+    sq, h, dh = q.shape
+    skv = k.shape[0]
+    bq, bkv = min(block_q, sq), min(block_kv, skv)
+    if sq % bq or skv % bkv:
+        raise ValueError(
+            f"(sq={sq}, skv={skv}) not divisible by blocks ({bq}, {bkv})"
+        )
+    qh = q.transpose(1, 0, 2)
+    kh = k.transpose(1, 0, 2)
+    vh = v.transpose(1, 0, 2)
+    kernel = functools.partial(
+        _flash_chunk_kernel, scale=scale, block_q=bq, block_kv=bkv
+    )
+    qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
+    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
+    accspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
+    mlspec = pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(h, sq // bq, skv // bkv),
+        in_specs=[qspec, kvspec, kvspec, accspec, mlspec, mlspec],
+        out_specs=[accspec, mlspec, mlspec],
+    )
+    offsets = jnp.stack(
+        [
+            jnp.asarray(row_offset, jnp.int32),
+            jnp.asarray(col_offset, jnp.int32),
+        ]
+    )
+    f32 = jnp.float32
+    acc, m_run, l_run = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct((h, sq, dh), f32),
+            jax.ShapeDtypeStruct((h, sq, 1), f32),
+            jax.ShapeDtypeStruct((h, sq, 1), f32),
+        ],
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * h * sq * skv * dh // 2,
+            bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize
+            + 2 * h * sq * (dh + 2) * 4,
+            transcendentals=h * sq * skv,
+        ),
+        interpret=interpret,
+    )(offsets, qh, kh, vh, acc, m_run, l_run)
+    return acc, m_run, l_run
+
+
+def init_flash_carry(sq: int, h: int, dh: int):
+    """Fresh (acc, m, l) accumulator for ``flash_attention_chunk``."""
+    return (
+        jnp.zeros((h, sq, dh), jnp.float32),
+        jnp.full((h, sq, 1), NEG_INF, jnp.float32),
+        jnp.zeros((h, sq, 1), jnp.float32),
+    )
+
+
+def finalize_flash_carry(carry, dtype):
+    """Normalize an accumulator into ``[sq, h, dh]`` attention output.
+    Fully-masked rows (l == 0) produce zeros, not NaNs."""
+    acc, _, l_run = carry
+    out = acc / jnp.where(l_run == 0.0, 1.0, l_run)
+    return out.transpose(1, 0, 2).astype(dtype)
 
 
 @functools.partial(

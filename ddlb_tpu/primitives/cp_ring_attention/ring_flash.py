@@ -1,0 +1,98 @@
+"""Ring attention with the Pallas flash kernel as per-hop compute.
+
+The full Liu-et-al construction: K/V chunks circulate the ring via
+``ppermute`` (one ICI neighbor hop per step) while each device folds the
+arriving chunk into a carried flash accumulator with
+``ddlb_tpu.ops.flash_attention.flash_attention_chunk`` — VMEM-resident
+score tiles (never a ``[h, q, kv]`` matrix in HBM) AND no device ever
+holding more than one sequence chunk of K/V. Combines the ``ring``
+implementation's communication pattern with the ``flash`` implementation's
+compute engine; the chunk's global column offset is a runtime scalar, so
+one compiled kernel serves every (device, hop) pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.flash_attention import (
+    finalize_flash_carry,
+    flash_attention_chunk,
+    init_flash_carry,
+)
+from ddlb_tpu.primitives.cp_ring_attention.base import CPRingAttention
+
+
+class RingFlashCPRingAttention(CPRingAttention):
+    DEFAULT_OPTIONS = {
+        "block_q": 1024,
+        "block_kv": 1024,
+        "skip_masked_blocks": True,
+    }
+    ALLOWED_VALUES = {
+        "block_q": (8, None),
+        "block_kv": (8, None),
+        "skip_masked_blocks": [True, False],
+    }
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        d = self.num_partitions
+        s_loc = self.m // d
+        h, dh = self.num_heads, self.k
+        scale = 1.0 / (dh ** 0.5)
+        fwd = [(i, (i + 1) % d) for i in range(d)]
+        interpret = self.runtime.platform != "tpu"
+        bq = self.options["block_q"]
+        bkv = self.options["block_kv"]
+        skip = self.options["skip_masked_blocks"]
+
+        def step(q, k, v):
+            my = jax.lax.axis_index("tp")
+            carry = init_flash_carry(s_loc, h, dh)
+            k_cur, v_cur = k, v
+            for t in range(d):
+                # after t backward-walking hops the resident chunk came
+                # from rank (my - t); its global key rows start there
+                src = (my - t) % d
+
+                def fold(carry, k_c=k_cur, v_c=v_cur, src_=src):
+                    return flash_attention_chunk(
+                        q,
+                        k_c,
+                        v_c,
+                        carry,
+                        scale=scale,
+                        row_offset=my * s_loc,
+                        col_offset=src_ * s_loc,
+                        block_q=bq,
+                        block_kv=bkv,
+                        interpret=interpret,
+                    )
+
+                if skip:
+                    # fully-future chunks (src > my) are entirely masked:
+                    # don't stream Q/KV/carry through the kernel for zero
+                    # FLOPs (ring.py's skip_masked_blocks, same semantics)
+                    carry = jax.lax.cond(
+                        src <= my, fold, lambda c: c, carry
+                    )
+                else:
+                    carry = fold(carry)
+                if t + 1 < d:
+                    k_cur = jax.lax.ppermute(k_cur, "tp", perm=fwd)
+                    v_cur = jax.lax.ppermute(v_cur, "tp", perm=fwd)
+            return finalize_flash_carry(carry, q.dtype)
+
+        spec = P("tp", None, None)
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )
+        )

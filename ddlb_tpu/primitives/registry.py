@@ -121,6 +121,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.cp_ring_attention.ulysses",
             "UlyssesCPRingAttention",
         ),
+        "ring_flash": (
+            "ddlb_tpu.primitives.cp_ring_attention.ring_flash",
+            "RingFlashCPRingAttention",
+        ),
     },
     # expert-parallel MoE dispatch/combine: no reference analogue
     # (SURVEY.md section 2.5 lists EP among the absent strategies);

@@ -79,7 +79,10 @@ def test_runner_integration(tmp_path):
             "allgather_0": {"implementation": "allgather"},
         },
         dtype="float32",
-        num_iterations=2,
+        # one iteration: Throughput = mean(flops/t) and mean time = mean(t)
+        # only multiply back to the exact flop count when N == 1 (mean of
+        # reciprocals); more iterations made this flaky on noisy CPU
+        num_iterations=1,
         num_warmups=1,
         output_csv=str(tmp_path / "attn.csv"),
         progress=False,
@@ -92,7 +95,7 @@ def test_runner_integration(tmp_path):
     row = df.iloc[0]
     assert abs(
         row["Throughput (TFLOPS)"] * row["mean time (ms)"] - expect_gflops
-    ) / expect_gflops < 0.05
+    ) / expect_gflops < 1e-6
 
 
 @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
@@ -164,3 +167,29 @@ def test_ulysses_matches_allgather_exactly_fp32():
     r1 = np.asarray(uly.run(), np.float32)
     r2 = np.asarray(ag.run(), np.float32)
     np.testing.assert_allclose(r1, r2, rtol=0, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("blocks", [(16, 16), (8, 8)])
+def test_ring_flash(dtype, blocks):
+    """Ring communication + flash-kernel compute (interpret mode on CPU);
+    the (8, 8) case exercises multiple (qi, kj) grid blocks per chunk —
+    carried-accumulator revisiting and the block-granular causal guard."""
+    bq, bkv = blocks
+    cls = load_impl_class("cp_ring_attention", "ring_flash")
+    impl = cls(M, N, K, dtype=dtype, block_q=bq, block_kv=bkv)
+    result = impl.run()
+    assert result.shape == (M, N // K, K)
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("skip", [True, False])
+def test_ring_flash_matches_ring(skip):
+    rf = load_impl_class("cp_ring_attention", "ring_flash")(
+        M, N, K, dtype="float32", block_q=16, block_kv=8,
+        skip_masked_blocks=skip,
+    )
+    ring = load_impl_class("cp_ring_attention", "ring")(M, N, K, dtype="float32")
+    np.testing.assert_allclose(
+        np.asarray(rf.run()), np.asarray(ring.run()), atol=2e-5
+    )
