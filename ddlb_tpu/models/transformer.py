@@ -51,6 +51,11 @@ class TransformerConfig:
     d_ff: int = 64
     layers_per_stage: int = 1
     microbatches: int = 2
+    #: "gathered": Megatron sp — all-gather the sequence, attend, scatter
+    #: back. "ring": context parallelism — K/V chunks circulate the tp
+    #: ring with an online-softmax accumulator, so no device ever holds
+    #: the full sequence (the long-context mode; same math, exact).
+    attention: str = "gathered"
     dtype: Any = jnp.float32
 
     @property
@@ -89,11 +94,28 @@ def init_params(
 
 def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
     """PartitionSpecs: stage axis on ``pp``; QKV columns / output-proj rows
-    / experts on ``tp``; embedding, head and norms replicated."""
+    / experts on ``tp``; embedding, head and norms replicated.
+
+    ``attention='ring'`` replicates the attention projections instead:
+    sequence and heads cannot shard on the same axis (a ringed K/V chunk
+    would have been projected with the source's head-group weights), so
+    in ring mode ``tp`` acts purely as the context-parallel axis for
+    attention — K/V chunks move, weights don't — while the MoE FFN still
+    uses it as the expert axis."""
+    attn_qkv = (
+        P("pp", None, None, None, None)
+        if cfg.attention == "ring"
+        else P("pp", None, None, None, "tp")
+    )
+    attn_o = (
+        P("pp", None, None, None)
+        if cfg.attention == "ring"
+        else P("pp", None, "tp", None)
+    )
     return {
         "embed": P(None, None),
-        "w_qkv": P("pp", None, None, None, "tp"),
-        "w_o": P("pp", None, "tp", None),
+        "w_qkv": attn_qkv,
+        "w_o": attn_o,
         "moe_w1": P("pp", None, "tp", None, None),
         "moe_w2": P("pp", None, "tp", None, None),
         "ln1": P("pp", None, None),
@@ -122,6 +144,49 @@ def _causal_attention(q, k, v):
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
+
+
+def _ring_attention(q, k, v, d, axis_name="tp"):
+    """Context-parallel causal attention inside the train step: K/V chunks
+    circulate the ``axis_name`` ring while a running (max, sum, output)
+    accumulator folds each arriving chunk — exact online softmax, no
+    device ever materializes the full sequence, and every op (including
+    ``ppermute``) is differentiable, so autodiff derives the backward
+    ring. The cp_ring_attention primitive family benchmarks this pattern
+    standalone.
+
+    ``q``/``k``/``v``: [b, s_loc, h_loc, dh] (local sequence chunk, local
+    heads). Returns [b, s_loc, h_loc, dh].
+    """
+    my = jax.lax.axis_index(axis_name)
+    s_loc, dh = q.shape[1], q.shape[3]
+    scale = 1.0 / np.sqrt(dh)
+    fwd = [(i, (i + 1) % d) for i in range(d)]
+    qh = q.astype(jnp.float32).transpose(0, 2, 1, 3) * scale  # [b, h, s, d]
+    acc = jnp.zeros(qh.shape, jnp.float32)
+    m_run = jnp.full(qh.shape[:3] + (1,), -1e30, jnp.float32)
+    l_run = jnp.zeros_like(m_run)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    k_cur, v_cur = k, v
+    for t in range(d):
+        src = (my - t) % d  # the chunk held after t hops came from src
+        s = jnp.einsum("bhqd,bkhd->bhqk", qh, k_cur.astype(jnp.float32))
+        mask = (my * s_loc + rows) >= (src * s_loc + cols)
+        s = jnp.where(mask[None, None], s, -1e30)
+        m_new = jnp.maximum(m_run, s.max(-1, keepdims=True))
+        alpha = jnp.exp(m_run - m_new)
+        p = jnp.exp(s - m_new)
+        l_run = l_run * alpha + p.sum(-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        m_run = m_new
+        if t + 1 < d:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm=fwd)
+    out = acc / l_run  # diagonal chunk guarantees every row attended
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def _ce_loss(logits, targets):
@@ -162,27 +227,46 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
                 f"rank); got {sp['moe_w1'].shape[2] * tp}"
             )
         for l in range(L):
-            # -- attention (tp_columnwise -> heads-local -> tp_rowwise) --
             h = _rms_norm(x, sp["ln1"][0, l])
-            h_full = jax.lax.all_gather(h, "tp", axis=1, tiled=True)
             wq = sp["w_qkv"][0, l]  # [3, D, D/tp]: local heads per projection
-            q, k, v = (
-                jnp.matmul(
-                    h_full, wq[i], preferred_element_type=jnp.float32
+            if cfg.attention == "ring":
+                # -- context-parallel attention (cp_ring_attention
+                # pattern): full-head QKV projected on the LOCAL sequence
+                # chunk (replicated weights — see param_specs), K/V chunks
+                # ring the tp axis, local out-proj, no collective --
+                q, k, v = (
+                    jnp.matmul(
+                        h, wq[i], preferred_element_type=jnp.float32
+                    )
+                    .astype(x.dtype)
+                    .reshape(b, s_loc, cfg.n_heads, cfg.head_dim)
+                    for i in range(3)
+                )
+                attn = _ring_attention(q, k, v, tp).reshape(b, s_loc, -1)
+                y = jnp.matmul(
+                    attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
+                ).astype(x.dtype)  # [b, s_loc, D], complete (all heads)
+            else:
+                # -- Megatron sp (tp_columnwise -> heads-local ->
+                # tp_rowwise) --
+                h_full = jax.lax.all_gather(h, "tp", axis=1, tiled=True)
+                q, k, v = (
+                    jnp.matmul(
+                        h_full, wq[i], preferred_element_type=jnp.float32
+                    ).astype(x.dtype)
+                    for i in range(3)
+                )
+                S = q.shape[1]
+                shape = (b, S, h_heads, cfg.head_dim)
+                attn = _causal_attention(
+                    q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                ).reshape(b, S, -1)  # [b, S, D/tp]
+                part = jnp.matmul(
+                    attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
+                )  # [b, S, D] partial over tp
+                y = jax.lax.psum_scatter(
+                    part, "tp", scatter_dimension=1, tiled=True
                 ).astype(x.dtype)
-                for i in range(3)
-            )
-            S = q.shape[1]
-            shape = (b, S, h_heads, cfg.head_dim)
-            attn = _causal_attention(
-                q.reshape(shape), k.reshape(shape), v.reshape(shape)
-            ).reshape(b, S, -1)  # [b, S, D/tp]
-            part = jnp.matmul(
-                attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
-            )  # [b, S, D] partial over tp
-            y = jax.lax.psum_scatter(
-                part, "tp", scatter_dimension=1, tiled=True
-            ).astype(x.dtype)
             x = x + y
             # -- MoE FFN (ep_alltoall over the tp axis) --
             h = _rms_norm(x, sp["ln2"][0, l])
@@ -228,7 +312,7 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
             )
         if S % tp != 0:
             raise ValueError(f"sequence {S} not divisible by tp={tp}")
-        if cfg.n_heads % tp != 0:
+        if cfg.attention != "ring" and cfg.n_heads % tp != 0:
             raise ValueError(
                 f"n_heads={cfg.n_heads} not divisible by tp={tp}"
             )

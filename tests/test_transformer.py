@@ -19,13 +19,13 @@ CFG = TransformerConfig(
 )
 
 
-def _setup(dp, tp, pp, lr=1e-2):
+def _setup(dp, tp, pp, lr=1e-2, cfg=CFG):
     mesh = jax.make_mesh((dp, tp, pp), ("dp", "tp", "pp"))
-    train_step, init_opt, shardings = make_train_step(mesh, CFG, lr)
-    params = init_params(CFG, pp, n_experts=tp)
+    train_step, init_opt, shardings = make_train_step(mesh, cfg, lr)
+    params = init_params(cfg, pp, n_experts=tp)
     params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
     opt_state = init_opt(params)
-    tokens, targets = example_tokens(dp * CFG.microbatches, 8 * tp, CFG.vocab)
+    tokens, targets = example_tokens(dp * cfg.microbatches, 8 * tp, cfg.vocab)
     tokens = jax.device_put(tokens, shardings["data"])
     targets = jax.device_put(targets, shardings["data"])
     return train_step, params, opt_state, tokens, targets
@@ -57,6 +57,46 @@ def test_descends():
     shard = tokens.sharding
     losses = []
     for _ in range(6):
+        tok = jax.device_put(np.asarray(tokens), shard)
+        tgt = jax.device_put(np.asarray(targets), shard)
+        params, opt_state, loss = train_step(params, opt_state, tok, tgt)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_ring_attention_matches_oracle():
+    """Context-parallel (ring) attention computes the exact same function:
+    the single-device oracle needs no changes."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, attention="ring")
+    train_step, params, opt_state, tokens, targets = _setup(2, 2, 2, cfg=cfg)
+    host_params = init_params(cfg, 2, n_experts=2)
+    expected = float(
+        reference_loss(
+            host_params,
+            np.asarray(tokens),
+            np.asarray(targets),
+            cfg,
+            tp=2,
+            dp=2,
+        )
+    )
+    _, _, loss = train_step(params, opt_state, tokens, targets)
+    assert np.isclose(float(loss), expected, rtol=0, atol=1e-4)
+
+
+def test_ring_attention_descends():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, attention="ring")
+    train_step, params, opt_state, tokens, targets = _setup(
+        2, 2, 2, lr=3e-2, cfg=cfg
+    )
+    shard = tokens.sharding
+    losses = []
+    for _ in range(4):
         tok = jax.device_put(np.asarray(tokens), shard)
         tgt = jax.device_put(np.asarray(targets), shard)
         params, opt_state, loss = train_step(params, opt_state, tok, tgt)
