@@ -56,6 +56,11 @@ class TransformerConfig:
     #: ring with an online-softmax accumulator, so no device ever holds
     #: the full sequence (the long-context mode; same math, exact).
     attention: str = "gathered"
+    #: "flash": the Pallas flash kernels (custom_vjp forward+backward,
+    #: ops/flash_attention.py) — the training path's compute engine.
+    #: "einsum": XLA einsum attention (HBM-resident scores; the oracle's
+    #: formulation), kept selectable for A/B measurement.
+    attn_kernel: str = "flash"
     dtype: Any = jnp.float32
 
     @property
@@ -189,6 +194,47 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _flash_full(q, k, v, interpret):
+    """Batched causal flash attention: [b, S, h, dh] -> [b, S, h, dh].
+
+    The batch dim merges into the kernel's head grid (heads are
+    independent and the causal mask depends only on sequence position),
+    so no vmap of the pallas call is needed.
+    """
+    from ddlb_tpu.ops.flash_attention import flash_attention
+
+    b, S, h, dh = q.shape
+    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(S, b * h, dh)
+    o = flash_attention(
+        merge(q), merge(k), merge(v),
+        scale=1.0 / np.sqrt(dh),
+        block_q=min(1024, S),
+        block_kv=min(1024, S),
+        interpret=interpret,
+    )
+    return o.reshape(S, b, h, dh).transpose(1, 0, 2, 3)
+
+
+def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
+    """Batched context-parallel flash attention on the local sequence
+    chunk: [b, s_loc, h, dh] -> [b, s_loc, h, dh]; K/V (and, in the
+    backward, their gradient accumulators) ride the ``axis_name`` ring."""
+    from ddlb_tpu.ops.flash_attention import ring_flash_attention
+
+    b, s_loc, h, dh = q.shape
+    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(s_loc, b * h, dh)
+    o = ring_flash_attention(
+        merge(q), merge(k), merge(v),
+        axis_name=axis_name,
+        axis_size=d,
+        scale=1.0 / np.sqrt(dh),
+        block_q=min(1024, s_loc),
+        block_kv=min(1024, s_loc),
+        interpret=interpret,
+    )
+    return o.reshape(s_loc, b, h, dh).transpose(1, 0, 2, 3)
+
+
 def _ce_loss(logits, targets):
     """Mean token cross-entropy in f32."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -213,6 +259,10 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
     mb = cfg.microbatches
     L = cfg.layers_per_stage
     specs = param_specs(cfg)
+    if cfg.attn_kernel not in ("flash", "einsum"):
+        raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
+    # pallas kernels run compiled on TPU, interpreted elsewhere (CPU sim)
+    interpret = jax.default_backend() != "tpu"
 
     def stage_fn(x, sp):
         """Apply this stage's L transformer blocks to a local activation
@@ -242,7 +292,12 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
                     .reshape(b, s_loc, cfg.n_heads, cfg.head_dim)
                     for i in range(3)
                 )
-                attn = _ring_attention(q, k, v, tp).reshape(b, s_loc, -1)
+                if cfg.attn_kernel == "flash":
+                    attn = _ring_flash(q, k, v, tp, interpret).reshape(
+                        b, s_loc, -1
+                    )
+                else:
+                    attn = _ring_attention(q, k, v, tp).reshape(b, s_loc, -1)
                 y = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)  # [b, s_loc, D], complete (all heads)
@@ -258,9 +313,15 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
                 )
                 S = q.shape[1]
                 shape = (b, S, h_heads, cfg.head_dim)
-                attn = _causal_attention(
-                    q.reshape(shape), k.reshape(shape), v.reshape(shape)
-                ).reshape(b, S, -1)  # [b, S, D/tp]
+                if cfg.attn_kernel == "flash":
+                    attn = _flash_full(
+                        q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                        interpret,
+                    ).reshape(b, S, -1)  # [b, S, D/tp]
+                else:
+                    attn = _causal_attention(
+                        q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                    ).reshape(b, S, -1)  # [b, S, D/tp]
                 part = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
                 )  # [b, S, D] partial over tp
@@ -345,8 +406,15 @@ def make_train_step(mesh, cfg: TransformerConfig, learning_rate: float = 1e-2):
             y = stage_fn(x_in, params)
             fin = t - (pp - 1)
             if 0 <= fin < mb:
-                loss_acc = loss_acc + jnp.where(
-                    p_pp == pp - 1, tail_loss(y, fin), 0.0
+                # lax.cond, not jnp.where: only last-stage devices execute
+                # the vocab-wide head GEMM + log-softmax; earlier stages
+                # skip it at runtime instead of computing and discarding it
+                # (ADVICE r1). Safe divergence: tail_loss has no collectives.
+                loss_acc = loss_acc + jax.lax.cond(
+                    p_pp == pp - 1,
+                    lambda yy: tail_loss(yy, fin),
+                    lambda yy: jnp.zeros((), jnp.float32),
+                    y,
                 )
             if t + 1 < mb + pp - 1:
                 buf = jax.lax.ppermute(y, "pp", perm=fwd)

@@ -1,13 +1,22 @@
-"""Pallas flash attention (causal, forward): the attention compute engine.
+"""Pallas flash attention (causal, forward + backward): the attention engine.
 
 The einsum attention paths materialize ``[h, q, kv]`` score matrices in
-HBM, which caps them at memory bandwidth; this kernel keeps each
+HBM, which caps them at memory bandwidth; these kernels keep each
 ``[block_q, block_kv]`` score tile in VMEM with the standard
 flash-attention online-softmax accumulator (running max / sum / output),
 so the MXU stays fed. Used per-device: the context-parallel
-implementations gather or ring the KV blocks and call this kernel on the
+implementations gather or ring the KV blocks and call the kernels on the
 local query shard with the right global ``row_offset`` for the causal
 mask.
+
+Training path: ``flash_attention`` carries a ``jax.custom_vjp`` whose
+backward recomputes score tiles from the saved log-sum-exp (the standard
+flash backward — no score matrix is ever stored) in two Pallas kernels:
+one accumulating dQ over KV tiles, one accumulating dK/dV over Q tiles.
+``ring_flash_attention`` lifts the same kernels to a context-parallel
+ring under ``shard_map``: K/V chunks circulate via ``ppermute`` in the
+forward, and in the backward the dK/dV accumulators travel the ring WITH
+their chunks, landing home after one extra hop.
 
 No reference analogue (the reference has no attention operator,
 SURVEY.md section 2.5).
@@ -16,6 +25,8 @@ SURVEY.md section 2.5).
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +65,7 @@ def _online_softmax_update(
 
 
 def _flash_kernel(
-    off_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, block_q: int, block_kv: int,
 ):
     qi = pl.program_id(1)
@@ -84,7 +95,15 @@ def _flash_kernel(
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _flush():
-        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+        # log-sum-exp of the scaled scores, the only residual the backward
+        # needs to rebuild p = exp(s - lse) tile by tile
+        lse_ref[0] = jnp.where(
+            l == 0.0, NEG_INF, m_ref[:] + jnp.log(l)
+        )
 
 
 def _flash_chunk_kernel(
@@ -220,30 +239,8 @@ def finalize_flash_carry(carry, dtype):
     return out.transpose(1, 0, 2).astype(dtype)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret"),
-)
-def flash_attention(
-    q,
-    k,
-    v,
-    *,
-    scale: float,
-    row_offset=0,
-    block_q: int = 1024,
-    block_kv: int = 1024,
-    interpret: bool = False,
-):
-    """Causal flash attention forward.
-
-    ``q``: [sq, h, dh] (global query rows start at ``row_offset``),
-    ``k``/``v``: [skv, h, dh]. Returns [sq, h, dh]. ``sq % block_q == 0``
-    and ``skv % block_kv == 0`` (benchmark shapes are powers of two).
-
-    Block defaults swept on a real v5e at seq=8192, 8 heads x dh=128 bf16:
-    (1024, 1024) reaches ~174 TFLOPS — 12x the einsum attention path.
-    """
+def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+    """Forward pallas call; returns ``(o [sq, h, dh], lse [h, sq, 1] f32)``."""
     sq, h, dh = q.shape
     skv = k.shape[0]
     bq, bkv = min(block_q, sq), min(block_kv, skv)
@@ -268,7 +265,10 @@ def flash_attention(
             pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0)),
             pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, dh), jnp.float32),  # output accumulator
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
@@ -276,9 +276,12 @@ def flash_attention(
         ],
     )
     offset = jnp.asarray(row_offset, jnp.int32).reshape(1)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((h, sq, dh), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((h, sq, dh), q.dtype),
+            jax.ShapeDtypeStruct((h, sq, 1), jnp.float32),
+        ],
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -290,4 +293,421 @@ def flash_attention(
         ),
         interpret=interpret,
     )(offset, qh, kh, vh)
-    return out.transpose(1, 0, 2)
+    return out.transpose(1, 0, 2), lse
+
+
+# -- backward kernels ---------------------------------------------------------
+
+
+def _recompute_p(q_blk, k_blk, lse_blk, *, scale, q_start, k_start,
+                 block_q, block_kv):
+    """Rebuild one probability tile from the saved log-sum-exp:
+    ``p = exp(scale * q k^T - lse)`` with the causal mask re-applied."""
+    s = jax.lax.dot_general(
+        q_blk.astype(jnp.float32) * scale,
+        k_blk.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    mask = (q_start + rows) >= (k_start + cols)
+    s = jnp.where(mask, s, NEG_INF)
+    return jnp.exp(s - lse_blk)
+
+
+def _flash_bwd_dq_kernel(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc_ref,
+    *, scale: float, block_q: int, block_kv: int,
+):
+    """dQ accumulated over KV tiles (inner grid dim).
+
+    ``dq = scale * sum_j ds_j @ k_j`` with ``ds = p * (do v^T - delta)``.
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    row_offset = offs_ref[0]
+    col_offset = offs_ref[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    q_start = row_offset + qi * block_q
+    k_start = col_offset + kj * block_kv
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _compute():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+            q_start=q_start, k_start=k_start,
+            block_q=block_q, block_kv=block_kv,
+        )
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bkv]
+        ds = p * (dp - delta_ref[0])
+        dq_acc_ref[:] += scale * jnp.dot(
+            ds, k_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _flush():
+        dq_ref[0] = dq_acc_ref[:]
+
+
+def _flash_bwd_dkv_kernel(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+    *, scale: float, block_q: int, block_kv: int,
+):
+    """dK/dV accumulated over Q tiles (inner grid dim).
+
+    ``dv = sum_i p_i^T @ do_i``; ``dk = scale * sum_i ds_i^T @ q_i``.
+    """
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    row_offset = offs_ref[0]
+    col_offset = offs_ref[1]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    q_start = row_offset + qi * block_q
+    k_start = col_offset + kj * block_kv
+
+    @pl.when(q_start + block_q - 1 >= k_start)
+    def _compute():
+        p = _recompute_p(
+            q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+            q_start=q_start, k_start=k_start,
+            block_q=block_q, block_kv=block_kv,
+        )
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc_ref[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # p^T @ do -> [bkv, dh]
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0])
+        dk_acc_ref[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # ds^T @ q -> [bkv, dh]
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _flush():
+        dk_ref[0] = dk_acc_ref[:]
+        dv_ref[0] = dv_acc_ref[:]
+
+
+def flash_attention_bwd(
+    q, k, v, o, lse, do,
+    *,
+    scale: float,
+    row_offset,
+    col_offset,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    interpret: bool = False,
+):
+    """Flash backward against one KV span: returns f32 ``(dq, dk, dv)``.
+
+    ``q``/``o``/``do``: [sq, h, dh] (global rows start at ``row_offset``),
+    ``k``/``v``: [skv, h, dh] (global rows start at ``col_offset``),
+    ``lse``: [h, sq, 1] f32 log-sum-exp of the GLOBAL softmax (so per-chunk
+    calls compose: each chunk's ds tiles are exact slices of the global
+    backward). Two pallas calls — one per accumulation direction — each
+    recomputing its score tiles in VMEM from ``lse``.
+    """
+    sq, h, dh = q.shape
+    skv = k.shape[0]
+    bq, bkv = min(block_q, sq), min(block_kv, skv)
+    if sq % bq or skv % bkv:
+        raise ValueError(
+            f"(sq={sq}, skv={skv}) not divisible by blocks ({bq}, {bkv})"
+        )
+    qh = q.transpose(1, 0, 2)
+    kh = k.transpose(1, 0, 2)
+    vh = v.transpose(1, 0, 2)
+    doh = do.transpose(1, 0, 2)
+    # delta = rowsum(do * o): the softmax-jacobian correction term, cheap
+    # elementwise reduce left to XLA
+    delta = jnp.sum(
+        doh.astype(jnp.float32) * o.transpose(1, 0, 2).astype(jnp.float32),
+        axis=-1,
+        keepdims=True,
+    )  # [h, sq, 1]
+    offsets = jnp.stack(
+        [jnp.asarray(row_offset, jnp.int32), jnp.asarray(col_offset, jnp.int32)]
+    )
+    f32 = jnp.float32
+    qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
+    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
+    mlspec = pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bkv
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, sq, dh), f32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h, sq // bq, skv // bkv),
+            in_specs=[qspec, kvspec, kvspec, qspec, mlspec, mlspec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((bq, dh), f32)],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=6 * h * sq * skv * dh // 2,
+            bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize,
+            transcendentals=h * sq * skv,
+        ),
+        interpret=interpret,
+    )(offsets, qh, kh, vh, doh, lse, delta)
+
+    # dK/dV: kv-major grid, q tiles innermost
+    qspec2 = pl.BlockSpec((1, bq, dh), lambda hh, j, i, off: (hh, i, 0))
+    kvspec2 = pl.BlockSpec((1, bkv, dh), lambda hh, j, i, off: (hh, j, 0))
+    mlspec2 = pl.BlockSpec((1, bq, 1), lambda hh, j, i, off: (hh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bkv
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((h, skv, dh), f32),
+            jax.ShapeDtypeStruct((h, skv, dh), f32),
+        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(h, skv // bkv, sq // bq),
+            in_specs=[qspec2, kvspec2, kvspec2, qspec2, mlspec2, mlspec2],
+            out_specs=[kvspec2, kvspec2],
+            scratch_shapes=[
+                pltpu.VMEM((bkv, dh), f32),
+                pltpu.VMEM((bkv, dh), f32),
+            ],
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * h * sq * skv * dh // 2,
+            bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize,
+            transcendentals=h * sq * skv,
+        ),
+        interpret=interpret,
+    )(offsets, qh, kh, vh, doh, lse, delta)
+    return (
+        dq.transpose(1, 0, 2),
+        dk.transpose(1, 0, 2),
+        dv.transpose(1, 0, 2),
+    )
+
+
+# -- differentiable public API ------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+    o, _ = _flash_forward(
+        q, k, v, row_offset, scale, block_q, block_kv, interpret
+    )
+    return o
+
+
+def _flash_fwd_rule(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+    o, lse = _flash_forward(
+        q, k, v, row_offset, scale, block_q, block_kv, interpret
+    )
+    return o, (q, k, v, o, lse, row_offset)
+
+
+def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
+    q, k, v, o, lse, row_offset = res
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, o, lse, do,
+        scale=scale, row_offset=row_offset, col_offset=0,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    d_off = np.zeros(np.shape(row_offset), jax.dtypes.float0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), d_off
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    scale: float,
+    row_offset=0,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    interpret: bool = False,
+):
+    """Causal flash attention — differentiable (custom_vjp flash backward).
+
+    ``q``: [sq, h, dh] (global query rows start at ``row_offset``),
+    ``k``/``v``: [skv, h, dh]. Returns [sq, h, dh]. ``sq % block_q == 0``
+    and ``skv % block_kv == 0`` (benchmark shapes are powers of two).
+
+    Block defaults swept on a real v5e at seq=8192, 8 heads x dh=128 bf16:
+    (1024, 1024) reaches ~174 TFLOPS — 12x the einsum attention path.
+    """
+    return _flash(
+        q, k, v, jnp.asarray(row_offset, jnp.int32),
+        scale, block_q, block_kv, interpret,
+    )
+
+
+def ring_flash_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str,
+    axis_size: int,
+    scale: float,
+    block_q: int = 1024,
+    block_kv: int = 1024,
+    interpret: bool = False,
+):
+    """Context-parallel causal flash attention inside ``shard_map`` —
+    differentiable end to end.
+
+    ``q``/``k``/``v``: [s_loc, h, dh], the local sequence chunk of a
+    sequence sharded over ``axis_name`` (size ``axis_size``). Forward: K/V
+    chunks circulate the ring via ``ppermute`` while each device folds the
+    arriving chunk into a carried flash accumulator (Liu et al. ring
+    attention; the ``cp_ring_attention/ring_flash`` benchmark pattern).
+    Backward (custom_vjp): per-chunk dQ accumulates locally; the dK/dV
+    accumulators TRAVEL THE RING with their chunks, so after the last hop
+    plus one delivery ``ppermute`` every gradient lands on its owner —
+    the communication volume matches the forward's.
+    """
+    return _ring_flash(
+        q, k, v, axis_name, axis_size, scale, block_q, block_kv, interpret
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, d, scale, block_q, block_kv, interpret):
+    o, _ = _ring_flash_forward(
+        q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+    )
+    return o
+
+
+def _ring_flash_forward(
+    q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+):
+    my = jax.lax.axis_index(axis_name)
+    s_loc, h, dh = q.shape
+    fwd = [(i, (i + 1) % d) for i in range(d)]
+    carry = init_flash_carry(s_loc, h, dh)
+    k_cur, v_cur = k, v
+    for t in range(d):
+        src = (my - t) % d  # the chunk held after t hops came from src
+
+        def fold(c, k_c=k_cur, v_c=v_cur, src_=src):
+            return flash_attention_chunk(
+                q, k_c, v_c, c,
+                scale=scale,
+                row_offset=my * s_loc,
+                col_offset=src_ * s_loc,
+                block_q=block_q,
+                block_kv=block_kv,
+                interpret=interpret,
+            )
+
+        # fully-future chunks (src > my) are entirely masked: skip
+        carry = jax.lax.cond(src <= my, fold, lambda c: c, carry)
+        if t + 1 < d:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm=fwd)
+    acc, m_run, l_run = carry
+    o = finalize_flash_carry(carry, q.dtype)
+    lse = jnp.where(l_run == 0.0, NEG_INF, m_run + jnp.log(l_run))
+    return o, lse
+
+
+def _ring_flash_fwd_rule(
+    q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+):
+    o, lse = _ring_flash_forward(
+        q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+    )
+    return o, (q, k, v, o, lse)
+
+
+def _ring_flash_bwd_rule(
+    axis_name, d, scale, block_q, block_kv, interpret, res, do
+):
+    q, k, v, o, lse = res
+    my = jax.lax.axis_index(axis_name)
+    s_loc = q.shape[0]
+    fwd = [(i, (i + 1) % d) for i in range(d)]
+    f32 = jnp.float32
+    dq_acc = jnp.zeros(q.shape, f32)
+    # the traveling gradient accumulators ride the ring WITH their chunks
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros(k.shape, f32)
+    dv_cur = jnp.zeros(v.shape, f32)
+    for t in range(d):
+        src = (my - t) % d
+
+        def step(args, k_c=k_cur, v_c=v_cur, src_=src):
+            dq_a, dk_a, dv_a = args
+            dq_c, dk_c, dv_c = flash_attention_bwd(
+                q, k_c, v_c, o, lse, do,
+                scale=scale,
+                row_offset=my * s_loc,
+                col_offset=src_ * s_loc,
+                block_q=block_q,
+                block_kv=block_kv,
+                interpret=interpret,
+            )
+            return dq_a + dq_c, dk_a + dk_c, dv_a + dv_c
+
+        dq_acc, dk_cur, dv_cur = jax.lax.cond(
+            src <= my, step, lambda a: a, (dq_acc, dk_cur, dv_cur)
+        )
+        if t + 1 < d:
+            k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)
+            v_cur = jax.lax.ppermute(v_cur, axis_name, perm=fwd)
+            dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm=fwd)
+            dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm=fwd)
+    # after step d-1 the buffer on this device belongs to chunk my+1:
+    # one delivery hop sends every accumulator home
+    dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm=fwd)
+    dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm=fwd)
+    return (
+        dq_acc.astype(q.dtype),
+        dk_cur.astype(k.dtype),
+        dv_cur.astype(v.dtype),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd_rule, _ring_flash_bwd_rule)

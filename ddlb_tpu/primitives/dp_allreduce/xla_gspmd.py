@@ -9,17 +9,14 @@ XLA's latency-hiding scheduler.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 
 
-class XLAGSPMDDPAllReduce(DPAllReduce):
-    DEFAULT_OPTIONS = {}
-    ALLOWED_VALUES = {}
-
+class XLAGSPMDDPAllReduce(GSPMDOptionsMixin, DPAllReduce):
     def _input_setup(self) -> None:
         super()._input_setup()
 
@@ -30,7 +27,7 @@ class XLAGSPMDDPAllReduce(DPAllReduce):
             # GSPMD to emit all-reduce (vs reduce-scatter for P('tp')).
             return jnp.matmul(a, b, out_sharding=out)
 
-        self._fn = jax.jit(
+        self._fn = self._gspmd_jit(
             product,
             in_shardings=(
                 NamedSharding(self.mesh, P(None, "tp")),

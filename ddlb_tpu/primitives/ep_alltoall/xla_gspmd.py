@@ -9,7 +9,6 @@ sharding constraints, and XLA's SPMD partitioner chooses the collectives
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -17,10 +16,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 from ddlb_tpu.runtime import as_auto_mesh
 
 
-class XLAGSPMDEPAllToAll(EPAllToAll):
+class XLAGSPMDEPAllToAll(GSPMDOptionsMixin, EPAllToAll):
     def _input_setup(self) -> None:
         self.mesh = as_auto_mesh(self.mesh)
         super()._input_setup()
@@ -29,11 +29,6 @@ class XLAGSPMDEPAllToAll(EPAllToAll):
         acc = acc_dtype(self.dtype)
         sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
 
-        @partial(
-            jax.jit,
-            in_shardings=(sh("tp", None), sh("tp", None, None)),
-            out_shardings=sh("tp", None),
-        )
         def step(a, w):
             # [src, expert, token, k], src-sharded
             x = a.reshape(d, d, g, self.k)
@@ -55,4 +50,8 @@ class XLAGSPMDEPAllToAll(EPAllToAll):
             )
             return ys.reshape(self.m, self.n)
 
-        self._fn = step
+        self._fn = self._gspmd_jit(
+            step,
+            in_shardings=(sh("tp", None), sh("tp", None, None)),
+            out_shardings=sh("tp", None),
+        )

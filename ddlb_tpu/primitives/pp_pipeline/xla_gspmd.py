@@ -12,18 +12,17 @@ movement is cheaper than the bubble).
 
 from __future__ import annotations
 
-from functools import partial
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 from ddlb_tpu.runtime import as_auto_mesh
 
 
-class XLAGSPMDPPPipeline(PPPipeline):
+class XLAGSPMDPPPipeline(GSPMDOptionsMixin, PPPipeline):
     def _input_setup(self) -> None:
         self.mesh = as_auto_mesh(self.mesh)
         super()._input_setup()
@@ -32,11 +31,6 @@ class XLAGSPMDPPPipeline(PPPipeline):
         mesh = self.mesh
         sh = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
 
-        @partial(
-            jax.jit,
-            in_shardings=(sh(None, None), sh("tp", None, None)),
-            out_shardings=sh(None, None),
-        )
         def step(a, w):
             y = a
             for j in range(d):
@@ -45,4 +39,8 @@ class XLAGSPMDPPPipeline(PPPipeline):
                 ).astype(dt)
             return y
 
-        self._fn = step
+        self._fn = self._gspmd_jit(
+            step,
+            in_shardings=(sh(None, None), sh("tp", None, None)),
+            out_shardings=sh(None, None),
+        )

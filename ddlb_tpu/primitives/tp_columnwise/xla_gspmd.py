@@ -11,20 +11,22 @@ which overlap the gather with GEMM tiles automatically.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 
 
-class XLAGSPMDTPColumnwise(TPColumnwise):
-    DEFAULT_OPTIONS = {}
-    ALLOWED_VALUES = {}
+class XLAGSPMDTPColumnwise(GSPMDOptionsMixin, TPColumnwise):
+    """Vendor-slot tuning surface: sweepable XLA scheduler knobs
+    (latency_hiding_scheduler / async_collective_fusion /
+    collective_matmul) — the TE-userbuffers-config analogue
+    (/root/reference/ddlb/primitives/TPColumnwise/transformer_engine.py:51-72)."""
 
     def _input_setup(self) -> None:
         super()._input_setup()
-        self._fn = jax.jit(
+        self._fn = self._gspmd_jit(
             jnp.matmul,
             in_shardings=(
                 NamedSharding(self.mesh, P("tp", None)),

@@ -11,16 +11,18 @@ scheduler overlaps it with GEMM tiles (the TE ring-exchange analogue,
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 
 
-class XLAGSPMDTPRowwise(TPRowwise):
-    DEFAULT_OPTIONS = {}
-    ALLOWED_VALUES = {}
+class XLAGSPMDTPRowwise(GSPMDOptionsMixin, TPRowwise):
+    """Vendor-slot tuning surface: sweepable XLA scheduler knobs (see
+    ddlb_tpu/primitives/xla_options.py; the TE ring-exchange config
+    analogue, /root/reference/ddlb/primitives/TPRowwise/
+    transformer_engine.py:51-64)."""
 
     def _input_setup(self) -> None:
         super()._input_setup()
@@ -33,7 +35,7 @@ class XLAGSPMDTPRowwise(TPRowwise):
             # all-reduce (replicated).
             return jnp.matmul(a, b, out_sharding=out)
 
-        self._fn = jax.jit(
+        self._fn = self._gspmd_jit(
             product,
             in_shardings=(
                 NamedSharding(self.mesh, P(None, "tp")),

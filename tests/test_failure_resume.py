@@ -170,6 +170,58 @@ def test_resume_widened_option_sweep(tmp_path):
     assert df.iloc[0]["option"] == "order=AG_after"
 
 
+def test_resume_key_matches_recorded_option_column(tmp_path):
+    """ADVICE r1: the resume key must be derived through the SAME merge
+    path the worker records (OptionsManager.parse), including dropping
+    keys that bind to named Primitive.__init__ params (seed), so resume
+    cannot fail open and re-run completed rows."""
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    spec = {"implementation": "jax_spmd", "order": "AG_after", "seed": 7}
+    PrimitiveBenchmarkRunner(
+        "tp_columnwise", implementations={"jax_spmd_0": dict(spec)}, **common
+    ).run()
+    df = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={"jax_spmd_0": dict(spec)},
+        resume=True,
+        **common,
+    ).run()
+    assert len(df) == 0  # skipped: key matched the recorded option column
+
+
+def test_resume_key_option_repr_parity(tmp_path):
+    """For every registered implementation of every primitive, the option
+    component of the resume key equals the option string the worker would
+    record for a default-options run."""
+    from ddlb_tpu.benchmark import _format_options
+    from ddlb_tpu.options import OptionsManager
+    from ddlb_tpu.primitives.registry import (
+        ALLOWED_PRIMITIVES,
+        implementation_names,
+        load_impl_class,
+    )
+
+    for primitive in ALLOWED_PRIMITIVES:
+        runner = PrimitiveBenchmarkRunner(
+            primitive, implementations={}, output_csv=None, **SHAPE
+        )
+        for base in implementation_names(primitive):
+            cls = load_impl_class(primitive, base)
+            recorded = _format_options(
+                OptionsManager(cls.DEFAULT_OPTIONS, cls.ALLOWED_VALUES).parse({})
+            )
+            key = runner._resume_key(f"{base}_0", {"implementation": base})
+            assert key[2] == recorded, (primitive, base)
+
+
 def test_resume_legacy_csv_rejected(tmp_path):
     import pandas as pd
 
