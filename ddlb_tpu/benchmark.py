@@ -404,11 +404,10 @@ class PrimitiveBenchmarkRunner:
 
             cls = load_impl_class(self.primitive, base)
             # the exact merge path the worker records: OptionsManager.parse
-            # over the class schema (Primitive.__init__ -> options.py:40-52),
-            # so the formatted key cannot drift from the CSV 'option' column
-            merged = OptionsManager(
-                cls.DEFAULT_OPTIONS, cls.ALLOWED_VALUES
-            ).parse(spec)
+            # over the class schema (Primitive.__init__ -> options.py:40-52,
+            # family BASE_OPTIONS included via option_schema), so the
+            # formatted key cannot drift from the CSV 'option' column
+            merged = OptionsManager(*cls.option_schema()).parse(spec)
         except Exception:
             merged = spec
         return (

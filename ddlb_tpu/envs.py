@@ -94,3 +94,14 @@ def get_sim_device_count() -> int:
     addition SURVEY.md section 4 calls out as the reference's biggest gap.
     """
     return get_env(("DDLB_TPU_SIM_DEVICES",), 0, int)
+
+
+def get_sim_slice_count() -> int:
+    """Simulated TPU slice count for the DCN topology axis (0 = off).
+
+    Partitions the (virtual) device list into N equal contiguous "slices"
+    so the ici/dcn transport dimension — the TPU analogue of the
+    reference's collective-backend axis (nccl/ucc/tl-*, SURVEY.md section
+    2.4) — is exercisable without multi-slice hardware.
+    """
+    return get_env(("DDLB_TPU_SIM_SLICES",), 0, int)

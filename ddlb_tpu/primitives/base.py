@@ -77,6 +77,21 @@ class Primitive(ABC):
     #: (reference ddlb/benchmark.py:76-77, 107-110)
     DEFAULT_OPTIONS: Dict[str, Any] = {}
     ALLOWED_VALUES: Dict[str, Any] = {}
+    #: family-level schema layered UNDER the implementation's (family ABCs
+    #: add axes every member shares — e.g. the tp families' ici/dcn
+    #: ``transport`` dimension — without each subclass re-declaring them)
+    BASE_OPTIONS: Dict[str, Any] = {}
+    BASE_ALLOWED: Dict[str, Any] = {}
+
+    @classmethod
+    def option_schema(cls):
+        """(defaults, allowed) with family-level entries merged in — the
+        single schema source for construction AND the runner's resume-key
+        derivation (they must not drift)."""
+        return (
+            {**cls.BASE_OPTIONS, **cls.DEFAULT_OPTIONS},
+            {**cls.BASE_ALLOWED, **cls.ALLOWED_VALUES},
+        )
 
     def __init__(
         self,
@@ -92,10 +107,21 @@ class Primitive(ABC):
         self.dtype = dtype
         self.seed = int(seed)
         self.runtime = Runtime()
-        self.mesh = mesh if mesh is not None else self.runtime.mesh(("tp",))
-        self.num_partitions = int(np.prod(list(self.mesh.shape.values())))
-        self._options_manager = OptionsManager(self.DEFAULT_OPTIONS, self.ALLOWED_VALUES)
+        defaults, allowed = self.option_schema()
+        self._options_manager = OptionsManager(defaults, allowed)
         self.options = self._options_manager.parse(options)
+        if mesh is not None:
+            self.mesh = mesh
+        elif "transport" in self.options:
+            # the family exposes the ici/dcn transport axis: order the 1-D
+            # mesh so collectives ride the requested transport
+            # (runtime.transport_mesh)
+            self.mesh = self.runtime.transport_mesh(
+                ("tp",), self.options["transport"]
+            )
+        else:
+            self.mesh = self.runtime.mesh(("tp",))
+        self.num_partitions = int(np.prod(list(self.mesh.shape.values())))
         self._check_shapes()
         self._input_setup()
 

@@ -40,6 +40,11 @@ class EPAllToAll(Primitive):
 
     primitive_name = "ep_alltoall"
 
+    #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
+    #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh
+    BASE_OPTIONS = {"transport": "ici"}
+    BASE_ALLOWED = {"transport": ["ici", "dcn"]}
+
     def _check_shapes(self) -> None:
         d = self.num_partitions
         if self.m % (d * d) != 0:

@@ -41,6 +41,11 @@ class PPPipeline(Primitive):
 
     primitive_name = "pp_pipeline"
 
+    #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
+    #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh
+    BASE_OPTIONS = {"transport": "ici"}
+    BASE_ALLOWED = {"transport": ["ici", "dcn"]}
+
     def _check_shapes(self) -> None:
         if self.k != self.n:
             raise ValueError(

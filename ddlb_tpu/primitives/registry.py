@@ -29,6 +29,7 @@ ALLOWED_PRIMITIVES = (
     "cp_ring_attention",
     "ep_alltoall",
     "pp_pipeline",
+    "transformer_step",
 )
 
 _REGISTRY = {
@@ -145,6 +146,20 @@ _REGISTRY = {
         "overlap": (
             "ddlb_tpu.primitives.ep_alltoall.overlap",
             "OverlapEPAllToAll",
+        ),
+    },
+    # the flagship model's full train/forward step through the same
+    # runner — the composition the GEMM primitives exist to accelerate
+    # (no reference analogue: the reference has no model, SURVEY.md
+    # section 2.5)
+    "transformer_step": {
+        "spmd": (
+            "ddlb_tpu.primitives.transformer_step.spmd",
+            "SPMDTransformerStep",
+        ),
+        "compute_only": (
+            "ddlb_tpu.primitives.transformer_step.compute_only",
+            "ComputeOnlyTransformerStep",
         ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue

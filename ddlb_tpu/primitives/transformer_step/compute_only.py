@@ -1,0 +1,75 @@
+"""Single-device roofline for the flagship step (no collectives).
+
+The model-level analogue of the GEMM families' ``compute_only``
+(/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55): the
+oracle formulation (models/transformer.py reference_loss) runs unsharded
+on one device — forward only or with autodiff + AdamW for
+``mode='train'`` — bounding what the distributed step could achieve if
+every collective were free. Its measured TFLOPS is the MFU denominator's
+practical ceiling for the same model math.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.transformer_step.base import TransformerStep
+
+
+class ComputeOnlyTransformerStep(TransformerStep):
+    def _input_setup(self) -> None:
+        import jax
+
+        from ddlb_tpu.models.transformer import init_params, reference_loss
+
+        cfg = self._model_config()
+        dp, tp, pp = self._mesh_factors()  # params keep the staged layout
+        device = self.runtime.local_devices[0]
+        params = jax.device_put(
+            init_params(cfg, pp, n_experts=tp, seed=self.seed), device
+        )
+        tokens, targets = self._host_tokens()
+        tokens = jax.device_put(tokens, device)
+        targets = jax.device_put(targets, device)
+
+        def fwd(p, tok, tgt):
+            return reference_loss(p, tok, tgt, cfg, tp=tp, dp=dp)
+
+        if self.options["mode"] == "train":
+            import optax
+
+            optimizer = optax.adamw(1e-2)
+
+            def step(p, opt_state, tok, tgt):
+                loss, grads = jax.value_and_grad(fwd)(p, tok, tgt)
+                updates, opt_state = optimizer.update(grads, opt_state, p)
+                return optax.apply_updates(p, updates), opt_state, loss
+
+            self._fn = jax.jit(step)
+            self._args = (params, optimizer.init(params), tokens, targets)
+        else:
+            self._fn = jax.jit(fwd)
+            self._args = (params, tokens, targets)
+        jax.block_until_ready(self._args)
+
+    @property
+    def _call_args(self):
+        return self._args
+
+    def timed_call(self):
+        """Token array first for the measured loop's poison (see
+        SPMDTransformerStep.timed_call)."""
+        if self.options["mode"] == "train":
+            params, opt_state, tokens, targets = self._args
+
+            def step_tokens_first(tok, tgt, p, o):
+                return self._fn(p, o, tok, tgt)
+
+            return step_tokens_first, (tokens, targets, params, opt_state)
+        params, tokens, targets = self._args
+
+        def fwd_tokens_first(tok, tgt, p):
+            return self._fn(p, tok, tgt)
+
+        return fwd_tokens_first, (tokens, targets, params)
+
+    def get_inputs(self):
+        return self._args

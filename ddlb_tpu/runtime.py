@@ -88,6 +88,36 @@ class Runtime:
         self.local_devices = tuple(jax.local_devices())
         self.num_devices = len(self.devices)
         self.platform = self.devices[0].platform if self.devices else "none"
+        self.slice_ids = self._slice_assignment()
+        self.num_slices = len(set(self.slice_ids)) if self.slice_ids else 1
+
+    def _slice_assignment(self):
+        """Per-device slice id — the DCN topology layer.
+
+        Priority: the simulation knob (DDLB_TPU_SIM_SLICES partitions the
+        device list into equal contiguous blocks), then the real
+        multi-slice id PJRT exposes (``device.slice_index`` on megascale
+        pods), then the owning process (cross-process collectives ride
+        the network, the sim stand-in for DCN; single-process worlds
+        collapse to one slice). Analogue of the reference's transport
+        layers (nccl vs ucc/tl/* — SURVEY.md section 2.4): here the layer
+        boundary is ICI inside a slice, DCN across.
+        """
+        n = self.num_devices
+        sim_slices = envs.get_sim_slice_count()
+        if sim_slices > 1:
+            if n % sim_slices:
+                raise ValueError(
+                    f"DDLB_TPU_SIM_SLICES={sim_slices} does not divide "
+                    f"{n} devices"
+                )
+            per = n // sim_slices
+            return tuple(i // per for i in range(n))
+        ids = []
+        for d in self.devices:
+            sid = getattr(d, "slice_index", None)
+            ids.append(int(sid) if sid is not None else int(d.process_index))
+        return tuple(ids)
 
     # -- mesh construction ---------------------------------------------------
 
@@ -109,6 +139,72 @@ class Runtime:
         if shape is None:
             raise ValueError("shape required for multi-axis meshes")
         return jax.make_mesh(shape, tuple(axis_names), devices=self.devices)
+
+    def transport_mesh(self, axis_names=("tp",), transport: str = "ici"):
+        """1-D mesh whose ring-neighbor structure rides the chosen
+        transport — the TPU form of the reference's collective-backend
+        sweep axis (nccl/ucc/tl-*, /root/reference/ddlb/primitives/
+        TPColumnwise/pytorch.py:32-45; SURVEY.md section 2.4 maps it to
+        {ici, dcn}):
+
+        - ``'ici'``: devices grouped by slice, so ring hops and collective
+          stages stay on intra-slice ICI except at slice boundaries (the
+          best-case layout, and the identity order on one slice);
+        - ``'dcn'``: slices interleaved round-robin, so EVERY neighbor hop
+          crosses the slice boundary — collectives are forced onto the
+          DCN/cross-process transport (the stress layout; on the CPU sim
+          this exercises the cross-"slice" code paths).
+        """
+        import numpy as np
+
+        import jax
+
+        if transport not in ("ici", "dcn"):
+            raise ValueError(f"transport must be 'ici' or 'dcn', got {transport!r}")
+        n = self.num_devices
+        order = sorted(range(n), key=lambda i: (self.slice_ids[i], i))
+        if transport == "dcn" and self.num_slices > 1:
+            by_slice = [
+                [i for i in order if self.slice_ids[i] == s]
+                for s in sorted(set(self.slice_ids))
+            ]
+            order = [
+                grp[j]
+                for j in range(max(len(g) for g in by_slice))
+                for grp in by_slice
+                if j < len(grp)
+            ]
+        devices = np.array([self.devices[i] for i in order])
+        return jax.sharding.Mesh(devices, tuple(axis_names))
+
+    def hybrid_mesh(self, axis_names=("dcn", "ici")):
+        """2-D ``(num_slices, per_slice)`` mesh separating the cross-slice
+        (DCN) axis from the intra-slice (ICI) axis — the hierarchical form
+        ``mesh_utils.create_hybrid_device_mesh`` builds on real multi-slice
+        pods, with a grouped-reshape fallback for the simulated topology.
+        """
+        import numpy as np
+
+        import jax
+
+        per = self.num_devices // self.num_slices
+        if self.num_slices > 1:
+            try:
+                from jax.experimental import mesh_utils
+
+                arr = mesh_utils.create_hybrid_device_mesh(
+                    (1, per), (self.num_slices, 1), devices=self.devices
+                )
+                return jax.sharding.Mesh(arr, tuple(axis_names))
+            except Exception:
+                pass  # simulated slices: PJRT lacks real slice topology
+        order = sorted(
+            range(self.num_devices), key=lambda i: (self.slice_ids[i], i)
+        )
+        arr = np.array([self.devices[i] for i in order]).reshape(
+            self.num_slices, per
+        )
+        return jax.sharding.Mesh(arr, tuple(axis_names))
 
     # -- synchronization -----------------------------------------------------
 

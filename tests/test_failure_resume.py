@@ -216,7 +216,7 @@ def test_resume_key_option_repr_parity(tmp_path):
         for base in implementation_names(primitive):
             cls = load_impl_class(primitive, base)
             recorded = _format_options(
-                OptionsManager(cls.DEFAULT_OPTIONS, cls.ALLOWED_VALUES).parse({})
+                OptionsManager(*cls.option_schema()).parse({})
             )
             key = runner._resume_key(f"{base}_0", {"implementation": base})
             assert key[2] == recorded, (primitive, base)

@@ -1,0 +1,145 @@
+"""transformer_step: the flagship model's step through the benchmark
+runner (VERDICT r1 item #4) — CSV rows, validation against the
+single-device oracle, option/mesh sweeps, and shape-constraint errors,
+all on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner, benchmark_worker
+from ddlb_tpu.primitives.registry import load_impl_class
+
+# m=seq, n=d_model, k=d_ff; einsum attention keeps interpret-mode cost out
+# of the suite (the flash path is pinned by tests/test_flash_grad.py)
+SHAPE = dict(m=16, n=16, k=32)
+SMALL = dict(
+    batch=4, vocab=32, n_heads=4, microbatches=2, attn_kernel="einsum"
+)
+
+
+def _worker_config(**over):
+    cfg = {
+        "primitive": "transformer_step",
+        "impl_id": "spmd_0",
+        "base_implementation": "spmd",
+        "options": dict(SMALL),
+        "dtype": "float32",
+        "num_iterations": 2,
+        "num_warmups": 1,
+        "validate": True,
+        "time_measurement_backend": "host_clock",
+        "barrier_at_each_iteration": False,
+        **SHAPE,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_spmd_train_step_row_validates():
+    row = benchmark_worker(_worker_config())
+    assert row["error"] == ""
+    assert row["valid"] is True  # loss == single-device oracle loss
+    assert row["mean time (ms)"] > 0
+    assert row["Throughput (TFLOPS)"] > 0
+    assert row["world_size"] == 8
+
+
+def test_spmd_forward_mode_and_ring_attention():
+    row = benchmark_worker(
+        _worker_config(
+            options={**SMALL, "mode": "forward", "attention": "ring"}
+        )
+    )
+    assert row["error"] == ""
+    assert row["valid"] is True
+
+
+def test_compute_only_roofline_validates():
+    row = benchmark_worker(
+        _worker_config(
+            impl_id="compute_only_0",
+            base_implementation="compute_only",
+            options={**SMALL, "mode": "forward"},
+        )
+    )
+    assert row["error"] == ""
+    assert row["valid"] is True
+
+
+def test_train_flops_triple_of_forward():
+    cls = load_impl_class("transformer_step", "spmd")
+    train = cls(dtype="float32", **SHAPE, **SMALL)
+    fwd = cls(dtype="float32", **SHAPE, mode="forward", **SMALL)
+    assert train.flops() == pytest.approx(3.0 * fwd.flops())
+    # census spot-check: layers*(8D^2+2SD+4DF) + 2DV per token, B*S tokens
+    D, F, S, V, B = 16, 32, 16, 32, 4
+    L = 2 * 1  # pp stages x layers_per_stage
+    per_token = L * (8 * D * D + 2 * S * D + 4 * D * F) + 2 * D * V
+    assert fwd.flops() == pytest.approx(B * S * per_token)
+
+
+def test_explicit_mesh_factors_and_mismatch():
+    cls = load_impl_class("transformer_step", "spmd")
+    impl = cls(dtype="float32", **SHAPE, **SMALL, dp=1, tp=4, pp=2)
+    assert impl.mesh.shape == {"dp": 1, "tp": 4, "pp": 2}
+    with pytest.raises(ValueError, match="devices"):
+        cls(dtype="float32", **SHAPE, **SMALL, dp=2, tp=4, pp=2)
+    with pytest.raises(ValueError, match="all of dp/tp/pp"):
+        cls(dtype="float32", **SHAPE, **SMALL, tp=4)
+
+
+def test_shape_constraint_errors():
+    cls = load_impl_class("transformer_step", "spmd")
+    with pytest.raises(ValueError, match="d_model"):
+        cls(m=16, n=18, k=32, dtype="float32", **SMALL)
+    with pytest.raises(ValueError, match="batch"):
+        cls(dtype="float32", **SHAPE, **{**SMALL, "batch": 3})
+    with pytest.raises(ValueError, match="floating"):
+        cls(dtype="int32", **SHAPE, **SMALL)
+    with pytest.raises(ValueError, match="mode"):
+        cls(dtype="float32", **SHAPE, **{**SMALL, "mode": "serve"})
+
+
+def test_runner_sweep_attention_modes(tmp_path):
+    """The sweep axis the VERDICT asks for: attention=gathered|ring
+    through the same runner/CSV as every other primitive."""
+    import pandas as pd
+
+    csv = str(tmp_path / "model.csv")
+    runner = PrimitiveBenchmarkRunner(
+        "transformer_step",
+        implementations={
+            "spmd_0": {"implementation": "spmd", **SMALL,
+                       "attention": "gathered"},
+            "spmd_1": {"implementation": "spmd", **SMALL,
+                       "attention": "ring"},
+        },
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        **SHAPE,
+    )
+    df = runner.run()
+    assert len(df) == 2
+    assert df["valid"].all()
+    on_disk = pd.read_csv(csv)
+    assert sorted(on_disk["implementation"]) == ["spmd_0", "spmd_1"]
+    assert any("attention=ring" in o for o in on_disk["option"])
+
+
+def test_device_loop_backend_on_model_step():
+    """The compiled-loop timing backend handles the (params, opt) pytree
+    via the token-first arg reorder; stats come from real windows."""
+    row = benchmark_worker(
+        _worker_config(
+            time_measurement_backend="device_loop",
+            validate=False,
+            device_loop_windows=3,
+        )
+    )
+    assert row["error"] == ""
+    assert row["mean time (ms)"] > 0
+    assert row["std time (ms)"] > 0
