@@ -161,6 +161,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.transformer_step.compute_only",
             "ComputeOnlyTransformerStep",
         ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.transformer_step.xla_gspmd",
+            "XLAGSPMDTransformerStep",
+        ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue
     # (SURVEY.md section 2.5 lists PP among the absent strategies);

@@ -27,16 +27,11 @@ class XLAGSPMDTPRowwise(GSPMDOptionsMixin, TPRowwise):
     def _input_setup(self) -> None:
         super()._input_setup()
 
-        out = NamedSharding(self.mesh, P("tp", None))
-
-        def product(a, b):
-            # Contracting dim is sharded: the output sharding choice is what
-            # tells GSPMD to emit reduce-scatter (P('tp') rows) rather than
-            # all-reduce (replicated).
-            return jnp.matmul(a, b, out_sharding=out)
-
+        # Contracting dim is sharded: the jit-level output sharding
+        # (P('tp') rows, not replicated) is what tells GSPMD to emit
+        # reduce-scatter rather than all-reduce.
         self._fn = self._gspmd_jit(
-            product,
+            jnp.matmul,
             in_shardings=(
                 NamedSharding(self.mesh, P(None, "tp")),
                 NamedSharding(self.mesh, P("tp", None)),

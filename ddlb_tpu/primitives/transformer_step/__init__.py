@@ -8,6 +8,9 @@ _EXPORTS = {
     "ComputeOnlyTransformerStep": (
         "ddlb_tpu.primitives.transformer_step.compute_only"
     ),
+    "XLAGSPMDTransformerStep": (
+        "ddlb_tpu.primitives.transformer_step.xla_gspmd"
+    ),
 }
 
 

@@ -49,27 +49,3 @@ class ComputeOnlyTransformerStep(TransformerStep):
             self._fn = jax.jit(fwd)
             self._args = (params, tokens, targets)
         jax.block_until_ready(self._args)
-
-    @property
-    def _call_args(self):
-        return self._args
-
-    def timed_call(self):
-        """Token array first for the measured loop's poison (see
-        SPMDTransformerStep.timed_call)."""
-        if self.options["mode"] == "train":
-            params, opt_state, tokens, targets = self._args
-
-            def step_tokens_first(tok, tgt, p, o):
-                return self._fn(p, o, tok, tgt)
-
-            return step_tokens_first, (tokens, targets, params, opt_state)
-        params, tokens, targets = self._args
-
-        def fwd_tokens_first(tok, tgt, p):
-            return self._fn(p, tok, tgt)
-
-        return fwd_tokens_first, (tokens, targets, params)
-
-    def get_inputs(self):
-        return self._args

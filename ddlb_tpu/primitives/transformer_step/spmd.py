@@ -52,28 +52,3 @@ class SPMDTransformerStep(TransformerStep):
         else:
             self._args = (params, tokens, targets)
         jax.block_until_ready(self._args)
-
-    @property
-    def _call_args(self):
-        return self._args
-
-    def timed_call(self):
-        """Reorder so the measured loop's data-dependency poison lands on
-        the token array (ints tolerate the +0 perturbation; the params
-        DICT in slot 0 would break the loop carry)."""
-        if self.options["mode"] == "train":
-            params, opt_state, tokens, targets = self._args
-
-            def step_tokens_first(tok, tgt, p, o):
-                return self._fn(p, o, tok, tgt)
-
-            return step_tokens_first, (tokens, targets, params, opt_state)
-        params, tokens, targets = self._args
-
-        def fwd_tokens_first(tok, tgt, p):
-            return self._fn(p, tok, tgt)
-
-        return fwd_tokens_first, (tokens, targets, params)
-
-    def get_inputs(self):
-        return self._args

@@ -1,0 +1,73 @@
+"""Compiler-partitioned flagship step: GSPMD auto-parallelization.
+
+The manual shard_map step (spmd.py) hand-schedules every collective; this
+member hands the SAME model math — the oracle's single-program
+formulation (models/transformer.py reference_loss) — to GSPMD with only
+param/data sharding annotations, and XLA chooses and schedules all
+collectives itself. The comparison is the framework's model-level form of
+the reference's compiler-driven JAX comparator vs its hand-tuned backends
+(/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:43-76 vs
+fuser.py/transformer_engine.py), and the mixin exposes the same sweepable
+XLA knobs as every other xla_gspmd member (latency-hiding scheduler,
+async collective fusion, collective matmul).
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.transformer_step.base import TransformerStep
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
+
+
+class XLAGSPMDTransformerStep(GSPMDOptionsMixin, TransformerStep):
+    def _input_setup(self) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddlb_tpu.models.transformer import (
+            init_params,
+            param_specs,
+            reference_loss,
+        )
+        from ddlb_tpu.runtime import as_auto_mesh
+
+        cfg = self._model_config()
+        dp, tp, pp = self._mesh_factors()
+        # Auto axes: GSPMD propagates shardings implicitly from the
+        # operand annotations (runtime.as_auto_mesh).
+        self.mesh = as_auto_mesh(
+            self.runtime.mesh(("dp", "tp", "pp"), shape=(dp, tp, pp))
+        )
+        self.num_partitions = dp * tp * pp
+
+        shardings = {
+            k: NamedSharding(self.mesh, s)
+            for k, s in param_specs(cfg).items()
+        }
+        data = NamedSharding(self.mesh, P("dp", None))
+        params = init_params(cfg, pp, n_experts=tp, seed=self.seed)
+        params = {
+            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+        }
+        tokens, targets = self._host_tokens()
+        tokens = jax.device_put(tokens, data)
+        targets = jax.device_put(targets, data)
+
+        def fwd(p, tok, tgt):
+            return reference_loss(p, tok, tgt, cfg, tp=tp, dp=dp)
+
+        if self.options["mode"] == "train":
+            import optax
+
+            optimizer = optax.adamw(1e-2)
+
+            def step(p, opt_state, tok, tgt):
+                loss, grads = jax.value_and_grad(fwd)(p, tok, tgt)
+                updates, opt_state = optimizer.update(grads, opt_state, p)
+                return optax.apply_updates(p, updates), opt_state, loss
+
+            self._fn = self._gspmd_jit(step)
+            self._args = (params, optimizer.init(params), tokens, targets)
+        else:
+            self._fn = self._gspmd_jit(fwd)
+            self._args = (params, tokens, targets)
+        jax.block_until_ready(self._args)

@@ -95,12 +95,15 @@ class Runtime:
         """Per-device slice id — the DCN topology layer.
 
         Priority: the simulation knob (DDLB_TPU_SIM_SLICES partitions the
-        device list into equal contiguous blocks), then the real
-        multi-slice id PJRT exposes (``device.slice_index`` on megascale
-        pods), then the owning process (cross-process collectives ride
-        the network, the sim stand-in for DCN; single-process worlds
-        collapse to one slice). Analogue of the reference's transport
-        layers (nccl vs ucc/tl/* — SURVEY.md section 2.4): here the layer
+        device list into equal contiguous blocks); on the CPU sim the
+        owning process (cross-process collectives ride the network — the
+        sim stand-in for DCN; CPU devices report ``slice_index == 0``
+        everywhere, so the process boundary is the only topology signal);
+        on real TPU the multi-slice id PJRT exposes
+        (``device.slice_index`` on megascale pods — a multi-host
+        single-slice pod is genuinely one ICI domain, so process index
+        must NOT split it). Analogue of the reference's transport layers
+        (nccl vs ucc/tl/* — SURVEY.md section 2.4): here the layer
         boundary is ICI inside a slice, DCN across.
         """
         n = self.num_devices
@@ -113,11 +116,11 @@ class Runtime:
                 )
             per = n // sim_slices
             return tuple(i // per for i in range(n))
-        ids = []
-        for d in self.devices:
-            sid = getattr(d, "slice_index", None)
-            ids.append(int(sid) if sid is not None else int(d.process_index))
-        return tuple(ids)
+        if self.platform != "tpu":
+            return tuple(int(d.process_index) for d in self.devices)
+        return tuple(
+            int(getattr(d, "slice_index", None) or 0) for d in self.devices
+        )
 
     # -- mesh construction ---------------------------------------------------
 

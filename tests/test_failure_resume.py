@@ -167,7 +167,7 @@ def test_resume_widened_option_sweep(tmp_path):
         **common,
     ).run()
     assert len(df) == 1
-    assert df.iloc[0]["option"] == "order=AG_after"
+    assert df.iloc[0]["option"] == "order=AG_after;transport=ici"
 
 
 def test_resume_key_matches_recorded_option_column(tmp_path):

@@ -130,6 +130,37 @@ def test_runner_sweep_attention_modes(tmp_path):
     assert any("attention=ring" in o for o in on_disk["option"])
 
 
+def test_xla_gspmd_train_step_row_validates():
+    """The compiler-partitioned step: GSPMD gets only the sharding
+    annotations yet must reproduce the oracle loss exactly (same math)."""
+    row = benchmark_worker(
+        _worker_config(
+            impl_id="xla_gspmd_0",
+            base_implementation="xla_gspmd",
+        )
+    )
+    assert row["error"] == ""
+    assert row["valid"] is True
+    assert row["world_size"] == 8
+
+
+def test_xla_gspmd_forward_with_compiler_knobs():
+    row = benchmark_worker(
+        _worker_config(
+            impl_id="xla_gspmd_0",
+            base_implementation="xla_gspmd",
+            options={
+                **SMALL,
+                "mode": "forward",
+                "collective_matmul": "force",
+            },
+        )
+    )
+    assert row["error"] == ""
+    assert row["valid"] is True
+    assert "collective_matmul=force" in row["option"]
+
+
 def test_device_loop_backend_on_model_step():
     """The compiled-loop timing backend handles the (params, opt) pytree
     via the token-first arg reorder; stats come from real windows."""
