@@ -574,7 +574,8 @@ def flash_attention(
     and ``skv % block_kv == 0`` (benchmark shapes are powers of two).
 
     Block defaults swept on a real v5e at seq=8192, 8 heads x dh=128 bf16:
-    (1024, 1024) reaches ~174 TFLOPS — 12x the einsum attention path.
+    (1024, 1024) reaches ~125 TFLOPS — 8.5x the einsum attention path
+    (median-of-8 device_loop windows, BASELINE.md round-2 protocol).
     """
     return _flash(
         q, k, v, jnp.asarray(row_offset, jnp.int32),

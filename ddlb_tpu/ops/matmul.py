@@ -41,9 +41,9 @@ def matmul(
     a,
     b,
     *,
-    block_m: int = 512,
-    block_n: int = 512,
-    block_k: int = 1024,
+    block_m: int = 1024,
+    block_n: int = 1024,
+    block_k: int = 512,
     interpret: bool = False,
 ):
     """``a [m, k] @ b [k, n]`` on the MXU via Pallas.
@@ -52,9 +52,12 @@ def matmul(
     (clamped) blocks — benchmark shapes are powers of two, so the canonical
     sweep (512..16384, /root/reference/scripts/config.json:3-7) always fits.
 
-    Block defaults were swept on a real v5e at 8192^3 bf16:
-    (512, 512, 1024) reaches ~189 TFLOPS (96% of peak), ahead of XLA's
-    stock matmul (~175 TFLOPS) on the same measurement.
+    Block defaults swept on a real v5e at 8192^3 bf16 (median of 8
+    device-loop windows, BASELINE.md round-2 protocol): (1024, 1024, 512)
+    reaches 172.6 TFLOPS (0.88 of peak) — parity with XLA's stock matmul
+    (174.0 same-day) and well ahead of the round-1 default (512, 512, 1024),
+    which measures 156.1. Larger tiles fail VMEM allocation (the f32
+    accumulator alone is 4 MB).
     """
     m, k = a.shape
     k2, n = b.shape

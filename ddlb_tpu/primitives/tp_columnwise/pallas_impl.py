@@ -32,9 +32,9 @@ class PallasTPColumnwise(TPColumnwise):
     DEFAULT_OPTIONS = {
         "algorithm": "xla_collective",
         "order": "AG_before",
-        "block_m": 512,
-        "block_n": 512,
-        "block_k": 1024,
+        "block_m": 1024,
+        "block_n": 1024,
+        "block_k": 512,
         "detect_races": False,
     }
     ALLOWED_VALUES = {

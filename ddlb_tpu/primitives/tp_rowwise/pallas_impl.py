@@ -24,9 +24,9 @@ from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 class PallasTPRowwise(TPRowwise):
     DEFAULT_OPTIONS = {
         "algorithm": "xla_collective",
-        "block_m": 512,
-        "block_n": 512,
-        "block_k": 1024,
+        "block_m": 1024,
+        "block_n": 1024,
+        "block_k": 512,
         "detect_races": False,
     }
     ALLOWED_VALUES = {

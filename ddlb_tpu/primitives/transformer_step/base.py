@@ -96,6 +96,34 @@ class TransformerStep(Primitive):
     def get_inputs(self):
         return self._args
 
+    def _finalize_step(self, fwd, jit_fn, params, tokens, targets):
+        """Assemble ``self._fn``/``self._args`` for the current mode from a
+        loss-forward callable ``fwd(params, tokens, targets) -> scalar``.
+
+        Shared by the single-program members (compute_only, xla_gspmd),
+        which differ only in ``jit_fn`` (plain jit vs the compiler-knob
+        jit) and operand placement; the manual-SPMD member builds its step
+        through models.transformer.make_train_step instead.
+        """
+        import jax
+
+        if self.options["mode"] == "train":
+            import optax
+
+            optimizer = optax.adamw(1e-2)
+
+            def step(p, opt_state, tok, tgt):
+                loss, grads = jax.value_and_grad(fwd)(p, tok, tgt)
+                updates, opt_state = optimizer.update(grads, opt_state, p)
+                return optax.apply_updates(p, updates), opt_state, loss
+
+            self._fn = jit_fn(step)
+            self._args = (params, optimizer.init(params), tokens, targets)
+        else:
+            self._fn = jit_fn(fwd)
+            self._args = (params, tokens, targets)
+        jax.block_until_ready(self._args)
+
     # -- mesh -----------------------------------------------------------------
 
     def _mesh_factors(self) -> Tuple[int, int, int]:

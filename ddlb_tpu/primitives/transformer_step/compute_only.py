@@ -33,19 +33,4 @@ class ComputeOnlyTransformerStep(TransformerStep):
         def fwd(p, tok, tgt):
             return reference_loss(p, tok, tgt, cfg, tp=tp, dp=dp)
 
-        if self.options["mode"] == "train":
-            import optax
-
-            optimizer = optax.adamw(1e-2)
-
-            def step(p, opt_state, tok, tgt):
-                loss, grads = jax.value_and_grad(fwd)(p, tok, tgt)
-                updates, opt_state = optimizer.update(grads, opt_state, p)
-                return optax.apply_updates(p, updates), opt_state, loss
-
-            self._fn = jax.jit(step)
-            self._args = (params, optimizer.init(params), tokens, targets)
-        else:
-            self._fn = jax.jit(fwd)
-            self._args = (params, tokens, targets)
-        jax.block_until_ready(self._args)
+        self._finalize_step(fwd, jax.jit, params, tokens, targets)

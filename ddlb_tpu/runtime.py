@@ -164,6 +164,16 @@ class Runtime:
 
         if transport not in ("ici", "dcn"):
             raise ValueError(f"transport must be 'ici' or 'dcn', got {transport!r}")
+        if transport == "dcn" and self.num_slices == 1:
+            # visible topology has one slice (possibly because this PJRT
+            # runtime exposes no device.slice_index): the dcn and ici
+            # layouts are identical, so say so rather than let a sweep
+            # record a 'dcn' row that silently measured the ici ordering
+            print(
+                "[ddlb_tpu] WARNING: transport='dcn' requested but the "
+                "device topology shows a single slice — dcn and ici mesh "
+                "layouts are identical here"
+            )
         n = self.num_devices
         order = sorted(range(n), key=lambda i: (self.slice_ids[i], i))
         if transport == "dcn" and self.num_slices > 1:
