@@ -60,11 +60,14 @@ def test_transport_mesh_orders(sliced_runtime):
         rt.transport_mesh(("tp",), "infiniband")
 
 
-def test_transport_single_slice_is_identity():
+def test_transport_single_slice_is_identity(capsys):
     rt = Runtime()
     assert rt.num_slices == 1  # single-process sim: one "slice"
     mesh = rt.transport_mesh(("tp",), "dcn")
     assert list(mesh.devices.flat) == list(rt.devices)
+    # a 'dcn' row on a one-slice topology would silently measure the ici
+    # layout — the runtime must say so (code-review r2 finding)
+    assert "single slice" in capsys.readouterr().out
 
 
 def test_hybrid_mesh(sliced_runtime):
