@@ -239,6 +239,75 @@ def finalize_flash_carry(carry, dtype):
     return out.transpose(1, 0, 2).astype(dtype)
 
 
+def _use_triangular(row_offset, sq, skv, bq, bkv) -> bool:
+    """The causal iteration space is a STATIC lower triangle exactly when
+    the query block starts at global row 0 (python-int offset, so the
+    shape of the triangle is known at trace time) and the tile grid is
+    square. Then masked-out tiles can be dropped from the grid entirely —
+    a rectangular grid merely predicates their compute off but still pays
+    their K/V prefetch DMA and grid step (~2x the needed steps)."""
+    return (
+        isinstance(row_offset, int)
+        and row_offset == 0
+        and sq == skv
+        and bq == bkv
+    )
+
+
+def _tri_maps_lower(n: int):
+    """Linear enumeration of the lower triangle {(i, j): j <= i}, row-major
+    (j innermost — the kv-accumulation order the kernels need): returns
+    int32 arrays ``qi_of[t]``, ``kj_of[t]`` of length n(n+1)/2 for the
+    scalar-prefetch index maps."""
+    qi = np.repeat(np.arange(n), np.arange(1, n + 1))
+    kj = np.concatenate([np.arange(i + 1) for i in range(n)])
+    return jnp.asarray(qi, jnp.int32), jnp.asarray(kj, jnp.int32)
+
+
+def _tri_maps_upper(n: int):
+    """Column-major enumeration of the same triangle {(j, i): i >= j}
+    (qi innermost) for the dK/dV kernel, which accumulates over q tiles."""
+    kj = np.repeat(np.arange(n), np.arange(n, 0, -1))
+    qi = np.concatenate([np.arange(j, n) for j in range(n)])
+    return jnp.asarray(kj, jnp.int32), jnp.asarray(qi, jnp.int32)
+
+
+def _flash_kernel_tri(
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, block_q: int, block_kv: int,
+):
+    """Triangular-grid forward: one grid step per LIVE causal tile.
+
+    Same math as ``_flash_kernel`` with the (qi, kj) pair decoded from the
+    scalar-prefetched triangle maps; init fires at each query row's first
+    kv tile (kj == 0), flush at its diagonal tile (kj == qi)."""
+    t = pl.program_id(1)
+    qi = qi_ref[t]
+    kj = kj_ref[t]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
+        q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
+        scale=scale, q_start=qi * block_q, k_start=kj * block_kv,
+        block_q=block_q, block_kv=block_kv,
+    )
+
+    @pl.when(kj == qi)
+    def _flush():
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
+            o_ref.dtype
+        )
+        lse_ref[0] = jnp.where(
+            l == 0.0, NEG_INF, m_ref[:] + jnp.log(l)
+        )
+
+
 def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
     """Forward pallas call; returns ``(o [sq, h, dh], lse [h, sq, 1] f32)``."""
     sq, h, dh = q.shape
@@ -251,6 +320,49 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
     qh = q.transpose(1, 0, 2)  # [h, sq, dh]
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
+    out_shape = [
+        jax.ShapeDtypeStruct((h, sq, dh), q.dtype),
+        jax.ShapeDtypeStruct((h, sq, 1), jnp.float32),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((bq, dh), jnp.float32),  # output accumulator
+        pltpu.VMEM((bq, 1), jnp.float32),   # running max
+        pltpu.VMEM((bq, 1), jnp.float32),   # running sum
+    ]
+    if _use_triangular(row_offset, sq, skv, bq, bkv):
+        n = sq // bq
+        qi_of, kj_of = _tri_maps_lower(n)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(h, n * (n + 1) // 2),
+            in_specs=[
+                pl.BlockSpec((1, bq, dh), lambda hh, t, qi, kj: (hh, qi[t], 0)),
+                pl.BlockSpec((1, bkv, dh), lambda hh, t, qi, kj: (hh, kj[t], 0)),
+                pl.BlockSpec((1, bkv, dh), lambda hh, t, qi, kj: (hh, kj[t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, dh), lambda hh, t, qi, kj: (hh, qi[t], 0)),
+                pl.BlockSpec((1, bq, 1), lambda hh, t, qi, kj: (hh, qi[t], 0)),
+            ],
+            scratch_shapes=scratch_shapes,
+        )
+        out, lse = pl.pallas_call(
+            functools.partial(
+                _flash_kernel_tri, scale=scale, block_q=bq, block_kv=bkv
+            ),
+            out_shape=out_shape,
+            grid_spec=grid_spec,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * h * sq * skv * dh // 2,
+                bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize,
+                transcendentals=h * sq * skv // 2,
+            ),
+            interpret=interpret,
+        )(qi_of, kj_of, qh, kh, vh)
+        return out.transpose(1, 0, 2), lse
     kernel = functools.partial(
         _flash_kernel,
         scale=scale,
@@ -269,19 +381,12 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
             pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0)),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((bq, dh), jnp.float32),  # output accumulator
-            pltpu.VMEM((bq, 1), jnp.float32),   # running max
-            pltpu.VMEM((bq, 1), jnp.float32),   # running sum
-        ],
+        scratch_shapes=scratch_shapes,
     )
     offset = jnp.asarray(row_offset, jnp.int32).reshape(1)
     out, lse = pl.pallas_call(
         kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((h, sq, dh), q.dtype),
-            jax.ShapeDtypeStruct((h, sq, 1), jnp.float32),
-        ],
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -413,6 +518,86 @@ def _flash_bwd_dkv_kernel(
         dv_ref[0] = dv_acc_ref[:]
 
 
+def _flash_bwd_dq_kernel_tri(
+    qi_ref, kj_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_acc_ref,
+    *, scale: float, block_q: int, block_kv: int,
+):
+    """Triangular-grid dQ: one step per live tile, kv innermost."""
+    t = pl.program_id(1)
+    qi = qi_ref[t]
+    kj = kj_ref[t]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    p = _recompute_p(
+        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+        q_start=qi * block_q, k_start=kj * block_kv,
+        block_q=block_q, block_kv=block_kv,
+    )
+    do = do_ref[0].astype(jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])
+    dq_acc_ref[:] += scale * jnp.dot(
+        ds, k_ref[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kj == qi)
+    def _flush():
+        dq_ref[0] = dq_acc_ref[:]
+
+
+def _flash_bwd_dkv_kernel_tri(
+    kj_ref, qi_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+    *, scale: float, block_q: int, block_kv: int, n_q: int,
+):
+    """Triangular-grid dK/dV: column-major over the same triangle (q tiles
+    innermost); init at the diagonal (qi == kj), flush at the last q tile."""
+    t = pl.program_id(1)
+    kj = kj_ref[t]
+    qi = qi_ref[t]
+
+    @pl.when(qi == kj)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    p = _recompute_p(
+        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+        q_start=qi * block_q, k_start=kj * block_kv,
+        block_q=block_q, block_kv=block_kv,
+    )
+    do = do_ref[0].astype(jnp.float32)
+    dv_acc_ref[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])
+    dk_acc_ref[:] += scale * jax.lax.dot_general(
+        ds, q_ref[0].astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == n_q - 1)
+    def _flush():
+        dk_ref[0] = dk_acc_ref[:]
+        dv_ref[0] = dv_acc_ref[:]
+
+
 def flash_attention_bwd(
     q, k, v, o, lse, do,
     *,
@@ -450,10 +635,84 @@ def flash_attention_bwd(
         axis=-1,
         keepdims=True,
     )  # [h, sq, 1]
+    f32 = jnp.float32
+    if (
+        _use_triangular(row_offset, sq, skv, bq, bkv)
+        and isinstance(col_offset, int)
+        and col_offset == 0
+    ):
+        n = sq // bq
+        tri = n * (n + 1) // 2
+        qspec_t = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, a[t], 0))
+        kvspec_t = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, b[t], 0))
+        mlspec_t = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, a[t], 0))
+        qi_of, kj_of = _tri_maps_lower(n)
+        dq = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dq_kernel_tri, scale=scale, block_q=bq, block_kv=bkv
+            ),
+            out_shape=jax.ShapeDtypeStruct((h, sq, dh), f32),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(h, tri),
+                in_specs=[qspec_t, kvspec_t, kvspec_t, qspec_t, mlspec_t, mlspec_t],
+                out_specs=qspec_t,
+                scratch_shapes=[pltpu.VMEM((bq, dh), f32)],
+            ),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=6 * h * sq * skv * dh // 2,
+                bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize,
+                transcendentals=h * sq * skv // 2,
+            ),
+            interpret=interpret,
+        )(qi_of, kj_of, qh, kh, vh, doh, lse, delta)
+
+        # dK/dV: column-major over the triangle, q tiles innermost; the
+        # index maps swap roles (a = kj enumeration, b = qi enumeration)
+        kj_of2, qi_of2 = _tri_maps_upper(n)
+        qspec_t2 = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, b[t], 0))
+        kvspec_t2 = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, a[t], 0))
+        mlspec_t2 = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, b[t], 0))
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_dkv_kernel_tri,
+                scale=scale, block_q=bq, block_kv=bkv, n_q=n,
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((h, skv, dh), f32),
+                jax.ShapeDtypeStruct((h, skv, dh), f32),
+            ],
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(h, tri),
+                in_specs=[qspec_t2, kvspec_t2, kvspec_t2, qspec_t2, mlspec_t2, mlspec_t2],
+                out_specs=[kvspec_t2, kvspec_t2],
+                scratch_shapes=[
+                    pltpu.VMEM((bkv, dh), f32),
+                    pltpu.VMEM((bkv, dh), f32),
+                ],
+            ),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+            ),
+            cost_estimate=pl.CostEstimate(
+                flops=4 * h * sq * skv * dh // 2,
+                bytes_accessed=(2 * sq + 2 * skv) * h * dh * q.dtype.itemsize,
+                transcendentals=h * sq * skv // 2,
+            ),
+            interpret=interpret,
+        )(kj_of2, qi_of2, qh, kh, vh, doh, lse, delta)
+        return (
+            dq.transpose(1, 0, 2),
+            dk.transpose(1, 0, 2),
+            dv.transpose(1, 0, 2),
+        )
     offsets = jnp.stack(
         [jnp.asarray(row_offset, jnp.int32), jnp.asarray(col_offset, jnp.int32)]
     )
-    f32 = jnp.float32
     qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
     kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
     mlspec = pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0))
@@ -552,10 +811,50 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_s0(q, k, v, scale, block_q, block_kv, interpret):
+    """Static ``row_offset == 0`` variant: keeping the offset a python int
+    through the custom_vjp lets BOTH directions take the triangular grid
+    (a traced offset — the generic ``_flash`` — forces the rectangular
+    masked grid, ~2x the live tiles)."""
+    o, _ = _flash_forward(q, k, v, 0, scale, block_q, block_kv, interpret)
+    return o
+
+
+def _flash_s0_fwd_rule(q, k, v, scale, block_q, block_kv, interpret):
+    o, lse = _flash_forward(q, k, v, 0, scale, block_q, block_kv, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_s0_bwd_rule(scale, block_q, block_kv, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, o, lse, do,
+        scale=scale, row_offset=0, col_offset=0,
+        block_q=block_q, block_kv=block_kv, interpret=interpret,
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_s0.defvjp(_flash_s0_fwd_rule, _flash_s0_bwd_rule)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "block_q", "block_kv", "interpret"),
 )
+def _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret):
+    return _flash_s0(q, k, v, scale, block_q, block_kv, interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+)
+def _flash_dyn_jit(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+    return _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret)
+
+
 def flash_attention(
     q,
     k,
@@ -573,11 +872,21 @@ def flash_attention(
     ``k``/``v``: [skv, h, dh]. Returns [sq, h, dh]. ``sq % block_q == 0``
     and ``skv % block_kv == 0`` (benchmark shapes are powers of two).
 
+    A literal ``row_offset=0`` (the full-sequence case: the flagship
+    model's gathered attention, the cp ``flash`` impl at world=1, direct
+    kernel calls) dispatches to the triangular grid — only live causal
+    tiles are visited, in forward AND backward. A traced offset (ring /
+    sharded callers) uses the rectangular masked grid, which any runtime
+    mesh position can share.
+
     Block defaults swept on a real v5e at seq=8192, 8 heads x dh=128 bf16:
-    (1024, 1024) reaches ~125 TFLOPS — 8.5x the einsum attention path
-    (median-of-8 device_loop windows, BASELINE.md round-2 protocol).
+    (1024, 1024) reaches 129 TFLOPS with the triangular grid — 8.8x the
+    einsum attention path, rising to 135 at seq=32768 (median-of-8
+    device_loop windows, BASELINE.md round-2 protocol).
     """
-    return _flash(
+    if isinstance(row_offset, int) and row_offset == 0:
+        return _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret)
+    return _flash_dyn_jit(
         q, k, v, jnp.asarray(row_offset, jnp.int32),
         scale, block_q, block_kv, interpret,
     )

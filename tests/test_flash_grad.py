@@ -195,3 +195,49 @@ def test_model_flash_vs_einsum_losses_match():
             losses["flash"], losses["einsum"], rtol=0, atol=1e-4,
             err_msg=f"attention={attention}",
         )
+
+
+def test_triangular_grid_matches_rectangular():
+    """A literal row_offset=0 square call dispatches to the triangular
+    grid (only live causal tiles visited); it must be BIT-exact against
+    the rectangular masked grid a traced offset selects, in forward and
+    in all three gradients."""
+    from ddlb_tpu.ops.flash_attention import _flash_dyn_jit
+
+    S, h, dh = 128, 2, 16
+    q, k, v = _rand((S, h, dh), 0), _rand((S, h, dh), 1), _rand((S, h, dh), 2)
+    scale = 1.0 / np.sqrt(dh)
+
+    def tri(q, k, v):
+        return flash_attention(
+            q, k, v, scale=scale, block_q=32, block_kv=32, interpret=True
+        )
+
+    def rect(q, k, v):
+        return _flash_dyn_jit(
+            q, k, v, jnp.asarray(0, jnp.int32), scale, 32, 32, True
+        )
+
+    np.testing.assert_array_equal(tri(q, k, v), rect(q, k, v))
+    g_tri = jax.grad(lambda *a: jnp.sum(tri(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    g_rect = jax.grad(lambda *a: jnp.sum(rect(*a) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_tri, g_rect):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_static_zero_offset_nonsquare_falls_back():
+    """Static offset 0 with skv != sq (or bq != bkv) cannot use the
+    triangle; the dispatch must fall back to the rectangular grid and
+    still match the reference."""
+    sq, skv, h, dh = 32, 64, 2, 8
+    q, k, v = _rand((sq, h, dh), 0), _rand((skv, h, dh), 1), _rand((skv, h, dh), 2)
+    scale = 1.0 / np.sqrt(dh)
+    o = flash_attention(
+        q, k, v, scale=scale, block_q=16, block_kv=16, interpret=True
+    )
+    assert np.allclose(o, _reference(q, k, v, scale), atol=1e-5)
+    # mixed blocks on a square shape: also rectangular, also exact
+    o2 = flash_attention(
+        q, k[:sq], v[:sq], scale=scale, block_q=16, block_kv=32, interpret=True
+    )
+    assert np.allclose(o2, _reference(q, k[:sq], v[:sq], scale), atol=1e-5)
