@@ -367,7 +367,9 @@ class PrimitiveBenchmarkRunner:
         done = self._completed_rows() if self.resume else set()
         rows: List[Dict[str, Any]] = []
         for impl_id, spec in iterator:
-            if self._resume_key(impl_id, spec) in done:
+            # key computation probes the device count — only pay that (and
+            # only touch the backend) when there is a resume set to match
+            if done and self._resume_key(impl_id, spec) in done:
                 # checkpoint/resume: the incremental CSV is the resumable
                 # artifact (SURVEY.md section 5) — rows already recorded
                 # for this (impl, shape, dtype) are skipped, so an
@@ -470,9 +472,14 @@ class PrimitiveBenchmarkRunner:
                 if self._probed_world_size == -1
                 else self._probed_world_size
             )
-        import jax
+        # In-process: go through Runtime, NOT a bare jax.devices() — in a
+        # multi-process world the backend must first be initialized via
+        # jax.distributed (Runtime._initialize ordering); a premature
+        # devices() call here would pin a local-only backend and the
+        # worker's Runtime() would then fail to form the joint world.
+        from ddlb_tpu.runtime import Runtime
 
-        return len(jax.devices())
+        return Runtime().num_devices
 
     def _completed_rows(self) -> set:
         """Keys already recorded in the output CSV (resume support).

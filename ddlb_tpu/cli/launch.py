@@ -1,0 +1,170 @@
+"""Multi-process launcher: the framework's `mpirun` analogue.
+
+The reference's L5 entry is ``mpirun -np N python scripts/run_benchmark.py``
+(/root/reference/scripts/run_benchmark.py:10-32, README.md:80-153) — the
+launcher's only real job there is fanning out N processes and handing each
+its rank env vars. The TPU-native equivalent does the same with the
+``jax.distributed`` bootstrap env this runtime reads (``envs.py``):
+``DDLB_TPU_NUM_PROCESSES`` / ``DDLB_TPU_PROCESS_ID`` /
+``DDLB_TPU_COORD_ADDR``, picking a free coordinator port automatically.
+
+On real pods one process per HOST is started by the pod tooling and this
+launcher is unnecessary; its value is local: an N-process × M-device
+CPU-sim world on one machine, so the cross-process collective paths (the
+DCN stand-in, runtime.transport_mesh) run without hardware. Example::
+
+    python -m ddlb_tpu.cli.launch --processes 2 --devices-per-process 4 -- \
+        python -m ddlb_tpu.cli.benchmark --primitive tp_columnwise \
+        --impl jax_spmd -m 1024 -n 256 -k 512
+
+Child stdout/stderr are drained concurrently (a blocked pipe would
+stall the lock-step collective world) and printed with a ``[p{rank}]``
+prefix once all children exit, rank 0 last so its result table ends the
+output; the launcher's exit code is the first non-zero child code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(
+    command: List[str],
+    processes: int,
+    devices_per_process: int = 0,
+    slices: int = 0,
+    coordinator: Optional[str] = None,
+    env: Optional[dict] = None,
+) -> int:
+    """Fan ``command`` out over ``processes`` local processes; returns the
+    first non-zero child exit code (0 if all succeed)."""
+    if processes < 1:
+        raise ValueError(f"processes must be >= 1, got {processes}")
+    coordinator = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(processes):
+        child_env = dict(os.environ if env is None else env)
+        child_env.update(
+            {
+                "DDLB_TPU_NUM_PROCESSES": str(processes),
+                "DDLB_TPU_PROCESS_ID": str(rank),
+                "DDLB_TPU_COORD_ADDR": coordinator,
+            }
+        )
+        if devices_per_process:
+            # CPU-sim world: force the cpu platform in every child (the
+            # reference parent also never touches the accelerator,
+            # cli/benchmark.py:126)
+            child_env.update(
+                {
+                    "JAX_PLATFORMS": "cpu",
+                    "PALLAS_AXON_POOL_IPS": "",
+                    "DDLB_TPU_SIM_DEVICES": "0",  # flag set directly:
+                    "XLA_FLAGS": (
+                        child_env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count="
+                        f"{devices_per_process}"
+                    ).strip(),
+                }
+            )
+        if slices:
+            child_env["DDLB_TPU_SIM_SLICES"] = str(slices)
+        procs.append(
+            subprocess.Popen(
+                command,
+                env=child_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    # Drain every pipe CONCURRENTLY: the children advance in lock-step
+    # through collectives, so one child blocked on a full 64 KB pipe
+    # (rank 0 prints per-row tables) stalls every other rank and a
+    # sequential communicate() would deadlock the whole launch.
+    import threading
+
+    buffers: List[List[str]] = [[] for _ in range(processes)]
+
+    def _drain(rank: int) -> None:
+        for line in procs[rank].stdout:
+            buffers[rank].append(line.rstrip("\n"))
+
+    threads = [
+        threading.Thread(target=_drain, args=(rank,), daemon=True)
+        for rank in range(processes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rc = 0
+    # print non-zero ranks first, rank 0 (the result-table rank) last
+    for rank in list(range(1, processes)) + [0]:
+        procs[rank].wait()
+        for line in buffers[rank]:
+            print(f"[p{rank}] {line}")
+        if procs[rank].returncode and rc == 0:
+            rc = procs[rank].returncode
+    return rc
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="ddlb_tpu.cli.launch",
+        description="Fan a command out over N coordinated local processes "
+        "(the mpirun analogue; see module docstring).",
+    )
+    parser.add_argument("--processes", type=int, required=True)
+    parser.add_argument(
+        "--devices-per-process",
+        type=int,
+        default=0,
+        help="N virtual CPU devices per process (0 = use the real platform)",
+    )
+    parser.add_argument(
+        "--slices",
+        type=int,
+        default=0,
+        help="DDLB_TPU_SIM_SLICES for every child (simulated DCN topology)",
+    )
+    parser.add_argument(
+        "--coordinator",
+        default=None,
+        help="host:port for jax.distributed (default: free local port)",
+    )
+    parser.add_argument(
+        "command",
+        nargs=argparse.REMAINDER,
+        help="command to run in every process (prefix with --)",
+    )
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (append: -- python -m ...)")
+    sys.exit(
+        launch(
+            command,
+            processes=args.processes,
+            devices_per_process=args.devices_per_process,
+            slices=args.slices,
+            coordinator=args.coordinator,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -247,7 +247,7 @@ def _use_triangular(row_offset, sq, skv, bq, bkv) -> bool:
     a rectangular grid merely predicates their compute off but still pays
     their K/V prefetch DMA and grid step (~2x the needed steps)."""
     return (
-        isinstance(row_offset, int)
+        isinstance(row_offset, (int, np.integer))
         and row_offset == 0
         and sq == skv
         and bq == bkv
@@ -638,7 +638,7 @@ def flash_attention_bwd(
     f32 = jnp.float32
     if (
         _use_triangular(row_offset, sq, skv, bq, bkv)
-        and isinstance(col_offset, int)
+        and isinstance(col_offset, (int, np.integer))
         and col_offset == 0
     ):
         n = sq // bq
@@ -884,7 +884,7 @@ def flash_attention(
     einsum attention path, rising to 135 at seq=32768 (median-of-8
     device_loop windows, BASELINE.md round-2 protocol).
     """
-    if isinstance(row_offset, int) and row_offset == 0:
+    if isinstance(row_offset, (int, np.integer)) and row_offset == 0:
         return _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret)
     return _flash_dyn_jit(
         q, k, v, jnp.asarray(row_offset, jnp.int32),

@@ -124,3 +124,40 @@ def _run_two_process(child_src, expect):
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
         assert expect in out, f"process {i} output:\n{out}"
+
+
+@pytest.mark.slow
+def test_launcher_cli_two_process_benchmark(tmp_path):
+    """The mpirun-analogue launcher (cli/launch.py) fans the benchmark CLI
+    over 2 processes x 4 devices; the joint 8-device world produces one
+    validated CSV row."""
+    import pandas as pd
+
+    csv = tmp_path / "launched.csv"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("DDLB_TPU_", "JAX_", "XLA_"))
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "ddlb_tpu.cli.launch",
+            "--processes", "2", "--devices-per-process", "4", "--",
+            sys.executable, "-m", "ddlb_tpu.cli.benchmark",
+            "--primitive", "tp_columnwise", "--impl", "jax_spmd",
+            "-m", "128", "-n", "32", "-k", "64",
+            "--dtype", "float32", "--num-iterations", "2",
+            "--num-warmups", "1", "--csv", str(csv),
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    df = pd.read_csv(csv)
+    assert len(df) == 1
+    assert bool(df.iloc[0]["valid"])
+    assert int(df.iloc[0]["world_size"]) == 8
+    assert int(df.iloc[0]["num_processes"]) == 2
