@@ -38,21 +38,24 @@ NEG_INF = -1e30
 
 def _online_softmax_update(
     q_blk, k_blk, v_blk, m_prev, l_prev, acc_prev,
-    *, scale, q_start, k_start, block_q, block_kv,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True,
 ):
     """One causal score tile folded into the (m, l, acc) recurrence — the
     single source of the numerically delicate flash update, shared by the
-    one-shot and carried-accumulator kernels."""
+    one-shot and carried-accumulator kernels. ``masked=False`` skips the
+    causal mask for tiles statically known to be fully in the past
+    (the triangular grid's strictly-below-diagonal tiles)."""
     q = q_blk.astype(jnp.float32) * scale
     k = k_blk.astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [block_q, block_kv]
-    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-    mask = (q_start + rows) >= (k_start + cols)
-    s = jnp.where(mask, s, NEG_INF)
+    if masked:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        mask = (q_start + rows) >= (k_start + cols)
+        s = jnp.where(mask, s, NEG_INF)
 
     m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
@@ -280,7 +283,9 @@ def _flash_kernel_tri(
 
     Same math as ``_flash_kernel`` with the (qi, kj) pair decoded from the
     scalar-prefetched triangle maps; init fires at each query row's first
-    kv tile (kj == 0), flush at its diagonal tile (kj == qi)."""
+    kv tile (kj == 0), flush at its diagonal tile (kj == qi). Only the
+    diagonal tile applies the causal mask — strictly-lower tiles are
+    statically fully live."""
     t = pl.program_id(1)
     qi = qi_ref[t]
     kj = kj_ref[t]
@@ -291,11 +296,20 @@ def _flash_kernel_tri(
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
-        q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
-        scale=scale, q_start=qi * block_q, k_start=kj * block_kv,
-        block_q=block_q, block_kv=block_kv,
-    )
+    def _update(masked):
+        m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
+            q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
+            scale=scale, q_start=qi * block_q, k_start=kj * block_kv,
+            block_q=block_q, block_kv=block_kv, masked=masked,
+        )
+
+    @pl.when(kj == qi)
+    def _diag():
+        _update(True)
+
+    @pl.when(kj != qi)
+    def _below():
+        _update(False)
 
     @pl.when(kj == qi)
     def _flush():
@@ -405,20 +419,78 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
 
 
 def _recompute_p(q_blk, k_blk, lse_blk, *, scale, q_start, k_start,
-                 block_q, block_kv):
+                 block_q, block_kv, masked=True):
     """Rebuild one probability tile from the saved log-sum-exp:
-    ``p = exp(scale * q k^T - lse)`` with the causal mask re-applied."""
+    ``p = exp(scale * q k^T - lse)`` with the causal mask re-applied
+    (``masked=False`` for tiles statically known fully in the past)."""
     s = jax.lax.dot_general(
         q_blk.astype(jnp.float32) * scale,
         k_blk.astype(jnp.float32),
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-    mask = (q_start + rows) >= (k_start + cols)
-    s = jnp.where(mask, s, NEG_INF)
+    if masked:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+        mask = (q_start + rows) >= (k_start + cols)
+        s = jnp.where(mask, s, NEG_INF)
     return jnp.exp(s - lse_blk)
+
+
+def _dq_tile_update(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True,
+):
+    """Fold one score tile into the dQ accumulator:
+    ``dq += scale * ds @ k`` with ``ds = p * (do v^T - delta)`` — the
+    single source shared by the rectangular and triangular kernels."""
+    p = _recompute_p(
+        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+        q_start=q_start, k_start=k_start,
+        block_q=block_q, block_kv=block_kv, masked=masked,
+    )
+    do = do_ref[0].astype(jnp.float32)
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [bq, bkv]
+    ds = p * (dp - delta_ref[0])
+    dq_acc_ref[:] += scale * jnp.dot(
+        ds, k_ref[0].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dkv_tile_update(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_acc_ref, dv_acc_ref,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True,
+):
+    """Fold one score tile into the dK/dV accumulators:
+    ``dv += p^T @ do``; ``dk += scale * ds^T @ q`` (shared by the
+    rectangular and triangular kernels)."""
+    p = _recompute_p(
+        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
+        q_start=q_start, k_start=k_start,
+        block_q=block_q, block_kv=block_kv, masked=masked,
+    )
+    do = do_ref[0].astype(jnp.float32)
+    dv_acc_ref[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # p^T @ do -> [bkv, dh]
+    dp = jax.lax.dot_general(
+        do, v_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0])
+    dk_acc_ref[:] += scale * jax.lax.dot_general(
+        ds, q_ref[0].astype(jnp.float32),
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # ds^T @ q -> [bkv, dh]
 
 
 def _flash_bwd_dq_kernel(
@@ -426,10 +498,7 @@ def _flash_bwd_dq_kernel(
     dq_ref, dq_acc_ref,
     *, scale: float, block_q: int, block_kv: int,
 ):
-    """dQ accumulated over KV tiles (inner grid dim).
-
-    ``dq = scale * sum_j ds_j @ k_j`` with ``ds = p * (do v^T - delta)``.
-    """
+    """dQ accumulated over KV tiles (inner grid dim)."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     row_offset = offs_ref[0]
@@ -444,21 +513,10 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(q_start + block_q - 1 >= k_start)
     def _compute():
-        p = _recompute_p(
-            q_ref[0], k_ref[0], lse_ref[0], scale=scale,
-            q_start=q_start, k_start=k_start,
+        _dq_tile_update(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
+            scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv,
-        )
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bkv]
-        ds = p * (dp - delta_ref[0])
-        dq_acc_ref[:] += scale * jnp.dot(
-            ds, k_ref[0].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == pl.num_programs(2) - 1)
@@ -471,10 +529,7 @@ def _flash_bwd_dkv_kernel(
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, scale: float, block_q: int, block_kv: int,
 ):
-    """dK/dV accumulated over Q tiles (inner grid dim).
-
-    ``dv = sum_i p_i^T @ do_i``; ``dk = scale * sum_i ds_i^T @ q_i``.
-    """
+    """dK/dV accumulated over Q tiles (inner grid dim)."""
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     row_offset = offs_ref[0]
@@ -490,27 +545,12 @@ def _flash_bwd_dkv_kernel(
 
     @pl.when(q_start + block_q - 1 >= k_start)
     def _compute():
-        p = _recompute_p(
-            q_ref[0], k_ref[0], lse_ref[0], scale=scale,
-            q_start=q_start, k_start=k_start,
+        _dkv_tile_update(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            dk_acc_ref, dv_acc_ref,
+            scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv,
         )
-        do = do_ref[0].astype(jnp.float32)
-        dv_acc_ref[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # p^T @ do -> [bkv, dh]
-        dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - delta_ref[0])
-        dk_acc_ref[:] += scale * jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # ds^T @ q -> [bkv, dh]
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _flush():
@@ -532,22 +572,20 @@ def _flash_bwd_dq_kernel_tri(
     def _init():
         dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
 
-    p = _recompute_p(
-        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
-        q_start=qi * block_q, k_start=kj * block_kv,
-        block_q=block_q, block_kv=block_kv,
-    )
-    do = do_ref[0].astype(jnp.float32)
-    dp = jax.lax.dot_general(
-        do, v_ref[0].astype(jnp.float32),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta_ref[0])
-    dq_acc_ref[:] += scale * jnp.dot(
-        ds, k_ref[0].astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
+    def _update(masked):
+        _dq_tile_update(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
+            scale=scale, q_start=qi * block_q, k_start=kj * block_kv,
+            block_q=block_q, block_kv=block_kv, masked=masked,
+        )
+
+    @pl.when(kj == qi)
+    def _diag():
+        _update(True)
+
+    @pl.when(kj != qi)
+    def _below():
+        _update(False)
 
     @pl.when(kj == qi)
     def _flush():
@@ -570,27 +608,21 @@ def _flash_bwd_dkv_kernel_tri(
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    p = _recompute_p(
-        q_ref[0], k_ref[0], lse_ref[0], scale=scale,
-        q_start=qi * block_q, k_start=kj * block_kv,
-        block_q=block_q, block_kv=block_kv,
-    )
-    do = do_ref[0].astype(jnp.float32)
-    dv_acc_ref[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    dp = jax.lax.dot_general(
-        do, v_ref[0].astype(jnp.float32),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta_ref[0])
-    dk_acc_ref[:] += scale * jax.lax.dot_general(
-        ds, q_ref[0].astype(jnp.float32),
-        (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+    def _update(masked):
+        _dkv_tile_update(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            dk_acc_ref, dv_acc_ref,
+            scale=scale, q_start=qi * block_q, k_start=kj * block_kv,
+            block_q=block_q, block_kv=block_kv, masked=masked,
+        )
+
+    @pl.when(qi == kj)
+    def _diag():
+        _update(True)
+
+    @pl.when(qi != kj)
+    def _above():
+        _update(False)
 
     @pl.when(qi == n_q - 1)
     def _flush():
@@ -880,8 +912,8 @@ def flash_attention(
     mesh position can share.
 
     Block defaults swept on a real v5e at seq=8192, 8 heads x dh=128 bf16:
-    (1024, 1024) reaches 129 TFLOPS with the triangular grid — 8.8x the
-    einsum attention path, rising to 135 at seq=32768 (median-of-8
+    (1024, 1024) reaches 124.5 TFLOPS with the triangular grid — 8.5x
+    the einsum attention path, rising to 144 at seq=32768 (median-of-8
     device_loop windows, BASELINE.md round-2 protocol).
     """
     if isinstance(row_offset, (int, np.integer)) and row_offset == 0:

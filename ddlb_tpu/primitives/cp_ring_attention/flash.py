@@ -6,7 +6,7 @@ query shard runs the hand-written flash kernel
 (a runtime scalar, so one compiled kernel serves every mesh position)
 driving the causal mask. Compared to the einsum ``allgather``
 implementation this never materializes ``[h, q, kv]`` scores in HBM —
-measured ~8.8x faster at seq=8192 on v5e (129.3 vs 14.7 TFLOPS,
+measured ~8.5x faster at seq=8192 on v5e (124.5 vs 14.7 TFLOPS,
 median-of-8 device_loop windows, BASELINE.md round-2 protocol).
 """
 
