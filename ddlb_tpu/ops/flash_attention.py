@@ -112,7 +112,7 @@ def _flash_kernel(
 def _flash_chunk_kernel(
     offs_ref, q_ref, k_ref, v_ref, acc_in_ref, m_in_ref, l_in_ref,
     acc_ref, m_ref, l_ref,
-    *, scale: float, block_q: int, block_kv: int,
+    *, scale: float, block_q: int, block_kv: int, causal: str = "offset",
 ):
     """One KV chunk folded into a carried (acc, m, l) accumulator.
 
@@ -122,6 +122,13 @@ def _flash_chunk_kernel(
     arrive one ``ppermute`` hop at a time. The output block mapping
     ignores the kv grid dim, so the out refs stay resident across the
     inner iterations and accumulate in place.
+
+    ``causal`` statically classifies the chunk's relation to the query
+    shard (the ring loop index is static, so callers know it at trace
+    time): ``"offset"`` masks from the runtime global offsets (any
+    chunk), ``"diagonal"`` masks relative positions only (the t == 0
+    chunk, whose row and column offsets are equal), ``"past"`` applies
+    no mask at all (every later executed chunk is strictly in the past).
     """
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -134,21 +141,32 @@ def _flash_chunk_kernel(
         m_ref[0] = m_in_ref[0]
         l_ref[0] = l_in_ref[0]
 
-    q_start = row_offset + qi * block_q
-    k_start = col_offset + kj * block_kv
+    if causal == "offset":
+        q_start = row_offset + qi * block_q
+        k_start = col_offset + kj * block_kv
+    else:
+        # relative coordinates: equal offsets cancel ("diagonal") or the
+        # mask is vacuous ("past")
+        q_start = qi * block_q
+        k_start = kj * block_kv
 
-    @pl.when(q_start + block_q - 1 >= k_start)
-    def _compute():
+    def _update():
         m_ref[0], l_ref[0], acc_ref[0] = _online_softmax_update(
             q_ref[0], k_ref[0], v_ref[0], m_ref[0], l_ref[0], acc_ref[0],
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv,
+            masked=causal != "past",
         )
+
+    if causal == "past":
+        _update()  # every tile fully live: no skip predicate, no mask
+    else:
+        pl.when(q_start + block_q - 1 >= k_start)(_update)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
 )
 def flash_attention_chunk(
     q,
@@ -162,6 +180,7 @@ def flash_attention_chunk(
     block_q: int = 1024,
     block_kv: int = 1024,
     interpret: bool = False,
+    causal: str = "offset",
 ):
     """Fold one KV chunk into a flash accumulator (ring-attention step).
 
@@ -183,8 +202,11 @@ def flash_attention_chunk(
     qh = q.transpose(1, 0, 2)
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
+    if causal not in ("offset", "diagonal", "past"):
+        raise ValueError(f"unknown causal mode {causal!r}")
     kernel = functools.partial(
-        _flash_chunk_kernel, scale=scale, block_q=bq, block_kv=bkv
+        _flash_chunk_kernel, scale=scale, block_q=bq, block_kv=bkv,
+        causal=causal,
     )
     qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
     kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
@@ -496,7 +518,7 @@ def _dkv_tile_update(
 def _flash_bwd_dq_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_acc_ref,
-    *, scale: float, block_q: int, block_kv: int,
+    *, scale: float, block_q: int, block_kv: int, masked: bool = True,
 ):
     """dQ accumulated over KV tiles (inner grid dim)."""
     qi = pl.program_id(1)
@@ -516,7 +538,7 @@ def _flash_bwd_dq_kernel(
         _dq_tile_update(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, masked=masked,
         )
 
     @pl.when(kj == pl.num_programs(2) - 1)
@@ -527,7 +549,7 @@ def _flash_bwd_dq_kernel(
 def _flash_bwd_dkv_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-    *, scale: float, block_q: int, block_kv: int,
+    *, scale: float, block_q: int, block_kv: int, masked: bool = True,
 ):
     """dK/dV accumulated over Q tiles (inner grid dim)."""
     kj = pl.program_id(1)
@@ -549,7 +571,7 @@ def _flash_bwd_dkv_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk_acc_ref, dv_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, masked=masked,
         )
 
     @pl.when(qi == pl.num_programs(2) - 1)
@@ -639,6 +661,7 @@ def flash_attention_bwd(
     block_q: int = 1024,
     block_kv: int = 1024,
     interpret: bool = False,
+    causal: str = "offset",
 ):
     """Flash backward against one KV span: returns f32 ``(dq, dk, dv)``.
 
@@ -668,6 +691,12 @@ def flash_attention_bwd(
         keepdims=True,
     )  # [h, sq, 1]
     f32 = jnp.float32
+    if causal not in ("offset", "diagonal", "past"):
+        raise ValueError(f"unknown causal mode {causal!r}")
+    if causal == "diagonal" and sq == skv and bq == bkv:
+        # the diagonal chunk in relative coordinates IS the static
+        # zero-offset square case: take the triangular grids
+        row_offset, col_offset = 0, 0
     if (
         _use_triangular(row_offset, sq, skv, bq, bkv)
         and isinstance(col_offset, (int, np.integer))
@@ -751,7 +780,8 @@ def flash_attention_bwd(
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bkv
+            _flash_bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bkv,
+            masked=causal != "past",
         ),
         out_shape=jax.ShapeDtypeStruct((h, sq, dh), f32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -778,7 +808,8 @@ def flash_attention_bwd(
     mlspec2 = pl.BlockSpec((1, bq, 1), lambda hh, j, i, off: (hh, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bkv
+            _flash_bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bkv,
+            masked=causal != "past",
         ),
         out_shape=[
             jax.ShapeDtypeStruct((h, skv, dh), f32),
@@ -973,7 +1004,7 @@ def _ring_flash_forward(
     for t in range(d):
         src = (my - t) % d  # the chunk held after t hops came from src
 
-        def fold(c, k_c=k_cur, v_c=v_cur, src_=src):
+        def fold(c, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
             return flash_attention_chunk(
                 q, k_c, v_c, c,
                 scale=scale,
@@ -982,6 +1013,10 @@ def _ring_flash_forward(
                 block_q=block_q,
                 block_kv=block_kv,
                 interpret=interpret,
+                # t is STATIC: the t=0 chunk is exactly diagonal (equal
+                # offsets), every later executed chunk strictly past —
+                # no runtime-offset masking needed on either
+                causal="diagonal" if t_ == 0 else "past",
             )
 
         # fully-future chunks (src > my) are entirely masked: skip
@@ -1020,7 +1055,7 @@ def _ring_flash_bwd_rule(
     for t in range(d):
         src = (my - t) % d
 
-        def step(args, k_c=k_cur, v_c=v_cur, src_=src):
+        def step(args, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
             dq_a, dk_a, dv_a = args
             dq_c, dk_c, dv_c = flash_attention_bwd(
                 q, k_c, v_c, o, lse, do,
@@ -1030,6 +1065,7 @@ def _ring_flash_bwd_rule(
                 block_q=block_q,
                 block_kv=block_kv,
                 interpret=interpret,
+                causal="diagonal" if t_ == 0 else "past",
             )
             return dq_a + dq_c, dk_a + dk_c, dv_a + dv_c
 

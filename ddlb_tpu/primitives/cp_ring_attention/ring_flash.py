@@ -7,8 +7,11 @@ arriving chunk into a carried flash accumulator with
 score tiles (never a ``[h, q, kv]`` matrix in HBM) AND no device ever
 holding more than one sequence chunk of K/V. Combines the ``ring``
 implementation's communication pattern with the ``flash`` implementation's
-compute engine; the chunk's global column offset is a runtime scalar, so
-one compiled kernel serves every (device, hop) pair.
+compute engine. With the cond skip (default) the hop index statically
+classifies each chunk — diagonal (relative mask) at t=0, strictly past
+(no mask) after — compiling one kernel per class; with
+``skip_masked_blocks=false`` every hop shares one runtime-offset-masked
+kernel.
 """
 
 from __future__ import annotations
@@ -58,7 +61,16 @@ class RingFlashCPRingAttention(CPRingAttention):
                 # from rank (my - t); its global key rows start there
                 src = (my - t) % d
 
-                def fold(carry, k_c=k_cur, v_c=v_cur, src_=src):
+                def fold(carry, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
+                    # with the cond skip, t is a static classifier: the
+                    # t=0 chunk is diagonal (relative mask), every later
+                    # executed chunk strictly past (no mask). Without the
+                    # skip, future chunks flow through the kernel and only
+                    # the runtime-offset mask zeroes them.
+                    if skip:
+                        causal = "diagonal" if t_ == 0 else "past"
+                    else:
+                        causal = "offset"
                     return flash_attention_chunk(
                         q,
                         k_c,
@@ -70,6 +82,7 @@ class RingFlashCPRingAttention(CPRingAttention):
                         block_q=bq,
                         block_kv=bkv,
                         interpret=interpret,
+                        causal=causal,
                     )
 
                 if skip:
