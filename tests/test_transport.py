@@ -81,6 +81,35 @@ def test_hybrid_mesh(sliced_runtime):
     ]
 
 
+def test_hybrid_mesh_collectives_execute(sliced_runtime):
+    """Collectives on the hierarchical (dcn, ici) mesh actually run: a
+    psum over each axis separately must see exactly that axis's extent,
+    proving the two transport layers are independent reduction scopes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = sliced_runtime.hybrid_mesh(("dcn", "ici"))
+
+    def body(x):
+        over_ici = jax.lax.psum(x, "ici")  # intra-slice reduction
+        over_dcn = jax.lax.psum(x, "dcn")  # cross-slice reduction
+        return over_ici, over_dcn
+
+    ones = jnp.ones((2, 4), jnp.float32)
+    over_ici, over_dcn = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=P("dcn", "ici"),
+            out_specs=(P("dcn", None), P(None, "ici")),
+            check_vma=False,
+        )
+    )(ones)
+    assert float(over_ici[0, 0]) == 4.0  # ici axis extent
+    assert float(over_dcn[0, 0]) == 2.0  # dcn axis extent
+
+
 @pytest.mark.parametrize("primitive", ["tp_columnwise", "tp_rowwise"])
 def test_tp_transport_sweep(primitive, sliced_runtime, tmp_path):
     """The VERDICT done-criterion: tp primitives sweep transport=ici|dcn
