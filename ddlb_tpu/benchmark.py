@@ -106,6 +106,7 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
             timing_backend,
             barrier_each,
             num_windows=config.get("device_loop_windows", 5),
+            min_window_s=config.get("device_loop_min_window_ms", 100.0) * 1e-3,
         )
         times_ms = _max_reduce_across_processes(times_ms, runtime)
 
@@ -202,7 +203,8 @@ def make_result_row(
 
 
 def _timing_loop(
-    impl, runtime, num_iterations, backend, barrier_each, num_windows=5
+    impl, runtime, num_iterations, backend, barrier_each, num_windows=5,
+    min_window_s=0.1,
 ):
     """The measured region (reference hot loop, benchmark.py:124-188)."""
     if backend == "host_clock" and barrier_each:
@@ -242,6 +244,8 @@ def _timing_loop(
         num_iterations,
         num_windows,
         compiler_options=getattr(impl, "xla_compiler_options", None),
+        min_window_s=min_window_s,
+        num_processes=runtime.num_processes,
     )
 
 
@@ -293,6 +297,8 @@ class PrimitiveBenchmarkRunner:
         progress: bool = True,
         worker_timeout: Optional[float] = None,
         resume: bool = False,
+        device_loop_windows: int = 5,
+        device_loop_min_window_ms: float = 100.0,
     ) -> None:
         if primitive not in self.ALLOWED_PRIMITIVES:
             raise ValueError(
@@ -320,6 +326,8 @@ class PrimitiveBenchmarkRunner:
         self.progress = progress
         self.worker_timeout = worker_timeout
         self.resume = resume
+        self.device_loop_windows = device_loop_windows
+        self.device_loop_min_window_ms = device_loop_min_window_ms
         self._probed_world_size: Optional[int] = None  # subprocess probe cache
 
     def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -340,6 +348,8 @@ class PrimitiveBenchmarkRunner:
             "time_measurement_backend": self.time_measurement_backend,
             "barrier_at_each_iteration": self.barrier_at_each_iteration,
             "profile_dir": self.profile_dir,
+            "device_loop_windows": self.device_loop_windows,
+            "device_loop_min_window_ms": self.device_loop_min_window_ms,
         }
 
     def run(self):

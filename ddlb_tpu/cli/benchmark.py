@@ -201,6 +201,10 @@ def run_benchmark(config: Dict[str, Any]):
             progress=cfg.get("progress", True),
             worker_timeout=cfg.get("worker_timeout"),
             resume=cfg.get("resume", False),
+            device_loop_windows=cfg.get("device_loop_windows", 5),
+            device_loop_min_window_ms=cfg.get(
+                "device_loop_min_window_ms", 100.0
+            ),
         )
         frames.append(runner.run())
 

@@ -90,6 +90,25 @@ class GSPMDOptionsMixin:
         self.xla_compiler_options = build_compiler_options(
             self.options, self.runtime.platform
         )
-        if self.xla_compiler_options:
-            jit_kwargs["compiler_options"] = self.xla_compiler_options
-        return jax.jit(fn, **jit_kwargs)
+        plain = jax.jit(fn, **jit_kwargs)
+        if not self.xla_compiler_options:
+            return plain
+        tuned = jax.jit(
+            fn, **jit_kwargs, compiler_options=self.xla_compiler_options
+        )
+
+        def dispatch(*args):
+            # compiler_options are only legal on a TOP-LEVEL jit: when this
+            # call is being traced into an enclosing program (the
+            # device_loop measurement loop), use the plain executable — the
+            # enclosing jit re-applies the same options itself
+            # (utils.timing.make_timed_loop(compiler_options=...)). Being
+            # traced is detected by tracer-typed arguments — public API,
+            # unlike jax internals' trace-state query.
+            traced = any(
+                isinstance(leaf, jax.core.Tracer)
+                for leaf in jax.tree_util.tree_leaves(args)
+            )
+            return (plain if traced else tuned)(*args)
+
+        return dispatch

@@ -116,6 +116,8 @@ def measure_device_loop(
     num_iterations: int,
     num_windows: int = 5,
     compiler_options=None,
+    min_window_s: float = 0.1,
+    num_processes: int = 1,
 ) -> np.ndarray:
     """Differential measurement over ``num_windows`` independent windows.
 
@@ -127,31 +129,82 @@ def measure_device_loop(
     windows reflect actual run-to-run jitter, the analogue of the
     reference's per-iteration cuda_event spread
     (/root/reference/ddlb/benchmark.py:127-144).
+
+    When the big window completes faster than ``min_window_s`` the loop
+    length is scaled up so the differential is measured against at least
+    that much device time — a sub-millisecond window is smaller than the
+    host/relay jitter being subtracted, which otherwise yields silently
+    inflated (even above-roofline) per-iteration rates at small shapes.
+    The reported values stay per-iteration.
     """
     num_windows = max(1, int(num_windows))
-    small = max(1, num_iterations // 4)
-    if small == num_iterations:
-        small = 0
-    loop_big, call_args = make_timed_loop(
-        fn, args, num_iterations, compiler_options
-    )
-    loop_small = None
-    if small:
-        loop_small, _ = make_timed_loop(fn, args, small, compiler_options)
-        float(loop_small(*call_args))  # warm compile
-    float(loop_big(*call_args))  # warm compile
+
+    def _build_loops(n):
+        """(loop_big, loop_small | None, call_args, small), warm-compiled."""
+        small_n = max(1, n // 4)
+        if small_n == n:
+            small_n = 0
+        big, cargs = make_timed_loop(fn, args, n, compiler_options)
+        sm = None
+        if small_n:
+            sm, _ = make_timed_loop(fn, args, small_n, compiler_options)
+            float(sm(*cargs))  # warm compile
+        float(big(*cargs))  # warm compile
+        return big, sm, cargs, small_n
+
+    def _run_once(loop, cargs):
+        t0 = _now_s()
+        float(loop(*cargs))
+        return _now_s() - t0
+
+    loop_big, loop_small, call_args, small = _build_loops(num_iterations)
+    if min_window_s > 0 and loop_small is not None:
+        # Estimate the DEVICE time inside the big window differentially —
+        # wall time alone includes dispatch/RPC overhead (tens of ms over
+        # a remote relay), which would satisfy the floor with almost no
+        # device work behind it and leave the per-iteration differential
+        # drowning in jitter (observed: above-roofline rates at small
+        # shapes).
+        t_small = _run_once(loop_small, call_args)
+        t_big = _run_once(loop_big, call_args)
+        per_iter = (t_big - t_small) / (num_iterations - small)
+        # guard: jitter can make the probe differential tiny or negative;
+        # never scale by more than 100x on one probe
+        per_iter = max(per_iter, t_big / num_iterations / 100.0, 1e-7)
+        factor = 1
+        if per_iter * num_iterations < min_window_s:
+            factor = int(
+                np.ceil(min_window_s / (per_iter * num_iterations))
+            )
+        if num_processes > 1:
+            # every process must compile the SAME trip count: the loop
+            # body carries collectives, so divergent factors (probe
+            # jitter is process-local) would deadlock mid-measurement
+            from jax.experimental import multihost_utils
+
+            factor = int(
+                multihost_utils.process_allgather(
+                    np.array([factor], np.int64)
+                ).max()
+            )
+        if factor > 1:
+            num_iterations *= factor
+            print(
+                f"[ddlb_tpu] device_loop: ~{per_iter * 1e3:.3f} ms/iter "
+                f"puts the window below the {min_window_s * 1e3:.0f} ms "
+                f"floor; scaling to {num_iterations} iterations per window"
+            )
+            loop_big, loop_small, call_args, small = _build_loops(
+                num_iterations
+            )
 
     windows = np.empty(num_windows, dtype=np.float64)
     underflows = 0
     for w in range(num_windows):
-        t_small = 0.0
-        if loop_small is not None:
-            t0 = _now_s()
-            float(loop_small(*call_args))
-            t_small = _now_s() - t0
-        t0 = _now_s()
-        float(loop_big(*call_args))
-        t_big = _now_s() - t0
+        t_small = (
+            _run_once(loop_small, call_args) if loop_small is not None else 0.0
+        )
+        t_big = _run_once(loop_big, call_args)
         per_iter = (t_big - t_small) * 1e3 / (num_iterations - small)
         if per_iter <= 0.0:
             # host-noise underflow (the small window hit a jitter spike);

@@ -156,3 +156,22 @@ def test_device_loop_row_stats_not_fabricated():
     # std computed across real windows; exact zero would mean broadcast
     assert row["std time (ms)"] > 0
     assert row["min time (ms)"] < row["max time (ms)"]
+
+
+def test_device_loop_scales_tiny_windows(capsys):
+    """A window far below the floor is scaled up so the differential is
+    measured against enough device time (sub-ms windows over the jittery
+    relay otherwise produce silently inflated, even above-roofline,
+    per-iteration rates)."""
+    import jax.numpy as jnp
+
+    from ddlb_tpu.utils.timing import measure_device_loop
+
+    a = jnp.ones((8, 8), jnp.float32)
+    windows = measure_device_loop(
+        jnp.matmul, (a, a), num_iterations=2, num_windows=2,
+        min_window_s=0.2,
+    )
+    assert (windows > 0).all()
+    out = capsys.readouterr().out
+    assert "scaling to" in out

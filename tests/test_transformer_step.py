@@ -169,6 +169,7 @@ def test_device_loop_backend_on_model_step():
             time_measurement_backend="device_loop",
             validate=False,
             device_loop_windows=3,
+            device_loop_min_window_ms=0,
         )
     )
     assert row["error"] == ""

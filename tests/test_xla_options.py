@@ -98,3 +98,42 @@ def test_gspmd_sets_compiler_options_attr():
     impl = cls(128, 32, 64, dtype="float32")
     # CPU mesh: attribute exists (device_loop reads it) and is None off-TPU
     assert impl.xla_compiler_options is None
+
+
+def test_gspmd_options_survive_device_loop_nesting(monkeypatch):
+    """compiler_options are only legal on a top-level jit; nested inside
+    the device_loop measurement program they must be dropped (the outer
+    loop re-applies them). Regression: on real TPU every xla_gspmd row
+    under time_measurement_backend=device_loop errored with
+    'compiler_options can only be passed to top-level jax.jit'."""
+    import ddlb_tpu.primitives.xla_options as xo
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    # CPU accepts this option name, so the tuned executable really carries
+    # compiler options during the test (off-TPU the mapping is None and
+    # the bug would be invisible)
+    monkeypatch.setattr(
+        xo,
+        "build_compiler_options",
+        lambda options, platform: {"xla_cpu_enable_fast_math": False},
+    )
+    row = benchmark_worker(
+        {
+            "primitive": "tp_columnwise",
+            "impl_id": "xla_gspmd_0",
+            "base_implementation": "xla_gspmd",
+            "options": {},
+            "m": 128, "n": 32, "k": 64,
+            "dtype": "float32",
+            "num_iterations": 4,
+            "num_warmups": 1,
+            "validate": True,
+            "time_measurement_backend": "device_loop",
+            "device_loop_windows": 2,
+            "device_loop_min_window_ms": 0,
+            "barrier_at_each_iteration": False,
+            "profile_dir": None,
+        }
+    )
+    assert row["error"] == "", row["error"]
+    assert row["valid"] is True
