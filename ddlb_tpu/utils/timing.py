@@ -158,41 +158,65 @@ def measure_device_loop(
         return _now_s() - t0
 
     loop_big, loop_small, call_args, small = _build_loops(num_iterations)
-    if min_window_s > 0 and loop_small is not None:
-        # Estimate the DEVICE time inside the big window differentially —
-        # wall time alone includes dispatch/RPC overhead (tens of ms over
-        # a remote relay), which would satisfy the floor with almost no
-        # device work behind it and leave the per-iteration differential
-        # drowning in jitter (observed: above-roofline rates at small
-        # shapes).
-        t_small = _run_once(loop_small, call_args)
-        t_big = _run_once(loop_big, call_args)
-        per_iter = (t_big - t_small) / (num_iterations - small)
-        # guard: jitter can make the probe differential tiny or negative;
-        # never scale by more than 100x on one probe
-        per_iter = max(per_iter, t_big / num_iterations / 100.0, 1e-7)
+    # Scale the loop until each window covers >= min_window_s of DEVICE
+    # time, estimated differentially — wall time alone includes
+    # dispatch/RPC overhead (tens of ms over a remote relay), which would
+    # satisfy the floor with almost no device work behind it and leave the
+    # per-iteration differential drowning in jitter (observed:
+    # above-roofline rates at small shapes). One probe caps its factor at
+    # 100x (jitter can make the estimate wildly small), so microsecond ops
+    # converge over up to 3 probe/scale rounds instead of stopping short.
+    def _probe():
+        """Median-of-3 differential probe: single (small, big) pairs are
+        spoofable in BOTH directions by host/relay RPC jitter (spikes of
+        the same magnitude as the floor), so one pair can neither prove a
+        window adequate nor size the scale factor reliably."""
+        raws = []
+        bigs = []
+        for _ in range(3):
+            t_small = _run_once(loop_small, call_args)
+            t_big = _run_once(loop_big, call_args)
+            raws.append(t_big - t_small)
+            bigs.append(t_big)
+        return float(np.median(raws)), float(np.median(bigs))
+
+    for _ in range(3 if min_window_s > 0 and loop_small is not None else 0):
+        raw, t_big = _probe()
+        # a window whose observed differential covers the floor is
+        # adequate — never rescale it (a jitter spike must not inflate an
+        # already-good window 100x)
+        satisfied = raw >= min_window_s
         factor = 1
-        if per_iter * num_iterations < min_window_s:
-            factor = int(
-                np.ceil(min_window_s / (per_iter * num_iterations))
+        if not satisfied and raw > 0:
+            per_iter = raw / (num_iterations - small)
+            factor = min(
+                int(np.ceil(min_window_s / (per_iter * num_iterations))),
+                100,
             )
+        elif not satisfied:
+            # even the median differential underflowed: the device work is
+            # far below the probe noise — scale by the cap
+            factor = 100
         if num_processes > 1:
-            # every process must compile the SAME trip count: the loop
-            # body carries collectives, so divergent factors (probe
-            # jitter is process-local) would deadlock mid-measurement
+            # every process must take the SAME decision each round: the
+            # loop body carries collectives, so divergent trip counts or
+            # round counts (probe jitter is process-local) would deadlock
+            # mid-measurement — decide only from allgathered values
             from jax.experimental import multihost_utils
 
-            factor = int(
-                multihost_utils.process_allgather(
-                    np.array([factor], np.int64)
-                ).max()
-            )
+            gathered = multihost_utils.process_allgather(
+                np.array([factor, int(satisfied)], np.int64)
+            ).reshape(-1, 2)
+            factor = int(gathered[:, 0].max())
+            satisfied = bool(gathered[:, 1].min())
+        if satisfied:
+            break
         if factor > 1:
             num_iterations *= factor
             print(
-                f"[ddlb_tpu] device_loop: ~{per_iter * 1e3:.3f} ms/iter "
-                f"puts the window below the {min_window_s * 1e3:.0f} ms "
-                f"floor; scaling to {num_iterations} iterations per window"
+                f"[ddlb_tpu] device_loop: window below the "
+                f"{min_window_s * 1e3:.0f} ms floor; scaling to "
+                f"{num_iterations} iterations per window"
             )
             loop_big, loop_small, call_args, small = _build_loops(
                 num_iterations
