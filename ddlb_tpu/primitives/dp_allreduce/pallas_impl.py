@@ -1,0 +1,112 @@
+"""GEMM+AR with hand-written Pallas kernels as the compute/comm path.
+
+Completes the native-kernel story across the collective trio (the tp
+families' pallas impls re-create nvFuser's fused pipelines; SURVEY.md
+section 2.4 maps that slot to Pallas):
+
+- ``xla_collective``: Pallas MXU GEMM (``ddlb_tpu.ops.matmul``) computes
+  the partial gradient, an explicit ``psum`` sums replicas;
+- ``ring_rdma``: the all-reduce decomposed as reduce-scatter +
+  all-gather with its GEMM+RS phase fused into ONE Pallas program
+  (``ddlb_tpu.ops.collective_matmul.ring_matmul_rs`` — travelling
+  partial-sum accumulators over ``make_async_remote_copy``), then an
+  XLA all-gather restores the replicated gradient layout the optimizer
+  step needs. The ring RS is where the overlap is; the AG is a pure
+  bandwidth collective XLA already schedules well.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.collective_matmul import ring_matmul_rs
+from ddlb_tpu.ops.matmul import matmul
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+
+
+class PallasDPAllReduce(DPAllReduce):
+    DEFAULT_OPTIONS = {
+        "algorithm": "xla_collective",
+        "block_m": 1024,
+        "block_n": 1024,
+        "block_k": 512,
+        "detect_races": False,
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["xla_collective", "ring_rdma"],
+        "block_m": (128, None),
+        "block_n": (128, None),
+        "block_k": (128, None),
+        "detect_races": [True, False],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if (
+            self.options["algorithm"] == "ring_rdma"
+            and self.m % self.num_partitions != 0
+        ):
+            # the ring's reduce-scatter phase shards the gradient rows
+            raise ValueError(
+                f"m={self.m} must be divisible by partitions="
+                f"{self.num_partitions} for algorithm=ring_rdma"
+            )
+        overridden = self._options_manager.overridden
+        if self.options["algorithm"] == "ring_rdma":
+            dead = {"block_m"} & overridden
+        else:
+            dead = {"detect_races"} & overridden
+        if dead:
+            raise ValueError(
+                f"Option(s) {sorted(dead)} have no effect with "
+                f"algorithm={self.options['algorithm']!r}"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        on_tpu = self.runtime.platform == "tpu"
+        opts = self.options
+        d = self.num_partitions
+
+        if opts["algorithm"] == "ring_rdma":
+            interpret = False
+            if not on_tpu:
+                from jax.experimental.pallas import tpu as pltpu
+
+                interpret = pltpu.InterpretParams(
+                    detect_races=bool(opts["detect_races"])
+                )
+
+            def step(a_shard, b_shard):
+                shard = ring_matmul_rs(
+                    a_shard,
+                    b_shard,
+                    axis_size=d,
+                    block_n=min(opts["block_n"], self.n),
+                    block_k=min(opts["block_k"], self.k // d),
+                    interpret=interpret,
+                )  # [m/d, n]: this replica's gradient rows, fully summed
+                return jax.lax.all_gather(shard, "tp", axis=0, tiled=True)
+
+        else:
+            blocks = dict(
+                block_m=opts["block_m"],
+                block_n=opts["block_n"],
+                block_k=opts["block_k"],
+                interpret=not on_tpu,
+            )
+
+            def step(a_shard, b_shard):
+                partial = matmul(a_shard, b_shard, **blocks)
+                return jax.lax.psum(partial, "tp")
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, "tp"), P("tp", None)),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )

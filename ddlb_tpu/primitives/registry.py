@@ -97,6 +97,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.dp_allreduce.overlap",
             "OverlapDPAllReduce",
         ),
+        "pallas": (
+            "ddlb_tpu.primitives.dp_allreduce.pallas_impl",
+            "PallasDPAllReduce",
+        ),
     },
     # context-parallel attention: no reference analogue (SURVEY.md section
     # 2.5 — the reference has no attention op); the natural extension of

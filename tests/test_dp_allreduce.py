@@ -96,3 +96,37 @@ def test_shape_constraints():
         cls(M, N, K, bogus=1)
     with pytest.raises(ValueError, match="strategy"):
         cls(M, N, K, strategy="tree")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_pallas_xla_collective(dtype):
+    cls = load_impl_class("dp_allreduce", "pallas")
+    impl = cls(M, N, K, dtype=dtype, block_m=128, block_n=128, block_k=128)
+    _check_replicated(impl, impl.run())
+
+
+@pytest.mark.parametrize("detect_races", [False, True])
+def test_pallas_ring_rdma(detect_races):
+    """The RDMA ring GEMM+RS kernel composed with an all-gather forms the
+    replicated all-reduce; validated under the distributed interpreter
+    (with the race detector on in one case)."""
+    cls = load_impl_class("dp_allreduce", "pallas")
+    impl = cls(
+        128, 128, 128, dtype="float32",
+        algorithm="ring_rdma", block_n=128, block_k=128,
+        detect_races=detect_races,
+    )
+    result = impl.run()
+    assert result.shape == (128, 128)
+    assert {s.data.shape for s in result.addressable_shards} == {(128, 128)}
+    assert impl.validate(result)
+
+
+def test_pallas_option_constraints():
+    cls = load_impl_class("dp_allreduce", "pallas")
+    with pytest.raises(ValueError, match="ring_rdma"):
+        cls(M + 1, N, K, algorithm="ring_rdma")  # m % d != 0
+    with pytest.raises(ValueError, match="no effect"):
+        cls(128, N, K, algorithm="ring_rdma", block_m=256)
+    with pytest.raises(ValueError, match="no effect"):
+        cls(M, N, K, detect_races=True)
