@@ -242,11 +242,16 @@ class TransformerStep(Primitive):
             reference_loss,
         )
 
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+
         cfg = self._model_config()
         dp, tp, pp = self._mesh_factors()
         params = init_params(cfg, pp, n_experts=tp, seed=self.seed)
         tokens, targets = self._host_tokens()
-        loss = reference_loss(params, tokens, targets, cfg, tp=tp, dp=dp)
+        # same precision scope as the measured step, so the f32 oracle on
+        # TPU is computed with the same (accurate) matmul form
+        with matmul_precision_scope(self.dtype):
+            loss = reference_loss(params, tokens, targets, cfg, tp=tp, dp=dp)
         return float(jax.block_until_ready(loss))
 
     def validate(self, result) -> bool:
