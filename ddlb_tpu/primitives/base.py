@@ -23,6 +23,9 @@ from ddlb_tpu.runtime import Runtime
 
 # Reference dtype map: tp_columnwise.py:63-70. bfloat16 is the canonical
 # half precision on TPU (SURVEY.md risk register); float16 kept for parity.
+# float64 executes at f32-highest precision on TPU unless the process
+# enables jax x64 (verified on hardware: results validate within the f64
+# tolerance at benchmark shapes, but the device array is float32).
 DTYPE_NAMES = ("float32", "float64", "float16", "bfloat16", "int32", "int64")
 
 
