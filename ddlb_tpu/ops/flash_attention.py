@@ -264,36 +264,60 @@ def finalize_flash_carry(carry, dtype):
     return out.transpose(1, 0, 2).astype(dtype)
 
 
-def _use_triangular(row_offset, sq, skv, bq, bkv) -> bool:
-    """The causal iteration space is a STATIC lower triangle exactly when
-    the query block starts at global row 0 (python-int offset, so the
-    shape of the triangle is known at trace time) and the tile grid is
-    square. Then masked-out tiles can be dropped from the grid entirely —
-    a rectangular grid merely predicates their compute off but still pays
-    their K/V prefetch DMA and grid step (~2x the needed steps)."""
+def _use_triangular(row_offset, sq, skv) -> bool:
+    """The causal iteration space is a STATIC staircase-triangle exactly
+    when the query block starts at global row 0 (python-int offset, so the
+    live-tile set is known at trace time) and Q and KV cover the same
+    square in element space. Masked-out tiles are then dropped from the
+    grid entirely — a rectangular grid merely predicates their compute off
+    but still pays their K/V prefetch DMA and grid step (~2x the needed
+    steps). Blocks need NOT be square: a wider kv block halves the
+    online-softmax rescale chain per unit of work."""
     return (
         isinstance(row_offset, (int, np.integer))
         and row_offset == 0
         and sq == skv
-        and bq == bkv
     )
 
 
-def _tri_maps_lower(n: int):
-    """Linear enumeration of the lower triangle {(i, j): j <= i}, row-major
-    (j innermost — the kv-accumulation order the kernels need): returns
-    int32 arrays ``qi_of[t]``, ``kj_of[t]`` of length n(n+1)/2 for the
-    scalar-prefetch index maps."""
-    qi = np.repeat(np.arange(n), np.arange(1, n + 1))
-    kj = np.concatenate([np.arange(i + 1) for i in range(n)])
+def _last_kj(qi, bq, bkv):
+    """Last live kv tile of query-tile row ``qi`` (static blocks, offset
+    0): the tile containing column ``qi*bq + bq - 1``."""
+    return (qi * bq + bq - 1) // bkv
+
+
+def _first_qi(kj, bq, bkv):
+    """First live q tile of kv-tile column ``kj``: the row containing
+    element row ``kj*bkv``."""
+    return (kj * bkv) // bq
+
+
+def _tile_needs_mask(qi, kj, bq, bkv):
+    """A tile straddles the causal boundary (so its update must mask)
+    unless every element is visible; the worst case is the tile's
+    top-right element (first q row, last kv column), visible iff
+    ``qi*bq >= kj*bkv + bkv - 1``."""
+    return (qi * bq) < ((kj + 1) * bkv - 1)
+
+
+def _tri_maps_lower(nq: int, bq: int, bkv: int):
+    """Row-major enumeration of live tiles {(qi, kj): kj <= last_kj(qi)}
+    (kj innermost — the kv-accumulation order the fwd/dQ kernels need):
+    int32 arrays ``qi_of[t]``, ``kj_of[t]`` for the scalar-prefetch index
+    maps."""
+    counts = [(_last_kj(i, bq, bkv) + 1) for i in range(nq)]
+    qi = np.repeat(np.arange(nq), counts)
+    kj = np.concatenate([np.arange(c) for c in counts])
     return jnp.asarray(qi, jnp.int32), jnp.asarray(kj, jnp.int32)
 
 
-def _tri_maps_upper(n: int):
-    """Column-major enumeration of the same triangle {(j, i): i >= j}
-    (qi innermost) for the dK/dV kernel, which accumulates over q tiles."""
-    kj = np.repeat(np.arange(n), np.arange(n, 0, -1))
-    qi = np.concatenate([np.arange(j, n) for j in range(n)])
+def _tri_maps_upper(nkv: int, nq: int, bq: int, bkv: int):
+    """Column-major enumeration of the same live set
+    {(kj, qi): qi >= first_qi(kj)} (qi innermost) for the dK/dV kernel,
+    which accumulates over q tiles."""
+    firsts = [_first_qi(j, bq, bkv) for j in range(nkv)]
+    kj = np.repeat(np.arange(nkv), [nq - f for f in firsts])
+    qi = np.concatenate([np.arange(f, nq) for f in firsts])
     return jnp.asarray(kj, jnp.int32), jnp.asarray(qi, jnp.int32)
 
 
@@ -304,13 +328,15 @@ def _flash_kernel_tri(
     """Triangular-grid forward: one grid step per LIVE causal tile.
 
     Same math as ``_flash_kernel`` with the (qi, kj) pair decoded from the
-    scalar-prefetched triangle maps; init fires at each query row's first
-    kv tile (kj == 0), flush at its diagonal tile (kj == qi). Only the
-    diagonal tile applies the causal mask — strictly-lower tiles are
-    statically fully live."""
+    scalar-prefetched live-tile maps; init fires at each query row's first
+    kv tile (kj == 0), flush at its last live tile. Only tiles straddling
+    the causal boundary apply the mask — fully-past tiles are statically
+    live."""
     t = pl.program_id(1)
     qi = qi_ref[t]
     kj = kj_ref[t]
+    boundary = _tile_needs_mask(qi, kj, block_q, block_kv)
+    last = _last_kj(qi, block_q, block_kv)
 
     @pl.when(kj == 0)
     def _init():
@@ -325,15 +351,15 @@ def _flash_kernel_tri(
             block_q=block_q, block_kv=block_kv, masked=masked,
         )
 
-    @pl.when(kj == qi)
+    @pl.when(boundary)
     def _diag():
         _update(True)
 
-    @pl.when(kj != qi)
+    @pl.when(jnp.logical_not(boundary))
     def _below():
         _update(False)
 
-    @pl.when(kj == qi)
+    @pl.when(kj == last)
     def _flush():
         l = l_ref[:]
         o_ref[0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)).astype(
@@ -365,12 +391,12 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
         pltpu.VMEM((bq, 1), jnp.float32),   # running max
         pltpu.VMEM((bq, 1), jnp.float32),   # running sum
     ]
-    if _use_triangular(row_offset, sq, skv, bq, bkv):
+    if _use_triangular(row_offset, sq, skv):
         n = sq // bq
-        qi_of, kj_of = _tri_maps_lower(n)
+        qi_of, kj_of = _tri_maps_lower(n, bq, bkv)
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(h, n * (n + 1) // 2),
+            grid=(h, int(qi_of.shape[0])),
             in_specs=[
                 pl.BlockSpec((1, bq, dh), lambda hh, t, qi, kj: (hh, qi[t], 0)),
                 pl.BlockSpec((1, bkv, dh), lambda hh, t, qi, kj: (hh, kj[t], 0)),
@@ -589,6 +615,7 @@ def _flash_bwd_dq_kernel_tri(
     t = pl.program_id(1)
     qi = qi_ref[t]
     kj = kj_ref[t]
+    boundary = _tile_needs_mask(qi, kj, block_q, block_kv)
 
     @pl.when(kj == 0)
     def _init():
@@ -601,15 +628,15 @@ def _flash_bwd_dq_kernel_tri(
             block_q=block_q, block_kv=block_kv, masked=masked,
         )
 
-    @pl.when(kj == qi)
+    @pl.when(boundary)
     def _diag():
         _update(True)
 
-    @pl.when(kj != qi)
+    @pl.when(jnp.logical_not(boundary))
     def _below():
         _update(False)
 
-    @pl.when(kj == qi)
+    @pl.when(kj == _last_kj(qi, block_q, block_kv))
     def _flush():
         dq_ref[0] = dq_acc_ref[:]
 
@@ -619,13 +646,15 @@ def _flash_bwd_dkv_kernel_tri(
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, scale: float, block_q: int, block_kv: int, n_q: int,
 ):
-    """Triangular-grid dK/dV: column-major over the same triangle (q tiles
-    innermost); init at the diagonal (qi == kj), flush at the last q tile."""
+    """Triangular-grid dK/dV: column-major over the live set (q tiles
+    innermost); init at the column's first live row, flush at the last
+    q tile."""
     t = pl.program_id(1)
     kj = kj_ref[t]
     qi = qi_ref[t]
+    boundary = _tile_needs_mask(qi, kj, block_q, block_kv)
 
-    @pl.when(qi == kj)
+    @pl.when(qi == _first_qi(kj, block_q, block_kv))
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -638,11 +667,11 @@ def _flash_bwd_dkv_kernel_tri(
             block_q=block_q, block_kv=block_kv, masked=masked,
         )
 
-    @pl.when(qi == kj)
+    @pl.when(boundary)
     def _diag():
         _update(True)
 
-    @pl.when(qi != kj)
+    @pl.when(jnp.logical_not(boundary))
     def _above():
         _update(False)
 
@@ -693,21 +722,22 @@ def flash_attention_bwd(
     f32 = jnp.float32
     if causal not in ("offset", "diagonal", "past"):
         raise ValueError(f"unknown causal mode {causal!r}")
-    if causal == "diagonal" and sq == skv and bq == bkv:
+    if causal == "diagonal" and sq == skv:
         # the diagonal chunk in relative coordinates IS the static
         # zero-offset square case: take the triangular grids
         row_offset, col_offset = 0, 0
     if (
-        _use_triangular(row_offset, sq, skv, bq, bkv)
+        _use_triangular(row_offset, sq, skv)
         and isinstance(col_offset, (int, np.integer))
         and col_offset == 0
     ):
         n = sq // bq
-        tri = n * (n + 1) // 2
+        nkv = skv // bkv
         qspec_t = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, a[t], 0))
         kvspec_t = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, b[t], 0))
         mlspec_t = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, a[t], 0))
-        qi_of, kj_of = _tri_maps_lower(n)
+        qi_of, kj_of = _tri_maps_lower(n, bq, bkv)
+        tri = int(qi_of.shape[0])
         dq = pl.pallas_call(
             functools.partial(
                 _flash_bwd_dq_kernel_tri, scale=scale, block_q=bq, block_kv=bkv
@@ -733,7 +763,8 @@ def flash_attention_bwd(
 
         # dK/dV: column-major over the triangle, q tiles innermost; the
         # index maps swap roles (a = kj enumeration, b = qi enumeration)
-        kj_of2, qi_of2 = _tri_maps_upper(n)
+        kj_of2, qi_of2 = _tri_maps_upper(nkv, n, bq, bkv)
+        tri2 = int(kj_of2.shape[0])
         qspec_t2 = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, b[t], 0))
         kvspec_t2 = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, a[t], 0))
         mlspec_t2 = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, b[t], 0))
@@ -748,7 +779,7 @@ def flash_attention_bwd(
             ],
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=2,
-                grid=(h, tri),
+                grid=(h, tri2),
                 in_specs=[qspec_t2, kvspec_t2, kvspec_t2, qspec_t2, mlspec_t2, mlspec_t2],
                 out_specs=[kvspec_t2, kvspec_t2],
                 scratch_shapes=[

@@ -241,3 +241,31 @@ def test_static_zero_offset_nonsquare_falls_back():
         q, k[:sq], v[:sq], scale=scale, block_q=16, block_kv=32, interpret=True
     )
     assert np.allclose(o2, _reference(q, k[:sq], v[:sq], scale), atol=1e-5)
+
+
+def test_staircase_asymmetric_blocks_match_reference():
+    """bq != bkv takes the generalized staircase live-tile grid (wider kv
+    tiles halve the online-softmax rescale chain); forward and all three
+    gradients must match autodiff of the einsum reference."""
+    S, h, dh = 128, 2, 16
+    q, k, v = _rand((S, h, dh), 0), _rand((S, h, dh), 1), _rand((S, h, dh), 2)
+    scale = 1.0 / np.sqrt(dh)
+
+    for bq, bkv in ((16, 32), (32, 16), (16, 64)):
+        def flash(q, k, v, bq=bq, bkv=bkv):
+            return flash_attention(
+                q, k, v, scale=scale, block_q=bq, block_kv=bkv,
+                interpret=True,
+            )
+
+        assert np.allclose(
+            flash(q, k, v), _reference(q, k, v, scale), atol=1e-5
+        ), (bq, bkv)
+        g = jax.grad(lambda *a: jnp.sum(flash(*a) ** 2), argnums=(0, 1, 2))(
+            q, k, v
+        )
+        g_ref = jax.grad(
+            lambda *a: jnp.sum(_reference(*a, scale) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        for a, b in zip(g, g_ref):
+            assert np.allclose(a, b, atol=1e-4), (bq, bkv)
