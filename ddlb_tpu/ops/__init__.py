@@ -1,3 +1,10 @@
 """Hand-written Pallas TPU kernels (the framework's native-code layer)."""
 
 from ddlb_tpu.ops.matmul import matmul  # noqa: F401
+from ddlb_tpu.ops.quantized_matmul import (  # noqa: F401
+    int8_matmul,
+    int8_matmul_pallas,
+    quantization_atol,
+    quantize_colwise,
+    quantize_rowwise,
+)

@@ -1,0 +1,73 @@
+"""Shared surface of the int8 ``quantized`` GEMM members.
+
+Both GEMM families (tp_columnwise, tp_rowwise) expose the same option
+schema, dtype gate and kernel selector around ``ops.quantized_matmul``;
+this mixin is their single source so the schema cannot drift between
+families. The families differ only in how scales travel with the
+collective — that stays in each member.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.ops.quantized_matmul import int8_matmul, int8_matmul_pallas
+
+#: operand dtypes the int8 path accepts: quantization replaces the float
+#: values, so only the float dtypes are meaningful inputs
+QUANTIZABLE_DTYPES = ("float32", "float16", "bfloat16")
+
+
+class QuantizedGEMMMixin:
+    DEFAULT_OPTIONS = {
+        "kernel": "xla",
+        "quantize": "static",
+        "block_m": 1024,
+        "block_n": 1024,
+        "block_k": 1024,
+    }
+    ALLOWED_VALUES = {
+        "kernel": ["xla", "pallas"],
+        "quantize": ["static", "dynamic"],
+        "block_m": (128, None),
+        "block_n": (128, None),
+        "block_k": (128, None),
+    }
+
+    def _check_quantized_options(self) -> None:
+        if self.dtype not in QUANTIZABLE_DTYPES:
+            raise ValueError(
+                "quantized implementation supports floating operand dtypes "
+                f"{QUANTIZABLE_DTYPES} only (got {self.dtype})"
+            )
+        if self.options["kernel"] == "xla":
+            overridden = self._options_manager.overridden
+            dead = {"block_m", "block_n", "block_k"} & overridden
+            if dead:
+                raise ValueError(
+                    f"Option(s) {sorted(dead)} have no effect with kernel='xla'"
+                )
+
+    def _make_int8_gemm(self, out_dtype, *, max_k: int):
+        """The int8 GEMM callable for this member's options.
+
+        ``max_k`` is the contraction length the kernel will actually see
+        (the local shard's for k-sharded layouts), bounding block_k.
+        """
+        if self.options["kernel"] != "pallas":
+            def gemm(aq, bq, sa, sb):
+                return int8_matmul(aq, bq, sa, sb, out_dtype=out_dtype)
+
+            return gemm
+
+        blocks = dict(
+            block_m=min(self.options["block_m"], self.m),
+            block_n=min(self.options["block_n"], self.n),
+            block_k=min(self.options["block_k"], max_k),
+            interpret=self.runtime.platform != "tpu",
+        )
+
+        def gemm(aq, bq, sa, sb):
+            return int8_matmul_pallas(
+                aq, bq, sa, sb, out_dtype=out_dtype, **blocks
+            )
+
+        return gemm

@@ -54,6 +54,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.tp_columnwise.pallas_impl",
             "PallasTPColumnwise",
         ),
+        "quantized": (
+            "ddlb_tpu.primitives.tp_columnwise.quantized",
+            "QuantizedTPColumnwise",
+        ),
     },
     "tp_rowwise": {
         "compute_only": (
@@ -75,6 +79,10 @@ _REGISTRY = {
         "pallas": (
             "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
             "PallasTPRowwise",
+        ),
+        "quantized": (
+            "ddlb_tpu.primitives.tp_rowwise.quantized",
+            "QuantizedTPRowwise",
         ),
     },
     # data-parallel gradient GEMM + all-reduce: no reference analogue

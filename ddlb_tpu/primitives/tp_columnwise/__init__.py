@@ -25,6 +25,10 @@ _LAZY = {
         "ddlb_tpu.primitives.tp_columnwise.pallas_impl",
         "PallasTPColumnwise",
     ),
+    "QuantizedTPColumnwise": (
+        "ddlb_tpu.primitives.tp_columnwise.quantized",
+        "QuantizedTPColumnwise",
+    ),
 }
 
 

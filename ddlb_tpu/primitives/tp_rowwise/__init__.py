@@ -25,6 +25,10 @@ _LAZY = {
         "ddlb_tpu.primitives.tp_rowwise.pallas_impl",
         "PallasTPRowwise",
     ),
+    "QuantizedTPRowwise": (
+        "ddlb_tpu.primitives.tp_rowwise.quantized",
+        "QuantizedTPRowwise",
+    ),
 }
 
 

@@ -1,0 +1,166 @@
+"""int8 quantized GEMM: ops-level error bounds and primitive validation.
+
+The quantized members have no reference analogue (the reference dtype
+floor is fp16); correctness is pinned against the framework's own f32
+oracle under the statistical tolerance derived in
+ops/quantized_matmul.py:quantization_atol.
+"""
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.primitives.registry import load_impl_class
+
+M, N, K = 128, 64, 96
+
+
+def _uniform_operands(m, k, n, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.uniform(-1.0, 1.0, (m, k)).astype(np.float32)
+    b = rng.uniform(-1.0, 1.0, (k, n)).astype(np.float32)
+    return a, b
+
+
+class TestOps:
+    def test_quantize_roundtrip(self):
+        import jax.numpy as jnp
+
+        from ddlb_tpu.ops.quantized_matmul import (
+            quantize_colwise,
+            quantize_rowwise,
+        )
+
+        a, b = _uniform_operands(32, 48, 16)
+        qa, sa = quantize_rowwise(jnp.asarray(a))
+        qb, sb = quantize_colwise(jnp.asarray(b))
+        assert qa.dtype == jnp.int8 and qb.dtype == jnp.int8
+        assert sa.shape == (32, 1) and sb.shape == (1, 16)
+        # dequantized operands are within half a quantization step
+        assert np.max(np.abs(np.asarray(qa, np.float32) * np.asarray(sa) - a)) <= (
+            np.max(np.abs(a), axis=1, keepdims=True) / 127 / 2 + 1e-7
+        ).max()
+        # extremes hit the grid ends exactly
+        assert int(np.max(np.abs(np.asarray(qa, np.int32)))) == 127
+
+    def test_zero_row_guard(self):
+        import jax.numpy as jnp
+
+        from ddlb_tpu.ops.quantized_matmul import quantize_rowwise
+
+        x = jnp.zeros((4, 8), jnp.float32)
+        q, s = quantize_rowwise(x)
+        assert np.all(np.isfinite(np.asarray(s)))
+        assert np.all(np.asarray(q) == 0)
+
+    @pytest.mark.parametrize("k", [96, 512])
+    def test_int8_matmul_error_bound(self, k):
+        import jax.numpy as jnp
+
+        from ddlb_tpu.ops.quantized_matmul import (
+            int8_matmul,
+            quantization_atol,
+            quantize_colwise,
+            quantize_rowwise,
+        )
+
+        a, b = _uniform_operands(64, k, 32)
+        qa, sa = quantize_rowwise(jnp.asarray(a))
+        qb, sb = quantize_colwise(jnp.asarray(b))
+        got = np.asarray(
+            int8_matmul(qa, qb, sa, sb, out_dtype=jnp.float32), np.float32
+        )
+        want = a @ b
+        err = np.max(np.abs(got - want))
+        assert err <= quantization_atol(k), (err, quantization_atol(k))
+        # and the bound is tight enough to mean something: within ~8x
+        assert err >= quantization_atol(k) / 8
+
+    def test_pallas_kernel_matches_xla(self):
+        import jax.numpy as jnp
+
+        from ddlb_tpu.ops.quantized_matmul import (
+            int8_matmul,
+            int8_matmul_pallas,
+            quantize_colwise,
+            quantize_rowwise,
+        )
+
+        a, b = _uniform_operands(256, 256, 256, seed=3)
+        qa, sa = quantize_rowwise(jnp.asarray(a))
+        qb, sb = quantize_colwise(jnp.asarray(b))
+        want = np.asarray(
+            int8_matmul(qa, qb, sa, sb, out_dtype=jnp.float32), np.float32
+        )
+        got = np.asarray(
+            int8_matmul_pallas(
+                qa, qb, sa, sb,
+                block_m=128, block_n=128, block_k=128,
+                out_dtype=jnp.float32, interpret=True,
+            ),
+            np.float32,
+        )
+        # same int32 accumulation, same epilogue -> bitwise-equal floats
+        assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("family", ["tp_columnwise", "tp_rowwise"])
+class TestPrimitive:
+    @pytest.mark.parametrize("quantize", ["static", "dynamic"])
+    def test_validates(self, family, quantize):
+        cls = load_impl_class(family, "quantized")
+        impl = cls(M, N, K if family == "tp_columnwise" else 128,
+                   dtype="bfloat16", quantize=quantize)
+        result = impl.run()
+        assert impl.validate(result)
+
+    def test_pallas_kernel_validates(self, family):
+        cls = load_impl_class(family, "quantized")
+        impl = cls(
+            1024, 256, 1024, dtype="bfloat16",
+            kernel="pallas", block_m=128, block_n=128, block_k=128,
+        )
+        assert impl.validate(impl.run())
+
+    def test_static_dynamic_agree(self, family):
+        cls = load_impl_class(family, "quantized")
+        k = K if family == "tp_columnwise" else 128
+        r_static = cls(M, N, k, dtype="bfloat16", quantize="static").run()
+        r_dynamic = cls(M, N, k, dtype="bfloat16", quantize="dynamic").run()
+        assert np.array_equal(
+            np.asarray(r_static, np.float32), np.asarray(r_dynamic, np.float32)
+        )
+
+    def test_int_dtype_rejected(self, family):
+        cls = load_impl_class(family, "quantized")
+        with pytest.raises(ValueError, match="floating"):
+            cls(M, N, 128, dtype="int32")
+
+    def test_dead_block_options_rejected(self, family):
+        cls = load_impl_class(family, "quantized")
+        with pytest.raises(ValueError, match="no effect"):
+            cls(M, N, 128, dtype="bfloat16", kernel="xla", block_m=256)
+
+
+def test_runs_through_benchmark_worker():
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(
+        {
+            "primitive": "tp_columnwise",
+            "impl_id": "quantized_0",
+            "base_implementation": "quantized",
+            "options": {"quantize": "dynamic"},
+            "m": 128,
+            "n": 64,
+            "k": 96,
+            "dtype": "bfloat16",
+            "num_iterations": 2,
+            "num_warmups": 1,
+            "validate": True,
+            "time_measurement_backend": "host_clock",
+            "barrier_at_each_iteration": False,
+        }
+    )
+    assert not row["error"]
+    assert row["valid"]
+    assert row["Throughput (TFLOPS)"] > 0
