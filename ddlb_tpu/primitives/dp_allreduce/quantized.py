@@ -1,0 +1,112 @@
+"""GEMM+AR on the int8 MXU path: the quantized gradient GEMM.
+
+Completes the int8 story across the collective trio (see
+tp_columnwise/quantized.py for the AG form, tp_rowwise/quantized.py for
+the RS form; no reference analogue). As in the rowwise member, the
+K(batch)-sharded layout gives every partition its own quantization
+scales, so the int8 partial gradient dequantizes to the operand dtype
+locally and the all-reduce rides that dtype — the 2x is in the MXU, not
+the wire. Only the gradient GEMM is quantized: the summation across
+replicas stays full precision, mirroring how int8 training recipes keep
+gradient accumulation in wide dtypes.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.quantized_matmul import (
+    quantization_atol,
+    quantize_colwise,
+    quantize_rowwise,
+)
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
+
+
+class QuantizedDPAllReduce(QuantizedGEMMMixin, DPAllReduce):
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        self._check_quantized_options()
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        gemm = self._make_int8_gemm(
+            jnp_dtype(self.dtype), max_k=self.k // self.num_partitions
+        )
+
+        def partial_ar(aq, sa, bq, sb):
+            partial = gemm(aq, bq, sa, sb)  # [m, n] dequantized partial
+            return jax.lax.psum(partial, "tp")  # replicated full gradient
+
+        def quant_shards(a_shard, b_shard):
+            aq, sa = quantize_rowwise(a_shard)
+            bq, sb = quantize_colwise(b_shard)
+            return aq, sa, bq, sb
+
+        if self.options["quantize"] == "static":
+            self.aq, self.sa, self.bq, self.sb = jax.block_until_ready(
+                jax.jit(
+                    jax.shard_map(
+                        quant_shards,
+                        mesh=self.mesh,
+                        in_specs=(P(None, "tp"), P("tp", None)),
+                        out_specs=(
+                            P(None, "tp"),
+                            P(None, "tp"),
+                            P("tp", None),
+                            P("tp", None),
+                        ),
+                        check_vma=False,
+                    )
+                )(self.a, self.b)
+            )
+            self._fn = jax.jit(
+                jax.shard_map(
+                    partial_ar,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(None, "tp"),
+                        P(None, "tp"),
+                        P("tp", None),
+                        P("tp", None),
+                    ),
+                    out_specs=P(None, None),
+                    check_vma=False,
+                )
+            )
+            self._args = (self.aq, self.sa, self.bq, self.sb)
+        else:  # dynamic: quantize BOTH shards in-step — in the DP gradient
+            # step activations AND output-grads are fresh every iteration,
+            # so unlike the TP members there is no static "weight" side
+
+            def step(a_shard, b_shard):
+                aq, sa, bq, sb = quant_shards(a_shard, b_shard)
+                return partial_ar(aq, sa, bq, sb)
+
+            self._fn = jax.jit(
+                jax.shard_map(
+                    step,
+                    mesh=self.mesh,
+                    in_specs=(P(None, "tp"), P("tp", None)),
+                    out_specs=P(None, None),
+                    check_vma=False,
+                )
+            )
+            self._args = (self.a, self.b)
+
+    @property
+    def _call_args(self):
+        return self._args
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        result = jax.block_until_ready(result)
+        # same bound as the TP members: d partials of k/d quantized terms
+        # sum to one full-k quantized GEMM's variance
+        return self._compare_global(
+            result, self._expected_full(), atol=quantization_atol(self.k)
+        )

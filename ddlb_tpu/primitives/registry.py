@@ -109,6 +109,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.dp_allreduce.pallas_impl",
             "PallasDPAllReduce",
         ),
+        "quantized": (
+            "ddlb_tpu.primitives.dp_allreduce.quantized",
+            "QuantizedDPAllReduce",
+        ),
     },
     # context-parallel attention: no reference analogue (SURVEY.md section
     # 2.5 — the reference has no attention op); the natural extension of

@@ -103,7 +103,9 @@ class TestOps:
         assert np.array_equal(got, want)
 
 
-@pytest.mark.parametrize("family", ["tp_columnwise", "tp_rowwise"])
+@pytest.mark.parametrize(
+    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce"]
+)
 class TestPrimitive:
     @pytest.mark.parametrize("quantize", ["static", "dynamic"])
     def test_validates(self, family, quantize):
