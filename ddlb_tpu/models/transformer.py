@@ -60,6 +60,14 @@ class TransformerConfig:
     #: "einsum": XLA einsum attention (HBM-resident scores; the oracle's
     #: formulation), kept selectable for A/B measurement.
     attn_kernel: str = "flash"
+    #: "int8": the MoE FFN GEMMs (the FLOPs-dominant block) run on the
+    #: int8 MXU path via the straight-through estimator
+    #: (ops/quantized_matmul.int8_ste_matmul) — real int8 compute forward,
+    #: full-precision gradients; per-token/per-feature scales make the
+    #: sharded forward bit-identical to the oracle's. Attention
+    #: projections stay in the operand dtype (head sharding would need
+    #: per-shard scale bookkeeping for marginal FLOPs share).
+    mlp_kernel: str = "bf16"
     dtype: Any = jnp.float32
 
     @property
@@ -234,6 +242,33 @@ def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
     return o.reshape(s_loc, b, h, dh).transpose(1, 0, 2, 3)
 
 
+def _moe_ffn(tokens2d, w1, w2, mlp_kernel, out_dtype):
+    """One expert's FFN on a ``[T, D]`` token slab -> ``[T, D]``.
+
+    Shared verbatim by the sharded stage body and the single-device
+    oracle: per-token/per-feature int8 scales are row/column-local, so
+    the two call sites produce bit-identical values whatever the token
+    batching — which is what keeps the oracle pinning exact under
+    ``mlp_kernel='int8'``.
+    """
+    if mlp_kernel == "int8":
+        from ddlb_tpu.ops.quantized_matmul import int8_ste_matmul
+
+        z = jax.nn.gelu(int8_ste_matmul(tokens2d, w1)).astype(out_dtype)
+        return int8_ste_matmul(z, w2).astype(out_dtype)
+    if mlp_kernel != "bf16":
+        # the shared choke point fails fast for every entry path —
+        # make_loss_fn validates, but reference_loss/library callers
+        # must not silently measure the full-precision kernel
+        raise ValueError(f"unknown mlp_kernel '{mlp_kernel}'")
+    z = jax.nn.gelu(
+        jnp.matmul(tokens2d, w1, preferred_element_type=jnp.float32)
+    ).astype(out_dtype)
+    return jnp.matmul(
+        z, w2, preferred_element_type=jnp.float32
+    ).astype(out_dtype)
+
+
 def _ce_loss(logits, targets):
     """Mean token cross-entropy in f32."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
@@ -257,6 +292,8 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
     specs = param_specs(cfg)
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
+    if cfg.mlp_kernel not in ("bf16", "int8"):
+        raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
     # pallas kernels run compiled on TPU, interpreted elsewhere (CPU sim)
     interpret = jax.default_backend() != "tpu"
 
@@ -332,16 +369,13 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
             t3 = jax.lax.all_to_all(
                 t3, "tp", split_axis=0, concat_axis=0, tiled=True
             )
-            u = jax.nn.gelu(
-                jnp.matmul(
-                    t3.reshape(T, D),
-                    sp["moe_w1"][0, l, 0],
-                    preferred_element_type=jnp.float32,
-                )
-            ).astype(x.dtype)
-            u = jnp.matmul(
-                u, sp["moe_w2"][0, l, 0], preferred_element_type=jnp.float32
-            ).astype(x.dtype)
+            u = _moe_ffn(
+                t3.reshape(T, D),
+                sp["moe_w1"][0, l, 0],
+                sp["moe_w2"][0, l, 0],
+                cfg.mlp_kernel,
+                x.dtype,
+            )
             u = jax.lax.all_to_all(
                 u.reshape(tp, T // tp, D),
                 "tp",
@@ -522,18 +556,13 @@ def reference_loss(
                     out_blk = jnp.zeros((T, D), x.dtype)
                     for e in range(tp):
                         grp = blk[e * g : (e + 1) * g]
-                        z = jax.nn.gelu(
-                            jnp.matmul(
-                                grp,
-                                params["moe_w1"][st, l, e],
-                                preferred_element_type=jnp.float32,
-                            )
-                        ).astype(x.dtype)
-                        z = jnp.matmul(
-                            z,
+                        z = _moe_ffn(
+                            grp,
+                            params["moe_w1"][st, l, e],
                             params["moe_w2"][st, l, e],
-                            preferred_element_type=jnp.float32,
-                        ).astype(x.dtype)
+                            cfg.mlp_kernel,
+                            x.dtype,
+                        )
                         out_blk = jax.lax.dynamic_update_slice(
                             out_blk, z, (e * g, 0)
                         )

@@ -86,6 +86,59 @@ def int8_matmul(aq, bq, sa, sb, *, out_dtype=jnp.bfloat16):
     return (acc.astype(jnp.float32) * sa * sb).astype(out_dtype)
 
 
+@jax.custom_vjp
+def int8_ste_matmul(x, w):
+    """``x @ w`` computed on the int8 MXU path, differentiable via the
+    straight-through estimator.
+
+    Forward: per-row (token) quantization of ``x``, per-column (feature)
+    quantization of ``w``, int8 MXU GEMM, fused dequant — float32 out
+    (the callers' ``preferred_element_type=float32`` convention). Because
+    row scales are per-row-local and column scales per-column-local, the
+    result is BIT-IDENTICAL however the row dimension is batched or
+    sharded — which is what lets a single-device oracle reproduce a
+    sharded model's int8 forward exactly.
+
+    Backward: standard QAT straight-through — gradients flow as if the
+    quantizer were the identity: the f32 cotangent contracts against the
+    ORIGINAL operands at full f32 width and only the results downcast to
+    the operand dtypes (the same form autodiff gives the unquantized
+    ``jnp.matmul(x, w, preferred_element_type=f32)``). 2-D operands only;
+    callers flatten leading dims.
+    """
+    q, s = quantize_rowwise(x)
+    qw, sw = quantize_colwise(w)
+    return int8_matmul(q, qw, s, sw, out_dtype=jnp.float32)
+
+
+def _ste_fwd(x, w):
+    return int8_ste_matmul(x, w), (x, w)
+
+
+def _ste_bwd(res, g):
+    # the f32 cotangent contracts at full width (as autodiff of the
+    # unquantized matmul does) and only the RESULTS downcast — rounding g
+    # to bf16 first would add gradient noise the STE contract doesn't have
+    x, w = res
+    gf = g.astype(jnp.float32)
+    dx = jax.lax.dot_general(
+        gf,
+        w.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    dw = jax.lax.dot_general(
+        x.astype(jnp.float32),
+        gf,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    return dx, dw
+
+
+int8_ste_matmul.defvjp(_ste_fwd, _ste_bwd)
+
+
 def _int8_kernel(a_ref, b_ref, sa_ref, sb_ref, o_ref, acc_ref):
     @pl.when(pl.program_id(2) == 0)
     def _zero():

@@ -50,6 +50,7 @@ class TransformerStep(Primitive):
         "microbatches": 2,
         "attention": "gathered",
         "attn_kernel": "flash",
+        "mlp_kernel": "bf16",
         "dp": 0,  # 0 = auto factorization of the device count
         "tp": 0,
         "pp": 0,
@@ -63,6 +64,7 @@ class TransformerStep(Primitive):
         "microbatches": (1, None),
         "attention": ["gathered", "ring"],
         "attn_kernel": ["flash", "einsum"],
+        "mlp_kernel": ["bf16", "int8"],
         "dp": (0, None),
         "tp": (0, None),
         "pp": (0, None),
@@ -221,6 +223,7 @@ class TransformerStep(Primitive):
             microbatches=o["microbatches"],
             attention=o["attention"],
             attn_kernel=o["attn_kernel"],
+            mlp_kernel=o["mlp_kernel"],
             dtype=jnp_dtype(self.dtype),
         )
 

@@ -141,6 +141,21 @@ def test_tp_transport_sweep(primitive, sliced_runtime, tmp_path):
     assert any("transport=ici" in o for o in opts)
 
 
+@pytest.mark.parametrize(
+    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce"]
+)
+def test_quantized_transport_sweep(family, sliced_runtime):
+    """The int8 members inherit the family transport axis: the int8-wire
+    all-gather (columnwise) and dequantized-partial collectives ride the
+    dcn-interleaved mesh and still validate."""
+    from ddlb_tpu.primitives.registry import load_impl_class
+
+    cls = load_impl_class(family, "quantized")
+    for transport in ("ici", "dcn"):
+        impl = cls(128, 32, 64, dtype="bfloat16", transport=transport)
+        assert impl.validate(impl.run()), (family, transport)
+
+
 def test_ring_kernel_on_dcn_mesh(sliced_runtime):
     """The RDMA ring kernel is transport-agnostic: on the interleaved
     (dcn) mesh every ppermute hop crosses the simulated slice boundary
