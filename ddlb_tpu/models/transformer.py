@@ -67,6 +67,11 @@ class TransformerConfig:
     #: sharded forward bit-identical to the oracle's. Attention
     #: projections stay in the operand dtype (head sharding would need
     #: per-shard scale bookkeeping for marginal FLOPs share).
+    #: "int8_weights": the inference serving form — expert weights are
+    #: quantized ONCE at init (init_params emits int8 weights + scale
+    #: leaves) so the step pays no per-call weight quantization, only the
+    #: dynamic per-token activation quant. Forward-only: int8 weight
+    #: leaves have no gradients.
     mlp_kernel: str = "bf16"
     dtype: Any = jnp.float32
 
@@ -89,7 +94,7 @@ def init_params(
 
     s_in = (1.0 / D) ** 0.5
     s_ff = (1.0 / F) ** 0.5
-    return {
+    params = {
         "embed": normal((V, D), 1.0),
         # leading 3 = Q/K/V so a tp column-shard is per-projection heads,
         # not a contiguous slice across the fused [D, 3D] layout
@@ -102,6 +107,17 @@ def init_params(
         "ln_f": jnp.ones((D,), cfg.dtype),
         "head": normal((D, V), s_in),
     }
+    if cfg.mlp_kernel == "int8_weights":
+        # inference serving form: the expert weights ship pre-quantized,
+        # so the step never re-quantizes them (deterministic: both the
+        # distributed step and the oracle consume THESE leaves)
+        from ddlb_tpu.ops.quantized_matmul import quantize_weight_stack
+
+        for name in ("moe_w1", "moe_w2"):
+            q, s = quantize_weight_stack(params[name])
+            params[name] = q
+            params[f"{name}_scale"] = s
+    return params
 
 
 def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
@@ -124,7 +140,7 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         if cfg.attention == "ring"
         else P("pp", None, "tp", None)
     )
-    return {
+    specs = {
         "embed": P(None, None),
         "w_qkv": attn_qkv,
         "w_o": attn_o,
@@ -135,6 +151,11 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         "ln_f": P(None),
         "head": P(None, None),
     }
+    if cfg.mlp_kernel == "int8_weights":
+        # scale leaves ride with their weights: expert axis on tp
+        specs["moe_w1_scale"] = P("pp", None, "tp", None, None)
+        specs["moe_w2_scale"] = P("pp", None, "tp", None, None)
+    return specs
 
 
 def _rms_norm(x, scale):
@@ -242,20 +263,36 @@ def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
     return o.reshape(s_loc, b, h, dh).transpose(1, 0, 2, 3)
 
 
-def _moe_ffn(tokens2d, w1, w2, mlp_kernel, out_dtype):
+def _moe_ffn(tokens2d, w1, w2, mlp_kernel, out_dtype, scales=None):
     """One expert's FFN on a ``[T, D]`` token slab -> ``[T, D]``.
 
     Shared verbatim by the sharded stage body and the single-device
     oracle: per-token/per-feature int8 scales are row/column-local, so
     the two call sites produce bit-identical values whatever the token
-    batching — which is what keeps the oracle pinning exact under
-    ``mlp_kernel='int8'``.
+    batching — which is what keeps the oracle pinning exact under the
+    int8 kernels. ``scales`` is the ``(w1_scale, w2_scale)`` pair in
+    ``int8_weights`` mode (w1/w2 are then the pre-quantized int8 leaves).
     """
     if mlp_kernel == "int8":
         from ddlb_tpu.ops.quantized_matmul import int8_ste_matmul
 
         z = jax.nn.gelu(int8_ste_matmul(tokens2d, w1)).astype(out_dtype)
         return int8_ste_matmul(z, w2).astype(out_dtype)
+    if mlp_kernel == "int8_weights":
+        from ddlb_tpu.ops.quantized_matmul import int8_matmul, quantize_rowwise
+
+        if scales is None:
+            raise ValueError(
+                "mlp_kernel='int8_weights' needs the (w1_scale, w2_scale) "
+                "pair emitted by init_params alongside the int8 weights"
+            )
+        s1, s2 = scales
+        qx, sx = quantize_rowwise(tokens2d)
+        z = jax.nn.gelu(
+            int8_matmul(qx, w1, sx, s1, out_dtype=jnp.float32)
+        ).astype(out_dtype)
+        qz, sz = quantize_rowwise(z)
+        return int8_matmul(qz, w2, sz, s2, out_dtype=out_dtype)
     if mlp_kernel != "bf16":
         # the shared choke point fails fast for every entry path —
         # make_loss_fn validates, but reference_loss/library callers
@@ -292,7 +329,7 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
     specs = param_specs(cfg)
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
-    if cfg.mlp_kernel not in ("bf16", "int8"):
+    if cfg.mlp_kernel not in ("bf16", "int8", "int8_weights"):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
     # pallas kernels run compiled on TPU, interpreted elsewhere (CPU sim)
     interpret = jax.default_backend() != "tpu"
@@ -375,6 +412,11 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
                 sp["moe_w2"][0, l, 0],
                 cfg.mlp_kernel,
                 x.dtype,
+                scales=(
+                    (sp["moe_w1_scale"][0, l, 0], sp["moe_w2_scale"][0, l, 0])
+                    if cfg.mlp_kernel == "int8_weights"
+                    else None
+                ),
             )
             u = jax.lax.all_to_all(
                 u.reshape(tp, T // tp, D),
@@ -487,6 +529,12 @@ def make_train_step(
     """
     import optax
 
+    if cfg.mlp_kernel == "int8_weights":
+        raise ValueError(
+            "mlp_kernel='int8_weights' is the forward-only serving form "
+            "(int8 weight leaves have no gradients); train with "
+            "mlp_kernel='int8' (STE) instead"
+        )
     optimizer = optax.adamw(learning_rate)
     loss_fn, shardings = make_loss_fn(mesh, cfg)
 
@@ -562,6 +610,14 @@ def reference_loss(
                             params["moe_w2"][st, l, e],
                             cfg.mlp_kernel,
                             x.dtype,
+                            scales=(
+                                (
+                                    params["moe_w1_scale"][st, l, e],
+                                    params["moe_w2_scale"][st, l, e],
+                                )
+                                if cfg.mlp_kernel == "int8_weights"
+                                else None
+                            ),
                         )
                         out_blk = jax.lax.dynamic_update_slice(
                             out_blk, z, (e * g, 0)

@@ -57,6 +57,16 @@ def quantize_colwise(x):
     return _quantize(x, axis=0)
 
 
+def quantize_weight_stack(w):
+    """Per-output-feature quantization of a stacked weight tensor
+    ``[..., k, n]`` (contraction on the second-to-last axis): the
+    pre-quantized-weights form for inference-style int8 GEMMs. Returns
+    ``(q [..., k, n] int8, s [..., 1, n] float32)`` — each trailing 2-D
+    matrix quantized exactly as ``quantize_colwise`` would.
+    """
+    return _quantize(w, axis=-2)
+
+
 def quantization_atol(k: int) -> float:
     """Validation tolerance for int8-quantized GEMM over the contract's
     seeded uniform [-1, 1] operands (primitives/base.py _host_operands).

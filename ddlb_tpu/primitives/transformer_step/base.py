@@ -64,7 +64,7 @@ class TransformerStep(Primitive):
         "microbatches": (1, None),
         "attention": ["gathered", "ring"],
         "attn_kernel": ["flash", "einsum"],
-        "mlp_kernel": ["bf16", "int8"],
+        "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "dp": (0, None),
         "tp": (0, None),
         "pp": (0, None),
@@ -185,6 +185,11 @@ class TransformerStep(Primitive):
             )
         if self.dtype not in ("float32", "bfloat16", "float16"):
             raise ValueError("transformer_step requires a floating dtype")
+        if o["mlp_kernel"] == "int8_weights" and o["mode"] != "forward":
+            raise ValueError(
+                "mlp_kernel='int8_weights' (pre-quantized serving weights) "
+                "requires mode='forward'; use mlp_kernel='int8' for train"
+            )
 
     def flops(self) -> float:
         """Model matmul FLOPs of one step.

@@ -261,6 +261,102 @@ class TestModelInt8:
             float(loss), expected,
         )
 
+    def test_forward_int8_weights_matches_oracle(self):
+        """The serving form: pre-quantized weight leaves, forward loss
+        pins the oracle (both consume the same init_params output)."""
+        import jax
+
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            example_tokens,
+            init_params,
+            make_loss_fn,
+            reference_loss,
+        )
+
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=1, microbatches=2, mlp_kernel="int8_weights",
+        )
+        dp, tp, pp = 2, 2, 2
+        mesh = jax.make_mesh((dp, tp, pp), ("dp", "tp", "pp"))
+        loss_fn, shardings = make_loss_fn(mesh, cfg)
+        params = init_params(cfg, pp, n_experts=tp)
+        assert str(params["moe_w1"].dtype) == "int8"
+        assert "moe_w1_scale" in params
+        tokens, targets = example_tokens(dp * cfg.microbatches, 8 * tp, cfg.vocab)
+        expected = float(
+            reference_loss(
+                params, np.asarray(tokens), np.asarray(targets),
+                cfg, tp=tp, dp=dp,
+            )
+        )
+        dev_params = {
+            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+        }
+        tokens = jax.device_put(tokens, shardings["data"])
+        targets = jax.device_put(targets, shardings["data"])
+        loss = jax.jit(loss_fn)(dev_params, tokens, targets)
+        assert np.isclose(float(loss), expected, rtol=0, atol=1e-4), (
+            float(loss), expected,
+        )
+
+    def test_int8_weights_train_rejected(self):
+        import jax
+
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            make_train_step,
+        )
+
+        cfg = TransformerConfig(mlp_kernel="int8_weights")
+        mesh = jax.make_mesh((2, 2, 2), ("dp", "tp", "pp"))
+        with pytest.raises(ValueError, match="forward-only"):
+            make_train_step(mesh, cfg)
+
+    def test_transformer_step_int8_weights_forward_validates(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_int8w",
+                "base_implementation": "spmd",
+                "options": {"mlp_kernel": "int8_weights", "mode": "forward",
+                            "batch": 4, "vocab": 64, "n_heads": 4},
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert not row["error"], row["error"]
+        assert row["valid"]
+
+    @pytest.mark.parametrize("member", ["compute_only", "xla_gspmd"])
+    def test_other_members_int8_weights_forward(self, member):
+        """The single-program members thread the serving mode through
+        reference_loss + param_specs too."""
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_step", member)
+        impl = cls(16, 32, 64, dtype="float32", mlp_kernel="int8_weights",
+                   mode="forward", batch=4, vocab=64, n_heads=4)
+        assert impl.validate(impl.run())
+
+    def test_transformer_step_int8_weights_train_rejected(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_step", "spmd")
+        with pytest.raises(ValueError, match="forward"):
+            cls(16, 32, 64, dtype="float32", mlp_kernel="int8_weights",
+                mode="train", batch=4, vocab=64, n_heads=4)
+
     def test_transformer_step_int8_validates(self):
         from ddlb_tpu.benchmark import benchmark_worker
 
