@@ -1,0 +1,142 @@
+"""MoE dispatch/combine on the int8 MXU path: quantized expert GEMM with
+int8 token dispatch.
+
+No reference analogue (see tp_columnwise/quantized.py). The EP twist
+mirrors the columnwise member's wire story for the all-to-all: tokens are
+quantized per-row BEFORE the dispatch, so the exchange moves int8 at half
+the width of the bf16 operand with only a tiny ``[tokens, 1]`` scale
+vector alongside, and the resident expert's GEMM runs on the MXU's 2x
+int8 path. Per-row scales travel WITH their tokens through the
+all-to-all (both are split/concatenated on the same token axis), so
+dequantization after the expert GEMM is exact wherever a token lands.
+The combine returns outputs in the operand dtype, as the bf16
+implementations do.
+
+``quantize=static`` pre-quantizes the token matrix at init; ``dynamic``
+re-quantizes the local token shard inside every measured step
+(activation-style). Expert weights are always pre-quantized per-column
+at init (the weight role).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.quantized_matmul import (
+    quantization_atol,
+    quantize_rowwise,
+    quantize_weight_stack,
+)
+from ddlb_tpu.primitives.base import jnp_dtype
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
+
+
+class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        self._check_quantized_options()
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        opts = self.options
+        d, g = self.num_partitions, self.group_tokens
+        out_dtype = jnp_dtype(self.dtype)
+        gemm = self._make_int8_gemm(out_dtype, max_k=self.k)
+
+        # expert weights pre-quantized per-column at init (weight role);
+        # quantize_weight_stack treats the leading expert axis as a stack
+        self.wq, self.ws = jax.block_until_ready(
+            jax.jit(
+                jax.shard_map(
+                    quantize_weight_stack,
+                    mesh=self.mesh,
+                    in_specs=(P("tp", None, None),),
+                    out_specs=(P("tp", None, None), P("tp", None, None)),
+                    check_vma=False,
+                )
+            )(self.w)
+        )
+
+        def dispatch_gemm_combine(aq, sa, wq_loc, ws_loc):
+            """int8 tokens + scales ride the dispatch together."""
+            x = aq.reshape(d, g, self.k)
+            s = sa.reshape(d, g, 1)
+            x = jax.lax.all_to_all(
+                x, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            s = jax.lax.all_to_all(
+                s, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            y = gemm(
+                x.reshape(d * g, self.k), wq_loc[0], s.reshape(d * g, 1),
+                ws_loc[0],
+            )
+            y = y.astype(out_dtype).reshape(d, g, self.n)
+            y = jax.lax.all_to_all(
+                y, "tp", split_axis=0, concat_axis=0, tiled=True
+            )
+            return y.reshape(d * g, self.n)
+
+        if opts["quantize"] == "static":
+            self.aq, self.sa = jax.block_until_ready(
+                jax.jit(
+                    jax.shard_map(
+                        quantize_rowwise,
+                        mesh=self.mesh,
+                        in_specs=(P("tp", None),),
+                        out_specs=(P("tp", None), P("tp", None)),
+                        check_vma=False,
+                    )
+                )(self.a)
+            )
+            self._fn = jax.jit(
+                jax.shard_map(
+                    dispatch_gemm_combine,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P("tp", None),
+                        P("tp", None),
+                        P("tp", None, None),
+                        P("tp", None, None),
+                    ),
+                    out_specs=P("tp", None),
+                    check_vma=False,
+                )
+            )
+            self._args = (self.aq, self.sa, self.wq, self.ws)
+        else:  # dynamic: quantize the local token shard in-step
+
+            def step(a_loc, wq_loc, ws_loc):
+                aq, sa = quantize_rowwise(a_loc)
+                return dispatch_gemm_combine(aq, sa, wq_loc, ws_loc)
+
+            self._fn = jax.jit(
+                jax.shard_map(
+                    step,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P("tp", None),
+                        P("tp", None, None),
+                        P("tp", None, None),
+                    ),
+                    out_specs=P("tp", None),
+                    check_vma=False,
+                )
+            )
+            self._args = (self.a, self.wq, self.ws)
+
+    @property
+    def _call_args(self):
+        return self._args
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        result = jax.block_until_ready(result)
+        # quantization noise dominates (ops/quantized_matmul.py)
+        return self._compare_global(
+            result, self._expected_full(), atol=quantization_atol(self.k)
+        )

@@ -163,6 +163,10 @@ _REGISTRY = {
             "ddlb_tpu.primitives.ep_alltoall.overlap",
             "OverlapEPAllToAll",
         ),
+        "quantized": (
+            "ddlb_tpu.primitives.ep_alltoall.quantized",
+            "QuantizedEPAllToAll",
+        ),
     },
     # the flagship model's full train/forward step through the same
     # runner — the composition the GEMM primitives exist to accelerate

@@ -104,7 +104,7 @@ class TestOps:
 
 
 @pytest.mark.parametrize(
-    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce"]
+    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce", "ep_alltoall"]
 )
 class TestPrimitive:
     @pytest.mark.parametrize("quantize", ["static", "dynamic"])

@@ -142,7 +142,7 @@ def test_tp_transport_sweep(primitive, sliced_runtime, tmp_path):
 
 
 @pytest.mark.parametrize(
-    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce"]
+    "family", ["tp_columnwise", "tp_rowwise", "dp_allreduce", "ep_alltoall"]
 )
 def test_quantized_transport_sweep(family, sliced_runtime):
     """The int8 members inherit the family transport axis: the int8-wire
