@@ -1,0 +1,435 @@
+"""Autoregressive decode for the flagship model: KV cache + one-token steps.
+
+The serving counterpart of models/transformer.py (no reference analogue —
+the reference has no model at all, SURVEY.md section 2.5). Training
+measures the compute-bound regime; decode is the OTHER TPU regime: one
+query token against a long cache is HBM-bandwidth-bound (every step re-reads
+the whole K/V cache and every weight), so tokens/s tracks bytes/token,
+not FLOPs. The module provides:
+
+- ``init_cache`` — the sharded K/V cache pytree ``[L, B, S_max, H, dh]``
+  (heads sharded over ``tp``, batch over ``dp``).
+- ``make_prefill_fn`` — the full-sequence forward that fills the cache
+  for a prompt and returns the last position's logits (compute-bound
+  phase).
+- ``make_decode_fn`` — one token per sequence against the cache
+  (bandwidth-bound phase); functionally pure (cache in, cache out) so
+  the step jits and re-runs under the benchmark loop.
+- ``reference_logits`` — single-device oracle: teacher-forced full
+  forward whose logits the incremental cache path must reproduce (the
+  prefill/decode consistency check is real — the two code paths share no
+  attention code).
+
+Topology: decode shards batch over ``dp`` and heads+experts over ``tp``
+(the standard serving layout); pipeline stages don't apply to a
+single-token step (``pp=1``). MoE routing at decode groups the batch's
+sequences into ``tp`` balanced blocks — sequence ``i`` uses expert
+``i // (B/(dp*tp))`` at every position — mirroring the family's
+capacity-balanced philosophy with a per-sequence-stable assignment both
+code paths reproduce exactly. The MLP kernel axis (bf16 / int8 STE /
+int8_weights) is the shared ``_moe_ffn``; decode takes no gradients, so
+all three are valid here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.models.transformer import (
+    TransformerConfig,
+    _moe_ffn,
+    _rms_norm,
+)
+
+
+def _ffn_scales(params, l, e, cfg):
+    if cfg.mlp_kernel != "int8_weights":
+        return None
+    return (
+        params["moe_w1_scale"][0, l, e],
+        params["moe_w2_scale"][0, l, e],
+    )
+
+
+def init_cache(
+    cfg: TransformerConfig, batch: int, max_len: int, mesh=None
+) -> Dict[str, jax.Array]:
+    """Zeroed K/V cache ``[L, B, S_max, H, dh]`` (+ sharded when a mesh is
+    given: batch over dp, heads over tp)."""
+    shape = (
+        cfg.layers_per_stage,
+        batch,
+        max_len,
+        cfg.n_heads,
+        cfg.head_dim,
+    )
+    k = jnp.zeros(shape, cfg.dtype)
+    v = jnp.zeros(shape, cfg.dtype)
+    if mesh is not None:
+        sh = NamedSharding(mesh, P(None, "dp", None, "tp", None))
+        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+    return {"k": k, "v": v}
+
+
+def cache_specs() -> Dict[str, P]:
+    return {
+        "k": P(None, "dp", None, "tp", None),
+        "v": P(None, "dp", None, "tp", None),
+    }
+
+
+def _project_qkv(h, w_qkv_l, b, t, h_loc, dh, dtype):
+    """[b, t, D] -> three [b, t, h_loc, dh] local-head projections."""
+    return (
+        jnp.matmul(h, w_qkv_l[i], preferred_element_type=jnp.float32)
+        .astype(dtype)
+        .reshape(b, t, h_loc, dh)
+        for i in range(3)
+    )
+
+
+def _routed_moe(h2d, params, cfg, l, B, dp, tp):
+    """Per-sequence-stable balanced routing on a FULL-width row-major
+    slab ``[B * per_seq, D]``: block ``e`` of each dp shard's sequences
+    through expert ``e`` — the single-program formulation shared by the
+    oracle and the GSPMD member (the shard_map path implements the same
+    assignment positionally in ``_block_moe``)."""
+    rows, _ = h2d.shape
+    per_seq = rows // B
+    b_dp = B // dp
+    g = b_dp // tp
+    u = jnp.zeros_like(h2d)
+    for i0 in range(0, B, b_dp):
+        for e in range(tp):
+            sl = slice((i0 + e * g) * per_seq, (i0 + (e + 1) * g) * per_seq)
+            z = _moe_ffn(
+                h2d[sl],
+                params["moe_w1"][0, l, e],
+                params["moe_w2"][0, l, e],
+                cfg.mlp_kernel,
+                h2d.dtype,
+                scales=_ffn_scales(params, l, e, cfg),
+            )
+            u = u.at[sl].set(z)
+    return u
+
+
+def _block_moe(h2d, params, l, cfg, tp):
+    """Balanced per-sequence MoE on a tp-replicated ``[rows, D]`` slab:
+    activations are replicated over ``tp`` at decode (tensor-parallel
+    serving layout), so each rank slices ITS sequence block locally,
+    applies the resident expert, and an all-gather reassembles the batch
+    — the EP exchange degenerates from all-to-all to gather when the
+    dispatch side is replicated."""
+    rows, D = h2d.shape
+    g = rows // tp
+    my = jax.lax.axis_index("tp")
+    blk = jax.lax.dynamic_slice_in_dim(h2d, my * g, g, 0)  # [g, D]
+    z = _moe_ffn(
+        blk,
+        params["moe_w1"][0, l, 0],
+        params["moe_w2"][0, l, 0],
+        cfg.mlp_kernel,
+        h2d.dtype,
+        scales=_ffn_scales(params, l, 0, cfg),
+    )
+    return jax.lax.all_gather(z, "tp", axis=0, tiled=True)  # [rows, D]
+
+
+def make_decode_fn(mesh, cfg: TransformerConfig):
+    """One-token decode step over a ``('dp', 'tp')`` mesh.
+
+    Returns ``(decode_step, shardings)``: ``decode_step(params, cache,
+    tokens, pos) -> (logits, cache)`` with ``tokens [B]`` (this step's
+    token per sequence), ``pos`` a scalar int32 position, ``logits
+    [B, vocab]``; jit at the call site (cache threads through
+    functionally, so the step re-runs under a measurement loop).
+    """
+
+    tp = mesh.shape["tp"]
+    if cfg.attention != "gathered":
+        raise ValueError(
+            "decode supports attention='gathered' (heads sharded over tp); "
+            "ring/context-parallel decode is a training-side construction"
+        )
+    if cfg.n_heads % tp != 0:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    L = cfg.layers_per_stage
+    h_loc = cfg.n_heads // tp
+    dh = cfg.head_dim
+
+    def body(params, ck, cv, tokens, pos):
+        b = tokens.shape[0]  # local batch (B/dp)
+        if b % tp != 0:
+            raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
+        S_max = ck.shape[2]
+        x = params["embed"][tokens][:, None, :]  # [b, 1, D]
+        for l in range(L):
+            h = _rms_norm(x, params["ln1"][0, l])
+            q, k, v = _project_qkv(
+                h, params["w_qkv"][0, l], b, 1, h_loc, dh, x.dtype
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k[None], (l, 0, pos, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v[None], (l, 0, pos, 0, 0)
+            )
+            # q [b, 1, h, dh] against the whole cache row; positions past
+            # ``pos`` are masked (zeros in the cache never win anyway, but
+            # the mask keeps softmax exact)
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(jnp.float32) / np.sqrt(dh),
+                ck[l].astype(jnp.float32),
+            )  # [b, h, 1, S_max]
+            live = (
+                jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
+            )
+            s = jnp.where(live[None, None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd", p, cv[l].astype(jnp.float32)
+            ).astype(x.dtype)
+            part = jnp.matmul(
+                attn.reshape(b, 1, h_loc * dh),
+                params["w_o"][0, l],
+                preferred_element_type=jnp.float32,
+            )
+            y = jax.lax.psum(part, "tp").astype(x.dtype)  # heads partial
+            x = x + y
+            h2 = _rms_norm(x, params["ln2"][0, l])
+            u = _block_moe(h2.reshape(b, -1), params, l, cfg, tp)
+            x = x + u[:, None, :]
+        h = _rms_norm(x, params["ln_f"])
+        logits = jnp.matmul(
+            h[:, 0], params["head"], preferred_element_type=jnp.float32
+        )
+        return logits, ck, cv
+
+    from ddlb_tpu.models.transformer import param_specs
+
+    specs = dict(param_specs(cfg))
+    # decode topology: no pp axis in the mesh, heads over tp; the stage
+    # axis of the param stacks is size pp=1 and stays unsharded
+    specs = {
+        name: P(*[None if ax == "pp" else ax for ax in spec])
+        for name, spec in specs.items()
+    }
+    cspecs = cache_specs()
+
+    def step(params, cache, tokens, pos):
+        logits, ck, cv = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(specs, cspecs["k"], cspecs["v"], P("dp"), P()),
+            out_specs=(P("dp", None), cspecs["k"], cspecs["v"]),
+            check_vma=False,
+        )(params, cache["k"], cache["v"], tokens, pos)
+        return logits, {"k": ck, "v": cv}
+
+    shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    shardings["cache_k"] = NamedSharding(mesh, cspecs["k"])
+    shardings["cache_v"] = NamedSharding(mesh, cspecs["v"])
+    shardings["tokens"] = NamedSharding(mesh, P("dp"))
+    return step, shardings
+
+
+def make_prefill_fn(mesh, cfg: TransformerConfig):
+    """Full-sequence prompt pass over a ``('dp', 'tp')`` mesh: fills the
+    cache for positions ``[0, S)`` and returns the last position's logits.
+
+    Returns ``(prefill, shardings)``: ``prefill(params, cache, tokens) ->
+    (logits, cache)`` with ``tokens [B, S]``. The compute-bound serving
+    phase; attention here is the plain causal form over the prompt.
+    """
+
+    tp = mesh.shape["tp"]
+    if cfg.attention != "gathered":
+        raise ValueError("decode/prefill support attention='gathered' only")
+    L = cfg.layers_per_stage
+    h_loc = cfg.n_heads // tp
+    dh = cfg.head_dim
+
+    from ddlb_tpu.models.transformer import _causal_attention
+
+    def body(params, ck, cv, tokens):
+        b, S = tokens.shape
+        if b % tp != 0:
+            raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
+        x = params["embed"][tokens]  # [b, S, D]
+        for l in range(L):
+            h = _rms_norm(x, params["ln1"][0, l])
+            q, k, v = _project_qkv(
+                h, params["w_qkv"][0, l], b, S, h_loc, dh, x.dtype
+            )
+            ck = jax.lax.dynamic_update_slice(
+                ck, k[None], (l, 0, 0, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v[None], (l, 0, 0, 0, 0)
+            )
+            attn = _causal_attention(q, k, v).reshape(b, S, h_loc * dh)
+            part = jnp.matmul(
+                attn, params["w_o"][0, l], preferred_element_type=jnp.float32
+            )
+            x = x + jax.lax.psum(part, "tp").astype(x.dtype)
+            h2 = _rms_norm(x, params["ln2"][0, l])
+            # per-sequence expert assignment, identical to the decode step
+            # (rows are sequence-major, so each rank's block is its g
+            # whole sequences)
+            D = x.shape[-1]
+            u = _block_moe(h2.reshape(b * S, D), params, l, cfg, tp)
+            x = x + u.reshape(b, S, D)
+        h = _rms_norm(x, params["ln_f"])
+        logits = jnp.matmul(
+            h[:, -1], params["head"], preferred_element_type=jnp.float32
+        )
+        return logits, ck, cv
+
+    from ddlb_tpu.models.transformer import param_specs
+
+    specs = dict(param_specs(cfg))
+    specs = {
+        name: P(*[None if ax == "pp" else ax for ax in spec])
+        for name, spec in specs.items()
+    }
+    cspecs = cache_specs()
+
+    def prefill(params, cache, tokens):
+        logits, ck, cv = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(specs, cspecs["k"], cspecs["v"], P("dp", None)),
+            out_specs=(P("dp", None), cspecs["k"], cspecs["v"]),
+            check_vma=False,
+        )(params, cache["k"], cache["v"], tokens)
+        return logits, {"k": ck, "v": cv}
+
+    shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    shardings["tokens"] = NamedSharding(mesh, P("dp", None))
+    return prefill, shardings
+
+
+def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
+    """Single-program (no shard_map) decode and prefill formulations:
+    full-head attention, ``_routed_moe`` expert blocks, cache threading.
+
+    These carry no collectives — GSPMD inserts them from sharding
+    annotations when the returned callables are jitted over a mesh (the
+    transformer_decode xla_gspmd member), and they double as the oracle
+    building blocks. Returns ``(decode_fwd, prefill_fwd)`` with
+    ``decode_fwd(params, ck, cv, tokens, pos) -> logits`` and
+    ``prefill_fwd(params, ck, cv, tokens) -> (logits, ck, cv)``.
+    """
+    from ddlb_tpu.models.transformer import _causal_attention
+
+    B = batch
+    L, H, dh = cfg.layers_per_stage, cfg.n_heads, cfg.head_dim
+
+    def decode_fwd(params, ck, cv, tokens, pos):
+        x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+        for l in range(L):
+            h = _rms_norm(x, params["ln1"][0, l])
+            q, k, v = _project_qkv(
+                h, params["w_qkv"][0, l], B, 1, H, dh, x.dtype
+            )
+            ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, pos, 0, 0))
+            S_max = ck.shape[2]
+            s = jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q.astype(jnp.float32) / np.sqrt(dh),
+                ck[l].astype(jnp.float32),
+            )
+            live = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
+            s = jnp.where(live[None, None, None], s, -1e30)
+            attn = jnp.einsum(
+                "bhqk,bkhd->bqhd",
+                jax.nn.softmax(s, axis=-1),
+                cv[l].astype(jnp.float32),
+            ).astype(x.dtype)
+            x = x + jnp.matmul(
+                attn.reshape(B, 1, H * dh),
+                params["w_o"][0, l],
+                preferred_element_type=jnp.float32,
+            ).astype(x.dtype)
+            h2 = _rms_norm(x, params["ln2"][0, l])
+            u = _routed_moe(h2.reshape(B, -1), params, cfg, l, B, dp, tp)
+            x = x + u[:, None, :]
+        h = _rms_norm(x, params["ln_f"])
+        return jnp.matmul(
+            h[:, 0], params["head"], preferred_element_type=jnp.float32
+        )
+
+    def prefill_fwd(params, ck, cv, tokens):
+        B_, S = tokens.shape
+        x = params["embed"][tokens]
+        for l in range(L):
+            h = _rms_norm(x, params["ln1"][0, l])
+            q, k, v = _project_qkv(
+                h, params["w_qkv"][0, l], B_, S, H, dh, x.dtype
+            )
+            ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, 0, 0, 0))
+            attn = _causal_attention(q, k, v).reshape(B_, S, H * dh)
+            x = x + jnp.matmul(
+                attn, params["w_o"][0, l], preferred_element_type=jnp.float32
+            ).astype(x.dtype)
+            h2 = _rms_norm(x, params["ln2"][0, l])
+            u = _routed_moe(h2.reshape(B_ * S, -1), params, cfg, l, B, dp, tp)
+            x = x + u.reshape(B_, S, -1)
+        h = _rms_norm(x, params["ln_f"])
+        logits = jnp.matmul(
+            h[:, -1], params["head"], preferred_element_type=jnp.float32
+        )
+        return logits, ck, cv
+
+    return decode_fwd, prefill_fwd
+
+
+def reference_logits(
+    params, tokens, cfg: TransformerConfig, tp: int, dp: int
+) -> jax.Array:
+    """Single-device oracle: teacher-forced full forward, logits at the
+    LAST position ``[B, vocab]``.
+
+    Reproduces the decode semantics exactly: per-sequence-stable expert
+    assignment (sequence ``i`` of a dp shard uses expert
+    ``i // (B/(dp*tp))``), full-precision causal attention, the shared
+    ``_moe_ffn`` MLP kernels. The incremental cache path must match this
+    non-incremental formulation — the real consistency check.
+    """
+    from ddlb_tpu.models.transformer import _causal_attention
+
+    B, S = tokens.shape
+    L = cfg.layers_per_stage
+    x = params["embed"][tokens]  # [B, S, D]
+    D = cfg.d_model
+    for l in range(L):
+        h = _rms_norm(x, params["ln1"][0, l])
+        q, k, v = (
+            jnp.matmul(
+                h, params["w_qkv"][0, l][i], preferred_element_type=jnp.float32
+            )
+            .astype(x.dtype)
+            .reshape(B, S, cfg.n_heads, cfg.head_dim)
+            for i in range(3)
+        )
+        attn = _causal_attention(q, k, v).reshape(B, S, D)
+        x = x + jnp.matmul(
+            attn, params["w_o"][0, l], preferred_element_type=jnp.float32
+        ).astype(x.dtype)
+        h2 = _rms_norm(x, params["ln2"][0, l])
+        u = _routed_moe(h2.reshape(B * S, D), params, cfg, l, B, dp, tp)
+        x = x + u.reshape(B, S, D)
+    h = _rms_norm(x, params["ln_f"])
+    return jnp.matmul(
+        h[:, -1], params["head"], preferred_element_type=jnp.float32
+    )

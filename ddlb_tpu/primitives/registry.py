@@ -30,6 +30,7 @@ ALLOWED_PRIMITIVES = (
     "ep_alltoall",
     "pp_pipeline",
     "transformer_step",
+    "transformer_decode",
 )
 
 _REGISTRY = {
@@ -184,6 +185,22 @@ _REGISTRY = {
         "xla_gspmd": (
             "ddlb_tpu.primitives.transformer_step.xla_gspmd",
             "XLAGSPMDTransformerStep",
+        ),
+    },
+    # the serving regime: KV-cache decode / prefill (no reference
+    # analogue — the reference has neither model nor inference path)
+    "transformer_decode": {
+        "spmd": (
+            "ddlb_tpu.primitives.transformer_decode.spmd",
+            "SPMDTransformerDecode",
+        ),
+        "compute_only": (
+            "ddlb_tpu.primitives.transformer_decode.compute_only",
+            "ComputeOnlyTransformerDecode",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.transformer_decode.xla_gspmd",
+            "XLAGSPMDTransformerDecode",
         ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue

@@ -1,0 +1,5 @@
+"""Serving-step (KV-cache decode / prefill) primitive family."""
+
+from ddlb_tpu.primitives.transformer_decode.base import TransformerDecode
+
+__all__ = ["TransformerDecode"]

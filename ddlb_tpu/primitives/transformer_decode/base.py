@@ -1,0 +1,203 @@
+"""TransformerDecode: the flagship model's serving step as a primitive.
+
+The training-side composition is ``transformer_step``; this family
+measures the OTHER serving regime (no reference analogue — the reference
+has neither model nor inference path): autoregressive decode with a K/V
+cache, where one token per sequence attends a ``pos``-long cache and
+every step re-reads the cache and the weights — HBM-bandwidth-bound, so
+the interesting numbers are ms/token and tokens/s, not MFU.
+
+Shape mapping onto the ``(m, n, k)`` contract:
+
+- ``m``: context length — the cache fill at which the step is measured
+  (phase=decode) or the prompt length (phase=prefill)
+- ``n``: d_model
+- ``k``: d_ff
+
+``phase`` selects the serving phase: ``decode`` measures ONE cached step
+at position ``m`` (the steady-state per-token cost; the cache is
+prefilled once at init), ``prefill`` measures the full prompt pass that
+fills the cache (the compute-bound phase). The MLP kernel axis includes
+``int8_weights`` — decode takes no gradients, so the pre-quantized
+serving form is first-class here.
+
+Validation pins the step's logits to the single-device teacher-forced
+oracle (models/decode.reference_logits): the incremental cache path and
+the non-incremental full forward share no attention code, so agreement
+is a real consistency check, sharded vs unsharded.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ddlb_tpu.primitives.base import Primitive
+
+
+class TransformerDecode(Primitive):
+    """ABC for serving-step implementations."""
+
+    primitive_name = "transformer_decode"
+
+    BASE_OPTIONS = {
+        "phase": "decode",
+        "batch": 8,
+        "vocab": 512,
+        "n_heads": 8,
+        "layers": 1,
+        "mlp_kernel": "bf16",
+        "dp": 0,  # 0 = auto factorization of the device count
+        "tp": 0,
+    }
+    BASE_ALLOWED = {
+        "phase": ["decode", "prefill"],
+        "batch": (1, None),
+        "vocab": (2, None),
+        "n_heads": (1, None),
+        "layers": (1, None),
+        "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "dp": (0, None),
+        "tp": (0, None),
+    }
+
+    @property
+    def _call_args(self):
+        return self._args
+
+    def get_inputs(self):
+        return self._args
+
+    def _mesh_factors(self) -> Tuple[int, int]:
+        """(dp, tp) — explicit options or auto factorization (tp gets 2
+        when the head/batch divisibilities allow, dp the rest)."""
+        n = self.runtime.num_devices
+        dp, tp = self.options["dp"], self.options["tp"]
+        if dp and tp:
+            if dp * tp != n:
+                raise ValueError(f"dp*tp = {dp * tp} != {n} devices")
+            return dp, tp
+        if dp or tp:
+            raise ValueError("set both dp and tp or neither (0 = auto)")
+        o = self.options
+        tp = (
+            2
+            if n % 2 == 0
+            and o["n_heads"] % 2 == 0
+            and o["batch"] % n == 0
+            else 1
+        )
+        return n // tp, tp
+
+    def _check_shapes(self) -> None:
+        o = self.options
+        dp, tp = self._mesh_factors()
+        if self.n % o["n_heads"] != 0:
+            raise ValueError(
+                f"n={self.n} (d_model) must be divisible by "
+                f"n_heads={o['n_heads']}"
+            )
+        if o["n_heads"] % tp != 0:
+            raise ValueError(
+                f"n_heads={o['n_heads']} not divisible by tp={tp}"
+            )
+        if o["batch"] % dp != 0:
+            raise ValueError(f"batch={o['batch']} not divisible by dp={dp}")
+        if (o["batch"] // dp) % tp != 0:
+            raise ValueError(
+                f"per-dp batch {o['batch'] // dp} not divisible by tp={tp} "
+                f"(the MoE block router)"
+            )
+        if self.dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError("transformer_decode requires a floating dtype")
+
+    def flops(self) -> float:
+        """Matmul FLOPs of one measured call.
+
+        decode (per token): ``L * (8 D^2 + 4 m D + 4 D F) + 2 D V`` —
+        QKV+out-proj ``8 D^2``, attention against the m-long cache
+        ``4 m D`` (scores + values), the routed expert ``4 D F``, LM head
+        ``2 D V`` — times the batch. prefill: the causal full-sequence
+        census over the m prompt tokens (attention averages m/2 live
+        positions).
+        """
+        o = self.options
+        D, F = self.n, self.k
+        L, B, V = o["layers"], o["batch"], o["vocab"]
+        if o["phase"] == "decode":
+            per_token = L * (8.0 * D * D + 4.0 * self.m * D + 4.0 * D * F)
+            return B * (per_token + 2.0 * D * V)
+        per_token = L * (8.0 * D * D + 2.0 * self.m * D + 4.0 * D * F)
+        return B * self.m * per_token + B * 2.0 * D * V
+
+    def _model_config(self):
+        from ddlb_tpu.models.transformer import TransformerConfig
+        from ddlb_tpu.primitives.base import jnp_dtype
+
+        o = self.options
+        return TransformerConfig(
+            vocab=o["vocab"],
+            d_model=self.n,
+            n_heads=o["n_heads"],
+            d_ff=self.k,
+            layers_per_stage=o["layers"],
+            mlp_kernel=o["mlp_kernel"],
+            dtype=jnp_dtype(self.dtype),
+        )
+
+    def _host_tokens(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(prompt [B, m], next_token [B]) — seeded, host-deterministic."""
+        from ddlb_tpu.models.transformer import example_tokens
+
+        tokens, targets = example_tokens(
+            self.options["batch"], self.m, self.options["vocab"],
+            seed=self.seed,
+        )
+        return np.asarray(tokens), np.asarray(targets[:, -1])
+
+    def _oracle_logits(self) -> np.ndarray:
+        """Teacher-forced single-device logits at the measured position."""
+        from ddlb_tpu.models.decode import reference_logits
+        from ddlb_tpu.models.transformer import init_params
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+
+        cfg = self._model_config()
+        dp, tp = self._mesh_factors()
+        params = init_params(cfg, pp=1, n_experts=tp, seed=self.seed)
+        prompt, nxt = self._host_tokens()
+        if self.options["phase"] == "decode":
+            toks = np.concatenate([prompt, nxt[:, None]], axis=1)
+        else:
+            toks = prompt
+        with matmul_precision_scope(self.dtype):
+            import jax
+
+            return np.asarray(
+                jax.block_until_ready(
+                    reference_logits(params, toks, cfg, tp=tp, dp=dp)
+                )
+            )
+
+    def validate(self, result) -> bool:
+        """The measured call's logits must match the oracle's at the same
+        position (decode: position m; prefill: position m-1)."""
+        import jax
+
+        logits = result[0] if isinstance(result, (tuple, list)) else result
+        logits = np.asarray(jax.block_until_ready(logits), np.float32)
+        expected = self._oracle_logits().astype(np.float32)
+        atol = 1e-4 if self.dtype == "float32" else 2e-2
+        err = (
+            float(np.max(np.abs(logits - expected)))
+            if logits.shape == expected.shape
+            else float("inf")
+        )
+        ok = bool(np.isfinite(err)) and err <= atol
+        if not ok:
+            print(
+                f"[ddlb_tpu] validation FAILED for {type(self).__name__}: "
+                f"max|logit err|={err:.3e} > atol={atol:g} "
+                f"(shapes {logits.shape} vs {expected.shape})"
+            )
+        return ok

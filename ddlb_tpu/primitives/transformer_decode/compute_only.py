@@ -1,0 +1,29 @@
+"""Single-device serving roofline: the same decode/prefill step with no
+collectives.
+
+The model-level compute_only for the serving regime (family pattern:
+TPColumnwise/compute_only.py in the reference bounds the distributed
+implementations with an uncommunicated version): the identical cache
+path runs on a degenerate 1x1 mesh pinned to one device, bounding what
+the sharded step could achieve if every psum/all-gather were free.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.transformer_decode.spmd import SPMDTransformerDecode
+
+
+class ComputeOnlyTransformerDecode(SPMDTransformerDecode):
+    def _mesh_factors(self):
+        if self.options["dp"] or self.options["tp"]:
+            raise ValueError(
+                "compute_only ignores dp/tp: it always runs the 1x1 mesh"
+            )
+        return 1, 1
+
+    def _make_mesh(self, dp: int, tp: int):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = np.array(self.runtime.local_devices[:1]).reshape(1, 1)
+        return Mesh(devs, ("dp", "tp"))

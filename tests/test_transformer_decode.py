@@ -1,0 +1,128 @@
+"""Serving step: KV-cache decode/prefill vs the teacher-forced oracle.
+
+The incremental cache path and the non-incremental full forward share no
+attention code, so logit agreement (sharded vs single-device) is a real
+consistency check — the serving-side analogue of the train-step oracle
+pinning (tests/test_transformer.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.benchmark import benchmark_worker
+from ddlb_tpu.primitives.registry import load_impl_class
+
+M, N, K = 12, 32, 64  # context length, d_model, d_ff
+COMMON = dict(batch=8, vocab=64, n_heads=4)
+
+
+class TestModel:
+    def test_decode_loop_matches_oracle_and_prefill(self):
+        """Token-by-token decode from an empty cache == prefill+decode ==
+        the single-device oracle."""
+        from ddlb_tpu.models.decode import (
+            init_cache,
+            make_decode_fn,
+            make_prefill_fn,
+            reference_logits,
+        )
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64, layers_per_stage=2
+        )
+        dp, tp = 2, 4
+        mesh = jax.make_mesh((dp, tp), ("dp", "tp"))
+        decode, sh = make_decode_fn(mesh, cfg)
+        prefill, _ = make_prefill_fn(mesh, cfg)
+        params = init_params(cfg, pp=1, n_experts=tp)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        B, S = 8, 6
+        rng = np.random.default_rng(3)
+        toks = jnp.asarray(rng.integers(0, 64, (B, S + 1)), jnp.int32)
+        host = init_params(cfg, pp=1, n_experts=tp)
+        want = np.asarray(
+            reference_logits(host, np.asarray(toks), cfg, tp=tp, dp=dp)
+        )
+
+        cache = init_cache(cfg, B, 8, mesh)
+        dstep = jax.jit(decode)
+        for p in range(S + 1):
+            logits, cache = dstep(params, cache, toks[:, p], jnp.int32(p))
+        assert np.max(np.abs(np.asarray(logits) - want)) < 1e-4
+
+        cache2 = init_cache(cfg, B, 8, mesh)
+        _, cache2 = jax.jit(prefill)(params, cache2, toks[:, :S])
+        logits2, _ = dstep(params, cache2, toks[:, S], jnp.int32(S))
+        assert np.max(np.abs(np.asarray(logits2) - want)) < 1e-4
+
+    def test_ring_attention_rejected(self):
+        from ddlb_tpu.models.decode import make_decode_fn
+        from ddlb_tpu.models.transformer import TransformerConfig
+
+        mesh = jax.make_mesh((2, 4), ("dp", "tp"))
+        with pytest.raises(ValueError, match="gathered"):
+            make_decode_fn(mesh, TransformerConfig(attention="ring"))
+
+
+class TestPrimitive:
+    @pytest.mark.parametrize("phase", ["decode", "prefill"])
+    @pytest.mark.parametrize("impl", ["spmd", "compute_only", "xla_gspmd"])
+    def test_validates(self, impl, phase):
+        cls = load_impl_class("transformer_decode", impl)
+        prim = cls(M, N, K, dtype="float32", phase=phase, **COMMON)
+        assert prim.validate(prim.run())
+
+    @pytest.mark.parametrize(
+        "mlp_kernel", ["int8", "int8_weights"]
+    )
+    def test_int8_kernels_validate(self, mlp_kernel):
+        cls = load_impl_class("transformer_decode", "spmd")
+        prim = cls(
+            M, N, K, dtype="float32", mlp_kernel=mlp_kernel, **COMMON
+        )
+        assert prim.validate(prim.run())
+
+    def test_decode_iterations_are_identical(self):
+        """The measured decode call is re-runnable: the cache write is
+        discarded, so every iteration decodes the same position."""
+        cls = load_impl_class("transformer_decode", "spmd")
+        prim = cls(M, N, K, dtype="float32", **COMMON)
+        a = np.asarray(prim.run())
+        b = np.asarray(prim.run())
+        assert np.array_equal(a, b)
+
+    def test_mesh_factor_errors(self):
+        cls = load_impl_class("transformer_decode", "spmd")
+        with pytest.raises(ValueError, match="devices"):
+            cls(M, N, K, dtype="float32", dp=3, tp=2, **COMMON)
+        with pytest.raises(ValueError, match="n_heads"):
+            cls(M, N, K, dtype="float32", dp=1, tp=8, **COMMON)
+
+    def test_through_benchmark_worker(self):
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_0",
+                "base_implementation": "spmd",
+                "options": dict(COMMON),
+                "m": M,
+                "n": N,
+                "k": K,
+                "dtype": "float32",
+                "num_iterations": 2,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert not row["error"], row["error"]
+        assert row["valid"]
+        assert row["Throughput (TFLOPS)"] > 0
