@@ -1,0 +1,92 @@
+"""Sharded train-state checkpointing for the flagship model (orbax).
+
+The reference has no training state to checkpoint — its only resumable
+artifact is the sweep CSV (SURVEY.md section 5 "checkpoint/resume:
+none"), which this framework mirrors at the runner layer (``--resume``).
+This module adds the MODEL layer's counterpart: the (params, opt_state,
+step) train state saved and restored as sharded global arrays via orbax
+— each host writes only its addressable shards, restore places shards
+directly onto the target mesh (which may differ from the save-time
+mesh: orbax reshards on read), so the same checkpoint moves between
+topologies and the CPU sim.
+
+Deliberately thin over ``orbax.checkpoint``: the framework's value is
+the sharding-aware round-trip contract (tests pin save -> restore ->
+continue training == uninterrupted training, bitwise on the loss), not
+a re-implementation of checkpoint management.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+
+def save_checkpoint(
+    directory: str,
+    step: int,
+    params: Dict[str, Any],
+    opt_state: Any = None,
+    *,
+    force: bool = False,
+) -> str:
+    """Write the train state under ``directory/<step>``; returns the path.
+
+    Arrays may be sharded global jax.Arrays — every process must call
+    this collectively (orbax coordinates the multi-host write).
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    state = {"params": params}
+    if opt_state is not None:
+        state["opt_state"] = opt_state
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        path = os.path.join(directory, str(step))
+        ckptr.save(path, state, force=force)
+    return path
+
+
+def restore_checkpoint(
+    directory: str,
+    step: int,
+    like: Dict[str, Any],
+) -> Tuple[Dict[str, Any], Any]:
+    """Restore ``(params, opt_state)`` from ``directory/<step>``.
+
+    ``like`` is ``{"params": ..., "opt_state": ...}`` of abstract or
+    concrete arrays carrying the TARGET shardings (e.g. freshly
+    initialized state on the current mesh) — orbax reads each shard
+    straight onto its destination devices, resharding if the save-time
+    topology differed. ``opt_state`` may be omitted from ``like`` for
+    params-only restores.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding")
+        else x,
+        like,
+    )
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        state = ckptr.restore(os.path.join(directory, str(step)), abstract)
+    return state["params"], state.get("opt_state")
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Largest integer-named subdirectory of ``directory`` holding a
+    complete checkpoint, or None — the resume probe."""
+    directory = os.path.abspath(directory)
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.isdecimal():
+            # orbax writes atomically: an incomplete save stays under a
+            # temp name (non-decimal suffix), so a decimal-named dir is
+            # a complete checkpoint
+            steps.append(int(name))
+    return max(steps) if steps else None

@@ -549,7 +549,13 @@ def make_train_step(
     )
 
     def init_opt_state(params):
-        return optimizer.init(params)
+        # jitted inside the mesh context so every leaf (including the
+        # scalar step count) comes out committed with a mesh-wide
+        # sharding — an uncommitted single-device skeleton would pin
+        # checkpoint restores to one device (models/checkpoint.py places
+        # onto the target's sharding)
+        with jax.set_mesh(mesh):
+            return jax.jit(optimizer.init)(params)
 
     return train_step, init_opt_state, shardings
 
