@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Round-2 hardware measurement batch (run when the TPU relay is up).
+
+Covers the rows BASELINE.md still owes from this round's features, in
+one session so medians are comparable: the transformer forward-mode MLP
+A/B (bf16 / int8 STE / int8_weights), the serving family's decode
+ms/token vs context length (bf16 vs int8_weights) and prefill, and the
+ep_alltoall quantized member. Prints one summary line per config;
+append results to BASELINE.md by hand (pinned-protocol medians).
+
+Usage:  python scripts/measure_r2_hw.py [--quick]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ddlb_tpu.benchmark import benchmark_worker
+
+QUICK = "--quick" in sys.argv[1:]
+
+PROTO = {
+    "dtype": "bfloat16",
+    "num_iterations": 8,
+    "num_warmups": 2,
+    "validate": True,
+    "time_measurement_backend": "device_loop",
+    "device_loop_windows": 4 if QUICK else 8,
+    "barrier_at_each_iteration": False,
+}
+
+
+def run(primitive, impl, m, n, k, **options):
+    row = benchmark_worker(
+        {
+            "primitive": primitive,
+            "impl_id": f"{impl}_hw",
+            "base_implementation": impl,
+            "options": options,
+            "m": m,
+            "n": n,
+            "k": k,
+            **PROTO,
+        }
+    )
+    t = row["median time (ms)"]
+    print(
+        f"{primitive:18s} {impl:10s} m={m:<6d} {options} -> "
+        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
+        f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
+        f"err={row['error'] or '-'}",
+        flush=True,
+    )
+    return row
+
+
+MODEL = dict(batch=1, vocab=16384, n_heads=16, microbatches=1)
+
+# 1) forward-mode MLP kernel A/B at the 0.80-MFU shape
+for mlp in ("bf16", "int8", "int8_weights"):
+    run(
+        "transformer_step", "spmd", 4096, 2048, 8192,
+        mode="forward", mlp_kernel=mlp, attn_kernel="flash", **MODEL,
+    )
+
+# 2) serving: decode ms/token vs context length, bf16 vs int8_weights
+SERVE = dict(batch=8, vocab=16384, n_heads=16)
+for ctx in (1024, 4096) if QUICK else (1024, 4096, 8192):
+    for mlp in ("bf16", "int8_weights"):
+        run(
+            "transformer_decode", "spmd", ctx, 2048, 8192,
+            phase="decode", mlp_kernel=mlp, **SERVE,
+        )
+run("transformer_decode", "spmd", 1024, 2048, 8192, phase="prefill", **SERVE)
+
+# 3) ep_alltoall quantized vs jax_spmd at the canonical shape
+run("ep_alltoall", "jax_spmd", 8192, 8192, 8192)
+run("ep_alltoall", "quantized", 8192, 8192, 8192, quantize="static")
