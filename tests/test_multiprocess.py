@@ -71,6 +71,33 @@ print("CHILD_DCN_OK", row["world_size"], row["num_processes"])
 """
 
 
+_CHILD_QUANTIZED = r"""
+import os, sys
+from ddlb_tpu.benchmark import benchmark_worker
+
+# the int8-wire claim across a REAL process boundary: the all-gather
+# moves int8 shards + scales between the two processes and the result
+# still meets the quantization bound
+row = benchmark_worker({
+    "primitive": "tp_columnwise",
+    "impl_id": "quantized_0",
+    "base_implementation": "quantized",
+    "options": {"quantize": "dynamic"},
+    "m": 128, "n": 32, "k": 64,
+    "dtype": "bfloat16",
+    "num_iterations": 2,
+    "num_warmups": 1,
+    "validate": True,
+    "time_measurement_backend": "host_clock",
+    "barrier_at_each_iteration": True,
+    "profile_dir": None,
+})
+assert row["valid"], row
+assert row["world_size"] == 8, row
+print("CHILD_Q_OK", row["world_size"], row["num_processes"])
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -80,6 +107,11 @@ def _free_port() -> int:
 @pytest.mark.slow
 def test_two_process_world(tmp_path):
     _run_two_process(_CHILD, "CHILD_OK 8 2")
+
+
+@pytest.mark.slow
+def test_two_process_quantized_int8_wire(tmp_path):
+    _run_two_process(_CHILD_QUANTIZED, "CHILD_Q_OK 8 2")
 
 
 @pytest.mark.slow
