@@ -289,6 +289,31 @@ class TestModelInt8:
             float(loss), expected,
         )
 
+    def test_xla_gspmd_train_int8_validates(self):
+        """int8 STE autodiff composes with GSPMD auto-partitioning."""
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "gspmd_int8",
+                "base_implementation": "xla_gspmd",
+                "options": {"mlp_kernel": "int8", "batch": 4, "vocab": 64,
+                            "n_heads": 4},
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert not row["error"], row["error"]
+        assert row["valid"]
+
     def test_forward_int8_weights_matches_oracle(self):
         """The serving form: pre-quantized weight leaves, forward loss
         pins the oracle (both consume the same init_params output)."""
