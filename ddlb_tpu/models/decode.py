@@ -247,17 +247,23 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
 
     Returns ``(prefill, shardings)``: ``prefill(params, cache, tokens) ->
     (logits, cache)`` with ``tokens [B, S]``. The compute-bound serving
-    phase; attention here is the plain causal form over the prompt.
+    phase — so ``cfg.attn_kernel='flash'`` (the default) runs the prompt
+    attention on the Pallas flash kernels, exactly the long-S regime they
+    exist for; ``'einsum'`` keeps the HBM-score-matrix form for A/B.
     """
 
     tp = mesh.shape["tp"]
     if cfg.attention != "gathered":
         raise ValueError("decode/prefill support attention='gathered' only")
+    if cfg.attn_kernel not in ("flash", "einsum"):
+        raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
     L = cfg.layers_per_stage
     h_loc = cfg.n_heads // tp
     dh = cfg.head_dim
 
-    from ddlb_tpu.models.transformer import _causal_attention
+    from ddlb_tpu.models.transformer import _causal_attention, _flash_full
+
+    interpret = jax.default_backend() != "tpu"
 
     def body(params, ck, cv, tokens):
         b, S = tokens.shape
@@ -275,7 +281,12 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
             cv = jax.lax.dynamic_update_slice(
                 cv, v[None], (l, 0, 0, 0, 0)
             )
-            attn = _causal_attention(q, k, v).reshape(b, S, h_loc * dh)
+            if cfg.attn_kernel == "flash":
+                attn = _flash_full(q, k, v, interpret).reshape(
+                    b, S, h_loc * dh
+                )
+            else:
+                attn = _causal_attention(q, k, v).reshape(b, S, h_loc * dh)
             part = jnp.matmul(
                 attn, params["w_o"][0, l], preferred_element_type=jnp.float32
             )

@@ -222,6 +222,19 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _flash_block(S: int) -> int:
+    """Largest usable flash tile for sequence length ``S``: the whole
+    sequence when it fits one tile, else the largest power-of-two divisor
+    up to 1024 (the kernel requires the grid to divide S — an S like 1536
+    under a fixed min(1024, S) would fail deep in tracing)."""
+    if S <= 1024:
+        return S
+    b = 1
+    while b < 1024 and S % (b * 2) == 0:
+        b *= 2
+    return b
+
+
 def _flash_full(q, k, v, interpret):
     """Batched causal flash attention: [b, S, h, dh] -> [b, S, h, dh].
 
@@ -236,8 +249,8 @@ def _flash_full(q, k, v, interpret):
     o = flash_attention(
         merge(q), merge(k), merge(v),
         scale=1.0 / np.sqrt(dh),
-        block_q=min(1024, S),
-        block_kv=min(1024, S),
+        block_q=_flash_block(S),
+        block_kv=_flash_block(S),
         interpret=interpret,
     )
     return o.reshape(S, b, h, dh).transpose(1, 0, 2, 3)
@@ -256,8 +269,8 @@ def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
         axis_name=axis_name,
         axis_size=d,
         scale=1.0 / np.sqrt(dh),
-        block_q=min(1024, s_loc),
-        block_kv=min(1024, s_loc),
+        block_q=_flash_block(s_loc),
+        block_kv=_flash_block(s_loc),
         interpret=interpret,
     )
     return o.reshape(s_loc, b, h, dh).transpose(1, 0, 2, 3)

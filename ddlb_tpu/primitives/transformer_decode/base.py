@@ -48,6 +48,9 @@ class TransformerDecode(Primitive):
         "n_heads": 8,
         "layers": 1,
         "mlp_kernel": "bf16",
+        #: prefill attention engine (flash = the Pallas kernels; the
+        #: single-token decode step always uses the dense cache read)
+        "attn_kernel": "flash",
         "dp": 0,  # 0 = auto factorization of the device count
         "tp": 0,
     }
@@ -58,6 +61,7 @@ class TransformerDecode(Primitive):
         "n_heads": (1, None),
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "attn_kernel": ["flash", "einsum"],
         "dp": (0, None),
         "tp": (0, None),
     }
@@ -143,6 +147,7 @@ class TransformerDecode(Primitive):
             d_ff=self.k,
             layers_per_stage=o["layers"],
             mlp_kernel=o["mlp_kernel"],
+            attn_kernel=o["attn_kernel"],
             dtype=jnp_dtype(self.dtype),
         )
 

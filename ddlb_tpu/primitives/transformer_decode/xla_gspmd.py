@@ -17,6 +17,25 @@ from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 
 
 class XLAGSPMDTransformerDecode(GSPMDOptionsMixin, TransformerDecode):
+    # the single-program comparator keeps the einsum attention form (a
+    # Pallas custom call inside GSPMD auto-partitioning is not a
+    # supported composition): the member's DEFAULT records einsum — a
+    # schema-level truth, so CSV rows and resume keys agree — and an
+    # EXPLICIT flash request is rejected rather than silently measured
+    # as einsum under the flash label
+    DEFAULT_OPTIONS = {
+        **GSPMDOptionsMixin.DEFAULT_OPTIONS,
+        "attn_kernel": "einsum",
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if self.options["attn_kernel"] == "flash":
+            raise ValueError(
+                "xla_gspmd measures the einsum formulation; "
+                "attn_kernel='flash' applies to the spmd member"
+            )
+
     def _input_setup(self) -> None:
         import jax
         import jax.numpy as jnp

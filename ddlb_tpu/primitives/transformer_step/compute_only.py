@@ -15,6 +15,18 @@ from ddlb_tpu.primitives.transformer_step.base import TransformerStep
 
 
 class ComputeOnlyTransformerStep(TransformerStep):
+    # the roofline runs the oracle's einsum formulation (reference_loss):
+    # default and label say so (see xla_gspmd for the rationale)
+    DEFAULT_OPTIONS = {"attn_kernel": "einsum"}
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if self.options["attn_kernel"] == "flash":
+            raise ValueError(
+                "compute_only measures the einsum (reference_loss) "
+                "formulation; attn_kernel='flash' applies to the spmd member"
+            )
+
     def _input_setup(self) -> None:
         import jax
 

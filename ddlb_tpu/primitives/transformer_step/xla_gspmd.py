@@ -19,6 +19,23 @@ from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
 
 
 class XLAGSPMDTransformerStep(GSPMDOptionsMixin, TransformerStep):
+    # this member measures the oracle's einsum formulation
+    # (reference_loss): its DEFAULT records einsum so CSV rows and resume
+    # keys tell the truth, and an explicit flash request errors instead
+    # of silently measuring einsum under the flash label
+    DEFAULT_OPTIONS = {
+        **GSPMDOptionsMixin.DEFAULT_OPTIONS,
+        "attn_kernel": "einsum",
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if self.options["attn_kernel"] == "flash":
+            raise ValueError(
+                "xla_gspmd measures the einsum (reference_loss) "
+                "formulation; attn_kernel='flash' applies to the spmd member"
+            )
+
     def _input_setup(self) -> None:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -144,6 +144,32 @@ class TestPrimitive:
         )
         assert prim.validate(prim.run())
 
+    @pytest.mark.parametrize("attn_kernel", ["flash", "einsum"])
+    def test_prefill_attn_kernels_validate(self, attn_kernel):
+        """Both prefill attention engines meet the oracle bound; flash is
+        the default (prefill is the compute-bound long-S regime the
+        Pallas kernels exist for)."""
+        cls = load_impl_class("transformer_decode", "spmd")
+        prim = cls(M, N, K, dtype="float32", phase="prefill",
+                   attn_kernel=attn_kernel, **COMMON)
+        assert prim.validate(prim.run())
+
+    def test_gspmd_rejects_explicit_flash(self):
+        cls = load_impl_class("transformer_decode", "xla_gspmd")
+        with pytest.raises(ValueError, match="spmd member"):
+            cls(M, N, K, dtype="float32", attn_kernel="flash", **COMMON)
+        # the default-constructed comparator records the kernel it
+        # actually measures
+        prim = cls(M, N, K, dtype="float32", **COMMON)
+        assert prim.options["attn_kernel"] == "einsum"
+
+    def test_flash_prefill_non_pow2_context(self):
+        """m=24 (not a power of two): the flash tile falls back to the
+        largest divisor instead of failing deep in tracing."""
+        cls = load_impl_class("transformer_decode", "spmd")
+        prim = cls(24, N, K, dtype="float32", phase="prefill", **COMMON)
+        assert prim.validate(prim.run())
+
     def test_decode_iterations_are_identical(self):
         """The measured decode call is re-runnable: the cache write is
         discarded, so every iteration decodes the same position."""
