@@ -405,19 +405,33 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
     return decode_fwd, prefill_fwd
 
 
-def make_generate_fn(mesh, cfg: TransformerConfig, n_new: int):
-    """Greedy autoregressive generation, one jitted program.
+def make_generate_fn(
+    mesh, cfg: TransformerConfig, n_new: int, temperature: float = 0.0
+):
+    """Autoregressive generation, one jitted program.
 
-    Returns ``(generate, shardings)``: ``generate(params, cache, prompt)
-    -> tokens [B, S0 + n_new]`` — prefill the prompt, then ``n_new``
-    decode steps under ``lax.fori_loop`` (the whole loop compiles once;
-    the cache and the sampled token thread the carry), taking the argmax
-    at every step. The cache must hold ``S0 + n_new`` positions.
+    Returns ``(generate, shardings)``: ``generate(params, cache, prompt
+    [, key])  -> tokens [B, S0 + n_new]`` — prefill the prompt, then
+    ``n_new`` decode steps under ``lax.fori_loop`` (the whole loop
+    compiles once; the cache and the sampled token thread the carry).
+    ``temperature=0`` samples the argmax (greedy, no key needed);
+    ``temperature>0`` draws from ``softmax(logits / temperature)`` with a
+    per-step fold of the caller's PRNG key. The cache must hold
+    ``S0 + n_new`` positions.
     """
     decode, shardings = make_decode_fn(mesh, cfg)
     prefill, _ = make_prefill_fn(mesh, cfg)
 
-    def generate(params, cache, prompt):
+    def sample(logits, key, step):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            jax.random.fold_in(key, step), logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    def generate(params, cache, prompt, key=None):
+        if temperature > 0.0 and key is None:
+            raise ValueError("temperature > 0 sampling needs a PRNG key")
         B, S0 = prompt.shape
         S_max = cache["k"].shape[2]
         if S0 + n_new > S_max:
@@ -442,7 +456,7 @@ def make_generate_fn(mesh, cfg: TransformerConfig, n_new: int):
 
         def body(i, carry):
             tokens, cache, logits = carry
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B]
+            nxt = sample(logits, key, i)  # [B]
             tokens = jax.lax.dynamic_update_slice(
                 tokens, nxt[:, None], (0, S0 + i)
             )
@@ -456,7 +470,7 @@ def make_generate_fn(mesh, cfg: TransformerConfig, n_new: int):
         tokens, cache, logits = jax.lax.fori_loop(
             0, n_new - 1, body, (tokens, cache, logits)
         )
-        last = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        last = sample(logits, key, n_new - 1)
         return jax.lax.dynamic_update_slice(
             tokens, last[:, None], (0, S0 + n_new - 1)
         )

@@ -117,6 +117,40 @@ class TestModel:
         )
         assert np.array_equal(out[:, S0], want0)
 
+    def test_temperature_sampling(self):
+        """temperature>0 draws deterministically under a fixed key, stays
+        in-vocab, and requires a key; temperature=0 stays greedy."""
+        from ddlb_tpu.models.decode import init_cache, make_generate_fn
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            init_params,
+        )
+
+        cfg = TransformerConfig(vocab=64, d_model=32, n_heads=4, d_ff=64)
+        mesh = jax.make_mesh((2, 4), ("dp", "tp"))
+        gen_t, sh = make_generate_fn(mesh, cfg, 4, temperature=0.8)
+        params = init_params(cfg, pp=1, n_experts=4)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        rng = np.random.default_rng(11)
+        prompt = jnp.asarray(rng.integers(0, 64, (8, 5)), jnp.int32)
+        key = jax.random.PRNGKey(0)
+
+        cache = init_cache(cfg, 8, 9, mesh)
+        a = np.asarray(jax.jit(gen_t)(params, cache, prompt, key))
+        cache = init_cache(cfg, 8, 9, mesh)
+        b = np.asarray(jax.jit(gen_t)(params, cache, prompt, key))
+        assert np.array_equal(a, b)
+        assert a.min() >= 0 and a.max() < 64
+
+        cache = init_cache(cfg, 8, 9, mesh)
+        c = np.asarray(
+            jax.jit(gen_t)(params, cache, prompt, jax.random.PRNGKey(7))
+        )
+        assert not np.array_equal(a[:, 5:], c[:, 5:])  # key matters
+
+        with pytest.raises(ValueError, match="PRNG key"):
+            gen_t(params, init_cache(cfg, 8, 9, mesh), prompt)
+
     def test_ring_attention_rejected(self):
         from ddlb_tpu.models.decode import make_decode_fn
         from ddlb_tpu.models.transformer import TransformerConfig
