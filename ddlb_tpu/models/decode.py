@@ -419,6 +419,10 @@ def make_generate_fn(
     per-step fold of the caller's PRNG key. The cache must hold
     ``S0 + n_new`` positions.
     """
+    if n_new < 1:
+        # n_new=0 would write the post-loop sample at column S0-1,
+        # silently overwriting the last prompt token
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
     decode, shardings = make_decode_fn(mesh, cfg)
     prefill, _ = make_prefill_fn(mesh, cfg)
 

@@ -71,6 +71,33 @@ print("CHILD_DCN_OK", row["world_size"], row["num_processes"])
 """
 
 
+_CHILD_DECODE = r"""
+import os, sys
+from ddlb_tpu.benchmark import benchmark_worker
+
+# the serving step across a REAL process boundary: the KV cache shards
+# batch-over-dp/heads-over-tp across two processes; prefill fills it and
+# the measured decode validates against the teacher-forced oracle
+row = benchmark_worker({
+    "primitive": "transformer_decode",
+    "impl_id": "spmd_0",
+    "base_implementation": "spmd",
+    "options": {"batch": 8, "vocab": 64, "n_heads": 4, "dp": 2, "tp": 4},
+    "m": 8, "n": 32, "k": 64,
+    "dtype": "float32",
+    "num_iterations": 2,
+    "num_warmups": 1,
+    "validate": True,
+    "time_measurement_backend": "host_clock",
+    "barrier_at_each_iteration": True,
+    "profile_dir": None,
+})
+assert row["valid"], row
+assert row["world_size"] == 8, row
+print("CHILD_DEC_OK", row["world_size"], row["num_processes"])
+"""
+
+
 _CHILD_QUANTIZED = r"""
 import os, sys
 from ddlb_tpu.benchmark import benchmark_worker
@@ -112,6 +139,11 @@ def test_two_process_world(tmp_path):
 @pytest.mark.slow
 def test_two_process_quantized_int8_wire(tmp_path):
     _run_two_process(_CHILD_QUANTIZED, "CHILD_Q_OK 8 2")
+
+
+@pytest.mark.slow
+def test_two_process_serving_decode(tmp_path):
+    _run_two_process(_CHILD_DECODE, "CHILD_DEC_OK 8 2")
 
 
 @pytest.mark.slow
