@@ -10,10 +10,17 @@ recorded ``rc=1`` with no number):
   It probes the backend in a subprocess with a hard timeout and retries,
   then runs the measurement worker in another subprocess with its own
   timeout. If the probe or the worker fails, hangs, or emits nothing
-  parseable, the parent re-runs the worker on the CPU platform at a smoke
-  shape and tags the row with ``fallback_reason``. It always prints
-  exactly one JSON line and always exits 0 — mirroring the reference's
-  soft-failure stance (/root/reference/ddlb/benchmark.py:242-245).
+  parseable, the parent falls back — first to the most recent CACHED TPU
+  headline (every successful TPU measurement is persisted to
+  ``bench_tpu_cache.json`` with a timestamp and the protocol it ran
+  under; the emitted row carries ``"cached": true``, ``"captured_at"``
+  and ``fallback_reason`` so its provenance is explicit), then to
+  re-running the worker on the CPU platform at a smoke shape. It always
+  prints exactly one JSON line and always exits 0 — mirroring the
+  reference's soft-failure stance
+  (/root/reference/ddlb/benchmark.py:242-245). The cache layer exists
+  because the TPU relay goes down for hours at a time: a relay outage at
+  capture time becomes a provenance note instead of evidence loss.
 - the WORKER (``--worker``) runs the framework's own measurement path
   (benchmark_worker) at the reference's canonical 8192^3 shape
   (/root/reference/scripts/config.json:3-7; bf16 on TPU) and reports the
@@ -64,6 +71,8 @@ BENCH_PROTOCOL = {
     "num_warmups": BENCH_WARMUPS,
     "time_measurement_backend": "device_loop",
     "barrier_at_each_iteration": False,
+    # the pinned BASELINE.md methodology: median of 8 device_loop windows
+    "device_loop_windows": 8,
 }
 DEFAULT_SHAPE = "8192,8192,8192"
 SMOKE_SHAPE = "1024,1024,1024"
@@ -78,6 +87,41 @@ _PROBE_CODE = (
     "print('PROBE_OK', r.platform, r.num_devices, flush=True)"
 )
 _REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+#: committed results cache: the most recent successful TPU headline rows,
+#: newest last (the third fallback layer — see module docstring)
+CACHE_PATH = os.path.join(_REPO_DIR, "bench_tpu_cache.json")
+_CACHE_KEEP = 10
+
+
+def _save_tpu_cache(row: dict) -> None:
+    """Append a successful TPU headline to the on-disk cache (best effort:
+    a cache write failure must never take down the headline print)."""
+    try:
+        entries = _load_tpu_cache()
+        entry = dict(row)
+        entry["captured_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        entry["protocol"] = dict(BENCH_PROTOCOL)
+        entries.append(entry)
+        # atomic replace: a kill mid-write (driver timeout under a relay
+        # stall) must not truncate the history this layer exists to keep
+        tmp = CACHE_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(entries[-_CACHE_KEEP:], f, indent=1)
+            f.write("\n")
+        os.replace(tmp, CACHE_PATH)
+    except Exception as exc:  # pragma: no cover - disk failure
+        print(f"[bench] cache write failed: {exc}", file=sys.stderr)
+
+
+def _load_tpu_cache() -> list:
+    try:
+        with open(CACHE_PATH) as f:
+            entries = json.load(f)
+        return entries if isinstance(entries, list) else []
+    except Exception:
+        return []
 
 
 def _env_float(name: str, default: float) -> float:
@@ -214,9 +258,36 @@ def _main_guarded() -> None:
     else:
         row, reason = _run_worker(env, worker_timeout)
         if row is not None:
+            if row.get("platform") == "tpu" and row.get("valid"):
+                _save_tpu_cache(row)
             print(json.dumps(row), flush=True)
             return
         fallback_reason = f"measurement on {platform} failed ({reason})"
+
+    # Second layer: the most recent cached TPU headline, provenance-tagged
+    # (VERDICT r2 next-round #1 — a relay outage at capture time must not
+    # erase already-captured on-chip evidence). The row keeps its original
+    # platform/"valid"/protocol fields and gains explicit cache markers.
+    if not env.get("DDLB_TPU_BENCH_NO_CACHE"):
+        cached = _load_tpu_cache()
+        shape_override = env.get("DDLB_TPU_BENCH_SHAPE")
+        if shape_override:
+            # only a row measured at the requested shape may stand in for
+            # it (metric format: "{label}_{m}x{k}x{n}_{dtype}")
+            m, n, k = (int(v) for v in shape_override.split(","))
+            tag = f"_{m}x{k}x{n}_"
+            cached = [e for e in cached if tag in str(e.get("metric", ""))]
+        if cached:
+            entry = dict(cached[-1])
+            entry["cached"] = True
+            entry["fallback_reason"] = fallback_reason
+            print(
+                f"[bench] {fallback_reason}; emitting cached TPU headline "
+                f"captured {entry.get('captured_at')}",
+                file=sys.stderr,
+            )
+            print(json.dumps(entry), flush=True)
+            return
 
     # CPU-sim fallback at a smoke shape so the driver still gets a real
     # measured number from the same code path. DDLB_TPU_SIM_DEVICES=1 is
@@ -260,8 +331,10 @@ def _main_guarded() -> None:
 
 def _rank(r):
     # Error rows carry NaN times, which would win a plain min() — rank
-    # them last explicitly.
-    t = r.get("mean time (ms)", float("nan"))
+    # them last explicitly. Ranked (and later reported) by the MEDIAN,
+    # the pinned BASELINE.md statistic: robust to the relay's cold/
+    # congested-window outliers, which skew a mean.
+    t = r.get("median time (ms)", float("nan"))
     bad = r.get("error") or not isinstance(t, float) or math.isnan(t)
     return float("inf") if bad else t
 
@@ -317,9 +390,13 @@ def _bench_int8_extra(m, n, k, n_dev):
         result = impl.run()
     fence(result)
     fn, args = impl.timed_call()
-    windows = measure_device_loop(fn, args, BENCH_ITERATIONS)
-    mean_ms = float(np.mean(windows))
-    tops = 2.0 * m * n * k / 1e9 / mean_ms
+    windows = measure_device_loop(
+        fn, args, BENCH_ITERATIONS,
+        num_windows=BENCH_PROTOCOL["device_loop_windows"],
+    )
+    # median of the window vector — the pinned BASELINE.md statistic
+    med_ms = float(np.median(windows))
+    tops = 2.0 * m * n * k / 1e9 / med_ms
     err = _device_oracle_err(impl)
     valid = bool(np.isfinite(err)) and err <= quantization_atol(k)
     return {
@@ -446,7 +523,10 @@ def worker_main() -> None:
         print(f"[bench] validation errored: {type(exc).__name__}: {exc}")
         valid = False
 
-    tflops = row["Throughput (TFLOPS)"]
+    # headline from the MEDIAN window (the pinned BASELINE.md statistic);
+    # the worker's "Throughput (TFLOPS)" column is the mean-based runner
+    # convention and stays for the CSV path
+    tflops = 2.0 * m * n * k / 1e9 / row["median time (ms)"]
     # roofline fraction only means something against the chip peak; on the
     # cpu platform (sim) report 0.0 so the driver never records a bogus
     # "MXU fraction" from a host GEMM
@@ -460,6 +540,7 @@ def worker_main() -> None:
         "value": round(tflops, 2),
         "unit": "TFLOPS",
         "vs_baseline": vs_baseline,
+        "median_ms": round(row["median time (ms)"], 4),
         "mean_ms": round(row["mean time (ms)"], 4),
         "std_ms": round(row["std time (ms)"], 4),
         "world_size": row["world_size"],

@@ -1,0 +1,33 @@
+#!/bin/bash
+# TPU relay watcher: probe until the backend answers, then immediately run
+# the owed hardware measurement batch and a live bench.py, logging to
+# hwlogs/. Detached via nohup so a long relay outage costs nothing but a
+# probe every few minutes. One-shot: exits after a successful capture.
+#
+# Usage: mkdir -p hwlogs && nohup bash scripts/tpu_watch.sh > hwlogs/watch.log 2>&1 &
+
+cd "$(dirname "$0")/.." || exit 1
+mkdir -p hwlogs
+
+PROBE='from ddlb_tpu.runtime import Runtime; r = Runtime(); print("PROBE_OK", r.platform, r.num_devices, flush=True)'
+
+while true; do
+    ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    out=$(timeout 90 python -c "$PROBE" 2>&1)
+    if echo "$out" | grep -q "PROBE_OK tpu"; then
+        echo "[$ts] relay UP: $out"
+        echo "[$ts] running measure_r2_hw.py..."
+        timeout 3600 python scripts/measure_r2_hw.py \
+            > hwlogs/measure_r2_hw.out 2> hwlogs/measure_r2_hw.err
+        echo "[$ts] measure_r2_hw rc=$?"
+        ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+        echo "[$ts] running bench.py..."
+        timeout 3600 python bench.py \
+            > hwlogs/bench_live.out 2> hwlogs/bench_live.err
+        echo "[$ts] bench rc=$?"
+        echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ)" > hwlogs/CAPTURED
+        exit 0
+    fi
+    echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
+    sleep 240
+done
