@@ -270,11 +270,16 @@ def _main_guarded() -> None:
     # platform/"valid"/protocol fields and gains explicit cache markers.
     if not env.get("DDLB_TPU_BENCH_NO_CACHE"):
         cached = _load_tpu_cache()
-        shape_override = env.get("DDLB_TPU_BENCH_SHAPE")
-        if shape_override:
-            # only a row measured at the requested shape may stand in for
-            # it (metric format: "{label}_{m}x{k}x{n}_{dtype}")
-            m, n, k = (int(v) for v in shape_override.split(","))
+        # only a row measured at the effective shape (override or the
+        # canonical default) may stand in for it (metric format:
+        # "{label}_{m}x{k}x{n}_{dtype}"); a malformed override must fall
+        # through to the CPU smoke layer, not crash the orchestrator
+        shape = env.get("DDLB_TPU_BENCH_SHAPE", DEFAULT_SHAPE)
+        try:
+            m, n, k = (int(v) for v in shape.split(","))
+        except ValueError:
+            cached = []
+        else:
             tag = f"_{m}x{k}x{n}_"
             cached = [e for e in cached if tag in str(e.get("metric", ""))]
         if cached:

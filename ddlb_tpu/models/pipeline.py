@@ -1,0 +1,308 @@
+"""1F1B pipeline training for the flagship model: manual-vjp schedule.
+
+The GPipe path (models/transformer.py:make_train_step) differentiates the
+whole pipelined forward loop with ``jax.value_and_grad`` — autodiff then
+REVERSES the loop, which is exactly GPipe's all-forwards-then-all-
+backwards schedule, with every microbatch's stage input live across the
+flush (O(microbatches) stash per device).
+
+This module runs the **1F1B schedule instead**: forward and backward
+ticks interleave per the host-precomputed dense tables of
+``utils/pipeline_schedule.py`` (the same tables the ``pp_pipeline``
+``schedules`` member executes), and the backward of each (microbatch,
+stage) is taken explicitly with ``jax.vjp`` of the rematerialized
+``stage_fn`` at its stashed INPUT — so the activation stash is a static
+buffer of ``O(pipeline depth)`` slots, not ``O(microbatches)``: 1F1B's
+memory story realized as smaller allocated buffer shapes.
+
+Design notes (TPU/XLA):
+- one traced program; per-tick behavior is ``lax.switch`` on the gathered
+  table entry for this device's ``pp`` coordinate. The stage body (with
+  its tp collectives) sits INSIDE the switch branches; every participant
+  of those collectives shares the same ``pp`` coordinate and therefore
+  the same branch, so the collective groups never diverge. Activation /
+  cotangent hops ride ``ppermute`` OUTSIDE the switch, once per tick.
+- the LM-head tail (ln_f + head + CE) is collective-free, so its
+  forward (loss) and vjp (the backward's seed cotangent) run under a
+  last-stage ``lax.cond`` — the same safe-divergence pattern the GPipe
+  loop uses for its tail.
+- gradients of tp/pp-sharded params come out of the stage vjp already
+  correct per shard (the transposed collectives do the cross-tp
+  reduction); replicated params are psum-reduced over every mesh axis
+  their spec does not shard, which is the generic manual-SPMD rule.
+
+No reference analogue: the reference has neither model nor pipeline
+schedules (SURVEY.md section 2.5); the schedule-depth ambition mirrors
+its overlap schedules (fuser.py:59-146) applied to PP.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.models.transformer import (
+    TransformerConfig,
+    _ce_loss,
+    _rms_norm,
+    make_stage_fn,
+    param_specs,
+)
+from ddlb_tpu.utils.pipeline_schedule import build_schedule
+
+
+def _tail_loss(y, ln_f, head, tgt):
+    """Last-stage tail on a local slab: ln_f + LM head + token CE."""
+    h = _rms_norm(y, ln_f)
+    logits = jnp.matmul(h, head, preferred_element_type=jnp.float32)
+    return _ce_loss(logits, tgt)
+
+
+def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
+    """Build ``fn(params, tokens, targets) -> (loss, grads)`` running the
+    1F1B schedule over the ``('dp', 'tp', 'pp')`` mesh.
+
+    Returns ``(fn, shardings)``; jit at the call site. ``grads`` is a
+    pytree matching ``params`` (sharded identically), produced WITHOUT
+    ``jax.grad`` of the loop — each backward tick applies the stage vjp
+    explicitly, per the schedule tables.
+    """
+    dp, tp, pp = mesh.shape["dp"], mesh.shape["tp"], mesh.shape["pp"]
+    mb = cfg.microbatches
+    specs = param_specs(cfg)
+    if cfg.mlp_kernel == "int8_weights":
+        raise ValueError(
+            "1F1B is a training schedule; int8_weights is forward-only"
+        )
+    interpret = jax.default_backend() != "tpu"
+    stage_fn = make_stage_fn(cfg, tp, interpret)
+    tables = build_schedule("1f1b", pp, mb)
+    T = {
+        name: jnp.asarray(getattr(tables, name))
+        for name in ("kind", "mb", "act_slot", "in_slot",
+                     "fwd_land", "bwd_land")
+    }
+    n_act = tables.act_slots + 1
+    n_land = tables.land_slots + 1
+    D = cfg.d_model
+
+    def body(params, tokens, targets):
+        p_tp = jax.lax.axis_index("tp")
+        p_pp = jax.lax.axis_index("pp")
+        B_loc, S = tokens.shape
+        if B_loc % mb != 0:
+            raise ValueError(
+                f"per-dp-rank batch {B_loc} not divisible by microbatches={mb}"
+            )
+        if S % tp != 0:
+            raise ValueError(f"sequence {S} not divisible by tp={tp}")
+        s_loc = S // tp
+        b_mb = B_loc // mb
+        ring_r = [(i, (i + 1) % pp) for i in range(pp)]
+        ring_l = [(i, (i - 1) % pp) for i in range(pp)]
+        # total loss = mean over (mb, dp ranks, tp seq shards); each
+        # microbatch tail therefore back-propagates with this cotangent
+        cot = 1.0 / (mb * dp * tp)
+
+        def mb_slab(arr, i):
+            sl = jax.lax.dynamic_slice_in_dim(arr, i * b_mb, b_mb, 0)
+            return jax.lax.dynamic_slice_in_dim(sl, p_tp * s_loc, s_loc, 1)
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), params
+        )
+        act = jnp.zeros((n_act, b_mb, s_loc, D), cfg.dtype)
+        fland = jnp.zeros((n_land, b_mb, s_loc, D), cfg.dtype)
+        bland = jnp.zeros((n_land, b_mb, s_loc, D), cfg.dtype)
+        fwd_arr = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
+        bwd_arr = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
+        loss_acc = jnp.zeros((), jnp.float32)
+        grads = zero_grads
+
+        def sl(slot, cap):
+            return jnp.where(slot < 0, cap - 1, slot)
+
+        for t in range(tables.ticks):
+            fland = jax.lax.dynamic_update_slice(
+                fland, fwd_arr[None],
+                (sl(T["fwd_land"][t, p_pp], n_land), 0, 0, 0),
+            )
+            bland = jax.lax.dynamic_update_slice(
+                bland, bwd_arr[None],
+                (sl(T["bwd_land"][t, p_pp], n_land), 0, 0, 0),
+            )
+            kind = T["kind"][t, p_pp]
+            i = jnp.maximum(T["mb"][t, p_pp], 0)
+            aslot = sl(T["act_slot"][t, p_pp], n_act)
+            islot = sl(T["in_slot"][t, p_pp], n_land)
+
+            def fwd_branch(act, fland, bland, loss_acc, grads):
+                tok = mb_slab(tokens, i)
+                inject = params["embed"][tok].astype(cfg.dtype)
+                landed = jax.lax.dynamic_index_in_dim(
+                    fland, islot, axis=0, keepdims=False
+                )
+                x_in = jnp.where(p_pp == 0, inject, landed)
+                y = stage_fn(x_in, params)
+                act_n = jax.lax.dynamic_update_slice(
+                    act, x_in[None], (aslot, 0, 0, 0)
+                )
+                # collective-free tail under the last-stage cond (the
+                # GPipe loop's safe-divergence pattern)
+                loss_i = jax.lax.cond(
+                    p_pp == pp - 1,
+                    lambda yy: _tail_loss(
+                        yy, params["ln_f"], params["head"], mb_slab(targets, i)
+                    ),
+                    lambda yy: jnp.zeros((), jnp.float32),
+                    y,
+                )
+                send_f = jnp.where(p_pp == pp - 1, jnp.zeros_like(y), y)
+                return (
+                    act_n, fland, bland, loss_acc + loss_i, grads,
+                    send_f, jnp.zeros_like(y),
+                )
+
+            def bwd_branch(act, fland, bland, loss_acc, grads):
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    act, aslot, axis=0, keepdims=False
+                )
+                # rematerializing vjp: stage_fn is checkpointed, so this
+                # recomputes the stage forward then backs through it —
+                # the physical ~2x-forward backward tick
+                y, pull = jax.vjp(stage_fn, x_saved, params)
+
+                def tail_seed(yy):
+                    # d(total loss)/dy at the last stage, plus the tail's
+                    # own param grads (ln_f, head); collective-free
+                    tgt = mb_slab(targets, i)
+
+                    def tl(yy_, lnf, hd):
+                        return _tail_loss(yy_, lnf, hd, tgt)
+
+                    _, tpull = jax.vjp(
+                        tl, yy, params["ln_f"], params["head"]
+                    )
+                    g_y, d_lnf, d_head = tpull(jnp.asarray(cot, jnp.float32))
+                    return g_y.astype(cfg.dtype), d_lnf, d_head
+
+                def mid_seed(yy):
+                    landed = jax.lax.dynamic_index_in_dim(
+                        bland, islot, axis=0, keepdims=False
+                    )
+                    return (
+                        landed,
+                        jnp.zeros_like(params["ln_f"]),
+                        jnp.zeros_like(params["head"]),
+                    )
+
+                g_y, d_lnf, d_head = jax.lax.cond(
+                    p_pp == pp - 1, tail_seed, mid_seed, y
+                )
+                dx, dparams = pull(g_y)
+                # embed backward at stage 0: scatter-add dx at the token
+                # ids (collective-free)
+                tok = mb_slab(tokens, i)
+                d_embed = jax.lax.cond(
+                    p_pp == 0,
+                    lambda dxx: jnp.zeros(
+                        params["embed"].shape, jnp.float32
+                    ).at[tok].add(dxx.astype(jnp.float32)),
+                    lambda dxx: jnp.zeros(params["embed"].shape, jnp.float32),
+                    dx,
+                )
+                gr = {
+                    name: grads[name] + dparams[name].astype(jnp.float32)
+                    for name in grads
+                }
+                gr["embed"] = gr["embed"] + d_embed
+                gr["ln_f"] = grads["ln_f"] + d_lnf.astype(jnp.float32)
+                gr["head"] = grads["head"] + d_head.astype(jnp.float32)
+                send_b = jnp.where(p_pp == 0, jnp.zeros_like(dx), dx)
+                send_b = send_b.astype(cfg.dtype)
+                return (
+                    act, fland, bland, loss_acc, gr,
+                    jnp.zeros_like(send_b), send_b,
+                )
+
+            def idle_branch(act, fland, bland, loss_acc, grads):
+                z = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
+                return act, fland, bland, loss_acc, grads, z, z
+
+            (act, fland, bland, loss_acc, grads, send_f, send_b) = (
+                jax.lax.switch(
+                    kind,
+                    [idle_branch, fwd_branch, bwd_branch],
+                    act, fland, bland, loss_acc, grads,
+                )
+            )
+            if pp > 1:
+                fwd_arr = jax.lax.ppermute(send_f, "pp", perm=ring_r)
+                bwd_arr = jax.lax.ppermute(send_b, "pp", perm=ring_l)
+            else:
+                fwd_arr, bwd_arr = send_f, send_b
+
+        # stage vjps applied a 'cot'-scaled seed per microbatch; the
+        # remaining reductions are the generic manual-SPMD rule: psum a
+        # grad over every mesh axis its param spec does NOT shard
+        # (dp always; tp for tp-replicated leaves; pp for the shared
+        # embed/ln_f/head, whose contributions live on one stage)
+        loss = jax.lax.psum(loss_acc / mb, "pp")
+        loss = jax.lax.psum(loss, "dp") / dp
+        loss = jax.lax.psum(loss, "tp") / tp
+        out_grads = {}
+        for name, g in grads.items():
+            spec_axes = set(a for a in specs[name] if a is not None)
+            for ax in ("dp", "tp", "pp"):
+                if ax not in spec_axes:
+                    g = jax.lax.psum(g, ax)
+            out_grads[name] = g.astype(params[name].dtype)
+        return loss, out_grads
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(specs, P("dp", None), P("dp", None)),
+        out_specs=(P(), specs),
+        check_vma=False,
+    )
+    shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    shardings["data"] = NamedSharding(mesh, P("dp", None))
+    return fn, shardings
+
+
+def make_train_step_1f1b(
+    mesh,
+    cfg: TransformerConfig,
+    learning_rate: float = 1e-2,
+    donate: bool = True,
+):
+    """Full 1F1B training step: the drop-in counterpart of
+    ``models.transformer.make_train_step`` (same returns, same shardings)
+    with the schedule swapped from autodiff-GPipe to table-driven 1F1B."""
+    import optax
+
+    if cfg.mlp_kernel == "int8_weights":
+        raise ValueError(
+            "mlp_kernel='int8_weights' is the forward-only serving form; "
+            "train with mlp_kernel='int8' (STE) instead"
+        )
+    optimizer = optax.adamw(learning_rate)
+    loss_and_grads, shardings = make_loss_and_grads_1f1b(mesh, cfg)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = loss_and_grads(params, tokens, targets)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    train_step = (
+        jax.jit(step, donate_argnums=(0, 1)) if donate else jax.jit(step)
+    )
+
+    def init_opt_state(params):
+        with jax.set_mesh(mesh):
+            return jax.jit(optimizer.init)(params)
+
+    return train_step, init_opt_state, shardings

@@ -326,26 +326,19 @@ def _ce_loss(logits, targets):
     return -jnp.mean(picked)
 
 
-def make_loss_fn(mesh, cfg: TransformerConfig):
-    """Build the shard_mapped loss of the flagship model over a
-    ``('dp', 'tp', 'pp')`` mesh.
-
-    Returns ``(loss_fn, shardings)``: ``loss_fn(params, tokens, targets) ->
-    scalar`` (differentiable; jit at the call site) and ``shardings`` maps
-    param names plus ``'data'`` to ``NamedSharding``s for ``device_put``.
-    """
-    dp = mesh.shape["dp"]
-    tp = mesh.shape["tp"]
-    pp = mesh.shape["pp"]
-    mb = cfg.microbatches
+def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
+    """Build the per-stage block body ``stage_fn(x, sp) -> x`` shared by
+    the GPipe loss loop and the 1F1B manual-vjp loop (models/pipeline.py):
+    this stage's L transformer blocks on a local activation slab
+    ``[b, S/tp, d_model]`` with the tp/sp/ep collectives inside. Wrapped
+    in ``jax.checkpoint`` (PP-standard per-stage remat) so a backward
+    through it stashes only the stage INPUT — which is exactly the
+    quantity 1F1B's memory story counts."""
     L = cfg.layers_per_stage
-    specs = param_specs(cfg)
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
     if cfg.mlp_kernel not in ("bf16", "int8", "int8_weights"):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
-    # pallas kernels run compiled on TPU, interpreted elsewhere (CPU sim)
-    interpret = jax.default_backend() != "tpu"
 
     def stage_fn(x, sp):
         """Apply this stage's L transformer blocks to a local activation
@@ -441,7 +434,25 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
             x = x + u.reshape(b, s_loc, D)
         return x
 
-    stage_fn = jax.checkpoint(stage_fn)  # PP-standard per-stage remat
+    return jax.checkpoint(stage_fn)  # PP-standard per-stage remat
+
+
+def make_loss_fn(mesh, cfg: TransformerConfig):
+    """Build the shard_mapped loss of the flagship model over a
+    ``('dp', 'tp', 'pp')`` mesh.
+
+    Returns ``(loss_fn, shardings)``: ``loss_fn(params, tokens, targets) ->
+    scalar`` (differentiable; jit at the call site) and ``shardings`` maps
+    param names plus ``'data'`` to ``NamedSharding``s for ``device_put``.
+    """
+    dp = mesh.shape["dp"]
+    tp = mesh.shape["tp"]
+    pp = mesh.shape["pp"]
+    mb = cfg.microbatches
+    specs = param_specs(cfg)
+    # pallas kernels run compiled on TPU, interpreted elsewhere (CPU sim)
+    interpret = jax.default_backend() != "tpu"
+    stage_fn = make_stage_fn(cfg, tp, interpret)
 
     def loss_body(params, tokens, targets):
         """shard_map body. tokens/targets: [B/dp, S] int32 (dp-sharded,

@@ -1,0 +1,268 @@
+"""Training-schedule pipeline member: GPipe / 1F1B / interleaved-1F1B.
+
+The forward-only members measure the activation-passing pattern; this
+member measures the **training schedule problem** — the reason 1F1B and
+interleaving exist. Each microbatch flows forward through the stage chain
+and then backward (cotangent of ``L = sum(y)``), producing real per-stage
+weight gradients, so a backward tick physically does the two matmuls
+(``dW += x^T g`` and ``g_out = g W^T``) that make it ~2x a forward tick.
+
+The schedule itself is not built from runtime queues (XLA traces one
+program) but from the host-precomputed dense tables of
+``utils/pipeline_schedule.py``: at tick ``t`` every device gathers its row
+``tables[t, my_index]`` and executes one of three branches under
+``lax.switch`` — idle, forward, backward — with every buffer slot index
+coming from the same tables. Static shapes, compiler-friendly control
+flow, hand-designed schedule: the TPU-native analogue of the reference's
+hand-written overlap schedules
+(/root/reference/ddlb/primitives/TPColumnwise/fuser.py:59-146) applied to
+pipeline parallelism.
+
+Communication stays one-ICI-neighbor per hop for every schedule: with
+``virtual`` chunks per device (Megatron-interleaved placement — device
+``p`` owns global stages ``p, p+d, p+2d, …``), stage ``s -> s+1`` is
+always device ``p -> p+1`` on the ring.
+
+Measurable results carried by the member:
+- ``tables.bubble_fraction`` — exact idle fraction from the schedule
+  (1F1B == GPipe at equal microbatches, the known synchronous-flush
+  result; interleaved drops below both by amortizing the fill/drain over
+  ``virtual``x more resident work).
+- ``tables.peak_stash`` — stashed-activation capacity actually allocated:
+  O(microbatches) for GPipe vs O(depth) for 1F1B, the memory story that
+  is 1F1B's entire point, realized as different static buffer shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import jnp_dtype, validation_atol
+from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+from ddlb_tpu.utils.pipeline_schedule import (
+    KIND_BWD,
+    KIND_FWD,
+    SCHEDULES,
+    build_schedule,
+)
+
+
+class SchedulePPPipeline(PPPipeline):
+    DEFAULT_OPTIONS = {"schedule": "1f1b", "microbatches": 4, "virtual": 1}
+    ALLOWED_VALUES = {
+        "schedule": list(SCHEDULES),
+        "microbatches": (1, None),
+        "virtual": (1, 8),
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        mb = self.options["microbatches"]
+        if self.m % mb != 0:
+            raise ValueError(f"m={self.m} must be divisible by microbatches={mb}")
+
+    @property
+    def num_stages(self) -> int:
+        # the chain is virtual x deeper than the device ring
+        return self.num_partitions * self.options["virtual"]
+
+    def _input_setup(self) -> None:
+        d = self.num_partitions
+        v = self.num_stages // d
+        mb = self.options["microbatches"]
+        tables = build_schedule(self.options["schedule"], d, mb, v)
+        self.tables = tables
+        rows = self.m // mb
+        dt = jnp_dtype(self.dtype)
+        S = self.num_stages
+
+        a_host, w_host = self._host_chain_operands()
+        # Megatron-interleaved placement: device p's chunk c is global
+        # stage c*d + p; block-sharding over tp needs those rows contiguous
+        # per device, so arrange host-side as [p*v + c] = stage[c*d + p].
+        arrange = np.stack(
+            [w_host[c * d + p] for p in range(d) for c in range(v)]
+        )
+        self.a = self._device_put(a_host, P(None, None))
+        self.w = self._device_put(arrange, P("tp", None, None))
+
+        # dense tables as device constants (replicated, tiny int32)
+        T = {
+            name: jnp.asarray(getattr(tables, name))
+            for name in (
+                "kind", "mb", "chunk", "act_slot", "in_slot",
+                "fwd_land", "bwd_land",
+            )
+        }
+        n_act = tables.act_slots + 1      # + scratch slot
+        n_land = tables.land_slots + 1
+        k, n = self.k, self.n
+
+        def step(a, w_loc):
+            p = jax.lax.axis_index("tp")
+            act = jnp.zeros((n_act, rows, k), dt)
+            fland = jnp.zeros((n_land, rows, k), dt)
+            bland = jnp.zeros((n_land, rows, n), dt)
+            dw = jnp.zeros((v, k, n), jnp.float32)
+            coll = jnp.zeros((mb, rows, n), dt)
+            fwd_arr = jnp.zeros((rows, k), dt)   # k==n (checked)
+            bwd_arr = jnp.zeros((rows, n), dt)
+            ring_r = [(i, (i + 1) % d) for i in range(d)]
+            ring_l = [(i, (i - 1) % d) for i in range(d)]
+            ones_g = jnp.ones((rows, n), dt)
+
+            def sl(slot, scratch):
+                return jnp.where(slot < 0, scratch, slot)
+
+            for t in range(tables.ticks):
+                # 1) land last tick's arrivals (slot -1 -> scratch)
+                fland = jax.lax.dynamic_update_slice(
+                    fland, fwd_arr[None],
+                    (sl(T["fwd_land"][t, p], n_land - 1), 0, 0),
+                )
+                bland = jax.lax.dynamic_update_slice(
+                    bland, bwd_arr[None],
+                    (sl(T["bwd_land"][t, p], n_land - 1), 0, 0),
+                )
+                kind = T["kind"][t, p]
+                i = jnp.maximum(T["mb"][t, p], 0)
+                c = jnp.maximum(T["chunk"][t, p], 0)
+                aslot = sl(T["act_slot"][t, p], n_act - 1)
+                islot = sl(T["in_slot"][t, p], n_land - 1)
+                s_glob = c * d + p
+                w_c = jax.lax.dynamic_index_in_dim(
+                    w_loc, c, axis=0, keepdims=False
+                )
+
+                def fwd_branch(act, fland, bland, dw, coll):
+                    inject = jax.lax.dynamic_slice(
+                        a, (i * rows, 0), (rows, k)
+                    ).astype(dt)
+                    landed = jax.lax.dynamic_index_in_dim(
+                        fland, islot, axis=0, keepdims=False
+                    )
+                    x_in = jnp.where(s_glob == 0, inject, landed)
+                    y = jnp.matmul(
+                        x_in, w_c, preferred_element_type=jnp.float32
+                    ).astype(dt)
+                    act = jax.lax.dynamic_update_slice(
+                        act, x_in[None], (aslot, 0, 0)
+                    )
+                    # last global stage: collect the chunk, send nothing
+                    # (write-back of the existing row keeps non-final
+                    # stages' update a no-op without a second switch)
+                    cur = jax.lax.dynamic_index_in_dim(
+                        coll, i, axis=0, keepdims=False
+                    )
+                    coll = jax.lax.dynamic_update_slice(
+                        coll,
+                        jnp.where(s_glob == S - 1, y, cur)[None],
+                        (i, 0, 0),
+                    )
+                    send_f = jnp.where(s_glob == S - 1, jnp.zeros_like(y), y)
+                    return act, fland, bland, dw, coll, send_f, jnp.zeros(
+                        (rows, n), dt
+                    )
+
+                def bwd_branch(act, fland, bland, dw, coll):
+                    landed = jax.lax.dynamic_index_in_dim(
+                        bland, islot, axis=0, keepdims=False
+                    )
+                    g_in = jnp.where(s_glob == S - 1, ones_g, landed)
+                    x_saved = jax.lax.dynamic_index_in_dim(
+                        act, aslot, axis=0, keepdims=False
+                    )
+                    dw_c = jnp.matmul(
+                        x_saved.T.astype(jnp.float32),
+                        g_in.astype(jnp.float32),
+                        preferred_element_type=jnp.float32,
+                    )
+                    dw = dw.at[c].add(dw_c)
+                    g_out = jnp.matmul(
+                        g_in, w_c.T, preferred_element_type=jnp.float32
+                    ).astype(dt)
+                    send_b = jnp.where(s_glob == 0, jnp.zeros_like(g_out), g_out)
+                    return act, fland, bland, dw, coll, jnp.zeros(
+                        (rows, k), dt
+                    ), send_b
+
+                def idle_branch(act, fland, bland, dw, coll):
+                    return act, fland, bland, dw, coll, jnp.zeros(
+                        (rows, k), dt
+                    ), jnp.zeros((rows, n), dt)
+
+                act, fland, bland, dw, coll, send_f, send_b = jax.lax.switch(
+                    kind,
+                    [idle_branch, fwd_branch, bwd_branch],
+                    act, fland, bland, dw, coll,
+                )
+                if d > 1:
+                    fwd_arr = jax.lax.ppermute(send_f, "tp", perm=ring_r)
+                    bwd_arr = jax.lax.ppermute(send_b, "tp", perm=ring_l)
+                else:
+                    fwd_arr, bwd_arr = send_f, send_b
+
+            # surface the collected output everywhere (the last global
+            # stage lives on device d-1); grads stay stage-resident
+            y_full = jnp.where(p == d - 1, coll, jnp.zeros_like(coll))
+            y_full = jax.lax.psum(y_full, "tp")
+            return y_full.reshape(self.m, self.n), dw
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, None), P("tp", None, None)),
+                out_specs=(P(None, None), P("tp", None, None)),
+                check_vma=False,
+            )
+        )
+
+    def _expected_grads(self) -> np.ndarray:
+        """Host-side stage gradients of L = sum(chain output), per
+        microbatch slab, in stage order ``[S, k, n]`` float32."""
+        a, w = self._host_chain_operands()
+        mb = self.options["microbatches"]
+        rows = self.m // mb
+        S = self.num_stages
+        acc = np.float32
+        dw = np.zeros((S, self.k, self.n), acc)
+        for i in range(mb):
+            x = a[i * rows : (i + 1) * rows].astype(acc)
+            xs = []
+            for s in range(S):
+                xs.append(x)
+                x = x @ w[s].astype(acc)
+            g = np.ones((rows, self.n), acc)
+            for s in range(S - 1, -1, -1):
+                dw[s] += xs[s].T @ g
+                g = g @ w[s].astype(acc).T
+        return dw
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        y, dw = result
+        y = jax.block_until_ready(y)
+        ok = self._compare_global(y, self._expected_full(), atol=self._atol())
+        # gradients: device-major (p, c) rows back to stage order
+        d = self.num_partitions
+        v = self.num_stages // d
+        got = np.asarray(jax.block_until_ready(dw), np.float32)
+        want = self._expected_grads()
+        atol = validation_atol(self.dtype, self.m) * self.num_stages
+        for p in range(d):
+            for c in range(v):
+                s = c * d + p
+                err = np.max(np.abs(got[p * v + c] - want[s]))
+                if not err <= atol:
+                    print(
+                        f"[ddlb_tpu] schedule grad validation FAILED "
+                        f"stage {s}: max|err|={err:.3e} > atol={atol:.3e}"
+                    )
+                    ok = False
+        return ok

@@ -219,6 +219,12 @@ _REGISTRY = {
             "ddlb_tpu.primitives.pp_pipeline.xla_gspmd",
             "XLAGSPMDPPPipeline",
         ),
+        # training schedules (fwd+bwd per microbatch): gpipe/1f1b/
+        # interleaved from host-precomputed dense tables
+        "schedules": (
+            "ddlb_tpu.primitives.pp_pipeline.schedules",
+            "SchedulePPPipeline",
+        ),
     },
 }
 

@@ -6,6 +6,11 @@ single XLA program per device (models/transformer.py), or the forward
 loss alone for ``mode='forward'``. Buffers are NOT donated: the runner
 re-executes the same step on identical operands, so inputs must survive
 each call (make_train_step(donate=False)).
+
+``schedule`` selects the pipeline training schedule: ``gpipe`` (autodiff
+reverses the forward loop — the flush schedule) or ``1f1b`` (the
+table-driven manual-vjp interleave, models/pipeline.py) — sweepable, so
+the runner can race the two schedules through the same rows.
 """
 
 from __future__ import annotations
@@ -14,9 +19,24 @@ from ddlb_tpu.primitives.transformer_step.base import TransformerStep
 
 
 class SPMDTransformerStep(TransformerStep):
+    DEFAULT_OPTIONS = {"schedule": "gpipe"}
+    ALLOWED_VALUES = {"schedule": ["gpipe", "1f1b"]}
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if (
+            self.options["schedule"] == "1f1b"
+            and self.options["mode"] != "train"
+        ):
+            raise ValueError(
+                "schedule='1f1b' is a training schedule; mode='forward' "
+                "has no backward to interleave"
+            )
+
     def _input_setup(self) -> None:
         import jax
 
+        from ddlb_tpu.models.pipeline import make_train_step_1f1b
         from ddlb_tpu.models.transformer import (
             init_params,
             make_loss_fn,
@@ -29,7 +49,11 @@ class SPMDTransformerStep(TransformerStep):
         self.num_partitions = dp * tp * pp
         mode = self.options["mode"]
 
-        if mode == "train":
+        if mode == "train" and self.options["schedule"] == "1f1b":
+            step, init_opt, shardings = make_train_step_1f1b(
+                self.mesh, cfg, donate=False
+            )
+        elif mode == "train":
             step, init_opt, shardings = make_train_step(
                 self.mesh, cfg, donate=False
             )

@@ -19,14 +19,25 @@ while true; do
         echo "[$ts] running measure_r2_hw.py..."
         timeout 3600 python scripts/measure_r2_hw.py \
             > hwlogs/measure_r2_hw.out 2> hwlogs/measure_r2_hw.err
-        echo "[$ts] measure_r2_hw rc=$?"
+        rc_hw=$?
+        echo "[$ts] measure_r2_hw rc=$rc_hw"
         ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
         echo "[$ts] running bench.py..."
         timeout 3600 python bench.py \
             > hwlogs/bench_live.out 2> hwlogs/bench_live.err
-        echo "[$ts] bench rc=$?"
-        echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ)" > hwlogs/CAPTURED
-        exit 0
+        rc_bench=$?
+        echo "[$ts] bench rc=$rc_bench"
+        # CAPTURED only on real success: bench must have emitted a live
+        # (non-fallback) TPU row — a relay that flapped mid-measurement
+        # sends us back to probing, not to a false success marker
+        if [ "$rc_bench" -eq 0 ] \
+            && grep -q '"platform": "tpu"' hwlogs/bench_live.out \
+            && ! grep -q '"fallback_reason"' hwlogs/bench_live.out; then
+            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw=$rc_hw" \
+                > hwlogs/CAPTURED
+            exit 0
+        fi
+        echo "[$ts] capture incomplete (rc_hw=$rc_hw rc_bench=$rc_bench); resuming probe loop"
     fi
     echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
     sleep 240

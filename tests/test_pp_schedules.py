@@ -1,0 +1,264 @@
+"""Pipeline training schedules: table properties + SPMD executor parity.
+
+The schedule tables are exact (built by simulation, not measured), so the
+classic results are asserted as equalities/inequalities, not trends:
+- 1F1B and GPipe have the SAME synchronous-flush bubble at equal
+  microbatches (the known result — 1F1B's win is memory, not ticks);
+- 1F1B's activation stash is O(depth) vs GPipe's O(microbatches);
+- interleaved (virtual chunks) strictly reduces the bubble vs the v=1
+  schedules on the same device count and model.
+
+The executor tests run the full fwd+bwd table-driven shard_map program on
+the 8-device CPU mesh and validate output AND per-stage gradients against
+the host chain oracle (schedules.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.utils.pipeline_schedule import (
+    KIND_BWD,
+    KIND_FWD,
+    KIND_IDLE,
+    build_schedule,
+)
+
+
+class TestScheduleTables:
+    def test_gpipe_1f1b_same_ticks_exact(self):
+        # 2*(mb + d - 1): fill + drain on both sides of the flush
+        for d, mb in [(2, 4), (4, 8), (8, 16), (8, 32)]:
+            g = build_schedule("gpipe", d, mb)
+            o = build_schedule("1f1b", d, mb)
+            assert g.ticks == 2 * (mb + d - 1)
+            assert o.ticks == g.ticks
+            assert o.bubble_fraction == g.bubble_fraction
+
+    def test_1f1b_stash_is_depth_not_microbatches(self):
+        for d, mb in [(4, 16), (8, 32)]:
+            g = build_schedule("gpipe", d, mb)
+            o = build_schedule("1f1b", d, mb)
+            assert g.peak_stash == mb
+            assert o.peak_stash == d
+            assert o.peak_stash < g.peak_stash
+
+    def test_interleaved_cuts_bubble_vs_v1(self):
+        # same devices, same model (d*v chunks vs d fat stages), same mb
+        for d, mb, v in [(4, 8, 2), (8, 16, 2), (8, 16, 4)]:
+            g = build_schedule("gpipe", d, mb)
+            i = build_schedule("interleaved", d, mb, v)
+            assert i.bubble_fraction < g.bubble_fraction
+
+    def test_every_op_scheduled_exactly_once(self):
+        t = build_schedule("interleaved", 4, 8, 2)
+        seen = set()
+        for tick in range(t.ticks):
+            for p in range(t.n_devices):
+                if t.kind[tick, p] == KIND_IDLE:
+                    continue
+                key = (int(t.kind[tick, p]), int(t.mb[tick, p]),
+                       int(t.chunk[tick, p]), p)
+                assert key not in seen
+                seen.add(key)
+        assert len(seen) == 2 * t.microbatches * t.n_stages
+
+    def test_dependencies_respected(self):
+        """fwd(i,s) strictly after fwd(i,s-1); bwd(i,s) after bwd(i,s+1)
+        and after fwd(i,s) — with at least one tick of hop latency."""
+        t = build_schedule("interleaved", 4, 6, 2)
+        d, S = t.n_devices, t.n_stages
+        fwd_t, bwd_t = {}, {}
+        for tick in range(t.ticks):
+            for p in range(d):
+                k = t.kind[tick, p]
+                if k == KIND_IDLE:
+                    continue
+                s = int(t.chunk[tick, p]) * d + p
+                i = int(t.mb[tick, p])
+                (fwd_t if k == KIND_FWD else bwd_t)[(i, s)] = tick
+        for (i, s), tk in fwd_t.items():
+            if s > 0:
+                assert fwd_t[(i, s - 1)] < tk
+        for (i, s), tk in bwd_t.items():
+            assert fwd_t[(i, s)] < tk
+            if s + 1 < S:
+                assert bwd_t[(i, s + 1)] < tk
+
+    def test_busy_accounting(self):
+        t = build_schedule("1f1b", 4, 8)
+        # every device does exactly 2*mb*v ops
+        assert (t.busy == 2 * t.microbatches * t.virtual).all()
+
+    def test_rejects_bad_combinations(self):
+        with pytest.raises(ValueError, match="interleaved"):
+            build_schedule("1f1b", 4, 8, virtual=2)
+        with pytest.raises(ValueError, match="virtual >= 2"):
+            build_schedule("interleaved", 4, 8, virtual=1)
+        with pytest.raises(ValueError, match="unknown schedule"):
+            build_schedule("pipedream", 4, 8)
+
+
+class TestScheduleExecutor:
+    @pytest.mark.parametrize(
+        "schedule,virtual", [("gpipe", 1), ("1f1b", 1), ("interleaved", 2)]
+    )
+    def test_output_and_grads_validate_f32(self, schedule, virtual):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("pp_pipeline", "schedules")
+        impl = cls(
+            64, 128, 128, dtype="float32",
+            schedule=schedule, microbatches=4, virtual=virtual,
+        )
+        assert impl.validate(impl.run())
+
+    def test_bf16_validates(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("pp_pipeline", "schedules")
+        impl = cls(
+            64, 128, 128, dtype="bfloat16",
+            schedule="1f1b", microbatches=8,
+        )
+        assert impl.validate(impl.run())
+
+    def test_gpipe_chunked_equal_depth(self):
+        """gpipe accepts virtual>1 (the equal-chain-depth comparison
+        partner for interleaved): same placement, flush policy."""
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("pp_pipeline", "schedules")
+        impl = cls(
+            32, 64, 64, dtype="float32",
+            schedule="gpipe", microbatches=4, virtual=2,
+        )
+        assert impl.validate(impl.run())
+        assert impl.num_stages == impl.num_partitions * 2
+
+    def test_schedule_through_benchmark_worker(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "pp_pipeline",
+                "impl_id": "schedules_0",
+                "base_implementation": "schedules",
+                "options": {"schedule": "1f1b", "microbatches": 4},
+                "m": 32,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 2,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_rejects_indivisible_microbatches(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("pp_pipeline", "schedules")
+        with pytest.raises(ValueError, match="divisible by microbatches"):
+            cls(30, 64, 64, dtype="float32", schedule="1f1b", microbatches=4)
+
+
+class TestModel1F1B:
+    """The flagship model training under the 1F1B schedule
+    (models/pipeline.py): manual-vjp loop vs autodiff-GPipe oracle."""
+
+    def _setup(self, mb=4):
+        import jax
+
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            example_tokens,
+            init_params,
+            make_loss_fn,
+        )
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp", "pp"), shape=(2, 2, 2))
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=1, microbatches=mb,
+        )
+        params = init_params(cfg, pp=2, n_experts=2)
+        tokens, targets = example_tokens(batch=8, seq=16, vocab=cfg.vocab)
+        loss_fn, sh = make_loss_fn(mesh, cfg)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        tokens = jax.device_put(tokens, sh["data"])
+        targets = jax.device_put(targets, sh["data"])
+        return mesh, cfg, loss_fn, params, tokens, targets
+
+    def test_1f1b_loss_and_grads_match_autodiff_gpipe(self):
+        import jax
+
+        from ddlb_tpu.models.pipeline import make_loss_and_grads_1f1b
+
+        mesh, cfg, loss_fn, params, tokens, targets = self._setup()
+        loss_g, grads_g = jax.jit(jax.value_and_grad(loss_fn))(
+            params, tokens, targets
+        )
+        fn, _ = make_loss_and_grads_1f1b(mesh, cfg)
+        loss_o, grads_o = jax.jit(fn)(params, tokens, targets)
+        assert abs(float(loss_g) - float(loss_o)) < 1e-6
+        for k in grads_g:
+            a = np.asarray(grads_g[k], np.float32)
+            b = np.asarray(grads_o[k], np.float32)
+            rel = np.max(np.abs(a - b)) / (np.max(np.abs(a)) + 1e-12)
+            assert rel < 2e-3, f"grad '{k}' diverges: rel={rel:.3e}"
+
+    def test_1f1b_train_step_decreases_loss(self):
+        import jax
+
+        from ddlb_tpu.models.pipeline import make_train_step_1f1b
+
+        mesh, cfg, _, params, tokens, targets = self._setup()
+        step, init_opt, _ = make_train_step_1f1b(mesh, cfg, donate=False)
+        opt_state = init_opt(params)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+            losses.append(float(jax.block_until_ready(loss)))
+        assert losses[-1] < losses[0]
+
+    def test_spmd_member_sweeps_schedule(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_1f1b",
+                "base_implementation": "spmd",
+                "options": {
+                    "schedule": "1f1b", "batch": 4, "vocab": 64,
+                    "n_heads": 4, "microbatches": 2, "attn_kernel": "einsum",
+                },
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_1f1b_rejects_forward_mode(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_step", "spmd")
+        with pytest.raises(ValueError, match="training schedule"):
+            cls(
+                16, 32, 64, dtype="float32",
+                schedule="1f1b", mode="forward", batch=4, vocab=64,
+                n_heads=4, microbatches=2,
+            )
