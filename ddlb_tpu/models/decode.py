@@ -158,6 +158,11 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
             "decode supports attention='gathered' (heads sharded over tp); "
             "ring/context-parallel decode is a training-side construction"
         )
+    if cfg.router != "block":
+        raise ValueError(
+            "serving paths use the per-sequence-stable block router; "
+            "router='topk' is a training-side construction"
+        )
     if cfg.n_heads % tp != 0:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
     L = cfg.layers_per_stage
@@ -255,6 +260,11 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
     tp = mesh.shape["tp"]
     if cfg.attention != "gathered":
         raise ValueError("decode/prefill support attention='gathered' only")
+    if cfg.router != "block":
+        raise ValueError(
+            "serving paths use the per-sequence-stable block router; "
+            "router='topk' is a training-side construction"
+        )
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
     L = cfg.layers_per_stage

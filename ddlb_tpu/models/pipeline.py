@@ -73,7 +73,9 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
     specs = param_specs(cfg)
     if cfg.mlp_kernel == "int8_weights":
         raise ValueError(
-            "1F1B is a training schedule; int8_weights is forward-only"
+            "1F1B is a training schedule and mlp_kernel='int8_weights' is "
+            "the forward-only serving form; train with mlp_kernel='int8' "
+            "(STE) instead"
         )
     interpret = jax.default_backend() != "tpu"
     stage_fn = make_stage_fn(cfg, tp, interpret)
@@ -118,7 +120,13 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
         fwd_arr = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
         bwd_arr = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
         loss_acc = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
         grads = zero_grads
+        # d(total loss)/d(per-tick stage aux): the aux term averages over
+        # (mb, stages, dp, tp) with weight router_aux
+        aux_cot = jnp.asarray(
+            cfg.router_aux / (mb * pp * dp * tp), jnp.float32
+        )
 
         def sl(slot, cap):
             return jnp.where(slot < 0, cap - 1, slot)
@@ -137,14 +145,14 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
             aslot = sl(T["act_slot"][t, p_pp], n_act)
             islot = sl(T["in_slot"][t, p_pp], n_land)
 
-            def fwd_branch(act, fland, bland, loss_acc, grads):
+            def fwd_branch(act, fland, bland, loss_acc, aux_acc, grads):
                 tok = mb_slab(tokens, i)
                 inject = params["embed"][tok].astype(cfg.dtype)
                 landed = jax.lax.dynamic_index_in_dim(
                     fland, islot, axis=0, keepdims=False
                 )
                 x_in = jnp.where(p_pp == 0, inject, landed)
-                y = stage_fn(x_in, params)
+                y, aux = stage_fn(x_in, params)
                 act_n = jax.lax.dynamic_update_slice(
                     act, x_in[None], (aslot, 0, 0, 0)
                 )
@@ -160,18 +168,18 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                 )
                 send_f = jnp.where(p_pp == pp - 1, jnp.zeros_like(y), y)
                 return (
-                    act_n, fland, bland, loss_acc + loss_i, grads,
-                    send_f, jnp.zeros_like(y),
+                    act_n, fland, bland, loss_acc + loss_i, aux_acc + aux,
+                    grads, send_f, jnp.zeros_like(y),
                 )
 
-            def bwd_branch(act, fland, bland, loss_acc, grads):
+            def bwd_branch(act, fland, bland, loss_acc, aux_acc, grads):
                 x_saved = jax.lax.dynamic_index_in_dim(
                     act, aslot, axis=0, keepdims=False
                 )
                 # rematerializing vjp: stage_fn is checkpointed, so this
                 # recomputes the stage forward then backs through it —
                 # the physical ~2x-forward backward tick
-                y, pull = jax.vjp(stage_fn, x_saved, params)
+                (y, _aux), pull = jax.vjp(stage_fn, x_saved, params)
 
                 def tail_seed(yy):
                     # d(total loss)/dy at the last stage, plus the tail's
@@ -200,7 +208,7 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                 g_y, d_lnf, d_head = jax.lax.cond(
                     p_pp == pp - 1, tail_seed, mid_seed, y
                 )
-                dx, dparams = pull(g_y)
+                dx, dparams = pull((g_y, aux_cot))
                 # embed backward at stage 0: scatter-add dx at the token
                 # ids (collective-free)
                 tok = mb_slab(tokens, i)
@@ -222,19 +230,19 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                 send_b = jnp.where(p_pp == 0, jnp.zeros_like(dx), dx)
                 send_b = send_b.astype(cfg.dtype)
                 return (
-                    act, fland, bland, loss_acc, gr,
+                    act, fland, bland, loss_acc, aux_acc, gr,
                     jnp.zeros_like(send_b), send_b,
                 )
 
-            def idle_branch(act, fland, bland, loss_acc, grads):
+            def idle_branch(act, fland, bland, loss_acc, aux_acc, grads):
                 z = jnp.zeros((b_mb, s_loc, D), cfg.dtype)
-                return act, fland, bland, loss_acc, grads, z, z
+                return act, fland, bland, loss_acc, aux_acc, grads, z, z
 
-            (act, fland, bland, loss_acc, grads, send_f, send_b) = (
+            (act, fland, bland, loss_acc, aux_acc, grads, send_f, send_b) = (
                 jax.lax.switch(
                     kind,
                     [idle_branch, fwd_branch, bwd_branch],
-                    act, fland, bland, loss_acc, grads,
+                    act, fland, bland, loss_acc, aux_acc, grads,
                 )
             )
             if pp > 1:
@@ -249,6 +257,10 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
         # (dp always; tp for tp-replicated leaves; pp for the shared
         # embed/ln_f/head, whose contributions live on one stage)
         loss = jax.lax.psum(loss_acc / mb, "pp")
+        if cfg.router == "topk":
+            loss = loss + cfg.router_aux * jax.lax.psum(
+                aux_acc / mb, "pp"
+            ) / pp
         loss = jax.lax.psum(loss, "dp") / dp
         loss = jax.lax.psum(loss, "tp") / tp
         out_grads = {}
@@ -283,11 +295,7 @@ def make_train_step_1f1b(
     with the schedule swapped from autodiff-GPipe to table-driven 1F1B."""
     import optax
 
-    if cfg.mlp_kernel == "int8_weights":
-        raise ValueError(
-            "mlp_kernel='int8_weights' is the forward-only serving form; "
-            "train with mlp_kernel='int8' (STE) instead"
-        )
+    # int8_weights (forward-only) is rejected by make_loss_and_grads_1f1b
     optimizer = optax.adamw(learning_rate)
     loss_and_grads, shardings = make_loss_and_grads_1f1b(mesh, cfg)
 

@@ -73,6 +73,19 @@ class TransformerConfig:
     #: dynamic per-token activation quant. Forward-only: int8 weight
     #: leaves have no gradients.
     mlp_kernel: str = "bf16"
+    #: "block": balanced block routing — sequence i's tokens use expert
+    #: i-block (deterministic, perfectly balanced; the benchmark default,
+    #: isolating the all-to-all traffic pattern from routing dynamics).
+    #: "topk": learned top-k gating (GShard/Switch style) — per-token
+    #: router logits, top-k expert choice, per-(shard, expert) capacity
+    #: with first-come slot assignment, overflow dropped to the residual
+    #: stream, Switch load-balance aux loss weighted ``router_aux``.
+    router: str = "block"
+    router_topk: int = 2
+    #: capacity factor: each (source shard, expert) pair gets
+    #: ceil(capacity_factor * k * T_loc / E) slots
+    capacity_factor: float = 1.25
+    router_aux: float = 0.01
     dtype: Any = jnp.float32
 
     @property
@@ -107,6 +120,13 @@ def init_params(
         "ln_f": jnp.ones((D,), cfg.dtype),
         "head": normal((D, V), s_in),
     }
+    if cfg.router == "topk":
+        # learned gate, one logit per expert; kept in float32 so the
+        # softmax/top-k selection is bit-identical between the sharded
+        # step and the oracle whatever the activation dtype
+        params["router"] = jnp.asarray(
+            rng.normal(0.0, s_in, (pp, L, D, n_experts)), jnp.float32
+        )
     if cfg.mlp_kernel == "int8_weights":
         # inference serving form: the expert weights ship pre-quantized,
         # so the step never re-quantizes them (deterministic: both the
@@ -151,6 +171,9 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         "ln_f": P(None),
         "head": P(None, None),
     }
+    if cfg.router == "topk":
+        # every rank routes its own token shard: gate replicated over tp
+        specs["router"] = P("pp", None, None, None)
     if cfg.mlp_kernel == "int8_weights":
         # scale leaves ride with their weights: expert axis on tp
         specs["moe_w1_scale"] = P("pp", None, "tp", None, None)
@@ -326,6 +349,73 @@ def _ce_loss(logits, targets):
     return -jnp.mean(picked)
 
 
+# -- learned top-k router (GShard/Switch style) -------------------------------
+#
+# Shared verbatim by the sharded stage body and the single-device oracle:
+# every op below is per-token-slab deterministic (softmax, top_k, cumsum
+# slot assignment), so identical slabs produce identical dispatch — which
+# is what keeps the oracle pinning exact. The EP exchange itself (an
+# all_to_all of the fixed-capacity dispatch buffer) lives only in the
+# sharded caller; the oracle applies the experts to the same buffer
+# directly.
+
+
+def router_capacity(t_loc: int, n_experts: int, k: int, factor: float) -> int:
+    """Static per-(source shard, expert) slot count."""
+    return max(1, int(np.ceil(factor * k * t_loc / n_experts)))
+
+
+def _router_assign(tokens2d, gate, k: int, capacity: int):
+    """Route one token slab: top-k choice, slot assignment, aux loss.
+
+    Returns ``(tope [T,k] int32, topv [T,k] f32, slot [T,k] int32,
+    kept [T,k] bool, aux f32 scalar)``. Slots are first-come in
+    (selection-rank-major, token-order) priority — GShard's assignment —
+    via a cumsum over the one-hot dispatch mask; a token whose slot
+    overflows ``capacity`` is dropped (``kept=False``) and its residual
+    stream passes through unchanged. ``aux`` is the Switch load-balance
+    loss ``E * sum_e f_e * P_e`` (f_e: top-1 dispatch fraction, P_e: mean
+    router probability), minimized at uniform load.
+    """
+    T = tokens2d.shape[0]
+    E = gate.shape[-1]
+    logits = jnp.matmul(
+        tokens2d.astype(jnp.float32), gate.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    topv, tope = jax.lax.top_k(probs, k)     # [T, k]
+    sel = jax.nn.one_hot(tope, E, dtype=jnp.float32)  # [T, k, E]
+    # selection-rank-major flattening: all rank-0 choices get slots before
+    # any rank-1 choice, matching GShard's priority
+    flat = sel.transpose(1, 0, 2).reshape(k * T, E)
+    pos = jnp.cumsum(flat, axis=0) - flat
+    slot = jnp.sum(
+        sel * pos.reshape(k, T, E).transpose(1, 0, 2), axis=-1
+    ).astype(jnp.int32)
+    kept = slot < capacity
+    f = jnp.mean(jax.nn.one_hot(tope[:, 0], E, dtype=jnp.float32), axis=0)
+    P = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * P)
+    return tope, topv, slot, kept, aux
+
+
+def _router_dispatch(tokens2d, tope, slot, kept, n_experts, capacity):
+    """Scatter the slab into the fixed-capacity buffer ``[E, C, D]``
+    (dropped selections scatter zeros at a clamped slot)."""
+    vals = tokens2d[:, None, :] * kept[..., None].astype(tokens2d.dtype)
+    buf = jnp.zeros((n_experts, capacity, tokens2d.shape[-1]), tokens2d.dtype)
+    return buf.at[tope, jnp.minimum(slot, capacity - 1)].add(vals)
+
+
+def _router_combine(buf_out, tope, slot, topv, kept, capacity, out_dtype):
+    """Gather each token's k expert outputs and mix by its (raw, un-
+    renormalized) router probabilities; dropped selections weigh 0."""
+    gathered = buf_out[tope, jnp.minimum(slot, capacity - 1)]  # [T, k, D]
+    w = (topv * kept.astype(jnp.float32))[..., None]
+    return jnp.sum(gathered.astype(jnp.float32) * w, axis=1).astype(out_dtype)
+
+
 def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
     """Build the per-stage block body ``stage_fn(x, sp) -> x`` shared by
     the GPipe loss loop and the 1F1B manual-vjp loop (models/pipeline.py):
@@ -339,10 +429,15 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
     if cfg.mlp_kernel not in ("bf16", "int8", "int8_weights"):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
+    if cfg.router not in ("block", "topk"):
+        raise ValueError(f"unknown router '{cfg.router}'")
 
     def stage_fn(x, sp):
         """Apply this stage's L transformer blocks to a local activation
-        slab ``[b, S/tp, d_model]``; tp/sp/ep collectives inside."""
+        slab ``[b, S/tp, d_model]``; tp/sp/ep collectives inside. Returns
+        ``(x, aux)`` — aux is the stage's mean-over-layers router
+        load-balance loss (0 under block routing)."""
+        aux = jnp.zeros((), jnp.float32)
         b, s_loc, D = x.shape
         h_heads = cfg.n_heads // tp
         if sp["moe_w1"].shape[2] != 1:
@@ -408,6 +503,45 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
             # -- MoE FFN (ep_alltoall over the tp axis) --
             h = _rms_norm(x, sp["ln2"][0, l])
             T = b * s_loc
+            scales = (
+                (sp["moe_w1_scale"][0, l, 0], sp["moe_w2_scale"][0, l, 0])
+                if cfg.mlp_kernel == "int8_weights"
+                else None
+            )
+            if cfg.router == "topk":
+                # learned routing: fixed-capacity dispatch buffers ride
+                # the same mirrored all_to_all as the block path, so the
+                # EP traffic pattern is identical — only the (data-
+                # dependent) buffer CONTENTS differ
+                C = router_capacity(
+                    T, tp, cfg.router_topk, cfg.capacity_factor
+                )
+                h2d = h.reshape(T, D)
+                tope, topv, slot, kept, aux_l = _router_assign(
+                    h2d, sp["router"][0, l], cfg.router_topk, C
+                )
+                buf = _router_dispatch(h2d, tope, slot, kept, tp, C)
+                buf = jax.lax.all_to_all(
+                    buf, "tp", split_axis=0, concat_axis=0, tiled=True
+                )  # [src_rank, C, D] at the resident expert
+                z = _moe_ffn(
+                    buf.reshape(tp * C, D),
+                    sp["moe_w1"][0, l, 0],
+                    sp["moe_w2"][0, l, 0],
+                    cfg.mlp_kernel,
+                    x.dtype,
+                    scales=scales,
+                )
+                z = jax.lax.all_to_all(
+                    z.reshape(tp, C, D),
+                    "tp", split_axis=0, concat_axis=0, tiled=True,
+                )  # [expert, C, D] back at the source
+                u2d = _router_combine(
+                    z, tope, slot, topv, kept, C, x.dtype
+                )
+                x = x + u2d.reshape(b, s_loc, D)
+                aux = aux + aux_l / L
+                continue
             t3 = h.reshape(tp, T // tp, D)  # balanced block routing
             t3 = jax.lax.all_to_all(
                 t3, "tp", split_axis=0, concat_axis=0, tiled=True
@@ -418,11 +552,7 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                 sp["moe_w2"][0, l, 0],
                 cfg.mlp_kernel,
                 x.dtype,
-                scales=(
-                    (sp["moe_w1_scale"][0, l, 0], sp["moe_w2_scale"][0, l, 0])
-                    if cfg.mlp_kernel == "int8_weights"
-                    else None
-                ),
+                scales=scales,
             )
             u = jax.lax.all_to_all(
                 u.reshape(tp, T // tp, D),
@@ -432,7 +562,7 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                 tiled=True,
             )
             x = x + u.reshape(b, s_loc, D)
-        return x
+        return x, aux
 
     return jax.checkpoint(stage_fn)  # PP-standard per-stage remat
 
@@ -494,12 +624,17 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
 
         buf = jnp.zeros((b_mb, s_loc, cfg.d_model), cfg.dtype)
         loss_acc = jnp.zeros((), jnp.float32)
+        aux_acc = jnp.zeros((), jnp.float32)
         for t in range(mb + pp - 1):
             if t < mb:
                 x_in = jnp.where(p_pp == 0, embed_mb(t), buf)
             else:
                 x_in = buf
-            y = stage_fn(x_in, params)
+            y, aux = stage_fn(x_in, params)
+            # router aux counts only the ticks where this stage held a
+            # real microbatch (bubble ticks run on garbage data)
+            valid = (t >= p_pp) & (t - p_pp < mb)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             fin = t - (pp - 1)
             if 0 <= fin < mb:
                 # lax.cond, not jnp.where: only last-stage devices execute
@@ -515,8 +650,14 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
             if t + 1 < mb + pp - 1:
                 buf = jax.lax.ppermute(y, "pp", perm=fwd)
         # scalar reductions: surface the loss everywhere (pp), average the
-        # equal-sized token blocks (dp batch shards, tp sequence shards)
+        # equal-sized token blocks (dp batch shards, tp sequence shards);
+        # the router aux term averages over (mb, stages, dp, tp) the same
+        # way the oracle does
         loss = jax.lax.psum(loss_acc / mb, "pp")
+        if cfg.router == "topk":
+            loss = loss + cfg.router_aux * jax.lax.psum(
+                aux_acc / mb, "pp"
+            ) / pp
         loss = jax.lax.psum(loss, "dp") / dp
         loss = jax.lax.psum(loss, "tp") / tp
         return loss
@@ -604,6 +745,7 @@ def reference_loss(
     D = cfg.d_model
     pp, L = params["w_qkv"].shape[:2]
     losses = []
+    aux_sum = jnp.zeros((), jnp.float32)
     for c0 in range(0, B, b_mb):
         x = params["embed"][tokens[c0 : c0 + b_mb]]  # [b_mb, S, D]
         for st in range(pp):
@@ -625,6 +767,52 @@ def reference_loss(
                     attn, params["w_o"][st, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)
                 h = _rms_norm(x, params["ln2"][st, l])
+                if cfg.router == "topk":
+                    # per seq shard, exactly the sharded step's math: same
+                    # slab, same dispatch buffer, same capacity
+                    u = jnp.zeros_like(h)
+                    T = b_mb * s_loc
+                    C = router_capacity(
+                        T, tp, cfg.router_topk, cfg.capacity_factor
+                    )
+                    for j in range(tp):
+                        slab = h[:, j * s_loc : (j + 1) * s_loc].reshape(T, D)
+                        tope, topv, slot, kept, aux_l = _router_assign(
+                            slab, params["router"][st, l],
+                            cfg.router_topk, C,
+                        )
+                        buf = _router_dispatch(slab, tope, slot, kept, tp, C)
+                        buf_out = jnp.stack(
+                            [
+                                _moe_ffn(
+                                    buf[e],
+                                    params["moe_w1"][st, l, e],
+                                    params["moe_w2"][st, l, e],
+                                    cfg.mlp_kernel,
+                                    x.dtype,
+                                    scales=(
+                                        (
+                                            params["moe_w1_scale"][st, l, e],
+                                            params["moe_w2_scale"][st, l, e],
+                                        )
+                                        if cfg.mlp_kernel == "int8_weights"
+                                        else None
+                                    ),
+                                )
+                                for e in range(tp)
+                            ]
+                        )
+                        u_blk = _router_combine(
+                            buf_out, tope, slot, topv, kept, C, x.dtype
+                        )
+                        u = jax.lax.dynamic_update_slice(
+                            u,
+                            u_blk.reshape(b_mb, s_loc, D),
+                            (0, j * s_loc, 0),
+                        )
+                        aux_sum = aux_sum + aux_l
+                    x = x + u
+                    continue
                 # per-seq-shard balanced block routing, as the tp ranks do
                 u = jnp.zeros_like(h)
                 T = b_mb * s_loc
@@ -659,7 +847,11 @@ def reference_loss(
         h = _rms_norm(x, params["ln_f"])
         logits = jnp.matmul(h, params["head"], preferred_element_type=jnp.float32)
         losses.append(_ce_loss(logits, targets[c0 : c0 + b_mb]))
-    return jnp.mean(jnp.stack(losses))
+    loss = jnp.mean(jnp.stack(losses))
+    if cfg.router == "topk":
+        n_chunks = B // b_mb
+        loss = loss + cfg.router_aux * aux_sum / (n_chunks * pp * L * tp)
+    return loss
 
 
 def example_tokens(

@@ -51,6 +51,9 @@ class TransformerStep(Primitive):
         "attention": "gathered",
         "attn_kernel": "flash",
         "mlp_kernel": "bf16",
+        "router": "block",
+        "router_topk": 2,
+        "capacity_factor": 1.25,
         "dp": 0,  # 0 = auto factorization of the device count
         "tp": 0,
         "pp": 0,
@@ -65,6 +68,9 @@ class TransformerStep(Primitive):
         "attention": ["gathered", "ring"],
         "attn_kernel": ["flash", "einsum"],
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "router": ["block", "topk"],
+        "router_topk": (1, 4),
+        "capacity_factor": (0.25, 8.0),
         "dp": (0, None),
         "tp": (0, None),
         "pp": (0, None),
@@ -229,6 +235,9 @@ class TransformerStep(Primitive):
             attention=o["attention"],
             attn_kernel=o["attn_kernel"],
             mlp_kernel=o["mlp_kernel"],
+            router=o["router"],
+            router_topk=o["router_topk"],
+            capacity_factor=o["capacity_factor"],
             dtype=jnp_dtype(self.dtype),
         )
 

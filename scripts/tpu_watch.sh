@@ -38,7 +38,8 @@ while true; do
             exit 0
         fi
         echo "[$ts] capture incomplete (rc_hw=$rc_hw rc_bench=$rc_bench); resuming probe loop"
+    else
+        echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
     fi
-    echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
     sleep 240
 done
