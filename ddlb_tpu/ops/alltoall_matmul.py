@@ -1,0 +1,241 @@
+"""Hand-written all-to-all expert-GEMM kernel (RDMA + MXU, one program).
+
+The third collective shape done at the kernel level: after the ring
+all-gather (`ring_ag_matmul`) and ring reduce-scatter (`ring_matmul_rs`)
+of ops/collective_matmul.py, this kernel fuses the MoE exchange —
+dispatch all-to-all, resident-expert GEMM, combine all-to-all — into ONE
+Pallas program driving the ICI directly with
+``pltpu.make_async_remote_copy`` (pallas_guide.md "Async Remote DMA").
+
+Protocol (inside ``shard_map`` over a 1-D ``axis_name`` of d devices;
+reference ambition mirrored: the nvFuser P2P overlap of
+/root/reference/ddlb/primitives/TPColumnwise/fuser.py:102-146 applied to
+the MoE pattern):
+
+1. one global entry barrier (every peer must have entered before anyone
+   RDMAs into anyone's landing buffers — the cross-invocation hazard
+   gate, same role as the ring kernels' neighbor barrier);
+2. ALL dispatch sends launch up front: group ``e`` of my tokens RDMAs
+   into device ``e``'s landing slot ``[my]`` — slots are distinct per
+   sender, so unlike the rings no credit gating is needed within a call;
+3. expert GEMMs run in arrival order ``(my, my+1, …)``, each gated only
+   by its own slot's recv semaphore — compute overlaps the still-flying
+   dispatches;
+4. each finished group's output RDMAs straight into the SOURCE device's
+   output rows (``o_hbm[my*g :]`` addressed with MY index — receiver ``s``
+   stores my result at its group ``my``), overlapping the combine with
+   the next GEMM;
+5. exit waits: all sends retired, all d-1 inbound output groups landed.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ddlb_tpu.ops.collective_matmul import _gemm_pipeline
+
+
+def _global_barrier(axis_name: str, d: int) -> None:
+    """Block until EVERY peer reached this point (all-pairs signal)."""
+    my = jax.lax.axis_index(axis_name)
+    barrier = pltpu.get_barrier_semaphore()
+
+    def signal(i, _):
+        peer = jax.lax.rem(my + i, d)
+        pltpu.semaphore_signal(
+            barrier,
+            inc=1,
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        return 0
+
+    jax.lax.fori_loop(1, d, signal, 0)
+    pltpu.semaphore_wait(barrier, d - 1)
+
+
+def _a2a_matmul_kernel(
+    a_hbm, w_hbm, disp_in, outb_in, o_hbm, disp_buf, out_buf,
+    send_disp, recv_disp, send_out, recv_out, copy_sem, acc_ref,
+    *, axis_name: str, d: int, bn: int, bk: int, interpret: bool = False,
+):
+    del disp_in, outb_in  # aliased landing/output buffers (HBM scratch
+    # cannot be allocated by this toolchain)
+    my = jax.lax.axis_index(axis_name)
+    m_loc, k = a_hbm.shape
+    g = m_loc // d
+    nsteps = k // bk
+
+    _global_barrier(axis_name, d)
+
+    # 2) launch every dispatch: my group e -> device e's landing slot [my]
+    def send_group(i, _):
+        peer = jax.lax.rem(my + i, d)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=a_hbm.at[pl.ds(peer * g, g), :],
+            dst_ref=disp_buf.at[my],
+            send_sem=send_disp.at[peer],
+            recv_sem=recv_disp.at[my],
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        return 0
+
+    jax.lax.fori_loop(1, d, send_group, 0)
+    # own group needs no wire: local copy into the landing slot
+    cp = pltpu.make_async_copy(
+        a_hbm.at[pl.ds(my * g, g), :], disp_buf.at[my], copy_sem
+    )
+    cp.start()
+    cp.wait()
+
+    # 3+4) GEMM each landed group, then fly its output home
+    def step(t, _):
+        s = jax.lax.rem(my + t, d)  # source whose tokens we process
+
+        @pl.when(t > 0)
+        def _arrived():
+            # the landing slot for source s carries its own recv credit
+            pltpu.make_async_copy(
+                disp_buf.at[s], disp_buf.at[s], recv_disp.at[s]
+            ).wait()
+
+        _gemm_pipeline(
+            disp_buf.at[s],
+            w_hbm,
+            out_buf.at[s],
+            nsteps=nsteps,
+            bn=bn,
+            bk=bk,
+            acc_ref=acc_ref,
+            interpret=interpret,
+        )
+
+        @pl.when(t > 0)
+        def _combine_remote():
+            # receiver s stores MY expert's output at ITS group index my;
+            # the recv credit is indexed by the SOURCE (my) so each
+            # arriving group lands on its own semaphore slot
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_buf.at[s],
+                dst_ref=o_hbm.at[pl.ds(my * g, g), :],
+                send_sem=send_out.at[s],
+                recv_sem=recv_out.at[my],
+                device_id=s,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        @pl.when(t == 0)
+        def _combine_local():
+            # started here, retired in the exit drain — a synchronous
+            # wait would stall the step-1 GEMM behind a g*n HBM copy
+            pltpu.make_async_copy(
+                out_buf.at[s], o_hbm.at[pl.ds(my * g, g), :], copy_sem
+            ).start()
+
+        return 0
+
+    jax.lax.fori_loop(0, d, step, 0)
+    # retire the local combine copy launched at step 0
+    pltpu.make_async_copy(
+        out_buf.at[my], o_hbm.at[pl.ds(my * g, g), :], copy_sem
+    ).wait()
+
+    # 5) retire everything before leaving: our outbound sends and the
+    # d-1 output groups other experts RDMA'd into our o_hbm
+    def drain(i, _):
+        peer = jax.lax.rem(my + i, d)
+        pltpu.make_async_copy(
+            a_hbm.at[pl.ds(peer * g, g), :],
+            a_hbm.at[pl.ds(peer * g, g), :],
+            send_disp.at[peer],
+        ).wait()
+        pltpu.make_async_copy(
+            out_buf.at[peer], out_buf.at[peer], send_out.at[peer]
+        ).wait()
+        pltpu.make_async_copy(
+            o_hbm.at[pl.ds(peer * g, g), :],
+            o_hbm.at[pl.ds(peer * g, g), :],
+            recv_out.at[peer],
+        ).wait()
+        return 0
+
+    jax.lax.fori_loop(1, d, drain, 0)
+
+
+def alltoall_expert_matmul(
+    a_shard,
+    w_expert,
+    *,
+    axis_name: str = "tp",
+    axis_size: int,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+    collective_id: int = 3,
+):
+    """Fused MoE dispatch/expert-GEMM/combine with kernel-level RDMA.
+
+    Call inside ``shard_map``: ``a_shard [m/d, k]`` (d contiguous routing
+    groups of g = m/d^2 tokens), ``w_expert [k, n]`` (the resident
+    expert) -> ``[m/d, n]`` in token order — the ep_alltoall contract
+    (primitives/ep_alltoall/base.py).
+    """
+    d = axis_size
+    m_loc, k = a_shard.shape
+    n = w_expert.shape[1]
+    if m_loc % d:
+        raise ValueError(f"m/d={m_loc} not divisible by d={d}")
+    g = m_loc // d
+    bn, bk = min(block_n, n), min(block_k, k)
+    if n % bn or k % bk:
+        raise ValueError(f"(n={n}, k={k}) not divisible by ({bn}, {bk})")
+    space = pltpu.VMEM if interpret else pltpu.ANY
+    kernel = functools.partial(
+        _a2a_matmul_kernel, axis_name=axis_name, d=d, bn=bn, bk=bk,
+        interpret=bool(interpret),
+    )
+    disp_init = jnp.zeros((d, g, k), a_shard.dtype)
+    outb_init = jnp.zeros((d, g, n), a_shard.dtype)
+    out, _, _ = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc, n), a_shard.dtype),
+            jax.ShapeDtypeStruct((d, g, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((d, g, n), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ),
+        # landing and output buffers ride as inputs 2/3 aliased to
+        # outputs 1/2
+        input_output_aliases={2: 1, 3: 2},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((d,)),            # dispatch sends
+            pltpu.SemaphoreType.DMA((d,)),            # dispatch recvs
+            pltpu.SemaphoreType.DMA((d,)),            # combine sends
+            pltpu.SemaphoreType.DMA((d,)),            # combine recvs
+            pltpu.SemaphoreType.DMA,                  # local copies
+            pltpu.VMEM((g, bn), jnp.float32),         # GEMM accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(a_shard, w_expert, disp_init, outb_init)
+    return out

@@ -1,0 +1,118 @@
+"""MoE dispatch/GEMM/combine as hand-written Pallas kernels.
+
+Closes the one collective-GEMM family gap in the hand-kernel slot
+(VERDICT r2 next-round #6): tp_columnwise and tp_rowwise have their RDMA
+rings; this member gives ep_alltoall the same treatment with two
+algorithms:
+
+- ``xla_collective``: explicit ``lax.all_to_all`` exchanges around the
+  framework's Pallas MXU GEMM (``ddlb_tpu.ops.matmul``) — kernel compute,
+  XLA comms.
+- ``a2a_rdma``: the whole primitive as ONE Pallas program
+  (``ddlb_tpu.ops.alltoall_matmul``) — dispatch RDMAs launch up front,
+  expert GEMMs run in arrival order, and each finished group's output
+  RDMAs straight home, all overlapped inside the kernel (the nvFuser
+  p2p ambition, /root/reference/ddlb/primitives/TPColumnwise/
+  fuser.py:102-146, applied to the all-to-all shape).
+
+Off-TPU both run in Pallas interpret mode (the RDMA path under the
+distributed TPU interpreter, ``detect_races=true`` sweepable — the same
+sanitizer story as the ring kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.alltoall_matmul import alltoall_expert_matmul
+from ddlb_tpu.ops.matmul import matmul
+from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+
+
+class PallasEPAllToAll(EPAllToAll):
+    # default matches the sibling tp pallas members (xla_collective), so
+    # the family's shared 'pallas' option surface behaves uniformly in
+    # sweeps; the RDMA program is the explicit algorithm=a2a_rdma choice
+    DEFAULT_OPTIONS = {
+        "algorithm": "xla_collective",
+        "block_m": 1024,
+        "block_n": 1024,
+        "block_k": 512,
+        "detect_races": False,
+    }
+    ALLOWED_VALUES = {
+        "algorithm": ["xla_collective", "a2a_rdma"],
+        "block_m": (128, None),
+        "block_n": (128, None),
+        "block_k": (128, None),
+        "detect_races": [True, False],
+    }
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        overridden = self._options_manager.overridden
+        if self.options["algorithm"] == "a2a_rdma":
+            dead = {"block_m"} & overridden
+        else:
+            dead = {"detect_races"} & overridden
+        if dead:
+            raise ValueError(
+                f"Option(s) {sorted(dead)} have no effect with "
+                f"algorithm={self.options['algorithm']!r}"
+            )
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        on_tpu = self.runtime.platform == "tpu"
+        opts = self.options
+        d, g = self.num_partitions, self.group_tokens
+
+        if opts["algorithm"] == "a2a_rdma":
+            interpret = False
+            if not on_tpu:
+                from jax.experimental.pallas import tpu as pltpu
+
+                interpret = pltpu.InterpretParams(
+                    detect_races=bool(opts["detect_races"])
+                )
+
+            def step(a_loc, w_loc):
+                return alltoall_expert_matmul(
+                    a_loc,
+                    w_loc[0],
+                    axis_size=d,
+                    block_n=min(opts["block_n"], self.n),
+                    block_k=min(opts["block_k"], self.k),
+                    interpret=interpret,
+                )
+
+        else:
+            blocks = dict(
+                block_m=min(opts["block_m"], d * g),
+                block_n=min(opts["block_n"], self.n),
+                block_k=min(opts["block_k"], self.k),
+                interpret=not on_tpu,
+            )
+
+            def step(a_loc, w_loc):
+                x = a_loc.reshape(d, g, self.k)
+                x = jax.lax.all_to_all(
+                    x, "tp", split_axis=0, concat_axis=0, tiled=True
+                )
+                y = matmul(x.reshape(d * g, self.k), w_loc[0], **blocks)
+                y = y.astype(a_loc.dtype).reshape(d, g, self.n)
+                y = jax.lax.all_to_all(
+                    y, "tp", split_axis=0, concat_axis=0, tiled=True
+                )
+                return y.reshape(d * g, self.n)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None), P("tp", None, None)),
+                out_specs=P("tp", None),
+                check_vma=False,
+            )
+        )

@@ -168,6 +168,12 @@ _REGISTRY = {
             "ddlb_tpu.primitives.ep_alltoall.quantized",
             "QuantizedEPAllToAll",
         ),
+        # hand-kernel slot: fused dispatch/expert-GEMM/combine RDMA
+        # program (ops/alltoall_matmul.py) or Pallas GEMM + XLA a2a
+        "pallas": (
+            "ddlb_tpu.primitives.ep_alltoall.pallas_impl",
+            "PallasEPAllToAll",
+        ),
     },
     # the flagship model's full train/forward step through the same
     # runner — the composition the GEMM primitives exist to accelerate

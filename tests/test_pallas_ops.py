@@ -284,3 +284,42 @@ def test_ring_matmul_rs_wire_dtype_pin():
             acc = part if acc is None else (part + acc)  # bf16 fold
         sim[c * m_loc:(c + 1) * m_loc] = np.asarray(acc.astype(jnp.float32))
     np.testing.assert_array_equal(out, sim)
+
+
+from ddlb_tpu.ops.alltoall_matmul import alltoall_expert_matmul  # noqa: E402
+
+
+@pytest.mark.parametrize("d", [2, 4, 8])
+def test_alltoall_expert_matmul(d):
+    """Kernel-level all-to-all: group e of each device's rows through
+    expert e, token order preserved — checked against the blocked einsum
+    oracle at d ring sizes (race detector on: the protocol has no credit
+    gating, so the detector guards the slot-distinctness argument)."""
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:d]), ("tp",))
+    m, n, k = 8 * d * d, 32, 32
+    g = m // (d * d)
+    rng = np.random.default_rng(7)
+    a = rng.uniform(-1, 1, (m, k)).astype(np.float32)
+    w = rng.uniform(-1, 1, (d, k, n)).astype(np.float32)
+    f = jax.jit(
+        jax.shard_map(
+            lambda a_s, w_s: alltoall_expert_matmul(
+                a_s, w_s[0], axis_size=d, block_n=32, block_k=32,
+                interpret=pltpu.InterpretParams(detect_races=True),
+            ),
+            mesh=mesh,
+            in_specs=(P("tp", None), P("tp", None, None)),
+            out_specs=P("tp", None),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(
+        f(
+            jax.device_put(a, NamedSharding(mesh, P("tp", None))),
+            jax.device_put(w, NamedSharding(mesh, P("tp", None, None))),
+        )
+    )
+    want = np.einsum(
+        "pegk,ekn->pegn", a.reshape(d, d, g, k), w
+    ).reshape(m, n)
+    np.testing.assert_allclose(out, want, rtol=0, atol=1e-4)
