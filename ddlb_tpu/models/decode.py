@@ -7,8 +7,11 @@ query token against a long cache is HBM-bandwidth-bound (every step re-reads
 the whole K/V cache and every weight), so tokens/s tracks bytes/token,
 not FLOPs. The module provides:
 
-- ``init_cache`` — the sharded K/V cache pytree ``[L, B, S_max, H, dh]``
-  (heads sharded over ``tp``, batch over ``dp``).
+- ``init_cache`` — the sharded K/V cache pytree ``[L, B, S_max, H_kv,
+  dh]`` (kv heads sharded over ``tp``, batch over ``dp``; ``H_kv <
+  n_heads`` under GQA, and ``kv_cache='int8'`` stores int8 payloads +
+  per-(position, head) scales at half the bytes — the two cache-read
+  levers of the bandwidth-bound regime).
 - ``make_prefill_fn`` — the full-sequence forward that fills the cache
   for a prompt and returns the last position's logits (compute-bound
   phase).
@@ -57,41 +60,164 @@ def _ffn_scales(params, l, e, cfg):
     )
 
 
+_KV_QMAX = 127.0
+
+
+def _quantize_kv(x):
+    """Symmetric per-(position, head) int8 over the feature axis:
+    ``x [..., dh] ~ q * s`` with ``q`` int8 and ``s [..., 1]`` f32."""
+    xf = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / _KV_QMAX
+    s = jnp.maximum(s, jnp.float32(1e-30))  # all-zero row guard
+    q = jnp.clip(jnp.round(xf / s), -_KV_QMAX, _KV_QMAX).astype(jnp.int8)
+    return q, s
+
+
+def _kv_roundtrip(x):
+    """Quantize-dequantize in one step — the value every cache READ sees
+    under ``kv_cache='int8'``; shared by the serving paths and the oracle
+    so their numerics agree bitwise."""
+    q, s = _quantize_kv(x)
+    return (q.astype(jnp.float32) * s).astype(x.dtype)
+
+
 def init_cache(
     cfg: TransformerConfig, batch: int, max_len: int, mesh=None
 ) -> Dict[str, jax.Array]:
-    """Zeroed K/V cache ``[L, B, S_max, H, dh]`` (+ sharded when a mesh is
-    given: batch over dp, heads over tp)."""
+    """Zeroed K/V cache ``[L, B, S_max, H_kv, dh]`` (+ sharded when a mesh
+    is given: batch over dp, heads over tp). Under GQA the cache carries
+    ``n_kv_heads`` heads — the whole point: per-token HBM read shrinks by
+    the group factor. ``cfg.kv_cache='int8'`` stores int8 payloads plus
+    f32 per-(position, head) scales — half the bytes again."""
     shape = (
         cfg.layers_per_stage,
         batch,
         max_len,
-        cfg.n_heads,
+        cfg.kv_heads,
         cfg.head_dim,
     )
-    k = jnp.zeros(shape, cfg.dtype)
-    v = jnp.zeros(shape, cfg.dtype)
+    if cfg.kv_cache == "int8":
+        cache = {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
+    elif cfg.kv_cache == "bf16":
+        cache = {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+    else:
+        raise ValueError(f"unknown kv_cache '{cfg.kv_cache}'")
     if mesh is not None:
-        sh = NamedSharding(mesh, P(None, "dp", None, "tp", None))
-        k, v = jax.device_put(k, sh), jax.device_put(v, sh)
-    return {"k": k, "v": v}
+        specs = cache_specs(cfg)
+        cache = {
+            name: jax.device_put(arr, NamedSharding(mesh, specs[name]))
+            for name, arr in cache.items()
+        }
+    return cache
 
 
-def cache_specs() -> Dict[str, P]:
-    return {
-        "k": P(None, "dp", None, "tp", None),
-        "v": P(None, "dp", None, "tp", None),
-    }
+def cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    spec = P(None, "dp", None, "tp", None)
+    specs = {"k": spec, "v": spec}
+    if cfg.kv_cache == "int8":
+        specs["k_scale"] = spec
+        specs["v_scale"] = spec
+    return specs
 
 
-def _project_qkv(h, w_qkv_l, b, t, h_loc, dh, dtype):
-    """[b, t, D] -> three [b, t, h_loc, dh] local-head projections."""
+def _project_qkv(h, params, l, b, t, h_loc, kv_loc, dh, dtype):
+    """[b, t, D] -> ``q [b, t, h_loc, dh]`` and ``k, v [b, t, kv_loc, dh]``
+    local-head projections, from either the fused MHA stack (``w_qkv``)
+    or the split GQA pair (``w_q``/``w_kv``)."""
+    if "w_qkv" in params:
+        w = params["w_qkv"][0, l]
+        q, k, v = (
+            jnp.matmul(h, w[i], preferred_element_type=jnp.float32)
+            .astype(dtype)
+            for i in range(3)
+        )
+    else:
+        q = jnp.matmul(
+            h, params["w_q"][0, l], preferred_element_type=jnp.float32
+        ).astype(dtype)
+        k, v = (
+            jnp.matmul(
+                h, params["w_kv"][0, l, i], preferred_element_type=jnp.float32
+            ).astype(dtype)
+            for i in range(2)
+        )
     return (
-        jnp.matmul(h, w_qkv_l[i], preferred_element_type=jnp.float32)
-        .astype(dtype)
-        .reshape(b, t, h_loc, dh)
-        for i in range(3)
+        q.reshape(b, t, h_loc, dh),
+        k.reshape(b, t, kv_loc, dh),
+        v.reshape(b, t, kv_loc, dh),
     )
+
+
+def _grouped_scores(q, ck_l, dh):
+    """Decode scores against the kv-head cache: ``q [b, 1, h, dh]``
+    grouped as ``[b, 1, h_kv, G, dh]`` -> ``[b, h_kv, G, 1, S]`` f32."""
+    b, t, h, _ = q.shape
+    h_kv = ck_l.shape[2]
+    G = h // h_kv
+    q5 = q.astype(jnp.float32).reshape(b, t, h_kv, G, dh) / np.sqrt(dh)
+    return jnp.einsum("bqhgd,bkhd->bhgqk", q5, ck_l.astype(jnp.float32))
+
+
+def _grouped_attend(p, cv_l, b, t, dtype):
+    """``p [b, h_kv, G, 1, S]`` x cache values -> ``[b, t, h, dh]``
+    (query-head order hq = kvh * G + g, matching the kernels)."""
+    attn = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv_l.astype(jnp.float32))
+    return attn.reshape(b, t, -1).astype(dtype)
+
+
+def _cache_write(cache, l, pos, k, v, int8):
+    """Store this step's ``k``/``v [b, t, h_kv, dh]`` at ``(l, :, pos)``
+    (quantizing first in int8 mode)."""
+    def upd(name, val):
+        cache[name] = jax.lax.dynamic_update_slice(
+            cache[name], val[None], (l, 0, pos, 0, 0)
+        )
+
+    if int8:
+        qk, sk = _quantize_kv(k)
+        qv, sv = _quantize_kv(v)
+        upd("k", qk)
+        upd("k_scale", sk)
+        upd("v", qv)
+        upd("v_scale", sv)
+    else:
+        upd("k", k)
+        upd("v", v)
+    return cache
+
+
+def _cache_read(cache, name, l, dtype):
+    """Cache layer ``l``, dequantized in int8 mode. The convert+scale is
+    an elementwise producer XLA fuses into the consuming einsum, so HBM
+    still reads the int8 payload; rounding to ``dtype`` reproduces
+    ``_kv_roundtrip`` bit-for-bit — scale-folding into the scores instead
+    would introduce 1e-7 f32 skew that flips int8 round() buckets at the
+    NEXT layer's cache write (observed: 2e-3 logits drift at 2 layers)."""
+    arr = cache[name][l]
+    scale = cache.get(f"{name}_scale")
+    if scale is None:
+        return arr
+    return (arr.astype(jnp.float32) * scale[l]).astype(dtype)
+
+
+def _cache_attend(q, cache, l, dh, pos, dtype):
+    """One query row against cache layer ``l``: grouped scores,
+    live-position mask at ``pos``, softmax, value read."""
+    b = q.shape[0]
+    S_max = cache["k"].shape[2]
+    s = _grouped_scores(q, _cache_read(cache, "k", l, dtype), dh)
+    live = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
+    s = jnp.where(live[None, None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return _grouped_attend(p, _cache_read(cache, "v", l, dtype), b, 1, dtype)
 
 
 def _routed_moe(h2d, params, cfg, l, B, dp, tp):
@@ -165,45 +291,34 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
         )
     if cfg.n_heads % tp != 0:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    if cfg.kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
+        )
     L = cfg.layers_per_stage
     h_loc = cfg.n_heads // tp
+    kv_loc = cfg.kv_heads // tp
     dh = cfg.head_dim
 
-    def body(params, ck, cv, tokens, pos):
+    int8_cache = cfg.kv_cache == "int8"
+
+    def body(params, cache, tokens, pos):
         b = tokens.shape[0]  # local batch (B/dp)
         if b % tp != 0:
             raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
-        S_max = ck.shape[2]
         x = params["embed"][tokens][:, None, :]  # [b, 1, D]
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
-                h, params["w_qkv"][0, l], b, 1, h_loc, dh, x.dtype
+                h, params, l, b, 1, h_loc, kv_loc, dh, x.dtype
             )
-            ck = jax.lax.dynamic_update_slice(
-                ck, k[None], (l, 0, pos, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v[None], (l, 0, pos, 0, 0)
-            )
-            # q [b, 1, h, dh] against the whole cache row; positions past
-            # ``pos`` are masked (zeros in the cache never win anyway, but
-            # the mask keeps softmax exact)
-            s = jnp.einsum(
-                "bqhd,bkhd->bhqk",
-                q.astype(jnp.float32) / np.sqrt(dh),
-                ck[l].astype(jnp.float32),
-            )  # [b, h, 1, S_max]
-            live = (
-                jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
-            )
-            s = jnp.where(live[None, None, None], s, -1e30)
-            p = jax.nn.softmax(s, axis=-1)
-            attn = jnp.einsum(
-                "bhqk,bkhd->bqhd", p, cv[l].astype(jnp.float32)
-            ).astype(x.dtype)
+            cache = _cache_write(cache, l, pos, k, v, int8_cache)
+            # q [b, 1, h, dh] grouped against the kv-head cache row;
+            # positions past ``pos`` are masked (zeros in the cache never
+            # win anyway, but the mask keeps softmax exact)
+            attn = _cache_attend(q, cache, l, dh, pos, x.dtype)
             part = jnp.matmul(
-                attn.reshape(b, 1, h_loc * dh),
+                attn,
                 params["w_o"][0, l],
                 preferred_element_type=jnp.float32,
             )
@@ -216,7 +331,7 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
         logits = jnp.matmul(
             h[:, 0], params["head"], preferred_element_type=jnp.float32
         )
-        return logits, ck, cv
+        return logits, cache
 
     from ddlb_tpu.models.transformer import param_specs
 
@@ -227,21 +342,22 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
         name: P(*[None if ax == "pp" else ax for ax in spec])
         for name, spec in specs.items()
     }
-    cspecs = cache_specs()
+    cspecs = cache_specs(cfg)
 
     def step(params, cache, tokens, pos):
-        logits, ck, cv = jax.shard_map(
+        return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(specs, cspecs["k"], cspecs["v"], P("dp"), P()),
-            out_specs=(P("dp", None), cspecs["k"], cspecs["v"]),
+            in_specs=(specs, cspecs, P("dp"), P()),
+            out_specs=(P("dp", None), cspecs),
             check_vma=False,
-        )(params, cache["k"], cache["v"], tokens, pos)
-        return logits, {"k": ck, "v": cv}
+        )(params, cache, tokens, pos)
 
     shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
-    shardings["cache_k"] = NamedSharding(mesh, cspecs["k"])
-    shardings["cache_v"] = NamedSharding(mesh, cspecs["v"])
+    # every cache leaf (incl. the int8 scale entries), prefixed to avoid
+    # param-name collisions
+    for name, spec in cspecs.items():
+        shardings[f"cache_{name}"] = NamedSharding(mesh, spec)
     shardings["tokens"] = NamedSharding(mesh, P("dp"))
     return step, shardings
 
@@ -267,15 +383,22 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
         )
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
+    if cfg.kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
+        )
     L = cfg.layers_per_stage
     h_loc = cfg.n_heads // tp
+    kv_loc = cfg.kv_heads // tp
     dh = cfg.head_dim
 
     from ddlb_tpu.models.transformer import _causal_attention, _flash_full
 
     interpret = jax.default_backend() != "tpu"
 
-    def body(params, ck, cv, tokens):
+    int8_cache = cfg.kv_cache == "int8"
+
+    def body(params, cache, tokens):
         b, S = tokens.shape
         if b % tp != 0:
             raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
@@ -283,14 +406,15 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
-                h, params["w_qkv"][0, l], b, S, h_loc, dh, x.dtype
+                h, params, l, b, S, h_loc, kv_loc, dh, x.dtype
             )
-            ck = jax.lax.dynamic_update_slice(
-                ck, k[None], (l, 0, 0, 0, 0)
-            )
-            cv = jax.lax.dynamic_update_slice(
-                cv, v[None], (l, 0, 0, 0, 0)
-            )
+            cache = _cache_write(cache, l, 0, k, v, int8_cache)
+            if int8_cache:
+                # prompt attention reads the same dequantized values the
+                # later decode steps will — one consistent serving
+                # numerics, exactly reproducible by the oracle
+                k = _kv_roundtrip(k)
+                v = _kv_roundtrip(v)
             if cfg.attn_kernel == "flash":
                 attn = _flash_full(q, k, v, interpret).reshape(
                     b, S, h_loc * dh
@@ -312,7 +436,7 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
         logits = jnp.matmul(
             h[:, -1], params["head"], preferred_element_type=jnp.float32
         )
-        return logits, ck, cv
+        return logits, cache
 
     from ddlb_tpu.models.transformer import param_specs
 
@@ -321,17 +445,16 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
         name: P(*[None if ax == "pp" else ax for ax in spec])
         for name, spec in specs.items()
     }
-    cspecs = cache_specs()
+    cspecs = cache_specs(cfg)
 
     def prefill(params, cache, tokens):
-        logits, ck, cv = jax.shard_map(
+        return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(specs, cspecs["k"], cspecs["v"], P("dp", None)),
-            out_specs=(P("dp", None), cspecs["k"], cspecs["v"]),
+            in_specs=(specs, cspecs, P("dp", None)),
+            out_specs=(P("dp", None), cspecs),
             check_vma=False,
-        )(params, cache["k"], cache["v"], tokens)
-        return logits, {"k": ck, "v": cv}
+        )(params, cache, tokens)
 
     shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
     shardings["tokens"] = NamedSharding(mesh, P("dp", None))
@@ -346,38 +469,28 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
     annotations when the returned callables are jitted over a mesh (the
     transformer_decode xla_gspmd member), and they double as the oracle
     building blocks. Returns ``(decode_fwd, prefill_fwd)`` with
-    ``decode_fwd(params, ck, cv, tokens, pos) -> logits`` and
-    ``prefill_fwd(params, ck, cv, tokens) -> (logits, ck, cv)``.
+    ``decode_fwd(params, cache, tokens, pos) -> logits`` and
+    ``prefill_fwd(params, cache, tokens) -> (logits, cache)``.
     """
     from ddlb_tpu.models.transformer import _causal_attention
 
     B = batch
     L, H, dh = cfg.layers_per_stage, cfg.n_heads, cfg.head_dim
+    H_kv = cfg.kv_heads
+    int8_cache = cfg.kv_cache == "int8"
 
-    def decode_fwd(params, ck, cv, tokens, pos):
+    def decode_fwd(params, cache, tokens, pos):
+        cache = dict(cache)
         x = params["embed"][tokens][:, None, :]  # [B, 1, D]
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
-                h, params["w_qkv"][0, l], B, 1, H, dh, x.dtype
+                h, params, l, B, 1, H, H_kv, dh, x.dtype
             )
-            ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, pos, 0, 0))
-            S_max = ck.shape[2]
-            s = jnp.einsum(
-                "bqhd,bkhd->bhqk",
-                q.astype(jnp.float32) / np.sqrt(dh),
-                ck[l].astype(jnp.float32),
-            )
-            live = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
-            s = jnp.where(live[None, None, None], s, -1e30)
-            attn = jnp.einsum(
-                "bhqk,bkhd->bqhd",
-                jax.nn.softmax(s, axis=-1),
-                cv[l].astype(jnp.float32),
-            ).astype(x.dtype)
+            cache = _cache_write(cache, l, pos, k, v, int8_cache)
+            attn = _cache_attend(q, cache, l, dh, pos, x.dtype)
             x = x + jnp.matmul(
-                attn.reshape(B, 1, H * dh),
+                attn,
                 params["w_o"][0, l],
                 preferred_element_type=jnp.float32,
             ).astype(x.dtype)
@@ -389,16 +502,19 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
             h[:, 0], params["head"], preferred_element_type=jnp.float32
         )
 
-    def prefill_fwd(params, ck, cv, tokens):
+    def prefill_fwd(params, cache, tokens):
+        cache = dict(cache)
         B_, S = tokens.shape
         x = params["embed"][tokens]
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
-                h, params["w_qkv"][0, l], B_, S, H, dh, x.dtype
+                h, params, l, B_, S, H, H_kv, dh, x.dtype
             )
-            ck = jax.lax.dynamic_update_slice(ck, k[None], (l, 0, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v[None], (l, 0, 0, 0, 0))
+            cache = _cache_write(cache, l, 0, k, v, int8_cache)
+            if int8_cache:
+                k = _kv_roundtrip(k)
+                v = _kv_roundtrip(v)
             attn = _causal_attention(q, k, v).reshape(B_, S, H * dh)
             x = x + jnp.matmul(
                 attn, params["w_o"][0, l], preferred_element_type=jnp.float32
@@ -410,13 +526,18 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
         logits = jnp.matmul(
             h[:, -1], params["head"], preferred_element_type=jnp.float32
         )
-        return logits, ck, cv
+        return logits, cache
 
     return decode_fwd, prefill_fwd
 
 
 def make_generate_fn(
-    mesh, cfg: TransformerConfig, n_new: int, temperature: float = 0.0
+    mesh,
+    cfg: TransformerConfig,
+    n_new: int,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Autoregressive generation, one jitted program.
 
@@ -426,21 +547,49 @@ def make_generate_fn(
     compiles once; the cache and the sampled token thread the carry).
     ``temperature=0`` samples the argmax (greedy, no key needed);
     ``temperature>0`` draws from ``softmax(logits / temperature)`` with a
-    per-step fold of the caller's PRNG key. The cache must hold
-    ``S0 + n_new`` positions.
+    per-step fold of the caller's PRNG key, optionally restricted to the
+    ``top_k`` highest logits and/or the smallest set of tokens whose
+    cumulative probability reaches ``top_p`` (nucleus sampling; the
+    first-past-the-threshold token is always kept, so the set is never
+    empty). The cache must hold ``S0 + n_new`` positions.
     """
     if n_new < 1:
         # n_new=0 would write the post-loop sample at column S0-1,
         # silently overwriting the last prompt token
         raise ValueError(f"n_new must be >= 1, got {n_new}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0 or top_k > cfg.vocab:
+        raise ValueError(f"top_k must be in [0, vocab], got {top_k}")
     decode, shardings = make_decode_fn(mesh, cfg)
     prefill, _ = make_prefill_fn(mesh, cfg)
+
+    def _restrict(logits):
+        """Mask logits outside the top-k set / the top-p nucleus."""
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            # exclusive cumulative mass BEFORE each token: a token enters
+            # the nucleus iff the mass before it is < top_p (the
+            # first-past-the-threshold token stays)
+            before = jnp.cumsum(probs, axis=-1) - probs
+            kept = before < top_p
+            # smallest kept logit = the acceptance threshold
+            thr = jnp.min(
+                jnp.where(kept, srt, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits < thr, -jnp.inf, logits)
+        return logits
 
     def sample(logits, key, step):
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = _restrict(logits.astype(jnp.float32) / temperature)
         return jax.random.categorical(
-            jax.random.fold_in(key, step), logits / temperature, axis=-1
+            jax.random.fold_in(key, step), logits, axis=-1
         ).astype(jnp.int32)
 
     def generate(params, cache, prompt, key=None):
@@ -512,14 +661,15 @@ def reference_logits(
     D = cfg.d_model
     for l in range(L):
         h = _rms_norm(x, params["ln1"][0, l])
-        q, k, v = (
-            jnp.matmul(
-                h, params["w_qkv"][0, l][i], preferred_element_type=jnp.float32
-            )
-            .astype(x.dtype)
-            .reshape(B, S, cfg.n_heads, cfg.head_dim)
-            for i in range(3)
+        q, k, v = _project_qkv(
+            h, params, l, B, S, cfg.n_heads, cfg.kv_heads,
+            cfg.head_dim, x.dtype,
         )
+        if cfg.kv_cache == "int8":
+            # the serving paths attend dequantized cache entries; the
+            # oracle applies the identical per-(position, head) rounding
+            k = _kv_roundtrip(k)
+            v = _kv_roundtrip(v)
         attn = _causal_attention(q, k, v).reshape(B, S, D)
         x = x + jnp.matmul(
             attn, params["w_o"][0, l], preferred_element_type=jnp.float32

@@ -47,6 +47,12 @@ class TransformerConfig:
     vocab: int = 64
     d_model: int = 32
     n_heads: int = 4
+    #: 0 = MHA (n_kv_heads == n_heads). Smaller = grouped-query attention:
+    #: K/V carry n_kv_heads heads shared by groups of n_heads/n_kv_heads
+    #: query heads — the flash kernels read the shared KV tile straight
+    #: from the head index map and the decode cache shrinks by the group
+    #: factor (ops/flash_attention.py GQA support).
+    n_kv_heads: int = 0
     d_ff: int = 64
     layers_per_stage: int = 1
     microbatches: int = 2
@@ -86,12 +92,30 @@ class TransformerConfig:
     #: ceil(capacity_factor * k * T_loc / E) slots
     capacity_factor: float = 1.25
     router_aux: float = 0.01
+    #: K/V cache precision for the serving paths (models/decode.py):
+    #: "bf16" stores the operand dtype; "int8" stores symmetric per-
+    #: (position, head) int8 with f32 scales — halves the cache bytes the
+    #: bandwidth-bound decode step re-reads every token, dequantized on
+    #: the fly inside the score/value einsums. Training paths ignore it.
+    kv_cache: str = "bf16"
     dtype: Any = jnp.float32
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        h_kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % h_kv == 0, (
+            f"n_heads={self.n_heads} not divisible by n_kv_heads={h_kv}"
+        )
+        return h_kv
+
+    @property
+    def kv_dim(self) -> int:
+        return self.kv_heads * self.head_dim
 
 
 def init_params(
@@ -109,9 +133,6 @@ def init_params(
     s_ff = (1.0 / F) ** 0.5
     params = {
         "embed": normal((V, D), 1.0),
-        # leading 3 = Q/K/V so a tp column-shard is per-projection heads,
-        # not a contiguous slice across the fused [D, 3D] layout
-        "w_qkv": normal((pp, L, 3, D, D), s_in),
         "w_o": normal((pp, L, D, D), s_in),
         "moe_w1": normal((pp, L, n_experts, D, F), s_in),
         "moe_w2": normal((pp, L, n_experts, F, D), s_ff),
@@ -120,6 +141,14 @@ def init_params(
         "ln_f": jnp.ones((D,), cfg.dtype),
         "head": normal((D, V), s_in),
     }
+    if cfg.kv_heads == cfg.n_heads:
+        # leading 3 = Q/K/V so a tp column-shard is per-projection heads,
+        # not a contiguous slice across the fused [D, 3D] layout
+        params["w_qkv"] = normal((pp, L, 3, D, D), s_in)
+    else:
+        # GQA: K/V project to n_kv_heads * head_dim columns
+        params["w_q"] = normal((pp, L, D, D), s_in)
+        params["w_kv"] = normal((pp, L, 2, D, cfg.kv_dim), s_in)
     if cfg.router == "topk":
         # learned gate, one logit per expert; kept in float32 so the
         # softmax/top-k selection is bit-identical between the sharded
@@ -150,11 +179,6 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
     in ring mode ``tp`` acts purely as the context-parallel axis for
     attention — K/V chunks move, weights don't — while the MoE FFN still
     uses it as the expert axis."""
-    attn_qkv = (
-        P("pp", None, None, None, None)
-        if cfg.attention == "ring"
-        else P("pp", None, None, None, "tp")
-    )
     attn_o = (
         P("pp", None, None, None)
         if cfg.attention == "ring"
@@ -162,7 +186,6 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
     )
     specs = {
         "embed": P(None, None),
-        "w_qkv": attn_qkv,
         "w_o": attn_o,
         "moe_w1": P("pp", None, "tp", None, None),
         "moe_w2": P("pp", None, "tp", None, None),
@@ -171,6 +194,21 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         "ln_f": P(None),
         "head": P(None, None),
     }
+    if cfg.kv_heads == cfg.n_heads:
+        specs["w_qkv"] = (
+            P("pp", None, None, None, None)
+            if cfg.attention == "ring"
+            else P("pp", None, None, None, "tp")
+        )
+    else:
+        if cfg.attention == "ring":
+            raise ValueError(
+                "attention='ring' is MHA-only (the ringed K/V chunks are "
+                "projected per-rank with replicated full-head weights); "
+                "GQA uses attention='gathered'"
+            )
+        specs["w_q"] = P("pp", None, None, "tp")
+        specs["w_kv"] = P("pp", None, None, None, "tp")
     if cfg.router == "topk":
         # every rank routes its own token shard: gate replicated over tp
         specs["router"] = P("pp", None, None, None)
@@ -189,7 +227,13 @@ def _rms_norm(x, scale):
 
 def _causal_attention(q, k, v):
     """[b, S, h, dh] f32 causal softmax attention (full gathered sequence,
-    local heads)."""
+    local heads). ``k``/``v`` may carry fewer (grouped/GQA) heads — they
+    are repeated up to the query head count (exact: repetition and
+    grouped attention compute identical dot products)."""
+    if k.shape[2] != q.shape[2]:
+        G = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, G, axis=2)
+        v = jnp.repeat(v, G, axis=2)
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
     s = s * scale
@@ -263,12 +307,14 @@ def _flash_full(q, k, v, interpret):
 
     The batch dim merges into the kernel's head grid (heads are
     independent and the causal mask depends only on sequence position),
-    so no vmap of the pallas call is needed.
+    so no vmap of the pallas call is needed. ``k``/``v`` may carry fewer
+    (GQA) heads: the merged layouts stay group-aligned because
+    ``(b_idx*h + qh) // G == b_idx*h_kv + qh // G`` exactly.
     """
     from ddlb_tpu.ops.flash_attention import flash_attention
 
     b, S, h, dh = q.shape
-    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(S, b * h, dh)
+    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(S, b * x.shape[2], dh)
     o = flash_attention(
         merge(q), merge(k), merge(v),
         scale=1.0 / np.sqrt(dh),
@@ -449,8 +495,8 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
             )
         for l in range(L):
             h = _rms_norm(x, sp["ln1"][0, l])
-            wq = sp["w_qkv"][0, l]  # [3, D, D/tp]: local heads per projection
             if cfg.attention == "ring":
+                wq = sp["w_qkv"][0, l]  # [3, D, D]: replicated full heads
                 # -- context-parallel attention (cp_ring_attention
                 # pattern): full-head QKV projected on the LOCAL sequence
                 # chunk (replicated weights — see param_specs), K/V chunks
@@ -476,22 +522,39 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                 # -- Megatron sp (tp_columnwise -> heads-local ->
                 # tp_rowwise) --
                 h_full = jax.lax.all_gather(h, "tp", axis=1, tiled=True)
-                q, k, v = (
-                    jnp.matmul(
-                        h_full, wq[i], preferred_element_type=jnp.float32
+                if cfg.kv_heads == cfg.n_heads:
+                    wq = sp["w_qkv"][0, l]  # [3, D, D/tp]: local heads
+                    q, k, v = (
+                        jnp.matmul(
+                            h_full, wq[i], preferred_element_type=jnp.float32
+                        ).astype(x.dtype)
+                        for i in range(3)
+                    )
+                else:
+                    # GQA: K/V project to the rank's kv-head columns
+                    q = jnp.matmul(
+                        h_full, sp["w_q"][0, l],
+                        preferred_element_type=jnp.float32,
                     ).astype(x.dtype)
-                    for i in range(3)
-                )
+                    k, v = (
+                        jnp.matmul(
+                            h_full, sp["w_kv"][0, l, i],
+                            preferred_element_type=jnp.float32,
+                        ).astype(x.dtype)
+                        for i in range(2)
+                    )
                 S = q.shape[1]
+                kv_loc = cfg.kv_heads // tp
                 shape = (b, S, h_heads, cfg.head_dim)
+                kshape = (b, S, kv_loc, cfg.head_dim)
                 if cfg.attn_kernel == "flash":
                     attn = _flash_full(
-                        q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                        q.reshape(shape), k.reshape(kshape), v.reshape(kshape),
                         interpret,
                     ).reshape(b, S, -1)  # [b, S, D/tp]
                 else:
                     attn = _causal_attention(
-                        q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                        q.reshape(shape), k.reshape(kshape), v.reshape(kshape)
                     ).reshape(b, S, -1)  # [b, S, D/tp]
                 part = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
@@ -602,6 +665,10 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
         if cfg.attention != "ring" and cfg.n_heads % tp != 0:
             raise ValueError(
                 f"n_heads={cfg.n_heads} not divisible by tp={tp}"
+            )
+        if cfg.attention != "ring" and cfg.kv_heads % tp != 0:
+            raise ValueError(
+                f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
             )
         s_loc = S // tp
         b_mb = B_loc // mb
@@ -743,7 +810,7 @@ def reference_loss(
     b_mb = B // (dp * cfg.microbatches)
     s_loc = S // tp
     D = cfg.d_model
-    pp, L = params["w_qkv"].shape[:2]
+    pp, L = params["ln1"].shape[:2]
     losses = []
     aux_sum = jnp.zeros((), jnp.float32)
     for c0 in range(0, B, b_mb):
@@ -751,17 +818,31 @@ def reference_loss(
         for st in range(pp):
             for l in range(L):
                 h = _rms_norm(x, params["ln1"][st, l])
-                q, k, v = (
-                    jnp.matmul(
-                        h,
-                        params["w_qkv"][st, l, i],
+                if cfg.kv_heads == cfg.n_heads:
+                    q, k, v = (
+                        jnp.matmul(
+                            h,
+                            params["w_qkv"][st, l, i],
+                            preferred_element_type=jnp.float32,
+                        ).astype(x.dtype)
+                        for i in range(3)
+                    )
+                else:
+                    q = jnp.matmul(
+                        h, params["w_q"][st, l],
                         preferred_element_type=jnp.float32,
                     ).astype(x.dtype)
-                    for i in range(3)
-                )
+                    k, v = (
+                        jnp.matmul(
+                            h, params["w_kv"][st, l, i],
+                            preferred_element_type=jnp.float32,
+                        ).astype(x.dtype)
+                        for i in range(2)
+                    )
                 shape = (b_mb, S, cfg.n_heads, cfg.head_dim)
+                kshape = (b_mb, S, cfg.kv_heads, cfg.head_dim)
                 attn = _causal_attention(
-                    q.reshape(shape), k.reshape(shape), v.reshape(shape)
+                    q.reshape(shape), k.reshape(kshape), v.reshape(kshape)
                 ).reshape(b_mb, S, D)
                 x = x + jnp.matmul(
                     attn, params["w_o"][st, l], preferred_element_type=jnp.float32

@@ -69,7 +69,7 @@ def _online_softmax_update(
 
 def _flash_kernel(
     off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, block_q: int, block_kv: int,
+    *, scale: float, block_q: int, block_kv: int, causal: bool = True,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -88,13 +88,17 @@ def _flash_kernel(
     q_start = row_offset + qi * block_q
     k_start = kj * block_kv
 
-    @pl.when(q_start + block_q - 1 >= k_start)
-    def _compute():
+    def _do_update():
         m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
             q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
             scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_kv=block_kv,
+            block_q=block_q, block_kv=block_kv, masked=causal,
         )
+
+    if causal:
+        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    else:
+        _do_update()  # non-causal: every tile is live, no mask
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _flush():
@@ -370,17 +374,36 @@ def _flash_kernel_tri(
         )
 
 
-def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
-    """Forward pallas call; returns ``(o [sq, h, dh], lse [h, sq, 1] f32)``."""
+def _gqa_group(q, k):
+    """Query-heads-per-kv-head ratio G (1 = MHA). Shapes are head-minor:
+    ``q [sq, h, dh]``, ``k [skv, h_kv, dh]``."""
+    h, h_kv = q.shape[1], k.shape[1]
+    if h % h_kv:
+        raise ValueError(
+            f"n_heads={h} not divisible by n_kv_heads={h_kv} (GQA groups)"
+        )
+    return h // h_kv
+
+
+def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
+                   causal=True):
+    """Forward pallas call; returns ``(o [sq, h, dh], lse [h, sq, 1] f32)``.
+
+    GQA: ``k``/``v`` may carry ``h_kv = h/G`` heads — query head ``hh``
+    reads kv head ``hh // G`` straight from the BlockSpec index map, so
+    grouped heads share one VMEM-resident KV tile and the kernel body is
+    unchanged. ``causal=False`` visits every tile unmasked.
+    """
     sq, h, dh = q.shape
     skv = k.shape[0]
+    G = _gqa_group(q, k)
     bq, bkv = min(block_q, sq), min(block_kv, skv)
     if sq % bq or skv % bkv:
         raise ValueError(
             f"(sq={sq}, skv={skv}) not divisible by blocks ({bq}, {bkv})"
         )
     qh = q.transpose(1, 0, 2)  # [h, sq, dh]
-    kh = k.transpose(1, 0, 2)
+    kh = k.transpose(1, 0, 2)  # [h_kv, skv, dh]
     vh = v.transpose(1, 0, 2)
     out_shape = [
         jax.ShapeDtypeStruct((h, sq, dh), q.dtype),
@@ -391,7 +414,7 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
         pltpu.VMEM((bq, 1), jnp.float32),   # running max
         pltpu.VMEM((bq, 1), jnp.float32),   # running sum
     ]
-    if _use_triangular(row_offset, sq, skv):
+    if causal and _use_triangular(row_offset, sq, skv):
         n = sq // bq
         qi_of, kj_of = _tri_maps_lower(n, bq, bkv)
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -399,8 +422,12 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
             grid=(h, int(qi_of.shape[0])),
             in_specs=[
                 pl.BlockSpec((1, bq, dh), lambda hh, t, qi, kj: (hh, qi[t], 0)),
-                pl.BlockSpec((1, bkv, dh), lambda hh, t, qi, kj: (hh, kj[t], 0)),
-                pl.BlockSpec((1, bkv, dh), lambda hh, t, qi, kj: (hh, kj[t], 0)),
+                pl.BlockSpec(
+                    (1, bkv, dh), lambda hh, t, qi, kj: (hh // G, kj[t], 0)
+                ),
+                pl.BlockSpec(
+                    (1, bkv, dh), lambda hh, t, qi, kj: (hh // G, kj[t], 0)
+                ),
             ],
             out_specs=[
                 pl.BlockSpec((1, bq, dh), lambda hh, t, qi, kj: (hh, qi[t], 0)),
@@ -430,14 +457,15 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret):
         scale=scale,
         block_q=bq,
         block_kv=bkv,
+        causal=causal,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(h, sq // bq, skv // bkv),
         in_specs=[
             pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0)),
-            pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0)),
-            pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0)),
+            pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh // G, j, 0)),
+            pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh // G, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0)),
@@ -545,8 +573,10 @@ def _flash_bwd_dq_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_acc_ref,
     *, scale: float, block_q: int, block_kv: int, masked: bool = True,
+    gated: bool = True,
 ):
-    """dQ accumulated over KV tiles (inner grid dim)."""
+    """dQ accumulated over KV tiles (inner grid dim). ``gated=False``
+    (non-causal) visits every tile."""
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     row_offset = offs_ref[0]
@@ -559,13 +589,17 @@ def _flash_bwd_dq_kernel(
     q_start = row_offset + qi * block_q
     k_start = col_offset + kj * block_kv
 
-    @pl.when(q_start + block_q - 1 >= k_start)
-    def _compute():
+    def _do_update():
         _dq_tile_update(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv, masked=masked,
         )
+
+    if gated:
+        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    else:
+        _do_update()
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _flush():
@@ -576,6 +610,7 @@ def _flash_bwd_dkv_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, scale: float, block_q: int, block_kv: int, masked: bool = True,
+    gated: bool = True,
 ):
     """dK/dV accumulated over Q tiles (inner grid dim)."""
     kj = pl.program_id(1)
@@ -591,14 +626,18 @@ def _flash_bwd_dkv_kernel(
     q_start = row_offset + qi * block_q
     k_start = col_offset + kj * block_kv
 
-    @pl.when(q_start + block_q - 1 >= k_start)
-    def _compute():
+    def _do_update():
         _dkv_tile_update(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dk_acc_ref, dv_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv, masked=masked,
         )
+
+    if gated:
+        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    else:
+        _do_update()
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _flush():
@@ -695,14 +734,19 @@ def flash_attention_bwd(
     """Flash backward against one KV span: returns f32 ``(dq, dk, dv)``.
 
     ``q``/``o``/``do``: [sq, h, dh] (global rows start at ``row_offset``),
-    ``k``/``v``: [skv, h, dh] (global rows start at ``col_offset``),
-    ``lse``: [h, sq, 1] f32 log-sum-exp of the GLOBAL softmax (so per-chunk
-    calls compose: each chunk's ds tiles are exact slices of the global
-    backward). Two pallas calls — one per accumulation direction — each
-    recomputing its score tiles in VMEM from ``lse``.
+    ``k``/``v``: [skv, h_kv, dh] (global rows start at ``col_offset``;
+    ``h_kv < h`` is GQA — dK/dV come back with ``h_kv`` heads, the
+    per-query-head contributions group-summed), ``lse``: [h, sq, 1] f32
+    log-sum-exp of the GLOBAL softmax (so per-chunk calls compose: each
+    chunk's ds tiles are exact slices of the global backward). Two pallas
+    calls — one per accumulation direction — each recomputing its score
+    tiles in VMEM from ``lse``. ``causal='none'`` disables mask and
+    tile-skip gates (bidirectional attention).
     """
     sq, h, dh = q.shape
     skv = k.shape[0]
+    h_kv = k.shape[1]
+    G = _gqa_group(q, k)
     bq, bkv = min(block_q, sq), min(block_kv, skv)
     if sq % bq or skv % bkv:
         raise ValueError(
@@ -712,6 +756,12 @@ def flash_attention_bwd(
     kh = k.transpose(1, 0, 2)
     vh = v.transpose(1, 0, 2)
     doh = do.transpose(1, 0, 2)
+
+    def _group_sum(dkv_h):
+        """[h, skv, dh] per-query-head grads -> [skv, h_kv, dh]."""
+        if G == 1:
+            return dkv_h.transpose(1, 0, 2)
+        return dkv_h.reshape(h_kv, G, skv, dh).sum(axis=1).transpose(1, 0, 2)
     # delta = rowsum(do * o): the softmax-jacobian correction term, cheap
     # elementwise reduce left to XLA
     delta = jnp.sum(
@@ -720,21 +770,24 @@ def flash_attention_bwd(
         keepdims=True,
     )  # [h, sq, 1]
     f32 = jnp.float32
-    if causal not in ("offset", "diagonal", "past"):
+    if causal not in ("offset", "diagonal", "past", "none"):
         raise ValueError(f"unknown causal mode {causal!r}")
     if causal == "diagonal" and sq == skv:
         # the diagonal chunk in relative coordinates IS the static
         # zero-offset square case: take the triangular grids
         row_offset, col_offset = 0, 0
     if (
-        _use_triangular(row_offset, sq, skv)
+        causal != "none"
+        and _use_triangular(row_offset, sq, skv)
         and isinstance(col_offset, (int, np.integer))
         and col_offset == 0
     ):
         n = sq // bq
         nkv = skv // bkv
         qspec_t = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, a[t], 0))
-        kvspec_t = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, b[t], 0))
+        kvspec_t = pl.BlockSpec(
+            (1, bkv, dh), lambda hh, t, a, b: (hh // G, b[t], 0)
+        )
         mlspec_t = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, a[t], 0))
         qi_of, kj_of = _tri_maps_lower(n, bq, bkv)
         tri = int(qi_of.shape[0])
@@ -766,7 +819,14 @@ def flash_attention_bwd(
         kj_of2, qi_of2 = _tri_maps_upper(nkv, n, bq, bkv)
         tri2 = int(kj_of2.shape[0])
         qspec_t2 = pl.BlockSpec((1, bq, dh), lambda hh, t, a, b: (hh, b[t], 0))
-        kvspec_t2 = pl.BlockSpec((1, bkv, dh), lambda hh, t, a, b: (hh, a[t], 0))
+        kvspec_t2 = pl.BlockSpec(
+            (1, bkv, dh), lambda hh, t, a, b: (hh // G, a[t], 0)
+        )
+        # dK/dV outputs stay per QUERY head (grid over h; grouped heads
+        # sum outside) — only the k/v INPUT maps fold the group
+        kvspec_t2_out = pl.BlockSpec(
+            (1, bkv, dh), lambda hh, t, a, b: (hh, a[t], 0)
+        )
         mlspec_t2 = pl.BlockSpec((1, bq, 1), lambda hh, t, a, b: (hh, b[t], 0))
         dk, dv = pl.pallas_call(
             functools.partial(
@@ -781,7 +841,7 @@ def flash_attention_bwd(
                 num_scalar_prefetch=2,
                 grid=(h, tri2),
                 in_specs=[qspec_t2, kvspec_t2, kvspec_t2, qspec_t2, mlspec_t2, mlspec_t2],
-                out_specs=[kvspec_t2, kvspec_t2],
+                out_specs=[kvspec_t2_out, kvspec_t2_out],
                 scratch_shapes=[
                     pltpu.VMEM((bkv, dh), f32),
                     pltpu.VMEM((bkv, dh), f32),
@@ -799,20 +859,20 @@ def flash_attention_bwd(
         )(kj_of2, qi_of2, qh, kh, vh, doh, lse, delta)
         return (
             dq.transpose(1, 0, 2),
-            dk.transpose(1, 0, 2),
-            dv.transpose(1, 0, 2),
+            _group_sum(dk),
+            _group_sum(dv),
         )
     offsets = jnp.stack(
         [jnp.asarray(row_offset, jnp.int32), jnp.asarray(col_offset, jnp.int32)]
     )
     qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
-    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
+    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh // G, j, 0))
     mlspec = pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bkv,
-            masked=causal != "past",
+            masked=causal not in ("past", "none"), gated=causal != "none",
         ),
         out_shape=jax.ShapeDtypeStruct((h, sq, dh), f32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -833,14 +893,16 @@ def flash_attention_bwd(
         interpret=interpret,
     )(offsets, qh, kh, vh, doh, lse, delta)
 
-    # dK/dV: kv-major grid, q tiles innermost
+    # dK/dV: kv-major grid, q tiles innermost; outputs per QUERY head
+    # (grouped heads sum outside), only the k/v inputs fold the group
     qspec2 = pl.BlockSpec((1, bq, dh), lambda hh, j, i, off: (hh, i, 0))
-    kvspec2 = pl.BlockSpec((1, bkv, dh), lambda hh, j, i, off: (hh, j, 0))
+    kvspec2 = pl.BlockSpec((1, bkv, dh), lambda hh, j, i, off: (hh // G, j, 0))
+    kvspec2_out = pl.BlockSpec((1, bkv, dh), lambda hh, j, i, off: (hh, j, 0))
     mlspec2 = pl.BlockSpec((1, bq, 1), lambda hh, j, i, off: (hh, i, 0))
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bkv,
-            masked=causal != "past",
+            masked=causal not in ("past", "none"), gated=causal != "none",
         ),
         out_shape=[
             jax.ShapeDtypeStruct((h, skv, dh), f32),
@@ -850,7 +912,7 @@ def flash_attention_bwd(
             num_scalar_prefetch=1,
             grid=(h, skv // bkv, sq // bq),
             in_specs=[qspec2, kvspec2, kvspec2, qspec2, mlspec2, mlspec2],
-            out_specs=[kvspec2, kvspec2],
+            out_specs=[kvspec2_out, kvspec2_out],
             scratch_shapes=[
                 pltpu.VMEM((bkv, dh), f32),
                 pltpu.VMEM((bkv, dh), f32),
@@ -868,35 +930,38 @@ def flash_attention_bwd(
     )(offsets, qh, kh, vh, doh, lse, delta)
     return (
         dq.transpose(1, 0, 2),
-        dk.transpose(1, 0, 2),
-        dv.transpose(1, 0, 2),
+        _group_sum(dk),
+        _group_sum(dv),
     )
 
 
 # -- differentiable public API ------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret,
+           causal=True):
     o, _ = _flash_forward(
-        q, k, v, row_offset, scale, block_q, block_kv, interpret
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
     )
     return o
 
 
-def _flash_fwd_rule(q, k, v, row_offset, scale, block_q, block_kv, interpret):
+def _flash_fwd_rule(q, k, v, row_offset, scale, block_q, block_kv, interpret,
+                    causal=True):
     o, lse = _flash_forward(
-        q, k, v, row_offset, scale, block_q, block_kv, interpret
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
     )
     return o, (q, k, v, o, lse, row_offset)
 
 
-def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
+def _flash_bwd_rule(scale, block_q, block_kv, interpret, causal, res, do):
     q, k, v, o, lse, row_offset = res
     dq, dk, dv = flash_attention_bwd(
         q, k, v, o, lse, do,
         scale=scale, row_offset=row_offset, col_offset=0,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        causal="offset" if causal else "none",
     )
     d_off = np.zeros(np.shape(row_offset), jax.dtypes.float0)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), d_off
@@ -905,27 +970,33 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_s0(q, k, v, scale, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_s0(q, k, v, scale, block_q, block_kv, interpret, causal=True):
     """Static ``row_offset == 0`` variant: keeping the offset a python int
     through the custom_vjp lets BOTH directions take the triangular grid
     (a traced offset — the generic ``_flash`` — forces the rectangular
     masked grid, ~2x the live tiles)."""
-    o, _ = _flash_forward(q, k, v, 0, scale, block_q, block_kv, interpret)
+    o, _ = _flash_forward(
+        q, k, v, 0, scale, block_q, block_kv, interpret, causal
+    )
     return o
 
 
-def _flash_s0_fwd_rule(q, k, v, scale, block_q, block_kv, interpret):
-    o, lse = _flash_forward(q, k, v, 0, scale, block_q, block_kv, interpret)
+def _flash_s0_fwd_rule(q, k, v, scale, block_q, block_kv, interpret,
+                       causal=True):
+    o, lse = _flash_forward(
+        q, k, v, 0, scale, block_q, block_kv, interpret, causal
+    )
     return o, (q, k, v, o, lse)
 
 
-def _flash_s0_bwd_rule(scale, block_q, block_kv, interpret, res, do):
+def _flash_s0_bwd_rule(scale, block_q, block_kv, interpret, causal, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = flash_attention_bwd(
         q, k, v, o, lse, do,
         scale=scale, row_offset=0, col_offset=0,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
+        causal="offset" if causal else "none",
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -935,18 +1006,21 @@ _flash_s0.defvjp(_flash_s0_fwd_rule, _flash_s0_bwd_rule)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
 )
-def _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret):
-    return _flash_s0(q, k, v, scale, block_q, block_kv, interpret)
+def _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret, causal):
+    return _flash_s0(q, k, v, scale, block_q, block_kv, interpret, causal)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret"),
+    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
 )
-def _flash_dyn_jit(q, k, v, row_offset, scale, block_q, block_kv, interpret):
-    return _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret)
+def _flash_dyn_jit(q, k, v, row_offset, scale, block_q, block_kv, interpret,
+                   causal):
+    return _flash(
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
+    )
 
 
 def flash_attention(
@@ -959,12 +1033,19 @@ def flash_attention(
     block_q: int = 1024,
     block_kv: int = 1024,
     interpret: bool = False,
+    causal: bool = True,
 ):
-    """Causal flash attention — differentiable (custom_vjp flash backward).
+    """Flash attention — differentiable (custom_vjp flash backward).
 
     ``q``: [sq, h, dh] (global query rows start at ``row_offset``),
-    ``k``/``v``: [skv, h, dh]. Returns [sq, h, dh]. ``sq % block_q == 0``
-    and ``skv % block_kv == 0`` (benchmark shapes are powers of two).
+    ``k``/``v``: [skv, h_kv, dh] with ``h_kv | h`` — ``h_kv < h`` is GQA:
+    query head ``hh`` attends kv head ``hh // (h/h_kv)`` (the kernels read
+    the shared KV tile straight from the head index map; dK/dV return with
+    ``h_kv`` heads). Returns [sq, h, dh]. ``sq % block_q == 0`` and
+    ``skv % block_kv == 0`` (benchmark shapes are powers of two).
+
+    ``causal=False`` is full bidirectional attention: every tile live,
+    no mask, forward and backward.
 
     A literal ``row_offset=0`` (the full-sequence case: the flagship
     model's gathered attention, the cp ``flash`` impl at world=1, direct
@@ -979,10 +1060,12 @@ def flash_attention(
     device_loop windows, BASELINE.md round-2 protocol).
     """
     if isinstance(row_offset, (int, np.integer)) and row_offset == 0:
-        return _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret)
+        return _flash_s0_jit(
+            q, k, v, scale, block_q, block_kv, interpret, causal
+        )
     return _flash_dyn_jit(
         q, k, v, jnp.asarray(row_offset, jnp.int32),
-        scale, block_q, block_kv, interpret,
+        scale, block_q, block_kv, interpret, causal,
     )
 
 
@@ -1011,6 +1094,11 @@ def ring_flash_attention(
     plus one delivery ``ppermute`` every gradient lands on its owner —
     the communication volume matches the forward's.
     """
+    if k.shape[1] != q.shape[1]:
+        raise ValueError(
+            "ring_flash_attention is MHA-only (n_kv_heads == n_heads); "
+            "GQA rides the gathered flash_attention path"
+        )
     return _ring_flash(
         q, k, v, axis_name, axis_size, scale, block_q, block_kv, interpret
     )

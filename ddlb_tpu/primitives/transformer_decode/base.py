@@ -46,8 +46,13 @@ class TransformerDecode(Primitive):
         "batch": 8,
         "vocab": 512,
         "n_heads": 8,
+        "n_kv_heads": 0,  # 0 = MHA; fewer = GQA (cache shrinks to match)
         "layers": 1,
         "mlp_kernel": "bf16",
+        #: K/V cache precision: int8 halves the bytes the bandwidth-bound
+        #: decode step re-reads per token (fast-decode member; composes
+        #: with n_kv_heads' GQA shrink)
+        "kv_cache": "bf16",
         #: prefill attention engine (flash = the Pallas kernels; the
         #: single-token decode step always uses the dense cache read)
         "attn_kernel": "flash",
@@ -59,8 +64,10 @@ class TransformerDecode(Primitive):
         "batch": (1, None),
         "vocab": (2, None),
         "n_heads": (1, None),
+        "n_kv_heads": (0, None),
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "kv_cache": ["bf16", "int8"],
         "attn_kernel": ["flash", "einsum"],
         "dp": (0, None),
         "tp": (0, None),
@@ -106,6 +113,16 @@ class TransformerDecode(Primitive):
             raise ValueError(
                 f"n_heads={o['n_heads']} not divisible by tp={tp}"
             )
+        if o["n_kv_heads"]:
+            if o["n_heads"] % o["n_kv_heads"] != 0:
+                raise ValueError(
+                    f"n_heads={o['n_heads']} not divisible by "
+                    f"n_kv_heads={o['n_kv_heads']}"
+                )
+            if o["n_kv_heads"] % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads={o['n_kv_heads']} not divisible by tp={tp}"
+                )
         if o["batch"] % dp != 0:
             raise ValueError(f"batch={o['batch']} not divisible by dp={dp}")
         if (o["batch"] // dp) % tp != 0:
@@ -129,10 +146,13 @@ class TransformerDecode(Primitive):
         o = self.options
         D, F = self.n, self.k
         L, B, V = o["layers"], o["batch"], o["vocab"]
+        # q + out projections 4 D^2; k/v 4 D * kv_dim (GQA shrinks them)
+        kv_frac = (o["n_kv_heads"] or o["n_heads"]) / o["n_heads"]
+        proj = (4.0 + 4.0 * kv_frac) * D * D
         if o["phase"] == "decode":
-            per_token = L * (8.0 * D * D + 4.0 * self.m * D + 4.0 * D * F)
+            per_token = L * (proj + 4.0 * self.m * D + 4.0 * D * F)
             return B * (per_token + 2.0 * D * V)
-        per_token = L * (8.0 * D * D + 2.0 * self.m * D + 4.0 * D * F)
+        per_token = L * (proj + 2.0 * self.m * D + 4.0 * D * F)
         return B * self.m * per_token + B * 2.0 * D * V
 
     def _model_config(self):
@@ -144,9 +164,11 @@ class TransformerDecode(Primitive):
             vocab=o["vocab"],
             d_model=self.n,
             n_heads=o["n_heads"],
+            n_kv_heads=o["n_kv_heads"],
             d_ff=self.k,
             layers_per_stage=o["layers"],
             mlp_kernel=o["mlp_kernel"],
+            kv_cache=o["kv_cache"],
             attn_kernel=o["attn_kernel"],
             dtype=jnp_dtype(self.dtype),
         )
@@ -199,6 +221,16 @@ class TransformerDecode(Primitive):
             # step-path/oracle gap by up to a quantization step (in f32
             # the two paths are bit-identical and the tight atol holds)
             atol *= 2
+        if self.options["kv_cache"] == "int8":
+            # the int8 cache re-rounds INTERMEDIATE activations (layer
+            # l's k/v depend on layer l-1's attention), so the sharded
+            # step and the differently-shaped oracle einsums accumulate
+            # ~1e-7 f32 skew that flips occasional round() buckets — a
+            # bounded cliff (<= 1/127 of the row max per flip; observed
+            # 2e-3 logits drift at 2 layers). The bf16-cache exactness
+            # contract cannot apply; this is the same amplification rule
+            # as the int8 MLP note above.
+            atol = max(atol, 1e-2)
         if logits.shape != expected.shape:
             print(
                 f"[ddlb_tpu] validation FAILED for {type(self).__name__}: "

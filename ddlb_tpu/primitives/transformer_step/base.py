@@ -46,6 +46,7 @@ class TransformerStep(Primitive):
         "batch": 4,
         "vocab": 512,
         "n_heads": 8,
+        "n_kv_heads": 0,  # 0 = MHA; fewer = grouped-query attention
         "layers_per_stage": 1,
         "microbatches": 2,
         "attention": "gathered",
@@ -63,6 +64,7 @@ class TransformerStep(Primitive):
         "batch": (1, None),
         "vocab": (2, None),
         "n_heads": (1, None),
+        "n_kv_heads": (0, None),
         "layers_per_stage": (1, None),
         "microbatches": (1, None),
         "attention": ["gathered", "ring"],
@@ -177,6 +179,21 @@ class TransformerStep(Primitive):
                 f"n_heads={o['n_heads']} not divisible by tp={tp} "
                 f"(gathered attention shards heads)"
             )
+        if o["n_kv_heads"]:
+            if o["n_heads"] % o["n_kv_heads"] != 0:
+                raise ValueError(
+                    f"n_heads={o['n_heads']} not divisible by "
+                    f"n_kv_heads={o['n_kv_heads']}"
+                )
+            if o["attention"] == "ring" and o["n_kv_heads"] != o["n_heads"]:
+                raise ValueError(
+                    "attention='ring' is MHA-only; GQA (n_kv_heads < "
+                    "n_heads) uses attention='gathered'"
+                )
+            if o["attention"] == "gathered" and o["n_kv_heads"] % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads={o['n_kv_heads']} not divisible by tp={tp}"
+                )
         if o["batch"] % (dp * o["microbatches"]) != 0:
             raise ValueError(
                 f"batch={o['batch']} not divisible by dp*microbatches="
@@ -210,7 +227,12 @@ class TransformerStep(Primitive):
         o = self.options
         D, F, S = self.n, self.k, self.m
         layers = self._total_stages() * o["layers_per_stage"]
-        per_token = layers * (8.0 * D * D + 2.0 * S * D + 4.0 * D * F)
+        # q + out projections 4 D^2; k/v 4 D * kv_dim (= 4 D^2 at MHA,
+        # smaller under GQA)
+        kv_frac = (o["n_kv_heads"] or o["n_heads"]) / o["n_heads"]
+        per_token = layers * (
+            (4.0 + 4.0 * kv_frac) * D * D + 2.0 * S * D + 4.0 * D * F
+        )
         per_token += 2.0 * D * o["vocab"]
         fwd = o["batch"] * S * per_token
         return 3.0 * fwd if o["mode"] == "train" else fwd
@@ -229,6 +251,7 @@ class TransformerStep(Primitive):
             vocab=o["vocab"],
             d_model=self.n,
             n_heads=o["n_heads"],
+            n_kv_heads=o["n_kv_heads"],
             d_ff=self.k,
             layers_per_stage=o["layers_per_stage"],
             microbatches=o["microbatches"],

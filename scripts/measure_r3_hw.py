@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Round-3 hardware measurement batch (run when the TPU relay is up).
+
+Three sections, one session so medians are comparable:
+
+1. **Serving table** (VERDICT r2 next-round #2/#3): decode ms/token and
+   tokens/s vs context {2k, 8k, 32k, 64k} across the fast-decode axes —
+   kv_cache bf16 vs int8, MHA vs GQA (n_kv_heads=4), int8_weights MLP —
+   plus one prefill row. Each row also prints the HBM bytes-read model
+   (cache + per-chip weights per step) and the implied bandwidth
+   fraction at the v5e's ~819 GB/s, the number the family exists to
+   measure.
+2. **int8 Pallas tile sweep** (VERDICT r2 next-round #7): the paired
+   same-session race — XLA int8 GEMM vs the Pallas kernel over tile
+   configs and quantize=static — to close or pin the 350.8-vs-381.9 TOPS
+   gap at the canonical 8192^3.
+3. **Pipeline schedules on the model** (VERDICT #4 rider): train-step
+   ms under schedule=gpipe vs 1f1b at equal microbatches (the schedule
+   tables predict equal ticks; this pins the wall-clock claim), plus
+   the flash GQA train row.
+
+Usage: python scripts/measure_r3_hw.py [--quick]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ddlb_tpu.benchmark import benchmark_worker
+
+QUICK = "--quick" in sys.argv[1:]
+
+V5E_HBM_GBPS = 819.0
+
+PROTO = {
+    "dtype": "bfloat16",
+    "num_iterations": 8,
+    "num_warmups": 2,
+    "validate": True,
+    "time_measurement_backend": "device_loop",
+    "device_loop_windows": 4 if QUICK else 8,
+    "barrier_at_each_iteration": False,
+}
+
+
+def run(primitive, impl, m, n, k, label="", **options):
+    row = benchmark_worker(
+        {
+            "primitive": primitive,
+            "impl_id": f"{impl}_hw",
+            "base_implementation": impl,
+            "options": options,
+            "m": m,
+            "n": n,
+            "k": k,
+            **PROTO,
+        }
+    )
+    t = row["median time (ms)"]
+    print(
+        f"{primitive:18s} {impl:10s} m={m:<6d} {label or options} -> "
+        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
+        f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
+        f"err={row['error'] or '-'}",
+        flush=True,
+    )
+    return row
+
+
+# -- 1) serving table ---------------------------------------------------------
+
+D, F, V, HEADS, B, LAYERS = 2048, 8192, 16384, 16, 8, 1
+DH = D // HEADS
+
+
+def decode_bytes(ctx, n_kv, kv_cache, mlp_kernel, tp=1):
+    """HBM bytes read per decode step (the bandwidth model): K+V cache at
+    the context length + this chip's weights once."""
+    h_kv = n_kv or HEADS
+    kv_bytes = 1 if kv_cache == "int8" else 2
+    cache = 2 * LAYERS * B * ctx * h_kv * DH * kv_bytes
+    if kv_cache == "int8":
+        cache += 2 * LAYERS * B * ctx * h_kv * 4  # f32 scales
+    w_bytes = 1 if mlp_kernel == "int8_weights" else 2
+    kv_frac = h_kv / HEADS
+    # param counts x bytes: q+out proj 2 D^2, k/v 2 D^2 * kv_frac,
+    # expert MLP 2 D F per chip, LM head D V (all bf16 except the MLP
+    # under int8_weights)
+    weights = (
+        LAYERS * ((2 + 2 * kv_frac) * D * D * 2 + 2 * D * F * w_bytes / tp)
+        + D * V * 2
+    )
+    return cache + weights
+
+
+def serving_row(ctx, label, **opts):
+    row = run(
+        "transformer_decode", "spmd", ctx, D, F,
+        label=label, batch=B, vocab=V, n_heads=HEADS, phase="decode",
+        attn_kernel="einsum", **opts,
+    )
+    t_ms = row["median time (ms)"]
+    toks = B / t_ms * 1e3
+    gb = decode_bytes(
+        ctx, opts.get("n_kv_heads", 0), opts.get("kv_cache", "bf16"),
+        opts.get("mlp_kernel", "bf16"),
+    ) / 1e9
+    frac = gb / (t_ms / 1e3) / V5E_HBM_GBPS
+    print(
+        f"    -> {t_ms / B:.3f} ms/token  {toks:,.0f} tok/s   "
+        f"bytes-read model {gb:.2f} GB/step  HBM fraction {frac:.2f}",
+        flush=True,
+    )
+    return row
+
+
+CONTEXTS = (2048, 8192) if QUICK else (2048, 8192, 32768, 65536)
+for ctx in CONTEXTS:
+    serving_row(ctx, f"bf16 cache, MHA @ {ctx}")
+    serving_row(ctx, f"int8 cache, MHA @ {ctx}", kv_cache="int8")
+    serving_row(ctx, f"bf16 cache, GQA4 @ {ctx}", n_kv_heads=4)
+    serving_row(
+        ctx, f"int8 cache, GQA4 @ {ctx}", n_kv_heads=4, kv_cache="int8"
+    )
+    serving_row(
+        ctx, f"int8 cache + int8 weights @ {ctx}",
+        kv_cache="int8", mlp_kernel="int8_weights",
+    )
+
+run(
+    "transformer_decode", "spmd", 2048, D, F,
+    label="prefill 2k (flash)", batch=B, vocab=V, n_heads=HEADS,
+    phase="prefill", attn_kernel="flash",
+)
+
+# -- 2) int8 Pallas tile sweep (paired, same session) -------------------------
+
+M = N = K = 8192
+run("tp_columnwise", "quantized", M, N, K, label="XLA int8 (reference)",
+    kernel="xla", quantize="static")
+TILES = (
+    [(1024, 1024, 1024), (512, 1024, 1024)]
+    if QUICK
+    else [
+        (1024, 1024, 1024),
+        (512, 1024, 1024),
+        (1024, 512, 1024),
+        (1024, 1024, 512),
+        (512, 512, 2048),
+        (2048, 1024, 512),
+        (512, 2048, 1024),
+    ]
+)
+for bm, bn, bk in TILES:
+    run(
+        "tp_columnwise", "quantized", M, N, K,
+        label=f"pallas int8 tiles ({bm},{bn},{bk})",
+        kernel="pallas", quantize="static",
+        block_m=bm, block_n=bn, block_k=bk,
+    )
+
+# -- 3) model schedules + GQA train row ---------------------------------------
+
+MODEL = dict(batch=4, vocab=V, n_heads=HEADS, microbatches=4, pp=1, tp=1, dp=1)
+for sched in ("gpipe", "1f1b"):
+    run(
+        "transformer_step", "spmd", 2048, D, F,
+        label=f"train schedule={sched} (single chip: pp=1 degenerate)",
+        mode="train", schedule=sched, attn_kernel="flash", **MODEL,
+    )
+run(
+    "transformer_step", "spmd", 4096, D, F,
+    label="train GQA4 flash", mode="train", attn_kernel="flash",
+    n_kv_heads=4, batch=4, vocab=V, n_heads=HEADS, microbatches=1,
+    pp=1, tp=1, dp=1,
+)

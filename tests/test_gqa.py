@@ -1,0 +1,235 @@
+"""Grouped-query attention + non-causal mode: kernels and model.
+
+The flash kernels read the shared KV tile straight from the head index
+map (query head hh -> kv head hh // G), dK/dV group-sum back to kv-head
+shape; everything is pinned against an einsum oracle that materializes
+the repetition. Model level: the gathered train path, the serving
+prefill/decode paths, and the kv-head cache shrink.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _oracle(q, k, v, scale, causal=True):
+    G = q.shape[1] // k.shape[1]
+    kr = jnp.repeat(k, G, axis=1)
+    vr = jnp.repeat(v, G, axis=1)
+    s = jnp.einsum(
+        "qhd,khd->hqk", q.astype(jnp.float32), kr.astype(jnp.float32)
+    ) * scale
+    if causal:
+        sq, skv = q.shape[0], k.shape[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+        s = jnp.where((rows >= cols)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, vr.astype(jnp.float32))
+
+
+def _qkv(sq=256, h=8, h_kv=2, dh=32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(sq, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(sq, h_kv, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(sq, h_kv, dh)), jnp.float32)
+    return q, k, v
+
+
+class TestKernelGQA:
+    @pytest.mark.parametrize("h_kv", [1, 2, 4, 8])
+    def test_forward_matches_oracle(self, h_kv):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(h_kv=h_kv)
+        scale = 1 / np.sqrt(q.shape[-1])
+        o = flash_attention(
+            q, k, v, scale=scale, block_q=64, block_kv=64, interpret=True
+        )
+        want = _oracle(q, k, v, scale)
+        assert float(jnp.max(jnp.abs(o - want))) < 1e-5
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_oracle(self, causal):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv()
+        scale = 1 / np.sqrt(q.shape[-1])
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, scale=scale, block_q=64, block_kv=64,
+                interpret=True, causal=causal,
+            ).sum()
+
+        def f0(q, k, v):
+            return _oracle(q, k, v, scale, causal).sum()
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f0, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", got, want):
+            assert a.shape == b.shape
+            err = float(jnp.max(jnp.abs(a - b)))
+            assert err < 2e-5, f"d{name}: {err:.2e}"
+        # dk/dv come back with kv-head shape — the group sum happened
+        assert got[1].shape == k.shape
+
+    def test_non_causal_forward(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(h_kv=8)  # MHA, pure causal-flag test
+        scale = 1 / np.sqrt(q.shape[-1])
+        o = flash_attention(
+            q, k, v, scale=scale, block_q=64, block_kv=64,
+            interpret=True, causal=False,
+        )
+        want = _oracle(q, k, v, scale, causal=False)
+        assert float(jnp.max(jnp.abs(o - want))) < 1e-5
+
+    def test_indivisible_heads_rejected(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(h=8, h_kv=3)
+        with pytest.raises(ValueError, match="GQA"):
+            flash_attention(
+                q, k, v, scale=0.1, block_q=64, block_kv=64, interpret=True
+            )
+
+    def test_ring_rejects_gqa(self):
+        from ddlb_tpu.ops.flash_attention import ring_flash_attention
+
+        q, k, v = _qkv()
+        with pytest.raises(ValueError, match="MHA-only"):
+            ring_flash_attention(
+                q, k, v, axis_name="tp", axis_size=2, scale=0.1,
+            )
+
+
+class TestModelGQA:
+    def _cfg(self, **kw):
+        from ddlb_tpu.models.transformer import TransformerConfig
+
+        base = dict(
+            vocab=64, d_model=64, n_heads=8, n_kv_heads=2, d_ff=64,
+            layers_per_stage=1, microbatches=2,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    @pytest.mark.parametrize("attn_kernel", ["einsum", "flash"])
+    def test_train_matches_oracle(self, attn_kernel):
+        from ddlb_tpu.models.transformer import (
+            example_tokens,
+            init_params,
+            make_loss_fn,
+            reference_loss,
+        )
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp", "pp"), shape=(2, 2, 2))
+        cfg = self._cfg(attn_kernel=attn_kernel)
+        params = init_params(cfg, pp=2, n_experts=2)
+        tokens, targets = example_tokens(4, 16, cfg.vocab)
+        want = float(reference_loss(params, tokens, targets, cfg, tp=2, dp=2))
+        loss_fn, sh = make_loss_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        tok = jax.device_put(tokens, sh["data"])
+        tgt = jax.device_put(targets, sh["data"])
+        loss, grads = jax.jit(jax.value_and_grad(loss_fn))(p, tok, tgt)
+        assert abs(float(loss) - want) < 1e-5
+        assert float(np.max(np.abs(np.asarray(grads["w_kv"])))) > 0
+
+    def test_cache_shrinks_and_decode_consistent(self):
+        from ddlb_tpu.models.decode import (
+            init_cache,
+            make_decode_fn,
+            make_prefill_fn,
+            reference_logits,
+        )
+        from ddlb_tpu.models.transformer import (
+            example_tokens,
+            init_params,
+        )
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp"), shape=(4, 2))
+        cfg = self._cfg(attn_kernel="einsum", microbatches=1)
+        B, S0 = 8, 8
+        params = init_params(cfg, pp=1, n_experts=2)
+        cache = init_cache(cfg, B, S0 + 1, mesh=mesh)
+        assert cache["k"].shape[3] == 2  # kv heads, not 8: 4x smaller
+        prompt, _ = example_tokens(B, S0, cfg.vocab)
+        prefill, sh = make_prefill_fn(mesh, cfg)
+        decode, _ = make_decode_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        logits, cache = jax.jit(prefill)(p, cache, prompt)
+        want = reference_logits(params, prompt, cfg, tp=2, dp=4)
+        assert float(np.max(np.abs(np.asarray(logits) - np.asarray(want)))) < 1e-4
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        logits2, cache = jax.jit(decode)(p, cache, nxt, S0)
+        toks2 = np.concatenate(
+            [np.asarray(prompt), np.asarray(nxt)[:, None]], 1
+        )
+        want2 = reference_logits(params, toks2, cfg, tp=2, dp=4)
+        assert float(np.max(np.abs(np.asarray(logits2) - np.asarray(want2)))) < 1e-4
+
+    def test_transformer_step_sweeps_n_kv_heads(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_gqa",
+                "base_implementation": "spmd",
+                "options": {
+                    "batch": 4, "vocab": 64, "n_heads": 8, "n_kv_heads": 2,
+                    "microbatches": 2, "attn_kernel": "einsum",
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_transformer_decode_sweeps_n_kv_heads(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_gqa",
+                "base_implementation": "spmd",
+                "options": {
+                    "batch": 8, "vocab": 64, "n_heads": 8, "n_kv_heads": 2,
+                    "phase": "decode", "attn_kernel": "einsum",
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_ring_attention_rejects_gqa(self):
+        from ddlb_tpu.models.transformer import param_specs
+
+        cfg = self._cfg(attention="ring")
+        with pytest.raises(ValueError, match="MHA-only"):
+            param_specs(cfg)
