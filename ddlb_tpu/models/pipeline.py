@@ -59,9 +59,58 @@ def _tail_loss(y, ln_f, head, tgt):
     return _ce_loss(logits, tgt)
 
 
-def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
-    """Build ``fn(params, tokens, targets) -> (loss, grads)`` running the
-    1F1B schedule over the ``('dp', 'tp', 'pp')`` mesh.
+def arrange_stage_stack(params, pp: int, virtual: int, cfg=None):
+    """Permute a stage-ordered param stack (leading axis ``pp * virtual``,
+    row ``s`` = global stage ``s``) into the Megatron-interleaved
+    device-major layout the sharded step consumes: row ``p*virtual + c``
+    holds global stage ``c*pp + p``, so the contiguous block-shard of
+    device ``p`` is exactly its chunks. The oracle keeps the
+    stage-ordered stack (``init_params`` output) — only the placement
+    differs, never the math.
+
+    Leaves are classified by the param SPEC (first axis named ``pp``),
+    never by shape — a replicated leaf whose leading dim happens to equal
+    the chain depth (e.g. ``vocab == pp*virtual``) must not be permuted.
+    ``cfg`` selects the spec table; omitted, leaves present in the
+    default-config spec table are classified by name (the param set is
+    config-dependent only through optional leaves, which always carry a
+    spec entry when present).
+    """
+    import numpy as np_  # local alias: params may be numpy or jax arrays
+
+    if cfg is None:
+        from ddlb_tpu.models.transformer import TransformerConfig
+
+        # name -> spec over the union of optional leaves (the MHA/GQA
+        # param sets are mutually exclusive, so merge both variants)
+        specs = {}
+        for c in (
+            TransformerConfig(router="topk", mlp_kernel="int8_weights"),
+            TransformerConfig(router="topk", n_heads=2, n_kv_heads=1),
+        ):
+            specs.update(param_specs(c))
+    else:
+        specs = param_specs(cfg)
+    idx = np_.array(
+        [c * pp + p for p in range(pp) for c in range(virtual)]
+    )
+    out = {}
+    for k, v in params.items():
+        spec = specs.get(k)
+        stage_stacked = spec is not None and len(spec) and spec[0] == "pp"
+        out[k] = v[idx] if stage_stacked else v
+    return out
+
+
+def make_loss_and_grads_1f1b(
+    mesh, cfg: TransformerConfig, schedule: str = "1f1b", virtual: int = 1
+):
+    """Build ``fn(params, tokens, targets) -> (loss, grads)`` running a
+    tabulated pipeline training schedule over the ``('dp', 'tp', 'pp')``
+    mesh — ``1f1b`` (default), or ``interleaved`` with ``virtual`` chunks
+    per device (the chain is then ``virtual * pp`` stages deep and params
+    must be stage-stacked to that depth, arranged device-major via
+    ``arrange_stage_stack``).
 
     Returns ``(fn, shardings)``; jit at the call site. ``grads`` is a
     pytree matching ``params`` (sharded identically), produced WITHOUT
@@ -70,6 +119,7 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
     """
     dp, tp, pp = mesh.shape["dp"], mesh.shape["tp"], mesh.shape["pp"]
     mb = cfg.microbatches
+    v = virtual
     specs = param_specs(cfg)
     if cfg.mlp_kernel == "int8_weights":
         raise ValueError(
@@ -79,10 +129,11 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
         )
     interpret = jax.default_backend() != "tpu"
     stage_fn = make_stage_fn(cfg, tp, interpret)
-    tables = build_schedule("1f1b", pp, mb)
+    tables = build_schedule(schedule, pp, mb, v)
+    S_glob = tables.n_stages
     T = {
         name: jnp.asarray(getattr(tables, name))
-        for name in ("kind", "mb", "act_slot", "in_slot",
+        for name in ("kind", "mb", "chunk", "act_slot", "in_slot",
                      "fwd_land", "bwd_land")
     }
     n_act = tables.act_slots + 1
@@ -123,10 +174,16 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
         aux_acc = jnp.zeros((), jnp.float32)
         grads = zero_grads
         # d(total loss)/d(per-tick stage aux): the aux term averages over
-        # (mb, stages, dp, tp) with weight router_aux
+        # (mb, global stage chunks, dp, tp) with weight router_aux
         aux_cot = jnp.asarray(
-            cfg.router_aux / (mb * pp * dp * tp), jnp.float32
+            cfg.router_aux / (mb * S_glob * dp * tp), jnp.float32
         )
+        # leaves with a leading stage axis (device-local size = virtual);
+        # the rest (embed/ln_f/head) are replicated whole
+        stage_names = {
+            name for name, spec in specs.items()
+            if len(spec) and spec[0] == "pp"
+        }
 
         def sl(slot, cap):
             return jnp.where(slot < 0, cap - 1, slot)
@@ -144,6 +201,26 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
             i = jnp.maximum(T["mb"][t, p_pp], 0)
             aslot = sl(T["act_slot"][t, p_pp], n_act)
             islot = sl(T["in_slot"][t, p_pp], n_land)
+            c = jnp.maximum(T["chunk"][t, p_pp], 0)
+            # interleaved placement: chunk c of device p is global stage
+            # c*pp + p; injection/tail gate on the GLOBAL chain ends
+            s_glob = c * pp + p_pp
+            is_first = s_glob == 0
+            is_last = s_glob == S_glob - 1
+
+            def chunk_params():
+                """This tick's stage-param slice (leading axis kept at 1
+                so stage_fn's ``[0, l]`` indexing is unchanged)."""
+                return {
+                    name: (
+                        jax.lax.dynamic_index_in_dim(
+                            leaf, c, axis=0, keepdims=True
+                        )
+                        if name in stage_names
+                        else leaf
+                    )
+                    for name, leaf in params.items()
+                }
 
             def fwd_branch(act, fland, bland, loss_acc, aux_acc, grads):
                 tok = mb_slab(tokens, i)
@@ -151,22 +228,22 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                 landed = jax.lax.dynamic_index_in_dim(
                     fland, islot, axis=0, keepdims=False
                 )
-                x_in = jnp.where(p_pp == 0, inject, landed)
-                y, aux = stage_fn(x_in, params)
+                x_in = jnp.where(is_first, inject, landed)
+                y, aux = stage_fn(x_in, chunk_params())
                 act_n = jax.lax.dynamic_update_slice(
                     act, x_in[None], (aslot, 0, 0, 0)
                 )
                 # collective-free tail under the last-stage cond (the
                 # GPipe loop's safe-divergence pattern)
                 loss_i = jax.lax.cond(
-                    p_pp == pp - 1,
+                    is_last,
                     lambda yy: _tail_loss(
                         yy, params["ln_f"], params["head"], mb_slab(targets, i)
                     ),
                     lambda yy: jnp.zeros((), jnp.float32),
                     y,
                 )
-                send_f = jnp.where(p_pp == pp - 1, jnp.zeros_like(y), y)
+                send_f = jnp.where(is_last, jnp.zeros_like(y), y)
                 return (
                     act_n, fland, bland, loss_acc + loss_i, aux_acc + aux,
                     grads, send_f, jnp.zeros_like(y),
@@ -179,7 +256,8 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                 # rematerializing vjp: stage_fn is checkpointed, so this
                 # recomputes the stage forward then backs through it —
                 # the physical ~2x-forward backward tick
-                (y, _aux), pull = jax.vjp(stage_fn, x_saved, params)
+                sp_c = chunk_params()
+                (y, _aux), pull = jax.vjp(stage_fn, x_saved, sp_c)
 
                 def tail_seed(yy):
                     # d(total loss)/dy at the last stage, plus the tail's
@@ -206,14 +284,14 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                     )
 
                 g_y, d_lnf, d_head = jax.lax.cond(
-                    p_pp == pp - 1, tail_seed, mid_seed, y
+                    is_last, tail_seed, mid_seed, y
                 )
                 dx, dparams = pull((g_y, aux_cot))
-                # embed backward at stage 0: scatter-add dx at the token
-                # ids (collective-free)
+                # embed backward at the global chain head: scatter-add dx
+                # at the token ids (collective-free)
                 tok = mb_slab(tokens, i)
                 d_embed = jax.lax.cond(
-                    p_pp == 0,
+                    is_first,
                     lambda dxx: jnp.zeros(
                         params["embed"].shape, jnp.float32
                     ).at[tok].add(dxx.astype(jnp.float32)),
@@ -221,13 +299,19 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
                     dx,
                 )
                 gr = {
-                    name: grads[name] + dparams[name].astype(jnp.float32)
+                    name: (
+                        grads[name].at[c].add(
+                            dparams[name][0].astype(jnp.float32)
+                        )
+                        if name in stage_names
+                        else grads[name] + dparams[name].astype(jnp.float32)
+                    )
                     for name in grads
                 }
                 gr["embed"] = gr["embed"] + d_embed
                 gr["ln_f"] = grads["ln_f"] + d_lnf.astype(jnp.float32)
                 gr["head"] = grads["head"] + d_head.astype(jnp.float32)
-                send_b = jnp.where(p_pp == 0, jnp.zeros_like(dx), dx)
+                send_b = jnp.where(is_first, jnp.zeros_like(dx), dx)
                 send_b = send_b.astype(cfg.dtype)
                 return (
                     act, fland, bland, loss_acc, aux_acc, gr,
@@ -258,9 +342,10 @@ def make_loss_and_grads_1f1b(mesh, cfg: TransformerConfig):
         # embed/ln_f/head, whose contributions live on one stage)
         loss = jax.lax.psum(loss_acc / mb, "pp")
         if cfg.router == "topk":
+            # mean over all S_glob stage-chunk calls (v per device)
             loss = loss + cfg.router_aux * jax.lax.psum(
                 aux_acc / mb, "pp"
-            ) / pp
+            ) / S_glob
         loss = jax.lax.psum(loss, "dp") / dp
         loss = jax.lax.psum(loss, "tp") / tp
         out_grads = {}
@@ -289,15 +374,22 @@ def make_train_step_1f1b(
     cfg: TransformerConfig,
     learning_rate: float = 1e-2,
     donate: bool = True,
+    schedule: str = "1f1b",
+    virtual: int = 1,
 ):
-    """Full 1F1B training step: the drop-in counterpart of
-    ``models.transformer.make_train_step`` (same returns, same shardings)
-    with the schedule swapped from autodiff-GPipe to table-driven 1F1B."""
+    """Full 1F1B (or interleaved) training step: the drop-in counterpart
+    of ``models.transformer.make_train_step`` (same returns, same
+    shardings) with the schedule swapped from autodiff-GPipe to the
+    table-driven manual-vjp loop. For ``schedule='interleaved'`` the
+    params must be stage-stacked ``virtual * pp`` deep and arranged
+    device-major (``arrange_stage_stack``)."""
     import optax
 
     # int8_weights (forward-only) is rejected by make_loss_and_grads_1f1b
     optimizer = optax.adamw(learning_rate)
-    loss_and_grads, shardings = make_loss_and_grads_1f1b(mesh, cfg)
+    loss_and_grads, shardings = make_loss_and_grads_1f1b(
+        mesh, cfg, schedule=schedule, virtual=virtual
+    )
 
     def step(params, opt_state, tokens, targets):
         loss, grads = loss_and_grads(params, tokens, targets)

@@ -286,7 +286,11 @@ class TransformerStep(Primitive):
 
         cfg = self._model_config()
         dp, tp, pp = self._mesh_factors()
-        params = init_params(cfg, pp, n_experts=tp, seed=self.seed)
+        # total chain depth may exceed the mesh's pp (interleaved
+        # virtual chunks stack more stages per device)
+        params = init_params(
+            cfg, self._total_stages(), n_experts=tp, seed=self.seed
+        )
         tokens, targets = self._host_tokens()
         # same precision scope as the measured step, so the f32 oracle on
         # TPU is computed with the same (accurate) matmul form

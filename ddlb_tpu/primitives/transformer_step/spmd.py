@@ -19,24 +19,37 @@ from ddlb_tpu.primitives.transformer_step.base import TransformerStep
 
 
 class SPMDTransformerStep(TransformerStep):
-    DEFAULT_OPTIONS = {"schedule": "gpipe"}
-    ALLOWED_VALUES = {"schedule": ["gpipe", "1f1b"]}
+    DEFAULT_OPTIONS = {"schedule": "gpipe", "virtual": 1}
+    ALLOWED_VALUES = {
+        "schedule": ["gpipe", "1f1b", "interleaved"],
+        "virtual": (1, 8),
+    }
+
+    def _total_stages(self) -> int:
+        return self._mesh_factors()[2] * self.options["virtual"]
 
     def _check_shapes(self) -> None:
         super()._check_shapes()
-        if (
-            self.options["schedule"] == "1f1b"
-            and self.options["mode"] != "train"
-        ):
+        o = self.options
+        if o["schedule"] != "gpipe" and o["mode"] != "train":
             raise ValueError(
-                "schedule='1f1b' is a training schedule; mode='forward' "
-                "has no backward to interleave"
+                f"schedule='{o['schedule']}' is a training schedule; "
+                f"mode='forward' has no backward to interleave"
+            )
+        if o["schedule"] == "interleaved" and o["virtual"] < 2:
+            raise ValueError("schedule='interleaved' needs virtual >= 2")
+        if o["schedule"] != "interleaved" and o["virtual"] != 1:
+            raise ValueError(
+                "virtual > 1 requires schedule='interleaved'"
             )
 
     def _input_setup(self) -> None:
         import jax
 
-        from ddlb_tpu.models.pipeline import make_train_step_1f1b
+        from ddlb_tpu.models.pipeline import (
+            arrange_stage_stack,
+            make_train_step_1f1b,
+        )
         from ddlb_tpu.models.transformer import (
             init_params,
             make_loss_fn,
@@ -48,10 +61,12 @@ class SPMDTransformerStep(TransformerStep):
         self.mesh = self.runtime.mesh(("dp", "tp", "pp"), shape=(dp, tp, pp))
         self.num_partitions = dp * tp * pp
         mode = self.options["mode"]
+        sched = self.options["schedule"]
+        v = self.options["virtual"]
 
-        if mode == "train" and self.options["schedule"] == "1f1b":
+        if mode == "train" and sched in ("1f1b", "interleaved"):
             step, init_opt, shardings = make_train_step_1f1b(
-                self.mesh, cfg, donate=False
+                self.mesh, cfg, donate=False, schedule=sched, virtual=v
             )
         elif mode == "train":
             step, init_opt, shardings = make_train_step(
@@ -61,9 +76,15 @@ class SPMDTransformerStep(TransformerStep):
             loss_fn, shardings = make_loss_fn(self.mesh, cfg)
             step, init_opt = jax.jit(loss_fn), None
 
-        params = init_params(cfg, pp, n_experts=tp, seed=self.seed)
+        params = init_params(
+            cfg, self._total_stages(), n_experts=tp, seed=self.seed
+        )
+        if v > 1:
+            # Megatron-interleaved placement: device p's contiguous
+            # block-shard must hold its chunks {p, p+pp, ...}
+            params = arrange_stage_stack(params, pp, v, cfg=cfg)
         params = {
-            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+            k: jax.device_put(v_, shardings[k]) for k, v_ in params.items()
         }
         tokens, targets = self._host_tokens()
         tokens = jax.device_put(tokens, shardings["data"])
