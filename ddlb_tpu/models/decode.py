@@ -175,11 +175,30 @@ def _grouped_attend(p, cv_l, b, t, dtype):
 
 def _cache_write(cache, l, pos, k, v, int8):
     """Store this step's ``k``/``v [b, t, h_kv, dh]`` at ``(l, :, pos)``
-    (quantizing first in int8 mode)."""
+    (quantizing first in int8 mode).
+
+    ``pos`` may be a scalar (the whole batch at one position) or a
+    ``[b]`` vector — per-sequence positions, the ragged/continuous-
+    batching form: sequence ``i``'s single new row lands at ``pos[i]``.
+    """
+    ragged = jnp.ndim(pos) == 1
+    if ragged:
+        # scatter's default out-of-bounds mode silently DROPS updates;
+        # clamp to match the scalar path's dynamic_update_slice semantics
+        # (callers must still bound-check — make_generate_fn does)
+        pos = jnp.minimum(pos, cache["k"].shape[2] - 1)
+
     def upd(name, val):
-        cache[name] = jax.lax.dynamic_update_slice(
-            cache[name], val[None], (l, 0, pos, 0, 0)
-        )
+        if ragged:
+            # val [b, 1, h_kv, dh] -> row i at (l, i, pos[i])
+            b = val.shape[0]
+            cache[name] = (
+                cache[name].at[l, jnp.arange(b), pos].set(val[:, 0])
+            )
+        else:
+            cache[name] = jax.lax.dynamic_update_slice(
+                cache[name], val[None], (l, 0, pos, 0, 0)
+            )
 
     if int8:
         qk, sk = _quantize_kv(k)
@@ -210,12 +229,19 @@ def _cache_read(cache, name, l, dtype):
 
 def _cache_attend(q, cache, l, dh, pos, dtype):
     """One query row against cache layer ``l``: grouped scores,
-    live-position mask at ``pos``, softmax, value read."""
+    live-position mask at ``pos`` (scalar, or ``[b]`` per-sequence —
+    each sequence then attends only its own prefix), softmax, value
+    read."""
     b = q.shape[0]
     S_max = cache["k"].shape[2]
     s = _grouped_scores(q, _cache_read(cache, "k", l, dtype), dh)
-    live = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0) <= pos
-    s = jnp.where(live[None, None, None, None], s, -1e30)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0)
+    if jnp.ndim(pos) == 1:
+        live = iota[None, :] <= pos[:, None]          # [b, S]
+        s = jnp.where(live[:, None, None, None, :], s, -1e30)
+    else:
+        live = iota <= pos
+        s = jnp.where(live[None, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return _grouped_attend(p, _cache_read(cache, "v", l, dtype), b, 1, dtype)
 
@@ -268,7 +294,7 @@ def _block_moe(h2d, params, l, cfg, tp):
     return jax.lax.all_gather(z, "tp", axis=0, tiled=True)  # [rows, D]
 
 
-def make_decode_fn(mesh, cfg: TransformerConfig):
+def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
     """One-token decode step over a ``('dp', 'tp')`` mesh.
 
     Returns ``(decode_step, shardings)``: ``decode_step(params, cache,
@@ -276,6 +302,13 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
     token per sequence), ``pos`` a scalar int32 position, ``logits
     [B, vocab]``; jit at the call site (cache threads through
     functionally, so the step re-runs under a measurement loop).
+
+    ``ragged=True`` is the continuous-batching form: ``pos`` is a
+    ``[B]`` int32 vector (sharded over ``dp`` with its sequences) and
+    every sequence decodes at ITS OWN cache position — the write lands
+    at ``pos[i]`` and the attention mask ends there, so one compiled
+    step serves a batch whose members are at different generation
+    depths.
     """
 
     tp = mesh.shape["tp"]
@@ -343,12 +376,13 @@ def make_decode_fn(mesh, cfg: TransformerConfig):
         for name, spec in specs.items()
     }
     cspecs = cache_specs(cfg)
+    pos_spec = P("dp") if ragged else P()
 
     def step(params, cache, tokens, pos):
         return jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(specs, cspecs, P("dp"), P()),
+            in_specs=(specs, cspecs, P("dp"), pos_spec),
             out_specs=(P("dp", None), cspecs),
             check_vma=False,
         )(params, cache, tokens, pos)

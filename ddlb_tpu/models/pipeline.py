@@ -91,13 +91,21 @@ def arrange_stage_stack(params, pp: int, virtual: int, cfg=None):
             specs.update(param_specs(c))
     else:
         specs = param_specs(cfg)
+    unknown = set(params) - set(specs)
+    if unknown:
+        # a leaf the spec table doesn't know would be silently treated
+        # as replicated — wrong placement with no error; fail instead
+        raise ValueError(
+            f"param leaves missing from the spec table: {sorted(unknown)} "
+            f"(pass the matching cfg, or extend param_specs)"
+        )
     idx = np_.array(
         [c * pp + p for p in range(pp) for c in range(virtual)]
     )
     out = {}
     for k, v in params.items():
-        spec = specs.get(k)
-        stage_stacked = spec is not None and len(spec) and spec[0] == "pp"
+        spec = specs[k]
+        stage_stacked = bool(len(spec)) and spec[0] == "pp"
         out[k] = v[idx] if stage_stacked else v
     return out
 

@@ -422,3 +422,86 @@ class TestSampling:
             self._gen(top_p=0.0)
         with _pytest.raises(ValueError, match="top_k"):
             self._gen(top_k=-1)
+
+
+class TestRaggedDecode:
+    """Continuous-batching foundation: per-sequence cache positions —
+    one compiled step serves a batch at different generation depths."""
+
+    def _setup(self, kv_cache="bf16"):
+        import jax
+        import jax.numpy as jnp
+
+        from ddlb_tpu.models.decode import (
+            init_cache,
+            make_decode_fn,
+            make_prefill_fn,
+        )
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            example_tokens,
+            init_params,
+        )
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp"), shape=(4, 2))
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=2, microbatches=1, attn_kernel="einsum",
+            kv_cache=kv_cache,
+        )
+        B, S0 = 8, 8
+        params = init_params(cfg, pp=1, n_experts=2)
+        prompt, _ = example_tokens(B, S0, cfg.vocab)
+        prefill, sh = make_prefill_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        cache = init_cache(cfg, B, S0 + 1, mesh=mesh)
+        logits, cache = jax.jit(prefill)(p, cache, prompt)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        dec_s, _ = make_decode_fn(mesh, cfg)
+        dec_r, _ = make_decode_fn(mesh, cfg, ragged=True)
+        return mesh, cfg, p, cache, nxt, dec_s, dec_r, B, S0
+
+    def test_equal_vector_equals_scalar(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _, _, p, cache, nxt, dec_s, dec_r, B, S0 = self._setup()
+        l_s, _ = jax.jit(dec_s)(p, cache, nxt, jnp.int32(S0))
+        l_r, _ = jax.jit(dec_r)(p, cache, nxt, jnp.full((B,), S0, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(l_s), np.asarray(l_r))
+
+    @pytest.mark.parametrize("kv_cache", ["bf16", "int8"])
+    def test_per_sequence_rows_match_scalar_runs(self, kv_cache):
+        """Row i of a ragged step at pos[i] must equal row i of a scalar
+        step at that position (rows are per-sequence independent given
+        the same batch slots)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _, _, p, cache, nxt, dec_s, dec_r, B, S0 = self._setup(kv_cache)
+        pos_vec = np.array([3, 5, 8, 2, 7, 4, 6, 1], np.int32)
+        l_rag = np.asarray(
+            jax.jit(dec_r)(p, cache, nxt, jnp.asarray(pos_vec))[0]
+        )
+        for i in range(B):
+            l_i, _ = jax.jit(dec_s)(p, cache, nxt, jnp.int32(int(pos_vec[i])))
+            np.testing.assert_array_equal(l_rag[i], np.asarray(l_i)[i])
+
+    def test_ragged_cache_write_lands_per_sequence(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _, _, p, cache, nxt, _, dec_r, B, S0 = self._setup()
+        pos_vec = np.arange(1, B + 1, dtype=np.int32)
+        _, cache2 = jax.jit(dec_r)(p, cache, nxt, jnp.asarray(pos_vec))
+        k0, k2 = np.asarray(cache["k"]), np.asarray(cache2["k"])
+        for i in range(B):
+            # row i changed exactly at its own position
+            changed = np.any(k0[:, i] != k2[:, i], axis=(0, 2, 3))
+            assert changed[pos_vec[i]]
+            assert not changed[: pos_vec[i]].any()
+            assert not changed[pos_vec[i] + 1 :].any()
