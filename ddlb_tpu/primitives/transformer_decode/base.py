@@ -47,6 +47,9 @@ class TransformerDecode(Primitive):
         "vocab": 512,
         "n_heads": 8,
         "n_kv_heads": 0,  # 0 = MHA; fewer = GQA (cache shrinks to match)
+        #: phase=generate: tokens emitted by the measured call (the whole
+        #: compiled prefill + greedy fori_loop — tokens/s end to end)
+        "n_new": 32,
         "layers": 1,
         "mlp_kernel": "bf16",
         #: K/V cache precision: int8 halves the bytes the bandwidth-bound
@@ -60,11 +63,12 @@ class TransformerDecode(Primitive):
         "tp": 0,
     }
     BASE_ALLOWED = {
-        "phase": ["decode", "prefill"],
+        "phase": ["decode", "prefill", "generate"],
         "batch": (1, None),
         "vocab": (2, None),
         "n_heads": (1, None),
         "n_kv_heads": (0, None),
+        "n_new": (1, None),
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "kv_cache": ["bf16", "int8"],
@@ -152,8 +156,23 @@ class TransformerDecode(Primitive):
         if o["phase"] == "decode":
             per_token = L * (proj + 4.0 * self.m * D + 4.0 * D * F)
             return B * (per_token + 2.0 * D * V)
-        per_token = L * (proj + 2.0 * self.m * D + 4.0 * D * F)
-        return B * self.m * per_token + B * 2.0 * D * V
+        prefill = (
+            B * self.m * (L * (proj + 2.0 * self.m * D + 4.0 * D * F))
+            + B * 2.0 * D * V
+        )
+        if o["phase"] == "prefill":
+            return prefill
+        # generate: the prompt pass + n_new - 1 decode forwards (the
+        # first new token comes from the prefill logits and the last from
+        # the carried logits — make_generate_fn runs no wasted step), at
+        # cache positions m .. m + n_new - 2
+        steps = o["n_new"] - 1
+        ctx_sum = steps * self.m + steps * (steps - 1) / 2.0
+        decode = B * (
+            steps * (L * (proj + 4.0 * D * F) + 2.0 * D * V)
+            + L * 4.0 * D * ctx_sum
+        )
+        return prefill + decode
 
     def _model_config(self):
         from ddlb_tpu.models.transformer import TransformerConfig
@@ -208,9 +227,18 @@ class TransformerDecode(Primitive):
 
     def validate(self, result) -> bool:
         """The measured call's logits must match the oracle's at the same
-        position (decode: position m; prefill: position m-1)."""
+        position (decode: position m; prefill: position m-1).
+
+        phase=generate returns TOKENS ``[B, m + n_new]``: the prompt
+        prefix must round-trip untouched and the first few generated
+        tokens must equal the teacher-forced oracle's greedy chain (each
+        check is one oracle forward; ties in f32 argmax are measure-zero
+        for seeded random weights).
+        """
         import jax
 
+        if self.options["phase"] == "generate":
+            return self._validate_generate(result)
         logits = result[0] if isinstance(result, (tuple, list)) else result
         logits = jax.block_until_ready(logits)
         expected = self._oracle_logits().astype(np.float32)
@@ -242,3 +270,89 @@ class TransformerDecode(Primitive):
         # impossible — each process checks its addressable shards against
         # the matching oracle slice (primitives/base.py _compare_global)
         return self._compare_global(logits, expected, atol=atol)
+
+    #: generated tokens pinned to the teacher-forced oracle chain (each
+    #: is one full oracle forward, so the check is capped)
+    _GENERATE_PIN_STEPS = 3
+
+    def _validate_generate(self, result) -> bool:
+        """Shard-wise (multi-host-safe) check of the generated tokens.
+
+        The expected chain is built entirely from the ORACLE (teacher-
+        forced greedy: each pinned step's context extends with the
+        oracle's own argmax), so no cross-process token fetch is ever
+        needed — each process compares only its addressable shards, like
+        the logits path above. An argmax mismatch is forgiven where the
+        oracle's top-2 logit gap is below the family's logits tolerance
+        (half precision / the int8 cache legitimately drift that much,
+        which can flip a near-tie without being wrong).
+        """
+        import jax
+        import numpy as np
+
+        from ddlb_tpu.models.decode import reference_logits
+        from ddlb_tpu.models.transformer import init_params
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+
+        result = jax.block_until_ready(result)
+        prompt, _ = self._host_tokens()
+        B, S0 = prompt.shape
+        n_new = self.options["n_new"]
+        if result.shape != (B, S0 + n_new):
+            print(
+                f"[ddlb_tpu] generate validation FAILED: shape "
+                f"{result.shape} != {(B, S0 + n_new)}"
+            )
+            return False
+        tie_tol = 2e-4 if self.dtype == "float32" else 4e-2
+        if self.options["kv_cache"] == "int8":
+            tie_tol = max(tie_tol, 2e-2)
+        cfg = self._model_config()
+        dp, tp = self._mesh_factors()
+        params = init_params(cfg, pp=1, n_experts=tp, seed=self.seed)
+        pin = min(self._GENERATE_PIN_STEPS, n_new)
+        want = np.full((B, pin), -1, np.int64)
+        gap = np.zeros((B, pin), np.float32)
+        ctx = prompt
+        with matmul_precision_scope(self.dtype):
+            for t in range(pin):
+                logits = np.asarray(
+                    jax.block_until_ready(
+                        reference_logits(params, ctx, cfg, tp=tp, dp=dp)
+                    ),
+                    np.float32,
+                )
+                top2 = np.sort(logits, axis=-1)[:, -2:]
+                gap[:, t] = top2[:, 1] - top2[:, 0]
+                want[:, t] = logits.argmax(-1)
+                ctx = np.concatenate([ctx, want[:, t : t + 1]], axis=1)
+        ok = True
+        for shard in result.addressable_shards:
+            got = np.asarray(shard.data)
+            rows = shard.index[0]
+            if not (got[:, :S0] == prompt[rows]).all():
+                print(
+                    "[ddlb_tpu] generate validation FAILED: prompt mangled"
+                )
+                ok = False
+            if ((got < 0) | (got >= self.options["vocab"])).any():
+                print("[ddlb_tpu] generate validation FAILED: token range")
+                ok = False
+            # only the FIRST divergence per row is checkable: a forgiven
+            # tie-flip changes that row's context, so later steps
+            # legitimately leave the oracle chain
+            mism = got[:, S0 : S0 + pin] != want[rows]
+            any_m = mism.any(axis=1)
+            first = np.where(any_m, mism.argmax(axis=1), 0)
+            row_gap = np.take_along_axis(
+                gap[rows], first[:, None], axis=1
+            )[:, 0]
+            hard = any_m & (row_gap >= tie_tol)
+            if hard.any():
+                print(
+                    f"[ddlb_tpu] generate validation FAILED: shard "
+                    f"{shard.index}: {int(hard.sum())} rows leave the "
+                    f"oracle chain at a non-tie position"
+                )
+                ok = False
+        return ok

@@ -22,8 +22,12 @@ class ComputeOnlyTransformerDecode(SPMDTransformerDecode):
         return 1, 1
 
     def _make_mesh(self, dp: int, tp: int):
-        import numpy as np
-        from jax.sharding import Mesh
+        import jax
 
-        devs = np.array(self.runtime.local_devices[:1]).reshape(1, 1)
-        return Mesh(devs, ("dp", "tp"))
+        # jax.make_mesh (not a raw Mesh): the serving paths use
+        # jax.sharding.reshard, which requires the Explicit axis types
+        # make_mesh defaults to
+        return jax.make_mesh(
+            (1, 1), ("dp", "tp"),
+            devices=self.runtime.local_devices[:1],
+        )

@@ -46,7 +46,26 @@ class SPMDTransformerDecode(TransformerDecode):
             jnp.asarray(prompt), NamedSharding(self.mesh, P("dp", None))
         )
 
-        if self.options["phase"] == "decode":
+        if self.options["phase"] == "generate":
+            from ddlb_tpu.models.decode import make_generate_fn
+
+            # the whole compiled serving loop — prefill + n_new greedy
+            # decode steps under fori_loop — as ONE measured call:
+            # end-to-end tokens/s (the cache re-inits from zeros inside
+            # the measured fn via init_cache being outside: we pass the
+            # zero cache; the loop prefills then decodes)
+            n_new = self.options["n_new"]
+            generate, _ = make_generate_fn(self.mesh, cfg, n_new=n_new)
+            cache = init_cache(
+                cfg, self.options["batch"], self.m + n_new, self.mesh
+            )
+
+            def step(prompt, params, cache):
+                return generate(params, cache, prompt)
+
+            self._fn = jax.jit(step)
+            self._args = (prompt_dev, params, cache)
+        elif self.options["phase"] == "decode":
             from ddlb_tpu.primitives.base import matmul_precision_scope
 
             # cache sized for the prompt plus the measured position; the
@@ -83,6 +102,8 @@ class SPMDTransformerDecode(TransformerDecode):
     def timed_call(self):
         """Token array first so the measured loop's poison lands on ints
         (the params dict in slot 0 would break the loop carry)."""
+        if self.options["phase"] == "generate":
+            return self._fn, self._args
         if self.options["phase"] == "decode":
             params, cache, tok, pos = self._args
 

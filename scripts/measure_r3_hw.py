@@ -132,6 +132,22 @@ run(
     label="prefill 2k (flash)", batch=B, vocab=V, n_heads=HEADS,
     phase="prefill", attn_kernel="flash",
 )
+# end-to-end serving loop: prefill + N_NEW greedy tokens, one compiled call
+N_NEW = 32
+for opts, lbl in (
+    ({}, f"generate 2k+{N_NEW} bf16 MHA"),
+    ({"kv_cache": "int8", "n_kv_heads": 4}, f"generate 2k+{N_NEW} int8+GQA4"),
+):
+    r = run(
+        "transformer_decode", "spmd", 2048, D, F,
+        label=lbl, batch=B, vocab=V, n_heads=HEADS,
+        phase="generate", n_new=N_NEW, attn_kernel="einsum", **opts,
+    )
+    t_ms = r["median time (ms)"]
+    print(
+        f"    -> {B * N_NEW / t_ms * 1e3:,.0f} generated tok/s end to end",
+        flush=True,
+    )
 
 # -- 2) int8 Pallas tile sweep (paired, same session) -------------------------
 

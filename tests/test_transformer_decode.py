@@ -505,3 +505,52 @@ class TestRaggedDecode:
             assert changed[pos_vec[i]]
             assert not changed[: pos_vec[i]].any()
             assert not changed[pos_vec[i] + 1 :].any()
+
+
+class TestGeneratePhase:
+    """phase=generate: the whole compiled serving loop (prefill + n_new
+    greedy decode steps) as one measured call — end-to-end tokens/s."""
+
+    def _run(self, impl, **opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        return benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": f"{impl}_gen",
+                "base_implementation": impl,
+                "options": {
+                    "phase": "generate", "n_new": 6, "batch": 8,
+                    "vocab": 64, "n_heads": 8, "attn_kernel": "einsum",
+                    **opts,
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+
+    @pytest.mark.parametrize("impl", ["spmd", "compute_only"])
+    def test_validates_against_oracle_chain(self, impl):
+        row = self._run(impl)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_fast_decode_levers_compose(self):
+        row = self._run("spmd", kv_cache="int8", n_kv_heads=2)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_xla_gspmd_rejects_generate(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_decode", "xla_gspmd")
+        with pytest.raises(ValueError, match="generate"):
+            cls(16, 64, 64, dtype="float32", phase="generate",
+                batch=8, vocab=64, n_heads=8)
