@@ -200,13 +200,13 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
             if cfg.attention == "ring"
             else P("pp", None, None, None, "tp")
         )
+    elif cfg.attention == "ring":
+        # ring mode replicates the attention projections (tp is the
+        # context axis); GQA just shrinks the replicated K/V columns —
+        # and the ringed chunks with them
+        specs["w_q"] = P("pp", None, None, None)
+        specs["w_kv"] = P("pp", None, None, None, None)
     else:
-        if cfg.attention == "ring":
-            raise ValueError(
-                "attention='ring' is MHA-only (the ringed K/V chunks are "
-                "projected per-rank with replicated full-head weights); "
-                "GQA uses attention='gathered'"
-            )
         specs["w_q"] = P("pp", None, None, "tp")
         specs["w_kv"] = P("pp", None, None, None, "tp")
     if cfg.router == "topk":
@@ -256,8 +256,11 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
     standalone.
 
     ``q``/``k``/``v``: [b, s_loc, h_loc, dh] (local sequence chunk, local
-    heads). Returns [b, s_loc, h_loc, dh].
+    heads; ``k``/``v`` may carry fewer GQA heads — each arriving chunk is
+    repeated up to the query head count before its fold, so the ring
+    still ships the small kv chunks). Returns [b, s_loc, h_loc, dh].
     """
+    G = q.shape[2] // k.shape[2]
     my = jax.lax.axis_index(axis_name)
     s_loc, dh = q.shape[1], q.shape[3]
     scale = 1.0 / np.sqrt(dh)
@@ -271,7 +274,9 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
     k_cur, v_cur = k, v
     for t in range(d):
         src = (my - t) % d  # the chunk held after t hops came from src
-        s = jnp.einsum("bhqd,bkhd->bhqk", qh, k_cur.astype(jnp.float32))
+        k_use = jnp.repeat(k_cur, G, axis=2) if G > 1 else k_cur
+        v_use = jnp.repeat(v_cur, G, axis=2) if G > 1 else v_cur
+        s = jnp.einsum("bhqd,bkhd->bhqk", qh, k_use.astype(jnp.float32))
         mask = (my * s_loc + rows) >= (src * s_loc + cols)
         s = jnp.where(mask[None, None], s, -1e30)
         m_new = jnp.maximum(m_run, s.max(-1, keepdims=True))
@@ -279,7 +284,7 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
         p = jnp.exp(s - m_new)
         l_run = l_run * alpha + p.sum(-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+            "bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32)
         )
         m_run = m_new
         if t + 1 < d:
@@ -328,11 +333,14 @@ def _flash_full(q, k, v, interpret):
 def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
     """Batched context-parallel flash attention on the local sequence
     chunk: [b, s_loc, h, dh] -> [b, s_loc, h, dh]; K/V (and, in the
-    backward, their gradient accumulators) ride the ``axis_name`` ring."""
+    backward, their gradient accumulators) ride the ``axis_name`` ring —
+    at the kv-head width, so GQA shrinks the ring traffic."""
     from ddlb_tpu.ops.flash_attention import ring_flash_attention
 
     b, s_loc, h, dh = q.shape
-    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(s_loc, b * h, dh)
+    merge = lambda x: x.transpose(1, 0, 2, 3).reshape(
+        s_loc, b * x.shape[2], dh
+    )
     o = ring_flash_attention(
         merge(q), merge(k), merge(v),
         axis_name=axis_name,
@@ -496,19 +504,40 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
         for l in range(L):
             h = _rms_norm(x, sp["ln1"][0, l])
             if cfg.attention == "ring":
-                wq = sp["w_qkv"][0, l]  # [3, D, D]: replicated full heads
                 # -- context-parallel attention (cp_ring_attention
                 # pattern): full-head QKV projected on the LOCAL sequence
                 # chunk (replicated weights — see param_specs), K/V chunks
-                # ring the tp axis, local out-proj, no collective --
-                q, k, v = (
-                    jnp.matmul(
-                        h, wq[i], preferred_element_type=jnp.float32
+                # ring the tp axis, local out-proj, no collective. Under
+                # GQA the ringed chunks carry only kv heads — the wire
+                # bytes shrink by the group factor --
+                if cfg.kv_heads == cfg.n_heads:
+                    wq = sp["w_qkv"][0, l]  # [3, D, D]: replicated heads
+                    q, k, v = (
+                        jnp.matmul(
+                            h, wq[i], preferred_element_type=jnp.float32
+                        )
+                        .astype(x.dtype)
+                        .reshape(b, s_loc, cfg.n_heads, cfg.head_dim)
+                        for i in range(3)
                     )
-                    .astype(x.dtype)
-                    .reshape(b, s_loc, cfg.n_heads, cfg.head_dim)
-                    for i in range(3)
-                )
+                else:
+                    q = (
+                        jnp.matmul(
+                            h, sp["w_q"][0, l],
+                            preferred_element_type=jnp.float32,
+                        )
+                        .astype(x.dtype)
+                        .reshape(b, s_loc, cfg.n_heads, cfg.head_dim)
+                    )
+                    k, v = (
+                        jnp.matmul(
+                            h, sp["w_kv"][0, l, i],
+                            preferred_element_type=jnp.float32,
+                        )
+                        .astype(x.dtype)
+                        .reshape(b, s_loc, cfg.kv_heads, cfg.head_dim)
+                        for i in range(2)
+                    )
                 if cfg.attn_kernel == "flash":
                     attn = _ring_flash(q, k, v, tp, interpret).reshape(
                         b, s_loc, -1

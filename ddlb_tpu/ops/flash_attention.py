@@ -188,16 +188,19 @@ def flash_attention_chunk(
 ):
     """Fold one KV chunk into a flash accumulator (ring-attention step).
 
-    ``q``: [sq, h, dh]; ``k``/``v``: [skv, h, dh] — the chunk whose global
-    key rows start at ``col_offset`` (a runtime scalar, like
-    ``row_offset``). ``carry`` is ``(acc, m, l)`` with head-major shapes
-    ``[h, sq, dh]``, ``[h, sq, 1]``, ``[h, sq, 1]`` (f32), as produced by
+    ``q``: [sq, h, dh]; ``k``/``v``: [skv, h_kv, dh] (``h_kv < h`` is
+    GQA — grouped query heads share the chunk's kv head straight from
+    the head index map) — the chunk whose global key rows start at
+    ``col_offset`` (a runtime scalar, like ``row_offset``). ``carry`` is
+    ``(acc, m, l)`` with head-major shapes ``[h, sq, dh]``,
+    ``[h, sq, 1]``, ``[h, sq, 1]`` (f32), as produced by
     ``init_flash_carry``. Returns the updated carry; normalize with
     ``finalize_flash_carry`` after the last chunk.
     """
     acc, m_run, l_run = carry
     sq, h, dh = q.shape
     skv = k.shape[0]
+    G = _gqa_group(q, k)
     bq, bkv = min(block_q, sq), min(block_kv, skv)
     if sq % bq or skv % bkv:
         raise ValueError(
@@ -213,7 +216,7 @@ def flash_attention_chunk(
         causal=causal,
     )
     qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
-    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh, j, 0))
+    kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh // G, j, 0))
     accspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
     mlspec = pl.BlockSpec((1, bq, 1), lambda hh, i, j, off: (hh, i, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -1093,12 +1096,13 @@ def ring_flash_attention(
     accumulators TRAVEL THE RING with their chunks, so after the last hop
     plus one delivery ``ppermute`` every gradient lands on its owner —
     the communication volume matches the forward's.
+
+    GQA composes naturally: ``k``/``v`` may carry ``h_kv = h/G`` heads —
+    the ring then ships the SMALL kv chunks (and their gradient
+    accumulators), so context parallelism's wire bytes shrink by the
+    same group factor as the serving cache.
     """
-    if k.shape[1] != q.shape[1]:
-        raise ValueError(
-            "ring_flash_attention is MHA-only (n_kv_heads == n_heads); "
-            "GQA rides the gathered flash_attention path"
-        )
+    _gqa_group(q, k)  # validates h % h_kv
     return _ring_flash(
         q, k, v, axis_name, axis_size, scale, block_q, block_kv, interpret
     )

@@ -185,11 +185,6 @@ class TransformerStep(Primitive):
                     f"n_heads={o['n_heads']} not divisible by "
                     f"n_kv_heads={o['n_kv_heads']}"
                 )
-            if o["attention"] == "ring" and o["n_kv_heads"] != o["n_heads"]:
-                raise ValueError(
-                    "attention='ring' is MHA-only; GQA (n_kv_heads < "
-                    "n_heads) uses attention='gathered'"
-                )
             if o["attention"] == "gathered" and o["n_kv_heads"] % tp != 0:
                 raise ValueError(
                     f"n_kv_heads={o['n_kv_heads']} not divisible by tp={tp}"
