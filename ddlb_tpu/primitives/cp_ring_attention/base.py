@@ -38,10 +38,15 @@ def causal_attention(q, k, v, scale, row_offset=0):
     """Masked softmax attention in jnp, queries at ``row_offset`` within the
     global sequence — the single source of the math used by the
     compute_only and allgather implementations (the ring implementation
-    re-derives it in online form)."""
+    re-derives it in online form). ``k``/``v`` may carry fewer (grouped/
+    GQA) heads; repetition computes the identical dot products."""
     import jax
     import jax.numpy as jnp
 
+    if k.shape[1] != q.shape[1]:
+        G = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, G, axis=1)
+        v = jnp.repeat(v, G, axis=1)
     qh = q.transpose(1, 0, 2).astype(jnp.float32) * scale
     kh = k.transpose(1, 0, 2).astype(jnp.float32)
     vh = v.transpose(1, 0, 2).astype(jnp.float32)
@@ -63,8 +68,11 @@ class CPRingAttention(Primitive):
 
     #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
     #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh
-    BASE_OPTIONS = {"transport": "ici"}
-    BASE_ALLOWED = {"transport": ["ici", "dcn"]}
+    #: — plus the GQA axis: n_kv_heads < num_heads shrinks the K/V
+    #: operands (and therefore the ring/all-to-all wire bytes) by the
+    #: group factor, the long-context serving shape
+    BASE_OPTIONS = {"transport": "ici", "n_kv_heads": 0}
+    BASE_ALLOWED = {"transport": ["ici", "dcn"], "n_kv_heads": (0, None)}
 
     def _check_shapes(self) -> None:
         d = self.num_partitions
@@ -77,10 +85,20 @@ class CPRingAttention(Primitive):
             )
         if self.dtype in ("int32", "int64"):
             raise ValueError("attention requires a floating dtype")
+        nkv = self.options["n_kv_heads"]
+        if nkv and self.num_heads % nkv != 0:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"n_kv_heads={nkv}"
+            )
 
     @property
     def num_heads(self) -> int:
         return self.n // self.k
+
+    @property
+    def kv_heads(self) -> int:
+        return self.options["n_kv_heads"] or self.num_heads
 
     def flops(self) -> float:
         # 2*m^2*n for QK^T + 2*m^2*n for PV, halved by the causal mask
@@ -88,11 +106,11 @@ class CPRingAttention(Primitive):
 
     def _host_qkv(self):
         rng = np.random.default_rng(self.seed)
-        shape = (self.m, self.num_heads, self.k)
         gen = np.float32
-        q = rng.uniform(-1, 1, shape).astype(gen)
-        k = rng.uniform(-1, 1, shape).astype(gen)
-        v = rng.uniform(-1, 1, shape).astype(gen)
+        q = rng.uniform(-1, 1, (self.m, self.num_heads, self.k)).astype(gen)
+        kv_shape = (self.m, self.kv_heads, self.k)
+        k = rng.uniform(-1, 1, kv_shape).astype(gen)
+        v = rng.uniform(-1, 1, kv_shape).astype(gen)
         return q, k, v
 
     def _input_setup(self) -> None:
@@ -126,13 +144,14 @@ class CPRingAttention(Primitive):
             k = np.asarray(jnp.asarray(k, cast), np.float32)
             v = np.asarray(jnp.asarray(v, cast), np.float32)
         m, h = self.m, self.num_heads
+        G = h // self.kv_heads
         scale = 1.0 / np.sqrt(self.k)
         out = np.empty((m, h, self.k), np.float32)
         block = max(1, min(m, (1 << 24) // max(m, 1)))  # ~64 MB scores
         cols = np.arange(m)
         for head in range(h):
-            kh = k[:, head, :]  # [m, dh]
-            vh = v[:, head, :]
+            kh = k[:, head // G, :]  # [m, dh] (shared GQA head)
+            vh = v[:, head // G, :]
             for r0 in range(0, m, block):
                 r1 = min(r0 + block, m)
                 scores = (q[r0:r1, head, :] @ kh.T) * scale  # [blk, m]

@@ -31,6 +31,7 @@ class RingCPRingAttention(CPRingAttention):
         d = self.num_partitions
         s_loc = self.m // d
         h, dh = self.num_heads, self.k
+        G = h // self.kv_heads
         scale = 1.0 / (dh ** 0.5)
         fwd = [(i, (i + 1) % d) for i in range(d)]
         skip = self.options["skip_masked_blocks"]
@@ -53,6 +54,11 @@ class RingCPRingAttention(CPRingAttention):
 
                 def fold(carry, k_blk=k_cur, v_blk=v_cur, kv_idx=kv_idx):
                     o, m_run, l_run = carry
+                    if G > 1:
+                        # GQA: the ring shipped the SMALL kv-head block;
+                        # expand only at fold time
+                        k_blk = jnp.repeat(k_blk, G, axis=0)
+                        v_blk = jnp.repeat(v_blk, G, axis=0)
                     s = jnp.einsum(
                         "hqd,hkd->hqk",
                         qh,

@@ -44,6 +44,12 @@ class UlyssesCPRingAttention(CPRingAttention):
                 f"num_heads={self.num_heads} must be divisible by "
                 f"partitions={d} for ulysses"
             )
+        if self.kv_heads % d != 0:
+            raise ValueError(
+                f"n_kv_heads={self.kv_heads} must be divisible by "
+                f"partitions={d} for ulysses (the K/V all-to-all shards "
+                f"kv heads)"
+            )
 
     def _input_setup(self) -> None:
         super()._input_setup()

@@ -193,3 +193,43 @@ def test_ring_flash_matches_ring(skip):
     np.testing.assert_allclose(
         np.asarray(rf.run()), np.asarray(ring.run()), atol=2e-5
     )
+
+
+class TestGQASweep:
+    """n_kv_heads on the family: K/V operands (and therefore the ring /
+    all-to-all wire bytes) shrink by the group factor; every member must
+    still match the grouped-attention oracle."""
+
+    @pytest.mark.parametrize(
+        "impl,opts",
+        [
+            ("compute_only", {"size": "unsharded"}),
+            ("allgather", {}),
+            ("ring", {}),
+            ("flash", {"block_q": 16, "block_kv": 16}),
+            ("ring_flash", {"block_q": 16, "block_kv": 16}),
+        ],
+    )
+    def test_members_validate_with_gqa(self, impl, opts):
+        cls = load_impl_class("cp_ring_attention", impl)
+        inst = cls(128, 256, 32, dtype="float32", n_kv_heads=2, **opts)
+        assert inst.validate(inst.run())
+
+    def test_ulysses_gqa_needs_divisible_kv_heads(self):
+        cls = load_impl_class("cp_ring_attention", "ulysses")
+        # 8 kv heads / 8 devices: fine
+        inst = cls(128, 256, 32, dtype="float32", n_kv_heads=8)
+        assert inst.validate(inst.run())
+        with pytest.raises(ValueError, match="kv heads"):
+            cls(128, 256, 32, dtype="float32", n_kv_heads=2)
+
+    def test_indivisible_group_rejected(self):
+        cls = load_impl_class("cp_ring_attention", "ring")
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            cls(128, 256, 32, dtype="float32", n_kv_heads=3)
+
+    def test_kv_operands_shrink(self):
+        cls = load_impl_class("cp_ring_attention", "ring")
+        inst = cls(128, 256, 32, dtype="float32", n_kv_heads=2)
+        q, k, v = inst.get_inputs()
+        assert q.shape[1] == 8 and k.shape[1] == 2 and v.shape[1] == 2
