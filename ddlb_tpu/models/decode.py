@@ -48,6 +48,7 @@ from ddlb_tpu.models.transformer import (
     TransformerConfig,
     _moe_ffn,
     _rms_norm,
+    apply_rope,
 )
 
 
@@ -340,11 +341,20 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
         if b % tp != 0:
             raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
         x = params["embed"][tokens][:, None, :]  # [b, 1, D]
+        if cfg.rope:
+            posb = (
+                pos[:, None]
+                if jnp.ndim(pos) == 1
+                else jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b, 1))
+            )
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
                 h, params, l, b, 1, h_loc, kv_loc, dh, x.dtype
             )
+            if cfg.rope:
+                q = apply_rope(q, posb, cfg.rope_theta)
+                k = apply_rope(k, posb, cfg.rope_theta)
             cache = _cache_write(cache, l, pos, k, v, int8_cache)
             # q [b, 1, h, dh] grouped against the kv-head cache row;
             # positions past ``pos`` are masked (zeros in the cache never
@@ -442,6 +452,10 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
             q, k, v = _project_qkv(
                 h, params, l, b, S, h_loc, kv_loc, dh, x.dtype
             )
+            if cfg.rope:
+                pos = jnp.arange(S, dtype=jnp.int32)[None]
+                q = apply_rope(q, pos, cfg.rope_theta)
+                k = apply_rope(k, pos, cfg.rope_theta)
             cache = _cache_write(cache, l, 0, k, v, int8_cache)
             if int8_cache:
                 # prompt attention reads the same dequantized values the
@@ -516,11 +530,20 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
     def decode_fwd(params, cache, tokens, pos):
         cache = dict(cache)
         x = params["embed"][tokens][:, None, :]  # [B, 1, D]
+        if cfg.rope:
+            posb = (
+                pos[:, None]
+                if jnp.ndim(pos) == 1
+                else jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B, 1))
+            )
         for l in range(L):
             h = _rms_norm(x, params["ln1"][0, l])
             q, k, v = _project_qkv(
                 h, params, l, B, 1, H, H_kv, dh, x.dtype
             )
+            if cfg.rope:
+                q = apply_rope(q, posb, cfg.rope_theta)
+                k = apply_rope(k, posb, cfg.rope_theta)
             cache = _cache_write(cache, l, pos, k, v, int8_cache)
             attn = _cache_attend(q, cache, l, dh, pos, x.dtype)
             x = x + jnp.matmul(
@@ -545,6 +568,10 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
             q, k, v = _project_qkv(
                 h, params, l, B_, S, H, H_kv, dh, x.dtype
             )
+            if cfg.rope:
+                pos = jnp.arange(S, dtype=jnp.int32)[None]
+                q = apply_rope(q, pos, cfg.rope_theta)
+                k = apply_rope(k, pos, cfg.rope_theta)
             cache = _cache_write(cache, l, 0, k, v, int8_cache)
             if int8_cache:
                 k = _kv_roundtrip(k)
@@ -699,6 +726,10 @@ def reference_logits(
             h, params, l, B, S, cfg.n_heads, cfg.kv_heads,
             cfg.head_dim, x.dtype,
         )
+        if cfg.rope:
+            pos = jnp.arange(S, dtype=jnp.int32)[None]
+            q = apply_rope(q, pos, cfg.rope_theta)
+            k = apply_rope(k, pos, cfg.rope_theta)
         if cfg.kv_cache == "int8":
             # the serving paths attend dequantized cache entries; the
             # oracle applies the identical per-(position, head) rounding

@@ -79,6 +79,14 @@ class TransformerConfig:
     #: dynamic per-token activation quant. Forward-only: int8 weight
     #: leaves have no gradients.
     mlp_kernel: str = "bf16"
+    #: rotary position embeddings (RoPE, rotate-half form) applied to
+    #: q/k after projection. Position source per path: global sequence
+    #: index (gathered), chunk offset + local index (ring), cache
+    #: position (decode — scalar or ragged per-sequence). The K cache
+    #: stores POST-rotation keys, so decode reads need no re-rotation.
+    #: False keeps the family's established benchmark numbers comparable.
+    rope: bool = False
+    rope_theta: float = 10000.0
     #: "block": balanced block routing — sequence i's tokens use expert
     #: i-block (deterministic, perfectly balanced; the benchmark default,
     #: isolating the all-to-all traffic pattern from routing dynamics).
@@ -217,6 +225,28 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         specs["moe_w1_scale"] = P("pp", None, "tp", None, None)
         specs["moe_w2_scale"] = P("pp", None, "tp", None, None)
     return specs
+
+
+def apply_rope(x, positions, theta: float):
+    """Rotate-half rotary embedding: ``x [..., s, h, dh]`` with
+    ``positions`` broadcastable to ``x.shape[:-2]`` (int32 absolute
+    positions per row). Pairs dimension i with i + dh/2 (the rotate-half
+    convention); computed in f32 and cast back, so the sharded paths and
+    the oracle agree bitwise. Shared by train (global/chunk positions),
+    prefill (0..S), and decode (cache position, scalar or ragged).
+    """
+    dh = x.shape[-1]
+    assert dh % 2 == 0, f"RoPE needs an even head_dim, got {dh}"
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[..., None, None].astype(jnp.float32) * freqs  # [..., 1, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
 
 
 def _rms_norm(x, scale):
@@ -538,6 +568,16 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                         .reshape(b, s_loc, cfg.kv_heads, cfg.head_dim)
                         for i in range(2)
                     )
+                if cfg.rope:
+                    # global positions of this rank's sequence chunk —
+                    # rotation happens BEFORE the chunks ring, so every
+                    # arriving K block already carries its true positions
+                    pos = (
+                        jax.lax.axis_index("tp") * s_loc
+                        + jnp.arange(s_loc, dtype=jnp.int32)
+                    )[None]
+                    q = apply_rope(q, pos, cfg.rope_theta)
+                    k = apply_rope(k, pos, cfg.rope_theta)
                 if cfg.attn_kernel == "flash":
                     attn = _ring_flash(q, k, v, tp, interpret).reshape(
                         b, s_loc, -1
@@ -576,15 +616,21 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                 kv_loc = cfg.kv_heads // tp
                 shape = (b, S, h_heads, cfg.head_dim)
                 kshape = (b, S, kv_loc, cfg.head_dim)
+                q4, k4, v4 = (
+                    q.reshape(shape), k.reshape(kshape), v.reshape(kshape)
+                )
+                if cfg.rope:
+                    pos = jnp.arange(S, dtype=jnp.int32)[None]
+                    q4 = apply_rope(q4, pos, cfg.rope_theta)
+                    k4 = apply_rope(k4, pos, cfg.rope_theta)
                 if cfg.attn_kernel == "flash":
-                    attn = _flash_full(
-                        q.reshape(shape), k.reshape(kshape), v.reshape(kshape),
-                        interpret,
-                    ).reshape(b, S, -1)  # [b, S, D/tp]
+                    attn = _flash_full(q4, k4, v4, interpret).reshape(
+                        b, S, -1
+                    )  # [b, S, D/tp]
                 else:
-                    attn = _causal_attention(
-                        q.reshape(shape), k.reshape(kshape), v.reshape(kshape)
-                    ).reshape(b, S, -1)  # [b, S, D/tp]
+                    attn = _causal_attention(q4, k4, v4).reshape(
+                        b, S, -1
+                    )  # [b, S, D/tp]
                 part = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
                 )  # [b, S, D] partial over tp
@@ -870,9 +916,14 @@ def reference_loss(
                     )
                 shape = (b_mb, S, cfg.n_heads, cfg.head_dim)
                 kshape = (b_mb, S, cfg.kv_heads, cfg.head_dim)
-                attn = _causal_attention(
+                q4, k4, v4 = (
                     q.reshape(shape), k.reshape(kshape), v.reshape(kshape)
-                ).reshape(b_mb, S, D)
+                )
+                if cfg.rope:
+                    pos = jnp.arange(S, dtype=jnp.int32)[None]
+                    q4 = apply_rope(q4, pos, cfg.rope_theta)
+                    k4 = apply_rope(k4, pos, cfg.rope_theta)
+                attn = _causal_attention(q4, k4, v4).reshape(b_mb, S, D)
                 x = x + jnp.matmul(
                     attn, params["w_o"][st, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)

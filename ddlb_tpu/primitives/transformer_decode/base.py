@@ -52,6 +52,7 @@ class TransformerDecode(Primitive):
         "n_new": 32,
         "layers": 1,
         "mlp_kernel": "bf16",
+        "rope": False,
         #: K/V cache precision: int8 halves the bytes the bandwidth-bound
         #: decode step re-reads per token (fast-decode member; composes
         #: with n_kv_heads' GQA shrink)
@@ -71,6 +72,7 @@ class TransformerDecode(Primitive):
         "n_new": (1, None),
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "rope": [True, False],
         "kv_cache": ["bf16", "int8"],
         "attn_kernel": ["flash", "einsum"],
         "dp": (0, None),
@@ -187,6 +189,7 @@ class TransformerDecode(Primitive):
             d_ff=self.k,
             layers_per_stage=o["layers"],
             mlp_kernel=o["mlp_kernel"],
+            rope=o["rope"],
             kv_cache=o["kv_cache"],
             attn_kernel=o["attn_kernel"],
             dtype=jnp_dtype(self.dtype),

@@ -52,6 +52,7 @@ class TransformerStep(Primitive):
         "attention": "gathered",
         "attn_kernel": "flash",
         "mlp_kernel": "bf16",
+        "rope": False,
         "router": "block",
         "router_topk": 2,
         "capacity_factor": 1.25,
@@ -70,6 +71,7 @@ class TransformerStep(Primitive):
         "attention": ["gathered", "ring"],
         "attn_kernel": ["flash", "einsum"],
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "rope": [True, False],
         "router": ["block", "topk"],
         "router_topk": (1, 4),
         "capacity_factor": (0.25, 8.0),
@@ -253,6 +255,7 @@ class TransformerStep(Primitive):
             attention=o["attention"],
             attn_kernel=o["attn_kernel"],
             mlp_kernel=o["mlp_kernel"],
+            rope=o["rope"],
             router=o["router"],
             router_topk=o["router_topk"],
             capacity_factor=o["capacity_factor"],

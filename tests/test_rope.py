@@ -1,0 +1,181 @@
+"""Rotary position embeddings: rotation math + every model path.
+
+RoPE is applied after projection, before attention (and before the
+cache write, so decode reads stored post-rotation keys). The oracles
+apply the identical f32 rotation, so parity stays exact across gathered
+and ring attention, both kernels, GQA, the 1F1B schedule, and the
+serving phases including the int8 cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.models.transformer import apply_rope
+
+
+class TestRotation:
+    def test_norm_preserved(self):
+        """Rotations preserve the norm of each (i, i+half) pair."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 8, 4, 16)), jnp.float32)
+        pos = jnp.arange(8, dtype=jnp.int32)[None]
+        y = apply_rope(x, pos, 10000.0)
+        nx = jnp.linalg.norm(x, axis=-1)
+        ny = jnp.linalg.norm(y, axis=-1)
+        assert float(jnp.max(jnp.abs(nx - ny))) < 1e-4
+
+    def test_position_zero_is_identity(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(1, 1, 2, 8)), jnp.float32)
+        y = apply_rope(x, jnp.zeros((1, 1), jnp.int32), 10000.0)
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-7)
+
+    def test_relative_position_property(self):
+        """q.k after RoPE depends only on the position DIFFERENCE."""
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+
+        def dot_at(pq, pk):
+            qr = apply_rope(q, jnp.full((1, 1), pq, jnp.int32), 10000.0)
+            kr = apply_rope(k, jnp.full((1, 1), pk, jnp.int32), 10000.0)
+            return float(jnp.sum(qr * kr))
+
+        assert abs(dot_at(7, 3) - dot_at(14, 10)) < 1e-4
+        assert abs(dot_at(7, 3) - dot_at(3, 7)) > 1e-3  # not symmetric
+
+    def test_changes_attention(self):
+        """RoPE must not be a silent no-op in the model."""
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            example_tokens,
+            init_params,
+            reference_loss,
+        )
+
+        kw = dict(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=1, microbatches=1,
+        )
+        tokens, targets = example_tokens(2, 16, 64)
+        params = init_params(TransformerConfig(**kw), pp=1, n_experts=2)
+        l0 = float(reference_loss(
+            params, tokens, targets, TransformerConfig(**kw), tp=2, dp=1
+        ))
+        l1 = float(reference_loss(
+            params, tokens, targets,
+            TransformerConfig(rope=True, **kw), tp=2, dp=1,
+        ))
+        assert abs(l0 - l1) > 1e-5
+
+
+class TestModelPaths:
+    @pytest.mark.parametrize(
+        "opts",
+        [
+            {"attn_kernel": "flash"},
+            {"attention": "ring", "attn_kernel": "flash"},
+            {"n_kv_heads": 2, "attn_kernel": "einsum"},
+        ],
+    )
+    def test_train_step_validates(self, opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_rope",
+                "base_implementation": "spmd",
+                "options": {
+                    "rope": True, "batch": 4, "vocab": 64, "n_heads": 8,
+                    "microbatches": 2, **opts,
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    @pytest.mark.parametrize(
+        "opts",
+        [
+            {"phase": "decode"},
+            {"phase": "decode", "kv_cache": "int8", "n_kv_heads": 2},
+            {"phase": "generate", "n_new": 5},
+        ],
+    )
+    def test_serving_validates(self, opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_rope",
+                "base_implementation": "spmd",
+                "options": {
+                    "rope": True, "batch": 8, "vocab": 64, "n_heads": 8,
+                    "attn_kernel": "einsum", **opts,
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_ragged_decode_rotates_per_sequence(self):
+        """Ragged positions rotate each sequence at ITS position: rows
+        must equal scalar runs at those positions (bitwise)."""
+        from ddlb_tpu.models.decode import (
+            init_cache,
+            make_decode_fn,
+            make_prefill_fn,
+        )
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            example_tokens,
+            init_params,
+        )
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp"), shape=(4, 2))
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=1, microbatches=1, attn_kernel="einsum",
+            rope=True,
+        )
+        B, S0 = 8, 8
+        params = init_params(cfg, pp=1, n_experts=2)
+        prompt, _ = example_tokens(B, S0, cfg.vocab)
+        prefill, sh = make_prefill_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        cache = init_cache(cfg, B, S0 + 1, mesh=mesh)
+        logits, cache = jax.jit(prefill)(p, cache, prompt)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        dec_s, _ = make_decode_fn(mesh, cfg)
+        dec_r, _ = make_decode_fn(mesh, cfg, ragged=True)
+        pos_vec = np.array([3, 5, 8, 2, 7, 4, 6, 1], np.int32)
+        l_rag = np.asarray(
+            jax.jit(dec_r)(p, cache, nxt, jnp.asarray(pos_vec))[0]
+        )
+        for i in range(B):
+            l_i, _ = jax.jit(dec_s)(p, cache, nxt, jnp.int32(int(pos_vec[i])))
+            np.testing.assert_array_equal(l_rag[i], np.asarray(l_i)[i])
