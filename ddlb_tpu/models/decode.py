@@ -228,20 +228,25 @@ def _cache_read(cache, name, l, dtype):
     return (arr.astype(jnp.float32) * scale[l]).astype(dtype)
 
 
-def _cache_attend(q, cache, l, dh, pos, dtype):
+def _cache_attend(q, cache, l, dh, pos, dtype, window: int = 0):
     """One query row against cache layer ``l``: grouped scores,
     live-position mask at ``pos`` (scalar, or ``[b]`` per-sequence —
-    each sequence then attends only its own prefix), softmax, value
-    read."""
+    each sequence then attends only its own prefix; ``window > 0``
+    additionally drops positions behind the sliding window), softmax,
+    value read."""
     b = q.shape[0]
     S_max = cache["k"].shape[2]
     s = _grouped_scores(q, _cache_read(cache, "k", l, dtype), dh)
     iota = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0)
     if jnp.ndim(pos) == 1:
         live = iota[None, :] <= pos[:, None]          # [b, S]
+        if window:
+            live &= iota[None, :] > pos[:, None] - window
         s = jnp.where(live[:, None, None, None, :], s, -1e30)
     else:
         live = iota <= pos
+        if window:
+            live &= iota > pos - window
         s = jnp.where(live[None, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return _grouped_attend(p, _cache_read(cache, "v", l, dtype), b, 1, dtype)
@@ -359,7 +364,9 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
             # q [b, 1, h, dh] grouped against the kv-head cache row;
             # positions past ``pos`` are masked (zeros in the cache never
             # win anyway, but the mask keeps softmax exact)
-            attn = _cache_attend(q, cache, l, dh, pos, x.dtype)
+            attn = _cache_attend(
+                q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
+            )
             part = jnp.matmul(
                 attn,
                 params["w_o"][0, l],
@@ -464,11 +471,15 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
                 k = _kv_roundtrip(k)
                 v = _kv_roundtrip(v)
             if cfg.attn_kernel == "flash":
-                attn = _flash_full(q, k, v, interpret).reshape(
+                attn = _flash_full(
+                    q, k, v, interpret, window=cfg.attn_window
+                ).reshape(
                     b, S, h_loc * dh
                 )
             else:
-                attn = _causal_attention(q, k, v).reshape(b, S, h_loc * dh)
+                attn = _causal_attention(
+                    q, k, v, window=cfg.attn_window
+                ).reshape(b, S, h_loc * dh)
             part = jnp.matmul(
                 attn, params["w_o"][0, l], preferred_element_type=jnp.float32
             )
@@ -545,7 +556,9 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
                 q = apply_rope(q, posb, cfg.rope_theta)
                 k = apply_rope(k, posb, cfg.rope_theta)
             cache = _cache_write(cache, l, pos, k, v, int8_cache)
-            attn = _cache_attend(q, cache, l, dh, pos, x.dtype)
+            attn = _cache_attend(
+                q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
+            )
             x = x + jnp.matmul(
                 attn,
                 params["w_o"][0, l],
@@ -576,7 +589,9 @@ def make_full_width_fns(cfg: TransformerConfig, batch: int, dp: int, tp: int):
             if int8_cache:
                 k = _kv_roundtrip(k)
                 v = _kv_roundtrip(v)
-            attn = _causal_attention(q, k, v).reshape(B_, S, H * dh)
+            attn = _causal_attention(
+                q, k, v, window=cfg.attn_window
+            ).reshape(B_, S, H * dh)
             x = x + jnp.matmul(
                 attn, params["w_o"][0, l], preferred_element_type=jnp.float32
             ).astype(x.dtype)
@@ -735,7 +750,9 @@ def reference_logits(
             # oracle applies the identical per-(position, head) rounding
             k = _kv_roundtrip(k)
             v = _kv_roundtrip(v)
-        attn = _causal_attention(q, k, v).reshape(B, S, D)
+        attn = _causal_attention(
+            q, k, v, window=cfg.attn_window
+        ).reshape(B, S, D)
         x = x + jnp.matmul(
             attn, params["w_o"][0, l], preferred_element_type=jnp.float32
         ).astype(x.dtype)

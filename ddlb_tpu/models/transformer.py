@@ -79,6 +79,12 @@ class TransformerConfig:
     #: dynamic per-token activation quant. Forward-only: int8 weight
     #: leaves have no gradients.
     mlp_kernel: str = "bf16"
+    #: sliding-window (local) attention span: each position attends only
+    #: the ``attn_window`` most recent positions including itself
+    #: (0 = full causal). Gathered + serving paths; the flash kernels
+    #: skip tiles entirely behind the band. Ring mode is full-causal
+    #: only (a windowed ring would skip whole hops — future work).
+    attn_window: int = 0
     #: rotary position embeddings (RoPE, rotate-half form) applied to
     #: q/k after projection. Position source per path: global sequence
     #: index (gathered), chunk offset + local index (ring), cache
@@ -107,6 +113,15 @@ class TransformerConfig:
     #: the fly inside the score/value einsums. Training paths ignore it.
     kv_cache: str = "bf16"
     dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        # config-construction-time validation so BOTH kernels (and the
+        # serving paths) fail identically: a negative window makes the
+        # einsum mask all-False — silently uniform attention
+        if self.attn_window < 0:
+            raise ValueError(
+                f"attn_window must be >= 0, got {self.attn_window}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -255,11 +270,12 @@ def _rms_norm(x, scale):
     return (h * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def _causal_attention(q, k, v):
+def _causal_attention(q, k, v, window: int = 0):
     """[b, S, h, dh] f32 causal softmax attention (full gathered sequence,
     local heads). ``k``/``v`` may carry fewer (grouped/GQA) heads — they
     are repeated up to the query head count (exact: repetition and
-    grouped attention compute identical dot products)."""
+    grouped attention compute identical dot products). ``window > 0``
+    restricts each query to its sliding window."""
     if k.shape[2] != q.shape[2]:
         G = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, G, axis=2)
@@ -270,7 +286,10 @@ def _causal_attention(q, k, v):
     S = s.shape[-1]
     rows = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
-    s = jnp.where((rows >= cols)[None, None], s, -1e30)
+    mask = rows >= cols
+    if window:
+        mask &= cols > rows - window
+    s = jnp.where(mask[None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -337,7 +356,7 @@ def _flash_block(S: int) -> int:
     return b
 
 
-def _flash_full(q, k, v, interpret):
+def _flash_full(q, k, v, interpret, window: int = 0):
     """Batched causal flash attention: [b, S, h, dh] -> [b, S, h, dh].
 
     The batch dim merges into the kernel's head grid (heads are
@@ -356,6 +375,7 @@ def _flash_full(q, k, v, interpret):
         block_q=_flash_block(S),
         block_kv=_flash_block(S),
         interpret=interpret,
+        window=window,
     )
     return o.reshape(S, b, h, dh).transpose(1, 0, 2, 3)
 
@@ -515,6 +535,11 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
     if cfg.router not in ("block", "topk"):
         raise ValueError(f"unknown router '{cfg.router}'")
+    if cfg.attn_window and cfg.attention == "ring":
+        raise ValueError(
+            "attn_window requires attention='gathered' (a windowed ring "
+            "would skip whole hops — not implemented)"
+        )
 
     def stage_fn(x, sp):
         """Apply this stage's L transformer blocks to a local activation
@@ -624,13 +649,13 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                     q4 = apply_rope(q4, pos, cfg.rope_theta)
                     k4 = apply_rope(k4, pos, cfg.rope_theta)
                 if cfg.attn_kernel == "flash":
-                    attn = _flash_full(q4, k4, v4, interpret).reshape(
-                        b, S, -1
-                    )  # [b, S, D/tp]
+                    attn = _flash_full(
+                        q4, k4, v4, interpret, window=cfg.attn_window
+                    ).reshape(b, S, -1)  # [b, S, D/tp]
                 else:
-                    attn = _causal_attention(q4, k4, v4).reshape(
-                        b, S, -1
-                    )  # [b, S, D/tp]
+                    attn = _causal_attention(
+                        q4, k4, v4, window=cfg.attn_window
+                    ).reshape(b, S, -1)  # [b, S, D/tp]
                 part = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
                 )  # [b, S, D] partial over tp
@@ -923,7 +948,9 @@ def reference_loss(
                     pos = jnp.arange(S, dtype=jnp.int32)[None]
                     q4 = apply_rope(q4, pos, cfg.rope_theta)
                     k4 = apply_rope(k4, pos, cfg.rope_theta)
-                attn = _causal_attention(q4, k4, v4).reshape(b_mb, S, D)
+                attn = _causal_attention(
+                    q4, k4, v4, window=cfg.attn_window
+                ).reshape(b_mb, S, D)
                 x = x + jnp.matmul(
                     attn, params["w_o"][st, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)

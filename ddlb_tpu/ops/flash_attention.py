@@ -38,13 +38,15 @@ NEG_INF = -1e30
 
 def _online_softmax_update(
     q_blk, k_blk, v_blk, m_prev, l_prev, acc_prev,
-    *, scale, q_start, k_start, block_q, block_kv, masked=True,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True, window=0,
 ):
     """One causal score tile folded into the (m, l, acc) recurrence — the
     single source of the numerically delicate flash update, shared by the
     one-shot and carried-accumulator kernels. ``masked=False`` skips the
     causal mask for tiles statically known to be fully in the past
-    (the triangular grid's strictly-below-diagonal tiles)."""
+    (the triangular grid's strictly-below-diagonal tiles). ``window > 0``
+    additionally masks keys more than ``window - 1`` positions behind the
+    query (sliding-window/local attention)."""
     q = q_blk.astype(jnp.float32) * scale
     k = k_blk.astype(jnp.float32)
     s = jax.lax.dot_general(
@@ -55,11 +57,20 @@ def _online_softmax_update(
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
         mask = (q_start + rows) >= (k_start + cols)
+        if window:
+            mask &= (k_start + cols) > (q_start + rows - window)
         s = jnp.where(mask, s, NEG_INF)
 
     m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)
+    if masked:
+        # a fully-masked row has m_new == NEG_INF, making exp(s - m_new)
+        # = 1 for every masked column — zero the masked entries so empty
+        # rows keep l == 0 (and flush to zeros) instead of averaging
+        # whatever the tile holds (reachable: a window band entirely
+        # past the KV span)
+        p = jnp.where(mask, p, 0.0)
     l_new = l_prev * alpha + p.sum(-1, keepdims=True)
     acc_new = acc_prev * alpha + jnp.dot(
         p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
@@ -67,9 +78,23 @@ def _online_softmax_update(
     return m_new, l_new, acc_new
 
 
+def _band_live(q_start, k_start, block_q, block_kv, causal, window):
+    """Static-shape predicate: does tile (q_start, k_start) intersect the
+    live attention band? Upper edge: not entirely in the future (causal).
+    Lower edge: not entirely behind the sliding window."""
+    live = True
+    if causal:
+        live = q_start + block_q - 1 >= k_start
+    if window:
+        lower = k_start + block_kv - 1 > q_start - window
+        live = jnp.logical_and(live, lower) if causal else lower
+    return live
+
+
 def _flash_kernel(
     off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, block_q: int, block_kv: int, causal: bool = True,
+    window: int = 0,
 ):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
@@ -92,13 +117,16 @@ def _flash_kernel(
         m_ref[:], l_ref[:], acc_ref[:] = _online_softmax_update(
             q_ref[0], k_ref[0], v_ref[0], m_ref[:], l_ref[:], acc_ref[:],
             scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_kv=block_kv, masked=causal,
+            block_q=block_q, block_kv=block_kv,
+            masked=causal or bool(window), window=window,
         )
 
-    if causal:
-        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    if causal or window:
+        pl.when(
+            _band_live(q_start, k_start, block_q, block_kv, causal, window)
+        )(_do_update)
     else:
-        _do_update()  # non-causal: every tile is live, no mask
+        _do_update()  # non-causal full: every tile is live, no mask
 
     @pl.when(kj == pl.num_programs(2) - 1)
     def _flush():
@@ -389,7 +417,7 @@ def _gqa_group(q, k):
 
 
 def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
-                   causal=True):
+                   causal=True, window=0):
     """Forward pallas call; returns ``(o [sq, h, dh], lse [h, sq, 1] f32)``.
 
     GQA: ``k``/``v`` may carry ``h_kv = h/G`` heads — query head ``hh``
@@ -417,7 +445,7 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
         pltpu.VMEM((bq, 1), jnp.float32),   # running max
         pltpu.VMEM((bq, 1), jnp.float32),   # running sum
     ]
-    if causal and _use_triangular(row_offset, sq, skv):
+    if causal and not window and _use_triangular(row_offset, sq, skv):
         n = sq // bq
         qi_of, kj_of = _tri_maps_lower(n, bq, bkv)
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -461,6 +489,7 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
         block_q=bq,
         block_kv=bkv,
         causal=causal,
+        window=window,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -498,10 +527,11 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
 
 
 def _recompute_p(q_blk, k_blk, lse_blk, *, scale, q_start, k_start,
-                 block_q, block_kv, masked=True):
+                 block_q, block_kv, masked=True, window=0):
     """Rebuild one probability tile from the saved log-sum-exp:
-    ``p = exp(scale * q k^T - lse)`` with the causal mask re-applied
-    (``masked=False`` for tiles statically known fully in the past)."""
+    ``p = exp(scale * q k^T - lse)`` with the causal (and sliding-window)
+    mask re-applied (``masked=False`` for tiles statically known fully
+    inside the live band)."""
     s = jax.lax.dot_general(
         q_blk.astype(jnp.float32) * scale,
         k_blk.astype(jnp.float32),
@@ -512,13 +542,18 @@ def _recompute_p(q_blk, k_blk, lse_blk, *, scale, q_start, k_start,
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
         mask = (q_start + rows) >= (k_start + cols)
+        if window:
+            mask &= (k_start + cols) > (q_start + rows - window)
         s = jnp.where(mask, s, NEG_INF)
+        # empty rows carry lse == NEG_INF; exp(NEG_INF - NEG_INF) would
+        # be 1 — zero the masked entries explicitly (mirrors the forward)
+        return jnp.where(mask, jnp.exp(s - lse_blk), 0.0)
     return jnp.exp(s - lse_blk)
 
 
 def _dq_tile_update(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
-    *, scale, q_start, k_start, block_q, block_kv, masked=True,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True, window=0,
 ):
     """Fold one score tile into the dQ accumulator:
     ``dq += scale * ds @ k`` with ``ds = p * (do v^T - delta)`` — the
@@ -526,7 +561,7 @@ def _dq_tile_update(
     p = _recompute_p(
         q_ref[0], k_ref[0], lse_ref[0], scale=scale,
         q_start=q_start, k_start=k_start,
-        block_q=block_q, block_kv=block_kv, masked=masked,
+        block_q=block_q, block_kv=block_kv, masked=masked, window=window,
     )
     do = do_ref[0].astype(jnp.float32)
     dp = jax.lax.dot_general(
@@ -544,7 +579,7 @@ def _dq_tile_update(
 def _dkv_tile_update(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_acc_ref, dv_acc_ref,
-    *, scale, q_start, k_start, block_q, block_kv, masked=True,
+    *, scale, q_start, k_start, block_q, block_kv, masked=True, window=0,
 ):
     """Fold one score tile into the dK/dV accumulators:
     ``dv += p^T @ do``; ``dk += scale * ds^T @ q`` (shared by the
@@ -552,7 +587,7 @@ def _dkv_tile_update(
     p = _recompute_p(
         q_ref[0], k_ref[0], lse_ref[0], scale=scale,
         q_start=q_start, k_start=k_start,
-        block_q=block_q, block_kv=block_kv, masked=masked,
+        block_q=block_q, block_kv=block_kv, masked=masked, window=window,
     )
     do = do_ref[0].astype(jnp.float32)
     dv_acc_ref[:] += jax.lax.dot_general(
@@ -576,7 +611,7 @@ def _flash_bwd_dq_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref, dq_acc_ref,
     *, scale: float, block_q: int, block_kv: int, masked: bool = True,
-    gated: bool = True,
+    gated: bool = True, window: int = 0,
 ):
     """dQ accumulated over KV tiles (inner grid dim). ``gated=False``
     (non-causal) visits every tile."""
@@ -597,10 +632,13 @@ def _flash_bwd_dq_kernel(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv, masked=masked,
+            window=window,
         )
 
-    if gated:
-        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    if gated or window:
+        pl.when(
+            _band_live(q_start, k_start, block_q, block_kv, gated, window)
+        )(_do_update)
     else:
         _do_update()
 
@@ -613,7 +651,7 @@ def _flash_bwd_dkv_kernel(
     offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
     *, scale: float, block_q: int, block_kv: int, masked: bool = True,
-    gated: bool = True,
+    gated: bool = True, window: int = 0,
 ):
     """dK/dV accumulated over Q tiles (inner grid dim)."""
     kj = pl.program_id(1)
@@ -635,10 +673,13 @@ def _flash_bwd_dkv_kernel(
             dk_acc_ref, dv_acc_ref,
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv, masked=masked,
+            window=window,
         )
 
-    if gated:
-        pl.when(q_start + block_q - 1 >= k_start)(_do_update)
+    if gated or window:
+        pl.when(
+            _band_live(q_start, k_start, block_q, block_kv, gated, window)
+        )(_do_update)
     else:
         _do_update()
 
@@ -733,6 +774,7 @@ def flash_attention_bwd(
     block_kv: int = 1024,
     interpret: bool = False,
     causal: str = "offset",
+    window: int = 0,
 ):
     """Flash backward against one KV span: returns f32 ``(dq, dk, dv)``.
 
@@ -775,12 +817,18 @@ def flash_attention_bwd(
     f32 = jnp.float32
     if causal not in ("offset", "diagonal", "past", "none"):
         raise ValueError(f"unknown causal mode {causal!r}")
+    if window and causal != "offset":
+        raise ValueError(
+            "window composes with causal='offset' only (the ring-chunk "
+            "modes have no windowed callers)"
+        )
     if causal == "diagonal" and sq == skv:
         # the diagonal chunk in relative coordinates IS the static
         # zero-offset square case: take the triangular grids
         row_offset, col_offset = 0, 0
     if (
         causal != "none"
+        and not window
         and _use_triangular(row_offset, sq, skv)
         and isinstance(col_offset, (int, np.integer))
         and col_offset == 0
@@ -876,6 +924,7 @@ def flash_attention_bwd(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bkv,
             masked=causal not in ("past", "none"), gated=causal != "none",
+            window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((h, sq, dh), f32),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -906,6 +955,7 @@ def flash_attention_bwd(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bkv,
             masked=causal not in ("past", "none"), gated=causal != "none",
+            window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((h, skv, dh), f32),
@@ -941,30 +991,33 @@ def flash_attention_bwd(
 # -- differentiable public API ------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def _flash(q, k, v, row_offset, scale, block_q, block_kv, interpret,
-           causal=True):
+           causal=True, window=0):
     o, _ = _flash_forward(
-        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal,
+        window,
     )
     return o
 
 
 def _flash_fwd_rule(q, k, v, row_offset, scale, block_q, block_kv, interpret,
-                    causal=True):
+                    causal=True, window=0):
     o, lse = _flash_forward(
-        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal,
+        window,
     )
     return o, (q, k, v, o, lse, row_offset)
 
 
-def _flash_bwd_rule(scale, block_q, block_kv, interpret, causal, res, do):
+def _flash_bwd_rule(scale, block_q, block_kv, interpret, causal, window,
+                    res, do):
     q, k, v, o, lse, row_offset = res
     dq, dk, dv = flash_attention_bwd(
         q, k, v, o, lse, do,
         scale=scale, row_offset=row_offset, col_offset=0,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
-        causal="offset" if causal else "none",
+        causal="offset" if causal else "none", window=window,
     )
     d_off = np.zeros(np.shape(row_offset), jax.dtypes.float0)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), d_off
@@ -973,33 +1026,35 @@ def _flash_bwd_rule(scale, block_q, block_kv, interpret, causal, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_s0(q, k, v, scale, block_q, block_kv, interpret, causal=True):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_s0(q, k, v, scale, block_q, block_kv, interpret, causal=True,
+              window=0):
     """Static ``row_offset == 0`` variant: keeping the offset a python int
     through the custom_vjp lets BOTH directions take the triangular grid
     (a traced offset — the generic ``_flash`` — forces the rectangular
     masked grid, ~2x the live tiles)."""
     o, _ = _flash_forward(
-        q, k, v, 0, scale, block_q, block_kv, interpret, causal
+        q, k, v, 0, scale, block_q, block_kv, interpret, causal, window
     )
     return o
 
 
 def _flash_s0_fwd_rule(q, k, v, scale, block_q, block_kv, interpret,
-                       causal=True):
+                       causal=True, window=0):
     o, lse = _flash_forward(
-        q, k, v, 0, scale, block_q, block_kv, interpret, causal
+        q, k, v, 0, scale, block_q, block_kv, interpret, causal, window
     )
     return o, (q, k, v, o, lse)
 
 
-def _flash_s0_bwd_rule(scale, block_q, block_kv, interpret, causal, res, do):
+def _flash_s0_bwd_rule(scale, block_q, block_kv, interpret, causal, window,
+                       res, do):
     q, k, v, o, lse = res
     dq, dk, dv = flash_attention_bwd(
         q, k, v, o, lse, do,
         scale=scale, row_offset=0, col_offset=0,
         block_q=block_q, block_kv=block_kv, interpret=interpret,
-        causal="offset" if causal else "none",
+        causal="offset" if causal else "none", window=window,
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -1009,20 +1064,28 @@ _flash_s0.defvjp(_flash_s0_fwd_rule, _flash_s0_bwd_rule)
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
+    static_argnames=(
+        "scale", "block_q", "block_kv", "interpret", "causal", "window"
+    ),
 )
-def _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret, causal):
-    return _flash_s0(q, k, v, scale, block_q, block_kv, interpret, causal)
+def _flash_s0_jit(q, k, v, scale, block_q, block_kv, interpret, causal,
+                  window):
+    return _flash_s0(
+        q, k, v, scale, block_q, block_kv, interpret, causal, window
+    )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
+    static_argnames=(
+        "scale", "block_q", "block_kv", "interpret", "causal", "window"
+    ),
 )
 def _flash_dyn_jit(q, k, v, row_offset, scale, block_q, block_kv, interpret,
-                   causal):
+                   causal, window):
     return _flash(
-        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal
+        q, k, v, row_offset, scale, block_q, block_kv, interpret, causal,
+        window,
     )
 
 
@@ -1037,6 +1100,7 @@ def flash_attention(
     block_kv: int = 1024,
     interpret: bool = False,
     causal: bool = True,
+    window: int = 0,
 ):
     """Flash attention — differentiable (custom_vjp flash backward).
 
@@ -1048,7 +1112,11 @@ def flash_attention(
     ``skv % block_kv == 0`` (benchmark shapes are powers of two).
 
     ``causal=False`` is full bidirectional attention: every tile live,
-    no mask, forward and backward.
+    no mask, forward and backward. ``window > 0`` is sliding-window
+    (local) attention: each query attends only the ``window`` most
+    recent positions including itself — tiles entirely behind the band
+    are skipped in forward AND backward (requires ``causal=True``;
+    every row keeps at least its own key, so no row is ever empty).
 
     A literal ``row_offset=0`` (the full-sequence case: the flagship
     model's gathered attention, the cp ``flash`` impl at world=1, direct
@@ -1062,13 +1130,26 @@ def flash_attention(
     the einsum attention path, rising to 144 at seq=32768 (median-of-8
     device_loop windows, BASELINE.md round-2 protocol).
     """
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window and not causal:
+        raise ValueError("window > 0 requires causal=True")
+    if (
+        window
+        and isinstance(row_offset, (int, np.integer))
+        and row_offset == 0
+        and window >= max(q.shape[0], k.shape[0])
+    ):
+        # the band covers the whole causal triangle: identical math, but
+        # window=0 dispatches to the triangular grid (~half the tiles)
+        window = 0
     if isinstance(row_offset, (int, np.integer)) and row_offset == 0:
         return _flash_s0_jit(
-            q, k, v, scale, block_q, block_kv, interpret, causal
+            q, k, v, scale, block_q, block_kv, interpret, causal, window
         )
     return _flash_dyn_jit(
         q, k, v, jnp.asarray(row_offset, jnp.int32),
-        scale, block_q, block_kv, interpret, causal,
+        scale, block_q, block_kv, interpret, causal, window,
     )
 
 

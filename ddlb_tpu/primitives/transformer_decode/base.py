@@ -53,6 +53,7 @@ class TransformerDecode(Primitive):
         "layers": 1,
         "mlp_kernel": "bf16",
         "rope": False,
+        "attn_window": 0,
         #: K/V cache precision: int8 halves the bytes the bandwidth-bound
         #: decode step re-reads per token (fast-decode member; composes
         #: with n_kv_heads' GQA shrink)
@@ -73,6 +74,7 @@ class TransformerDecode(Primitive):
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "rope": [True, False],
+        "attn_window": (0, None),
         "kv_cache": ["bf16", "int8"],
         "attn_kernel": ["flash", "einsum"],
         "dp": (0, None),
@@ -190,6 +192,7 @@ class TransformerDecode(Primitive):
             layers_per_stage=o["layers"],
             mlp_kernel=o["mlp_kernel"],
             rope=o["rope"],
+            attn_window=o["attn_window"],
             kv_cache=o["kv_cache"],
             attn_kernel=o["attn_kernel"],
             dtype=jnp_dtype(self.dtype),

@@ -53,6 +53,7 @@ class TransformerStep(Primitive):
         "attn_kernel": "flash",
         "mlp_kernel": "bf16",
         "rope": False,
+        "attn_window": 0,
         "router": "block",
         "router_topk": 2,
         "capacity_factor": 1.25,
@@ -72,6 +73,7 @@ class TransformerStep(Primitive):
         "attn_kernel": ["flash", "einsum"],
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "rope": [True, False],
+        "attn_window": (0, None),
         "router": ["block", "topk"],
         "router_topk": (1, 4),
         "capacity_factor": (0.25, 8.0),
@@ -256,6 +258,7 @@ class TransformerStep(Primitive):
             attn_kernel=o["attn_kernel"],
             mlp_kernel=o["mlp_kernel"],
             rope=o["rope"],
+            attn_window=o["attn_window"],
             router=o["router"],
             router_topk=o["router_topk"],
             capacity_factor=o["capacity_factor"],

@@ -215,7 +215,7 @@ def test_triangular_grid_matches_rectangular():
 
     def rect(q, k, v):
         return _flash_dyn_jit(
-            q, k, v, jnp.asarray(0, jnp.int32), scale, 32, 32, True, True
+            q, k, v, jnp.asarray(0, jnp.int32), scale, 32, 32, True, True, 0
         )
 
     np.testing.assert_array_equal(tri(q, k, v), rect(q, k, v))

@@ -1,0 +1,184 @@
+"""Sliding-window (local) attention: kernel band masks + model paths.
+
+``window > 0`` restricts each query to its ``window`` most recent
+positions (inclusive). The flash kernels mask both band edges and SKIP
+tiles entirely behind the band (forward and both backward grids); the
+einsum paths and all oracles apply the identical two-sided mask.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _oracle(q, k, v, scale, window=0, row_offset=0):
+    G = q.shape[1] // k.shape[1]
+    kr = jnp.repeat(k, G, axis=1)
+    vr = jnp.repeat(v, G, axis=1)
+    s = jnp.einsum(
+        "qhd,khd->hqk", q.astype(jnp.float32), kr.astype(jnp.float32)
+    ) * scale
+    sq, skv = q.shape[0], k.shape[0]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 0) + row_offset
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+    m = rows >= cols
+    if window:
+        m &= cols > rows - window
+    s = jnp.where(m[None], s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("hqk,khd->qhd", p, vr.astype(jnp.float32))
+
+
+def _qkv(sq=256, h=4, h_kv=4, dh=16, seed=5):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(sq, h, dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(sq, h_kv, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(sq, h_kv, dh)), jnp.float32)
+    return q, k, v
+
+
+class TestKernelWindow:
+    @pytest.mark.parametrize("window", [32, 64, 100])
+    def test_forward_matches_oracle(self, window):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv()
+        scale = 1 / np.sqrt(q.shape[-1])
+        o = flash_attention(
+            q, k, v, scale=scale, block_q=32, block_kv=32,
+            interpret=True, window=window,
+        )
+        want = _oracle(q, k, v, scale, window=window)
+        assert float(jnp.max(jnp.abs(o - want))) < 1e-5
+
+    def test_grads_match_oracle_with_gqa(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(h=4, h_kv=2)
+        scale = 1 / np.sqrt(q.shape[-1])
+        W = 48
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, scale=scale, block_q=32, block_kv=32,
+                interpret=True, window=W,
+            ).sum()
+
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(
+            lambda q, k, v: _oracle(q, k, v, scale, window=W).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, a, b in zip("qkv", got, want):
+            assert a.shape == b.shape
+            err = float(jnp.max(jnp.abs(a - b)))
+            assert err < 2e-5, f"d{name}: {err:.2e}"
+
+    def test_dynamic_offset_window(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv()
+        scale = 1 / np.sqrt(q.shape[-1])
+        o = flash_attention(
+            q[:128], k, v, scale=scale, row_offset=jnp.int32(128),
+            block_q=32, block_kv=32, interpret=True, window=64,
+        )
+        want = _oracle(q[:128], k, v, scale, window=64, row_offset=128)
+        assert float(jnp.max(jnp.abs(o - want))) < 1e-5
+
+    def test_window_changes_output(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv()
+        scale = 1 / np.sqrt(q.shape[-1])
+        kw = dict(scale=scale, block_q=32, block_kv=32, interpret=True)
+        full = flash_attention(q, k, v, **kw)
+        win = flash_attention(q, k, v, window=32, **kw)
+        assert float(jnp.max(jnp.abs(full - win))) > 1e-3
+
+    def test_bad_window_rejected(self):
+        from ddlb_tpu.ops.flash_attention import flash_attention
+
+        q, k, v = _qkv(sq=64)
+        with pytest.raises(ValueError, match="window"):
+            flash_attention(q, k, v, scale=0.1, interpret=True, window=-1)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(
+                q, k, v, scale=0.1, interpret=True, window=8, causal=False
+            )
+
+
+class TestModelWindow:
+    def test_ring_mode_rejects_window(self):
+        from ddlb_tpu.models.transformer import (
+            TransformerConfig,
+            make_stage_fn,
+        )
+
+        cfg = TransformerConfig(attention="ring", attn_window=8)
+        with pytest.raises(ValueError, match="attn_window"):
+            make_stage_fn(cfg, tp=2, interpret=True)
+
+    @pytest.mark.parametrize("attn_kernel", ["einsum", "flash"])
+    def test_train_step_validates(self, attn_kernel):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_window",
+                "base_implementation": "spmd",
+                "options": {
+                    "attn_window": 8, "attn_kernel": attn_kernel,
+                    "batch": 4, "vocab": 64, "n_heads": 8,
+                    "microbatches": 2,
+                },
+                "m": 32,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    @pytest.mark.parametrize(
+        "opts",
+        [
+            {"phase": "decode"},
+            {"phase": "decode", "kv_cache": "int8"},
+            {"phase": "generate", "n_new": 5},
+        ],
+    )
+    def test_serving_validates(self, opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_window",
+                "base_implementation": "spmd",
+                "options": {
+                    "attn_window": 8, "batch": 8, "vocab": 64,
+                    "n_heads": 8, "attn_kernel": "einsum", **opts,
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True
