@@ -43,7 +43,7 @@ PROTO = {
 }
 
 
-def run(primitive, impl, m, n, k, label="", **options):
+def run(primitive, impl, m, n, k, label="", proto_overrides=None, **options):
     row = benchmark_worker(
         {
             "primitive": primitive,
@@ -54,6 +54,7 @@ def run(primitive, impl, m, n, k, label="", **options):
             "n": n,
             "k": k,
             **PROTO,
+            **(proto_overrides or {}),
         }
     )
     t = row["median time (ms)"]
@@ -174,6 +175,24 @@ for bm, bn, bk in TILES:
         kernel="pallas", quantize="static",
         block_m=bm, block_n=bn, block_k=bk,
     )
+
+# -- 2b) xprof trace of the MFU-headline train step (VERDICT r2 weak #8:
+# account where the 0.20 non-MFU fraction goes). NOTE the worker's
+# profiler traces 5 DEDICATED runs before the timed loop
+# (ddlb_tpu/benchmark.py:94-112) — the trace shows the same compiled
+# step the median measures, but the measured iterations themselves run
+# untraced, so per-op fractions from xprof apply to the median, not
+# trace-window wall time. Trace lands under profiles/mfu_breakdown. ------
+
+run(
+    "transformer_step", "spmd", 4096, D, F,
+    label="MFU-headline train step (xprof trace)",
+    proto_overrides={
+        "validate": False, "profile_dir": "profiles/mfu_breakdown"
+    },
+    mode="train", attn_kernel="flash", batch=1, vocab=V, n_heads=HEADS,
+    microbatches=1, pp=1, tp=1, dp=1,
+)
 
 # -- 3) model schedules + GQA train row ---------------------------------------
 
