@@ -326,7 +326,7 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
     if cfg.router != "block":
         raise ValueError(
             "serving paths use the per-sequence-stable block router; "
-            "router='topk' is a training-side construction"
+            f"router='{cfg.router}' is a training-side construction"
         )
     if cfg.n_heads % tp != 0:
         raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
@@ -430,7 +430,7 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
     if cfg.router != "block":
         raise ValueError(
             "serving paths use the per-sequence-stable block router; "
-            "router='topk' is a training-side construction"
+            f"router='{cfg.router}' is a training-side construction"
         )
     if cfg.attn_kernel not in ("flash", "einsum"):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")

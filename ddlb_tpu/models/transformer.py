@@ -100,6 +100,11 @@ class TransformerConfig:
     #: router logits, top-k expert choice, per-(shard, expert) capacity
     #: with first-come slot assignment, overflow dropped to the residual
     #: stream, Switch load-balance aux loss weighted ``router_aux``.
+    #: "expert_choice": the dual (Zhou et al.) — each EXPERT picks its
+    #: top-C tokens, so load is perfectly balanced by construction (no
+    #: aux loss needed; aux reports 0); tokens chosen by no expert pass
+    #: through on the residual stream, tokens chosen by several get a
+    #: gate-weighted sum.
     router: str = "block"
     router_topk: int = 2
     #: capacity factor: each (source shard, expert) pair gets
@@ -172,7 +177,7 @@ def init_params(
         # GQA: K/V project to n_kv_heads * head_dim columns
         params["w_q"] = normal((pp, L, D, D), s_in)
         params["w_kv"] = normal((pp, L, 2, D, cfg.kv_dim), s_in)
-    if cfg.router == "topk":
+    if cfg.router in ("topk", "expert_choice"):
         # learned gate, one logit per expert; kept in float32 so the
         # softmax/top-k selection is bit-identical between the sharded
         # step and the oracle whatever the activation dtype
@@ -232,7 +237,7 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
     else:
         specs["w_q"] = P("pp", None, None, "tp")
         specs["w_kv"] = P("pp", None, None, None, "tp")
-    if cfg.router == "topk":
+    if cfg.router in ("topk", "expert_choice"):
         # every rank routes its own token shard: gate replicated over tp
         specs["router"] = P("pp", None, None, None)
     if cfg.mlp_kernel == "int8_weights":
@@ -469,6 +474,18 @@ def router_capacity(t_loc: int, n_experts: int, k: int, factor: float) -> int:
     return max(1, int(np.ceil(factor * k * t_loc / n_experts)))
 
 
+def _router_probs(tokens2d, gate):
+    """f32 gate probabilities for one token slab — the parity-critical
+    prologue shared by the token-choice and expert-choice routers (the
+    float32 cast keeps selection bit-identical across activation
+    dtypes)."""
+    logits = jnp.matmul(
+        tokens2d.astype(jnp.float32), gate.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return jax.nn.softmax(logits, axis=-1)
+
+
 def _router_assign(tokens2d, gate, k: int, capacity: int):
     """Route one token slab: top-k choice, slot assignment, aux loss.
 
@@ -483,11 +500,7 @@ def _router_assign(tokens2d, gate, k: int, capacity: int):
     """
     T = tokens2d.shape[0]
     E = gate.shape[-1]
-    logits = jnp.matmul(
-        tokens2d.astype(jnp.float32), gate.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    )
-    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    probs = _router_probs(tokens2d, gate)    # [T, E]
     topv, tope = jax.lax.top_k(probs, k)     # [T, k]
     sel = jax.nn.one_hot(tope, E, dtype=jnp.float32)  # [T, k, E]
     # selection-rank-major flattening: all rank-0 choices get slots before
@@ -502,6 +515,35 @@ def _router_assign(tokens2d, gate, k: int, capacity: int):
     P = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(f * P)
     return tope, topv, slot, kept, aux
+
+
+def _expert_choice_assign(tokens2d, gate, capacity: int):
+    """Expert-choice routing on one token slab: every expert picks its
+    ``capacity`` highest-scoring tokens.
+
+    Returns ``(idx [E, C] int32 token indices, w [E, C] f32 gate
+    weights)``. Dispatch is a GATHER (``tokens2d[idx]``), combine a
+    gate-weighted scatter-add back to token rows — per-slab
+    deterministic, so the sharded step and the oracle agree exactly.
+    Load is perfectly balanced by construction; unchosen tokens ride the
+    residual stream.
+    """
+    # scores normalized over experts per token (the Zhou et al. form),
+    # then each expert takes its top-C column entries
+    probs = _router_probs(tokens2d, gate)
+    w, idx = jax.lax.top_k(probs.T, capacity)  # [E, C] each
+    return idx.astype(jnp.int32), w
+
+
+def _expert_choice_combine(buf_out, idx, w, T, out_dtype):
+    """Scatter each expert's ``[C, D]`` outputs back to their token rows,
+    weighted by the gate: ``u[t] = sum_e w[e, c] * buf_out[e, c]`` over
+    the slots that picked token ``t``."""
+    D = buf_out.shape[-1]
+    u = jnp.zeros((T, D), jnp.float32)
+    vals = buf_out.astype(jnp.float32) * w[..., None]
+    u = u.at[idx.reshape(-1)].add(vals.reshape(-1, D))
+    return u.astype(out_dtype)
 
 
 def _router_dispatch(tokens2d, tope, slot, kept, n_experts, capacity):
@@ -533,7 +575,7 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
         raise ValueError(f"unknown attn_kernel '{cfg.attn_kernel}'")
     if cfg.mlp_kernel not in ("bf16", "int8", "int8_weights"):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
-    if cfg.router not in ("block", "topk"):
+    if cfg.router not in ("block", "topk", "expert_choice"):
         raise ValueError(f"unknown router '{cfg.router}'")
     if cfg.attn_window and cfg.attention == "ring":
         raise ValueError(
@@ -704,6 +746,37 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                 )
                 x = x + u2d.reshape(b, s_loc, D)
                 aux = aux + aux_l / L
+                continue
+            if cfg.router == "expert_choice":
+                # each resident expert picks its top-C tokens: dispatch
+                # is a gather, combine a gate-weighted scatter-add; load
+                # is balanced by construction (aux stays 0) and the
+                # buffers ride the same mirrored all_to_all
+                C = min(
+                    router_capacity(T, tp, 1, cfg.capacity_factor), T
+                )
+                h2d = h.reshape(T, D)
+                idx, wgt = _expert_choice_assign(
+                    h2d, sp["router"][0, l], C
+                )
+                buf = h2d[idx]  # [E, C, D]
+                buf = jax.lax.all_to_all(
+                    buf, "tp", split_axis=0, concat_axis=0, tiled=True
+                )
+                z = _moe_ffn(
+                    buf.reshape(tp * C, D),
+                    sp["moe_w1"][0, l, 0],
+                    sp["moe_w2"][0, l, 0],
+                    cfg.mlp_kernel,
+                    x.dtype,
+                    scales=scales,
+                )
+                z = jax.lax.all_to_all(
+                    z.reshape(tp, C, D),
+                    "tp", split_axis=0, concat_axis=0, tiled=True,
+                )
+                u2d = _expert_choice_combine(z, idx, wgt, T, x.dtype)
+                x = x + u2d.reshape(b, s_loc, D)
                 continue
             t3 = h.reshape(tp, T // tp, D)  # balanced block routing
             t3 = jax.lax.all_to_all(
@@ -955,6 +1028,50 @@ def reference_loss(
                     attn, params["w_o"][st, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)
                 h = _rms_norm(x, params["ln2"][st, l])
+                if cfg.router == "expert_choice":
+                    # per seq shard, the sharded step's math verbatim:
+                    # gather each expert's top-C tokens, FFN, gate-
+                    # weighted scatter back
+                    u = jnp.zeros_like(h)
+                    T = b_mb * s_loc
+                    C = min(
+                        router_capacity(T, tp, 1, cfg.capacity_factor), T
+                    )
+                    for j in range(tp):
+                        slab = h[:, j * s_loc : (j + 1) * s_loc].reshape(T, D)
+                        idx, wgt = _expert_choice_assign(
+                            slab, params["router"][st, l], C
+                        )
+                        buf_out = jnp.stack(
+                            [
+                                _moe_ffn(
+                                    slab[idx[e]],
+                                    params["moe_w1"][st, l, e],
+                                    params["moe_w2"][st, l, e],
+                                    cfg.mlp_kernel,
+                                    x.dtype,
+                                    scales=(
+                                        (
+                                            params["moe_w1_scale"][st, l, e],
+                                            params["moe_w2_scale"][st, l, e],
+                                        )
+                                        if cfg.mlp_kernel == "int8_weights"
+                                        else None
+                                    ),
+                                )
+                                for e in range(tp)
+                            ]
+                        )
+                        u_blk = _expert_choice_combine(
+                            buf_out, idx, wgt, T, x.dtype
+                        )
+                        u = jax.lax.dynamic_update_slice(
+                            u,
+                            u_blk.reshape(b_mb, s_loc, D),
+                            (0, j * s_loc, 0),
+                        )
+                    x = x + u
+                    continue
                 if cfg.router == "topk":
                     # per seq shard, exactly the sharded step's math: same
                     # slab, same dispatch buffer, same capacity

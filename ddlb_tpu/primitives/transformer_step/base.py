@@ -74,7 +74,7 @@ class TransformerStep(Primitive):
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "rope": [True, False],
         "attn_window": (0, None),
-        "router": ["block", "topk"],
+        "router": ["block", "topk", "expert_choice"],
         "router_topk": (1, 4),
         "capacity_factor": (0.25, 8.0),
         "dp": (0, None),
