@@ -25,6 +25,7 @@
 #include <cmath>
 #include <cstdint>
 #include <ctime>
+#include <unordered_map>
 #include <vector>
 
 extern "C" {
@@ -124,6 +125,227 @@ int32_t ddlb_robust_stats(const double* xs, int32_t n, double* out) {
   out[6] = percentile(v, 0.95);
   out[7] = percentile(dev, 0.5);
   return 0;
+}
+
+// -- pipeline training schedule simulator ------------------------------------
+//
+// The native form of utils/pipeline_schedule.py: simulate the fwd/bwd
+// dependency graph of a GPipe / 1F1B / interleaved-virtual-chunk pipeline
+// under FIXED per-device issue orders (the Megatron sequences) and emit
+// the dense per-tick tables the SPMD executors run from. Semantics are a
+// line-for-line port of the Python simulator; the test suite pins the two
+// implementations exactly equal over a (schedule, d, mb, v) matrix, so
+// either path may serve any caller.
+//
+// Outputs (all int32, caller-allocated as [max_ticks * d]):
+//   kind, mb, chunk, act_slot, in_slot, fwd_land, bwd_land
+// meta[0..3] = {ticks, act_slots, land_slots, 0}; busy[d].
+// Returns actual ticks, or <0 on error (-4: did not converge within
+// max_ticks — the same safety net the Python version raises on).
+
+enum DdlbSchedKind : int32_t {
+  DDLB_SCHED_GPIPE = 0,
+  DDLB_SCHED_1F1B = 1,
+  DDLB_SCHED_INTERLEAVED = 2,
+};
+
+namespace {
+
+struct FreeList {
+  std::vector<int32_t> free;
+  int32_t next = 0;
+  int32_t high = 0;
+  int32_t take() {
+    if (!free.empty()) {
+      int32_t s = free.back();
+      free.pop_back();
+      return s;
+    }
+    int32_t s = next++;
+    if (next > high) high = next;
+    return s;
+  }
+  void give(int32_t s) { free.push_back(s); }
+};
+
+}  // namespace
+
+int32_t ddlb_pipeline_schedule(
+    int32_t sched, int32_t d, int32_t mb, int32_t v, int32_t max_ticks,
+    int32_t* kind, int32_t* mb_out, int32_t* chunk_out, int32_t* act_slot,
+    int32_t* in_slot, int32_t* fwd_land, int32_t* bwd_land, int32_t* busy,
+    int32_t* meta) {
+  if (d <= 0 || mb <= 0 || v <= 0 || max_ticks <= 0) return -1;
+  if (sched < 0 || sched > 2) return -2;
+  if (sched == DDLB_SCHED_1F1B && v != 1) return -3;
+  if (sched == DDLB_SCHED_INTERLEAVED && v < 2) return -3;
+  const int32_t S = d * v;
+  auto dev = [d](int32_t s) { return s % d; };
+  auto chunk_of = [d](int32_t s) { return s / d; };
+  auto key = [S](int32_t i, int32_t s) { return i * S + s; };
+
+  // completion tick per op (absent = not done)
+  std::unordered_map<int32_t, int32_t> fwd_done, bwd_done;
+  std::vector<FreeList> acts(d), lands_f(d), lands_b(d);
+  std::unordered_map<int32_t, int32_t> act_of, land_of_f, land_of_b;
+  std::vector<int32_t> outstanding(d, 0);
+
+  auto warmup_cap = [&](int32_t p) -> int32_t {
+    if (sched == DDLB_SCHED_GPIPE) return mb * v;
+    if (v == 1) return d - p;
+    return (d - p - 1) * 2 + (v - 1) * d + 1;
+  };
+
+  // fixed Megatron issue orders: forwards round-robin chunk groups of d
+  // microbatches, backwards the same groups chunks-deepest-first
+  std::vector<std::vector<std::pair<int32_t, int32_t>>> fwd_order(d),
+      bwd_order(d);
+  for (int32_t p = 0; p < d; ++p) {
+    auto& f = fwd_order[p];
+    auto& b = bwd_order[p];
+    for (int32_t c = 0; c < v; ++c)
+      for (int32_t i = 0; i < mb; ++i) {
+        f.push_back({i, c * d + p});
+        b.push_back({i, c * d + p});
+      }
+    auto fkey = [&](const std::pair<int32_t, int32_t>& x) {
+      return std::make_tuple(x.first / d, chunk_of(x.second), x.first % d);
+    };
+    auto bkey = [&](const std::pair<int32_t, int32_t>& x) {
+      return std::make_tuple(x.first / d, v - 1 - chunk_of(x.second),
+                             x.first % d);
+    };
+    std::stable_sort(f.begin(), f.end(),
+                     [&](const auto& a, const auto& b_) {
+                       return fkey(a) < fkey(b_);
+                     });
+    std::stable_sort(b.begin(), b.end(),
+                     [&](const auto& a, const auto& b_) {
+                       return bkey(a) < bkey(b_);
+                     });
+  }
+  std::vector<int32_t> fptr(d, 0), bptr(d, 0);
+
+  const int64_t n_ops_total = 2LL * mb * S;
+  const int64_t total_fwd = 1LL * mb * S;
+  int64_t done_ops = 0, fwd_issued = 0;
+  int32_t t = 0;
+  for (int32_t p = 0; p < d; ++p) busy[p] = 0;
+
+  while (done_ops < n_ops_total) {
+    if (t >= max_ticks) return -4;
+    int32_t* row_kind = kind + static_cast<int64_t>(t) * d;
+    int32_t* row_mb = mb_out + static_cast<int64_t>(t) * d;
+    int32_t* row_chunk = chunk_out + static_cast<int64_t>(t) * d;
+    int32_t* row_act = act_slot + static_cast<int64_t>(t) * d;
+    int32_t* row_in = in_slot + static_cast<int64_t>(t) * d;
+    int32_t* row_fl = fwd_land + static_cast<int64_t>(t) * d;
+    int32_t* row_bl = bwd_land + static_cast<int64_t>(t) * d;
+    for (int32_t p = 0; p < d; ++p) {
+      row_kind[p] = 0;
+      row_mb[p] = row_chunk[p] = row_act[p] = row_in[p] = -1;
+      row_fl[p] = row_bl[p] = -1;
+    }
+    // 1) land last tick's arrivals (op finished at t-1 -> input
+    // available from t on); iterate ops in deterministic (i, s) order
+    // to match the Python dict-insertion iteration
+    for (int32_t i = 0; i < mb; ++i)
+      for (int32_t s = 0; s < S; ++s) {
+        auto it = fwd_done.find(key(i, s));
+        if (it != fwd_done.end() && it->second == t - 1 && s + 1 < S) {
+          int32_t p = dev(s + 1);
+          int32_t slot = lands_f[p].take();
+          land_of_f[key(i, s + 1)] = slot;
+          row_fl[p] = slot;
+        }
+        auto ib = bwd_done.find(key(i, s));
+        if (ib != bwd_done.end() && ib->second == t - 1 && s - 1 >= 0) {
+          int32_t p = dev(s - 1);
+          int32_t slot = lands_b[p].take();
+          land_of_b[key(i, s - 1)] = slot;
+          row_bl[p] = slot;
+        }
+      }
+    // 2) each device runs the next ready op of its fixed order
+    for (int32_t p = 0; p < d; ++p) {
+      bool picked = false;
+      const bool bwd_ok =
+          sched != DDLB_SCHED_GPIPE || fwd_issued == total_fwd;
+      if (bwd_ok && bptr[p] < static_cast<int32_t>(bwd_order[p].size())) {
+        auto [i, s] = bwd_order[p][bptr[p]];
+        auto tf = fwd_done.find(key(i, s));
+        bool ready = tf != fwd_done.end() && tf->second < t;
+        if (ready && s + 1 < S) {
+          auto td = bwd_done.find(key(i, s + 1));
+          ready = td != bwd_done.end() && td->second < t;
+        }
+        if (ready) {
+          bwd_done[key(i, s)] = t;
+          outstanding[p] -= 1;
+          int32_t slot = act_of[key(i, s)];
+          act_of.erase(key(i, s));
+          acts[p].give(slot);
+          row_kind[p] = 2;
+          row_mb[p] = i;
+          row_chunk[p] = chunk_of(s);
+          row_act[p] = slot;
+          if (s + 1 < S) {
+            int32_t l = land_of_b[key(i, s)];
+            land_of_b.erase(key(i, s));
+            row_in[p] = l;
+            lands_b[p].give(l);
+          }
+          ++done_ops;
+          ++busy[p];
+          picked = true;
+        }
+      }
+      if (!picked && outstanding[p] < warmup_cap(p) &&
+          fptr[p] < static_cast<int32_t>(fwd_order[p].size())) {
+        auto [i, s] = fwd_order[p][fptr[p]];
+        bool ready = true;
+        if (s > 0) {
+          auto td = fwd_done.find(key(i, s - 1));
+          ready = td != fwd_done.end() && td->second < t;
+        }
+        if (ready) {
+          fwd_done[key(i, s)] = t;
+          ++fwd_issued;
+          outstanding[p] += 1;
+          int32_t slot = acts[p].take();
+          act_of[key(i, s)] = slot;
+          row_kind[p] = 1;
+          row_mb[p] = i;
+          row_chunk[p] = chunk_of(s);
+          row_act[p] = slot;
+          if (s > 0) {
+            int32_t l = land_of_f[key(i, s)];
+            land_of_f.erase(key(i, s));
+            row_in[p] = l;
+            lands_f[p].give(l);
+          }
+          ++fptr[p];
+          ++done_ops;
+          ++busy[p];
+          picked = true;
+        }
+      }
+      if (picked && row_kind[p] == 2) ++bptr[p];
+    }
+    ++t;
+  }
+
+  int32_t act_high = 1, land_high = 1;
+  for (int32_t p = 0; p < d; ++p) {
+    act_high = std::max(act_high, acts[p].high);
+    land_high = std::max(land_high, lands_f[p].high);
+    land_high = std::max(land_high, lands_b[p].high);
+  }
+  meta[0] = t;
+  meta[1] = act_high;
+  meta[2] = land_high;
+  meta[3] = 0;
+  return t;
 }
 
 }  // extern "C"
