@@ -17,7 +17,11 @@ from typing import Dict, Optional
 import numpy as np
 
 RING_KINDS = {"ag_fwd": 0, "ag_bwd": 1, "rs_fwd": 2, "rs_bwd": 3}
+SCHED_KINDS = {"gpipe": 0, "1f1b": 1, "interleaved": 2}
 STAT_NAMES = ("mean", "std", "min", "max", "median", "p05", "p95", "mad")
+SCHEDULE_TABLE_NAMES = (
+    "kind", "mb", "chunk", "act_slot", "in_slot", "fwd_land", "bwd_land",
+)
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -53,6 +57,11 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
             ctypes.POINTER(ctypes.c_double),
         ]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.ddlb_pipeline_schedule.restype = ctypes.c_int32
+        lib.ddlb_pipeline_schedule.argtypes = (
+            [ctypes.c_int32] * 5 + [i32p] * 9
+        )
     except (OSError, AttributeError):
         # AttributeError: a stale cached .so built from an older source
         # revision missing a symbol — fall back to the numpy path
@@ -131,6 +140,64 @@ def coll_pipeline_row_map(m: int, d: int, s: int) -> np.ndarray:
     b = m // (d * s)
     idx = np.arange(m, dtype=np.int32).reshape(d, s, b)  # global rank-major
     return idx.transpose(1, 0, 2).reshape(m).astype(np.int32)
+
+
+def pipeline_schedule(
+    schedule: str, n_devices: int, microbatches: int, virtual: int = 1
+) -> Optional[Dict[str, object]]:
+    """Native pipeline-schedule simulator (``ddlb_pipeline_schedule``).
+
+    Simulates the GPipe / 1F1B / interleaved dependency graph under the
+    fixed Megatron issue orders and returns the dense per-tick tables the
+    SPMD executors run from — the same outputs as the Python simulator in
+    ``utils/pipeline_schedule.py``, to which it is pinned exactly equal by
+    ``tests/test_native.py`` over a (schedule, d, mb, v) matrix.
+
+    Returns ``None`` when the compiled library is unavailable (callers
+    fall back to the Python simulator). Raises on invalid arguments or a
+    non-converging schedule, mirroring the Python path.
+    """
+    if schedule not in SCHED_KINDS:
+        raise ValueError(
+            f"unknown schedule '{schedule}'; one of {sorted(SCHED_KINDS)}"
+        )
+    d, mb, v = int(n_devices), int(microbatches), int(virtual)
+    if d <= 0 or mb <= 0 or v <= 0:
+        raise ValueError(f"d/mb/v must be positive, got {(d, mb, v)}")
+    lib = _load()
+    if lib is None:
+        return None
+    # same safety-net bound as the Python simulator
+    max_ticks = 16 * (mb * v + d) + 64
+    bufs = {
+        name: np.empty((max_ticks, d), np.int32)
+        for name in SCHEDULE_TABLE_NAMES
+    }
+    busy = np.zeros(d, np.int32)
+    meta = np.zeros(4, np.int32)
+
+    def _p(a: np.ndarray):
+        return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    rc = lib.ddlb_pipeline_schedule(
+        SCHED_KINDS[schedule], d, mb, v, max_ticks,
+        *(_p(bufs[name]) for name in SCHEDULE_TABLE_NAMES),
+        _p(busy), _p(meta),
+    )
+    if rc < 0:
+        raise RuntimeError(
+            f"ddlb_pipeline_schedule('{schedule}', d={d}, mb={mb}, v={v}) "
+            f"failed: rc={rc}"
+        )
+    ticks = int(meta[0])
+    out: Dict[str, object] = {
+        name: bufs[name][:ticks].copy() for name in SCHEDULE_TABLE_NAMES
+    }
+    out["ticks"] = ticks
+    out["act_slots"] = max(int(meta[1]), 1)
+    out["land_slots"] = max(int(meta[2]), 1)
+    out["busy"] = busy.astype(np.int64)
+    return out
 
 
 def robust_stats(xs) -> Dict[str, float]:

@@ -114,7 +114,14 @@ def build_schedule(
     microbatches: int,
     virtual: int = 1,
 ) -> ScheduleTables:
-    """Simulate the chosen policy and emit the dense tables."""
+    """Simulate the chosen policy and emit the dense tables.
+
+    Dispatches to the native C++ simulator (``native.pipeline_schedule``,
+    host_runtime.cpp) when the compiled library is loaded; the Python
+    simulator below is the fallback and the parity oracle — the two are
+    pinned exactly equal over a (schedule, d, mb, v) matrix in
+    tests/test_native.py.
+    """
     if schedule not in SCHEDULES:
         raise ValueError(f"unknown schedule '{schedule}'; one of {SCHEDULES}")
     if schedule == "1f1b" and virtual != 1:
@@ -123,6 +130,32 @@ def build_schedule(
         raise ValueError("1f1b is the virtual=1 schedule; use 'interleaved'")
     if schedule == "interleaved" and virtual < 2:
         raise ValueError("schedule='interleaved' needs virtual >= 2")
+    from ddlb_tpu import native
+
+    tables = native.pipeline_schedule(schedule, n_devices, microbatches, virtual)
+    if tables is not None:
+        # dict keys are ScheduleTables field names by construction
+        return ScheduleTables(
+            schedule=schedule,
+            n_devices=n_devices,
+            n_stages=n_devices * virtual,
+            virtual=virtual,
+            microbatches=microbatches,
+            **tables,
+        )
+    return _build_schedule_py(schedule, n_devices, microbatches, virtual)
+
+
+def _build_schedule_py(
+    schedule: str,
+    n_devices: int,
+    microbatches: int,
+    virtual: int = 1,
+) -> ScheduleTables:
+    """The pure-Python simulator (fallback + parity oracle; see above).
+
+    Callers go through ``build_schedule``; arguments arrive validated.
+    """
     # gpipe accepts any virtual: same chunked placement, flush policy —
     # the equal-chain-depth comparison partner for 'interleaved'
     d, mb, v = n_devices, microbatches, virtual

@@ -158,6 +158,88 @@ def test_library_actually_built():
     assert os.path.exists(LIBRARY)
 
 
+_SCHED_MATRIX = [
+    ("gpipe", 2, 4, 1), ("gpipe", 4, 8, 1), ("gpipe", 4, 8, 2),
+    ("gpipe", 8, 16, 1),
+    ("1f1b", 2, 4, 1), ("1f1b", 4, 8, 1), ("1f1b", 4, 16, 1),
+    ("1f1b", 8, 32, 1),
+    ("interleaved", 2, 4, 2), ("interleaved", 4, 8, 2),
+    ("interleaved", 4, 8, 4), ("interleaved", 8, 16, 2),
+]
+
+
+@pytest.mark.skipif(
+    bool(os.environ.get("DDLB_TPU_NO_NATIVE")) or shutil.which("g++") is None,
+    reason="native path disabled or no C++ toolchain (fallbacks are supported)",
+)
+@pytest.mark.parametrize("schedule,d,mb,v", _SCHED_MATRIX)
+def test_pipeline_schedule_native_matches_python(schedule, d, mb, v):
+    """The C++ schedule simulator is pinned exactly equal to the Python
+    one — every table, slot assignment, and accounting field."""
+    from ddlb_tpu.utils.pipeline_schedule import _build_schedule_py
+
+    nat = native.pipeline_schedule(schedule, d, mb, v)
+    assert nat is not None
+    py = _build_schedule_py(schedule, d, mb, v)
+    assert nat["ticks"] == py.ticks
+    assert nat["act_slots"] == py.act_slots
+    assert nat["land_slots"] == py.land_slots
+    np.testing.assert_array_equal(nat["busy"], py.busy)
+    for name in native.SCHEDULE_TABLE_NAMES:
+        np.testing.assert_array_equal(
+            nat[name], getattr(py, name), err_msg=f"table '{name}' diverges"
+        )
+
+
+def test_pipeline_schedule_bad_args():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        native.pipeline_schedule("zigzag", 2, 4)
+    if native.available():
+        with pytest.raises(ValueError, match="positive"):
+            native.pipeline_schedule("gpipe", 0, 4)
+        with pytest.raises(RuntimeError, match="rc="):
+            # 1f1b with virtual != 1 is rejected by the C ABI (rc=-3);
+            # build_schedule screens it first with a friendlier message
+            native.pipeline_schedule("1f1b", 2, 4, 2)
+
+
+def test_build_schedule_routes_through_native():
+    """With the library loaded, build_schedule uses the C++ simulator and
+    the ScheduleTables it assembles matches the Python path field-by-field
+    (pins the dict->dataclass mapping, not just the raw tables)."""
+    from ddlb_tpu.utils import pipeline_schedule as ps
+
+    t = ps.build_schedule("interleaved", 4, 8, virtual=2)
+    assert t.ticks > 0 and t.kind.shape == (t.ticks, 4)
+    if native.available():
+        py = ps._build_schedule_py("interleaved", 4, 8, 2)
+        for name in (
+            "schedule", "n_devices", "n_stages", "virtual", "microbatches",
+            "ticks", "act_slots", "land_slots",
+        ):
+            assert getattr(t, name) == getattr(py, name), name
+        for name in native.SCHEDULE_TABLE_NAMES + ("busy",):
+            np.testing.assert_array_equal(
+                getattr(t, name), getattr(py, name), err_msg=name
+            )
+    # 1F1B keeps GPipe's tick count but shrinks the stash to O(depth)
+    f = ps.build_schedule("1f1b", 4, 8)
+    g = ps.build_schedule("gpipe", 4, 8)
+    assert f.ticks == g.ticks
+    assert f.peak_stash <= 4 + 1 < g.peak_stash
+
+
+def test_build_schedule_bad_sizes_uniform_across_paths():
+    # d/mb/v positivity is screened before the native/fallback split, so
+    # both paths raise the same ValueError
+    from ddlb_tpu.utils.pipeline_schedule import build_schedule
+
+    with pytest.raises(ValueError, match="positive"):
+        build_schedule("gpipe", 0, 4)
+    with pytest.raises(ValueError, match="positive"):
+        build_schedule("gpipe", 4, -1)
+
+
 def test_robust_stats_nonfinite_is_all_nan():
     # pinned contract: both native and fallback paths return all-NaN for a
     # sample containing any non-finite value (C++ sort of NaNs is UB)
