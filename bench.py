@@ -275,16 +275,30 @@ def _main_guarded() -> None:
         # "{label}_{m}x{k}x{n}_{dtype}"); a malformed override must fall
         # through to the CPU smoke layer, not crash the orchestrator
         shape = env.get("DDLB_TPU_BENCH_SHAPE", DEFAULT_SHAPE)
+        # a cached row may stand in only if it was measured under the SAME
+        # conditions the live run would use: shape, world size (the relay
+        # exposes 1 chip; override if that ever changes) and the pinned
+        # protocol — a row captured on a different device count or under
+        # an older protocol is not this run's headline (ADVICE r3)
+        expect_world = int(_env_float("DDLB_TPU_BENCH_EXPECT_WORLD", 1))
         try:
             m, n, k = (int(v) for v in shape.split(","))
         except ValueError:
             cached = []
         else:
             tag = f"_{m}x{k}x{n}_"
-            cached = [e for e in cached if tag in str(e.get("metric", ""))]
+            cached = [
+                e for e in cached
+                if tag in str(e.get("metric", ""))
+                and e.get("world_size") == expect_world
+                and e.get("protocol") == BENCH_PROTOCOL
+            ]
         if cached:
             entry = dict(cached[-1])
             entry["cached"] = True
+            # distinct status so a consumer reading value/valid alone still
+            # has one field that says "this is not a fresh measurement"
+            entry["status"] = "cached"
             entry["fallback_reason"] = fallback_reason
             print(
                 f"[bench] {fallback_reason}; emitting cached TPU headline "

@@ -183,18 +183,20 @@ def _cache_write(cache, l, pos, k, v, int8):
     batching form: sequence ``i``'s single new row lands at ``pos[i]``.
     """
     ragged = jnp.ndim(pos) == 1
-    if ragged:
-        # scatter's default out-of-bounds mode silently DROPS updates;
-        # clamp to match the scalar path's dynamic_update_slice semantics
-        # (callers must still bound-check — make_generate_fn does)
-        pos = jnp.minimum(pos, cache["k"].shape[2] - 1)
 
     def upd(name, val):
         if ragged:
-            # val [b, 1, h_kv, dh] -> row i at (l, i, pos[i])
+            # val [b, 1, h_kv, dh] -> row i at (l, i, pos[i]). A position
+            # past the cache is DROPPED (mode="drop"), not clamped: a
+            # continuous-batching caller that overflows a sequence loses
+            # that write instead of silently corrupting the last cache row
+            # for every other consumer of it (ADVICE r3). make_generate_fn
+            # sizes the cache so its positions are always in bounds.
             b = val.shape[0]
             cache[name] = (
-                cache[name].at[l, jnp.arange(b), pos].set(val[:, 0])
+                cache[name]
+                .at[l, jnp.arange(b), pos]
+                .set(val[:, 0], mode="drop")
             )
         else:
             cache[name] = jax.lax.dynamic_update_slice(

@@ -36,12 +36,19 @@ class SPMDTransformerStep(TransformerStep):
                 f"schedule='{o['schedule']}' is a training schedule; "
                 f"mode='forward' has no backward to interleave"
             )
+        if o["virtual"] != 1 and o["mode"] != "train":
+            # chunked (virtual) placement exists only in the table-driven
+            # training executor; make_loss_fn runs one chunk per device and
+            # would silently skip the rest
+            raise ValueError("virtual > 1 requires mode='train'")
         if o["schedule"] == "interleaved" and o["virtual"] < 2:
             raise ValueError("schedule='interleaved' needs virtual >= 2")
-        if o["schedule"] != "interleaved" and o["virtual"] != 1:
-            raise ValueError(
-                "virtual > 1 requires schedule='interleaved'"
-            )
+        if o["schedule"] == "1f1b" and o["virtual"] != 1:
+            # same rule as build_schedule: 1F1B over chunks IS interleaved.
+            # gpipe accepts any virtual (the equal-chain-depth comparison
+            # partner for interleaved — same semantics as the pp_pipeline
+            # schedules member, ADVICE r3)
+            raise ValueError("1f1b is the virtual=1 schedule; use 'interleaved'")
 
     def _input_setup(self) -> None:
         import jax
@@ -64,7 +71,10 @@ class SPMDTransformerStep(TransformerStep):
         sched = self.options["schedule"]
         v = self.options["virtual"]
 
-        if mode == "train" and sched in ("1f1b", "interleaved"):
+        if mode == "train" and (sched in ("1f1b", "interleaved") or v > 1):
+            # table-driven manual-vjp executor; gpipe lands here when
+            # virtual > 1 (chunked placement needs the schedule tables —
+            # autodiff-GPipe only covers the virtual=1 stage-per-device form)
             step, init_opt, shardings = make_train_step_1f1b(
                 self.mesh, cfg, donate=False, schedule=sched, virtual=v
             )

@@ -169,6 +169,7 @@ def test_bench_dead_backend_emits_cached_tpu_row(tmp_path, monkeypatch):
         "unit": "TFLOPS",
         "vs_baseline": 0.8924,
         "platform": "tpu",
+        "world_size": 1,
         "valid": True,
         "captured_at": "2026-07-30T05:10:00Z",
         "protocol": dict(bench.BENCH_PROTOCOL),
@@ -186,10 +187,52 @@ def test_bench_dead_backend_emits_cached_tpu_row(tmp_path, monkeypatch):
         bench.main()
     row = _last_json_line(buf.getvalue())
     assert row["cached"] is True
+    assert row["status"] == "cached"
     assert row["platform"] == "tpu"
     assert row["value"] == 175.8
     assert row["captured_at"] == "2026-07-30T05:10:00Z"
     assert "forced probe failure" in row["fallback_reason"]
+
+
+def test_bench_cache_rejects_mismatched_world_or_protocol(
+    tmp_path, monkeypatch
+):
+    """A cached row measured on a different device count or under an older
+    protocol may NOT stand in for this run's headline (ADVICE r3) — the
+    fallback goes to the CPU smoke layer instead. Short-circuit that layer
+    too, so the test pins the filter without a 15-min smoke run."""
+    bench = _load_bench_module()
+    base = {
+        "metric": "tp_columnwise_gemm_pallas_8192x8192x8192_bf16",
+        "value": 175.8,
+        "unit": "TFLOPS",
+        "platform": "tpu",
+        "valid": True,
+        "captured_at": "2026-07-30T05:10:00Z",
+    }
+    stale_world = dict(base, world_size=8, protocol=dict(bench.BENCH_PROTOCOL))
+    stale_proto = dict(
+        base, world_size=1,
+        protocol=dict(bench.BENCH_PROTOCOL, device_loop_windows=3),
+    )
+    cache = tmp_path / "bench_tpu_cache.json"
+    cache.write_text(json.dumps([stale_world, stale_proto]))
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    monkeypatch.setenv("DDLB_TPU_BENCH_FORCE_PROBE_FAIL", "1")
+    monkeypatch.delenv("DDLB_TPU_BENCH_NO_CACHE", raising=False)
+    monkeypatch.setattr(
+        bench, "_run_worker", lambda env, timeout: (None, "short-circuit")
+    )
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    row = _last_json_line(buf.getvalue())
+    assert "cached" not in row  # neither stale row stood in
+    assert row["value"] == 0.0
 
 
 def test_bench_cache_roundtrip(tmp_path, monkeypatch):

@@ -389,6 +389,41 @@ class TestModelInterleaved:
         with pytest.raises(ValueError, match="virtual >= 2"):
             cls(16, 32, 64, dtype="float32", schedule="interleaved",
                 batch=4, vocab=64, n_heads=4, microbatches=2)
-        with pytest.raises(ValueError, match="requires schedule"):
-            cls(16, 32, 64, dtype="float32", schedule="gpipe", virtual=2,
+        with pytest.raises(ValueError, match="virtual=1 schedule"):
+            cls(16, 32, 64, dtype="float32", schedule="1f1b", virtual=2,
                 batch=4, vocab=64, n_heads=4, microbatches=2)
+        # forward mode has no table executor: gpipe+virtual>1 must not
+        # silently run one chunk per device through make_loss_fn
+        with pytest.raises(ValueError, match="mode='train'"):
+            cls(16, 32, 64, dtype="float32", schedule="gpipe", virtual=2,
+                mode="forward", batch=4, vocab=64, n_heads=4, microbatches=2)
+
+    def test_member_sweeps_gpipe_virtual(self):
+        """gpipe+virtual>1 (the equal-chain-depth comparison partner for
+        interleaved) is accepted and validates — same semantics as the
+        pp_pipeline schedules member (ADVICE r3)."""
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_step",
+                "impl_id": "spmd_gpipe_v2",
+                "base_implementation": "spmd",
+                "options": {
+                    "schedule": "gpipe", "virtual": 2, "batch": 4,
+                    "vocab": 64, "n_heads": 4, "microbatches": 2,
+                    "attn_kernel": "einsum",
+                },
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["error"] == ""
+        assert row["valid"] is True

@@ -506,6 +506,29 @@ class TestRaggedDecode:
             assert not changed[: pos_vec[i]].any()
             assert not changed[pos_vec[i] + 1 :].any()
 
+    def test_ragged_out_of_bounds_write_is_dropped(self):
+        """A position past the cache must drop the write (mode="drop"),
+        not clamp onto the last row — an overflowing sequence corrupts
+        nothing (ADVICE r3)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _, _, p, cache, nxt, _, dec_r, B, S0 = self._setup()
+        S_max = cache["k"].shape[2]
+        pos_vec = np.full(B, 2, np.int32)
+        pos_vec[3] = S_max          # one sequence overflows
+        pos_vec[5] = S_max + 100    # far overflow
+        _, cache2 = jax.jit(dec_r)(p, cache, nxt, jnp.asarray(pos_vec))
+        k0, k2 = np.asarray(cache["k"]), np.asarray(cache2["k"])
+        for i in range(B):
+            changed = np.any(k0[:, i] != k2[:, i], axis=(0, 2, 3))
+            if pos_vec[i] >= S_max:
+                assert not changed.any(), f"OOB write for seq {i} landed"
+            else:
+                assert changed[pos_vec[i]]
+                assert changed.sum() == 1
+
 
 class TestGeneratePhase:
     """phase=generate: the whole compiled serving loop (prefill + n_new
