@@ -16,13 +16,15 @@ native:
 bench:
 	$(PYTHON) bench.py
 
-# pyflakes when installed; otherwise a strict syntax check. Failures fail.
+# pyflakes when installed (dev extra); otherwise the in-repo
+# undefined-name checker — an undefined name fails the build either way
+# (never a bare syntax check).
 lint:
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
-		$(PYTHON) -m pyflakes ddlb_tpu tests bench.py __graft_entry__.py; \
+		$(PYTHON) -m pyflakes ddlb_tpu tests scripts bench.py __graft_entry__.py; \
 	else \
-		echo "pyflakes not installed; running syntax check only"; \
-		$(PYTHON) -m compileall -q ddlb_tpu tests bench.py __graft_entry__.py; \
+		echo "pyflakes not installed; using scripts/lint.py (undefined-name check)"; \
+		$(PYTHON) scripts/lint.py ddlb_tpu tests scripts bench.py __graft_entry__.py; \
 	fi
 
 clean:

