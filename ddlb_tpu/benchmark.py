@@ -472,9 +472,15 @@ class PrimitiveBenchmarkRunner:
             if self._probed_world_size is None and cache_path:
                 try:
                     with open(cache_path) as f:
-                        self._probed_world_size = int(f.read().strip())
+                        cached = int(f.read().strip())
                 except (OSError, ValueError):
-                    pass
+                    cached = 0
+                if cached > 0:  # a corrupt/zero file never becomes a key
+                    self._probed_world_size = cached
+                    print(
+                        f"[ddlb_tpu] resume world_size={cached} from "
+                        f"{cache_path} — delete it if the topology changed"
+                    )
             if self._probed_world_size is None:
                 import subprocess
                 import sys

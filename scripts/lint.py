@@ -50,6 +50,15 @@ def _module_bindings(tree: ast.Module) -> set:
             names.add(node.id)
         elif isinstance(node, (ast.Global, ast.Nonlocal)):
             names.update(node.names)
+        elif isinstance(node, (ast.MatchAs, ast.MatchStar)):
+            if node.name:  # match-case capture patterns bind raw strings
+                names.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            names.add(node.rest)
+        elif hasattr(ast, "TypeAlias") and isinstance(
+            node, ast.TypeAlias
+        ):  # PEP 695 `type X = ...`
+            names.add(node.name.id)
     return names
 
 

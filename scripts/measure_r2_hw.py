@@ -13,7 +13,12 @@ Usage:  python scripts/measure_r2_hw.py [--quick]
 
 from __future__ import annotations
 
+import os
 import sys
+
+# runnable as `python scripts/<name>.py` from the repo root: the
+# script dir is sys.path[0], so add the repo root for ddlb_tpu
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddlb_tpu.benchmark import benchmark_worker
 

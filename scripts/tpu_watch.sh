@@ -28,6 +28,12 @@ while true; do
         rc_hw3=$?
         echo "[$ts] measure_r3_hw rc=$rc_hw3"
         ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+        echo "[$ts] running measure_r4_hw.py..."
+        timeout 5400 python scripts/measure_r4_hw.py \
+            > hwlogs/measure_r4_hw.out 2> hwlogs/measure_r4_hw.err
+        rc_hw4=$?
+        echo "[$ts] measure_r4_hw rc=$rc_hw4"
+        ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
         echo "[$ts] running bench.py..."
         timeout 3600 python bench.py \
             > hwlogs/bench_live.out 2> hwlogs/bench_live.err
@@ -39,7 +45,7 @@ while true; do
         if [ "$rc_bench" -eq 0 ] \
             && grep -q '"platform": "tpu"' hwlogs/bench_live.out \
             && ! grep -q '"fallback_reason"' hwlogs/bench_live.out; then
-            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw=$rc_hw rc_hw3=$rc_hw3" \
+            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw=$rc_hw rc_hw3=$rc_hw3 rc_hw4=$rc_hw4" \
                 > hwlogs/CAPTURED
             exit 0
         fi
