@@ -231,16 +231,29 @@ def _cache_read(cache, name, l, dtype):
 
 
 def _cache_attend(q, cache, l, dh, pos, dtype, window: int = 0):
-    """One query row against cache layer ``l``: grouped scores,
-    live-position mask at ``pos`` (scalar, or ``[b]`` per-sequence —
-    each sequence then attends only its own prefix; ``window > 0``
-    additionally drops positions behind the sliding window), softmax,
-    value read."""
-    b = q.shape[0]
+    """Query rows against cache layer ``l``: grouped scores,
+    live-position mask, softmax, value read.
+
+    ``q [b, t, h, dh]``. For ``t == 1``, ``pos`` is a scalar (the whole
+    batch at one position) or ``[b]`` per-sequence positions (each
+    sequence attends only its own prefix). For ``t > 1`` (the
+    speculative-verify chunk), ``pos`` is the scalar START: chunk row
+    ``j`` sits at absolute position ``pos + j`` and attends causally up
+    to itself. ``window > 0`` additionally drops positions behind the
+    sliding window."""
+    b, t = q.shape[0], q.shape[1]
     S_max = cache["k"].shape[2]
     s = _grouped_scores(q, _cache_read(cache, "k", l, dtype), dh)
     iota = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0)
-    if jnp.ndim(pos) == 1:
+    if t > 1:
+        if jnp.ndim(pos) != 0:
+            raise ValueError("chunk attention takes a scalar start position")
+        rowpos = jnp.asarray(pos, jnp.int32) + jnp.arange(t, dtype=jnp.int32)
+        live = iota[None, :] <= rowpos[:, None]       # [t, S]
+        if window:
+            live &= iota[None, :] > rowpos[:, None] - window
+        s = jnp.where(live[None, None, None, :, :], s, -1e30)
+    elif jnp.ndim(pos) == 1:
         live = iota[None, :] <= pos[:, None]          # [b, S]
         if window:
             live &= iota[None, :] > pos[:, None] - window
@@ -251,7 +264,7 @@ def _cache_attend(q, cache, l, dh, pos, dtype, window: int = 0):
             live &= iota > pos - window
         s = jnp.where(live[None, None, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return _grouped_attend(p, _cache_read(cache, "v", l, dtype), b, 1, dtype)
+    return _grouped_attend(p, _cache_read(cache, "v", l, dtype), b, t, dtype)
 
 
 def _routed_moe(h2d, params, cfg, l, B, dp, tp):
@@ -302,6 +315,66 @@ def _block_moe(h2d, params, l, cfg, tp):
     return jax.lax.all_gather(z, "tp", axis=0, tiled=True)  # [rows, D]
 
 
+def _serving_body(params, cache, tokens, pos, cfg, tp, h_loc, kv_loc, dh):
+    """The shared cached serving forward: ``tokens [b, t]`` consumed at
+    positions derived from ``pos``, attending through the cache.
+
+    ONE implementation serves both cadences — ``make_decode_fn`` is the
+    ``t=1`` case (``pos`` scalar, or ``[b]`` ragged per-sequence) and
+    ``make_chunk_decode_fn`` the ``t>1`` speculative-verify chunk
+    (``pos`` = scalar start; row ``j`` sits at ``pos + j``) — so a new
+    serving lever cannot diverge the decode and verify paths.
+
+    Returns ``(logits [b, t, vocab], cache)``: one logits row per
+    consumed token.
+    """
+    b, t = tokens.shape
+    if b % tp != 0:
+        raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
+    int8_cache = cfg.kv_cache == "int8"
+    x = params["embed"][tokens]  # [b, t, D]
+    if cfg.rope:
+        posb = (
+            pos[:, None]  # ragged: each sequence at its own position
+            if jnp.ndim(pos) == 1
+            else (
+                jnp.asarray(pos, jnp.int32)
+                + jnp.arange(t, dtype=jnp.int32)
+            )[None]
+        )
+    for l in range(cfg.layers_per_stage):
+        h = _rms_norm(x, params["ln1"][0, l])
+        q, k, v = _project_qkv(
+            h, params, l, b, t, h_loc, kv_loc, dh, x.dtype
+        )
+        if cfg.rope:
+            q = apply_rope(q, posb, cfg.rope_theta)
+            k = apply_rope(k, posb, cfg.rope_theta)
+        cache = _cache_write(cache, l, pos, k, v, int8_cache)
+        # grouped against the kv-head cache rows; positions past each
+        # query's own position are masked (zeros in the cache never win
+        # anyway, but the mask keeps softmax exact)
+        attn = _cache_attend(
+            q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
+        )
+        part = jnp.matmul(
+            attn,
+            params["w_o"][0, l],
+            preferred_element_type=jnp.float32,
+        )
+        x = x + jax.lax.psum(part, "tp").astype(x.dtype)
+        h2 = _rms_norm(x, params["ln2"][0, l])
+        D = x.shape[-1]
+        # rows sequence-major: each rank's block is whole sequences
+        u = _block_moe(h2.reshape(b * t, D), params, l, cfg, tp)
+        x = x + u.reshape(b, t, D)
+    h = _rms_norm(x, params["ln_f"])
+    logits = jnp.matmul(
+        h, params["head"], preferred_element_type=jnp.float32
+    )
+    return logits, cache
+
+
 def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
     """One-token decode step over a ``('dp', 'tp')`` mesh.
 
@@ -336,54 +409,15 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
         raise ValueError(
             f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
         )
-    L = cfg.layers_per_stage
     h_loc = cfg.n_heads // tp
     kv_loc = cfg.kv_heads // tp
     dh = cfg.head_dim
 
-    int8_cache = cfg.kv_cache == "int8"
-
     def body(params, cache, tokens, pos):
-        b = tokens.shape[0]  # local batch (B/dp)
-        if b % tp != 0:
-            raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
-        x = params["embed"][tokens][:, None, :]  # [b, 1, D]
-        if cfg.rope:
-            posb = (
-                pos[:, None]
-                if jnp.ndim(pos) == 1
-                else jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b, 1))
-            )
-        for l in range(L):
-            h = _rms_norm(x, params["ln1"][0, l])
-            q, k, v = _project_qkv(
-                h, params, l, b, 1, h_loc, kv_loc, dh, x.dtype
-            )
-            if cfg.rope:
-                q = apply_rope(q, posb, cfg.rope_theta)
-                k = apply_rope(k, posb, cfg.rope_theta)
-            cache = _cache_write(cache, l, pos, k, v, int8_cache)
-            # q [b, 1, h, dh] grouped against the kv-head cache row;
-            # positions past ``pos`` are masked (zeros in the cache never
-            # win anyway, but the mask keeps softmax exact)
-            attn = _cache_attend(
-                q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
-            )
-            part = jnp.matmul(
-                attn,
-                params["w_o"][0, l],
-                preferred_element_type=jnp.float32,
-            )
-            y = jax.lax.psum(part, "tp").astype(x.dtype)  # heads partial
-            x = x + y
-            h2 = _rms_norm(x, params["ln2"][0, l])
-            u = _block_moe(h2.reshape(b, -1), params, l, cfg, tp)
-            x = x + u[:, None, :]
-        h = _rms_norm(x, params["ln_f"])
-        logits = jnp.matmul(
-            h[:, 0], params["head"], preferred_element_type=jnp.float32
+        logits, cache = _serving_body(
+            params, cache, tokens[:, None], pos, cfg, tp, h_loc, kv_loc, dh
         )
-        return logits, cache
+        return logits[:, 0], cache
 
     from ddlb_tpu.models.transformer import param_specs
 
@@ -413,6 +447,83 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
         shardings[f"cache_{name}"] = NamedSharding(mesh, spec)
     shardings["tokens"] = NamedSharding(mesh, P("dp"))
     return step, shardings
+
+
+def make_chunk_decode_fn(mesh, cfg: TransformerConfig):
+    """Multi-token cached step over a ``('dp', 'tp')`` mesh — the
+    speculative-verify engine: ``chunk(params, cache, tokens, start) ->
+    (logits, cache)`` with ``tokens [B, t]`` consumed at absolute
+    positions ``[start, start + t)`` and ``logits [B, t, vocab]`` (one
+    row per consumed token, each attending causally through the cache up
+    to itself).
+
+    The t-token generalization of ``make_decode_fn`` (both run the same
+    ``_serving_body``): cache rows ``[start, start + t)`` are written in
+    one block, attention reads THE CACHE (so int8 quantization numerics
+    are identical to plain decode), and the MoE block routing is
+    per-sequence exactly as decode/prefill. One target-model call
+    verifies t draft proposals — turning t bandwidth-bound cache+weight
+    re-reads into one.
+
+    PRECONDITION: ``start + t <= S_max``. The block write is a
+    ``dynamic_update_slice``, whose out-of-bounds semantics CLAMP the
+    start — an overflowing chunk would shift onto and overwrite live
+    prefix rows with no error (the ragged t=1 path drops instead; a
+    block write has no drop mode). ``make_speculate_fn`` sizes both
+    caches so this holds; size yours the same way.
+    """
+
+    tp = mesh.shape["tp"]
+    if cfg.attention != "gathered":
+        raise ValueError("chunk decode supports attention='gathered' only")
+    if cfg.router != "block":
+        raise ValueError(
+            "serving paths use the per-sequence-stable block router; "
+            f"router='{cfg.router}' is a training-side construction"
+        )
+    if cfg.n_heads % tp != 0:
+        raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp}")
+    if cfg.kv_heads % tp != 0:
+        raise ValueError(
+            f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
+        )
+    h_loc = cfg.n_heads // tp
+    kv_loc = cfg.kv_heads // tp
+    dh = cfg.head_dim
+
+    def body(params, cache, tokens, start):
+        if jnp.ndim(start) != 0:
+            raise ValueError(
+                "chunk decode takes a scalar start position (the batch-"
+                "uniform speculative form; ragged is the t=1 decode path)"
+            )
+        return _serving_body(
+            params, cache, tokens, start, cfg, tp, h_loc, kv_loc, dh
+        )
+
+    from ddlb_tpu.models.transformer import param_specs
+
+    specs = dict(param_specs(cfg))
+    specs = {
+        name: P(*[None if ax == "pp" else ax for ax in spec])
+        for name, spec in specs.items()
+    }
+    cspecs = cache_specs(cfg)
+
+    def chunk(params, cache, tokens, start):
+        return jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(specs, cspecs, P("dp", None), P()),
+            out_specs=(P("dp", None, None), cspecs),
+            check_vma=False,
+        )(params, cache, tokens, start)
+
+    shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+    for name, spec in cspecs.items():
+        shardings[f"cache_{name}"] = NamedSharding(mesh, spec)
+    shardings["tokens"] = NamedSharding(mesh, P("dp", None))
+    return chunk, shardings
 
 
 def make_prefill_fn(mesh, cfg: TransformerConfig):
@@ -717,6 +828,136 @@ def make_generate_fn(
         )
 
     return generate, shardings
+
+
+def make_speculate_fn(
+    mesh,
+    cfg: TransformerConfig,
+    cfg_draft: TransformerConfig,
+    n_new: int,
+    spec_k: int = 4,
+):
+    """Greedy speculative decoding, one jitted program — LOSSLESS: the
+    output is exactly the target model's own greedy chain, for ANY draft
+    model (the draft only changes how fast the chain is produced).
+
+    Each round: the draft autoregressively proposes ``spec_k`` tokens
+    (cheap decode steps), the target verifies all of them in ONE chunk
+    forward (``make_chunk_decode_fn`` — one cache+weights HBM re-read
+    instead of ``spec_k``), and the batch advances by ``a + 1`` tokens
+    where ``a`` is the count of leading proposals every sequence's target
+    argmax agrees with (batch-uniform: the minimum across sequences, so
+    one scalar position serves the whole batch — the ragged form would
+    use per-sequence positions). The ``+1`` is the target's own next
+    token at the first disagreement (or the bonus token when everything
+    matched), so every emitted token is the target's argmax given the
+    tokens before it — greedy speculative decoding's losslessness,
+    pinned by test_speculative.py against ``make_generate_fn``.
+
+    Greedy only (``temperature=0``): lossless acceptance for sampled
+    generation needs the rejection-sampling scheme (Leviathan et al.
+    2023), whose verdict depends on the draft's full distribution —
+    out of scope for the benchmark family this serves.
+
+    Returns ``(generate, (shardings, shardings_draft))``:
+    ``generate(params, params_draft, cache, cache_draft, prompt) ->
+    tokens [B, S0 + n_new]``. Both caches must hold at least
+    ``S0 + n_new + spec_k`` positions (the verify chunk writes up to
+    ``spec_k`` provisional rows past the accepted prefix; they are
+    masked by position until overwritten).
+    """
+    if n_new < 1:
+        raise ValueError(f"n_new must be >= 1, got {n_new}")
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    if cfg_draft.vocab != cfg.vocab:
+        raise ValueError(
+            f"draft vocab {cfg_draft.vocab} != target vocab {cfg.vocab}"
+        )
+    decode_d, sh_d = make_decode_fn(mesh, cfg_draft)
+    chunk_t, _ = make_chunk_decode_fn(mesh, cfg)
+    prefill_t, sh_t = make_prefill_fn(mesh, cfg)
+    prefill_d, _ = make_prefill_fn(mesh, cfg_draft)
+    k = spec_k
+
+    def generate(params, params_draft, cache, cache_draft, prompt):
+        B, S0 = prompt.shape
+        need = S0 + n_new + k
+        for name, c in (("target", cache), ("draft", cache_draft)):
+            S_max = c["k"].shape[2]
+            if S_max < need:
+                raise ValueError(
+                    f"{name} cache holds {S_max} positions < prompt {S0} "
+                    f"+ n_new {n_new} + spec_k {k}"
+                )
+        dp_rows = NamedSharding(mesh, P("dp", None))
+        prompt = jax.sharding.reshard(prompt, dp_rows)
+        logits, cache = prefill_t(params, cache, prompt)
+        _, cache_draft = prefill_d(params_draft, cache_draft, prompt)
+        # token buffer wide enough for a full provisional block written
+        # at the last in-range position; final slice trims it
+        width = S0 + n_new + k + 1
+        tokens = jax.sharding.reshard(
+            jnp.zeros((B, width), jnp.int32), dp_rows
+        )
+        tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, first[:, None], (0, S0)
+        )
+
+        def cond(carry):
+            return carry[3] < S0 + n_new
+
+        def body(carry):
+            tokens, cache, cache_draft, ntok = carry
+            # tokens[:, :ntok] are final; the last one is not yet in
+            # either model's cache — both consume it first
+            last = jax.lax.dynamic_slice(
+                tokens, (0, ntok - 1), (B, 1)
+            )[:, 0]
+
+            def dstep(j, dc):
+                cache_draft, tok, props = dc
+                lg, cache_draft = decode_d(
+                    params_draft, cache_draft, tok, ntok - 1 + j
+                )
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                props = jax.lax.dynamic_update_slice(
+                    props, nxt[:, None], (0, j)
+                )
+                return cache_draft, nxt, props
+
+            props = jax.sharding.reshard(jnp.zeros((B, k), jnp.int32), dp_rows)
+            cache_draft, last_prop, props = jax.lax.fori_loop(
+                0, k, dstep, (cache_draft, last, props)
+            )
+            # consume the final proposal too: when every proposal is
+            # accepted, the next round's draft attends its cache row
+            _, cache_draft = decode_d(
+                params_draft, cache_draft, last_prop, ntok - 1 + k
+            )
+
+            # ONE target forward verifies the whole proposal chain:
+            # g[:, j] is the target argmax after [.., last, p_1..p_j]
+            chunk_in = jnp.concatenate([last[:, None], props], axis=1)
+            lg, cache = chunk_t(params, cache, chunk_in, ntok - 1)
+            g = jnp.argmax(lg, axis=-1).astype(jnp.int32)  # [B, k+1]
+            # write the target's whole greedy block: cols < a repeat the
+            # accepted proposals (equal by construction), col a is the
+            # correction/bonus, cols > a are provisional — the next
+            # round's block starts at ntok + a + 1 and overwrites them
+            tokens = jax.lax.dynamic_update_slice(tokens, g, (0, ntok))
+            match = (props == g[:, :k]).astype(jnp.int32)
+            a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+            return tokens, cache, cache_draft, ntok + a + 1
+
+        tokens, cache, cache_draft, _ = jax.lax.while_loop(
+            cond, body, (tokens, cache, cache_draft, jnp.int32(S0 + 1))
+        )
+        return jax.lax.dynamic_slice(tokens, (0, 0), (B, S0 + n_new))
+
+    return generate, (sh_t, sh_d)
 
 
 def reference_logits(

@@ -17,9 +17,13 @@ Shape mapping onto the ``(m, n, k)`` contract:
 ``phase`` selects the serving phase: ``decode`` measures ONE cached step
 at position ``m`` (the steady-state per-token cost; the cache is
 prefilled once at init), ``prefill`` measures the full prompt pass that
-fills the cache (the compute-bound phase). The MLP kernel axis includes
-``int8_weights`` — decode takes no gradients, so the pre-quantized
-serving form is first-class here.
+fills the cache (the compute-bound phase), ``generate`` the whole
+compiled prefill + greedy loop, and ``speculate`` the same loop under
+greedy speculative decoding (a ``draft_layers``-deep draft proposes
+``spec_k`` tokens, the target verifies them in one chunk forward —
+lossless, so it validates against the identical oracle chain). The
+MLP kernel axis includes ``int8_weights`` — decode takes no gradients,
+so the pre-quantized serving form is first-class here.
 
 Validation pins the step's logits to the single-device teacher-forced
 oracle (models/decode.reference_logits): the incremental cache path and
@@ -47,9 +51,15 @@ class TransformerDecode(Primitive):
         "vocab": 512,
         "n_heads": 8,
         "n_kv_heads": 0,  # 0 = MHA; fewer = GQA (cache shrinks to match)
-        #: phase=generate: tokens emitted by the measured call (the whole
-        #: compiled prefill + greedy fori_loop — tokens/s end to end)
+        #: phase=generate/speculate: tokens emitted by the measured call
+        #: (the whole compiled prefill + greedy loop — tokens/s end to end)
         "n_new": 32,
+        #: phase=speculate: draft proposals verified per target chunk
+        "spec_k": 4,
+        #: phase=speculate: the draft model's layer count (the draft is
+        #: the same architecture at draft_layers depth; layers - the
+        #: knob that makes proposing cheap)
+        "draft_layers": 1,
         "layers": 1,
         "mlp_kernel": "bf16",
         "rope": False,
@@ -65,12 +75,14 @@ class TransformerDecode(Primitive):
         "tp": 0,
     }
     BASE_ALLOWED = {
-        "phase": ["decode", "prefill", "generate"],
+        "phase": ["decode", "prefill", "generate", "speculate"],
         "batch": (1, None),
         "vocab": (2, None),
         "n_heads": (1, None),
         "n_kv_heads": (0, None),
         "n_new": (1, None),
+        "spec_k": (1, None),
+        "draft_layers": (1, None),
         "layers": (1, None),
         "mlp_kernel": ["bf16", "int8", "int8_weights"],
         "rope": [True, False],
@@ -169,7 +181,13 @@ class TransformerDecode(Primitive):
         # generate: the prompt pass + n_new - 1 decode forwards (the
         # first new token comes from the prefill logits and the last from
         # the carried logits — make_generate_fn runs no wasted step), at
-        # cache positions m .. m + n_new - 2
+        # cache positions m .. m + n_new - 2.
+        # speculate reports the SAME census: the tokens produced are
+        # identical (greedy speculative decoding is lossless), so this is
+        # the useful-work convention — draft and verify overheads are
+        # overhead, not model work, exactly like remat in the train
+        # family; tokens/s and TFLOPS stay directly comparable with
+        # phase=generate, and speculation shows up as the time dropping.
         steps = o["n_new"] - 1
         ctx_sum = steps * self.m + steps * (steps - 1) / 2.0
         decode = B * (
@@ -243,7 +261,10 @@ class TransformerDecode(Primitive):
         """
         import jax
 
-        if self.options["phase"] == "generate":
+        if self.options["phase"] in ("generate", "speculate"):
+            # speculate shares the generate contract exactly: greedy
+            # speculative decoding is lossless, so its tokens must sit on
+            # the same teacher-forced oracle chain
             return self._validate_generate(result)
         logits = result[0] if isinstance(result, (tuple, list)) else result
         logits = jax.block_until_ready(logits)

@@ -46,7 +46,38 @@ class SPMDTransformerDecode(TransformerDecode):
             jnp.asarray(prompt), NamedSharding(self.mesh, P("dp", None))
         )
 
-        if self.options["phase"] == "generate":
+        if self.options["phase"] == "speculate":
+            from dataclasses import replace
+
+            from ddlb_tpu.models.decode import make_speculate_fn
+
+            # the draft: same architecture and serving levers (GQA, RoPE,
+            # int8 cache, window) at draft_layers depth — proposing is
+            # layers/draft_layers cheaper per token
+            o = self.options
+            n_new, spec_k = o["n_new"], o["spec_k"]
+            cfg_d = replace(cfg, layers_per_stage=o["draft_layers"])
+            spec, (sh_t, sh_d) = make_speculate_fn(
+                self.mesh, cfg, cfg_d, n_new=n_new, spec_k=spec_k
+            )
+            params_d = init_params(
+                cfg_d, pp=1, n_experts=tp, seed=self.seed + 1
+            )
+            params_d = {
+                k: jax.device_put(v, sh_d[k]) for k, v in params_d.items()
+            }
+            B = o["batch"]
+            cache = init_cache(cfg, B, self.m + n_new + spec_k, self.mesh)
+            cache_d = init_cache(
+                cfg_d, B, self.m + n_new + spec_k, self.mesh
+            )
+
+            def step(prompt, params, params_d, cache, cache_d):
+                return spec(params, params_d, cache, cache_d, prompt)
+
+            self._fn = jax.jit(step)
+            self._args = (prompt_dev, params, params_d, cache, cache_d)
+        elif self.options["phase"] == "generate":
             from ddlb_tpu.models.decode import make_generate_fn
 
             # the whole compiled serving loop — prefill + n_new greedy
@@ -102,7 +133,7 @@ class SPMDTransformerDecode(TransformerDecode):
     def timed_call(self):
         """Token array first so the measured loop's poison lands on ints
         (the params dict in slot 0 would break the loop carry)."""
-        if self.options["phase"] == "generate":
+        if self.options["phase"] in ("generate", "speculate"):
             return self._fn, self._args
         if self.options["phase"] == "decode":
             params, cache, tok, pos = self._args

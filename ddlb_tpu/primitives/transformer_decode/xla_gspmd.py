@@ -35,11 +35,11 @@ class XLAGSPMDTransformerDecode(GSPMDOptionsMixin, TransformerDecode):
                 "xla_gspmd measures the einsum formulation; "
                 "attn_kernel='flash' applies to the spmd member"
             )
-        if self.options["phase"] == "generate":
+        if self.options["phase"] in ("generate", "speculate"):
             raise ValueError(
-                "phase='generate' (the compiled serving loop) is an spmd/"
-                "compute_only measurement; xla_gspmd measures the single "
-                "decode/prefill step"
+                f"phase='{self.options['phase']}' (the compiled serving "
+                "loop) is an spmd/compute_only measurement; xla_gspmd "
+                "measures the single decode/prefill step"
             )
 
     def _input_setup(self) -> None:

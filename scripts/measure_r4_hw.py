@@ -107,6 +107,30 @@ for seq, d, f, heads in CURVE:
         n_heads=heads, microbatches=1, pp=1, tp=1, dp=1,
     )
 
+# -- 1b) speculative decoding: generate vs speculate tokens/s ----------------
+# Same produced tokens (greedy spec-decode is lossless), so tokens/s is
+# directly comparable; the draft (1 of 2 layers) should lift the
+# bandwidth-bound loop whenever its acceptance rate beats the draft+
+# verify overhead.
+
+if not SMOKE:
+    D_S, F_S, V_S, B_S, N_NEW = 2048, 8192, 16384, 8, 64
+    for phase, extra in (
+        ("generate", {}),
+        ("speculate", {"spec_k": 4, "draft_layers": 1}),
+        ("speculate", {"spec_k": 8, "draft_layers": 1}),
+    ):
+        row = run(
+            "transformer_decode", "spmd", 2048, D_S, F_S,
+            label=f"{phase} 2k+{N_NEW} {extra or ''}",
+            phase=phase, n_new=N_NEW, batch=B_S, vocab=V_S,
+            n_heads=16, layers=2, attn_kernel="einsum", **extra,
+        )
+        t_ms = row["median time (ms)"]
+        if np.isfinite(t_ms):
+            print(f"    -> {B_S * N_NEW / t_ms * 1e3:,.0f} tok/s end to end",
+                  flush=True)
+
 # -- 2) compiled-vs-interpreted kernel parity (world=1 self-DMA) --------------
 
 print("== compiled vs interpreted kernel parity ==", flush=True)

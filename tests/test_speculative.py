@@ -1,0 +1,272 @@
+"""Speculative decoding: chunk verify + the lossless greedy guarantee.
+
+Greedy speculative decoding must produce EXACTLY the target model's own
+greedy chain for any draft — the draft only changes speed. That makes the
+strongest possible oracle: integer equality against ``make_generate_fn``
+(no tolerances), across the fast-decode axes (GQA, RoPE, int8 cache,
+sliding window) and adversarial drafts (random weights, draft == target).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _setup(cfg, B, S0, seed=0, tp=2):
+    from ddlb_tpu.models.transformer import example_tokens, init_params
+    from ddlb_tpu.runtime import Runtime
+
+    mesh = Runtime().mesh(("dp", "tp"), shape=(8 // tp, tp))
+    params = init_params(cfg, pp=1, n_experts=tp, seed=seed)
+    prompt, _ = example_tokens(B, S0, cfg.vocab)
+    return mesh, params, prompt
+
+
+def _cfg(layers=2, **kw):
+    from ddlb_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, d_ff=64,
+        layers_per_stage=layers, microbatches=1, attn_kernel="einsum",
+        **kw,
+    )
+
+
+def _greedy(mesh, cfg, params, prompt, n_new):
+    from ddlb_tpu.models.decode import init_cache, make_generate_fn
+
+    gen, sh = make_generate_fn(mesh, cfg, n_new=n_new)
+    p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    B, S0 = prompt.shape
+    cache = init_cache(cfg, B, S0 + n_new, mesh=mesh)
+    return p, np.asarray(jax.jit(gen)(p, cache, prompt))
+
+
+def _speculate(mesh, cfg, cfg_d, p, params_d, prompt, n_new, k):
+    from ddlb_tpu.models.decode import init_cache, make_speculate_fn
+
+    spec, (_, sh_d) = make_speculate_fn(mesh, cfg, cfg_d, n_new=n_new,
+                                        spec_k=k)
+    pd = {kk: jax.device_put(v, sh_d[kk]) for kk, v in params_d.items()}
+    B, S0 = prompt.shape
+    return np.asarray(
+        jax.jit(spec)(
+            p, pd,
+            init_cache(cfg, B, S0 + n_new + k, mesh=mesh),
+            init_cache(cfg_d, B, S0 + n_new + k, mesh=mesh),
+            prompt,
+        )
+    )
+
+
+class TestChunkDecode:
+    """make_chunk_decode_fn == t sequential decode steps."""
+
+    @pytest.mark.parametrize("kv_cache", ["bf16", "int8"])
+    def test_chunk_equals_sequential_decode(self, kv_cache):
+        from ddlb_tpu.models.decode import (
+            init_cache,
+            make_chunk_decode_fn,
+            make_decode_fn,
+            make_prefill_fn,
+        )
+
+        cfg = _cfg(kv_cache=kv_cache, rope=True, n_kv_heads=2)
+        B, S0, t = 8, 8, 3
+        mesh, params, prompt = _setup(cfg, B, S0)
+        prefill, sh = make_prefill_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        rng = np.random.default_rng(3)
+        toks = jnp.asarray(rng.integers(0, cfg.vocab, (B, t)), jnp.int32)
+
+        cache = init_cache(cfg, B, S0 + t, mesh=mesh)
+        _, cache = jax.jit(prefill)(p, cache, prompt)
+        chunk, _ = make_chunk_decode_fn(mesh, cfg)
+        lg_c, cache_c = jax.jit(chunk)(p, cache, toks, jnp.int32(S0))
+
+        decode, _ = make_decode_fn(mesh, cfg)
+        cache2 = init_cache(cfg, B, S0 + t, mesh=mesh)
+        _, cache2 = jax.jit(prefill)(p, cache2, prompt)
+        seq_logits = []
+        for j in range(t):
+            lg, cache2 = jax.jit(decode)(
+                p, cache2, toks[:, j], jnp.int32(S0 + j)
+            )
+            seq_logits.append(np.asarray(lg))
+        np.testing.assert_allclose(
+            np.asarray(lg_c), np.stack(seq_logits, axis=1),
+            rtol=0, atol=1e-5,
+        )
+        # the caches agree too — same rows written; batched-vs-sequential
+        # f32 GEMMs reorder accumulation, so the pin is a tight tolerance
+        # (int8 payloads may flip one quantization bucket at a cliff)
+        for name in cache_c:
+            a = np.asarray(cache_c[name])
+            b_ = np.asarray(cache2[name])
+            if a.dtype == np.int8:
+                assert np.abs(
+                    a.astype(np.int16) - b_.astype(np.int16)
+                ).max() <= 1, name
+            else:
+                np.testing.assert_allclose(
+                    a.astype(np.float32), b_.astype(np.float32),
+                    rtol=0, atol=1e-4, err_msg=name,
+                )
+
+    def test_chunk_rejects_vector_start(self):
+        from ddlb_tpu.models.decode import init_cache, make_chunk_decode_fn
+
+        cfg = _cfg()
+        B, S0 = 8, 8
+        mesh, params, prompt = _setup(cfg, B, S0)
+        chunk, sh = make_chunk_decode_fn(mesh, cfg)
+        p = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        cache = init_cache(cfg, B, S0 + 2, mesh=mesh)
+        toks = jnp.zeros((B, 2), jnp.int32)
+        with pytest.raises(ValueError, match="scalar start"):
+            jax.jit(chunk)(p, cache, toks, jnp.zeros((B,), jnp.int32))
+
+
+class TestLossless:
+    """speculate == plain greedy, integer equality, across the axes."""
+
+    N_NEW, K = 12, 3
+
+    @pytest.mark.parametrize(
+        "axes",
+        [
+            {},
+            {"n_kv_heads": 2, "rope": True},
+            {"kv_cache": "int8"},
+            {"attn_window": 4},
+        ],
+        ids=["plain", "gqa-rope", "int8-cache", "window"],
+    )
+    def test_exact_chain(self, axes):
+        from ddlb_tpu.models.transformer import init_params
+
+        cfg = _cfg(layers=2, **axes)
+        cfg_d = _cfg(layers=1, **axes)
+        mesh, params, prompt = _setup(cfg, 8, 8)
+        p, want = _greedy(mesh, cfg, params, prompt, self.N_NEW)
+        params_d = init_params(cfg_d, pp=1, n_experts=2, seed=1)
+        got = _speculate(
+            mesh, cfg, cfg_d, p, params_d, prompt, self.N_NEW, self.K
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_draft_equals_target_is_exact(self):
+        cfg = _cfg(layers=2)
+        mesh, params, prompt = _setup(cfg, 8, 8)
+        p, want = _greedy(mesh, cfg, params, prompt, self.N_NEW)
+        got = _speculate(
+            mesh, cfg, cfg, p, params, prompt, self.N_NEW, self.K
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_adversarial_random_draft_is_exact(self):
+        """A draft whose proposals are near-always wrong still yields the
+        target chain — only slower (advance degenerates to 1/round)."""
+        from ddlb_tpu.models.transformer import init_params
+
+        cfg = _cfg(layers=2)
+        cfg_d = _cfg(layers=1)
+        mesh, params, prompt = _setup(cfg, 8, 8)
+        p, want = _greedy(mesh, cfg, params, prompt, self.N_NEW)
+        params_bad = init_params(cfg_d, pp=1, n_experts=2, seed=999)
+        got = _speculate(
+            mesh, cfg, cfg_d, p, params_bad, prompt, self.N_NEW, self.K
+        )
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("n_new", [1, 2])
+    def test_tiny_n_new(self, n_new):
+        from ddlb_tpu.models.transformer import init_params
+
+        cfg = _cfg(layers=2)
+        cfg_d = _cfg(layers=1)
+        mesh, params, prompt = _setup(cfg, 8, 8)
+        p, want = _greedy(mesh, cfg, params, prompt, n_new)
+        params_d = init_params(cfg_d, pp=1, n_experts=2, seed=1)
+        got = _speculate(mesh, cfg, cfg_d, p, params_d, prompt, n_new, 4)
+        np.testing.assert_array_equal(got, want)
+
+    def test_cache_too_small_rejected(self):
+        from ddlb_tpu.models.decode import init_cache, make_speculate_fn
+
+        cfg = _cfg(layers=2)
+        mesh, params, prompt = _setup(cfg, 8, 8)
+        spec, (sh_t, _) = make_speculate_fn(
+            mesh, cfg, cfg, n_new=4, spec_k=4
+        )
+        p = {k: jax.device_put(v, sh_t[k]) for k, v in params.items()}
+        small = init_cache(cfg, 8, 8 + 4, mesh=mesh)  # missing + spec_k
+        with pytest.raises(ValueError, match="cache holds"):
+            jax.jit(spec)(p, p, small, small, prompt)
+
+    def test_bad_args_rejected(self):
+        from dataclasses import replace
+
+        from ddlb_tpu.models.decode import make_speculate_fn
+
+        cfg = _cfg()
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp"), shape=(4, 2))
+        with pytest.raises(ValueError, match="spec_k"):
+            make_speculate_fn(mesh, cfg, cfg, n_new=4, spec_k=0)
+        with pytest.raises(ValueError, match="n_new"):
+            make_speculate_fn(mesh, cfg, cfg, n_new=0)
+        with pytest.raises(ValueError, match="vocab"):
+            make_speculate_fn(mesh, cfg, replace(cfg, vocab=32), n_new=4)
+
+
+class TestSpeculateMember:
+    """phase=speculate through the benchmark worker, oracle-validated."""
+
+    def _run(self, impl, **opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        return benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": f"{impl}_spec",
+                "base_implementation": impl,
+                "options": {
+                    "phase": "speculate", "n_new": 6, "spec_k": 2,
+                    "draft_layers": 1, "layers": 2, "batch": 8,
+                    "vocab": 64, "n_heads": 8, "attn_kernel": "einsum",
+                    **opts,
+                },
+                "m": 16,
+                "n": 64,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+
+    @pytest.mark.parametrize("impl", ["spmd", "compute_only"])
+    def test_validates_against_oracle_chain(self, impl):
+        row = self._run(impl)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_fast_decode_levers_compose(self):
+        row = self._run("spmd", kv_cache="int8", n_kv_heads=2)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_xla_gspmd_rejects_speculate(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_decode", "xla_gspmd")
+        with pytest.raises(ValueError, match="spmd/compute_only"):
+            cls(16, 64, 64, dtype="float32", phase="speculate",
+                batch=8, vocab=64, n_heads=8)
