@@ -854,6 +854,12 @@ def make_speculate_fn(
     tokens before it — greedy speculative decoding's losslessness,
     pinned by test_speculative.py against ``make_generate_fn``.
 
+    int8-cache caveat: the verify chunk quantizes K/V computed by a
+    batched projection whose f32 accumulation order can differ from the
+    t=1 decode path's by one int8 bucket, so under ``kv_cache='int8'``
+    exactness holds up to quantization near-ties (an argmax whose top-2
+    gap is below the ~1e-2 drift may flip); the bf16 cache is exact.
+
     Greedy only (``temperature=0``): lossless acceptance for sampled
     generation needs the rejection-sampling scheme (Leviathan et al.
     2023), whose verdict depends on the draft's full distribution —

@@ -60,6 +60,12 @@ class SPMDTransformerDecode(TransformerDecode):
             spec, (sh_t, sh_d) = make_speculate_fn(
                 self.mesh, cfg, cfg_d, n_new=n_new, spec_k=spec_k
             )
+            # re-place the target params under the speculate fn's own
+            # shardings (a no-op today — decode and prefill share param
+            # specs — but keeps the placement tied to the fn measured)
+            params = {
+                k: jax.device_put(v, sh_t[k]) for k, v in params.items()
+            }
             params_d = init_params(
                 cfg_d, pp=1, n_experts=tp, seed=self.seed + 1
             )

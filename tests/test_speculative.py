@@ -155,7 +155,42 @@ class TestLossless:
         got = _speculate(
             mesh, cfg, cfg_d, p, params_d, prompt, self.N_NEW, self.K
         )
-        np.testing.assert_array_equal(got, want)
+        if axes.get("kv_cache") == "int8":
+            # under int8 the verify chunk's batched projection can flip
+            # one quantization bucket vs generate's t=1 writes (~1e-2
+            # logits drift), so exactness holds only up to near-ties: a
+            # divergence is legitimate IFF the target itself was near-
+            # tied (top-2 gap below the drift) at that row's first
+            # mismatch, given the common prefix
+            self._assert_chain_up_to_ties(
+                got, want, params, cfg, prompt, tie_tol=2e-2
+            )
+        else:
+            np.testing.assert_array_equal(got, want)
+
+    @staticmethod
+    def _assert_chain_up_to_ties(got, want, params, cfg, prompt, tie_tol):
+        from ddlb_tpu.models.decode import reference_logits
+
+        if (got == want).all():
+            return
+        _, S0 = prompt.shape
+        for i in np.argwhere((got[:, S0:] != want[:, S0:]).any(axis=1))[:, 0]:
+            t = int(np.argmax(got[i, S0:] != want[i, S0:]))
+            # teacher-force the agreed prefix; the divergent step must be
+            # a near-tie in the target's own logits
+            ctx = jnp.asarray(want[:, : S0 + t])
+            logits = np.asarray(
+                reference_logits(params, ctx, cfg, tp=2, dp=4), np.float32
+            )
+            top2 = np.sort(logits[i])[-2:]
+            gap = float(top2[1] - top2[0])
+            assert gap < tie_tol, (
+                f"row {i} leaves the greedy chain at step {t} with a "
+                f"decisive top-2 gap {gap:.3e} (not an int8 near-tie)"
+            )
+            # beyond the first (forgiven) flip the contexts differ, so
+            # later tokens legitimately diverge — nothing more to check
 
     def test_draft_equals_target_is_exact(self):
         cfg = _cfg(layers=2)
