@@ -1,0 +1,304 @@
+"""Continuous-batching serving engine: slot-level admission over the
+ragged decode step.
+
+The serving capability the ragged machinery exists for (no reference
+analogue — the reference has no model or serving path, SURVEY.md §2.5):
+``make_decode_fn(ragged=True)`` decodes a batch whose sequences sit at
+DIFFERENT positions in one compiled step, and the int8/bf16 cache's
+out-of-bounds write semantics (drop, models/decode.py ``_cache_write``)
+make an idle slot representable as "position past the cache" — its write
+vanishes, its lane costs nothing but the flops it was already paying.
+
+Design (the standard host-scheduled pattern: device steps are batched
+and compiled, scheduling is host-side between steps):
+
+- ``max_batch`` slots share one KV cache. Each request is admitted into
+  a free slot by a tp-replicated prefill (batch = tp copies so the MoE
+  block router's ``b % tp`` divisibility holds; copy ``e(slot)`` — the
+  expert the block router assigns that slot — is the one whose cache
+  rows and logits are kept, so admission numerics equal an in-batch
+  prefill of that slot). One compile per distinct prompt length.
+- Every engine tick runs ONE ragged decode over all ``max_batch`` lanes:
+  active slots decode at their own ``pos[i]`` and advance; idle slots
+  ride along at ``pos = max_len`` (write dropped, output ignored).
+- A slot frees when its request hits ``max_new`` or emits ``eos_id``;
+  the next queued request is admitted before the next tick. Requests
+  finish and admit at different times — continuous batching, not static.
+
+Correctness contract (pinned in tests/test_serving_engine.py): every
+completed request's tokens equal the target model's own greedy chain for
+that prompt in that slot — the engine changes scheduling, never tokens.
+
+Engine mesh is ``('dp', 'tp')`` with ``dp == 1`` (slot-level scheduling
+and data parallelism compose by running one engine per dp shard; the
+in-engine batch axis IS the slot axis).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu.models.decode import (
+    init_cache,
+    make_decode_fn,
+    make_prefill_fn,
+)
+from ddlb_tpu.models.transformer import TransformerConfig
+
+
+@dataclass
+class Request:
+    """One generation request. ``max_new`` caps the generated tokens;
+    ``eos_id`` (engine-level) can end it earlier."""
+
+    prompt: np.ndarray          # [S0] int32
+    max_new: int
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32)
+        if self.prompt.ndim != 1 or self.prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        if self.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {self.max_new}")
+
+
+@dataclass
+class Completion:
+    """A finished request: ``tokens`` is prompt + generated (including
+    the eos token when one ended the request). ``slot`` is the lane it
+    ran in — the block router's expert assignment is slot-stable, so the
+    oracle for a completion is the greedy chain of that prompt in that
+    batch row."""
+
+    request_index: int
+    slot: int
+    tokens: np.ndarray
+    finished_by: str            # "max_new" | "eos"
+    admitted_at_step: int
+    finished_at_step: int
+
+
+@dataclass
+class EngineStats:
+    steps: int = 0              # ragged decode ticks
+    generated: int = 0          # tokens emitted so far (incl. active slots)
+    admissions: int = 0
+    lane_ticks_active: int = 0  # per-tick count of active lanes
+    lane_ticks_total: int = 0
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of decode-lane capacity that did useful work — the
+        number continuous batching exists to raise."""
+        if self.lane_ticks_total == 0:
+            return 0.0
+        return self.lane_ticks_active / self.lane_ticks_total
+
+
+class ContinuousBatchingEngine:
+    """Greedy continuous-batching engine over one ``(1, tp)`` mesh.
+
+    ``submit()`` requests, then ``run()`` to drain; or drive manually
+    with ``admit_ready()`` + ``step()`` for custom arrival processes.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        cfg: TransformerConfig,
+        params: Dict[str, jax.Array],
+        max_batch: int,
+        max_len: int,
+        eos_id: Optional[int] = None,
+    ):
+        if mesh.shape.get("dp", 1) != 1:
+            raise ValueError(
+                "engine mesh must have dp=1 (run one engine per dp shard; "
+                "the in-engine batch axis is the slot axis)"
+            )
+        self.tp = mesh.shape["tp"]
+        if max_batch % self.tp != 0:
+            raise ValueError(
+                f"max_batch={max_batch} not divisible by tp={self.tp} "
+                f"(the MoE block router)"
+            )
+        self.mesh = mesh
+        self.cfg = cfg
+        self.params = params
+        self.B = max_batch
+        self.S_max = max_len
+        self.eos_id = eos_id
+
+        decode, _ = make_decode_fn(mesh, cfg, ragged=True)
+        self._decode = jax.jit(decode)
+        prefill, _ = make_prefill_fn(mesh, cfg)
+        self._prefill = jax.jit(prefill)
+        self.cache = init_cache(cfg, max_batch, max_len, mesh=mesh)
+
+        # slot copy: scratch-cache copy `c`'s rows [0, S0) into slot `s`
+        # of the big cache. slot/copy are DYNAMIC scalars so only the
+        # prompt length drives compiles (same cadence as the prefill);
+        # heads shard identically on both sides, so the copy is local to
+        # every tp rank.
+        from ddlb_tpu.models.decode import cache_specs
+        from jax.sharding import PartitionSpec as P
+
+        cs = cache_specs(cfg)
+
+        def copy_body(big, small, slot, copy):
+            out = {}
+            for name in big:
+                row = jax.lax.dynamic_slice_in_dim(
+                    small[name], copy, 1, axis=1
+                )
+                out[name] = jax.lax.dynamic_update_slice(
+                    big[name], row, (0, slot, 0, 0, 0)
+                )
+            return out
+
+        self._copy_slot = jax.jit(
+            jax.shard_map(
+                copy_body,
+                mesh=mesh,
+                in_specs=(cs, cs, P(), P()),
+                out_specs=cs,
+                check_vma=False,
+            )
+        )
+
+        # host-side lane state
+        self.pos = np.full(self.B, self.S_max, np.int32)   # parked
+        self.cur_tok = np.zeros(self.B, np.int32)
+        self._slot_req: List[Optional[int]] = [None] * self.B
+        self._slot_new: List[List[int]] = [[] for _ in range(self.B)]
+        self._slot_admitted: List[int] = [0] * self.B
+        self._queue: deque = deque()
+        self._requests: List[Request] = []
+        self.completions: List[Completion] = []
+        self.stats = EngineStats()
+
+    # -- scheduling --------------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Queue a request; returns its index (completion order may
+        differ — match on ``Completion.request_index``). Fails fast on a
+        request that could never fit — an admission-time failure would
+        abort a drain mid-flight with the request already dequeued."""
+        S0 = request.prompt.size
+        if S0 + request.max_new > self.S_max:
+            raise ValueError(
+                f"prompt {S0} + max_new {request.max_new} exceeds "
+                f"max_len {self.S_max}"
+            )
+        idx = len(self._requests)
+        self._requests.append(request)
+        self._queue.append(idx)
+        return idx
+
+    def _expert_of(self, slot: int) -> int:
+        # the block router's per-sequence-stable assignment on a dp=1
+        # shard: slot i -> expert i // (B / tp) (models/decode._block_moe)
+        return slot // (self.B // self.tp)
+
+    def admit_ready(self) -> int:
+        """Admit queued requests into free slots; returns count admitted."""
+        n = 0
+        for slot in range(self.B):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            self._admit(slot, self._queue.popleft())
+            n += 1
+        return n
+
+    def _admit(self, slot: int, req_idx: int) -> None:
+        req = self._requests[req_idx]
+        S0 = req.prompt.size
+        assert S0 + req.max_new <= self.S_max  # screened in submit()
+        # tp-replicated prefill into a scratch cache (one compile per
+        # distinct S0); keep copy e(slot)'s rows + logits
+        e = self._expert_of(slot)
+        prompt_rep = jnp.asarray(
+            np.broadcast_to(req.prompt, (self.tp, S0)).copy()
+        )
+        scratch = init_cache(self.cfg, self.tp, S0, mesh=self.mesh)
+        logits, scratch = self._prefill(self.params, scratch, prompt_rep)
+        self.cache = self._copy_slot(
+            self.cache, scratch, jnp.int32(slot), jnp.int32(e)
+        )
+        first = int(np.asarray(logits)[e].argmax())
+        self.pos[slot] = S0
+        self.cur_tok[slot] = first
+        self._slot_req[slot] = req_idx
+        self._slot_new[slot] = [first]
+        self._slot_admitted[slot] = self.stats.steps
+        self.stats.admissions += 1
+        self.stats.generated += 1  # the admission's first token
+        # a request can finish at admission (max_new=1 or instant eos)
+        self._maybe_finish(slot)
+
+    def _maybe_finish(self, slot: int) -> None:
+        req_idx = self._slot_req[slot]
+        req = self._requests[req_idx]
+        new = self._slot_new[slot]
+        by = None
+        if self.eos_id is not None and new and new[-1] == self.eos_id:
+            by = "eos"
+        elif len(new) >= req.max_new:
+            by = "max_new"
+        if by is None:
+            return
+        self.completions.append(
+            Completion(
+                request_index=req_idx,
+                slot=slot,
+                tokens=np.concatenate([req.prompt, np.asarray(new, np.int32)]),
+                finished_by=by,
+                admitted_at_step=self._slot_admitted[slot],
+                finished_at_step=self.stats.steps,
+            )
+        )
+        self._slot_req[slot] = None
+        self._slot_new[slot] = []
+        self.pos[slot] = self.S_max          # park: writes drop, lane idles
+        self.cur_tok[slot] = 0
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> int:
+        """One ragged decode over all lanes; returns active-lane count."""
+        active = [s for s in range(self.B) if self._slot_req[s] is not None]
+        if not active:
+            return 0
+        logits, self.cache = self._decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self.cur_tok),
+            jnp.asarray(self.pos),
+        )
+        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        self.stats.steps += 1
+        self.stats.lane_ticks_total += self.B
+        self.stats.lane_ticks_active += len(active)
+        self.stats.generated += len(active)
+        for s in active:
+            self.pos[s] += 1
+            self.cur_tok[s] = nxt[s]
+            self._slot_new[s].append(int(nxt[s]))
+            self._maybe_finish(s)
+        return len(active)
+
+    def run(self) -> List[Completion]:
+        """Admit + step until the queue and all slots drain."""
+        while True:
+            self.admit_ready()
+            if self.step() == 0 and not self._queue:
+                return self.completions
+
+

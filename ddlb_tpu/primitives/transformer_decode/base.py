@@ -312,7 +312,9 @@ class TransformerDecode(Primitive):
         the logits path above. An argmax mismatch is forgiven where the
         oracle's top-2 logit gap is below the family's logits tolerance
         (half precision / the int8 cache legitimately drift that much,
-        which can flip a near-tie without being wrong).
+        which can flip a near-tie without being wrong). A sibling of
+        this forgiveness rule lives in tests/test_speculative.py
+        (_assert_chain_up_to_ties) — keep the semantics aligned.
         """
         import jax
         import numpy as np

@@ -183,11 +183,21 @@ class TestLossless:
             logits = np.asarray(
                 reference_logits(params, ctx, cfg, tp=2, dp=4), np.float32
             )
-            top2 = np.sort(logits[i])[-2:]
+            # sibling of TransformerDecode._validate_generate's
+            # tie-forgiveness rule (keep semantics aligned), plus a
+            # stronger membership check: the flipped token must BE one of
+            # the two near-tied candidates — a wrong-token bug at a
+            # near-tied step must not hide behind the forgiveness
+            order = np.argsort(logits[i])
+            top2 = logits[i][order[-2:]]
             gap = float(top2[1] - top2[0])
             assert gap < tie_tol, (
                 f"row {i} leaves the greedy chain at step {t} with a "
                 f"decisive top-2 gap {gap:.3e} (not an int8 near-tie)"
+            )
+            assert got[i, S0 + t] in order[-2:], (
+                f"row {i} step {t}: divergent token {got[i, S0 + t]} is "
+                f"not one of the near-tied top-2 {order[-2:]}"
             )
             # beyond the first (forgiven) flip the contexts differ, so
             # later tokens legitimately diverge — nothing more to check
