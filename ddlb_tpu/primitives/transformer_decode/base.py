@@ -54,6 +54,9 @@ class TransformerDecode(Primitive):
         #: phase=generate/speculate: tokens emitted by the measured call
         #: (the whole compiled prefill + greedy loop — tokens/s end to end)
         "n_new": 32,
+        #: phase=serve: queued requests drained through the continuous-
+        #: batching engine (0 = 2 * batch)
+        "n_requests": 0,
         #: phase=speculate: draft proposals verified per target chunk
         "spec_k": 4,
         #: phase=speculate: the draft model's layer count (the draft is
@@ -75,7 +78,8 @@ class TransformerDecode(Primitive):
         "tp": 0,
     }
     BASE_ALLOWED = {
-        "phase": ["decode", "prefill", "generate", "speculate"],
+        "phase": ["decode", "prefill", "generate", "speculate", "serve"],
+        "n_requests": (0, None),
         "batch": (1, None),
         "vocab": (2, None),
         "n_heads": (1, None),
@@ -112,6 +116,10 @@ class TransformerDecode(Primitive):
         if dp or tp:
             raise ValueError("set both dp and tp or neither (0 = auto)")
         o = self.options
+        if o["phase"] == "serve":
+            # the engine's batch axis is the slot axis: dp must be 1
+            # (one engine per dp shard composes data parallelism)
+            return 1, n
         tp = (
             2
             if n % 2 == 0
@@ -152,6 +160,12 @@ class TransformerDecode(Primitive):
             )
         if self.dtype not in ("float32", "bfloat16", "float16"):
             raise ValueError("transformer_decode requires a floating dtype")
+        if o["phase"] == "serve" and dp != 1:
+            raise ValueError(
+                "phase='serve' runs the continuous-batching engine on a "
+                "(1, tp) mesh; set dp=1 (one engine per dp shard is how "
+                "data parallelism composes)"
+            )
 
     def flops(self) -> float:
         """Matmul FLOPs of one measured call.
@@ -172,6 +186,23 @@ class TransformerDecode(Primitive):
         if o["phase"] == "decode":
             per_token = L * (proj + 4.0 * self.m * D + 4.0 * D * F)
             return B * (per_token + 2.0 * D * V)
+        if o["phase"] == "serve":
+            # useful-work census of the whole drained workload: per
+            # request, one prompt prefill + its generated tokens' decode
+            # forwards (idle-lane ride-along ticks are overhead, exactly
+            # like speculation's draft/verify — not model work)
+            total = 0.0
+            for prompt, max_new in self._serve_workload():
+                S0 = prompt.size
+                total += S0 * (L * (proj + 2.0 * S0 * D + 4.0 * D * F))
+                total += 2.0 * D * V  # prefill head (last position)
+                steps = max_new - 1
+                ctx_sum = steps * S0 + steps * (steps - 1) / 2.0
+                total += (
+                    steps * (L * (proj + 4.0 * D * F) + 2.0 * D * V)
+                    + L * 4.0 * D * ctx_sum
+                )
+            return total
         prefill = (
             B * self.m * (L * (proj + 2.0 * self.m * D + 4.0 * D * F))
             + B * 2.0 * D * V
@@ -215,6 +246,28 @@ class TransformerDecode(Primitive):
             attn_kernel=o["attn_kernel"],
             dtype=jnp_dtype(self.dtype),
         )
+
+    def _serve_workload(self):
+        """The deterministic phase=serve request list: ``n_requests``
+        prompts of length ``m`` (one prefill compile) with per-request
+        ``max_new`` cycling through ``[1, n_new]`` (stride 1 — full
+        period for EVERY n_new) so completions stagger and slots
+        actually turn over mid-drain. Shared by the member setup, the
+        FLOP census and validation — one definition, computed once."""
+        cached = getattr(self, "_serve_workload_memo", None)
+        if cached is not None:
+            return cached
+        from ddlb_tpu.models.transformer import example_tokens
+
+        o = self.options
+        n_req = o["n_requests"] or 2 * o["batch"]
+        prompts, _ = example_tokens(n_req, self.m, o["vocab"], seed=self.seed)
+        prompts = np.asarray(prompts, np.int32)
+        self._serve_workload_memo = [
+            (prompts[i], 1 + ((i + 3) % o["n_new"]))
+            for i in range(n_req)
+        ]
+        return self._serve_workload_memo
 
     def _host_tokens(self) -> Tuple[np.ndarray, np.ndarray]:
         """(prompt [B, m], next_token [B]) — seeded, host-deterministic."""
@@ -261,6 +314,8 @@ class TransformerDecode(Primitive):
         """
         import jax
 
+        if self.options["phase"] == "serve":
+            return self._validate_serve()
         if self.options["phase"] in ("generate", "speculate"):
             # speculate shares the generate contract exactly: greedy
             # speculative decoding is lossless, so its tokens must sit on
@@ -301,6 +356,85 @@ class TransformerDecode(Primitive):
     #: generated tokens pinned to the teacher-forced oracle chain (each
     #: is one full oracle forward, so the check is capped)
     _GENERATE_PIN_STEPS = 3
+    #: phase=serve: completions pinned per validation run (each pinned
+    #: step is one oracle forward)
+    _SERVE_PIN_REQUESTS = 2
+
+    def _validate_serve(self) -> bool:
+        """Pin the engine's completions to per-slot teacher-forced oracle
+        chains (the engine stashes its validation-run completions on the
+        impl as ``_serve_completions``). The block router's expert
+        assignment is slot-stable, so a completion that ran in slot ``s``
+        must follow the greedy chain of its prompt placed at batch row
+        ``s`` — checked for the first completions, first
+        ``_GENERATE_PIN_STEPS`` tokens each, with the same near-tie
+        forgiveness as phase=generate."""
+        import jax
+
+        from ddlb_tpu.models.decode import reference_logits
+        from ddlb_tpu.models.transformer import init_params
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+
+        done = getattr(self, "_serve_completions", None)
+        if not done:
+            print("[ddlb_tpu] serve validation FAILED: no completions")
+            return False
+        workload = self._serve_workload()
+        if len(done) != len(workload):
+            print(
+                f"[ddlb_tpu] serve validation FAILED: {len(done)} "
+                f"completions != {len(workload)} requests"
+            )
+            return False
+        tie_tol = 2e-4 if self.dtype == "float32" else 4e-2
+        if self.options["kv_cache"] == "int8":
+            tie_tol = max(tie_tol, 2e-2)
+        cfg = self._model_config()
+        dp, tp = self._mesh_factors()
+        B = self.options["batch"]
+        params = init_params(cfg, pp=1, n_experts=tp, seed=self.seed)
+        ok = True
+        with matmul_precision_scope(self.dtype):
+            for c in done[: self._SERVE_PIN_REQUESTS]:
+                prompt, max_new = workload[c.request_index]
+                S0 = prompt.size
+                if c.finished_by == "max_new" and (
+                    c.tokens.size != S0 + max_new
+                ):
+                    print(
+                        f"[ddlb_tpu] serve validation FAILED: request "
+                        f"{c.request_index} length {c.tokens.size} != "
+                        f"{S0 + max_new}"
+                    )
+                    ok = False
+                    continue
+                pin = min(self._GENERATE_PIN_STEPS, c.tokens.size - S0)
+                # the oracle batch carries the prompt in every row; row
+                # c.slot is the chain under that slot's expert
+                ctx = np.broadcast_to(prompt, (B, S0)).copy()
+                for t in range(pin):
+                    logits = np.asarray(
+                        jax.block_until_ready(
+                            reference_logits(params, ctx, cfg, tp=tp, dp=dp)
+                        ),
+                        np.float32,
+                    )[c.slot]
+                    want = int(logits.argmax())
+                    got = int(c.tokens[S0 + t])
+                    if got != want:
+                        top2 = np.sort(logits)[-2:]
+                        if float(top2[1] - top2[0]) >= tie_tol:
+                            print(
+                                f"[ddlb_tpu] serve validation FAILED: "
+                                f"request {c.request_index} slot {c.slot} "
+                                f"leaves the oracle chain at step {t}"
+                            )
+                            ok = False
+                        break  # past a (forgiven) tie the contexts differ
+                    ctx = np.concatenate(
+                        [ctx, np.full((B, 1), want, np.int32)], axis=1
+                    )
+        return ok
 
     def _validate_generate(self, result) -> bool:
         """Shard-wise (multi-host-safe) check of the generated tokens.

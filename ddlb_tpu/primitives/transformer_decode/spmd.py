@@ -46,7 +46,49 @@ class SPMDTransformerDecode(TransformerDecode):
             jnp.asarray(prompt), NamedSharding(self.mesh, P("dp", None))
         )
 
-        if self.options["phase"] == "speculate":
+        if self.options["phase"] == "serve":
+            from ddlb_tpu.models.serving import (
+                ContinuousBatchingEngine,
+                Request,
+            )
+
+            o = self.options
+            workload = self._serve_workload()
+            max_need = max(p.size + mn for p, mn in workload)
+            eng = ContinuousBatchingEngine(
+                self.mesh, cfg, params,
+                max_batch=o["batch"], max_len=max_need,
+            )
+            self._engine = eng
+
+            def run_workload(tok0):
+                # ONE host-scheduled drain of the whole workload: the
+                # engine's jitted step/prefill/copy programs are compile-
+                # cached, so iterations after the first measure steady-
+                # state scheduling + device time. Host-driven control
+                # flow cannot be traced — device_loop is not applicable.
+                import jax.core as _core
+
+                if isinstance(tok0, _core.Tracer):
+                    raise ValueError(
+                        "phase='serve' requires "
+                        "time_measurement_backend='host_clock' (the "
+                        "engine drain is host-scheduled)"
+                    )
+                eng.reset()
+                for prompt, mn in workload:
+                    eng.submit(Request(prompt, max_new=mn))
+                eng.run()
+                self._serve_completions = eng.completions
+                # fence on the cache so timing includes the last step
+                return eng.cache["k"]
+
+            self._fn = run_workload
+            self._args = (prompt_dev,)
+            # validation needs one drained run even when the runner skips
+            # warmups; run() below executes the measured call anyway, so
+            # completions are always populated before validate()
+        elif self.options["phase"] == "speculate":
             from dataclasses import replace
 
             from ddlb_tpu.models.decode import make_speculate_fn
@@ -139,7 +181,7 @@ class SPMDTransformerDecode(TransformerDecode):
     def timed_call(self):
         """Token array first so the measured loop's poison lands on ints
         (the params dict in slot 0 would break the loop carry)."""
-        if self.options["phase"] in ("generate", "speculate"):
+        if self.options["phase"] in ("generate", "speculate", "serve"):
             return self._fn, self._args
         if self.options["phase"] == "decode":
             params, cache, tok, pos = self._args

@@ -35,7 +35,7 @@ class XLAGSPMDTransformerDecode(GSPMDOptionsMixin, TransformerDecode):
                 "xla_gspmd measures the einsum formulation; "
                 "attn_kernel='flash' applies to the spmd member"
             )
-        if self.options["phase"] in ("generate", "speculate"):
+        if self.options["phase"] in ("generate", "speculate", "serve"):
             raise ValueError(
                 f"phase='{self.options['phase']}' (the compiled serving "
                 "loop) is an spmd/compute_only measurement; xla_gspmd "

@@ -152,6 +152,69 @@ class TestLosslessScheduling:
         assert eng.stats.generated == 4 * 5
 
 
+class TestServeMember:
+    """phase=serve through the benchmark worker: the engine drain as a
+    measured row, oracle-validated."""
+
+    def _run(self, impl, **opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        return benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": f"{impl}_serve",
+                "base_implementation": impl,
+                "options": {
+                    "phase": "serve", "n_new": 5, "n_requests": 6,
+                    "batch": 8, "vocab": 64, "n_heads": 8,
+                    "attn_kernel": "einsum", **opts,
+                },
+                "m": 8,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+
+    @pytest.mark.parametrize("impl", ["spmd", "compute_only"])
+    def test_validates_against_oracle_chains(self, impl):
+        row = self._run(impl)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_device_loop_rejected(self):
+        # the device_loop backend must produce an error row, not a
+        # silent mis-measurement of the host-scheduled drain
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_serve_dl",
+                "base_implementation": "spmd",
+                "options": {
+                    "phase": "serve", "n_new": 4, "batch": 8,
+                    "vocab": 64, "n_heads": 8, "attn_kernel": "einsum",
+                },
+                "m": 8,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": False,
+                "time_measurement_backend": "device_loop",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert "host_clock" in row["error"]
+
+
 class TestEngineErrors:
     def test_dp_mesh_rejected(self):
         from ddlb_tpu.models.serving import ContinuousBatchingEngine
