@@ -315,6 +315,29 @@ def _block_moe(h2d, params, l, cfg, tp):
     return jax.lax.all_gather(z, "tp", axis=0, tiled=True)  # [rows, D]
 
 
+def _cache_attend_pallas(q, cache, l, pos, dtype, cfg):
+    """The fused decode-attention engine (ops/decode_attention.py):
+    ``q [b, 1, h, dh]`` against cache layer ``l`` with NO HBM score
+    round-trip; int8 payloads + scales are read as-is and dequantized
+    in-kernel. Same mask/window/dequant semantics as ``_cache_attend``
+    (pinned to float tolerance in tests/test_decode_attention.py)."""
+    from ddlb_tpu.ops.decode_attention import decode_attention
+
+    b = q.shape[0]
+    interpret = jax.default_backend() != "tpu"
+    out = decode_attention(
+        q[:, 0],
+        cache["k"][l],
+        cache["v"][l],
+        pos,
+        k_scale=(cache["k_scale"][l] if "k_scale" in cache else None),
+        v_scale=(cache["v_scale"][l] if "v_scale" in cache else None),
+        window=cfg.attn_window,
+        interpret=interpret,
+    )
+    return out.reshape(b, 1, -1).astype(dtype)
+
+
 def _serving_body(params, cache, tokens, pos, cfg, tp, h_loc, kv_loc, dh):
     """The shared cached serving forward: ``tokens [b, t]`` consumed at
     positions derived from ``pos``, attending through the cache.
@@ -354,9 +377,12 @@ def _serving_body(params, cache, tokens, pos, cfg, tp, h_loc, kv_loc, dh):
         # grouped against the kv-head cache rows; positions past each
         # query's own position are masked (zeros in the cache never win
         # anyway, but the mask keeps softmax exact)
-        attn = _cache_attend(
-            q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
-        )
+        if t == 1 and cfg.decode_kernel == "pallas":
+            attn = _cache_attend_pallas(q, cache, l, pos, x.dtype, cfg)
+        else:
+            attn = _cache_attend(
+                q, cache, l, dh, pos, x.dtype, window=cfg.attn_window
+            )
         part = jnp.matmul(
             attn,
             params["w_o"][0, l],

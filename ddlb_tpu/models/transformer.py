@@ -93,6 +93,13 @@ class TransformerConfig:
     #: False keeps the family's established benchmark numbers comparable.
     rope: bool = False
     rope_theta: float = 10000.0
+    #: single-token cache attention engine for the decode step:
+    #: "einsum" materializes the [b, h_kv, G, 1, S] scores in HBM (the
+    #: oracle's formulation); "pallas" streams the cache through the
+    #: fused online-softmax kernel (ops/decode_attention.py) — no score
+    #: round-trip, int8 dequant in-kernel. The t>1 verify chunk and the
+    #: full-width oracle always use einsum.
+    decode_kernel: str = "einsum"
     #: "block": balanced block routing — sequence i's tokens use expert
     #: i-block (deterministic, perfectly balanced; the benchmark default,
     #: isolating the all-to-all traffic pattern from routing dynamics).

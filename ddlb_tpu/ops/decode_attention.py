@@ -1,0 +1,213 @@
+"""Pallas fused decode attention: one query token vs the KV cache.
+
+The einsum decode path materializes the ``[b, h_kv, G, 1, S]`` score
+tensor in HBM between the score einsum, the softmax and the value
+einsum. At long contexts that round-trip is pure overhead on a step
+whose whole cost is HBM bytes — and it GROWS as the fast-decode levers
+shrink the cache (at int8+GQA the score tensor can approach a quarter of
+the traffic). This kernel streams the cache once: S-tiles of K and V are
+read tile-by-tile (ALL kv heads per tile, so the DMA is contiguous in
+the cache's native ``[b, S, h_kv, dh]`` layout), scores live in VMEM,
+and the classic online-softmax recurrence (m, l, acc) folds tiles as
+they arrive. int8 caches are dequantized IN the kernel — the HBM read is
+genuinely the int8 payload + scales, never a dequantized copy.
+
+Semantics match ``models/decode._cache_attend`` exactly: positions
+``<= pos[b]`` are live (per-sequence ragged positions are the native
+form; scalar callers broadcast), ``window > 0`` drops positions behind
+the sliding window, and int8 dequantization rounds through the model
+dtype (``_cache_read``'s contract) so the two paths agree to float
+tolerance. Grouped queries share their kv head inside the kernel via a
+reshape — no head replication.
+
+No reference analogue (the reference has no attention operator,
+SURVEY.md section 2.5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pick_block(S: int, want: int) -> int:
+    """Largest divisor of ``S`` that is ``<= want`` (TPU pallas wants
+    whole tiles; caches sized to powers of two hit ``want`` itself)."""
+    b = min(want, S)
+    while S % b:
+        b -= 1
+    return b
+
+
+def _decode_attn_kernel(
+    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_ref, l_ref, acc_ref,
+    *, block_s: int, h_kv: int, G: int, dh: int, scale: float,
+    window: int, int8: bool, dtype,
+):
+    bi = pl.program_id(0)
+    sj = pl.program_id(1)
+
+    @pl.when(sj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    pos = pos_ref[bi]
+    s_start = sj * block_s
+
+    # tile skip: not entirely in the future, and (static window) not
+    # entirely behind the sliding window — windowed decode then costs
+    # O(window) live tiles, not O(S)
+    live_tile = s_start <= pos
+    if window:
+        live_tile = jnp.logical_and(
+            live_tile, s_start + block_s > pos - window
+        )
+
+    @pl.when(live_tile)
+    def _update():
+        # [block_s, h_kv, dh] cache tiles, contiguous in the native
+        # layout; dequantize through the model dtype (the _cache_read
+        # contract) so einsum/kernel numerics agree
+        k = k_ref[0]
+        v = v_ref[0]
+        if int8:
+            k = (k.astype(jnp.float32) * ks_ref[0]).astype(dtype)
+            v = (v.astype(jnp.float32) * vs_ref[0]).astype(dtype)
+        kh = k.astype(jnp.float32).transpose(1, 0, 2)   # [h_kv, bs, dh]
+        vh = v.astype(jnp.float32).transpose(1, 0, 2)
+        q = q_ref[0].astype(jnp.float32).reshape(h_kv, G, dh) * scale
+        # s[h_kv, G, bs]: grouped queries against their shared kv head
+        s = jax.lax.dot_general(
+            q, kh, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        cols = s_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_s), 2
+        )
+        live = cols <= pos
+        if window:
+            live &= cols > pos - window
+        s = jnp.where(live, s, NEG_INF)
+
+        m_prev, l_prev, acc_prev = m_ref[:], l_ref[:], acc_ref[:]
+        m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        # a fully-masked tile row must contribute zero mass, not
+        # exp(NEG_INF - NEG_INF) = 1 per column
+        p = jnp.where(live, p, 0.0)
+        l_ref[:] = l_prev * alpha + p.sum(-1, keepdims=True)
+        acc_ref[:] = acc_prev * alpha + jax.lax.dot_general(
+            p, vh, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_new
+
+    @pl.when(sj == pl.num_programs(1) - 1)
+    def _flush():
+        l = l_ref[:]
+        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = out.reshape(h_kv * G, dh).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "block_s", "interpret"),
+)
+def decode_attention(
+    q,
+    k_cache,
+    v_cache,
+    pos,
+    *,
+    k_scale=None,
+    v_scale=None,
+    window: int = 0,
+    block_s: int = 512,
+    interpret=False,
+):
+    """Fused single-token cache attention.
+
+    ``q``: [b, h, dh]; ``k_cache``/``v_cache``: [b, S, h_kv, dh] (the
+    cache's native layout; int8 with ``k_scale``/``v_scale``
+    [b, S, h_kv, 1] f32, or the model dtype with scales None);
+    ``pos``: [b] int32 per-sequence live positions (scalar broadcasts).
+    Returns [b, h, dh] in the query dtype.
+    """
+    b, h, dh = q.shape
+    _, S, h_kv, _ = k_cache.shape
+    if h % h_kv:
+        raise ValueError(f"h={h} not divisible by h_kv={h_kv}")
+    G = h // h_kv
+    int8 = k_cache.dtype == jnp.int8
+    if int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 cache needs k_scale and v_scale")
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    bs = _pick_block(S, block_s)
+
+    kernel = functools.partial(
+        _decode_attn_kernel,
+        block_s=bs, h_kv=h_kv, G=G, dh=dh,
+        scale=1.0 / float(np.sqrt(dh)), window=int(window), int8=int8,
+        dtype=q.dtype,
+    )
+    qspec = pl.BlockSpec((1, h, dh), lambda bi, sj, pos_p: (bi, 0, 0))
+    kvspec = pl.BlockSpec(
+        (1, bs, h_kv, dh), lambda bi, sj, pos_p: (bi, sj, 0, 0)
+    )
+    ospec = pl.BlockSpec((1, h, dh), lambda bi, sj, pos_p: (bi, 0, 0))
+    if int8:
+        sspec = pl.BlockSpec(
+            (1, bs, h_kv, 1), lambda bi, sj, pos_p: (bi, sj, 0, 0)
+        )
+        in_specs = [qspec, kvspec, kvspec, sspec, sspec]
+        operands = (q, k_cache, v_cache, k_scale, v_scale)
+    else:
+        # scale slots unused: ONE tiny constant-index block per grid
+        # step (not an S-proportional dummy stream) keeps a single
+        # kernel signature for both cache precisions at ~zero traffic
+        sspec = pl.BlockSpec(
+            (1, 1, h_kv, 1), lambda bi, sj, pos_p: (0, 0, 0, 0)
+        )
+        dummy = jnp.zeros((1, 1, h_kv, 1), jnp.float32)
+        in_specs = [qspec, kvspec, kvspec, sspec, sspec]
+        operands = (q, k_cache, v_cache, dummy, dummy)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, S // bs),
+        in_specs=in_specs,
+        out_specs=ospec,
+        scratch_shapes=[
+            pltpu.VMEM((h_kv, G, 1), jnp.float32),
+            pltpu.VMEM((h_kv, G, 1), jnp.float32),
+            pltpu.VMEM((h_kv, G, dh), jnp.float32),
+        ],
+    )
+    itemsize = k_cache.dtype.itemsize
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * S * dh,
+            bytes_accessed=2 * b * S * h_kv * dh * itemsize
+            + (2 * b * S * h_kv * 4 if int8 else 0)
+            + 2 * b * h * dh * q.dtype.itemsize,
+            transcendentals=b * h * S,
+        ),
+        interpret=interpret,
+    )(pos, *operands)

@@ -74,6 +74,10 @@ class TransformerDecode(Primitive):
         #: prefill attention engine (flash = the Pallas kernels; the
         #: single-token decode step always uses the dense cache read)
         "attn_kernel": "flash",
+        #: decode-step cache attention engine: einsum (HBM-resident
+        #: scores) or pallas (fused streaming kernel, int8 dequant
+        #: in-kernel — ops/decode_attention.py)
+        "decode_kernel": "einsum",
         "dp": 0,  # 0 = auto factorization of the device count
         "tp": 0,
     }
@@ -93,6 +97,7 @@ class TransformerDecode(Primitive):
         "attn_window": (0, None),
         "kv_cache": ["bf16", "int8"],
         "attn_kernel": ["flash", "einsum"],
+        "decode_kernel": ["einsum", "pallas"],
         "dp": (0, None),
         "tp": (0, None),
     }
@@ -244,6 +249,7 @@ class TransformerDecode(Primitive):
             attn_window=o["attn_window"],
             kv_cache=o["kv_cache"],
             attn_kernel=o["attn_kernel"],
+            decode_kernel=o["decode_kernel"],
             dtype=jnp_dtype(self.dtype),
         )
 

@@ -35,6 +35,11 @@ class XLAGSPMDTransformerDecode(GSPMDOptionsMixin, TransformerDecode):
                 "xla_gspmd measures the einsum formulation; "
                 "attn_kernel='flash' applies to the spmd member"
             )
+        if self.options["decode_kernel"] == "pallas":
+            raise ValueError(
+                "xla_gspmd measures the einsum formulation; "
+                "decode_kernel='pallas' applies to the spmd member"
+            )
         if self.options["phase"] in ("generate", "speculate", "serve"):
             raise ValueError(
                 f"phase='{self.options['phase']}' (the compiled serving "

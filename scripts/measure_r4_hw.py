@@ -58,7 +58,7 @@ PROTO = {
 }
 
 
-def run(primitive, impl, m, n, k, label="", **options):
+def run(primitive, impl, m, n, k, label="", proto_overrides=None, **options):
     row = benchmark_worker(
         {
             "primitive": primitive,
@@ -69,6 +69,7 @@ def run(primitive, impl, m, n, k, label="", **options):
             "n": n,
             "k": k,
             **PROTO,
+            **(proto_overrides or {}),
         }
     )
     t = row["median time (ms)"]
@@ -130,6 +131,46 @@ if not SMOKE:
         if np.isfinite(t_ms):
             print(f"    -> {B_S * N_NEW / t_ms * 1e3:,.0f} tok/s end to end",
                   flush=True)
+    # continuous batching: sustained tokens/s under slot turnover (the
+    # host_clock drain of a 2x-oversubscribed workload; dp=1, tp=1 on
+    # the single chip)
+    N_REQ = 16
+    row = run(
+        "transformer_decode", "spmd", 2048, D_S, F_S,
+        label=f"serve {N_REQ} reqs @2k, n_new<={N_NEW}",
+        phase="serve", n_new=N_NEW, n_requests=N_REQ, batch=8, vocab=V_S,
+        n_heads=16, layers=2, attn_kernel="einsum", dp=1, tp=1,
+        proto_overrides={"time_measurement_backend": "host_clock"},
+    )
+    t_ms = row["median time (ms)"]
+    if np.isfinite(t_ms):
+        # same workload definition as _serve_workload: stride-1 cycle
+        total_new = sum(1 + ((i + 3) % N_NEW) for i in range(N_REQ))
+        print(
+            f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
+            f"({total_new} tokens drained)",
+            flush=True,
+        )
+
+# -- 1c) fused decode-attention kernel A/B -----------------------------------
+# The einsum decode path round-trips the [b, h_kv, G, 1, S] scores
+# through HBM; the fused kernel streams the cache once with online
+# softmax and in-kernel int8 dequant. The win should grow as the
+# fast-decode levers shrink the cache (scores become a larger fraction).
+
+if not SMOKE:
+    for ctx in (8192, 32768, 65536):
+        for lbl, extra in (
+            ("bf16 MHA", {}),
+            ("int8+GQA4", {"kv_cache": "int8", "n_kv_heads": 4}),
+        ):
+            for dk in ("einsum", "pallas"):
+                run(
+                    "transformer_decode", "spmd", ctx, 2048, 8192,
+                    label=f"decode @{ctx} {lbl} kernel={dk}",
+                    phase="decode", batch=8, vocab=16384, n_heads=16,
+                    attn_kernel="einsum", decode_kernel=dk, **extra,
+                )
 
 # -- 2) compiled-vs-interpreted kernel parity (world=1 self-DMA) --------------
 

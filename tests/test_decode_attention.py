@@ -1,0 +1,158 @@
+"""Fused decode-attention kernel vs the einsum cache path.
+
+The kernel must reproduce ``_cache_attend``'s semantics — live mask at
+per-sequence positions, sliding window, GQA grouping, int8 dequant
+through the model dtype — to float tolerance, and the serving step with
+``decode_kernel='pallas'`` must still pin to the teacher-forced oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _rand_cache(rng, b, S, h_kv, dh, int8):
+    from ddlb_tpu.models.decode import _quantize_kv
+
+    k = jnp.asarray(rng.normal(0, 1, (b, S, h_kv, dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 1, (b, S, h_kv, dh)), jnp.float32)
+    if not int8:
+        return {"k": k, "v": v}
+    qk, sk = _quantize_kv(k)
+    qv, sv = _quantize_kv(v)
+    return {"k": qk, "k_scale": sk, "v": qv, "v_scale": sv}
+
+
+def _einsum_reference(q, cache, pos, window):
+    """The _cache_attend math on direct arrays (layer axis pre-stripped):
+    grouped scores, live mask, softmax, value read, f32."""
+    from ddlb_tpu.models.decode import _cache_attend
+
+    layered = {name: arr[None] for name, arr in cache.items()}
+    b, h, dh = q.shape
+    return _cache_attend(
+        q[:, None], layered, 0, dh, pos, jnp.float32, window=window
+    ).reshape(b, h, dh)
+
+
+@pytest.mark.parametrize(
+    "case",
+    [
+        dict(),                               # MHA
+        dict(h_kv=2),                         # GQA
+        dict(int8=True),                      # int8 dequant in-kernel
+        dict(h_kv=2, int8=True, window=6),    # everything at once
+        dict(window=5),                       # sliding window
+    ],
+    ids=["mha", "gqa", "int8", "gqa-int8-window", "window"],
+)
+def test_kernel_matches_einsum_path(case):
+    from ddlb_tpu.ops.decode_attention import decode_attention
+
+    b, S, h, dh = 4, 24, 4, 8
+    h_kv = case.get("h_kv", h)
+    int8 = case.get("int8", False)
+    window = case.get("window", 0)
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(0, 1, (b, h, dh)), jnp.float32)
+    cache = _rand_cache(rng, b, S, h_kv, dh, int8)
+    pos = jnp.asarray(rng.integers(0, S, b), jnp.int32)
+
+    got = decode_attention(
+        q, cache["k"], cache["v"], pos,
+        k_scale=cache.get("k_scale"), v_scale=cache.get("v_scale"),
+        window=window, block_s=8, interpret=True,
+    )
+    want = _einsum_reference(q, cache, pos, window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=0, atol=1e-5
+    )
+
+
+def test_scalar_pos_broadcasts_and_blocks_shrink():
+    from ddlb_tpu.ops.decode_attention import decode_attention
+
+    b, S, h, dh = 2, 9, 4, 8  # S=9: block auto-shrinks to a divisor
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(0, 1, (b, h, dh)), jnp.float32)
+    cache = _rand_cache(rng, b, S, h, dh, False)
+    got = decode_attention(
+        q, cache["k"], cache["v"], jnp.int32(5), block_s=4, interpret=True
+    )
+    want = _einsum_reference(q, cache, jnp.full(b, 5, jnp.int32), 0)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=0, atol=1e-5
+    )
+
+
+def test_bad_args():
+    from ddlb_tpu.ops.decode_attention import decode_attention
+
+    q = jnp.zeros((2, 4, 8), jnp.float32)
+    k8 = jnp.zeros((2, 8, 4, 8), jnp.int8)
+    with pytest.raises(ValueError, match="needs k_scale"):
+        decode_attention(q, k8, k8, jnp.int32(0), interpret=True)
+    with pytest.raises(ValueError, match="divisible"):
+        decode_attention(
+            q, jnp.zeros((2, 8, 3, 8), jnp.float32),
+            jnp.zeros((2, 8, 3, 8), jnp.float32), jnp.int32(0),
+            interpret=True,
+        )
+
+
+class TestServingIntegration:
+    """decode_kernel='pallas' through the member: oracle-pinned."""
+
+    def _run(self, **opts):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        return benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_dka",
+                "base_implementation": "spmd",
+                "options": {
+                    "phase": "decode", "batch": 8, "vocab": 64,
+                    "n_heads": 4, "decode_kernel": "pallas",
+                    "attn_kernel": "einsum", **opts,
+                },
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "float32",
+                "num_iterations": 1,
+                "num_warmups": 1,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+
+    @pytest.mark.parametrize(
+        "opts",
+        [
+            {},
+            {"kv_cache": "int8", "n_kv_heads": 2},
+            {"rope": True, "attn_window": 6},
+        ],
+        ids=["plain", "int8-gqa", "rope-window"],
+    )
+    def test_decode_step_validates(self, opts):
+        row = self._run(**opts)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_generate_loop_validates(self):
+        row = self._run(phase="generate", n_new=5)
+        assert row["error"] == ""
+        assert row["valid"] is True
+
+    def test_xla_gspmd_rejects_pallas_decode_kernel(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_decode", "xla_gspmd")
+        with pytest.raises(ValueError, match="decode_kernel"):
+            cls(16, 32, 64, dtype="float32", decode_kernel="pallas",
+                batch=8, vocab=64, n_heads=4)
