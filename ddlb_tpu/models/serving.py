@@ -92,6 +92,8 @@ class EngineStats:
     admissions: int = 0
     lane_ticks_active: int = 0  # per-tick count of active lanes
     lane_ticks_total: int = 0
+    prefix_hits: int = 0        # admissions served from the shared prefix
+    prefill_tokens_saved: int = 0
 
     @property
     def occupancy(self) -> float:
@@ -136,10 +138,17 @@ class ContinuousBatchingEngine:
         self.S_max = max_len
         self.eos_id = eos_id
 
+        from ddlb_tpu.models.decode import make_chunk_decode_fn
+
         decode, _ = make_decode_fn(mesh, cfg, ragged=True)
         self._decode = jax.jit(decode)
         prefill, _ = make_prefill_fn(mesh, cfg)
         self._prefill = jax.jit(prefill)
+        chunk, _ = make_chunk_decode_fn(mesh, cfg)
+        self._chunk = jax.jit(chunk)
+        # shared-prefix state (set_shared_prefix)
+        self._prefix_tokens: Optional[np.ndarray] = None
+        self._prefix_scratch = None
 
         # slot copy: scratch-cache copy `c`'s rows [0, S0) into slot `s`
         # of the big cache. slot/copy are DYNAMIC scalars so only the
@@ -167,6 +176,28 @@ class ContinuousBatchingEngine:
                 copy_body,
                 mesh=mesh,
                 in_specs=(cs, cs, P(), P()),
+                out_specs=cs,
+                check_vma=False,
+            )
+        )
+
+        # prefix seed: the shared-prefix scratch's rows [0, P) land at
+        # the head of a fresh admission scratch (leading rows, static
+        # shapes — compile per (P, S0) pair, the same cadence as the
+        # prefill it replaces)
+        def seed_body(dst, src):
+            return {
+                name: jax.lax.dynamic_update_slice(
+                    dst[name], src[name], (0, 0, 0, 0, 0)
+                )
+                for name in dst
+            }
+
+        self._seed_prefix = jax.jit(
+            jax.shard_map(
+                seed_body,
+                mesh=mesh,
+                in_specs=(cs, cs),
                 out_specs=cs,
                 check_vma=False,
             )
@@ -209,6 +240,35 @@ class ContinuousBatchingEngine:
         self._queue.append(idx)
         return idx
 
+    def set_shared_prefix(self, prefix) -> None:
+        """Prefill a shared prompt prefix ONCE (e.g. a system prompt);
+        every admission whose prompt starts with it reuses the cached
+        rows and prefills only the suffix — a chunk-decode at
+        ``start=P`` that attends the prefix THROUGH the cache. The K/V
+        rows are identical to a full prefill's BY CONSTRUCTION (prefix
+        rows depend only on prefix tokens; int8 rows are quantized once
+        and read back the same way on both paths); the suffix logits
+        agree to float tolerance (the chunk path accumulates attention
+        in a different order than a flash prefill would), which the
+        lossless tests pin at the token level across einsum AND flash
+        prefill kernels. ``None`` clears the prefix and frees its device
+        scratch; a set prefix survives ``reset()`` (it is derived from
+        params, like the jitted step programs)."""
+        if prefix is None:
+            self._prefix_tokens = None
+            self._prefix_scratch = None
+            return
+        prefix = np.asarray(prefix, np.int32)
+        if prefix.ndim != 1 or prefix.size == 0:
+            raise ValueError("prefix must be a non-empty 1-D token array")
+        rep = jnp.asarray(
+            np.broadcast_to(prefix, (self.tp, prefix.size)).copy()
+        )
+        scratch = init_cache(self.cfg, self.tp, prefix.size, mesh=self.mesh)
+        _, scratch = self._prefill(self.params, scratch, rep)
+        self._prefix_tokens = prefix
+        self._prefix_scratch = jax.block_until_ready(scratch)
+
     def _expert_of(self, slot: int) -> int:
         # the block router's per-sequence-stable assignment on a dp=1
         # shard: slot i -> expert i // (B / tp) (models/decode._block_moe)
@@ -229,13 +289,38 @@ class ContinuousBatchingEngine:
         S0 = req.prompt.size
         assert S0 + req.max_new <= self.S_max  # screened in submit()
         # tp-replicated prefill into a scratch cache (one compile per
-        # distinct S0); keep copy e(slot)'s rows + logits
+        # distinct S0); keep copy e(slot)'s rows + logits. With a shared
+        # prefix match, seed the scratch from the prefix cache and
+        # chunk-decode only the suffix (O((S0-P)*S0) attention instead of
+        # O(S0^2), and no prefix MLP/projection recompute).
         e = self._expert_of(slot)
-        prompt_rep = jnp.asarray(
-            np.broadcast_to(req.prompt, (self.tp, S0)).copy()
-        )
+        P_len = 0
+        if self._prefix_tokens is not None:
+            P_len = self._prefix_tokens.size
+            if not (
+                S0 > P_len
+                and np.array_equal(req.prompt[:P_len], self._prefix_tokens)
+            ):
+                P_len = 0  # no match (or no suffix): full prefill path
         scratch = init_cache(self.cfg, self.tp, S0, mesh=self.mesh)
-        logits, scratch = self._prefill(self.params, scratch, prompt_rep)
+        if P_len:
+            scratch = self._seed_prefix(scratch, self._prefix_scratch)
+            suffix = jnp.asarray(
+                np.broadcast_to(
+                    req.prompt[P_len:], (self.tp, S0 - P_len)
+                ).copy()
+            )
+            logits, scratch = self._chunk(
+                self.params, scratch, suffix, jnp.int32(P_len)
+            )
+            logits = logits[:, -1]
+            self.stats.prefix_hits += 1
+            self.stats.prefill_tokens_saved += P_len
+        else:
+            prompt_rep = jnp.asarray(
+                np.broadcast_to(req.prompt, (self.tp, S0)).copy()
+            )
+            logits, scratch = self._prefill(self.params, scratch, prompt_rep)
         self.cache = self._copy_slot(
             self.cache, scratch, jnp.int32(slot), jnp.int32(e)
         )

@@ -18,9 +18,10 @@ import jax.numpy as jnp
 def _cfg(**kw):
     from ddlb_tpu.models.transformer import TransformerConfig
 
+    kw.setdefault("attn_kernel", "einsum")
     return TransformerConfig(
         vocab=64, d_model=32, n_heads=4, d_ff=64,
-        layers_per_stage=2, microbatches=1, attn_kernel="einsum",
+        layers_per_stage=2, microbatches=1,
         **kw,
     )
 
@@ -150,6 +151,108 @@ class TestLosslessScheduling:
         assert eng.stats.steps > 0
         assert 0.0 < eng.stats.occupancy <= 1.0
         assert eng.stats.generated == 4 * 5
+
+
+class TestSharedPrefix:
+    """Prefix caching: admissions reuse the shared-prefix KV rows and
+    prefill only the suffix — and the tokens still equal the per-slot
+    greedy oracle of the FULL prompt (the lossless bar, again)."""
+
+    @pytest.mark.parametrize(
+        "kv_cache,attn_kernel",
+        [("bf16", "einsum"), ("int8", "einsum"), ("bf16", "flash")],
+        ids=["bf16", "int8", "bf16-flash-prefill"],
+    )
+    def test_prefix_hits_are_lossless(self, kv_cache, attn_kernel):
+        # the flash case pins the cross-kernel claim: the oracle chain
+        # and non-prefix admissions prefill through the flash kernel
+        # while prefix hits chunk-decode with einsum cache attention —
+        # tokens must still match
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg(kv_cache=kv_cache, rope=True, attn_kernel=attn_kernel)
+        eng, mesh, params = _engine(cfg)
+        rng = np.random.default_rng(9)
+        prefix = rng.integers(1, 64, 6).astype(np.int32)
+        eng.set_shared_prefix(prefix)
+        prompts = [
+            np.concatenate([prefix, rng.integers(1, 64, s).astype(np.int32)])
+            for s in (3, 5, 2, 4, 6)
+        ]
+        for p in prompts:
+            eng.submit(Request(p, max_new=4))
+        done = eng.run()
+        assert len(done) == 5
+        assert eng.stats.prefix_hits == 5
+        assert eng.stats.prefill_tokens_saved == 5 * prefix.size
+        for c in done:
+            want = _oracle_chain(
+                mesh, cfg, params, prompts[c.request_index], c.slot,
+                eng.B, 4,
+            )
+            np.testing.assert_array_equal(
+                c.tokens, want,
+                err_msg=f"request {c.request_index} in slot {c.slot}",
+            )
+
+    def test_mismatch_and_exact_prefix_fall_back(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, mesh, params = _engine(cfg)
+        rng = np.random.default_rng(10)
+        prefix = rng.integers(1, 64, 6).astype(np.int32)
+        eng.set_shared_prefix(prefix)
+        other = rng.integers(1, 64, 8).astype(np.int32)
+        other[0] = (prefix[0] + 1) % 64  # diverges at token 0
+        for p in (other, prefix.copy()):  # mismatch; prompt == prefix
+            eng.submit(Request(p, max_new=3))
+        done = eng.run()
+        assert len(done) == 2
+        assert eng.stats.prefix_hits == 0  # both took the full prefill
+        for c in done:
+            prompt = (other, prefix)[c.request_index]
+            want = _oracle_chain(
+                mesh, cfg, params, prompt, c.slot, eng.B, 3
+            )
+            np.testing.assert_array_equal(c.tokens, want)
+
+    def test_prefix_survives_reset(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, mesh, params = _engine(cfg)
+        rng = np.random.default_rng(11)
+        prefix = rng.integers(1, 64, 4).astype(np.int32)
+        eng.set_shared_prefix(prefix)
+        prompt = np.concatenate(
+            [prefix, rng.integers(1, 64, 4).astype(np.int32)]
+        )
+        eng.submit(Request(prompt, max_new=3))
+        first = eng.run()[0].tokens
+        eng.reset()
+        eng.submit(Request(prompt, max_new=3))
+        again = eng.run()[0].tokens
+        np.testing.assert_array_equal(first, again)
+        assert eng.stats.prefix_hits == 1  # post-reset stats count anew
+
+    def test_bad_prefix_rejected_and_none_clears(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg)
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.set_shared_prefix(np.zeros((0,), np.int32))
+        rng = np.random.default_rng(12)
+        prefix = rng.integers(1, 64, 4).astype(np.int32)
+        eng.set_shared_prefix(prefix)
+        eng.set_shared_prefix(None)  # cleared: back to full prefills
+        prompt = np.concatenate(
+            [prefix, rng.integers(1, 64, 4).astype(np.int32)]
+        )
+        eng.submit(Request(prompt, max_new=2))
+        eng.run()
+        assert eng.stats.prefix_hits == 0
 
 
 class TestServeMember:
