@@ -81,9 +81,11 @@ class TransformerConfig:
     mlp_kernel: str = "bf16"
     #: sliding-window (local) attention span: each position attends only
     #: the ``attn_window`` most recent positions including itself
-    #: (0 = full causal). Gathered + serving paths; the flash kernels
-    #: skip tiles entirely behind the band. Ring mode is full-causal
-    #: only (a windowed ring would skip whole hops — future work).
+    #: (0 = full causal). All paths: gathered and serving (the flash and
+    #: decode kernels skip tiles entirely behind the band) AND ring —
+    #: a windowed ring skips whole hops' compute (chunks entirely behind
+    #: the band; the ppermute chain still circulates every chunk, since
+    #: hop liveness differs per device).
     attn_window: int = 0
     #: rotary position embeddings (RoPE, rotate-half form) applied to
     #: q/k after projection. Position source per path: global sequence
@@ -307,7 +309,7 @@ def _causal_attention(q, k, v, window: int = 0):
     return out.astype(q.dtype)
 
 
-def _ring_attention(q, k, v, d, axis_name="tp"):
+def _ring_attention(q, k, v, d, axis_name="tp", window: int = 0):
     """Context-parallel causal attention inside the train step: K/V chunks
     circulate the ``axis_name`` ring while a running (max, sum, output)
     accumulator folds each arriving chunk — exact online softmax, no
@@ -333,21 +335,48 @@ def _ring_attention(q, k, v, d, axis_name="tp"):
     rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
     k_cur, v_cur = k, v
+    from ddlb_tpu.ops.flash_attention import _ring_chunk_live
+
     for t in range(d):
         src = (my - t) % d  # the chunk held after t hops came from src
-        k_use = jnp.repeat(k_cur, G, axis=2) if G > 1 else k_cur
-        v_use = jnp.repeat(v_cur, G, axis=2) if G > 1 else v_cur
-        s = jnp.einsum("bhqd,bkhd->bhqk", qh, k_use.astype(jnp.float32))
-        mask = (my * s_loc + rows) >= (src * s_loc + cols)
-        s = jnp.where(mask[None, None], s, -1e30)
-        m_new = jnp.maximum(m_run, s.max(-1, keepdims=True))
-        alpha = jnp.exp(m_run - m_new)
-        p = jnp.exp(s - m_new)
-        l_run = l_run * alpha + p.sum(-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32)
+
+        def fold(carry, k_blk=k_cur, v_blk=v_cur, src_=src):
+            acc, m_run, l_run = carry
+            k_use = jnp.repeat(k_blk, G, axis=2) if G > 1 else k_blk
+            v_use = jnp.repeat(v_blk, G, axis=2) if G > 1 else v_blk
+            s = jnp.einsum(
+                "bhqd,bkhd->bhqk", qh, k_use.astype(jnp.float32)
+            )
+            mask = (my * s_loc + rows) >= (src_ * s_loc + cols)
+            if window:
+                # sliding window: keys more than window-1 behind the
+                # query drop out (global coordinates — the band crosses
+                # chunk boundaries)
+                mask &= (src_ * s_loc + cols) > (
+                    my * s_loc + rows - window
+                )
+            s = jnp.where(mask[None, None], s, -1e30)
+            m_new = jnp.maximum(m_run, s.max(-1, keepdims=True))
+            alpha = jnp.exp(m_run - m_new)
+            p = jnp.exp(s - m_new)
+            if window:
+                # a fully-masked score row would make exp(s - m_new) = 1
+                # per column — zero masked entries (a partially-banded
+                # chunk can fully mask some rows)
+                p = jnp.where(mask[None, None], p, 0.0)
+            l_new = l_run * alpha + p.sum(-1, keepdims=True)
+            acc_new = acc * alpha + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, v_use.astype(jnp.float32)
+            )
+            return acc_new, m_new, l_new
+
+        # skip chunks entirely outside the live band — strictly future,
+        # or (windowed) entirely behind it (same predicate as the flash
+        # ring: dead hops cost no FLOPs on any ring path)
+        acc, m_run, l_run = jax.lax.cond(
+            _ring_chunk_live(src, my, s_loc, window),
+            fold, lambda c: c, (acc, m_run, l_run),
         )
-        m_run = m_new
         if t + 1 < d:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm=fwd)
@@ -392,7 +421,7 @@ def _flash_full(q, k, v, interpret, window: int = 0):
     return o.reshape(S, b, h, dh).transpose(1, 0, 2, 3)
 
 
-def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
+def _ring_flash(q, k, v, d, interpret, axis_name="tp", window: int = 0):
     """Batched context-parallel flash attention on the local sequence
     chunk: [b, s_loc, h, dh] -> [b, s_loc, h, dh]; K/V (and, in the
     backward, their gradient accumulators) ride the ``axis_name`` ring —
@@ -411,6 +440,7 @@ def _ring_flash(q, k, v, d, interpret, axis_name="tp"):
         block_q=_flash_block(s_loc),
         block_kv=_flash_block(s_loc),
         interpret=interpret,
+        window=window,
     )
     return o.reshape(s_loc, b, h, dh).transpose(1, 0, 2, 3)
 
@@ -584,11 +614,6 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
         raise ValueError(f"unknown mlp_kernel '{cfg.mlp_kernel}'")
     if cfg.router not in ("block", "topk", "expert_choice"):
         raise ValueError(f"unknown router '{cfg.router}'")
-    if cfg.attn_window and cfg.attention == "ring":
-        raise ValueError(
-            "attn_window requires attention='gathered' (a windowed ring "
-            "would skip whole hops — not implemented)"
-        )
 
     def stage_fn(x, sp):
         """Apply this stage's L transformer blocks to a local activation
@@ -653,11 +678,13 @@ def make_stage_fn(cfg: TransformerConfig, tp: int, interpret: bool):
                     q = apply_rope(q, pos, cfg.rope_theta)
                     k = apply_rope(k, pos, cfg.rope_theta)
                 if cfg.attn_kernel == "flash":
-                    attn = _ring_flash(q, k, v, tp, interpret).reshape(
-                        b, s_loc, -1
-                    )
+                    attn = _ring_flash(
+                        q, k, v, tp, interpret, window=cfg.attn_window
+                    ).reshape(b, s_loc, -1)
                 else:
-                    attn = _ring_attention(q, k, v, tp).reshape(b, s_loc, -1)
+                    attn = _ring_attention(
+                        q, k, v, tp, window=cfg.attn_window
+                    ).reshape(b, s_loc, -1)
                 y = jnp.matmul(
                     attn, sp["w_o"][0, l], preferred_element_type=jnp.float32
                 ).astype(x.dtype)  # [b, s_loc, D], complete (all heads)

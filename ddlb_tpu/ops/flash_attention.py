@@ -145,6 +145,7 @@ def _flash_chunk_kernel(
     offs_ref, q_ref, k_ref, v_ref, acc_in_ref, m_in_ref, l_in_ref,
     acc_ref, m_ref, l_ref,
     *, scale: float, block_q: int, block_kv: int, causal: str = "offset",
+    window: int = 0,
 ):
     """One KV chunk folded into a carried (acc, m, l) accumulator.
 
@@ -187,18 +188,23 @@ def _flash_chunk_kernel(
             q_ref[0], k_ref[0], v_ref[0], m_ref[0], l_ref[0], acc_ref[0],
             scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_kv=block_kv,
-            masked=causal != "past",
+            masked=causal != "past", window=window,
         )
 
     if causal == "past":
         _update()  # every tile fully live: no skip predicate, no mask
     else:
-        pl.when(q_start + block_q - 1 >= k_start)(_update)
+        # live-band skip on both edges: causal upper, window lower
+        pl.when(
+            _band_live(q_start, k_start, block_q, block_kv, True, window)
+        )(_update)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "block_q", "block_kv", "interpret", "causal"),
+    static_argnames=(
+        "scale", "block_q", "block_kv", "interpret", "causal", "window",
+    ),
 )
 def flash_attention_chunk(
     q,
@@ -213,6 +219,7 @@ def flash_attention_chunk(
     block_kv: int = 1024,
     interpret: bool = False,
     causal: str = "offset",
+    window: int = 0,
 ):
     """Fold one KV chunk into a flash accumulator (ring-attention step).
 
@@ -239,9 +246,14 @@ def flash_attention_chunk(
     vh = v.transpose(1, 0, 2)
     if causal not in ("offset", "diagonal", "past"):
         raise ValueError(f"unknown causal mode {causal!r}")
+    if window and causal == "past":
+        raise ValueError(
+            "window composes with causal='offset'/'diagonal' (a 'past' "
+            "chunk may be partially behind the band and needs the mask)"
+        )
     kernel = functools.partial(
         _flash_chunk_kernel, scale=scale, block_q=bq, block_kv=bkv,
-        causal=causal,
+        causal=causal, window=window,
     )
     qspec = pl.BlockSpec((1, bq, dh), lambda hh, i, j, off: (hh, i, 0))
     kvspec = pl.BlockSpec((1, bkv, dh), lambda hh, i, j, off: (hh // G, j, 0))
@@ -1164,6 +1176,7 @@ def ring_flash_attention(
     block_q: int = 1024,
     block_kv: int = 1024,
     interpret: bool = False,
+    window: int = 0,
 ):
     """Context-parallel causal flash attention inside ``shard_map`` —
     differentiable end to end.
@@ -1182,23 +1195,47 @@ def ring_flash_attention(
     the ring then ships the SMALL kv chunks (and their gradient
     accumulators), so context parallelism's wire bytes shrink by the
     same group factor as the serving cache.
+
+    ``window > 0`` is sliding-window attention over the ring: chunks
+    entirely behind the band are skipped — compute per device drops to
+    the live hops, ~ceil(window / s_loc) + 1 of d (the ring traffic
+    itself still circulates every chunk: the ppermute chain is the
+    collective, and hop t's liveness differs per device).
     """
     _gqa_group(q, k)  # validates h % h_kv
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     return _ring_flash(
-        q, k, v, axis_name, axis_size, scale, block_q, block_kv, interpret
+        q, k, v, axis_name, axis_size, scale, block_q, block_kv, interpret,
+        window,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring_flash(q, k, v, axis_name, d, scale, block_q, block_kv, interpret):
+def _ring_chunk_live(src, my, s_loc, window):
+    """Is chunk ``src`` live for device ``my``'s queries? Causal upper
+    edge: not entirely in the future. Window lower edge: its last key
+    (src+1)*s_loc - 1 not entirely behind the band of the first query
+    my*s_loc (the diagonal chunk is always live)."""
+    live = src <= my
+    if window:
+        live = jnp.logical_and(
+            live, (src + 1) * s_loc - 1 > my * s_loc - window
+        )
+    return live
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_flash(
+    q, k, v, axis_name, d, scale, block_q, block_kv, interpret, window
+):
     o, _ = _ring_flash_forward(
-        q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+        q, k, v, axis_name, d, scale, block_q, block_kv, interpret, window
     )
     return o
 
 
 def _ring_flash_forward(
-    q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+    q, k, v, axis_name, d, scale, block_q, block_kv, interpret, window
 ):
     my = jax.lax.axis_index(axis_name)
     s_loc, h, dh = q.shape
@@ -1209,6 +1246,15 @@ def _ring_flash_forward(
         src = (my - t) % d  # the chunk held after t hops came from src
 
         def fold(c, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
+            # t is STATIC: the t=0 chunk is exactly diagonal (equal
+            # offsets), every later executed chunk strictly past — no
+            # runtime-offset masking needed on either. A window needs
+            # the mask on past chunks too (partially behind the band),
+            # so those switch to the runtime-offset mode.
+            if window:
+                causal = "diagonal" if t_ == 0 else "offset"
+            else:
+                causal = "diagonal" if t_ == 0 else "past"
             return flash_attention_chunk(
                 q, k_c, v_c, c,
                 scale=scale,
@@ -1217,14 +1263,16 @@ def _ring_flash_forward(
                 block_q=block_q,
                 block_kv=block_kv,
                 interpret=interpret,
-                # t is STATIC: the t=0 chunk is exactly diagonal (equal
-                # offsets), every later executed chunk strictly past —
-                # no runtime-offset masking needed on either
-                causal="diagonal" if t_ == 0 else "past",
+                causal=causal,
+                window=window,
             )
 
-        # fully-future chunks (src > my) are entirely masked: skip
-        carry = jax.lax.cond(src <= my, fold, lambda c: c, carry)
+        # skip chunks entirely outside the live band (future, or — with
+        # a window — entirely behind it)
+        carry = jax.lax.cond(
+            _ring_chunk_live(src, my, s_loc, window), fold, lambda c: c,
+            carry,
+        )
         if t + 1 < d:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm=fwd)
@@ -1235,16 +1283,16 @@ def _ring_flash_forward(
 
 
 def _ring_flash_fwd_rule(
-    q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+    q, k, v, axis_name, d, scale, block_q, block_kv, interpret, window
 ):
     o, lse = _ring_flash_forward(
-        q, k, v, axis_name, d, scale, block_q, block_kv, interpret
+        q, k, v, axis_name, d, scale, block_q, block_kv, interpret, window
     )
     return o, (q, k, v, o, lse)
 
 
 def _ring_flash_bwd_rule(
-    axis_name, d, scale, block_q, block_kv, interpret, res, do
+    axis_name, d, scale, block_q, block_kv, interpret, window, res, do
 ):
     q, k, v, o, lse = res
     my = jax.lax.axis_index(axis_name)
@@ -1261,6 +1309,13 @@ def _ring_flash_bwd_rule(
 
         def step(args, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
             dq_a, dk_a, dv_a = args
+            # the backward's windowed mode is offset-only (the bwd
+            # kernels reject window elsewhere) — equal offsets make it
+            # exact for the diagonal chunk too
+            if window:
+                causal = "offset"
+            else:
+                causal = "diagonal" if t_ == 0 else "past"
             dq_c, dk_c, dv_c = flash_attention_bwd(
                 q, k_c, v_c, o, lse, do,
                 scale=scale,
@@ -1269,12 +1324,14 @@ def _ring_flash_bwd_rule(
                 block_q=block_q,
                 block_kv=block_kv,
                 interpret=interpret,
-                causal="diagonal" if t_ == 0 else "past",
+                causal=causal,
+                window=window,
             )
             return dq_a + dq_c, dk_a + dk_c, dv_a + dv_c
 
         dq_acc, dk_cur, dv_cur = jax.lax.cond(
-            src <= my, step, lambda a: a, (dq_acc, dk_cur, dv_cur)
+            _ring_chunk_live(src, my, s_loc, window), step, lambda a: a,
+            (dq_acc, dk_cur, dv_cur),
         )
         if t + 1 < d:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm=fwd)

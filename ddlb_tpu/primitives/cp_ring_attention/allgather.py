@@ -26,13 +26,14 @@ class AllGatherCPRingAttention(CPRingAttention):
         super()._input_setup()
         s_loc = self.m // self.num_partitions
         scale = 1.0 / (self.k ** 0.5)
+        w = self.options["window"]
 
         def step(q, k, v):
             my = jax.lax.axis_index("tp")
             k_full = jax.lax.all_gather(k, "tp", axis=0, tiled=True)
             v_full = jax.lax.all_gather(v, "tp", axis=0, tiled=True)
             return causal_attention(
-                q, k_full, v_full, scale, row_offset=my * s_loc
+                q, k_full, v_full, scale, row_offset=my * s_loc, window=w
             )
 
         self._fn = jax.jit(

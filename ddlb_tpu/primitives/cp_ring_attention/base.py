@@ -34,12 +34,13 @@ from ddlb_tpu.primitives.base import Primitive
 NEG_INF = -1e30
 
 
-def causal_attention(q, k, v, scale, row_offset=0):
+def causal_attention(q, k, v, scale, row_offset=0, window: int = 0):
     """Masked softmax attention in jnp, queries at ``row_offset`` within the
     global sequence — the single source of the math used by the
     compute_only and allgather implementations (the ring implementation
     re-derives it in online form). ``k``/``v`` may carry fewer (grouped/
-    GQA) heads; repetition computes the identical dot products."""
+    GQA) heads; repetition computes the identical dot products.
+    ``window > 0`` additionally drops keys behind the sliding band."""
     import jax
     import jax.numpy as jnp
 
@@ -54,7 +55,10 @@ def causal_attention(q, k, v, scale, row_offset=0):
     n_q, n_k = s.shape[1], s.shape[2]
     rows = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (n_q, n_k), 1)
-    s = jnp.where(((row_offset + rows) >= cols)[None], s, NEG_INF)
+    mask = (row_offset + rows) >= cols
+    if window:
+        mask &= cols > (row_offset + rows - window)
+    s = jnp.where(mask[None], s, NEG_INF)
     s = s - s.max(-1, keepdims=True)
     p = jnp.exp(s)
     p = p / p.sum(-1, keepdims=True)
@@ -71,8 +75,15 @@ class CPRingAttention(Primitive):
     #: — plus the GQA axis: n_kv_heads < num_heads shrinks the K/V
     #: operands (and therefore the ring/all-to-all wire bytes) by the
     #: group factor, the long-context serving shape
-    BASE_OPTIONS = {"transport": "ici", "n_kv_heads": 0}
-    BASE_ALLOWED = {"transport": ["ici", "dcn"], "n_kv_heads": (0, None)}
+    #: plus sliding-window (local) attention: window > 0 restricts each
+    #: query to its window most recent positions — the band crosses chunk
+    #: boundaries, and the ring members skip hops entirely behind it
+    BASE_OPTIONS = {"transport": "ici", "n_kv_heads": 0, "window": 0}
+    BASE_ALLOWED = {
+        "transport": ["ici", "dcn"],
+        "n_kv_heads": (0, None),
+        "window": (0, None),
+    }
 
     def _check_shapes(self) -> None:
         d = self.num_partitions
@@ -101,7 +112,14 @@ class CPRingAttention(Primitive):
         return self.options["n_kv_heads"] or self.num_heads
 
     def flops(self) -> float:
-        # 2*m^2*n for QK^T + 2*m^2*n for PV, halved by the causal mask
+        # 4*n FLOPs per live (query, key) pair (QK^T + PV). Full causal:
+        # m(m+1)/2 pairs (reported as the conventional m^2/2). A window
+        # caps each query's live keys at min(window, q+1):
+        # w*m - w(w-1)/2 pairs.
+        w = self.options["window"]
+        if w and w < self.m:
+            pairs = w * self.m - w * (w - 1) / 2.0
+            return 4.0 * pairs * self.n
         return 2.0 * self.m * self.m * self.n
 
     def _host_qkv(self):
@@ -145,6 +163,7 @@ class CPRingAttention(Primitive):
             v = np.asarray(jnp.asarray(v, cast), np.float32)
         m, h = self.m, self.num_heads
         G = h // self.kv_heads
+        w = self.options["window"]
         scale = 1.0 / np.sqrt(self.k)
         out = np.empty((m, h, self.k), np.float32)
         block = max(1, min(m, (1 << 24) // max(m, 1)))  # ~64 MB scores
@@ -155,7 +174,10 @@ class CPRingAttention(Primitive):
             for r0 in range(0, m, block):
                 r1 = min(r0 + block, m)
                 scores = (q[r0:r1, head, :] @ kh.T) * scale  # [blk, m]
-                mask = (r0 + np.arange(r1 - r0))[:, None] >= cols[None, :]
+                rws = (r0 + np.arange(r1 - r0))[:, None]
+                mask = rws >= cols[None, :]
+                if w:
+                    mask &= cols[None, :] > rws - w
                 scores = np.where(mask, scores, -np.inf)
                 scores -= scores.max(axis=-1, keepdims=True)
                 p = np.exp(scores)

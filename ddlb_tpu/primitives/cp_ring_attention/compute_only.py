@@ -35,7 +35,10 @@ class ComputeOnlyCPRingAttention(CPRingAttention):
         self.kv_k = jax.device_put(jnp.asarray(k).astype(dt), device)
         self.kv_v = jax.device_put(jnp.asarray(v).astype(dt), device)
         scale = 1.0 / (self.k ** 0.5)
-        self._fn = jax.jit(lambda q, k, v: causal_attention(q, k, v, scale))
+        w = self.options["window"]
+        self._fn = jax.jit(
+            lambda q, k, v: causal_attention(q, k, v, scale, window=w)
+        )
         jax.block_until_ready((self.q, self.kv_k, self.kv_v))
 
     def validate(self, result) -> bool:

@@ -54,6 +54,7 @@ class FlashCPRingAttention(CPRingAttention):
                 block_q=opts["block_q"],
                 block_kv=opts["block_kv"],
                 interpret=interpret,
+                window=opts["window"],
             )
 
         self._fn = jax.jit(

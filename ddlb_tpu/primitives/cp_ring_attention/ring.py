@@ -35,6 +35,7 @@ class RingCPRingAttention(CPRingAttention):
         scale = 1.0 / (dh ** 0.5)
         fwd = [(i, (i + 1) % d) for i in range(d)]
         skip = self.options["skip_masked_blocks"]
+        w = self.options["window"]
 
         def step(q, k, v):
             # [s_loc, h, dh] -> [h, s_loc, dh]
@@ -66,7 +67,12 @@ class RingCPRingAttention(CPRingAttention):
                     )
                     # causal mask on GLOBAL positions: query my*s_loc+r may
                     # see key kv_idx*s_loc+c iff it is not in the future
+                    # (and, windowed, not behind the sliding band)
                     mask = (my * s_loc + rows) >= (kv_idx * s_loc + cols)
+                    if w:
+                        mask &= (kv_idx * s_loc + cols) > (
+                            my * s_loc + rows - w
+                        )
                     s = jnp.where(mask[None], s, _NEG)
                     m_new = jnp.maximum(m_run, s.max(-1))
                     alpha = jnp.exp(m_run - m_new)
@@ -78,10 +84,15 @@ class RingCPRingAttention(CPRingAttention):
                     return o_new, m_new, l_new
 
                 if skip:
-                    # blocks strictly in the future are fully masked; skip
-                    # their matmuls entirely (the causal-half FLOP saving)
+                    # blocks entirely outside the live band are fully
+                    # masked: strictly future (causal) or — windowed —
+                    # entirely behind the band. Skip their matmuls.
+                    from ddlb_tpu.ops.flash_attention import (
+                        _ring_chunk_live,
+                    )
+
                     o, m_run, l_run = jax.lax.cond(
-                        kv_idx <= my,
+                        _ring_chunk_live(kv_idx, my, s_loc, w),
                         fold,
                         lambda c: c,
                         (o, m_run, l_run),

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.ops.flash_attention import (
+    _ring_chunk_live,
     finalize_flash_carry,
     flash_attention_chunk,
     init_flash_carry,
@@ -51,6 +52,7 @@ class RingFlashCPRingAttention(CPRingAttention):
         bq = self.options["block_q"]
         bkv = self.options["block_kv"]
         skip = self.options["skip_masked_blocks"]
+        w = self.options["window"]
 
         def step(q, k, v):
             my = jax.lax.axis_index("tp")
@@ -64,11 +66,14 @@ class RingFlashCPRingAttention(CPRingAttention):
                 def fold(carry, k_c=k_cur, v_c=v_cur, src_=src, t_=t):
                     # with the cond skip, t is a static classifier: the
                     # t=0 chunk is diagonal (relative mask), every later
-                    # executed chunk strictly past (no mask). Without the
-                    # skip, future chunks flow through the kernel and only
-                    # the runtime-offset mask zeroes them.
-                    if skip:
+                    # executed chunk strictly past (no mask — unless a
+                    # window needs the band mask on past chunks too).
+                    # Without the skip, every chunk shares the
+                    # runtime-offset-masked kernel.
+                    if skip and not w:
                         causal = "diagonal" if t_ == 0 else "past"
+                    elif skip:
+                        causal = "diagonal" if t_ == 0 else "offset"
                     else:
                         causal = "offset"
                     return flash_attention_chunk(
@@ -83,14 +88,17 @@ class RingFlashCPRingAttention(CPRingAttention):
                         block_kv=bkv,
                         interpret=interpret,
                         causal=causal,
+                        window=w,
                     )
 
                 if skip:
-                    # fully-future chunks (src > my) are entirely masked:
-                    # don't stream Q/KV/carry through the kernel for zero
-                    # FLOPs (ring.py's skip_masked_blocks, same semantics)
+                    # chunks entirely outside the live band — strictly
+                    # future, or (windowed) entirely behind it — are
+                    # fully masked: don't stream Q/KV/carry through the
+                    # kernel for zero FLOPs
                     carry = jax.lax.cond(
-                        src <= my, fold, lambda c: c, carry
+                        _ring_chunk_live(src, my, s_loc, w),
+                        fold, lambda c: c, carry,
                     )
                 else:
                     carry = fold(carry)

@@ -88,9 +88,12 @@ class UlyssesCPRingAttention(CPRingAttention):
                     block_q=opts["block_q"],
                     block_kv=opts["block_kv"],
                     interpret=interpret,
+                    window=opts["window"],
                 )
             else:
-                out = causal_attention(q_h, k_h, v_h, scale)
+                out = causal_attention(
+                    q_h, k_h, v_h, scale, window=opts["window"]
+                )
             return heads_to_seq(out)
 
         self._fn = jax.jit(

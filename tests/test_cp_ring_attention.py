@@ -46,6 +46,62 @@ def test_flops_override():
     cls = load_impl_class("cp_ring_attention", "ring")
     impl = cls(M, N, K, dtype="float32")
     assert impl.flops() == 2.0 * M * M * N  # causal half of 4*m^2*n
+    # window census: min(window, q+1) live keys per query
+    w = 16
+    impl_w = cls(M, N, K, dtype="float32", window=w)
+    assert impl_w.flops() == 4.0 * (w * M - w * (w - 1) / 2.0) * N
+    # a band covering the whole triangle reports the causal census
+    impl_big = cls(M, N, K, dtype="float32", window=M)
+    assert impl_big.flops() == 2.0 * M * M * N
+
+
+class TestWindowSweep:
+    """window > 0 across every member, validated against the windowed
+    oracle — the band crosses chunk boundaries on the sharded members and
+    the ring members skip hops entirely behind it."""
+
+    W = 48  # spans 1-2 chunks at M=128 on 8 partitions (s_loc=16)
+
+    @pytest.mark.parametrize(
+        "impl,opts",
+        [
+            ("ring", {"skip_masked_blocks": True}),
+            ("ring", {"skip_masked_blocks": False}),
+            ("ring_flash", {"block_q": 8, "block_kv": 8}),
+            ("ring_flash",
+             {"block_q": 8, "block_kv": 8, "skip_masked_blocks": False}),
+            ("allgather", {}),
+            ("flash", {"block_q": 16, "block_kv": 16}),
+            ("ulysses", {"compute": "einsum"}),
+            ("ulysses",
+             {"compute": "flash", "block_q": 16, "block_kv": 16}),
+            ("compute_only", {"size": "unsharded"}),
+        ],
+        ids=[
+            "ring-skip", "ring-noskip", "ring_flash", "ring_flash-noskip",
+            "allgather", "flash", "ulysses-einsum", "ulysses-flash",
+            "compute_only",
+        ],
+    )
+    def test_members_validate_windowed(self, impl, opts):
+        cls = load_impl_class("cp_ring_attention", impl)
+        # ulysses shards heads over the 8 partitions: give it 8 heads
+        n = 8 * K if impl == "ulysses" else N
+        inst = cls(M, n, K, dtype="float32", window=self.W, **opts)
+        assert inst.validate(inst.run())
+
+    def test_window_with_gqa(self):
+        cls = load_impl_class("cp_ring_attention", "ring")
+        inst = cls(M, N, K, dtype="float32", window=self.W, n_kv_heads=2)
+        assert inst.validate(inst.run())
+
+    def test_window_changes_result(self):
+        cls = load_impl_class("cp_ring_attention", "ring")
+        full = np.asarray(cls(M, N, K, dtype="float32").run(), np.float32)
+        win = np.asarray(
+            cls(M, N, K, dtype="float32", window=16).run(), np.float32
+        )
+        assert float(np.max(np.abs(full - win))) > 1e-3
 
 
 def test_shape_constraints():
