@@ -1,0 +1,103 @@
+"""Per-config subprocess isolation for the hardware measurement batches.
+
+The first live-relay run of measure_r2_hw.py showed why this exists: the
+batches call ``benchmark_worker`` directly, and a dozen configs into the
+session the backend died with RESOURCE_EXHAUSTED — compiled executables
+pin their captured weight buffers in the jit cache, so HBM fills up
+monotonically in one process (the sweep runner already knows this: its
+in-process path calls ``jax.clear_caches()`` between impls and its
+``isolation='subprocess'`` mode spawns a child per impl,
+ddlb_tpu/benchmark.py:584-648, mirroring the reference's spawn-per-impl
+design, /root/reference/ddlb/benchmark.py:336-370). Worse, once the TPU
+backend has OOMed it can stay wedged for the rest of the process.
+
+``run_isolated`` gives the measurement scripts the same remedy: one
+fresh process per config, one JSON row back over stdout, crash/timeout
+reported as an error row instead of poisoning the rest of the session.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from ddlb_tpu.benchmark import benchmark_worker
+row = benchmark_worker(json.loads(sys.argv[1]))
+print("ROW " + json.dumps(row, default=float), flush=True)
+"""
+
+
+def _error_row(config, error):
+    """Crash/timeout as a row: the runner's own JAX-free error-row path
+    (make_result_row with NaN times) so hw-batch rows share the one
+    schema and cannot drift from measured ones."""
+    import numpy as np
+
+    from ddlb_tpu.benchmark import make_result_row
+
+    return make_result_row(
+        config,
+        times_ms=np.array([float("nan")]),
+        flop_count=2.0 * config["m"] * config["n"] * config["k"],
+        option_repr=";".join(
+            f"{k}={v}" for k, v in sorted(config.get("options", {}).items())
+        )
+        or "-",
+        valid=False,
+        error=error,
+        world_size=0,
+        num_processes=0,
+        platform="unknown",
+    )
+
+
+def _forward_diagnostics(stdout):
+    """Surface the child's [ddlb_tpu] lines (validation failures, window
+    scaling) in the batch log — on every exit path, since a crashed or
+    hung child's diagnostics are exactly the ones worth keeping."""
+    if isinstance(stdout, bytes):  # TimeoutExpired captures bytes
+        stdout = stdout.decode("utf-8", errors="replace")
+    for line in (stdout or "").splitlines():
+        if line.startswith("[ddlb_tpu]"):
+            print(line, flush=True)
+
+
+def run_isolated(config, timeout=1800.0):
+    """Run one benchmark_worker config in a fresh child process.
+
+    Returns the worker's result row; a crashed, hung, or silent child
+    becomes an error row (same soft-failure contract as the sweep
+    runner's subprocess mode).
+    """
+    child = _CHILD.format(repo=REPO)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", child, json.dumps(config)],
+            cwd=REPO,
+            timeout=timeout,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired as exc:
+        _forward_diagnostics(exc.stdout)
+        return _error_row(config, f"TimeoutError: worker exceeded {timeout:.0f}s")
+    except OSError as exc:
+        return _error_row(config, f"worker spawn failed: {exc}")
+    _forward_diagnostics(out.stdout)
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("ROW "):
+            return json.loads(line[4:])
+    tail = (out.stderr or out.stdout or "").strip().splitlines()
+    return _error_row(
+        config,
+        "worker rc={} with no row: {}".format(
+            out.returncode, tail[-1] if tail else "no output"
+        ),
+    )

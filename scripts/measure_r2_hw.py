@@ -20,7 +20,7 @@ import sys
 # script dir is sys.path[0], so add the repo root for ddlb_tpu
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from ddlb_tpu.benchmark import benchmark_worker
+from hw_common import run_isolated
 
 QUICK = "--quick" in sys.argv[1:]
 
@@ -36,7 +36,9 @@ PROTO = {
 
 
 def run(primitive, impl, m, n, k, **options):
-    row = benchmark_worker(
+    # one fresh process per config: a dozen in-process configs OOM the
+    # chip (see hw_common.py) and a wedged backend poisons the session
+    row = run_isolated(
         {
             "primitive": primitive,
             "impl_id": f"{impl}_hw",

@@ -42,7 +42,7 @@ if SMOKE:
 
 import numpy as np
 
-from ddlb_tpu.benchmark import benchmark_worker
+from hw_common import run_isolated
 
 V5E_PEAK_BF16_TFLOPS = 197.0
 
@@ -59,7 +59,9 @@ PROTO = {
 
 
 def run(primitive, impl, m, n, k, label="", proto_overrides=None, **options):
-    row = benchmark_worker(
+    # one fresh process per config: a dozen in-process configs OOM the
+    # chip (see hw_common.py) and a wedged backend poisons the session
+    row = run_isolated(
         {
             "primitive": primitive,
             "impl_id": f"{impl}_hw",
