@@ -114,9 +114,16 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
 
         valid = True
         if do_validate:
-            result = impl.run()
-            fence(result)
-            valid = bool(impl.validate(result))
+            # a validation crash (e.g. the oracle OOMs at a context the
+            # measured step handles fine) must not discard the completed
+            # measurement: times stand, valid=False + error records why
+            try:
+                result = impl.run()
+                fence(result)
+                valid = bool(impl.validate(result))
+            except Exception as exc:
+                error = f"validation crashed: {type(exc).__name__}: {exc}"
+                valid = False
             if not valid:
                 # soft failure: recorded, not fatal (reference
                 # benchmark.py:242-245)

@@ -36,6 +36,7 @@ all three are valid here.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -992,6 +993,63 @@ def make_speculate_fn(
     return generate, (sh_t, sh_d)
 
 
+@functools.partial(jax.jit, static_argnames=("window",))
+def _oracle_attn_block(qc, q0, k, v, window):
+    """One query-chunk of the oracle attention: rows ``[q0, q0+C)``
+    softmaxed over the full key range. Module-level jit so the graph
+    compiles once and is reused across layers and validation forwards
+    (k/v are arguments, not trace-time closure constants)."""
+    S = k.shape[1]
+    C = qc.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", qc, k, preferred_element_type=jnp.float32
+    )
+    s = s * (1.0 / np.sqrt(qc.shape[-1]))
+    rows = q0 + jax.lax.broadcasted_iota(jnp.int32, (C, S), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (C, S), 1)
+    mask = rows >= cols
+    if window:
+        mask &= cols > rows - window
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(qc.dtype)
+
+
+def _oracle_attention(q, k, v, window: int = 0):
+    """Exact causal attention for the oracle without the ``[B, H, S, S]``
+    score matrix: query rows are processed in chunks, each chunk's rows
+    softmaxed over the full key range (query chunking is exact — no
+    online-softmax accumulator needed; a ragged final chunk is fine, and
+    matters: the decode oracle's teacher-forced length is m+1, odd for
+    every power-of-two context).
+
+    Same math as ``models.transformer._causal_attention``: operands stay
+    bf16 with an f32 MXU accumulator (bf16 products are exact in f32, so
+    the only difference from the upcast-first einsum is the accumulation
+    order, far below the validation atol). The full matrix OOMs the v5e
+    past ctx≈4k — observed RESOURCE_EXHAUSTED in the first live serving
+    batch — while the chunked rows keep oracle scratch around 1 GB even
+    at 64k context.
+    """
+    B, S, H, dh = q.shape
+    if k.shape[2] != H:
+        G = H // k.shape[2]
+        k = jnp.repeat(k, G, axis=2)
+        v = jnp.repeat(v, G, axis=2)
+    # chunk sized so the [B, H, chunk, S] f32 score block stays ~1 GB
+    chunk = S
+    while B * H * chunk * S * 4 > (1 << 30) and chunk > 1:
+        chunk = (chunk + 1) // 2
+    outs = [
+        _oracle_attn_block(q[:, q0 : q0 + chunk], jnp.int32(q0), k, v, window)
+        for q0 in range(0, S, chunk)
+    ]
+    return jnp.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+
+
 def reference_logits(
     params, tokens, cfg: TransformerConfig, tp: int, dp: int
 ) -> jax.Array:
@@ -1000,12 +1058,13 @@ def reference_logits(
 
     Reproduces the decode semantics exactly: per-sequence-stable expert
     assignment (sequence ``i`` of a dp shard uses expert
-    ``i // (B/(dp*tp))``), full-precision causal attention, the shared
-    ``_moe_ffn`` MLP kernels. The incremental cache path must match this
-    non-incremental formulation — the real consistency check.
+    ``i // (B/(dp*tp))``), q-chunked causal attention with an f32
+    accumulator over bf16 operands (``_oracle_attention`` — bf16
+    products are exact in f32, so this differs from a full-f32 einsum
+    only in accumulation order), the shared ``_moe_ffn`` MLP kernels.
+    The incremental cache path must match this non-incremental
+    formulation — the real consistency check.
     """
-    from ddlb_tpu.models.transformer import _causal_attention
-
     B, S = tokens.shape
     L = cfg.layers_per_stage
     x = params["embed"][tokens]  # [B, S, D]
@@ -1025,7 +1084,7 @@ def reference_logits(
             # oracle applies the identical per-(position, head) rounding
             k = _kv_roundtrip(k)
             v = _kv_roundtrip(v)
-        attn = _causal_attention(
+        attn = _oracle_attention(
             q, k, v, window=cfg.attn_window
         ).reshape(B, S, D)
         x = x + jnp.matmul(

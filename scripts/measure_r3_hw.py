@@ -102,10 +102,13 @@ def decode_bytes(ctx, n_kv, kv_cache, mlp_kernel, tp=1):
 
 
 def serving_row(ctx, label, **opts):
+    # attn_kernel governs the SETUP prefill (flash: no [B,H,S,S] scores —
+    # einsum prefill OOMs past ctx~4k); the measured decode step's
+    # einsum-vs-fused lever is decode_kernel (r4 batch section 1c)
     row = run(
         "transformer_decode", "spmd", ctx, D, F,
         label=label, batch=B, vocab=V, n_heads=HEADS, phase="decode",
-        attn_kernel="einsum", **opts,
+        attn_kernel="flash", **opts,
     )
     t_ms = row["median time (ms)"]
     toks = B / t_ms * 1e3

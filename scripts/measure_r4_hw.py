@@ -167,11 +167,13 @@ if not SMOKE:
             ("int8+GQA4", {"kv_cache": "int8", "n_kv_heads": 4}),
         ):
             for dk in ("einsum", "pallas"):
+                # attn_kernel=flash is the SETUP prefill (einsum prefill
+                # OOMs past ctx~4k); decode_kernel is the measured lever
                 run(
                     "transformer_decode", "spmd", ctx, 2048, 8192,
                     label=f"decode @{ctx} {lbl} kernel={dk}",
                     phase="decode", batch=8, vocab=16384, n_heads=16,
-                    attn_kernel="einsum", decode_kernel=dk, **extra,
+                    attn_kernel="flash", decode_kernel=dk, **extra,
                 )
 
 # -- 1d) windowed flash attention: the band FLOP saving on the MXU -----------
