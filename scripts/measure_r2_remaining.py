@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""The round-2 rows the first live session could not land.
+
+The 2026-07-31 relay session measured the forward-mode MLP A/B trio and
+the ctx=1024 decode rows (BASELINE.md round-4 section), then lost the
+long-context decode rows to the full-score-matrix oracle OOM (fixed:
+``_oracle_attention`` q-chunking, models/decode.py) and the tail of the
+batch to a relay flap. This script reruns exactly the missing rows so
+the next session doesn't repeat the ~15 minutes of already-banked
+measurements.
+
+Usage:  python scripts/measure_r2_remaining.py [--quick]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hw_common import run_isolated
+
+QUICK = "--quick" in sys.argv[1:]
+
+PROTO = {
+    "dtype": "bfloat16",
+    "num_iterations": 8,
+    "num_warmups": 2,
+    "validate": True,
+    "time_measurement_backend": "device_loop",
+    "device_loop_windows": 4 if QUICK else 8,
+    "barrier_at_each_iteration": False,
+}
+
+
+def run(primitive, impl, m, n, k, **options):
+    row = run_isolated(
+        {
+            "primitive": primitive,
+            "impl_id": f"{impl}_hw",
+            "base_implementation": impl,
+            "options": options,
+            "m": m,
+            "n": n,
+            "k": k,
+            **PROTO,
+        }
+    )
+    t = row["median time (ms)"]
+    print(
+        f"{primitive:18s} {impl:10s} m={m:<6d} {options} -> "
+        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
+        f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
+        f"err={row['error'] or '-'}",
+        flush=True,
+    )
+    return row
+
+
+SERVE = dict(batch=8, vocab=16384, n_heads=16)
+for ctx in (4096,) if QUICK else (4096, 8192):
+    for mlp in ("bf16", "int8_weights"):
+        run(
+            "transformer_decode", "spmd", ctx, 2048, 8192,
+            phase="decode", mlp_kernel=mlp, **SERVE,
+        )
+run("transformer_decode", "spmd", 1024, 2048, 8192, phase="prefill", **SERVE)
+
+run("ep_alltoall", "jax_spmd", 8192, 8192, 8192)
+run("ep_alltoall", "quantized", 8192, 8192, 8192, quantize="static")

@@ -16,11 +16,13 @@ while true; do
     out=$(timeout 90 python -c "$PROBE" 2>&1)
     if echo "$out" | grep -q "PROBE_OK tpu"; then
         echo "[$ts] relay UP: $out"
-        echo "[$ts] running measure_r2_hw.py..."
-        timeout 3600 python scripts/measure_r2_hw.py \
-            > hwlogs/measure_r2_hw.out 2> hwlogs/measure_r2_hw.err
+        # the 2026-07-31 session already banked the r2 MLP A/B and
+        # ctx=1024 decode rows; only the remainder is still owed
+        echo "[$ts] running measure_r2_remaining.py..."
+        timeout 3600 python scripts/measure_r2_remaining.py \
+            > hwlogs/measure_r2_remaining.out 2> hwlogs/measure_r2_remaining.err
         rc_hw=$?
-        echo "[$ts] measure_r2_hw rc=$rc_hw"
+        echo "[$ts] measure_r2_remaining rc=$rc_hw"
         ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
         echo "[$ts] running measure_r3_hw.py..."
         timeout 5400 python scripts/measure_r3_hw.py \
