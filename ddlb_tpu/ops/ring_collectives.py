@@ -1,0 +1,330 @@
+"""Pure ring collectives as single Pallas programs (RDMA only, no GEMM).
+
+The communication half of ``ops/collective_matmul.py`` factored out: the
+same double-buffered credit-semaphore ring protocol (pallas_guide.md
+"Patterns: Ring Collectives" + "Async Remote DMA"), but the payload is
+copied/accumulated instead of feeding an MXU pipeline. These kernels
+exist so the collectives family can measure the hand-driven ICI path
+against XLA's lowered collectives with zero compute in the way — the
+kernel-level member of the pure-wire benchmark, the role nvFuser's
+executor plays for the reference's fused primitives
+(/root/reference/ddlb/primitives/TPColumnwise/fuser.py:102-146).
+
+Both kernels run inside ``shard_map`` over a 1-D ``axis_name`` ring of d
+devices, and degrade gracefully to d=1 (self-copy). The ring buffer
+rides as an input/output-aliased pair because this toolchain cannot
+allocate HBM scratch directly (same note as collective_matmul.py).
+
+Protocol recap (see _ag_matmul_kernel for the original):
+
+- two HBM slots per device; slot t%2 holds the chunk being processed at
+  step t while the RDMA forwarding it to the right neighbor's slot
+  (t+1)%2 is in flight
+- a REGULAR credit semaphore gates sends: the right neighbor signals
+  when the target slot is free, preventing the step-t send from landing
+  on a buffer still being read for step t-1
+- a neighbor barrier before the first RDMA ensures every buffer is
+  seeded before anyone writes remotely
+
+Interpreter envelope: the distributed Pallas interpreter emulates the
+d-device ring in host threads, and at d=8 it livelocks once the
+per-hop RDMA payload grows past ~12 KB when there is no compute
+between a send and the matching wait (d<=4 handles 64 KB hops fine,
+and the fused kernels — which always have a GEMM in that window — pass
+at 32 KB hops; measured 2026-07-31 on the 8-device CPU sim). Protocol
+correctness is pinned at d in {2,4,8} on small shards with
+``detect_races=True``; realistic payloads are a hardware-only
+measurement, like every other kernel in ops/.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ddlb_tpu.ops.collective_matmul import _neighbor_barrier
+
+
+def _ring_ag_kernel(
+    a_hbm, buf_in, o_hbm, comm_buf, send_sem, recv_sem, copy_sem,
+    credit_sem,
+    *, axis_name: str, d: int, interpret: bool = False,
+):
+    del buf_in  # aliased with comm_buf
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my - 1 + d, d)
+    m_loc = a_hbm.shape[0]
+
+    # seed slot 0 with the local shard; barrier so every neighbor's
+    # buffer exists before any remote write
+    cp = pltpu.make_async_copy(a_hbm, comm_buf.at[0], copy_sem)
+    cp.start()
+    cp.wait()
+    _neighbor_barrier(axis_name, d)
+
+    def step(t, _):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
+
+        @pl.when(t < d - 1)
+        def _send():
+            @pl.when(t >= 1)
+            def _credit_gate():
+                pltpu.semaphore_wait(credit_sem, 1)
+
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nxt],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        # while the forward flies, land the chunk we hold in its output
+        # rows (chunk (my - t) mod d, same schedule as the AG+GEMM ring)
+        chunk = jax.lax.rem(my - t + d, d)
+        if interpret:
+            # the interpreter cannot DMA into a dynamically sliced ref;
+            # it CAN read/write refs wholesale (same note as the fused
+            # ring's _gemm_pipeline)
+            o_hbm[pl.ds(chunk * m_loc, m_loc), :] = comm_buf[slot]
+        else:
+            ocp = pltpu.make_async_copy(
+                comm_buf.at[slot],
+                o_hbm.at[pl.ds(chunk * m_loc, m_loc), :],
+                copy_sem,
+            )
+            ocp.start()
+            ocp.wait()
+
+        @pl.when(t < d - 1)
+        def _wait():
+            pltpu.make_async_copy(
+                comm_buf.at[nxt], comm_buf.at[nxt], recv_sem.at[nxt]
+            ).wait()
+            pltpu.make_async_copy(
+                comm_buf.at[slot], comm_buf.at[slot], send_sem.at[slot]
+            ).wait()
+            pltpu.semaphore_signal(
+                credit_sem,
+                inc=1,
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        return 0
+
+    jax.lax.fori_loop(0, d, step, 0)
+    if d >= 2:
+        # one credit is produced but never consumed (the last send needs
+        # no gate)
+        pltpu.semaphore_wait(credit_sem, 1)
+
+
+def ring_all_gather(
+    a_shard,
+    *,
+    axis_name: str = "tp",
+    axis_size: int,
+    interpret: bool = False,
+    collective_id: int = 5,
+):
+    """Ring all-gather: ``a_shard [m/d, k]`` -> ``[m, k]`` on every device.
+
+    Call inside ``shard_map``.
+    """
+    m_loc, k = a_shard.shape
+    space = pltpu.VMEM if interpret else pltpu.ANY
+    kernel = functools.partial(
+        _ring_ag_kernel, axis_name=axis_name, d=axis_size,
+        interpret=bool(interpret),
+    )
+    buf_init = jnp.zeros((2, m_loc, k), a_shard.dtype)
+    out, _ = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((m_loc * axis_size, k), a_shard.dtype),
+            jax.ShapeDtypeStruct((2, m_loc, k), a_shard.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ),
+        input_output_aliases={1: 1},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),   # send
+            pltpu.SemaphoreType.DMA((2,)),   # recv
+            pltpu.SemaphoreType.DMA,         # seed + output copies
+            pltpu.SemaphoreType.REGULAR,     # buffer-free credits
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(a_shard, buf_init)
+    return out
+
+
+def _ring_rs_kernel(
+    a_hbm, acc_in, o_hbm, acc_buf, send_sem, recv_sem, copy_sem,
+    credit_sem,
+    *, axis_name: str, d: int, bn: int, interpret: bool = False,
+):
+    del acc_in  # aliased with acc_buf
+    my = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(my + 1, d)
+    left = jax.lax.rem(my - 1 + d, d)
+    m_loc, k = a_hbm.shape
+    rows = m_loc // d
+
+    _neighbor_barrier(axis_name, d)
+
+    def step(t, _):
+        slot = jax.lax.rem(t, 2)
+        nxt = jax.lax.rem(t + 1, 2)
+        # after d steps each device's accumulator holds its own chunk,
+        # fully reduced (same schedule as the GEMM+RS ring)
+        chunk = jax.lax.rem(my + d - 1 - t, d)
+        a_chunk = a_hbm.at[pl.ds(chunk * rows, rows), :]
+
+        # retire the previous send and free the left neighbor's buffer
+        @pl.when(t >= 1)
+        def _retire():
+            pltpu.make_async_copy(
+                acc_buf.at[nxt], acc_buf.at[nxt], send_sem.at[nxt]
+            ).wait()
+            pltpu.semaphore_signal(
+                credit_sem,
+                inc=1,
+                device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        # the travelling partial for this step has landed in acc_buf[slot]
+        @pl.when(t >= 1)
+        def _recv():
+            pltpu.make_async_copy(
+                acc_buf.at[slot], acc_buf.at[slot], recv_sem.at[slot]
+            ).wait()
+
+        # fold our chunk's rows into it (first step initializes)
+        if interpret:
+            acc_buf[slot] = jnp.where(
+                t == 0, a_chunk[...], a_chunk[...] + acc_buf[slot]
+            )
+        else:
+
+            def add_body(a_ref, acc_ref, o_ref):
+                @pl.when(t == 0)
+                def _init():
+                    o_ref[:] = a_ref[:]
+
+                @pl.when(t > 0)
+                def _add():
+                    o_ref[:] = a_ref[:] + acc_ref[:]
+
+            pltpu.emit_pipeline(
+                add_body,
+                grid=(k // bn,),
+                in_specs=[
+                    pl.BlockSpec((rows, bn), lambda j: (0, j)),
+                    pl.BlockSpec((rows, bn), lambda j: (0, j)),
+                ],
+                out_specs=[pl.BlockSpec((rows, bn), lambda j: (0, j))],
+            )(a_chunk, acc_buf.at[slot], acc_buf.at[slot])
+
+        @pl.when(t < d - 1)
+        def _send():
+            @pl.when(t >= 1)
+            def _credit_gate():
+                pltpu.semaphore_wait(credit_sem, 1)
+
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=acc_buf.at[slot],
+                dst_ref=acc_buf.at[nxt],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nxt],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+
+        @pl.when(t == d - 1)
+        def _flush():
+            cp = pltpu.make_async_copy(acc_buf.at[slot], o_hbm, copy_sem)
+            cp.start()
+            cp.wait()
+
+        return 0
+
+    jax.lax.fori_loop(0, d, step, 0)
+    if d >= 2:
+        pltpu.semaphore_wait(credit_sem, 1)
+
+
+def ring_reduce_scatter(
+    a_local,
+    *,
+    axis_name: str = "tp",
+    axis_size: int,
+    block_n: int = 512,
+    interpret: bool = False,
+    collective_id: int = 6,
+):
+    """Ring reduce-scatter: ``a_local [m/d, k]`` viewed as d chunks
+    ``[m/d^2, k]``; chunk j summed across devices lands on device j ->
+    ``[m/d^2, k]``. Call inside ``shard_map``.
+    """
+    m_loc, k = a_local.shape
+    if m_loc % axis_size:
+        raise ValueError(
+            f"local rows {m_loc} not divisible by axis_size={axis_size}"
+        )
+    rows = m_loc // axis_size
+    bn = min(block_n, k)
+    if k % bn:
+        raise ValueError(f"k={k} not divisible by block {bn}")
+    space = pltpu.VMEM if interpret else pltpu.ANY
+    kernel = functools.partial(
+        _ring_rs_kernel, axis_name=axis_name, d=axis_size, bn=bn,
+        interpret=bool(interpret),
+    )
+    acc_init = jnp.zeros((2, rows, k), a_local.dtype)
+    out, _ = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, k), a_local.dtype),
+            jax.ShapeDtypeStruct((2, rows, k), a_local.dtype),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=space),
+            pl.BlockSpec(memory_space=space),
+        ),
+        input_output_aliases={1: 1},
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),   # send
+            pltpu.SemaphoreType.DMA((2,)),   # recv
+            pltpu.SemaphoreType.DMA,         # output flush
+            pltpu.SemaphoreType.REGULAR,     # buffer-free credits
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id
+        ),
+        interpret=interpret,
+    )(a_local, acc_init)
+    return out

@@ -1,0 +1,36 @@
+"""Pure-collective microbenchmark family (lazy re-exports).
+
+Lazy member loading mirrors the reference's module ``__getattr__``
+pattern (/root/reference/ddlb/primitives/TPColumnwise/__init__.py:28-39).
+"""
+
+_EXPORTS = {
+    "Collectives": ("ddlb_tpu.primitives.collectives.base", "Collectives"),
+    "JaxSPMDCollectives": (
+        "ddlb_tpu.primitives.collectives.jax_spmd",
+        "JaxSPMDCollectives",
+    ),
+    "XLAGSPMDCollectives": (
+        "ddlb_tpu.primitives.collectives.xla_gspmd",
+        "XLAGSPMDCollectives",
+    ),
+    "PallasCollectives": (
+        "ddlb_tpu.primitives.collectives.pallas_impl",
+        "PallasCollectives",
+    ),
+    "ComputeOnlyCollectives": (
+        "ddlb_tpu.primitives.collectives.compute_only",
+        "ComputeOnlyCollectives",
+    ),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name not in _EXPORTS:
+        raise AttributeError(name)
+    module_name, attr = _EXPORTS[name]
+    return getattr(importlib.import_module(module_name), attr)

@@ -1,0 +1,170 @@
+"""Collectives: the pure communication microbenchmark family.
+
+No reference analogue — the reference measures collectives only *through*
+GEMM fusion (AG+GEMM, GEMM+RS; /root/reference/ddlb/primitives/), so the
+communication term can never be read off on its own. This family isolates
+it: one collective op per row, timed under the same runner/validation
+contract as every other family, the nccl-tests role in this framework's
+vocabulary. Together with the fused families it closes the measurement
+triangle: compute roofline (compute_only GEMMs), pure wire (this family),
+and fused overlap (tp_*/dp/ep overlap + pallas members).
+
+Payload: operand ``a`` ``[m, k]`` (``n`` is unused — collectives have no
+second operand; keep ``n`` small in configs). The global array is row-
+sharded ``[m/d, k]`` per device over the 1-D ``tp`` mesh and each op's
+result is defined on the SAME global-array model the rest of the
+framework uses:
+
+- ``all_gather``:      shards -> the full ``[m, k]`` replicated.
+- ``all_reduce``:      elementwise sum of the d row-shards, ``[m/d, k]``
+                       replicated (each shard is a distinct summand — the
+                       global array IS the stack of summands).
+- ``reduce_scatter``:  each shard viewed as d chunks ``[m/d^2, k]``;
+                       chunk j summed across devices lands on device j ->
+                       global ``[m/d, k]`` row-sharded.
+- ``all_to_all``:      block transpose: device i's chunk j becomes device
+                       j's chunk i -> global ``[m, k]`` row-sharded.
+- ``ppermute``:        ring shift: device i's shard moves to device i+1 ->
+                       the globally rolled ``[m, k]``, row-sharded.
+
+Metric: the shared result-row schema computes ``flop_count/1e9/time_ms``
+into the "Throughput (TFLOPS)" column (reference TFLOPS formula,
+/root/reference/ddlb/benchmark.py:209-214). This family's ``flops()``
+returns ``1000 * wire_bytes()`` so that the SAME formula lands on
+**per-device ring wire traffic in GB/s** — the busbw convention of
+nccl-tests, restated for a ring: the bytes one device must inject into
+the ICI under a ring algorithm, divided by the measured time. Rows from
+this family therefore read the Throughput column in GB/s, stated here
+and in the docs rather than silently.
+
+Validation: pure data movement (ag / a2a / ppermute) must round-trip the
+seeded operand exactly; reductions sum d terms, so the tolerance scales
+with d (not with k, which a GEMM's atol rule reflects but a sum over
+devices does not): ``atol = (1e-2 half / 1e-5 else) * d``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.base import Primitive, jnp_dtype
+
+COLLECTIVE_OPS = (
+    "all_gather",
+    "all_reduce",
+    "reduce_scatter",
+    "all_to_all",
+    "ppermute",
+)
+
+#: ops whose result is a pure permutation/copy of the input (exact) vs
+#: d-term reductions (tolerance scales with d)
+_EXACT_OPS = ("all_gather", "all_to_all", "ppermute")
+
+#: ops that chunk each shard into d sub-chunks, requiring m % d^2 == 0
+_CHUNKED_OPS = ("reduce_scatter", "all_to_all", "all_reduce")
+
+
+class Collectives(Primitive):
+    """ABC for pure-collective implementations."""
+
+    primitive_name = "collectives"
+
+    BASE_OPTIONS = {"op": "all_gather", "transport": "ici"}
+    BASE_ALLOWED = {"op": list(COLLECTIVE_OPS), "transport": ["ici", "dcn"]}
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.m % d != 0:
+            raise ValueError(f"m={self.m} must be divisible by partitions={d}")
+        if self.options["op"] in _CHUNKED_OPS and (self.m // d) % d != 0:
+            # the uniform ring/chunk constraint: every shard splits into d
+            # equal sub-chunks (also what psum_scatter tiled and the
+            # rs_ag decomposition of all_reduce need)
+            raise ValueError(
+                f"m={self.m} must be divisible by partitions^2={d * d} "
+                f"for op={self.options['op']}"
+            )
+
+    def _input_setup(self) -> None:
+        a_host, _ = self._host_operands()
+        self.a = self._device_put(a_host, P("tp", None))
+        self.b = None
+
+    @property
+    def _call_args(self):
+        return (self.a,)
+
+    def get_inputs(self):
+        return (self.a,)
+
+    # -- metric ---------------------------------------------------------------
+
+    def wire_bytes(self) -> float:
+        """Bytes one device sends over the interconnect under a ring
+        algorithm for this op (the busbw numerator)."""
+        d = self.num_partitions
+        isz = np.dtype(jnp_dtype(self.dtype)).itemsize
+        if self.dtype == "float64":
+            isz = 4  # device arrays are f32 unless x64 is enabled
+        shard = (self.m // d) * self.k * isz
+        if d == 1:
+            return 0.0
+        if self.options["op"] == "all_gather":
+            return shard * (d - 1)
+        if self.options["op"] == "reduce_scatter":
+            return (shard / d) * (d - 1)
+        if self.options["op"] == "all_reduce":
+            return 2.0 * (shard / d) * (d - 1)
+        if self.options["op"] == "all_to_all":
+            return (shard / d) * (d - 1)
+        return float(shard)  # ppermute: one hop
+
+    def flops(self) -> float:
+        # 1000 * bytes makes the shared TFLOPS formula
+        # (flops/1e9/time_ms) numerically equal per-device wire GB/s —
+        # see the module docstring; this family reports bandwidth, not
+        # FLOPs, and says so everywhere the number surfaces
+        return 1000.0 * self.wire_bytes()
+
+    # -- validation -----------------------------------------------------------
+
+    def _expected(self) -> np.ndarray:
+        """Host-computed expected GLOBAL result per the op table above."""
+        a_host, _ = self._host_operands()
+        a = a_host.astype(np.float32)
+        if self.dtype in ("float16", "bfloat16"):
+            # device arrays were rounded on placement; round the oracle
+            # identically so pure copies compare exactly
+            a = a.astype(jnp_dtype(self.dtype)).astype(np.float32)
+        d = self.num_partitions
+        op = self.options["op"]
+        if op == "all_gather":
+            return a
+        shards = a.reshape(d, self.m // d, self.k)
+        if op == "all_reduce":
+            return shards.sum(axis=0)
+        if op == "ppermute":
+            return np.roll(a, self.m // d, axis=0)
+        chunks = a.reshape(d, d, self.m // (d * d), self.k)
+        if op == "reduce_scatter":
+            # chunk j summed over devices, device j holds it
+            return chunks.sum(axis=0).reshape(self.m // d, self.k)
+        # all_to_all: block transpose
+        return chunks.swapaxes(0, 1).reshape(self.m, self.k)
+
+    def _atol(self) -> float:
+        if self.options["op"] in _EXACT_OPS:
+            return 1e-6
+        base = 1e-2 if self.dtype in ("float16", "bfloat16") else 1e-5
+        return base * self.num_partitions
+
+    def validate(self, result) -> bool:
+        if result is None:
+            return False
+        import jax
+
+        result = jax.block_until_ready(result)
+        return self._compare_global(result, self._expected(), atol=self._atol())

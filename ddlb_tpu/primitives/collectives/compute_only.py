@@ -1,0 +1,72 @@
+"""HBM-copy roofline for the collectives family.
+
+Role analogue of the reference's compute_only members
+(/root/reference/ddlb/primitives/TPColumnwise/compute_only.py:8-55): the
+no-communication bound. For a pure collective the local analogue of "the
+same work without the wire" is a device memory copy of the payload — ICI
+bandwidth rows from the other members read against this HBM ceiling the
+way GEMM members read against the MXU roofline.
+
+``size=sharded`` copies one device's ``[m/d, k]`` shard; ``unsharded``
+the full ``[m, k]`` payload. The Throughput column (GB/s for this
+family, base.py) counts the payload bytes once — the copy engine reads
+and writes them, so the raw HBM traffic is 2x the reported number;
+reported this way the row answers "how fast could a device even source
+this payload", the same question the other members' GB/s answers for
+the wire.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ddlb_tpu.primitives.collectives.base import Collectives
+from ddlb_tpu.primitives.base import jnp_dtype
+
+
+class ComputeOnlyCollectives(Collectives):
+    DEFAULT_OPTIONS = {"size": "sharded"}
+    ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def _check_shapes(self) -> None:
+        d = self.num_partitions
+        if self.m % d != 0:
+            raise ValueError(f"m={self.m} must be divisible by partitions={d}")
+
+    def _input_setup(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        a_host, _ = self._host_operands()
+        if self.options["size"] == "sharded":
+            a_host = a_host[: self.m // self.num_partitions]
+        device = self.runtime.local_devices[0]
+        self.a = jax.device_put(
+            jnp.asarray(a_host).astype(jnp_dtype(self.dtype)), device
+        )
+        self.b = None
+        # x + 0: a materialized device-to-device copy (jit cannot alias the
+        # donated-free input to the output, so the payload is read and a
+        # fresh buffer written)
+        self._fn = jax.jit(lambda x: x + 0)
+        jax.block_until_ready(self.a)
+
+    def wire_bytes(self) -> float:
+        isz = np.dtype(jnp_dtype(self.dtype)).itemsize
+        if self.dtype == "float64":
+            isz = 4
+        rows = (
+            self.m // self.num_partitions
+            if self.options["size"] == "sharded"
+            else self.m
+        )
+        return float(rows * self.k * isz)
+
+    def validate(self, result) -> bool:
+        import jax
+
+        result = jax.block_until_ready(result)
+        a = np.asarray(self.a, dtype=np.float32)
+        return bool(
+            np.allclose(np.asarray(result, np.float32), a, rtol=0.0, atol=0.0)
+        )

@@ -1,0 +1,71 @@
+"""Explicit collectives via ``shard_map`` — the baseline member.
+
+The pure-wire analogue of the reference's PyTorch implementations
+(explicit ``torch.distributed`` collectives,
+/root/reference/ddlb/primitives/TPColumnwise/pytorch.py:85-104): one
+``jax.lax`` collective per op, nothing else in the measured region.
+
+``strategy`` applies to ``all_reduce`` only and mirrors the dp_allreduce
+member's axis: ``psum`` (XLA's fused all-reduce) vs ``rs_ag`` (explicit
+bandwidth-optimal two-phase ring) — on a pure payload the two should
+measure identically if XLA's fusion is ring-optimal, which is exactly
+the kind of statement this family exists to test.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.primitives.collectives.base import Collectives
+
+
+class JaxSPMDCollectives(Collectives):
+    DEFAULT_OPTIONS = {"strategy": "psum"}
+    ALLOWED_VALUES = {"strategy": ["psum", "rs_ag"]}
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        op = self.options["op"]
+        strategy = self.options["strategy"]
+        d = self.num_partitions
+
+        def step(a_shard):
+            if op == "all_gather":
+                return jax.lax.all_gather(a_shard, "tp", axis=0, tiled=True)
+            if op == "all_reduce":
+                if strategy == "psum":
+                    return jax.lax.psum(a_shard, "tp")
+                part = jax.lax.psum_scatter(
+                    a_shard, "tp", scatter_dimension=0, tiled=True
+                )
+                return jax.lax.all_gather(part, "tp", axis=0, tiled=True)
+            if op == "reduce_scatter":
+                return jax.lax.psum_scatter(
+                    a_shard, "tp", scatter_dimension=0, tiled=True
+                )
+            if op == "all_to_all":
+                return jax.lax.all_to_all(
+                    a_shard, "tp", split_axis=0, concat_axis=0, tiled=True
+                )
+            # ppermute: shard i -> shard i+1 (the globally rolled array)
+            return jax.lax.ppermute(
+                a_shard, "tp", perm=[(i, (i + 1) % d) for i in range(d)]
+            )
+
+        out_specs = {
+            "all_gather": P(None, None),
+            "all_reduce": P(None, None),
+            "reduce_scatter": P("tp", None),
+            "all_to_all": P("tp", None),
+            "ppermute": P("tp", None),
+        }[op]
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None),),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        )

@@ -1,0 +1,81 @@
+"""Hand-driven ICI rings for the pure collectives (the kernel slot).
+
+The collectives member of the hand-tuned-native-kernel slot (SURVEY.md
+section 2.4 — the role nvFuser's P2P pipelines play for the reference's
+fused primitives): each supported op is ONE Pallas program circulating
+the payload with ``make_async_remote_copy`` (``ops/ring_collectives``):
+
+- ``all_gather``:     shard chunks ride the ring, landing in output rows
+- ``reduce_scatter``: travelling partial sums fold each device's chunk
+- ``all_reduce``:     the classic two-phase ring, reduce-scatter then
+                      all-gather, two kernels back to back
+
+Measuring these against jax_spmd's ``lax`` collectives answers whether a
+hand-driven ring can match XLA's lowered collectives with no compute to
+hide behind. Off-TPU both run under the distributed Pallas interpreter
+(``detect_races=True`` supported, same sanitizer wiring as the fused
+ring kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.ops.ring_collectives import ring_all_gather, ring_reduce_scatter
+from ddlb_tpu.primitives.collectives.base import Collectives
+
+
+class PallasCollectives(Collectives):
+    DEFAULT_OPTIONS = {"detect_races": False}
+    ALLOWED_VALUES = {
+        # the ring kernels cover the gather/reduce ops; a2a/ppermute stay
+        # with the lax members (their fused forms live in
+        # ops/alltoall_matmul.py)
+        "op": ["all_gather", "reduce_scatter", "all_reduce"],
+        "detect_races": [True, False],
+    }
+
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        op = self.options["op"]
+        d = self.num_partitions
+        on_tpu = self.runtime.platform == "tpu"
+        interpret = False
+        if not on_tpu:
+            from jax.experimental.pallas import tpu as pltpu
+
+            interpret = pltpu.InterpretParams(
+                detect_races=bool(self.options["detect_races"])
+            )
+
+        def step(a_shard):
+            if op == "all_gather":
+                return ring_all_gather(
+                    a_shard, axis_size=d, interpret=interpret
+                )
+            if op == "reduce_scatter":
+                return ring_reduce_scatter(
+                    a_shard, axis_size=d, interpret=interpret
+                )
+            # all_reduce: reduce-scatter then all-gather, the
+            # bandwidth-optimal ring decomposition
+            part = ring_reduce_scatter(
+                a_shard, axis_size=d, interpret=interpret
+            )
+            return ring_all_gather(part, axis_size=d, interpret=interpret)
+
+        out_specs = {
+            "all_gather": P(None, None),
+            "all_reduce": P(None, None),
+            "reduce_scatter": P("tp", None),
+        }[op]
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P("tp", None),),
+                out_specs=out_specs,
+                check_vma=False,
+            )
+        )

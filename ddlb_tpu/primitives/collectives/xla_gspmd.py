@@ -1,0 +1,61 @@
+"""Compiler-driven collectives: GSPMD infers each op from shardings.
+
+The pure-wire analogue of the reference's JAX comparator
+(/root/reference/ddlb/primitives/TPColumnwise/jax_tp.py:60-76): no
+explicit collective appears in the program — each op is written as the
+global-array computation whose input/output sharding pair forces GSPMD
+to emit it:
+
+- ``all_gather``:     identity, sharded in -> replicated out
+- ``all_reduce``:     sum over the shard-stacked axis, replicated out
+- ``reduce_scatter``: the same sum, row-sharded out
+- ``all_to_all``:     block transpose of the (device, chunk) axes with
+                      sharded in AND out
+- ``ppermute``:       global roll by one shard, sharded in and out
+
+Sweeping this member against jax_spmd measures GSPMD's collective
+lowering against the hand-placed ``lax`` ops — the compiler-vs-explicit
+question at zero compute, sharpened by the family's tunable XLA knobs
+(GSPMDOptionsMixin: latency-hiding scheduler, async fusion).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlb_tpu.primitives.collectives.base import Collectives
+from ddlb_tpu.primitives.xla_options import GSPMDOptionsMixin
+
+
+class XLAGSPMDCollectives(GSPMDOptionsMixin, Collectives):
+    def _input_setup(self) -> None:
+        super()._input_setup()
+        op = self.options["op"]
+        d = self.num_partitions
+        m, k = self.m, self.k
+        sharded = NamedSharding(self.mesh, P("tp", None))
+        replicated = NamedSharding(self.mesh, P(None, None))
+
+        if op == "all_gather":
+            fn, out = (lambda a: a + 0), replicated
+        elif op == "all_reduce":
+            fn = lambda a: a.reshape(d, m // d, k).sum(axis=0)
+            out = replicated
+        elif op == "reduce_scatter":
+            fn = lambda a: a.reshape(d, m // d, k).sum(axis=0)
+            out = sharded
+        elif op == "all_to_all":
+            fn = lambda a: (
+                a.reshape(d, d, m // (d * d), k)
+                .swapaxes(0, 1)
+                .reshape(m, k)
+            )
+            out = sharded
+        else:  # ppermute
+            fn = lambda a: jnp.roll(a, m // d, axis=0)
+            out = sharded
+
+        self._fn = self._gspmd_jit(
+            fn, in_shardings=(sharded,), out_shardings=out
+        )

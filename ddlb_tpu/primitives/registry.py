@@ -31,6 +31,7 @@ ALLOWED_PRIMITIVES = (
     "pp_pipeline",
     "transformer_step",
     "transformer_decode",
+    "collectives",
 )
 
 _REGISTRY = {
@@ -207,6 +208,28 @@ _REGISTRY = {
         "xla_gspmd": (
             "ddlb_tpu.primitives.transformer_decode.xla_gspmd",
             "XLAGSPMDTransformerDecode",
+        ),
+    },
+    # pure communication microbenchmark: no reference analogue (the
+    # reference measures collectives only through GEMM fusion); the
+    # nccl-tests role — NOTE this family's Throughput column reads in
+    # per-device wire GB/s (collectives/base.py flops() convention)
+    "collectives": {
+        "jax_spmd": (
+            "ddlb_tpu.primitives.collectives.jax_spmd",
+            "JaxSPMDCollectives",
+        ),
+        "xla_gspmd": (
+            "ddlb_tpu.primitives.collectives.xla_gspmd",
+            "XLAGSPMDCollectives",
+        ),
+        "pallas": (
+            "ddlb_tpu.primitives.collectives.pallas_impl",
+            "PallasCollectives",
+        ),
+        "compute_only": (
+            "ddlb_tpu.primitives.collectives.compute_only",
+            "ComputeOnlyCollectives",
         ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue

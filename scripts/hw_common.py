@@ -25,6 +25,50 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def proto(quick: bool, validate: bool = True) -> dict:
+    """The pinned measurement protocol every hardware batch shares
+    (BASELINE.md round-2 methodology: median of 8 device_loop windows,
+    4 under --quick)."""
+    return {
+        "dtype": "bfloat16",
+        "num_iterations": 8,
+        "num_warmups": 2,
+        "validate": validate,
+        "time_measurement_backend": "device_loop",
+        "device_loop_windows": 4 if quick else 8,
+        "barrier_at_each_iteration": False,
+    }
+
+
+def run_and_print(
+    base_proto, primitive, impl, m, n, k, label="", proto_overrides=None,
+    **options,
+):
+    """One isolated config + the batch scripts' shared summary line."""
+    row = run_isolated(
+        {
+            "primitive": primitive,
+            "impl_id": f"{impl}_hw",
+            "base_implementation": impl,
+            "options": options,
+            "m": m,
+            "n": n,
+            "k": k,
+            **base_proto,
+            **(proto_overrides or {}),
+        }
+    )
+    t = row["median time (ms)"]
+    print(
+        f"{primitive:18s} {impl:10s} m={m:<6d} {label or options} -> "
+        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
+        f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
+        f"err={row['error'] or '-'}",
+        flush=True,
+    )
+    return row
+
 _CHILD = """
 import json, sys
 sys.path.insert(0, {repo!r})

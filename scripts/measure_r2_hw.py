@@ -20,45 +20,15 @@ import sys
 # script dir is sys.path[0], so add the repo root for ddlb_tpu
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from hw_common import run_isolated
+import functools
+
+from hw_common import proto, run_and_print
 
 QUICK = "--quick" in sys.argv[1:]
 
-PROTO = {
-    "dtype": "bfloat16",
-    "num_iterations": 8,
-    "num_warmups": 2,
-    "validate": True,
-    "time_measurement_backend": "device_loop",
-    "device_loop_windows": 4 if QUICK else 8,
-    "barrier_at_each_iteration": False,
-}
-
-
-def run(primitive, impl, m, n, k, **options):
-    # one fresh process per config: a dozen in-process configs OOM the
-    # chip (see hw_common.py) and a wedged backend poisons the session
-    row = run_isolated(
-        {
-            "primitive": primitive,
-            "impl_id": f"{impl}_hw",
-            "base_implementation": impl,
-            "options": options,
-            "m": m,
-            "n": n,
-            "k": k,
-            **PROTO,
-        }
-    )
-    t = row["median time (ms)"]
-    print(
-        f"{primitive:18s} {impl:10s} m={m:<6d} {options} -> "
-        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
-        f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
-        f"err={row['error'] or '-'}",
-        flush=True,
-    )
-    return row
+# one fresh process per config: a dozen in-process configs OOM the
+# chip (see hw_common.py) and a wedged backend poisons the session
+run = functools.partial(run_and_print, proto(QUICK))
 
 
 MODEL = dict(batch=1, vocab=16384, n_heads=16, microbatches=1)

@@ -42,20 +42,13 @@ if SMOKE:
 
 import numpy as np
 
-from hw_common import run_isolated
+from hw_common import proto, run_isolated
 
 V5E_PEAK_BF16_TFLOPS = 197.0
 
-PROTO = {
-    "dtype": "bfloat16",
-    "num_iterations": 8,
-    "num_warmups": 2,
-    "validate": False,  # device-side f32 oracle is separately pinned; the
-    # large shapes here would grind a host oracle for hours
-    "time_measurement_backend": "device_loop",
-    "device_loop_windows": 4 if QUICK else 8,
-    "barrier_at_each_iteration": False,
-}
+# validate=False: the device-side f32 oracle is separately pinned; the
+# large shapes here would grind a host oracle for hours
+PROTO = proto(QUICK, validate=False)
 
 
 def run(primitive, impl, m, n, k, label="", proto_overrides=None, **options):

@@ -1,0 +1,155 @@
+"""Pure-collectives family on the 8-device CPU mesh.
+
+Every member x op is validated against the host-computed expected global
+result (collectives/base.py op table); the pallas member additionally
+runs its RDMA rings under the distributed interpreter with the race
+detector on, the same sanitizer bar as the fused ring kernels.
+"""
+
+import numpy as np
+import pytest
+
+from ddlb_tpu.primitives.registry import load_impl_class
+
+# m % d^2 == 0 for the chunked ops at d=8; k padded to lane width
+M, K = 512, 256
+N = 8  # unused by the family; small keeps host operand construction cheap
+# the pallas rings stay inside the distributed interpreter's envelope
+# (~12 KB per ring hop at d=8 — see ops/ring_collectives.py); protocol
+# correctness is what these pin, hardware measures real payloads
+M_RING, K_RING = 128, 128
+
+ALL_OPS = (
+    "all_gather",
+    "all_reduce",
+    "reduce_scatter",
+    "all_to_all",
+    "ppermute",
+)
+
+
+def _expected_shape(op, d):
+    return {
+        "all_gather": (M, K),
+        "all_reduce": (M // d, K),
+        "reduce_scatter": (M // d, K),
+        "all_to_all": (M, K),
+        "ppermute": (M, K),
+    }[op]
+
+
+@pytest.mark.parametrize("op", ALL_OPS)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_jax_spmd(op, dtype):
+    cls = load_impl_class("collectives", "jax_spmd")
+    impl = cls(M, N, K, dtype=dtype, op=op)
+    result = impl.run()
+    assert result.shape == _expected_shape(op, impl.num_partitions)
+    assert impl.validate(result)
+
+
+def test_jax_spmd_rs_ag_strategy():
+    cls = load_impl_class("collectives", "jax_spmd")
+    impl = cls(M, N, K, dtype="float32", op="all_reduce", strategy="rs_ag")
+    assert impl.validate(impl.run())
+
+
+@pytest.mark.parametrize("op", ALL_OPS)
+def test_xla_gspmd(op):
+    cls = load_impl_class("collectives", "xla_gspmd")
+    impl = cls(M, N, K, dtype="float32", op=op)
+    result = impl.run()
+    assert result.shape == _expected_shape(op, impl.num_partitions)
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("op", ["all_gather", "reduce_scatter", "all_reduce"])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_pallas_rings(op, dtype):
+    cls = load_impl_class("collectives", "pallas")
+    impl = cls(M_RING, N, K_RING, dtype=dtype, op=op)
+    result = impl.run()
+    assert result.shape == {
+        "all_gather": (M_RING, K_RING),
+        "all_reduce": (M_RING // impl.num_partitions, K_RING),
+        "reduce_scatter": (M_RING // impl.num_partitions, K_RING),
+    }[op]
+    assert impl.validate(result)
+
+
+@pytest.mark.parametrize("op", ["all_gather", "reduce_scatter"])
+def test_pallas_race_detector(op):
+    # the distributed interpreter checks the RDMA/semaphore protocol for
+    # data races — any race raises inside run()
+    cls = load_impl_class("collectives", "pallas")
+    impl = cls(M_RING, N, K_RING, dtype="float32", op=op, detect_races=True)
+    assert impl.validate(impl.run())
+
+
+@pytest.mark.parametrize("size", ["sharded", "unsharded"])
+def test_compute_only(size):
+    cls = load_impl_class("collectives", "compute_only")
+    impl = cls(M, N, K, dtype="float32", size=size)
+    result = impl.run()
+    assert impl.validate(result)
+    rows = M // 8 if size == "sharded" else M
+    assert result.shape == (rows, K)
+
+
+def test_wire_bytes_metric():
+    # the Throughput column must read per-device ring wire GB/s: flops()
+    # is 1000x the documented byte counts
+    cls = load_impl_class("collectives", "jax_spmd")
+    d = 8
+    shard_bytes = (M // d) * K * 4  # float32
+    expect = {
+        "all_gather": shard_bytes * (d - 1),
+        "reduce_scatter": shard_bytes / d * (d - 1),
+        "all_reduce": 2 * shard_bytes / d * (d - 1),
+        "all_to_all": shard_bytes / d * (d - 1),
+        "ppermute": shard_bytes,
+    }
+    for op, want in expect.items():
+        impl = cls(M, N, K, dtype="float32", op=op)
+        assert impl.wire_bytes() == pytest.approx(want), op
+        assert impl.flops() == pytest.approx(1000.0 * want), op
+
+
+def test_chunked_ops_reject_bad_m():
+    cls = load_impl_class("collectives", "jax_spmd")
+    with pytest.raises(ValueError, match="partitions\\^2"):
+        cls(8 * 9, N, K, dtype="float32", op="reduce_scatter")
+    with pytest.raises(ValueError, match="divisible by partitions"):
+        cls(12, N, K, dtype="float32", op="all_gather")
+
+
+def test_unknown_op_rejected():
+    cls = load_impl_class("collectives", "jax_spmd")
+    with pytest.raises(ValueError, match="op"):
+        cls(M, N, K, dtype="float32", op="broadcast")
+
+
+def test_runner_row():
+    # one config through the shared worker: the row schema carries the
+    # family and the Throughput column is finite (GB/s, not TFLOPS)
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(
+        {
+            "primitive": "collectives",
+            "impl_id": "jax_spmd_t",
+            "base_implementation": "jax_spmd",
+            "options": {"op": "all_gather"},
+            "m": M,
+            "n": N,
+            "k": K,
+            "dtype": "float32",
+            "num_iterations": 2,
+            "num_warmups": 1,
+            "validate": True,
+            "time_measurement_backend": "host_clock",
+            "barrier_at_each_iteration": False,
+        }
+    )
+    assert row["valid"], row["error"]
+    assert np.isfinite(row["Throughput (TFLOPS)"])
