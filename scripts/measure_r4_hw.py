@@ -182,6 +182,30 @@ if not SMOKE:
             window=w, block_q=1024, block_kv=1024,
         )
 
+# -- 1e) measured HBM-copy bandwidth (collectives compute_only) --------------
+# One chip cannot exercise the wire, but it CAN measure the HBM copy
+# roofline the collectives family reads its GB/s against — and this row
+# calibrates the ~819 GB/s spec number the serving bytes-model divides
+# by. Throughput column = payload GB/s (collectives/base.py convention);
+# the copy engine reads+writes, so raw HBM traffic is 2x the number.
+
+if not SMOKE:
+    for m_pay in (8192, 32768):
+        row = run(
+            "collectives", "compute_only", m_pay, 8, 8192,
+            label=f"hbm copy roofline {m_pay}x8192 bf16",
+            size="unsharded",
+            proto_overrides={"validate": True},
+        )
+        t_ms = row["median time (ms)"]
+        if np.isfinite(t_ms):
+            gb = m_pay * 8192 * 2 / 1e9
+            print(
+                f"    -> payload {gb:.2f} GB  copy GB/s "
+                f"{gb / (t_ms / 1e3):,.0f}  (raw HBM r+w ~2x)",
+                flush=True,
+            )
+
 # -- 2) compiled-vs-interpreted kernel parity (world=1 self-DMA) --------------
 
 print("== compiled vs interpreted kernel parity ==", flush=True)
