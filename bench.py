@@ -495,14 +495,25 @@ def worker_main() -> None:
         ]
         if platform == "tpu":
             # compiled Pallas only: interpret mode (CPU smoke) is orders of
-            # magnitude too slow to race
+            # magnitude too slow to race. A primed autotune cache (the r3
+            # hardware batch's tune=true rows) supplies measured-best
+            # blocks; otherwise the member defaults stand.
+            pallas_opts = {"algorithm": "xla_collective"}
+            try:
+                from ddlb_tpu.utils.autotune import cached_blocks
+
+                tuned = cached_blocks(
+                    "tp_columnwise_pallas_AG_before", m, n, k, "bfloat16"
+                )
+                if tuned:
+                    pallas_opts.update(
+                        block_m=tuned[0], block_n=tuned[1], block_k=tuned[2]
+                    )
+            except Exception:
+                pass
             candidates.insert(
                 0,
-                (
-                    "pallas",
-                    {"algorithm": "xla_collective"},
-                    "tp_columnwise_gemm_pallas",
-                ),
+                ("pallas", pallas_opts, "tp_columnwise_gemm_pallas"),
             )
 
     rows = []

@@ -44,7 +44,10 @@ class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
         opts = self.options
         d, g = self.num_partitions, self.group_tokens
         out_dtype = jnp_dtype(self.dtype)
-        gemm = self._make_int8_gemm(out_dtype, max_k=self.k)
+        # the expert GEMM runs on the m/d tokens landing on this device
+        gemm = self._make_int8_gemm(
+            out_dtype, max_k=self.k, gemm_m=self.m // self.num_partitions
+        )
 
         # expert weights pre-quantized per-column at init (weight role);
         # quantize_weight_stack treats the leading expert axis as a stack

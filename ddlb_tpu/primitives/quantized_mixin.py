@@ -23,6 +23,7 @@ class QuantizedGEMMMixin:
         "block_m": 1024,
         "block_n": 1024,
         "block_k": 1024,
+        "tune": False,
     }
     ALLOWED_VALUES = {
         "kernel": ["xla", "pallas"],
@@ -30,6 +31,7 @@ class QuantizedGEMMMixin:
         "block_m": (128, None),
         "block_n": (128, None),
         "block_k": (128, None),
+        "tune": [True, False],
     }
 
     def _check_quantized_options(self) -> None:
@@ -38,19 +40,35 @@ class QuantizedGEMMMixin:
                 "quantized implementation supports floating operand dtypes "
                 f"{QUANTIZABLE_DTYPES} only (got {self.dtype})"
             )
+        overridden = self._options_manager.overridden
         if self.options["kernel"] == "xla":
-            overridden = self._options_manager.overridden
-            dead = {"block_m", "block_n", "block_k"} & overridden
+            dead = {"block_m", "block_n", "block_k", "tune"} & overridden
             if dead:
                 raise ValueError(
                     f"Option(s) {sorted(dead)} have no effect with kernel='xla'"
                 )
+        from ddlb_tpu.utils.autotune import reject_block_override_with_tune
 
-    def _make_int8_gemm(self, out_dtype, *, max_k: int):
+        reject_block_override_with_tune(
+            self.options, self._options_manager.overridden
+        )
+
+    def _make_int8_gemm(self, out_dtype, *, max_k: int, gemm_m: int = 0):
         """The int8 GEMM callable for this member's options.
 
         ``max_k`` is the contraction length the kernel will actually see
-        (the local shard's for k-sharded layouts), bounding block_k.
+        (the local shard's for k-sharded layouts), bounding block_k;
+        ``gemm_m`` the row count it will actually see (ep_alltoall's
+        expert GEMM runs on the m/d tokens landing on this device, not
+        the global m; 0 = ``self.m``).
+
+        With ``tune=true`` the BARE kernel is autotuned over the shared
+        candidate grid on synthetic operands of exactly that local shape
+        — the blocks only affect the MXU-bound GEMM, not the member's
+        collective, so the bare-kernel winner is the member winner, and
+        a tuning pass is shared by every member whose local GEMM shape
+        matches (the cache key IS the local shape). The tuning operands
+        are only allocated on a cache miss.
         """
         if self.options["kernel"] != "pallas":
             def gemm(aq, bq, sa, sb):
@@ -58,11 +76,52 @@ class QuantizedGEMMMixin:
 
             return gemm
 
+        interpret = self.runtime.platform != "tpu"
+        gemm_m = gemm_m or self.m
+        bm = min(self.options["block_m"], gemm_m)
+        bn = min(self.options["block_n"], self.n)
+        bk = min(self.options["block_k"], max_k)
+        if self.options["tune"]:
+            from ddlb_tpu.utils.autotune import (
+                autotune,
+                cached_blocks,
+                gemm_block_candidates,
+            )
+
+            hit = cached_blocks(
+                "int8_matmul_pallas", gemm_m, self.n, max_k, self.dtype
+            )
+            if hit is not None:
+                bm, bn, bk = hit
+            else:
+                import jax
+                import jax.numpy as jnp
+
+                aq = jnp.ones((gemm_m, max_k), jnp.int8)
+                bq = jnp.ones((max_k, self.n), jnp.int8)
+                sa = jnp.ones((gemm_m, 1), jnp.float32)
+                sb = jnp.ones((1, self.n), jnp.float32)
+
+                def build(c):
+                    cbm, cbn, cbk = c
+                    fn = jax.jit(
+                        lambda a, b, s1, s2: int8_matmul_pallas(
+                            a, b, s1, s2, out_dtype=out_dtype,
+                            block_m=cbm, block_n=cbn, block_k=cbk,
+                            interpret=interpret,
+                        )
+                    )
+                    return fn, (aq, bq, sa, sb)
+
+                bm, bn, bk = autotune(
+                    "int8_matmul_pallas",
+                    gemm_m, self.n, max_k, self.dtype,
+                    list(gemm_block_candidates(gemm_m, self.n, max_k)),
+                    build,
+                )
+
         blocks = dict(
-            block_m=min(self.options["block_m"], self.m),
-            block_n=min(self.options["block_n"], self.n),
-            block_k=min(self.options["block_k"], max_k),
-            interpret=self.runtime.platform != "tpu",
+            block_m=bm, block_n=bn, block_k=bk, interpret=interpret,
         )
 
         def gemm(aq, bq, sa, sb):

@@ -36,6 +36,7 @@ class PallasTPColumnwise(TPColumnwise):
         "block_n": 1024,
         "block_k": 512,
         "detect_races": False,
+        "tune": False,
     }
     ALLOWED_VALUES = {
         "algorithm": ["xla_collective", "ring_rdma"],
@@ -44,6 +45,7 @@ class PallasTPColumnwise(TPColumnwise):
         "block_n": (128, None),
         "block_k": (128, None),
         "detect_races": [True, False],
+        "tune": [True, False],
     }
 
     def _check_shapes(self) -> None:
@@ -52,7 +54,7 @@ class PallasTPColumnwise(TPColumnwise):
         # sweep cannot record identical runs under distinct labels
         overridden = self._options_manager.overridden
         if self.options["algorithm"] == "ring_rdma":
-            dead = {"order", "block_m"} & overridden
+            dead = {"order", "block_m", "tune"} & overridden
         else:
             dead = {"detect_races"} & overridden
         if dead:
@@ -60,6 +62,9 @@ class PallasTPColumnwise(TPColumnwise):
                 f"Option(s) {sorted(dead)} have no effect with "
                 f"algorithm={self.options['algorithm']!r}"
             )
+        from ddlb_tpu.utils.autotune import reject_block_override_with_tune
+
+        reject_block_override_with_tune(self.options, overridden)
 
     def _input_setup(self) -> None:
         super()._input_setup()
@@ -87,28 +92,67 @@ class PallasTPColumnwise(TPColumnwise):
                 )
 
         else:
-            blocks = dict(
-                block_m=opts["block_m"],
-                block_n=opts["block_n"],
-                block_k=opts["block_k"],
-                interpret=not on_tpu,
-            )
 
-            if opts["order"] == "AG_before":
+            def build_fn(bm, bn, bk):
+                blocks = dict(
+                    block_m=bm, block_n=bn, block_k=bk,
+                    interpret=not on_tpu,
+                )
 
-                def step(a_shard, b):
-                    a_full = jax.lax.all_gather(
-                        a_shard, "tp", axis=0, tiled=True
+                if opts["order"] == "AG_before":
+
+                    def step(a_shard, b):
+                        a_full = jax.lax.all_gather(
+                            a_shard, "tp", axis=0, tiled=True
+                        )
+                        return matmul(a_full, b, **blocks)
+
+                else:
+
+                    def step(a_shard, b):
+                        partial = matmul(a_shard, b, **blocks)
+                        return jax.lax.all_gather(
+                            partial, "tp", axis=0, tiled=True
+                        )
+
+                return jax.jit(
+                    jax.shard_map(
+                        step,
+                        mesh=self.mesh,
+                        in_specs=(P("tp", None), P(None, None)),
+                        out_specs=P(None, None),
+                        check_vma=False,
                     )
-                    return matmul(a_full, b, **blocks)
+                )
 
-            else:
+            bm, bn, bk = opts["block_m"], opts["block_n"], opts["block_k"]
+            if opts["tune"]:
+                from ddlb_tpu.utils.autotune import (
+                    autotune,
+                    gemm_block_candidates,
+                )
 
-                def step(a_shard, b):
-                    partial = matmul(a_shard, b, **blocks)
-                    return jax.lax.all_gather(
-                        partial, "tp", axis=0, tiled=True
-                    )
+                # the GEMM sees the full m (AG_before) or the shard
+                # (AG_after); candidates must divide what it sees
+                m_seen = (
+                    self.m
+                    if opts["order"] == "AG_before"
+                    else self.m // self.num_partitions
+                )
+                bm, bn, bk = autotune(
+                    f"tp_columnwise_pallas_{opts['order']}",
+                    self.m, self.n, self.k, self.dtype,
+                    list(
+                        gemm_block_candidates(
+                            self.m, self.n, self.k, sharded_m=m_seen
+                        )
+                    ),
+                    lambda c: (build_fn(*c), (self.a, self.b)),
+                    partitions=self.num_partitions,
+                )
+
+            self._fn = build_fn(bm, bn, bk)
+            return
 
         self._fn = jax.jit(
             jax.shard_map(

@@ -28,6 +28,7 @@ class PallasTPRowwise(TPRowwise):
         "block_n": 1024,
         "block_k": 512,
         "detect_races": False,
+        "tune": False,
     }
     ALLOWED_VALUES = {
         "algorithm": ["xla_collective", "ring_rdma"],
@@ -35,13 +36,14 @@ class PallasTPRowwise(TPRowwise):
         "block_n": (128, None),
         "block_k": (128, None),
         "detect_races": [True, False],
+        "tune": [True, False],
     }
 
     def _check_shapes(self) -> None:
         super()._check_shapes()
         overridden = self._options_manager.overridden
         if self.options["algorithm"] == "ring_rdma":
-            dead = {"block_m"} & overridden
+            dead = {"block_m", "tune"} & overridden
         else:
             dead = {"detect_races"} & overridden
         if dead:
@@ -49,6 +51,9 @@ class PallasTPRowwise(TPRowwise):
                 f"Option(s) {sorted(dead)} have no effect with "
                 f"algorithm={self.options['algorithm']!r}"
             )
+        from ddlb_tpu.utils.autotune import reject_block_override_with_tune
+
+        reject_block_override_with_tune(self.options, overridden)
 
     def _input_setup(self) -> None:
         super()._input_setup()
@@ -76,18 +81,47 @@ class PallasTPRowwise(TPRowwise):
                 )
 
         else:
-            blocks = dict(
-                block_m=opts["block_m"],
-                block_n=opts["block_n"],
-                block_k=opts["block_k"],
-                interpret=not on_tpu,
-            )
 
-            def step(a_shard, b_shard):
-                partial = matmul(a_shard, b_shard, **blocks)
-                return jax.lax.psum_scatter(
-                    partial, "tp", scatter_dimension=0, tiled=True
+            def build_fn(bm, bn, bk):
+                blocks = dict(
+                    block_m=bm, block_n=bn, block_k=bk,
+                    interpret=not on_tpu,
                 )
+
+                def step(a_shard, b_shard):
+                    partial = matmul(a_shard, b_shard, **blocks)
+                    return jax.lax.psum_scatter(
+                        partial, "tp", scatter_dimension=0, tiled=True
+                    )
+
+                return jax.jit(
+                    jax.shard_map(
+                        step,
+                        mesh=self.mesh,
+                        in_specs=(P(None, "tp"), P("tp", None)),
+                        out_specs=P("tp", None),
+                        check_vma=False,
+                    )
+                )
+
+            bm, bn, bk = opts["block_m"], opts["block_n"], opts["block_k"]
+            if opts["tune"]:
+                from ddlb_tpu.utils.autotune import (
+                    autotune,
+                    gemm_block_candidates,
+                )
+
+                # the local GEMM contracts the k/d shard
+                kd = self.k // self.num_partitions
+                bm, bn, bk = autotune(
+                    "tp_rowwise_pallas",
+                    self.m, self.n, self.k, self.dtype,
+                    list(gemm_block_candidates(self.m, self.n, kd)),
+                    lambda c: (build_fn(*c), (self.a, self.b)),
+                    partitions=self.num_partitions,
+                )
+            self._fn = build_fn(bm, bn, bk)
+            return
 
         self._fn = jax.jit(
             jax.shard_map(

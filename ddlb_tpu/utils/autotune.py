@@ -1,0 +1,220 @@
+"""Block-size autotuner for the Pallas kernels, with a persistent cache.
+
+Rounds 2 and 3 established empirically that tile choice is worth real
+throughput on the v5e — (1024,1024,512) replaced the round-1 GEMM
+default for +16 TFLOPS at 8192^3, and the int8 kernel sat 8% behind
+XLA's GEMM pending a tile sweep (BASELINE.md). Each of those was a
+hand-run, hand-transcribed measurement session. This module makes the
+sweep a property of the framework instead: a member constructed with
+``tune=true`` measures a small candidate grid ONCE per
+(kernel, shape, dtype, device kind) and persists the winner, so later
+constructions — including bench.py and the sweep runner — reuse the
+tuned blocks for free.
+
+Design points:
+
+- The timer is the framework's own differential device loop
+  (``utils.timing.measure_device_loop``), so candidates are ranked by
+  the same methodology the benchmark reports — not a separate ad-hoc
+  clock that could disagree with the measured rows.
+- Candidates that fail to build or run (VMEM overflow, divisibility)
+  are skipped, mirroring how the hand sweeps treated them ("2048 fails
+  VMEM allocation" — BASELINE.md round-2 flash notes).
+- The cache is a committed-friendly JSON (default
+  ``autotune_cache.json`` at the repo root, override with
+  ``DDLB_TPU_AUTOTUNE_CACHE``) with provenance per entry, the same
+  pattern as ``bench_tpu_cache.json``: prime it on hardware once and
+  the tuned defaults survive relay outages.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO_DIR = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+DEFAULT_CACHE_PATH = os.path.join(_REPO_DIR, "autotune_cache.json")
+
+
+def cache_path() -> str:
+    return os.environ.get("DDLB_TPU_AUTOTUNE_CACHE", DEFAULT_CACHE_PATH)
+
+
+def _load_cache(path: str) -> Dict[str, Any]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def _save_cache(path: str, data: Dict[str, Any]) -> None:
+    """Best effort: a cache write failure must never fail the benchmark."""
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def make_key(
+    kernel: str, m: int, n: int, k: int, dtype: str, partitions: int = 1
+) -> str:
+    """Cache key. The device kind is appended so a cache primed on one
+    TPU generation is not silently applied to another, and the partition
+    count so a winner tuned against one mesh's local shapes (m/d, k/d)
+    is never reused on a different mesh where the same global shape
+    means a different local problem."""
+    import jax
+
+    dev = jax.devices()[0]
+    return (
+        f"{kernel}:{m}x{n}x{k}:{dtype}:d{partitions}"
+        f":{dev.platform}:{dev.device_kind}"
+    )
+
+
+def reject_block_override_with_tune(options, overridden) -> None:
+    """The one tune-vs-explicit-blocks rule, shared by every member that
+    exposes both (schema drift guard — see quantized_mixin docstring)."""
+    if options["tune"] and ({"block_m", "block_n", "block_k"} & overridden):
+        raise ValueError(
+            "tune=true picks the blocks; do not also set block_m/n/k"
+        )
+
+
+def cached_blocks(
+    kernel: str, m: int, n: int, k: int, dtype: str,
+    partitions: int = 1, path: Optional[str] = None,
+) -> Optional[Tuple[int, ...]]:
+    """The persisted winner for this key, or None — a read-only probe for
+    callers that want tuned blocks when a primed cache exists but must
+    not pay a tuning pass (bench.py) or even the tuning-operand
+    allocation (the quantized mixin's hit path)."""
+    hit = _load_cache(path or cache_path()).get(
+        make_key(kernel, m, n, k, dtype, partitions)
+    )
+    return tuple(hit["blocks"]) if hit else None
+
+
+def autotune(
+    kernel: str,
+    m: int,
+    n: int,
+    k: int,
+    dtype: str,
+    candidates: Sequence[Tuple[int, ...]],
+    build: Callable[[Tuple[int, ...]], Tuple[Callable, Tuple]],
+    *,
+    partitions: int = 1,
+    num_iterations: int = 4,
+    num_windows: int = 2,
+    min_window_s: float = 0.03,
+    path: Optional[str] = None,
+) -> Tuple[int, ...]:
+    """Return the best candidate for ``kernel`` at this shape/dtype.
+
+    ``build(candidate) -> (fn, args)`` constructs the measurable callable
+    (the member's own jitted step). Cached winners are returned without
+    re-measurement; otherwise every buildable candidate is timed with the
+    differential device loop and the median winner is persisted.
+    ``partitions`` keys the cache by mesh size — the local problem a
+    candidate was measured on must match the one it is reused for.
+    """
+    from ddlb_tpu.utils.timing import measure_device_loop
+
+    path = path or cache_path()
+    key = make_key(kernel, m, n, k, dtype, partitions)
+    cache = _load_cache(path)
+    hit = cache.get(key)
+    if hit and tuple(hit["blocks"]) in {tuple(c) for c in candidates}:
+        return tuple(hit["blocks"])
+
+    results = []
+    for cand in candidates:
+        try:
+            fn, args = build(tuple(cand))
+            times = measure_device_loop(
+                fn,
+                args,
+                num_iterations,
+                num_windows=num_windows,
+                min_window_s=min_window_s,
+            )
+            med = float(np.median(times))
+            if np.isfinite(med) and med > 0:
+                results.append((med, tuple(cand)))
+        except Exception as exc:  # unbuildable candidate (VMEM, shape)
+            print(
+                f"[ddlb_tpu] autotune: skipping {kernel} blocks {cand}: "
+                f"{type(exc).__name__}: {exc}",
+                flush=True,
+            )
+    if not results:
+        raise ValueError(
+            f"autotune: no candidate for {kernel} at {m}x{n}x{k} ({dtype}) "
+            f"could be built — tried {list(candidates)}"
+        )
+    results.sort()
+    best_ms, best = results[0]
+    cache = _load_cache(path)  # re-read: another process may have written
+    cache[key] = {
+        "blocks": list(best),
+        "median_ms": best_ms,
+        "tried": [
+            {"blocks": list(c), "median_ms": t} for t, c in results
+        ],
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    _save_cache(path, cache)
+    print(
+        f"[ddlb_tpu] autotune: {key} -> blocks {best} "
+        f"({best_ms:.3f} ms/iter over {len(results)} candidates)",
+        flush=True,
+    )
+    return best
+
+
+#: the curated tile list the rounds-2/3 hand sweeps explored
+#: (BASELINE.md) — deliberately small: every candidate pays a full XLA
+#: compile (~30 s at 8192^3 on the relay), so tuning time is bounded by
+#: the grid, and a full cartesian product would blow the per-config
+#: worker timeout
+_GEMM_TILE_GRID = (
+    (1024, 1024, 512),   # the round-2 retuned bf16 default
+    (1024, 1024, 1024),  # the int8 default
+    (512, 1024, 1024),
+    (1024, 512, 1024),
+    (2048, 1024, 512),
+    (512, 2048, 1024),
+    (512, 512, 1024),
+    (2048, 1024, 1024),  # needs a raised scoped-vmem limit at some
+                         # shapes; unbuildable candidates are skipped
+)
+
+
+def gemm_block_candidates(
+    m: int, n: int, k: int, *, sharded_m: int = 0
+) -> Iterable[Tuple[int, int, int]]:
+    """The curated GEMM tile grid, clamped to the shape and filtered by
+    divisibility. ``sharded_m``: the per-device m the kernel actually
+    sees (0 = use ``m``)."""
+    m_eff = sharded_m or m
+    seen = []
+    for bm, bn, bk in _GEMM_TILE_GRID:
+        cand = (min(bm, m_eff), min(bn, n), min(bk, k))
+        if m_eff % cand[0] or n % cand[1] or k % cand[2]:
+            continue
+        if cand not in seen:
+            seen.append(cand)
+    return seen
