@@ -122,12 +122,98 @@ def init_cache(
 
 
 def cache_specs(cfg: TransformerConfig) -> Dict[str, P]:
-    spec = P(None, "dp", None, "tp", None)
-    specs = {"k": spec, "v": spec}
+    if cfg.cache_layout == "paged":
+        # pool [L, P, ps, H_kv, dh]: pages shard over heads only (the
+        # pool is shared across the slot axis, which is why the paged
+        # serving engine requires dp == 1); the table is replicated
+        spec = P(None, None, None, "tp", None)
+        specs = {"k": spec, "v": spec, "table": P(None, None)}
+    else:
+        spec = P(None, "dp", None, "tp", None)
+        specs = {"k": spec, "v": spec}
     if cfg.kv_cache == "int8":
         specs["k_scale"] = spec
         specs["v_scale"] = spec
     return specs
+
+
+def init_paged_cache(
+    cfg: TransformerConfig,
+    batch: int,
+    max_len: int,
+    num_pages: int,
+    mesh=None,
+) -> Dict[str, jax.Array]:
+    """Paged K/V cache: pool ``[L, num_pages, page_size, H_kv, dh]`` plus
+    a per-slot page table ``[batch, max_len // page_size]`` of page ids.
+
+    The SENTINEL id ``num_pages`` marks an unmapped table entry: reads
+    through it yield zeros (``mode='fill'``) — indistinguishable from the
+    contiguous layout's zero-initialized rows — and writes through it
+    drop (``mode='drop'``), which is also how a parked lane (pos =
+    max_len) idles without corrupting anything, exactly the contiguous
+    ragged contract (ADVICE r3).
+    """
+    if max_len % cfg.page_size:
+        raise ValueError(
+            f"max_len={max_len} not divisible by page_size={cfg.page_size}"
+        )
+    max_pages = max_len // cfg.page_size
+    shape = (
+        cfg.layers_per_stage,
+        num_pages,
+        cfg.page_size,
+        cfg.kv_heads,
+        cfg.head_dim,
+    )
+    if cfg.kv_cache == "int8":
+        cache = {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+            "v_scale": jnp.zeros(shape[:-1] + (1,), jnp.float32),
+        }
+    elif cfg.kv_cache == "bf16":
+        cache = {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+    else:
+        raise ValueError(f"unknown kv_cache '{cfg.kv_cache}'")
+    cache["table"] = jnp.full((batch, max_pages), num_pages, jnp.int32)
+    if mesh is not None:
+        specs = cache_specs(cfg)
+        cache = {
+            name: jax.device_put(arr, NamedSharding(mesh, specs[name]))
+            for name, arr in cache.items()
+        }
+    return cache
+
+
+def _cache_max_len(cache) -> int:
+    """S_max of either layout (pages x page_size, or the row axis)."""
+    if "table" in cache:
+        return cache["table"].shape[1] * cache["k"].shape[2]
+    return cache["k"].shape[2]
+
+
+def _page_coords(cache, pos):
+    """Map absolute positions (scalar or ``[b]``) to ``(pages [b],
+    rows [b])`` through the table. Out-of-range positions and unmapped
+    table entries both resolve to the sentinel page id (OOB for the
+    pool), so downstream reads fill zeros and writes drop — the paged
+    form of the contiguous layout's drop-on-overflow contract."""
+    table = cache["table"]
+    num_pages = cache["k"].shape[1]
+    ps = cache["k"].shape[2]
+    b = table.shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    page_idx = pos // ps
+    oob = (page_idx < 0) | (page_idx >= table.shape[1])
+    safe = jnp.clip(page_idx, 0, table.shape[1] - 1)
+    pages = jnp.take_along_axis(table, safe[:, None], axis=1)[:, 0]
+    pages = jnp.where(oob, num_pages, pages)
+    return pages, pos % ps
 
 
 def _project_qkv(h, params, l, b, t, h_loc, kv_loc, dh, dtype):
@@ -184,9 +270,39 @@ def _cache_write(cache, l, pos, k, v, int8):
     batching form: sequence ``i``'s single new row lands at ``pos[i]``.
     """
     ragged = jnp.ndim(pos) == 1
+    paged = "table" in cache
 
     def upd(name, val):
-        if ragged:
+        if paged:
+            # rows land at (page, row) through the slot's table; sentinel
+            # coords (parked lane, unmapped page, overflow) drop — the
+            # same contract as the contiguous ragged branch below
+            b, t = val.shape[0], val.shape[1]
+            if t == 1:
+                pages, rows = _page_coords(cache, pos)  # [b], [b]
+                cache[name] = (
+                    cache[name]
+                    .at[l, pages, rows]
+                    .set(val[:, 0], mode="drop")
+                )
+            else:
+                # verify chunk: rows j at scalar start pos + j, batchwide
+                ps = cache["k"].shape[2]
+                num_pages = cache["k"].shape[1]
+                table = cache["table"]
+                rowpos = jnp.asarray(pos, jnp.int32) + jnp.arange(
+                    t, dtype=jnp.int32
+                )
+                page_idx = rowpos // ps                      # [t]
+                oob = page_idx >= table.shape[1]
+                safe = jnp.clip(page_idx, 0, table.shape[1] - 1)
+                pages = table[:, safe]                       # [b, t]
+                pages = jnp.where(oob[None, :], num_pages, pages)
+                rows = jnp.broadcast_to(rowpos % ps, (b, t))
+                cache[name] = (
+                    cache[name].at[l, pages, rows].set(val, mode="drop")
+                )
+        elif ragged:
             # val [b, 1, h_kv, dh] -> row i at (l, i, pos[i]). A position
             # past the cache is DROPPED (mode="drop"), not clamped: a
             # continuous-batching caller that overflows a sequence loses
@@ -218,12 +334,37 @@ def _cache_write(cache, l, pos, k, v, int8):
 
 
 def _cache_read(cache, name, l, dtype):
-    """Cache layer ``l``, dequantized in int8 mode. The convert+scale is
-    an elementwise producer XLA fuses into the consuming einsum, so HBM
-    still reads the int8 payload; rounding to ``dtype`` reproduces
-    ``_kv_roundtrip`` bit-for-bit — scale-folding into the scores instead
-    would introduce 1e-7 f32 skew that flips int8 round() buckets at the
-    NEXT layer's cache write (observed: 2e-3 logits drift at 2 layers)."""
+    """Cache layer ``l`` as the linear ``[B, S_max, H_kv, dh]`` view,
+    dequantized in int8 mode. The convert+scale is an elementwise
+    producer XLA fuses into the consuming einsum, so HBM still reads the
+    int8 payload; rounding to ``dtype`` reproduces ``_kv_roundtrip``
+    bit-for-bit — scale-folding into the scores instead would introduce
+    1e-7 f32 skew that flips int8 round() buckets at the NEXT layer's
+    cache write (observed: 2e-3 logits drift at 2 layers).
+
+    Paged layout: the view is assembled by gathering each slot's pages
+    (sentinel entries fill zeros — identical to the contiguous layout's
+    zero-initialized rows); this is the one extra HBM pass per decode
+    step that pages cost on the einsum path.
+    """
+    if "table" in cache:
+        table = cache["table"]                       # [B, max_pages]
+        b, mp = table.shape
+        ps = cache[name].shape[2]
+
+        def lin(arr):
+            pages = arr[l].at[table].get(
+                mode="fill", fill_value=0
+            )                                        # [B, mp, ps, ...]
+            return pages.reshape((b, mp * ps) + arr.shape[3:])
+
+        view = lin(cache[name])
+        scale = cache.get(f"{name}_scale")
+        if scale is None:
+            return view
+        return (
+            view.astype(jnp.float32) * lin(scale)
+        ).astype(dtype)
     arr = cache[name][l]
     scale = cache.get(f"{name}_scale")
     if scale is None:
@@ -243,7 +384,7 @@ def _cache_attend(q, cache, l, dh, pos, dtype, window: int = 0):
     to itself. ``window > 0`` additionally drops positions behind the
     sliding window."""
     b, t = q.shape[0], q.shape[1]
-    S_max = cache["k"].shape[2]
+    S_max = _cache_max_len(cache)
     s = _grouped_scores(q, _cache_read(cache, "k", l, dtype), dh)
     iota = jax.lax.broadcasted_iota(jnp.int32, (S_max,), 0)
     if t > 1:
@@ -435,6 +576,11 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
     if cfg.kv_heads % tp != 0:
         raise ValueError(
             f"n_kv_heads={cfg.kv_heads} not divisible by tp={tp}"
+        )
+    if cfg.cache_layout == "paged" and mesh.shape.get("dp", 1) != 1:
+        raise ValueError(
+            "cache_layout='paged' shares one page pool across the slot "
+            "axis and requires dp=1 (run one engine per dp shard)"
         )
     h_loc = cfg.n_heads // tp
     kv_loc = cfg.kv_heads // tp
@@ -812,7 +958,7 @@ def make_generate_fn(
         if temperature > 0.0 and key is None:
             raise ValueError("temperature > 0 sampling needs a PRNG key")
         B, S0 = prompt.shape
-        S_max = cache["k"].shape[2]
+        S_max = _cache_max_len(cache)
         if S0 + n_new > S_max:
             # OOB dynamic_update_slice CLAMPS: without this check later
             # steps would silently overwrite the last cache slot and

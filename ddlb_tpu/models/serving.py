@@ -29,6 +29,17 @@ Correctness contract (pinned in tests/test_serving_engine.py): every
 completed request's tokens equal the target model's own greedy chain for
 that prompt in that slot — the engine changes scheduling, never tokens.
 
+With ``cfg.cache_layout='paged'`` the big cache is a shared page pool
+indexed by a per-slot page table (models/decode.init_paged_cache — the
+vLLM pattern with static pool/table shapes): admissions allocate pages
+from a host-side free list, completions return them, and a mixed-length
+workload runs in a pool smaller than the contiguous layout's B x S_max
+(``num_pages`` engine knob; page pressure defers head-of-queue
+admissions FIFO-fairly). Full pages of the shared prefix are SHARED
+across same-expert slots instead of copied — table entries, not data.
+Tokens are identical to the contiguous engine by construction (pinned
+in tests/test_paged.py).
+
 Engine mesh is ``('dp', 'tp')`` with ``dp == 1`` (slot-level scheduling
 and data parallelism compose by running one engine per dp shard; the
 in-engine batch axis IS the slot axis).
@@ -36,6 +47,7 @@ in-engine batch axis IS the slot axis).
 
 from __future__ import annotations
 
+import dataclasses
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -47,6 +59,7 @@ import jax.numpy as jnp
 
 from ddlb_tpu.models.decode import (
     init_cache,
+    init_paged_cache,
     make_decode_fn,
     make_prefill_fn,
 )
@@ -94,6 +107,11 @@ class EngineStats:
     lane_ticks_total: int = 0
     prefix_hits: int = 0        # admissions served from the shared prefix
     prefill_tokens_saved: int = 0
+    # paged layout only: page-pool pressure
+    pages_capacity: int = 0
+    pages_in_use: int = 0       # current gauge (incl. shared prefix pages)
+    peak_pages_in_use: int = 0
+    admissions_deferred: int = 0  # head-of-queue waits for free pages
 
     @property
     def occupancy(self) -> float:
@@ -119,6 +137,7 @@ class ContinuousBatchingEngine:
         max_batch: int,
         max_len: int,
         eos_id: Optional[int] = None,
+        num_pages: Optional[int] = None,
     ):
         if mesh.shape.get("dp", 1) != 1:
             raise ValueError(
@@ -137,49 +156,112 @@ class ContinuousBatchingEngine:
         self.B = max_batch
         self.S_max = max_len
         self.eos_id = eos_id
+        self.paged = cfg.cache_layout == "paged"
+        # prefill/chunk run on small CONTIGUOUS scratch caches even in
+        # paged mode (a per-admission scratch has nothing to page);
+        # only the big shared cache and its ragged decode are paged
+        scratch_cfg = (
+            dataclasses.replace(cfg, cache_layout="contiguous")
+            if self.paged
+            else cfg
+        )
+        self._scratch_cfg = scratch_cfg
+        if self.paged:
+            ps = cfg.page_size
+            if max_len % ps:
+                raise ValueError(
+                    f"max_len={max_len} not divisible by page_size={ps}"
+                )
+            self.page_size = ps
+            self.max_pages = max_len // ps
+            # default pool = contiguous parity (B full-length slots);
+            # the interesting configs pass fewer — that is the feature
+            self.num_pages = (
+                num_pages if num_pages is not None else max_batch * self.max_pages
+            )
+            if self.num_pages < 1:
+                raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        elif num_pages is not None:
+            raise ValueError(
+                "num_pages only applies to cache_layout='paged'"
+            )
 
         from ddlb_tpu.models.decode import make_chunk_decode_fn
 
         decode, _ = make_decode_fn(mesh, cfg, ragged=True)
         self._decode = jax.jit(decode)
-        prefill, _ = make_prefill_fn(mesh, cfg)
+        prefill, _ = make_prefill_fn(mesh, scratch_cfg)
         self._prefill = jax.jit(prefill)
-        chunk, _ = make_chunk_decode_fn(mesh, cfg)
+        chunk, _ = make_chunk_decode_fn(mesh, scratch_cfg)
         self._chunk = jax.jit(chunk)
         # shared-prefix state (set_shared_prefix)
         self._prefix_tokens: Optional[np.ndarray] = None
         self._prefix_scratch = None
+        self._prefix_pages: List[int] = []
 
-        # slot copy: scratch-cache copy `c`'s rows [0, S0) into slot `s`
-        # of the big cache. slot/copy are DYNAMIC scalars so only the
-        # prompt length drives compiles (same cadence as the prefill);
-        # heads shard identically on both sides, so the copy is local to
-        # every tp rank.
         from ddlb_tpu.models.decode import cache_specs
         from jax.sharding import PartitionSpec as P
 
-        cs = cache_specs(cfg)
+        cs = cache_specs(scratch_cfg)
+        self._table_sharding = None
 
-        def copy_body(big, small, slot, copy):
-            out = {}
-            for name in big:
-                row = jax.lax.dynamic_slice_in_dim(
-                    small[name], copy, 1, axis=1
-                )
-                out[name] = jax.lax.dynamic_update_slice(
-                    big[name], row, (0, slot, 0, 0, 0)
-                )
-            return out
-
-        self._copy_slot = jax.jit(
-            jax.shard_map(
-                copy_body,
-                mesh=mesh,
-                in_specs=(cs, cs, P(), P()),
-                out_specs=cs,
-                check_vma=False,
+        if self.paged:
+            big_cs = dict(cache_specs(cfg))
+            self._table_sharding = jax.sharding.NamedSharding(
+                mesh, big_cs.pop("table")
             )
-        )
+
+            # paged slot copy: scratch copy `c`'s rows [0, S0) scatter to
+            # (page, row) coords computed on the host from the slot's
+            # table (compile per S0, the prefill cadence; sentinel
+            # coords drop)
+            def copy_paged_body(big, small, pages, rows, copy):
+                out = dict(big)
+                for name in small:
+                    data = jax.lax.dynamic_slice_in_dim(
+                        small[name], copy, 1, axis=1
+                    )[:, 0]  # [L, S0, H_kv, dh]
+                    out[name] = (
+                        big[name].at[:, pages, rows].set(data, mode="drop")
+                    )
+                return out
+
+            self._copy_slot_paged = jax.jit(
+                jax.shard_map(
+                    copy_paged_body,
+                    mesh=mesh,
+                    in_specs=(big_cs, cs, P(), P(), P()),
+                    out_specs=big_cs,
+                    check_vma=False,
+                )
+            )
+        else:
+
+            # slot copy: scratch-cache copy `c`'s rows [0, S0) into slot
+            # `s` of the big cache. slot/copy are DYNAMIC scalars so only
+            # the prompt length drives compiles (same cadence as the
+            # prefill); heads shard identically on both sides, so the
+            # copy is local to every tp rank.
+            def copy_body(big, small, slot, copy):
+                out = {}
+                for name in big:
+                    row = jax.lax.dynamic_slice_in_dim(
+                        small[name], copy, 1, axis=1
+                    )
+                    out[name] = jax.lax.dynamic_update_slice(
+                        big[name], row, (0, slot, 0, 0, 0)
+                    )
+                return out
+
+            self._copy_slot = jax.jit(
+                jax.shard_map(
+                    copy_body,
+                    mesh=mesh,
+                    in_specs=(cs, cs, P(), P()),
+                    out_specs=cs,
+                    check_vma=False,
+                )
+            )
 
         # prefix seed: the shared-prefix scratch's rows [0, P) land at
         # the head of a fresh admission scratch (leading rows, static
@@ -210,8 +292,23 @@ class ContinuousBatchingEngine:
         """Return the engine to its just-constructed state (fresh cache,
         all lanes parked, queues/completions/stats cleared) WITHOUT
         rebuilding the jitted step functions — a benchmark loop re-runs
-        the same workload against compile-cached programs."""
-        self.cache = init_cache(self.cfg, self.B, self.S_max, mesh=self.mesh)
+        the same workload against compile-cached programs. A shared
+        prefix survives (like the jitted programs, it derives from
+        params); in paged mode its pool pages are re-seeded."""
+        if self.paged:
+            self.cache = init_paged_cache(
+                self.cfg, self.B, self.S_max, self.num_pages, mesh=self.mesh
+            )
+            self._free_pages = list(range(self.num_pages))
+            self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
+            self._table_np = np.full(
+                (self.B, self.max_pages), self.num_pages, np.int32
+            )
+            self._prefix_pages = []
+        else:
+            self.cache = init_cache(
+                self.cfg, self.B, self.S_max, mesh=self.mesh
+            )
         self.pos = np.full(self.B, self.S_max, np.int32)
         self.cur_tok = np.zeros(self.B, np.int32)
         self._slot_req = [None] * self.B
@@ -221,6 +318,11 @@ class ContinuousBatchingEngine:
         self._requests = []
         self.completions = []
         self.stats = EngineStats()
+        if self.paged:
+            self.stats.pages_capacity = self.num_pages
+            if self._prefix_tokens is not None:
+                # re-pin the surviving prefix into fresh pool pages
+                self._seed_prefix_pages()
 
     # -- scheduling --------------------------------------------------------
 
@@ -235,10 +337,95 @@ class ContinuousBatchingEngine:
                 f"prompt {S0} + max_new {request.max_new} exceeds "
                 f"max_len {self.S_max}"
             )
+        if self.paged:
+            # a request that could never fit the pool would spin run()
+            # forever (admit defers, step idles, the queue never drains):
+            # screen against the worst case — no prefix credit, since the
+            # prefix can be cleared while the request is queued — minus
+            # the pages the current prefix pins
+            worst = -(-(S0 + request.max_new) // self.page_size)
+            usable = self.num_pages - len(self._prefix_pages)
+            if worst > usable:
+                raise ValueError(
+                    f"request needs up to {worst} pages but the pool has "
+                    f"{usable} usable ({self.num_pages} total, "
+                    f"{len(self._prefix_pages)} pinned by the prefix)"
+                )
         idx = len(self._requests)
         self._requests.append(request)
         self._queue.append(idx)
         return idx
+
+    # -- paged-pool bookkeeping (host side) --------------------------------
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` pages off the free list, or None if short."""
+        if len(self._free_pages) < n:
+            return None
+        pages = [self._free_pages.pop() for _ in range(n)]
+        self._gauge_pages()
+        return pages
+
+    def _release_pages(self, pages: List[int]) -> None:
+        self._free_pages.extend(pages)
+        self._gauge_pages()
+
+    def _gauge_pages(self) -> None:
+        in_use = self.num_pages - len(self._free_pages)
+        self.stats.pages_in_use = in_use
+        self.stats.peak_pages_in_use = max(
+            self.stats.peak_pages_in_use, in_use
+        )
+
+    def _push_table(self) -> None:
+        self.cache["table"] = jax.device_put(
+            jnp.asarray(self._table_np), self._table_sharding
+        )
+
+    def _prefix_full_pages(self) -> int:
+        """Full pages covered by the shared prefix (the shareable part;
+        a partial trailing page is copied per admission, not shared)."""
+        if self._prefix_tokens is None:
+            return 0
+        return self._prefix_tokens.size // self.page_size
+
+    def _seed_prefix_pages(self) -> None:
+        """Pin the shared prefix's FULL pages into the pool, one page set
+        per expert: prefix K/V rows beyond layer 0 depend on the expert
+        the block router assigns, so slots share the page set of THEIR
+        expert (B/tp slots per set)."""
+        if self._prefix_pages:
+            self._release_pages(self._prefix_pages)
+            self._prefix_pages = []
+        self._prefix_pages_by_e = [[] for _ in range(self.tp)]
+        p_full = self._prefix_full_pages()
+        if p_full == 0:
+            return
+        ps = self.page_size
+        p_len = self._prefix_tokens.size
+        # capacity check BEFORE any allocation: a partial failure would
+        # leave earlier experts' pages pinned with no owner and a prefix
+        # that matches but cannot map
+        if self.tp * p_full > len(self._free_pages):
+            raise ValueError(
+                f"page pool too small for the shared prefix: need "
+                f"{p_full} pages x tp={self.tp}, have "
+                f"{len(self._free_pages)} free of {self.num_pages}"
+            )
+        for e in range(self.tp):
+            pages = self._alloc_pages(p_full)
+            assert pages is not None  # guaranteed by the check above
+            # scatter coords for every scratch row: full-page rows map to
+            # the allocated pages, the partial tail (re-copied per
+            # admission) to the sentinel (dropped)
+            pages_vec = np.full(p_len, self.num_pages, np.int32)
+            pages_vec[: p_full * ps] = np.repeat(pages, ps)
+            rows_vec = np.arange(p_len, dtype=np.int32) % ps
+            self._scatter_into_pool(
+                self._prefix_scratch, pages_vec, rows_vec, e
+            )
+            self._prefix_pages_by_e[e] = pages
+            self._prefix_pages.extend(pages)
 
     def set_shared_prefix(self, prefix) -> None:
         """Prefill a shared prompt prefix ONCE (e.g. a system prompt);
@@ -257,6 +444,10 @@ class ContinuousBatchingEngine:
         if prefix is None:
             self._prefix_tokens = None
             self._prefix_scratch = None
+            if self.paged and self._prefix_pages:
+                self._release_pages(self._prefix_pages)
+                self._prefix_pages = []
+                self._prefix_pages_by_e = [[] for _ in range(self.tp)]
             return
         prefix = np.asarray(prefix, np.int32)
         if prefix.ndim != 1 or prefix.size == 0:
@@ -264,22 +455,68 @@ class ContinuousBatchingEngine:
         rep = jnp.asarray(
             np.broadcast_to(prefix, (self.tp, prefix.size)).copy()
         )
-        scratch = init_cache(self.cfg, self.tp, prefix.size, mesh=self.mesh)
+        scratch = init_cache(
+            self._scratch_cfg, self.tp, prefix.size, mesh=self.mesh
+        )
         _, scratch = self._prefill(self.params, scratch, rep)
         self._prefix_tokens = prefix
         self._prefix_scratch = jax.block_until_ready(scratch)
+        if self.paged:
+            try:
+                self._seed_prefix_pages()
+            except Exception:
+                # stay consistent on failure: no half-set prefix (a match
+                # with no mapped pages would crash later admissions)
+                self._prefix_tokens = None
+                self._prefix_scratch = None
+                raise
 
     def _expert_of(self, slot: int) -> int:
         # the block router's per-sequence-stable assignment on a dp=1
         # shard: slot i -> expert i // (B / tp) (models/decode._block_moe)
         return slot // (self.B // self.tp)
 
+    def _prefix_match_len(self, req: Request) -> int:
+        """Length of the shared prefix if this prompt starts with it (and
+        has a non-empty suffix), else 0."""
+        if self._prefix_tokens is None:
+            return 0
+        p_len = self._prefix_tokens.size
+        if req.prompt.size > p_len and np.array_equal(
+            req.prompt[:p_len], self._prefix_tokens
+        ):
+            return p_len
+        return 0
+
+    def _pages_needed(self, req: Request) -> int:
+        """Fresh pages an admission must allocate (beyond shared prefix
+        pages): enough to hold prompt + every generated token. Allocated
+        up front — simpler than on-demand growth and it makes admission
+        the single capacity decision point."""
+        ps = self.page_size
+        total = -(-(req.prompt.size + req.max_new) // ps)
+        shared = 0
+        if self._prefix_match_len(req):
+            shared = self._prefix_full_pages()
+        return total - shared
+
     def admit_ready(self) -> int:
-        """Admit queued requests into free slots; returns count admitted."""
+        """Admit queued requests into free slots; returns count admitted.
+
+        Paged layout: admission is additionally gated on pool capacity.
+        The queue stays FIFO — a head request that does not fit DEFERS
+        (counted in ``admissions_deferred``) rather than being skipped,
+        so completion-order fairness is preserved under page pressure.
+        """
         n = 0
         for slot in range(self.B):
             if self._slot_req[slot] is not None or not self._queue:
                 continue
+            if self.paged:
+                head = self._requests[self._queue[0]]
+                if self._pages_needed(head) > len(self._free_pages):
+                    self.stats.admissions_deferred += 1
+                    break
             self._admit(slot, self._queue.popleft())
             n += 1
         return n
@@ -294,15 +531,8 @@ class ContinuousBatchingEngine:
         # chunk-decode only the suffix (O((S0-P)*S0) attention instead of
         # O(S0^2), and no prefix MLP/projection recompute).
         e = self._expert_of(slot)
-        P_len = 0
-        if self._prefix_tokens is not None:
-            P_len = self._prefix_tokens.size
-            if not (
-                S0 > P_len
-                and np.array_equal(req.prompt[:P_len], self._prefix_tokens)
-            ):
-                P_len = 0  # no match (or no suffix): full prefill path
-        scratch = init_cache(self.cfg, self.tp, S0, mesh=self.mesh)
+        P_len = self._prefix_match_len(req)
+        scratch = init_cache(self._scratch_cfg, self.tp, S0, mesh=self.mesh)
         if P_len:
             scratch = self._seed_prefix(scratch, self._prefix_scratch)
             suffix = jnp.asarray(
@@ -321,9 +551,12 @@ class ContinuousBatchingEngine:
                 np.broadcast_to(req.prompt, (self.tp, S0)).copy()
             )
             logits, scratch = self._prefill(self.params, scratch, prompt_rep)
-        self.cache = self._copy_slot(
-            self.cache, scratch, jnp.int32(slot), jnp.int32(e)
-        )
+        if self.paged:
+            self._map_slot_pages(slot, req, e, P_len, scratch)
+        else:
+            self.cache = self._copy_slot(
+                self.cache, scratch, jnp.int32(slot), jnp.int32(e)
+            )
         first = int(np.asarray(logits)[e].argmax())
         self.pos[slot] = S0
         self.cur_tok[slot] = first
@@ -334,6 +567,56 @@ class ContinuousBatchingEngine:
         self.stats.generated += 1  # the admission's first token
         # a request can finish at admission (max_new=1 or instant eos)
         self._maybe_finish(slot)
+
+    def _map_slot_pages(self, slot, req, e, P_len, scratch) -> None:
+        """Paged admission: build the slot's table row (shared prefix
+        pages for the full-prefix span, fresh pages for the rest), push
+        it, and scatter the scratch rows the slot OWNS — the shared span
+        maps to the sentinel so shared pages are never rewritten (they
+        already hold identical rows by construction)."""
+        S0 = req.prompt.size
+        ps = self.page_size
+        p_full = self._prefix_full_pages() if P_len else 0
+        # ONE capacity rule: the fresh-page count comes from the same
+        # _pages_needed the admit_ready gate used, so the two cannot
+        # drift into admit-then-abort
+        n_fresh = self._pages_needed(req)
+        total = n_fresh + p_full
+        fresh = self._alloc_pages(n_fresh)
+        # admit_ready gates on capacity; a direct _admit caller that
+        # overcommits must fail loudly, not corrupt the pool
+        if fresh is None:
+            raise RuntimeError(
+                f"page pool exhausted admitting slot {slot}: need "
+                f"{n_fresh}, free {len(self._free_pages)}"
+            )
+        row = np.full(self.max_pages, self.num_pages, np.int32)
+        if p_full:
+            row[:p_full] = self._prefix_pages_by_e[e]
+        row[p_full:total] = fresh
+        self._table_np[slot] = row
+        self._slot_pages[slot] = fresh
+        self._push_table()
+        # scatter coords for all S0 scratch rows; the shared span drops
+        pages_vec = np.full(S0, self.num_pages, np.int32)
+        rows_vec = np.arange(S0, dtype=np.int32) % ps
+        owned_rows = np.arange(p_full * ps, S0, dtype=np.int32)
+        pages_vec[owned_rows] = row[owned_rows // ps]
+        self._scatter_into_pool(scratch, pages_vec, rows_vec, e)
+
+    def _scatter_into_pool(self, scratch, pages_vec, rows_vec, e) -> None:
+        """Run the jitted pool scatter; the table rides outside it (it is
+        host-managed state, not part of the copy's pytree)."""
+        pool = {k: v for k, v in self.cache.items() if k != "table"}
+        pool = self._copy_slot_paged(
+            pool,
+            scratch,
+            jnp.asarray(pages_vec),
+            jnp.asarray(rows_vec),
+            jnp.int32(e),
+        )
+        pool["table"] = self.cache["table"]
+        self.cache = pool
 
     def _maybe_finish(self, slot: int) -> None:
         req_idx = self._slot_req[slot]
@@ -360,6 +643,13 @@ class ContinuousBatchingEngine:
         self._slot_new[slot] = []
         self.pos[slot] = self.S_max          # park: writes drop, lane idles
         self.cur_tok[slot] = 0
+        if self.paged:
+            # unmap before the pages are reused: the parked lane's reads
+            # must see zeros, not a later tenant's rows
+            self._table_np[slot] = self.num_pages
+            self._push_table()
+            self._release_pages(self._slot_pages[slot])
+            self._slot_pages[slot] = []
 
     # -- the tick ----------------------------------------------------------
 

@@ -126,6 +126,20 @@ class TransformerConfig:
     #: bandwidth-bound decode step re-reads every token, dequantized on
     #: the fly inside the score/value einsums. Training paths ignore it.
     kv_cache: str = "bf16"
+    #: cache memory layout for the serving engine (models/decode.py):
+    #: "contiguous" — per-slot [B, S_max] rows, the benchmark members'
+    #: layout. "paged" — a shared pool of fixed-size pages indexed by a
+    #: per-slot page table (the vLLM pattern, TPU-first: static pool and
+    #: table shapes, gather/scatter by page id). Pages let a mixed-length
+    #: workload share HBM that a contiguous layout strands at B*S_max,
+    #: and full prefix pages are shared across slots instead of copied.
+    #: Decode-step cost: the einsum path gathers the linear cache view
+    #: per step (one extra HBM pass over live pages vs contiguous).
+    #: Serving-engine paths only; decode_kernel='pallas' requires the
+    #: contiguous layout.
+    cache_layout: str = "contiguous"
+    #: tokens per page under cache_layout='paged'
+    page_size: int = 128
     dtype: Any = jnp.float32
 
     def __post_init__(self):
@@ -136,6 +150,21 @@ class TransformerConfig:
             raise ValueError(
                 f"attn_window must be >= 0, got {self.attn_window}"
             )
+        if self.cache_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"unknown cache_layout '{self.cache_layout}'"
+            )
+        if self.cache_layout == "paged":
+            if self.page_size < 1:
+                raise ValueError(
+                    f"page_size must be >= 1, got {self.page_size}"
+                )
+            if self.decode_kernel == "pallas":
+                raise ValueError(
+                    "decode_kernel='pallas' reads the contiguous cache "
+                    "layout; use cache_layout='contiguous' (or the "
+                    "einsum decode kernel with pages)"
+                )
 
     @property
     def head_dim(self) -> int:

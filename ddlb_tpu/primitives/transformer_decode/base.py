@@ -78,6 +78,13 @@ class TransformerDecode(Primitive):
         #: scores) or pallas (fused streaming kernel, int8 dequant
         #: in-kernel — ops/decode_attention.py)
         "decode_kernel": "einsum",
+        #: phase=serve cache layout: "paged" serves from a page pool +
+        #: per-slot tables (models/serving.py) — identical tokens,
+        #: shared-pool memory; page_pool_frac scales the pool relative
+        #: to contiguous parity (1.0 = B * S_max worth of pages)
+        "cache_layout": "contiguous",
+        "page_size": 128,
+        "page_pool_frac": 1.0,
         "dp": 0,  # 0 = auto factorization of the device count
         "tp": 0,
     }
@@ -98,6 +105,9 @@ class TransformerDecode(Primitive):
         "kv_cache": ["bf16", "int8"],
         "attn_kernel": ["flash", "einsum"],
         "decode_kernel": ["einsum", "pallas"],
+        "cache_layout": ["contiguous", "paged"],
+        "page_size": (1, None),
+        "page_pool_frac": (0.01, 1.0),
         "dp": (0, None),
         "tp": (0, None),
     }
@@ -171,6 +181,21 @@ class TransformerDecode(Primitive):
                 "(1, tp) mesh; set dp=1 (one engine per dp shard is how "
                 "data parallelism composes)"
             )
+        if o["cache_layout"] == "paged" and o["phase"] != "serve":
+            raise ValueError(
+                "cache_layout='paged' is the serving engine's pool "
+                "(phase='serve'); the fixed-shape phases measure the "
+                "contiguous layout"
+            )
+        if o["cache_layout"] != "paged":
+            dead = {"page_size", "page_pool_frac"} & (
+                self._options_manager.overridden
+            )
+            if dead:
+                raise ValueError(
+                    f"Option(s) {sorted(dead)} have no effect with "
+                    "cache_layout='contiguous'"
+                )
 
     def flops(self) -> float:
         """Matmul FLOPs of one measured call.
@@ -250,6 +275,8 @@ class TransformerDecode(Primitive):
             kv_cache=o["kv_cache"],
             attn_kernel=o["attn_kernel"],
             decode_kernel=o["decode_kernel"],
+            cache_layout=o["cache_layout"],
+            page_size=o["page_size"],
             dtype=jnp_dtype(self.dtype),
         )
 

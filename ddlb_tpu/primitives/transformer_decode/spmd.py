@@ -55,9 +55,23 @@ class SPMDTransformerDecode(TransformerDecode):
             o = self.options
             workload = self._serve_workload()
             max_need = max(p.size + mn for p, mn in workload)
+            num_pages = None
+            if cfg.cache_layout == "paged":
+                import math
+
+                # round the horizon to whole pages; pool scaled by
+                # page_pool_frac relative to contiguous parity
+                ps = cfg.page_size
+                max_need = -(-max_need // ps) * ps
+                per_slot = max_need // ps
+                num_pages = max(
+                    1,
+                    math.ceil(o["page_pool_frac"] * o["batch"] * per_slot),
+                )
             eng = ContinuousBatchingEngine(
                 self.mesh, cfg, params,
                 max_batch=o["batch"], max_len=max_need,
+                num_pages=num_pages,
             )
             self._engine = eng
 

@@ -128,24 +128,32 @@ if not SMOKE:
                   flush=True)
     # continuous batching: sustained tokens/s under slot turnover (the
     # host_clock drain of a 2x-oversubscribed workload; dp=1, tp=1 on
-    # the single chip)
+    # the single chip), contiguous vs the paged pool at parity and at
+    # half capacity — the serve-side cost of pages (the per-step gather)
+    # and the memory lever, measured
     N_REQ = 16
-    row = run(
-        "transformer_decode", "spmd", 2048, D_S, F_S,
-        label=f"serve {N_REQ} reqs @2k, n_new<={N_NEW}",
-        phase="serve", n_new=N_NEW, n_requests=N_REQ, batch=8, vocab=V_S,
-        n_heads=16, layers=2, attn_kernel="einsum", dp=1, tp=1,
-        proto_overrides={"time_measurement_backend": "host_clock"},
-    )
-    t_ms = row["median time (ms)"]
-    if np.isfinite(t_ms):
-        # same workload definition as _serve_workload: stride-1 cycle
-        total_new = sum(1 + ((i + 3) % N_NEW) for i in range(N_REQ))
-        print(
-            f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
-            f"({total_new} tokens drained)",
-            flush=True,
+    for lbl, extra in (
+        ("contiguous", {}),
+        ("paged 1.0", {"cache_layout": "paged", "page_pool_frac": 1.0}),
+        ("paged 0.5", {"cache_layout": "paged", "page_pool_frac": 0.5}),
+    ):
+        row = run(
+            "transformer_decode", "spmd", 2048, D_S, F_S,
+            label=f"serve {N_REQ} reqs @2k, n_new<={N_NEW} [{lbl}]",
+            phase="serve", n_new=N_NEW, n_requests=N_REQ, batch=8,
+            vocab=V_S, n_heads=16, layers=2, attn_kernel="einsum",
+            dp=1, tp=1, **extra,
+            proto_overrides={"time_measurement_backend": "host_clock"},
         )
+        t_ms = row["median time (ms)"]
+        if np.isfinite(t_ms):
+            # same workload definition as _serve_workload: stride-1 cycle
+            total_new = sum(1 + ((i + 3) % N_NEW) for i in range(N_REQ))
+            print(
+                f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
+                f"({total_new} tokens drained)",
+                flush=True,
+            )
 
 # -- 1c) fused decode-attention kernel A/B -----------------------------------
 # The einsum decode path round-trips the [b, h_kv, G, 1, S] scores

@@ -1,0 +1,303 @@
+"""Paged KV cache: identical tokens, smaller memory.
+
+The contract has two halves, both pinned here on the sim mesh:
+
+1. **Losslessness** — a paged engine produces integer-identical
+   completions to the contiguous engine on the same workload (mixed
+   prompt lengths, staggered admissions, slot reuse, int8 cache, GQA,
+   shared prefix). Pages change where rows LIVE, never what they hold.
+2. **The memory claim** — a pool smaller than the contiguous B x S_max
+   still drains the workload (admissions defer FIFO-fairly under page
+   pressure), pages recycle across waves without leaking, and shared
+   prefix pages are table entries, not copies.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+
+def _cfg(**kw):
+    from ddlb_tpu.models.transformer import TransformerConfig
+
+    kw.setdefault("attn_kernel", "einsum")
+    kw.setdefault("cache_layout", "paged")
+    kw.setdefault("page_size", 8)
+    return TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, d_ff=64,
+        layers_per_stage=2, microbatches=1,
+        **kw,
+    )
+
+
+def _engine(cfg, B=4, S_max=40, eos_id=None, num_pages=None):
+    from ddlb_tpu.models.decode import make_decode_fn
+    from ddlb_tpu.models.serving import ContinuousBatchingEngine
+    from ddlb_tpu.models.transformer import init_params
+    from ddlb_tpu.runtime import Runtime
+
+    mesh = Runtime().mesh(("dp", "tp"), shape=(1, 2))
+    params = init_params(cfg, pp=1, n_experts=2, seed=0)
+    _, sh = make_decode_fn(mesh, cfg)
+    params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    eng = ContinuousBatchingEngine(
+        mesh, cfg, params, max_batch=B, max_len=S_max, eos_id=eos_id,
+        num_pages=num_pages,
+    )
+    return eng, mesh, params
+
+
+def _prompts(lengths, vocab=64, seed=5):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, s).astype(np.int32) for s in lengths]
+
+
+def _by_request(completions):
+    return {c.request_index: np.asarray(c.tokens) for c in completions}
+
+
+def _run_both(paged_kw, engine_kw=None, lengths=(8, 11, 6, 9, 8, 7),
+              max_new=6, prefix=None):
+    """The same workload through a paged and a contiguous engine;
+    returns (paged_completions, contiguous_completions, paged_engine)."""
+    from ddlb_tpu.models.serving import Request
+
+    engine_kw = engine_kw or {}
+    outs = []
+    eng_paged = None
+    for layout in ("paged", "contiguous"):
+        kw = dict(paged_kw)
+        kw["cache_layout"] = layout
+        cfg = _cfg(**kw)
+        ekw = dict(engine_kw)
+        if layout == "contiguous":
+            ekw.pop("num_pages", None)
+        eng, mesh, params = _engine(cfg, **ekw)
+        if prefix is not None:
+            eng.set_shared_prefix(prefix)
+        for p in _prompts(lengths):
+            eng.submit(Request(p, max_new=max_new))
+        outs.append(_by_request(eng.run()))
+        if layout == "paged":
+            eng_paged = eng
+    return outs[0], outs[1], eng_paged
+
+
+class TestLossless:
+    def test_equals_contiguous_mixed_lengths(self):
+        paged, contig, _ = _run_both({})
+        assert paged.keys() == contig.keys()
+        for idx in paged:
+            np.testing.assert_array_equal(paged[idx], contig[idx])
+
+    def test_equals_contiguous_int8_gqa(self):
+        paged, contig, _ = _run_both(
+            {"kv_cache": "int8", "n_kv_heads": 2}
+        )
+        for idx in paged:
+            np.testing.assert_array_equal(paged[idx], contig[idx])
+
+    def test_prefix_sharing_lossless(self):
+        # prefix spans 2 full pages (16 tokens) + a 3-token tail
+        prefix = np.arange(1, 20, dtype=np.int32)
+        rng = np.random.default_rng(9)
+        lengths = (24, 27, 25, 26)
+        prompts = []
+        for s in lengths:
+            p = rng.integers(1, 64, s).astype(np.int32)
+            p[: prefix.size] = prefix
+            prompts.append(p)
+
+        from ddlb_tpu.models.serving import Request
+
+        outs = []
+        engines = []
+        for layout in ("paged", "contiguous"):
+            cfg = _cfg(cache_layout=layout)
+            eng, _, _ = _engine(cfg, S_max=48)
+            eng.set_shared_prefix(prefix)
+            for p in prompts:
+                eng.submit(Request(p, max_new=5))
+            outs.append(_by_request(eng.run()))
+            engines.append(eng)
+        paged, contig = outs
+        for idx in paged:
+            np.testing.assert_array_equal(paged[idx], contig[idx])
+        eng = engines[0]
+        assert eng.stats.prefix_hits == len(prompts)
+        # the shared span is table entries, not copies: per expert one
+        # page set, regardless of how many slots used it
+        assert len(eng._prefix_pages) == eng.tp * (prefix.size // 8)
+
+
+def _oracle_chain(mesh, cfg, params, prompt, slot, B, n_new):
+    """Row ``slot`` of a greedy generate carrying ``prompt`` in every
+    row, on a CONTIGUOUS cache (layouts change where rows live, not the
+    math — the slot index pins the block router's expert)."""
+    from ddlb_tpu.models.decode import init_cache, make_generate_fn
+
+    ccfg = dataclasses.replace(cfg, cache_layout="contiguous")
+    gen, _ = make_generate_fn(mesh, ccfg, n_new=n_new)
+    S0 = prompt.size
+    batch = jnp.asarray(np.broadcast_to(prompt, (B, S0)).copy())
+    cache = init_cache(ccfg, B, S0 + n_new, mesh=mesh)
+    return np.asarray(jax.jit(gen)(params, cache, batch))[slot]
+
+
+class TestPool:
+    def test_small_pool_drains_with_deferrals(self):
+        # each request needs ceil((8 + 6) / 8) = 2 pages; a 5-page pool
+        # admits at most 2 at once where B=4 slots could run 4. Under
+        # deferral, requests land in DIFFERENT slots than a contiguous
+        # run would give them (slot -> expert -> tokens), so each
+        # completion is pinned to its own slot's greedy oracle instead
+        # of the contiguous engine's completions.
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, mesh, params = _engine(cfg, num_pages=5)
+        prompts = _prompts((8, 8, 8, 8, 8, 8))
+        for p in prompts:
+            eng.submit(Request(p, max_new=6))
+        done = eng.run()
+        assert len(done) == len(prompts)
+        for c in done:
+            want = _oracle_chain(
+                mesh, cfg, params, prompts[c.request_index], c.slot,
+                eng.B, 6,
+            )
+            np.testing.assert_array_equal(c.tokens, want)
+        assert eng.stats.admissions_deferred > 0
+        assert eng.stats.peak_pages_in_use <= 5
+        # drained: every page returned
+        assert eng.stats.pages_in_use == 0
+
+    def test_pool_recycles_without_leak(self):
+        _, _, eng = _run_both({}, engine_kw={"num_pages": 6})
+        assert eng.stats.pages_in_use == 0
+        assert sorted(eng._free_pages) == list(range(6))
+
+    def test_reset_reruns_identically(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg, num_pages=8)
+        prompts = _prompts((8, 10, 7))
+        for p in prompts:
+            eng.submit(Request(p, max_new=5))
+        first = _by_request(eng.run())
+        eng.reset()
+        for p in prompts:
+            eng.submit(Request(p, max_new=5))
+        second = _by_request(eng.run())
+        assert first.keys() == second.keys()
+        for idx in first:
+            np.testing.assert_array_equal(first[idx], second[idx])
+
+
+class TestBenchmarkMember:
+    def test_serve_paged_through_worker(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_paged",
+                "base_implementation": "spmd",
+                "options": {
+                    "phase": "serve",
+                    "n_requests": 6,
+                    "n_new": 4,
+                    "batch": 8,
+                    "vocab": 64,
+                    "n_heads": 8,
+                    "layers": 1,
+                    "attn_kernel": "einsum",
+                    "cache_layout": "paged",
+                    "page_size": 8,
+                    "page_pool_frac": 0.5,
+                },
+                "m": 16,
+                "n": 32,
+                "k": 64,
+                "dtype": "bfloat16",
+                "num_iterations": 1,
+                "num_warmups": 0,
+                "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["valid"], row["error"]
+
+    def test_paged_requires_serve_phase(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_decode", "spmd")
+        with pytest.raises(ValueError, match="serve"):
+            cls(
+                16, 32, 64, dtype="bfloat16", phase="decode",
+                cache_layout="paged", batch=8, vocab=64, n_heads=4,
+            )
+
+    def test_page_options_dead_when_contiguous(self):
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("transformer_decode", "spmd")
+        with pytest.raises(ValueError, match="no effect"):
+            cls(
+                16, 32, 64, dtype="bfloat16", phase="decode",
+                page_size=16, batch=8, vocab=64, n_heads=4,
+            )
+
+
+class TestGuards:
+    def test_paged_rejects_dp(self):
+        from ddlb_tpu.models.decode import make_decode_fn
+        from ddlb_tpu.runtime import Runtime
+
+        mesh = Runtime().mesh(("dp", "tp"), shape=(2, 2))
+        with pytest.raises(ValueError, match="dp=1"):
+            make_decode_fn(mesh, _cfg(), ragged=True)
+
+    def test_paged_rejects_pallas_decode_kernel(self):
+        with pytest.raises(ValueError, match="contiguous"):
+            _cfg(decode_kernel="pallas")
+
+    def test_page_size_must_divide_max_len(self):
+        with pytest.raises(ValueError, match="page_size"):
+            _engine(_cfg(page_size=7), S_max=40)
+
+    def test_num_pages_requires_paged(self):
+        with pytest.raises(ValueError, match="num_pages"):
+            _engine(_cfg(cache_layout="contiguous"), num_pages=4)
+
+    def test_pool_too_small_for_prefix(self):
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg, S_max=48, num_pages=2)
+        with pytest.raises(ValueError, match="page pool too small"):
+            eng.set_shared_prefix(np.arange(1, 20, dtype=np.int32))
+        # failure leaves a consistent engine: no half-set prefix, no
+        # orphaned pages — serving continues as if no prefix were set
+        assert eng._prefix_tokens is None
+        assert eng.stats.pages_in_use == 0
+
+        from ddlb_tpu.models.serving import Request
+
+        eng.submit(Request(np.arange(1, 9, dtype=np.int32), max_new=4))
+        done = eng.run()
+        assert len(done) == 1
+
+    def test_submit_rejects_unfittable_request(self):
+        # a request that could NEVER fit the pool must fail at submit,
+        # not spin run() forever with admissions deferring
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg, S_max=40, num_pages=2)
+        with pytest.raises(ValueError, match="pages"):
+            eng.submit(Request(np.arange(1, 20, dtype=np.int32), max_new=6))
