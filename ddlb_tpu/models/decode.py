@@ -462,11 +462,32 @@ def _cache_attend_pallas(q, cache, l, pos, dtype, cfg):
     ``q [b, 1, h, dh]`` against cache layer ``l`` with NO HBM score
     round-trip; int8 payloads + scales are read as-is and dequantized
     in-kernel. Same mask/window/dequant semantics as ``_cache_attend``
-    (pinned to float tolerance in tests/test_decode_attention.py)."""
-    from ddlb_tpu.ops.decode_attention import decode_attention
+    (pinned to float tolerance in tests/test_decode_attention.py).
+
+    Paged caches route to ``paged_decode_attention``: the kernel reads
+    the page table directly and streams only mapped pages — the fused
+    alternative to the einsum path's gather of the whole linear view.
+    """
+    from ddlb_tpu.ops.decode_attention import (
+        decode_attention,
+        paged_decode_attention,
+    )
 
     b = q.shape[0]
     interpret = jax.default_backend() != "tpu"
+    if "table" in cache:
+        out = paged_decode_attention(
+            q[:, 0],
+            cache["k"][l],
+            cache["v"][l],
+            cache["table"],
+            pos,
+            k_scale=(cache["k_scale"][l] if "k_scale" in cache else None),
+            v_scale=(cache["v_scale"][l] if "v_scale" in cache else None),
+            window=cfg.attn_window,
+            interpret=interpret,
+        )
+        return out.reshape(b, 1, -1).astype(dtype)
     out = decode_attention(
         q[:, 0],
         cache["k"][l],

@@ -134,9 +134,11 @@ class TransformerConfig:
     #: workload share HBM that a contiguous layout strands at B*S_max,
     #: and full prefix pages are shared across slots instead of copied.
     #: Decode-step cost: the einsum path gathers the linear cache view
-    #: per step (one extra HBM pass over live pages vs contiguous).
-    #: Serving-engine paths only; decode_kernel='pallas' requires the
-    #: contiguous layout.
+    #: per step (one extra HBM pass over live pages vs contiguous);
+    #: decode_kernel='pallas' instead streams mapped pages directly
+    #: through the fused kernel's table-reading block index map
+    #: (ops/decode_attention.paged_decode_attention). Serving-engine
+    #: paths only.
     cache_layout: str = "contiguous"
     #: tokens per page under cache_layout='paged'
     page_size: int = 128
@@ -154,17 +156,10 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown cache_layout '{self.cache_layout}'"
             )
-        if self.cache_layout == "paged":
-            if self.page_size < 1:
-                raise ValueError(
-                    f"page_size must be >= 1, got {self.page_size}"
-                )
-            if self.decode_kernel == "pallas":
-                raise ValueError(
-                    "decode_kernel='pallas' reads the contiguous cache "
-                    "layout; use cache_layout='contiguous' (or the "
-                    "einsum decode kernel with pages)"
-                )
+        if self.cache_layout == "paged" and self.page_size < 1:
+            raise ValueError(
+                f"page_size must be >= 1, got {self.page_size}"
+            )
 
     @property
     def head_dim(self) -> int:

@@ -47,14 +47,16 @@ def _pick_block(S: int, want: int) -> int:
     return b
 
 
-def _decode_attn_kernel(
-    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-    m_ref, l_ref, acc_ref,
-    *, block_s: int, h_kv: int, G: int, dh: int, scale: float,
-    window: int, int8: bool, dtype,
+def _attn_tile_body(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, sj, pos, s_start, live_tile, block: int, h_kv: int, G: int,
+    dh: int, scale: float, window: int, int8: bool, dtype,
 ):
-    bi = pl.program_id(0)
-    sj = pl.program_id(1)
+    """The ONE online-softmax recurrence (init / masked tile update /
+    flush) shared by the contiguous and paged kernels — they differ only
+    in how a grid step finds its KV tile (sequential block vs
+    table-mapped page) and in the extra liveness term the paged form
+    adds; the numerically delicate part lives here once."""
 
     @pl.when(sj == 0)
     def _init():
@@ -62,21 +64,9 @@ def _decode_attn_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    pos = pos_ref[bi]
-    s_start = sj * block_s
-
-    # tile skip: not entirely in the future, and (static window) not
-    # entirely behind the sliding window — windowed decode then costs
-    # O(window) live tiles, not O(S)
-    live_tile = s_start <= pos
-    if window:
-        live_tile = jnp.logical_and(
-            live_tile, s_start + block_s > pos - window
-        )
-
     @pl.when(live_tile)
     def _update():
-        # [block_s, h_kv, dh] cache tiles, contiguous in the native
+        # [block, h_kv, dh] cache tiles, contiguous in the native
         # layout; dequantize through the model dtype (the _cache_read
         # contract) so einsum/kernel numerics agree
         k = k_ref[0]
@@ -93,7 +83,7 @@ def _decode_attn_kernel(
             preferred_element_type=jnp.float32,
         )
         cols = s_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, block_s), 2
+            jnp.int32, (1, 1, block), 2
         )
         live = cols <= pos
         if window:
@@ -119,6 +109,34 @@ def _decode_attn_kernel(
         l = l_ref[:]
         out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = out.reshape(h_kv * G, dh).astype(o_ref.dtype)
+
+
+def _decode_attn_kernel(
+    pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_ref, l_ref, acc_ref,
+    *, block_s: int, h_kv: int, G: int, dh: int, scale: float,
+    window: int, int8: bool, dtype,
+):
+    bi = pl.program_id(0)
+    sj = pl.program_id(1)
+    pos = pos_ref[bi]
+    s_start = sj * block_s
+
+    # tile skip: not entirely in the future, and (static window) not
+    # entirely behind the sliding window — windowed decode then costs
+    # O(window) live tiles, not O(S)
+    live_tile = s_start <= pos
+    if window:
+        live_tile = jnp.logical_and(
+            live_tile, s_start + block_s > pos - window
+        )
+
+    _attn_tile_body(
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+        sj=sj, pos=pos, s_start=s_start, live_tile=live_tile,
+        block=block_s, h_kv=h_kv, G=G, dh=dh, scale=scale, window=window,
+        int8=int8, dtype=dtype,
+    )
 
 
 @functools.partial(
@@ -211,3 +229,135 @@ def decode_attention(
         ),
         interpret=interpret,
     )(pos, *operands)
+
+
+def _paged_decode_attn_kernel(
+    pos_ref, table_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_ref, l_ref, acc_ref,
+    *, page_size: int, num_pages: int, h_kv: int, G: int, dh: int,
+    scale: float, window: int, int8: bool, dtype,
+):
+    """``_attn_tile_body`` with the KV tile for grid step ``sj`` fetched
+    from the PAGE the slot's table maps — the block index map does the
+    lookup (see ``paged_decode_attention``); this wrapper only adds the
+    "is this table entry mapped" predicate to tile liveness."""
+    bi = pl.program_id(0)
+    sj = pl.program_id(1)
+    pos = pos_ref[bi]
+    s_start = sj * page_size
+
+    live_tile = jnp.logical_and(
+        s_start <= pos,
+        # sentinel (unmapped) pages contribute nothing — the paged form
+        # of the contiguous layout's zero-filled tail
+        table_ref[bi, sj] < num_pages,
+    )
+    if window:
+        live_tile = jnp.logical_and(
+            live_tile, s_start + page_size > pos - window
+        )
+
+    _attn_tile_body(
+        q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+        sj=sj, pos=pos, s_start=s_start, live_tile=live_tile,
+        block=page_size, h_kv=h_kv, G=G, dh=dh, scale=scale,
+        window=window, int8=int8, dtype=dtype,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "interpret"),
+)
+def paged_decode_attention(
+    q,
+    k_pool,
+    v_pool,
+    table,
+    pos,
+    *,
+    k_scale=None,
+    v_scale=None,
+    window: int = 0,
+    interpret=False,
+):
+    """Fused single-token cache attention over a PAGED cache.
+
+    ``q``: [b, h, dh]; ``k_pool``/``v_pool``: [P, page_size, h_kv, dh]
+    (the shared page pool, int8 with [P, page_size, h_kv, 1] f32 scale
+    pools); ``table``: [b, max_pages] int32 page ids (the sentinel id P
+    marks unmapped entries); ``pos``: [b] int32 live positions.
+
+    The page table rides as a prefetched scalar operand and the KV block
+    index map READS it: grid step (bi, sj) fetches page
+    ``table[bi, sj]`` — so only mapped pages ever stream from HBM
+    (sentinel entries clamp their fetch to page P-1 and are masked dead
+    in the kernel; the pipeline still pays that one redundant page read
+    per unmapped tail entry, the static-shape tax). The einsum paged path
+    instead gathers the whole linear view through HBM first —
+    this kernel IS that gather, fused into the attention.
+    """
+    b, h, dh = q.shape
+    P, ps, h_kv, _ = k_pool.shape
+    if h % h_kv:
+        raise ValueError(f"h={h} not divisible by h_kv={h_kv}")
+    G = h // h_kv
+    int8 = k_pool.dtype == jnp.int8
+    if int8 and (k_scale is None or v_scale is None):
+        raise ValueError("int8 cache needs k_scale and v_scale")
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    table = jnp.asarray(table, jnp.int32)
+    max_pages = table.shape[1]
+
+    kernel = functools.partial(
+        _paged_decode_attn_kernel,
+        page_size=ps, num_pages=P, h_kv=h_kv, G=G, dh=dh,
+        scale=1.0 / float(np.sqrt(dh)), window=int(window), int8=int8,
+        dtype=q.dtype,
+    )
+
+    def page_of(bi, sj, pos_p, table_p):
+        del pos_p
+        return (jnp.minimum(table_p[bi, sj], P - 1), 0, 0, 0)
+
+    qspec = pl.BlockSpec((1, h, dh), lambda bi, sj, pos_p, tab_p: (bi, 0, 0))
+    kvspec = pl.BlockSpec((1, ps, h_kv, dh), page_of)
+    ospec = pl.BlockSpec((1, h, dh), lambda bi, sj, pos_p, tab_p: (bi, 0, 0))
+    if int8:
+        sspec = pl.BlockSpec((1, ps, h_kv, 1), page_of)
+        operands = (q, k_pool, v_pool, k_scale, v_scale)
+    else:
+        sspec = pl.BlockSpec(
+            (1, 1, h_kv, 1), lambda bi, sj, pos_p, tab_p: (0, 0, 0, 0)
+        )
+        dummy = jnp.zeros((1, 1, h_kv, 1), jnp.float32)
+        operands = (q, k_pool, v_pool, dummy, dummy)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[qspec, kvspec, kvspec, sspec, sspec],
+        out_specs=ospec,
+        scratch_shapes=[
+            pltpu.VMEM((h_kv, G, 1), jnp.float32),
+            pltpu.VMEM((h_kv, G, 1), jnp.float32),
+            pltpu.VMEM((h_kv, G, dh), jnp.float32),
+        ],
+    )
+    itemsize = k_pool.dtype.itemsize
+    S = max_pages * ps
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * S * dh,
+            bytes_accessed=2 * b * S * h_kv * dh * itemsize
+            + (2 * b * S * h_kv * 4 if int8 else 0)
+            + 2 * b * h * dh * q.dtype.itemsize,
+            transcendentals=b * h * S,
+        ),
+        interpret=interpret,
+    )(pos, table, *operands)

@@ -136,6 +136,10 @@ if not SMOKE:
         ("contiguous", {}),
         ("paged 1.0", {"cache_layout": "paged", "page_pool_frac": 1.0}),
         ("paged 0.5", {"cache_layout": "paged", "page_pool_frac": 0.5}),
+        ("paged 0.5 + fused kernel", {
+            "cache_layout": "paged", "page_pool_frac": 0.5,
+            "decode_kernel": "pallas",
+        }),
     ):
         row = run(
             "transformer_decode", "spmd", 2048, D_S, F_S,

@@ -71,6 +71,73 @@ def test_kernel_matches_einsum_path(case):
     )
 
 
+def _page_scatter(cache, ps, rng, unmap_tail_for=None):
+    """Scatter a contiguous ``[b, S, ...]`` cache into a paged pool with
+    a SHUFFLED page assignment (pages deliberately non-contiguous in the
+    pool) plus a few never-mapped pages; ``unmap_tail_for[i]`` (a
+    position per slot) additionally sentinels every table entry strictly
+    past that position's page — the allocator's true shape, where the
+    unwritten tail has no pages at all."""
+    b, S = np.asarray(cache["k"]).shape[:2]
+    mp = S // ps
+    num_pages = b * mp + 3
+    perm = rng.permutation(b * mp)
+    table = np.full((b, mp), num_pages, np.int32)
+    pools = {
+        name: np.zeros(
+            (num_pages, ps) + np.asarray(arr).shape[2:],
+            np.asarray(arr).dtype,
+        )
+        for name, arr in cache.items()
+    }
+    for i in range(b):
+        for j in range(mp):
+            if unmap_tail_for is not None and j > unmap_tail_for[i] // ps:
+                continue
+            pg = int(perm[i * mp + j])
+            table[i, j] = pg
+            for name, arr in cache.items():
+                pools[name][pg] = np.asarray(arr)[i, j * ps : (j + 1) * ps]
+    out = {name: jnp.asarray(p) for name, p in pools.items()}
+    out["table"] = jnp.asarray(table)
+    return out
+
+
+@pytest.mark.parametrize(
+    "case",
+    [
+        dict(),
+        dict(h_kv=2),
+        dict(int8=True),
+        dict(h_kv=2, int8=True, window=6),
+        dict(window=5),
+    ],
+    ids=["mha", "gqa", "int8", "gqa-int8-window", "window"],
+)
+def test_paged_kernel_matches_einsum_path(case):
+    from ddlb_tpu.ops.decode_attention import paged_decode_attention
+
+    b, S, h, dh, ps = 4, 24, 4, 8, 8
+    h_kv = case.get("h_kv", h)
+    int8 = case.get("int8", False)
+    window = case.get("window", 0)
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(0, 1, (b, h, dh)), jnp.float32)
+    cache = _rand_cache(rng, b, S, h_kv, dh, int8)
+    pos = jnp.asarray(rng.integers(0, S, b), jnp.int32)
+    paged = _page_scatter(cache, ps, rng, unmap_tail_for=np.asarray(pos))
+
+    got = paged_decode_attention(
+        q, paged["k"], paged["v"], paged["table"], pos,
+        k_scale=paged.get("k_scale"), v_scale=paged.get("v_scale"),
+        window=window, interpret=True,
+    )
+    want = _einsum_reference(q, cache, pos, window)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=0, atol=1e-5
+    )
+
+
 def test_scalar_pos_broadcasts_and_blocks_shrink():
     from ddlb_tpu.ops.decode_attention import decode_attention
 

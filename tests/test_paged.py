@@ -264,9 +264,14 @@ class TestGuards:
         with pytest.raises(ValueError, match="dp=1"):
             make_decode_fn(mesh, _cfg(), ragged=True)
 
-    def test_paged_rejects_pallas_decode_kernel(self):
-        with pytest.raises(ValueError, match="contiguous"):
-            _cfg(decode_kernel="pallas")
+    def test_paged_pallas_decode_kernel_lossless(self):
+        # the fused paged kernel through the engine: identical tokens to
+        # the einsum paged path on the same workload
+        einsum, _, _ = _run_both({})
+        pallas, _, _ = _run_both({"decode_kernel": "pallas"})
+        assert einsum.keys() == pallas.keys()
+        for idx in einsum:
+            np.testing.assert_array_equal(einsum[idx], pallas[idx])
 
     def test_page_size_must_divide_max_len(self):
         with pytest.raises(ValueError, match="page_size"):
