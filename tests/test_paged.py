@@ -255,6 +255,57 @@ class TestBenchmarkMember:
             )
 
 
+class TestCacheOps:
+    def test_chunk_write_matches_contiguous(self):
+        # the t>1 (speculative-verify chunk) write path, paged vs
+        # contiguous: same rows land at the same logical positions.
+        # No engine path drives this today (speculate is fixed-shape and
+        # measures the contiguous layout); this pin keeps the branch
+        # live for a future paged speculate without an engine detour.
+        from ddlb_tpu.models.decode import (
+            _cache_max_len,
+            _cache_read,
+            _cache_write,
+            init_cache,
+            init_paged_cache,
+        )
+        from ddlb_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab=16, d_model=16, n_heads=2, d_ff=16,
+            layers_per_stage=2, cache_layout="paged", page_size=4,
+        )
+        ccfg = dataclasses.replace(cfg, cache_layout="contiguous")
+        b, S, t = 2, 16, 3
+        rng = np.random.default_rng(3)
+        k = jnp.asarray(rng.normal(0, 1, (b, t, 2, 8)), jnp.float32)
+        v = jnp.asarray(rng.normal(0, 1, (b, t, 2, 8)), jnp.float32)
+
+        paged = init_paged_cache(cfg, b, S, num_pages=b * (S // 4))
+        # map every slot's pages (identity-ish shuffled layout)
+        table = np.arange(b * (S // 4), dtype=np.int32)
+        rng.shuffle(table)
+        paged["table"] = jnp.asarray(table.reshape(b, S // 4))
+        contig = init_cache(ccfg, b, S)
+
+        start = 5  # crosses a page boundary (pages of 4: rows 5,6,7)
+        for l in range(2):
+            paged = _cache_write(paged, l, jnp.int32(start), k, v, False)
+            contig = _cache_write(contig, l, jnp.int32(start), k, v, False)
+        assert _cache_max_len(paged) == S
+        for l in range(2):
+            np.testing.assert_allclose(
+                np.asarray(_cache_read(paged, "k", l, jnp.float32)),
+                np.asarray(_cache_read(contig, "k", l, jnp.float32)),
+                rtol=0, atol=0,
+            )
+            np.testing.assert_allclose(
+                np.asarray(_cache_read(paged, "v", l, jnp.float32)),
+                np.asarray(_cache_read(contig, "v", l, jnp.float32)),
+                rtol=0, atol=0,
+            )
+
+
 class TestGuards:
     def test_paged_rejects_dp(self):
         from ddlb_tpu.models.decode import make_decode_fn
