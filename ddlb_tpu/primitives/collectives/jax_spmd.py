@@ -5,11 +5,18 @@ The pure-wire analogue of the reference's PyTorch implementations
 /root/reference/ddlb/primitives/TPColumnwise/pytorch.py:85-104): one
 ``jax.lax`` collective per op, nothing else in the measured region.
 
-``strategy`` applies to ``all_reduce`` only and mirrors the dp_allreduce
-member's axis: ``psum`` (XLA's fused all-reduce) vs ``rs_ag`` (explicit
-bandwidth-optimal two-phase ring) — on a pure payload the two should
-measure identically if XLA's fusion is ring-optimal, which is exactly
-the kind of statement this family exists to test.
+``strategy`` applies to ``all_reduce`` only:
+
+- ``psum``: XLA's fused all-reduce.
+- ``rs_ag``: explicit bandwidth-optimal two-phase ring (reduce-scatter
+  then all-gather) on the flat ring — measured against ``psum`` it asks
+  whether XLA's fusion is ring-optimal.
+- ``hierarchical``: the multi-slice TPU decomposition on the 2-D
+  ``(dcn, ici)`` hybrid mesh — reduce-scatter over ICI, all-reduce of
+  the scattered shard over DCN, all-gather over ICI — so the narrow
+  cross-slice links carry ``1/ici_size`` of the payload. On a
+  single-slice world the dcn axis has extent 1 and the strategy
+  degenerates to rs_ag exactly.
 """
 
 from __future__ import annotations
@@ -22,9 +29,37 @@ from ddlb_tpu.primitives.collectives.base import Collectives
 
 class JaxSPMDCollectives(Collectives):
     DEFAULT_OPTIONS = {"strategy": "psum"}
-    ALLOWED_VALUES = {"strategy": ["psum", "rs_ag"]}
+    ALLOWED_VALUES = {"strategy": ["psum", "rs_ag", "hierarchical"]}
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        if self.options["strategy"] == "hierarchical":
+            if self.options["op"] != "all_reduce":
+                raise ValueError(
+                    "strategy='hierarchical' decomposes all_reduce only"
+                )
+            if "transport" in self._options_manager.overridden:
+                raise ValueError(
+                    "strategy='hierarchical' builds its own (dcn, ici) "
+                    "hybrid mesh; the transport axis does not apply"
+                )
+            # the ICI reduce-scatter needs (m/d) % ici == 0, which the
+            # base class's m % d^2 rule for all_reduce already implies
+            # (ici divides d)
+            if self.runtime.num_slices == 1:
+                # same loud degenerate-case note as transport_mesh: a
+                # sweep must not record a "hierarchical" row that
+                # silently measured rs_ag on a one-slice world
+                print(
+                    "[ddlb_tpu] strategy='hierarchical' on a single "
+                    "slice: the dcn axis has extent 1 — this row "
+                    "measures the rs_ag decomposition"
+                )
 
     def _input_setup(self) -> None:
+        if self.options["strategy"] == "hierarchical":
+            self._setup_hierarchical()
+            return
         super()._input_setup()
         op = self.options["op"]
         strategy = self.options["strategy"]
@@ -66,6 +101,34 @@ class JaxSPMDCollectives(Collectives):
                 mesh=self.mesh,
                 in_specs=(P("tp", None),),
                 out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+
+    def _setup_hierarchical(self) -> None:
+        """all_reduce on the 2-D hybrid mesh: device (s, j) holds summand
+        ``s * ici + j``; RS over ici leaves block j of the slice-local
+        sum, the DCN psum adds the other slices' partials of that block,
+        and the ici all-gather reassembles the replicated result —
+        identical semantics to the flat strategies, DCN bytes / ici."""
+        self.mesh = self.runtime.hybrid_mesh(("dcn", "ici"))
+        a_host, _ = self._host_operands()
+        self.a = self._device_put(a_host, P(("dcn", "ici"), None))
+        self.b = None
+
+        def step(a_shard):
+            part = jax.lax.psum_scatter(
+                a_shard, "ici", scatter_dimension=0, tiled=True
+            )
+            part = jax.lax.psum(part, "dcn")
+            return jax.lax.all_gather(part, "ici", axis=0, tiled=True)
+
+        self._fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(("dcn", "ici"), None),),
+                out_specs=P(None, None),
                 check_vma=False,
             )
         )

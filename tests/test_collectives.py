@@ -54,6 +54,50 @@ def test_jax_spmd_rs_ag_strategy():
     assert impl.validate(impl.run())
 
 
+def test_hierarchical_all_reduce_single_slice():
+    # one slice: the dcn axis has extent 1 and the decomposition
+    # degenerates to rs_ag — same replicated sum
+    cls = load_impl_class("collectives", "jax_spmd")
+    impl = cls(
+        M, N, K, dtype="float32", op="all_reduce", strategy="hierarchical"
+    )
+    result = impl.run()
+    assert result.shape == (M // impl.num_partitions, K)
+    assert impl.validate(result)
+
+
+def test_hierarchical_all_reduce_two_slices(monkeypatch):
+    # 2 simulated slices x 4 devices: the DCN phase genuinely crosses
+    # the slice boundary on the hybrid mesh
+    from ddlb_tpu.runtime import Runtime
+
+    monkeypatch.setenv("DDLB_TPU_SIM_SLICES", "2")
+    Runtime.reset()
+    try:
+        cls = load_impl_class("collectives", "jax_spmd")
+        impl = cls(
+            M, N, K, dtype="float32", op="all_reduce",
+            strategy="hierarchical",
+        )
+        assert impl.mesh.axis_names == ("dcn", "ici")
+        assert impl.mesh.devices.shape == (2, 4)
+        assert impl.validate(impl.run())
+    finally:
+        monkeypatch.delenv("DDLB_TPU_SIM_SLICES")
+        Runtime.reset()
+        Runtime()  # rebuild the clean singleton for later tests
+
+
+def test_hierarchical_guards():
+    cls = load_impl_class("collectives", "jax_spmd")
+    with pytest.raises(ValueError, match="all_reduce only"):
+        cls(M, N, K, dtype="float32", op="all_gather",
+            strategy="hierarchical")
+    with pytest.raises(ValueError, match="transport axis"):
+        cls(M, N, K, dtype="float32", op="all_reduce",
+            strategy="hierarchical", transport="dcn")
+
+
 @pytest.mark.parametrize("op", ALL_OPS)
 def test_xla_gspmd(op):
     cls = load_impl_class("collectives", "xla_gspmd")
