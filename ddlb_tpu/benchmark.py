@@ -33,7 +33,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ddlb_tpu.native import now_ns, robust_stats
-from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES, load_impl_class
+from ddlb_tpu.primitives.registry import (
+    ALLOWED_PRIMITIVES,
+    load_impl_class,
+    throughput_unit,
+)
 from ddlb_tpu.utils.timing import fence, measure_device_loop
 
 TIMING_BACKENDS = ("host_clock", "device_loop")
@@ -134,8 +138,11 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         valid = False
 
     # TFLOPS = flops / 1e9 / time_ms; GEMM primitives use the reference's
-    # 2*m*n*k (benchmark.py:209-214), attention primitives override flops()
-    flop_count = impl.flops() if impl is not None else 2.0 * m * n * k
+    # 2*m*n*k (benchmark.py:209-214), attention primitives override
+    # flops(). No impl -> no flop convention: NaN, matching the row's
+    # NaN times (a number here would imply a semantics the family may
+    # not have — transformer/collectives flops are not 2mnk)
+    flop_count = impl.flops() if impl is not None else float("nan")
     row = make_result_row(
         config,
         times_ms=times_ms,
@@ -193,6 +200,9 @@ def make_result_row(
         "dtype": config.get("dtype", "bfloat16"),
         "Throughput (TFLOPS)": float(np.mean(tflops)),
         "Throughput std (TFLOPS)": float(np.std(tflops)),
+        # what the Throughput column actually measures for this family
+        # ("GB/s" for collectives — registry.throughput_unit)
+        "unit": throughput_unit(config["primitive"]),
         "world_size": world_size,
         "num_processes": num_processes,
         "hostname": socket.gethostname(),
@@ -659,7 +669,9 @@ class PrimitiveBenchmarkRunner:
         return make_result_row(
             config,
             times_ms=np.array([float("nan")]),
-            flop_count=2.0 * config["m"] * config["n"] * config["k"],
+            # the worker died before an impl existed to define a flop
+            # convention; NaN (not 2mnk) so the dead row implies nothing
+            flop_count=float("nan"),
             option_repr=_format_options(config.get("options", {})),
             valid=False,
             error=error,
@@ -710,7 +722,12 @@ class PrimitiveBenchmarkRunner:
         ax.bar(range(len(labels)), values, yerr=err, capsize=3)
         ax.set_xticks(range(len(labels)))
         ax.set_xticklabels(labels, rotation=30, ha="right", fontsize=8)
-        ax.set_ylabel(metric)
+        ylabel = metric
+        if metric.startswith("Throughput") and "unit" in df:
+            units = sorted(set(df["unit"].dropna()))
+            if units == ["GB/s"]:  # the collectives family's convention
+                ylabel = "Throughput (GB/s, per-device wire)"
+        ax.set_ylabel(ylabel)
         row0 = df.iloc[0]
         ax.set_title(
             f"{row0.get('m')}x{row0.get('k')}x{row0.get('n')} "

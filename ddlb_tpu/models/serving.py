@@ -305,6 +305,8 @@ class ContinuousBatchingEngine:
                 (self.B, self.max_pages), self.num_pages, np.int32
             )
             self._prefix_pages = []
+            self._prefix_slots: set = set()
+            self._retired_prefix: List[tuple] = []
         else:
             self.cache = init_cache(
                 self.cfg, self.B, self.S_max, mesh=self.mesh
@@ -389,15 +391,41 @@ class ContinuousBatchingEngine:
             return 0
         return self._prefix_tokens.size // self.page_size
 
+    def _retire_prefix_pages(self) -> None:
+        """Stop treating the current prefix page set as the prefix, and
+        return its pages to the pool — DEFERRED while any active slot's
+        table still maps them. An immediate release would let the next
+        admission (or a replacement prefix's own scatter) reallocate and
+        overwrite rows an in-flight sequence is still attending."""
+        if self._prefix_pages:
+            if self._prefix_slots:
+                self._retired_prefix.append(
+                    (self._prefix_pages, set(self._prefix_slots))
+                )
+            else:
+                self._release_pages(self._prefix_pages)
+            self._prefix_pages = []
+        self._prefix_pages_by_e = [[] for _ in range(self.tp)]
+        self._prefix_slots = set()
+
+    def _drain_retired_prefix(self, slot: int) -> None:
+        """Slot ``slot`` just finished: drop it from every retired prefix
+        group and release any group no active slot references anymore."""
+        kept = []
+        for pages, slots in self._retired_prefix:
+            slots.discard(slot)
+            if slots:
+                kept.append((pages, slots))
+            else:
+                self._release_pages(pages)
+        self._retired_prefix = kept
+
     def _seed_prefix_pages(self) -> None:
         """Pin the shared prefix's FULL pages into the pool, one page set
         per expert: prefix K/V rows beyond layer 0 depend on the expert
         the block router assigns, so slots share the page set of THEIR
         expert (B/tp slots per set)."""
-        if self._prefix_pages:
-            self._release_pages(self._prefix_pages)
-            self._prefix_pages = []
-        self._prefix_pages_by_e = [[] for _ in range(self.tp)]
+        self._retire_prefix_pages()
         p_full = self._prefix_full_pages()
         if p_full == 0:
             return
@@ -444,10 +472,8 @@ class ContinuousBatchingEngine:
         if prefix is None:
             self._prefix_tokens = None
             self._prefix_scratch = None
-            if self.paged and self._prefix_pages:
-                self._release_pages(self._prefix_pages)
-                self._prefix_pages = []
-                self._prefix_pages_by_e = [[] for _ in range(self.tp)]
+            if self.paged:
+                self._retire_prefix_pages()
             return
         prefix = np.asarray(prefix, np.int32)
         if prefix.ndim != 1 or prefix.size == 0:
@@ -514,7 +540,24 @@ class ContinuousBatchingEngine:
                 continue
             if self.paged:
                 head = self._requests[self._queue[0]]
-                if self._pages_needed(head) > len(self._free_pages):
+                need = self._pages_needed(head)
+                # submit() screened against the prefix pin count AT
+                # SUBMIT TIME; a prefix set/grown while the request was
+                # queued can shrink the attainable pages below its worst
+                # case. Deferring would spin run() forever — fail loudly
+                # at the single capacity decision point instead. (Pages
+                # in retired prefix groups DO return when their slots
+                # finish, so only the live prefix pin is unattainable.)
+                if need > self.num_pages - len(self._prefix_pages):
+                    raise RuntimeError(
+                        f"queued request {self._queue[0]} needs {need} "
+                        f"pages but only "
+                        f"{self.num_pages - len(self._prefix_pages)} can "
+                        f"ever free ({self.num_pages} total, "
+                        f"{len(self._prefix_pages)} pinned by a prefix "
+                        f"set after it was submitted)"
+                    )
+                if need > len(self._free_pages):
                     self.stats.admissions_deferred += 1
                     break
             self._admit(slot, self._queue.popleft())
@@ -593,6 +636,7 @@ class ContinuousBatchingEngine:
         row = np.full(self.max_pages, self.num_pages, np.int32)
         if p_full:
             row[:p_full] = self._prefix_pages_by_e[e]
+            self._prefix_slots.add(slot)
         row[p_full:total] = fresh
         self._table_np[slot] = row
         self._slot_pages[slot] = fresh
@@ -650,6 +694,8 @@ class ContinuousBatchingEngine:
             self._push_table()
             self._release_pages(self._slot_pages[slot])
             self._slot_pages[slot] = []
+            self._prefix_slots.discard(slot)
+            self._drain_retired_prefix(slot)
 
     # -- the tick ----------------------------------------------------------
 

@@ -34,8 +34,11 @@ returns ``1000 * wire_bytes()`` so that the SAME formula lands on
 **per-device ring wire traffic in GB/s** — the busbw convention of
 nccl-tests, restated for a ring: the bytes one device must inject into
 the ICI under a ring algorithm, divided by the measured time. Rows from
-this family therefore read the Throughput column in GB/s, stated here
-and in the docs rather than silently.
+this family therefore read the Throughput column in GB/s — stated here,
+in the docs, AND machine-readably: every result row carries a ``unit``
+column ("GB/s" for this family, "TFLOPS" elsewhere —
+registry.throughput_unit) so cross-family CSV joins cannot silently mix
+the two.
 
 Validation: pure data movement (ag / a2a / ppermute) must round-trip the
 seeded operand exactly; reductions sum d terms, so the tolerance scales

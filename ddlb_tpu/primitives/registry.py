@@ -258,6 +258,22 @@ _REGISTRY = {
 }
 
 
+#: Unit of the shared "Throughput (TFLOPS)" column, per family. The
+#: collectives family routes per-device wire bandwidth through the same
+#: formula (collectives/base.py ``flops()`` returns 1000*wire_bytes), so
+#: its rows must SAY so — a cross-family CSV join that sorts or ratios
+#: the column would otherwise silently mix TFLOPS with GB/s.
+_THROUGHPUT_UNITS = {"collectives": "GB/s"}
+
+
+def throughput_unit(primitive: str) -> str:
+    """Unit of the Throughput column for this family. Kept here (JAX-free,
+    keyed on the primitive name) so the runner's error-row paths can stamp
+    it without loading the implementation or touching the accelerator."""
+    _check_primitive(primitive)
+    return _THROUGHPUT_UNITS.get(primitive, "TFLOPS")
+
+
 def implementation_names(primitive: str) -> Tuple[str, ...]:
     _check_primitive(primitive)
     return tuple(_REGISTRY[primitive])

@@ -60,9 +60,10 @@ def run_and_print(
         }
     )
     t = row["median time (ms)"]
+    unit = "GB/s" if row.get("unit") == "GB/s" else "TF"
     print(
         f"{primitive:18s} {impl:10s} m={m:<6d} {label or options} -> "
-        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} TF  "
+        f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} {unit}  "
         f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
         f"err={row['error'] or '-'}",
         flush=True,
@@ -89,7 +90,10 @@ def _error_row(config, error):
     return make_result_row(
         config,
         times_ms=np.array([float("nan")]),
-        flop_count=2.0 * config["m"] * config["n"] * config["k"],
+        # no impl ran, so no flop convention applies (2mnk would be
+        # semantically wrong for transformer/collectives configs); the
+        # row's stats are all-NaN either way
+        flop_count=float("nan"),
         option_repr=";".join(
             f"{k}={v}" for k, v in sorted(config.get("options", {}).items())
         )

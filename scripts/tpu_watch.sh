@@ -22,10 +22,13 @@ mkdir -p hwlogs
 PROBE='from ddlb_tpu.runtime import Runtime; r = Runtime(); print("PROBE_OK", r.platform, r.num_devices, flush=True)'
 
 commit_capture() {
-    # persist whatever exists right now; never fail the watch loop
+    # persist whatever exists right now; never fail the watch loop.
+    # The commit is pathspec-restricted so content a concurrent session
+    # staged in the index is NOT swept into the automated commit.
     git add -f hwlogs/*.out hwlogs/*.err 2>/dev/null
     git add bench_tpu_cache.json autotune_cache.json 2>/dev/null
-    git commit -q -m "Hardware capture: $1" 2>/dev/null || true
+    git commit -q -m "Hardware capture: $1" \
+        -- hwlogs bench_tpu_cache.json autotune_cache.json 2>/dev/null || true
 }
 
 while true; do
@@ -66,7 +69,7 @@ while true; do
             echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw" \
                 > hwlogs/CAPTURED
             git add -f hwlogs/CAPTURED 2>/dev/null
-            git commit -q -m "Hardware capture complete" 2>/dev/null || true
+            git commit -q -m "Hardware capture complete" -- hwlogs 2>/dev/null || true
             exit 0
         fi
         echo "[$ts] capture incomplete (rc_hw3=$rc_hw3 rc_bench=$rc_bench); resuming probe loop"

@@ -197,3 +197,5 @@ def test_runner_row():
     )
     assert row["valid"], row["error"]
     assert np.isfinite(row["Throughput (TFLOPS)"])
+    # the schema says what the number is: this family reports bandwidth
+    assert row["unit"] == "GB/s"

@@ -306,6 +306,108 @@ class TestCacheOps:
             )
 
 
+class TestPrefixRetirement:
+    """Clearing/replacing the shared prefix while slots are IN FLIGHT
+    must not release pages their tables still map: a freed page would be
+    reallocated by the next admission (or the replacement prefix's own
+    scatter) and overwritten under an active sequence's reads. Release
+    is refcounted: deferred until the last mapping slot finishes."""
+
+    PREFIX = np.arange(1, 17, dtype=np.int32)   # 2 full pages of 8
+
+    def _admit_four(self, eng, max_new=8):
+        from ddlb_tpu.models.serving import Request
+
+        rng = np.random.default_rng(11)
+        prompts = []
+        for _ in range(4):
+            p = np.empty(20, np.int32)
+            p[:16] = self.PREFIX
+            p[16:] = rng.integers(1, 64, 4)
+            prompts.append(p)
+            eng.submit(Request(p, max_new=max_new))
+        assert eng.admit_ready() == 4
+        eng.step()
+        eng.step()
+        return prompts
+
+    def test_clear_mid_flight_defers_release(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        # pool exactly = prefix (tp*2=4) + 4 slots x 2 fresh = 12: after
+        # the four admissions the ONLY pages a new admission could get
+        # are the prefix's — the bug would hand them over mid-read
+        eng, mesh, params = _engine(cfg, S_max=40, num_pages=12)
+        eng.set_shared_prefix(self.PREFIX)
+        prompts = self._admit_four(eng)
+
+        eng.set_shared_prefix(None)
+        # pages retired, NOT freed: all four slots still map them
+        assert len(eng._retired_prefix) == 1
+        pages, slots = eng._retired_prefix[0]
+        assert sorted(pages) and slots == {0, 1, 2, 3}
+        assert eng.stats.pages_in_use == 12
+        assert not eng._free_pages
+
+        # a post-clear request must DEFER (no free pages), not steal the
+        # retired prefix pages
+        extra = np.arange(30, 42, dtype=np.int32)  # 12 tokens, no match
+        eng.submit(Request(extra, max_new=4))
+        assert eng.admit_ready() == 0
+        assert not eng._free_pages  # retired pages stayed unavailable
+
+        done = eng.run()
+        assert len(done) == 5
+        for c in done:
+            p = prompts[c.request_index] if c.request_index < 4 else extra
+            n_new = 8 if c.request_index < 4 else 4
+            want = _oracle_chain(mesh, cfg, params, p, c.slot, eng.B, n_new)
+            np.testing.assert_array_equal(c.tokens, want)
+        # drained: retirement released everything back
+        assert eng._retired_prefix == []
+        assert sorted(eng._free_pages) == list(range(12))
+
+    def test_replace_mid_flight_defers_old_release(self):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        # ample pool: replacement seeds fresh pages while the old set
+        # stays pinned under the four in-flight slots
+        eng, mesh, params = _engine(cfg, S_max=40, num_pages=20)
+        eng.set_shared_prefix(self.PREFIX)
+        old_pages = sorted(eng._prefix_pages)
+        prompts = self._admit_four(eng)
+
+        new_prefix = np.arange(101, 118, dtype=np.int32)  # 17 tokens
+        eng.set_shared_prefix(new_prefix)
+        assert len(eng._retired_prefix) == 1
+        assert sorted(eng._retired_prefix[0][0]) == old_pages
+        # the new prefix's pages are disjoint from the retired set
+        assert not set(eng._prefix_pages) & set(old_pages)
+
+        # admissions under the NEW prefix while the old one drains
+        rng = np.random.default_rng(13)
+        extras = []
+        for _ in range(2):
+            p = np.empty(21, np.int32)
+            p[:17] = new_prefix
+            p[17:] = rng.integers(1, 64, 4)
+            extras.append(p)
+            eng.submit(Request(p, max_new=4))
+
+        done = eng.run()
+        assert len(done) == 6
+        for c in done:
+            p = (prompts[c.request_index] if c.request_index < 4
+                 else extras[c.request_index - 4])
+            n_new = 8 if c.request_index < 4 else 4
+            want = _oracle_chain(mesh, cfg, params, p, c.slot, eng.B, n_new)
+            np.testing.assert_array_equal(c.tokens, want)
+        assert eng._retired_prefix == []
+        assert eng.stats.pages_in_use == len(eng._prefix_pages)
+
+
 class TestGuards:
     def test_paged_rejects_dp(self):
         from ddlb_tpu.models.decode import make_decode_fn
@@ -347,6 +449,21 @@ class TestGuards:
         eng.submit(Request(np.arange(1, 9, dtype=np.int32), max_new=4))
         done = eng.run()
         assert len(done) == 1
+
+    def test_admit_raises_when_prefix_growth_makes_head_unfittable(self):
+        # submit() screens against the prefix pin AT SUBMIT TIME; if the
+        # prefix then grows, a queued head that can never fit must fail
+        # loudly at admission, not defer forever (run() livelock)
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg, S_max=48, num_pages=8)
+        eng.submit(Request(np.arange(1, 9, dtype=np.int32), max_new=24))
+        # 24-token prefix pins tp*3 = 6 of 8 pages; the queued request
+        # needs ceil((8+24)/8) = 4 > 2 attainable
+        eng.set_shared_prefix(np.arange(1, 25, dtype=np.int32))
+        with pytest.raises(RuntimeError, match="can\\s+ever free"):
+            eng.run()
 
     def test_submit_rejects_unfittable_request(self):
         # a request that could NEVER fit the pool must fail at submit,

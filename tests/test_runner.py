@@ -48,6 +48,7 @@ def test_worker_row_schema():
         "k",
         "dtype",
         "Throughput (TFLOPS)",
+        "unit",
         "world_size",
         "hostname",
         "time_measurement_backend",
