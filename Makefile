@@ -22,10 +22,8 @@ bench:
 lint:
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
 		$(PYTHON) -m pyflakes ddlb_tpu tests scripts bench.py __graft_entry__.py; \
-	else \
-		echo "pyflakes not installed; using scripts/lint.py (undefined-name check)"; \
-		$(PYTHON) scripts/lint.py ddlb_tpu tests scripts bench.py __graft_entry__.py; \
 	fi
+	@$(PYTHON) scripts/lint.py ddlb_tpu tests scripts bench.py __graft_entry__.py
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

@@ -10,7 +10,7 @@ Public API is lazily exported like the reference package root
 
 from __future__ import annotations
 
-__version__ = "0.4.0"
+__version__ = "0.8.0"
 
 _LAZY = {
     "PrimitiveBenchmarkRunner": ("ddlb_tpu.benchmark", "PrimitiveBenchmarkRunner"),

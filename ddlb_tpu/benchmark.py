@@ -79,19 +79,36 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     result = None
     impl = None
     option_repr = _format_options(options)
+    # Phase heartbeats: flushed BEFORE each long stage so a worker that
+    # dies on a timeout leaves a log saying WHICH stage ate the clock
+    # (the r2 live session burned 1800 s on a ctx=8192 row and the
+    # TimeoutError could not distinguish a slow compile from a hung
+    # relay — r4 verdict weak #8). hw_common forwards these lines from
+    # crashed/hung children on every exit path.
+    def _mark(stage: str, t0=[now_ns()]) -> None:
+        t1 = now_ns()
+        print(
+            f"[ddlb_tpu] worker: {stage} (+{(t1 - t0[0]) * 1e-9:.1f}s)",
+            flush=True,
+        )
+        t0[0] = t1
+
     try:
         impl_class = load_impl_class(primitive, base_impl)
         # option merge: DEFAULT_OPTIONS ∪ overrides (reference
         # benchmark.py:76-77); crash isolation covers construction too —
         # a bad option or OOM becomes a row, not an aborted sweep
         # (reference per-impl child process, benchmark.py:336-370).
+        _mark("setup begin (backend init + operand placement + prefill)")
         impl = impl_class(m, n, k, dtype=dtype, **options)
         option_repr = _format_options(impl.options)
+        _mark("setup done; warmup begin (first compile happens here)")
 
         # warmup (reference benchmark.py:84-85)
         for _ in range(num_warmups):
             result = impl.run()
         fence(result)
+        _mark("warmup done; measuring")
 
         # profiler window (reference cudaProfilerStart/Stop window,
         # benchmark.py:87-104 -> jax.profiler trace for xprof/tensorboard)
@@ -115,6 +132,7 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
             min_window_s=config.get("device_loop_min_window_ms", 100.0) * 1e-3,
         )
         times_ms = _max_reduce_across_processes(times_ms, runtime)
+        _mark("measured; validation begin" if do_validate else "measured")
 
         valid = True
         if do_validate:

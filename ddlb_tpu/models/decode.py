@@ -720,7 +720,7 @@ def make_chunk_decode_fn(mesh, cfg: TransformerConfig):
     return chunk, shardings
 
 
-def make_prefill_fn(mesh, cfg: TransformerConfig):
+def make_prefill_fn(mesh, cfg: TransformerConfig, dynamic_last: bool = False):
     """Full-sequence prompt pass over a ``('dp', 'tp')`` mesh: fills the
     cache for positions ``[0, S)`` and returns the last position's logits.
 
@@ -729,6 +729,13 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
     phase — so ``cfg.attn_kernel='flash'`` (the default) runs the prompt
     attention on the Pallas flash kernels, exactly the long-S regime they
     exist for; ``'einsum'`` keeps the HBM-score-matrix form for A/B.
+
+    ``dynamic_last=True`` is the bucketed-prompt form: the returned fn
+    takes a fourth TRACED scalar ``last`` and emits the logits at that
+    position instead of ``S - 1`` — the serving engine pads prompts to
+    power-of-two buckets so compile count is O(log S), and reads each
+    prompt's true last row (the pad tail is causally downstream and
+    never influences it).
     """
 
     tp = mesh.shape["tp"]
@@ -756,7 +763,7 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
 
     int8_cache = cfg.kv_cache == "int8"
 
-    def body(params, cache, tokens):
+    def body(params, cache, tokens, last):
         b, S = tokens.shape
         if b % tp != 0:
             raise ValueError(f"per-dp batch {b} not divisible by tp={tp}")
@@ -799,8 +806,19 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
             u = _block_moe(h2.reshape(b * S, D), params, l, cfg, tp)
             x = x + u.reshape(b, S, D)
         h = _rms_norm(x, params["ln_f"])
+        # ``last`` (dynamic_last=True) indexes the logits position so a
+        # BUCKETED prompt — padded past its real length — reads its own
+        # last row: K/V row j and hidden row i depend only on tokens
+        # <= themselves under the causal mask, so pad-tail garbage never
+        # reaches rows [0, last]. The index is a traced scalar: bucket
+        # length, not prompt length, drives compiles.
+        h_last = (
+            h[:, -1]
+            if last is None
+            else jax.lax.dynamic_index_in_dim(h, last, axis=1, keepdims=False)
+        )
         logits = jnp.matmul(
-            h[:, -1], params["head"], preferred_element_type=jnp.float32
+            h_last, params["head"], preferred_element_type=jnp.float32
         )
         return logits, cache
 
@@ -813,14 +831,27 @@ def make_prefill_fn(mesh, cfg: TransformerConfig):
     }
     cspecs = cache_specs(cfg)
 
-    def prefill(params, cache, tokens):
-        return jax.shard_map(
-            body,
-            mesh=mesh,
-            in_specs=(specs, cspecs, P("dp", None)),
-            out_specs=(P("dp", None), cspecs),
-            check_vma=False,
-        )(params, cache, tokens)
+    if dynamic_last:
+
+        def prefill(params, cache, tokens, last):
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(specs, cspecs, P("dp", None), P()),
+                out_specs=(P("dp", None), cspecs),
+                check_vma=False,
+            )(params, cache, tokens, last)
+
+    else:
+
+        def prefill(params, cache, tokens):
+            return jax.shard_map(
+                functools.partial(body, last=None),
+                mesh=mesh,
+                in_specs=(specs, cspecs, P("dp", None)),
+                out_specs=(P("dp", None), cspecs),
+                check_vma=False,
+            )(params, cache, tokens)
 
     shardings = {k: NamedSharding(mesh, s) for k, s in specs.items()}
     shardings["tokens"] = NamedSharding(mesh, P("dp", None))

@@ -100,6 +100,10 @@ class Completion:
 
 @dataclass
 class EngineStats:
+    """Counters one ``run()``/manual drive accumulates: scheduling
+    health (occupancy, deferrals), prefix-cache effectiveness, and
+    page-pool pressure in the paged layout."""
+
     steps: int = 0              # ragged decode ticks
     generated: int = 0          # tokens emitted so far (incl. active slots)
     admissions: int = 0
@@ -138,6 +142,7 @@ class ContinuousBatchingEngine:
         max_len: int,
         eos_id: Optional[int] = None,
         num_pages: Optional[int] = None,
+        bucket_prefill: bool = True,
     ):
         if mesh.shape.get("dp", 1) != 1:
             raise ValueError(
@@ -188,12 +193,31 @@ class ContinuousBatchingEngine:
 
         from ddlb_tpu.models.decode import make_chunk_decode_fn
 
+        # bucketed admission (default): prompts pad to power-of-two
+        # scratch lengths so prefill/chunk/copy compile O(log S_max)
+        # programs instead of one per distinct prompt length — the
+        # compile-storm hazard of realistic length distributions. The
+        # pad tail is causally downstream of every real row (K/V row j
+        # depends only on token j; attention is masked), so tokens are
+        # identical to exact-length admission (pinned in
+        # tests/test_serving_engine.py / test_paged.py).
+        self._bucket_prefill = bucket_prefill
         decode, _ = make_decode_fn(mesh, cfg, ragged=True)
         self._decode = jax.jit(decode)
-        prefill, _ = make_prefill_fn(mesh, scratch_cfg)
+        prefill, _ = make_prefill_fn(
+            mesh, scratch_cfg, dynamic_last=bucket_prefill
+        )
         self._prefill = jax.jit(prefill)
         chunk, _ = make_chunk_decode_fn(mesh, scratch_cfg)
         self._chunk = jax.jit(chunk)
+        # dynamic last-position pick for the bucketed chunk path (the
+        # index is traced: logits shape, not suffix length, drives
+        # compiles)
+        self._pick = jax.jit(
+            lambda lg, i: jax.lax.dynamic_index_in_dim(
+                lg, i, axis=1, keepdims=False
+            )
+        )
         # shared-prefix state (set_shared_prefix)
         self._prefix_tokens: Optional[np.ndarray] = None
         self._prefix_scratch = None
@@ -484,7 +508,14 @@ class ContinuousBatchingEngine:
         scratch = init_cache(
             self._scratch_cfg, self.tp, prefix.size, mesh=self.mesh
         )
-        _, scratch = self._prefill(self.params, scratch, rep)
+        if self._bucket_prefill:
+            # prefix prefill stays exact-length (a one-time cost, and
+            # _seed_prefix/page seeding key on the exact row count)
+            _, scratch = self._prefill(
+                self.params, scratch, rep, jnp.int32(prefix.size - 1)
+            )
+        else:
+            _, scratch = self._prefill(self.params, scratch, rep)
         self._prefix_tokens = prefix
         self._prefix_scratch = jax.block_until_ready(scratch)
         if self.paged:
@@ -564,36 +595,71 @@ class ContinuousBatchingEngine:
             n += 1
         return n
 
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Next power of two >= n, floored at 16 — the prompt-length
+        buckets that bound admission compiles at O(log S_max)."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
     def _admit(self, slot: int, req_idx: int) -> None:
         req = self._requests[req_idx]
         S0 = req.prompt.size
         assert S0 + req.max_new <= self.S_max  # screened in submit()
-        # tp-replicated prefill into a scratch cache (one compile per
-        # distinct S0); keep copy e(slot)'s rows + logits. With a shared
-        # prefix match, seed the scratch from the prefix cache and
-        # chunk-decode only the suffix (O((S0-P)*S0) attention instead of
-        # O(S0^2), and no prefix MLP/projection recompute).
+        # tp-replicated prefill into a scratch cache (bucketed: one
+        # compile per power-of-two bucket; exact-length when
+        # bucket_prefill=False); keep copy e(slot)'s rows + logits. With
+        # a shared prefix match, seed the scratch from the prefix cache
+        # and chunk-decode only the suffix (O((S0-P)*S0) attention
+        # instead of O(S0^2), and no prefix MLP/projection recompute).
+        # Bucket-pad tails hold token 0: their K/V rows are garbage the
+        # causal mask keeps downstream of every real row, the copy path
+        # either drops them (paged sentinel coords) or parks them past
+        # ``pos`` where the ragged decode write-then-masked-read
+        # overwrites before any read.
         e = self._expert_of(slot)
         P_len = self._prefix_match_len(req)
-        scratch = init_cache(self._scratch_cfg, self.tp, S0, mesh=self.mesh)
         if P_len:
+            t_real = S0 - P_len
+            t_pad = (
+                min(self._bucket(t_real), self.S_max - P_len)
+                if self._bucket_prefill
+                else t_real
+            )
+            scratch = init_cache(
+                self._scratch_cfg, self.tp, P_len + t_pad, mesh=self.mesh
+            )
             scratch = self._seed_prefix(scratch, self._prefix_scratch)
-            suffix = jnp.asarray(
-                np.broadcast_to(
-                    req.prompt[P_len:], (self.tp, S0 - P_len)
-                ).copy()
-            )
+            suffix_np = np.zeros((self.tp, t_pad), np.int32)
+            suffix_np[:, :t_real] = req.prompt[P_len:]
             logits, scratch = self._chunk(
-                self.params, scratch, suffix, jnp.int32(P_len)
+                self.params, scratch, jnp.asarray(suffix_np), jnp.int32(P_len)
             )
-            logits = logits[:, -1]
+            logits = self._pick(logits, jnp.int32(t_real - 1))
             self.stats.prefix_hits += 1
             self.stats.prefill_tokens_saved += P_len
         else:
-            prompt_rep = jnp.asarray(
-                np.broadcast_to(req.prompt, (self.tp, S0)).copy()
+            s_pad = (
+                min(self._bucket(S0), self.S_max)
+                if self._bucket_prefill
+                else S0
             )
-            logits, scratch = self._prefill(self.params, scratch, prompt_rep)
+            scratch = init_cache(
+                self._scratch_cfg, self.tp, s_pad, mesh=self.mesh
+            )
+            prompt_np = np.zeros((self.tp, s_pad), np.int32)
+            prompt_np[:, :S0] = req.prompt
+            prompt_rep = jnp.asarray(prompt_np)
+            if self._bucket_prefill:
+                logits, scratch = self._prefill(
+                    self.params, scratch, prompt_rep, jnp.int32(S0 - 1)
+                )
+            else:
+                logits, scratch = self._prefill(
+                    self.params, scratch, prompt_rep
+                )
         if self.paged:
             self._map_slot_pages(slot, req, e, P_len, scratch)
         else:
@@ -641,9 +707,12 @@ class ContinuousBatchingEngine:
         self._table_np[slot] = row
         self._slot_pages[slot] = fresh
         self._push_table()
-        # scatter coords for all S0 scratch rows; the shared span drops
-        pages_vec = np.full(S0, self.num_pages, np.int32)
-        rows_vec = np.arange(S0, dtype=np.int32) % ps
+        # scatter coords for every scratch row (the scratch may be
+        # bucket-padded past S0); the shared-prefix span AND the pad
+        # tail map to the sentinel page and drop
+        s_len = scratch["k"].shape[2]
+        pages_vec = np.full(s_len, self.num_pages, np.int32)
+        rows_vec = np.arange(s_len, dtype=np.int32) % ps
         owned_rows = np.arange(p_full * ps, S0, dtype=np.int32)
         pages_vec[owned_rows] = row[owned_rows // ps]
         self._scatter_into_pool(scratch, pages_vec, rows_vec, e)

@@ -1,0 +1,207 @@
+"""Static HBM budget model for the serving-family configs.
+
+Why this exists (round-4 verdict #2): the one live relay session lost
+every ctx >= 4096 decode row to RESOURCE_EXHAUSTED or a timeout, and the
+diagnosis took a second session that never came. The OOMs were
+predictable from shapes alone — the pre-fix validation oracle held TWO
+full ``[B, H, S, S]`` f32 score matrices (17 GB at ctx=4096/B=8), and at
+ctx=64k the prefill's ``[B, S, F]`` MLP live set (10.7 GB) plus the bf16
+MHA cache (4.3 GB) cannot fit 16 GB regardless of the oracle. This
+module makes that arithmetic a pre-flight gate: the measurement batches
+consult it BEFORE burning a 1800-s worker timeout, and right-size the
+batch instead of dying.
+
+This is a planning model, not an allocator. Components are the dominant
+live sets; XLA's true peak depends on fusion and scheduling, so the
+default limit keeps 10% of physical HBM as headroom and a flat slack
+term covers executables/workspace. Calibration points (first live
+session, 2026-07-31): ctx=1024 rows ran in ~3 GB as modeled; the
+ctx=4096 full-matrix-oracle OOM and the einsum-prefill ~4k OOM cliff are
+both reproduced by the model (tests/test_hbm_budget.py).
+
+Component census (bf16 activations, f32 oracle scores — matching
+models/decode.py and models/transformer.py):
+
+- ``weights``: untied embed + LM head ``2 * V * D`` bf16, per layer
+  q/o projections ``2 D^2`` + k/v ``2 D^2 * kv_frac`` bf16, routed MLP
+  ``2 D F`` (int8 under ``mlp_kernel=int8_weights``).
+- ``kv_cache``: ``layers * 2 * B * S_cache * h_kv * dh`` at 1 (int8,
+  plus f32 per-(position, head) scales) or 2 (bf16) bytes.
+- ``prefill_live``: the prompt pass's dominant concurrent buffers —
+  ``max(B*S*(D+F), 4*B*S*D)`` activations plus the ``B*S*D`` residual
+  stream, all bf16; with ``attn_kernel='einsum'`` add two f32
+  ``[B, H, S, S]`` score copies (the cliff that forces flash past ~4k).
+- ``oracle_live`` (``validate=True`` only): the q-chunked teacher-forced
+  oracle (models/decode._oracle_attention) — same activation census at
+  the validated length plus two f32 score chunks capped at 1 GiB each.
+  The oracle runs while the measured args are still resident, so it adds
+  on top of weights+cache.
+- ``slack``: flat 512 MiB for compiled executables, logits, fori_loop
+  state and XLA workspace.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+GiB = float(1 << 30)
+
+#: v5e physical HBM; the gate keeps 10% headroom (the model is planning,
+#: not allocation — fusion/scheduling can move peak by that much)
+V5E_HBM_BYTES = 16 * GiB
+DEFAULT_LIMIT = 0.9 * V5E_HBM_BYTES
+
+_SLACK = 0.5 * GiB
+_ORACLE_CHUNK_CAP = 1.0 * GiB  # models/decode._oracle_attention's target
+
+
+@dataclass
+class BudgetReport:
+    """Per-component HBM bytes for one serving config, plus the verdict."""
+
+    components: Dict[str, float]
+    limit: float = DEFAULT_LIMIT
+    meta: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def total(self) -> float:
+        return sum(self.components.values())
+
+    @property
+    def fits(self) -> bool:
+        return self.total <= self.limit
+
+    def line(self) -> str:
+        parts = "  ".join(
+            f"{k}={v / GiB:.2f}" for k, v in self.components.items()
+        )
+        return (
+            f"hbm budget: total {self.total / GiB:.2f} GiB "
+            f"{'<=' if self.fits else '>'} limit {self.limit / GiB:.1f} "
+            f"({parts})"
+        )
+
+
+def decode_budget(
+    *,
+    ctx: int,
+    d_model: int,
+    d_ff: int,
+    vocab: int,
+    n_heads: int,
+    batch: int,
+    n_kv_heads: int = 0,
+    layers: int = 1,
+    kv_cache: str = "bf16",
+    mlp_kernel: str = "bf16",
+    attn_kernel: str = "flash",
+    phase: str = "decode",
+    validate: bool = True,
+    n_new: int = 32,
+    spec_k: int = 4,
+    draft_layers: int = 1,
+    page_pool_frac: float = 1.0,
+    cache_layout: str = "contiguous",
+    limit: float = DEFAULT_LIMIT,
+) -> BudgetReport:
+    """Model the HBM peak of one ``transformer_decode`` config.
+
+    Mirrors the shapes the spmd member actually allocates
+    (primitives/transformer_decode/spmd.py): phase=decode prefills a
+    ``ctx+1`` cache then measures one step; generate/speculate size the
+    cache for the whole loop (speculate adds the draft's params+cache);
+    serve sizes the engine pool. Single-chip (tp=1) weights — the
+    measurement batches this gates run on one chip.
+    """
+    D, F, V, B, L = d_model, d_ff, vocab, batch, layers
+    h_kv = n_kv_heads or n_heads
+    kv_frac = h_kv / n_heads
+    dh = D // n_heads
+
+    w_bytes = 1 if mlp_kernel == "int8_weights" else 2
+    weights = (
+        2.0 * V * D * 2  # embed + untied head
+        + L * ((2.0 + 2.0 * kv_frac) * D * D * 2 + 2.0 * D * F * w_bytes)
+    )
+    if phase == "speculate":
+        weights *= (L + draft_layers) / L if L else 1.0
+
+    # cache horizon per phase (spmd.py's init_cache calls)
+    if phase == "decode":
+        s_cache = ctx + 1
+    elif phase == "prefill":
+        s_cache = ctx
+    elif phase == "generate":
+        s_cache = ctx + n_new
+    elif phase == "speculate":
+        s_cache = ctx + n_new + spec_k
+    elif phase == "serve":
+        s_cache = ctx + n_new
+    else:
+        raise ValueError(f"unknown phase {phase!r}")
+
+    def cache_bytes(n_layers: int, s: float) -> float:
+        per_pos = 2.0 * B * s * h_kv * dh  # K and V
+        total = n_layers * per_pos * (1 if kv_cache == "int8" else 2)
+        if kv_cache == "int8":
+            total += n_layers * 2.0 * B * s * h_kv * 4  # f32 scales
+        return total
+
+    cache = cache_bytes(L, s_cache)
+    if phase == "speculate":
+        cache += cache_bytes(draft_layers, s_cache)
+    if phase == "serve" and cache_layout == "paged":
+        cache *= page_pool_frac
+
+    def act_live(b: float, s: float) -> float:
+        # dominant concurrent buffers of one full-sequence forward:
+        # the first MLP matmul's in+out vs flash attention's q/k/v/out,
+        # plus the residual stream — all bf16
+        return b * s * (max(D + F, 4.0 * D) + D) * 2.0
+
+    prefill_s = ctx  # every phase's big pass is over the prompt
+    prefill_live = act_live(B, prefill_s)
+    if attn_kernel == "einsum":
+        # two concurrent f32 [B, H, S, S] copies (scores + softmax) —
+        # the cliff that forces flash prefill past ctx ~4k
+        prefill_live += 2.0 * B * n_heads * float(prefill_s) ** 2 * 4
+    if phase == "serve":
+        # admission prefill is tp-replicated per request (tp slots),
+        # not batch-wide; on one chip that is a 1-row pass
+        prefill_live = act_live(1, ctx)
+
+    oracle_live = 0.0
+    if validate:
+        s_val = ctx + 1 if phase == "decode" else ctx
+        full_scores = B * n_heads * float(s_val) ** 2 * 4
+        oracle_live = act_live(B, s_val) + 2.0 * min(
+            full_scores, _ORACLE_CHUNK_CAP
+        )
+
+    report = BudgetReport(
+        components={
+            "weights": weights,
+            "kv_cache": cache,
+            "act_peak": max(prefill_live, oracle_live),
+            "slack": _SLACK,
+        },
+        limit=limit,
+        meta={"ctx": ctx, "batch": B, "phase": phase, "validate": validate},
+    )
+    return report
+
+
+def fit_batch(
+    preferred_batch: int = 8, min_batch: int = 1, **kwargs
+) -> "tuple[int, BudgetReport]":
+    """Largest batch in {preferred, preferred/2, ...} >= ``min_batch``
+    whose budget fits; falls back to ``min_batch`` (caller checks
+    ``report.fits``). The measurement batches use one batch per context
+    so the lever A/B rows at that context stay comparable."""
+    b = preferred_batch
+    while True:
+        report = decode_budget(batch=b, **kwargs)
+        if report.fits or b <= min_batch:
+            return b, report
+        b = max(min_batch, b // 2)

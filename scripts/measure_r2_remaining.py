@@ -30,6 +30,17 @@ run = functools.partial(run_and_print, proto(QUICK))
 
 SERVE = dict(batch=8, vocab=16384, n_heads=16)
 for ctx in (4096,) if QUICK else (4096, 8192):
+    # pre-flight the arithmetic that ate these rows last session: with
+    # the q-chunked oracle both contexts fit at B=8 (~4-5 GiB peak,
+    # tests/test_hbm_budget.py); the printed line puts the budget next
+    # to the row so an OOM here falsifies the MODEL, not just the row
+    from ddlb_tpu.utils.hbm_budget import decode_budget
+
+    rep = decode_budget(
+        ctx=ctx, batch=8, d_model=2048, d_ff=8192, vocab=16384,
+        n_heads=16, layers=1, phase="decode", validate=True,
+    )
+    print(f"[budget] ctx={ctx}: {rep.line()}", flush=True)
     for mlp in ("bf16", "int8_weights"):
         run(
             "transformer_decode", "spmd", ctx, 2048, 8192,

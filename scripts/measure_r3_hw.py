@@ -50,14 +50,14 @@ D, F, V, HEADS, B, LAYERS = 2048, 8192, 16384, 16, 8, 1
 DH = D // HEADS
 
 
-def decode_bytes(ctx, n_kv, kv_cache, mlp_kernel, tp=1):
+def decode_bytes(ctx, b, n_kv, kv_cache, mlp_kernel, tp=1):
     """HBM bytes read per decode step (the bandwidth model): K+V cache at
     the context length + this chip's weights once."""
     h_kv = n_kv or HEADS
     kv_bytes = 1 if kv_cache == "int8" else 2
-    cache = 2 * LAYERS * B * ctx * h_kv * DH * kv_bytes
+    cache = 2 * LAYERS * b * ctx * h_kv * DH * kv_bytes
     if kv_cache == "int8":
-        cache += 2 * LAYERS * B * ctx * h_kv * 4  # f32 scales
+        cache += 2 * LAYERS * b * ctx * h_kv * 4  # f32 scales
     w_bytes = 1 if mlp_kernel == "int8_weights" else 2
     kv_frac = h_kv / HEADS
     # param counts x bytes: q+out proj 2 D^2, k/v 2 D^2 * kv_frac,
@@ -70,24 +70,24 @@ def decode_bytes(ctx, n_kv, kv_cache, mlp_kernel, tp=1):
     return cache + weights
 
 
-def serving_row(ctx, label, **opts):
+def serving_row(ctx, b, label, **opts):
     # attn_kernel governs the SETUP prefill (flash: no [B,H,S,S] scores —
     # einsum prefill OOMs past ctx~4k); the measured decode step's
     # einsum-vs-fused lever is decode_kernel (r4 batch section 1c)
     row = run(
         "transformer_decode", "spmd", ctx, D, F,
-        label=label, batch=B, vocab=V, n_heads=HEADS, phase="decode",
+        label=label, batch=b, vocab=V, n_heads=HEADS, phase="decode",
         attn_kernel="flash", **opts,
     )
     t_ms = row["median time (ms)"]
-    toks = B / t_ms * 1e3
+    toks = b / t_ms * 1e3
     gb = decode_bytes(
-        ctx, opts.get("n_kv_heads", 0), opts.get("kv_cache", "bf16"),
+        ctx, b, opts.get("n_kv_heads", 0), opts.get("kv_cache", "bf16"),
         opts.get("mlp_kernel", "bf16"),
     ) / 1e9
     frac = gb / (t_ms / 1e3) / V5E_HBM_GBPS
     print(
-        f"    -> {t_ms / B:.3f} ms/token  {toks:,.0f} tok/s   "
+        f"    -> {t_ms / b:.3f} ms/token  {toks:,.0f} tok/s   "
         f"bytes-read model {gb:.2f} GB/step  HBM fraction {frac:.2f}",
         flush=True,
     )
@@ -96,14 +96,35 @@ def serving_row(ctx, label, **opts):
 
 CONTEXTS = (2048, 8192) if QUICK else (2048, 8192, 32768, 65536)
 for ctx in CONTEXTS:
-    serving_row(ctx, f"bf16 cache, MHA @ {ctx}")
-    serving_row(ctx, f"int8 cache, MHA @ {ctx}", kv_cache="int8")
-    serving_row(ctx, f"bf16 cache, GQA4 @ {ctx}", n_kv_heads=4)
+    # One batch per context, sized so the LEAST-capable lever row (bf16
+    # MHA, validated) fits the chip — the r2 live session lost every
+    # ctx>=4096 row to OOM/timeouts this gate now prevents, and one B
+    # per context keeps the lever A/B rows comparable. At 64k the model
+    # says B=8 cannot fit (prefill [B,S,F] live set + 4.3-GiB cache);
+    # B=4 fits WITH validation (tests/test_hbm_budget.py).
+    from ddlb_tpu.utils.hbm_budget import fit_batch
+
+    b_ctx, rep = fit_batch(
+        preferred_batch=B, ctx=ctx, d_model=D, d_ff=F, vocab=V,
+        n_heads=HEADS, layers=LAYERS, phase="decode", validate=True,
+    )
+    print(f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}", flush=True)
+    if not rep.fits:
+        print(f"[budget] ctx={ctx}: SKIPPED — no batch fits", flush=True)
+        continue
+    serving_row(ctx, b_ctx, f"bf16 cache, MHA @ {ctx} B={b_ctx}")
     serving_row(
-        ctx, f"int8 cache, GQA4 @ {ctx}", n_kv_heads=4, kv_cache="int8"
+        ctx, b_ctx, f"int8 cache, MHA @ {ctx} B={b_ctx}", kv_cache="int8"
     )
     serving_row(
-        ctx, f"int8 cache + int8 weights @ {ctx}",
+        ctx, b_ctx, f"bf16 cache, GQA4 @ {ctx} B={b_ctx}", n_kv_heads=4
+    )
+    serving_row(
+        ctx, b_ctx, f"int8 cache, GQA4 @ {ctx} B={b_ctx}",
+        n_kv_heads=4, kv_cache="int8",
+    )
+    serving_row(
+        ctx, b_ctx, f"int8 cache + int8 weights @ {ctx} B={b_ctx}",
         kv_cache="int8", mlp_kernel="int8_weights",
     )
 

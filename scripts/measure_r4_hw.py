@@ -166,7 +166,22 @@ if not SMOKE:
 # fast-decode levers shrink the cache (scores become a larger fraction).
 
 if not SMOKE:
+    from ddlb_tpu.utils.hbm_budget import fit_batch
+
     for ctx in (8192, 32768, 65536):
+        # one batch per context, sized so the worst lever (bf16 MHA)
+        # fits — at 64k the budget model says B=8 cannot (prefill
+        # [B,S,F] live set + 4.3-GiB cache; tests/test_hbm_budget.py),
+        # which is the OOM class that ate the r2 live session
+        b_ctx, rep = fit_batch(
+            preferred_batch=8, ctx=ctx, d_model=2048, d_ff=8192,
+            vocab=16384, n_heads=16, layers=1, phase="decode",
+            validate=False,
+        )
+        print(f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}", flush=True)
+        if not rep.fits:
+            print(f"[budget] ctx={ctx}: SKIPPED — no batch fits", flush=True)
+            continue
         for lbl, extra in (
             ("bf16 MHA", {}),
             ("int8+GQA4", {"kv_cache": "int8", "n_kv_heads": 4}),
@@ -176,8 +191,8 @@ if not SMOKE:
                 # OOMs past ctx~4k); decode_kernel is the measured lever
                 run(
                     "transformer_decode", "spmd", ctx, 2048, 8192,
-                    label=f"decode @{ctx} {lbl} kernel={dk}",
-                    phase="decode", batch=8, vocab=16384, n_heads=16,
+                    label=f"decode @{ctx} {lbl} kernel={dk} B={b_ctx}",
+                    phase="decode", batch=b_ctx, vocab=16384, n_heads=16,
                     attn_kernel="flash", decode_kernel=dk, **extra,
                 )
 

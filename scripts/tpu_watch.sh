@@ -24,11 +24,23 @@ PROBE='from ddlb_tpu.runtime import Runtime; r = Runtime(); print("PROBE_OK", r.
 commit_capture() {
     # persist whatever exists right now; never fail the watch loop.
     # The commit is pathspec-restricted so content a concurrent session
-    # staged in the index is NOT swept into the automated commit.
-    git add -f hwlogs/*.out hwlogs/*.err 2>/dev/null
-    git add bench_tpu_cache.json autotune_cache.json 2>/dev/null
-    git commit -q -m "Hardware capture: $1" \
-        -- hwlogs bench_tpu_cache.json autotune_cache.json 2>/dev/null || true
+    # staged in the index is NOT swept into the automated commit — but a
+    # pathspec git doesn't know (e.g. autotune_cache.json before the
+    # first tuning pass) aborts the WHOLE commit, so only the staged
+    # changes among the intended paths are passed.
+    # one add per existing path: git add aborts the WHOLE invocation if
+    # ANY pathspec matches nothing (an unmatched glob passes through
+    # literally), which would silently drop every capture until all
+    # four patterns exist
+    for f in hwlogs/*.out hwlogs/*.err bench_tpu_cache.json \
+             autotune_cache.json; do
+        [ -e "$f" ] && git add -f "$f" 2>/dev/null
+    done
+    staged=$(git diff --cached --name-only -- \
+        hwlogs bench_tpu_cache.json autotune_cache.json)
+    [ -n "$staged" ] || return 0
+    # shellcheck disable=SC2086  # capture paths never contain spaces
+    git commit -q -m "Hardware capture: $1" -- $staged 2>/dev/null || true
 }
 
 while true; do

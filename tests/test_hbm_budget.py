@@ -1,0 +1,103 @@
+"""The static HBM budget model the measurement batches gate on.
+
+The judgments pinned here are the round-4 postmortem turned arithmetic
+(VERDICT r4 #2): the ctx=4096 OOM cliff (einsum/full-matrix scores), the
+q-chunked oracle making ctx=4096 fit, and the ctx=64k bf16-MHA config
+needing batch=4 on a 16-GB v5e — so the next live session right-sizes
+up front instead of burning worker timeouts rediscovering them.
+"""
+
+from ddlb_tpu.utils.hbm_budget import (
+    DEFAULT_LIMIT,
+    GiB,
+    decode_budget,
+    fit_batch,
+)
+
+# the serving-table shape (scripts/measure_r3_hw.py)
+SHAPE = dict(d_model=2048, d_ff=8192, vocab=16384, n_heads=16, layers=1)
+
+
+def test_component_arithmetic_hand_checked():
+    r = decode_budget(ctx=4096, batch=8, phase="decode", **SHAPE)
+    # untied embed+head 2*V*D*2 + (q/o + k/v) 4*D^2*2 + MLP 2*D*F*2
+    assert r.components["weights"] == (
+        2 * 16384 * 2048 * 2 + 4 * 2048 * 2048 * 2 + 2 * 2048 * 8192 * 2
+    )
+    # bf16 K+V over ctx+1 positions: 2 (K,V) * B * S * D * 2 bytes
+    assert r.components["kv_cache"] == 2 * 8 * 4097 * 16 * 128 * 2
+    assert r.fits  # ~3.7 GiB with the q-chunked oracle
+
+
+def test_int8_gqa_cache_shrink():
+    mha = decode_budget(ctx=8192, batch=8, phase="decode", **SHAPE)
+    lever = decode_budget(
+        ctx=8192, batch=8, phase="decode", kv_cache="int8",
+        n_kv_heads=4, **SHAPE,
+    )
+    # int8 quarters-heads cache = bf16 MHA cache / 8, plus f32 scales
+    assert lever.components["kv_cache"] == (
+        mha.components["kv_cache"] / 8 + 2 * 8 * 8193 * 4 * 4
+    )
+
+
+def test_einsum_prefill_cliff_at_4k():
+    # two f32 [B, H, S, S] score copies: the observed ~4k einsum OOM
+    # cliff (and the shape of the pre-fix full-matrix oracle OOM)
+    r = decode_budget(
+        ctx=4096, batch=8, phase="decode", attn_kernel="einsum", **SHAPE
+    )
+    assert not r.fits
+    assert r.components["act_peak"] > 17e9
+
+
+def test_64k_bf16_mha_needs_batch_4():
+    # [B, S, F]-dominated prefill live set + 4.3-GiB cache: B=8 cannot
+    # fit even unvalidated; B=4 fits WITH the q-chunked oracle
+    r8 = decode_budget(
+        ctx=65536, batch=8, phase="decode", validate=False, **SHAPE
+    )
+    assert not r8.fits
+    b, rep = fit_batch(
+        preferred_batch=8, ctx=65536, phase="decode", validate=True,
+        **SHAPE,
+    )
+    assert b == 4 and rep.fits
+
+
+def test_32k_keeps_batch_8_validated():
+    b, rep = fit_batch(
+        preferred_batch=8, ctx=32768, phase="decode", validate=True,
+        **SHAPE,
+    )
+    assert b == 8 and rep.fits
+
+
+def test_64k_int8_gqa_fits_b8_unvalidated():
+    # the fast-decode levers are exactly what buys headroom at 64k
+    r = decode_budget(
+        ctx=65536, batch=8, phase="decode", validate=False,
+        kv_cache="int8", n_kv_heads=4, **SHAPE,
+    )
+    assert r.fits
+
+
+def test_speculate_counts_draft():
+    base = decode_budget(
+        ctx=2048, batch=8, phase="generate", n_new=64, layers=2,
+        **{k: v for k, v in SHAPE.items() if k != "layers"},
+    )
+    spec = decode_budget(
+        ctx=2048, batch=8, phase="speculate", n_new=64, spec_k=4,
+        draft_layers=1, layers=2,
+        **{k: v for k, v in SHAPE.items() if k != "layers"},
+    )
+    assert spec.components["weights"] > base.components["weights"]
+    assert spec.components["kv_cache"] > base.components["kv_cache"]
+
+
+def test_report_line_is_printable():
+    r = decode_budget(ctx=2048, batch=8, phase="decode", **SHAPE)
+    assert r.limit == DEFAULT_LIMIT
+    line = r.line()
+    assert "total" in line and "GiB" in line

@@ -26,7 +26,7 @@ def _cfg(**kw):
     )
 
 
-def _engine(cfg, B=4, S_max=40, eos_id=None):
+def _engine(cfg, B=4, S_max=40, eos_id=None, **engine_kw):
     from ddlb_tpu.models.decode import make_decode_fn
     from ddlb_tpu.models.serving import ContinuousBatchingEngine
     from ddlb_tpu.models.transformer import init_params
@@ -37,7 +37,8 @@ def _engine(cfg, B=4, S_max=40, eos_id=None):
     _, sh = make_decode_fn(mesh, cfg)
     params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
     eng = ContinuousBatchingEngine(
-        mesh, cfg, params, max_batch=B, max_len=S_max, eos_id=eos_id
+        mesh, cfg, params, max_batch=B, max_len=S_max, eos_id=eos_id,
+        **engine_kw,
     )
     return eng, mesh, params
 
@@ -351,3 +352,66 @@ class TestEngineErrors:
             Request(np.zeros((0,), np.int32), max_new=2)
         with pytest.raises(ValueError, match="max_new"):
             Request(np.ones(4, np.int32), max_new=0)
+
+
+class TestBucketedPrefill:
+    """Prompts pad to power-of-two buckets at admission (the default):
+    compile count is O(log S_max), tokens are byte-identical to
+    exact-length prefill — the pad tail is causally downstream of every
+    real row, so it can never influence the kept logits or cache."""
+
+    LENGTHS = (9, 10, 11, 12, 13, 14, 15, 16, 17, 18)  # 2 buckets: 16, 32
+
+    def _drain(self, bucket):
+        from ddlb_tpu.models.serving import Request
+
+        cfg = _cfg()
+        eng, _, _ = _engine(cfg, S_max=48, bucket_prefill=bucket)
+        rng = np.random.default_rng(21)
+        for s in self.LENGTHS:
+            eng.submit(Request(rng.integers(1, 64, s).astype(np.int32),
+                               max_new=4))
+        done = {c.request_index: np.asarray(c.tokens) for c in eng.run()}
+        return eng, done
+
+    def test_two_buckets_compile_two_prefills_tokens_identical(self):
+        bucketed, tok_b = self._drain(bucket=True)
+        exact, tok_e = self._drain(bucket=False)
+        assert tok_b.keys() == tok_e.keys()
+        for idx in tok_b:
+            np.testing.assert_array_equal(tok_b[idx], tok_e[idx])
+        # 10 distinct lengths span buckets {16, 32}: two compiled
+        # prefill programs vs one per distinct length without bucketing
+        assert bucketed._prefill._cache_size() == 2
+        assert exact._prefill._cache_size() == len(set(self.LENGTHS))
+
+    def test_prefix_suffix_buckets(self):
+        # suffix lengths 1..6 against a 9-token prefix: one chunk
+        # compile (bucket 16) where exact-length admission compiles one
+        # per distinct suffix length; tokens equal the exact engine's
+        from ddlb_tpu.models.serving import Request
+
+        prefix = np.arange(1, 10, dtype=np.int32)
+        rng = np.random.default_rng(22)
+        prompts = []
+        for s in (1, 2, 3, 4, 5, 6):
+            prompts.append(np.concatenate(
+                [prefix, rng.integers(1, 64, s).astype(np.int32)]
+            ))
+        outs = []
+        engines = []
+        for bucket in (True, False):
+            cfg = _cfg()
+            eng, _, _ = _engine(cfg, S_max=48, bucket_prefill=bucket)
+            eng.set_shared_prefix(prefix)
+            for p in prompts:
+                eng.submit(Request(p, max_new=4))
+            outs.append(
+                {c.request_index: np.asarray(c.tokens) for c in eng.run()}
+            )
+            engines.append(eng)
+        for idx in outs[0]:
+            np.testing.assert_array_equal(outs[0][idx], outs[1][idx])
+        assert engines[0].stats.prefix_hits == len(prompts)
+        assert engines[0]._chunk._cache_size() == 1
+        assert engines[1]._chunk._cache_size() == len({1, 2, 3, 4, 5, 6})
