@@ -172,6 +172,17 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         num_processes=runtime.num_processes,
         platform=runtime.platform,
     )
+    if impl is not None and np.isfinite(times_ms).any():
+        # family-specific measured quantities (speculate acceptance
+        # rate, serve engine stats); a failure here must not discard
+        # the completed measurement
+        try:
+            row.update(impl.extra_row_fields())
+        except Exception as exc:
+            print(
+                f"[ddlb_tpu] WARNING: extra_row_fields failed: "
+                f"{type(exc).__name__}: {exc}"
+            )
     del impl, result
     return row
 

@@ -201,6 +201,16 @@ class Primitive(ABC):
         attention-family primitives override)."""
         return 2.0 * self.m * self.n * self.k
 
+    def extra_row_fields(self) -> dict:
+        """Family-specific measured quantities merged into the result
+        row AFTER the shared schema (the CSV appender aligns headers, so
+        new columns only appear in fresh CSVs). Called once per row,
+        after timing and validation — safe to run the measured fn again
+        here. Default: nothing. Overrides: transformer_decode reports
+        the speculate phase's MEASURED acceptance rate and the serve
+        phase's engine scheduling stats."""
+        return {}
+
     @abstractmethod
     def validate(self, result) -> bool:
         """Compare against the single-device reference product."""

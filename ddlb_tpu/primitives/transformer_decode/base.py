@@ -352,7 +352,10 @@ class TransformerDecode(Primitive):
         if self.options["phase"] in ("generate", "speculate"):
             # speculate shares the generate contract exactly: greedy
             # speculative decoding is lossless, so its tokens must sit on
-            # the same teacher-forced oracle chain
+            # the same teacher-forced oracle chain (its measured call
+            # returns (tokens, stats) — with_stats — so unpack first)
+            if isinstance(result, (tuple, list)):
+                result = result[0]
             return self._validate_generate(result)
         logits = result[0] if isinstance(result, (tuple, list)) else result
         logits = jax.block_until_ready(logits)

@@ -114,7 +114,8 @@ class SPMDTransformerDecode(TransformerDecode):
             n_new, spec_k = o["n_new"], o["spec_k"]
             cfg_d = replace(cfg, layers_per_stage=o["draft_layers"])
             spec, (sh_t, sh_d) = make_speculate_fn(
-                self.mesh, cfg, cfg_d, n_new=n_new, spec_k=spec_k
+                self.mesh, cfg, cfg_d, n_new=n_new, spec_k=spec_k,
+                with_stats=True,
             )
             # re-place the target params under the speculate fn's own
             # shardings (a no-op today — decode and prefill share param
@@ -191,6 +192,44 @@ class SPMDTransformerDecode(TransformerDecode):
             self._fn = jax.jit(step)
             self._args = (params, cache, prompt_dev)
         jax.block_until_ready(self._args)
+
+    def extra_row_fields(self) -> dict:
+        """Measured scheduling quantities next to the timing columns:
+
+        - phase=speculate: the acceptance rate the ~1.3x model
+          (BASELINE.md) PREDICTS from — ``accepted / (rounds * spec_k)``
+          with ``accepted`` the batch-min leading-agreement count per
+          verify round (one extra run of the measured fn, same cost
+          class as a validation forward).
+        - phase=serve: the engine's own drain stats (occupancy is the
+          number continuous batching exists to raise; deferrals and
+          peak pages are the paged pool's pressure gauges).
+        """
+        import jax
+
+        o = self.options
+        if o["phase"] == "speculate":
+            _, stats = jax.block_until_ready(self.run())
+            rounds = int(stats["rounds"])
+            accepted = int(stats["accepted"])
+            return {
+                "spec_rounds": rounds,
+                "spec_accept_rate": round(
+                    accepted / max(rounds * o["spec_k"], 1), 4
+                ),
+            }
+        if o["phase"] == "serve":
+            s = self._engine.stats
+            out = {
+                "serve_occupancy": round(s.occupancy, 4),
+                "serve_prefix_hits": s.prefix_hits,
+                "serve_admissions_deferred": s.admissions_deferred,
+            }
+            if self._engine.paged:
+                out["serve_peak_pages"] = s.peak_pages_in_use
+                out["serve_pages_capacity"] = s.pages_capacity
+            return out
+        return {}
 
     def timed_call(self):
         """Token array first so the measured loop's poison lands on ints

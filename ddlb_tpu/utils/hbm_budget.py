@@ -160,16 +160,20 @@ def decode_budget(
         # plus the residual stream — all bf16
         return b * s * (max(D + F, 4.0 * D) + D) * 2.0
 
-    prefill_s = ctx  # every phase's big pass is over the prompt
-    prefill_live = act_live(B, prefill_s)
-    if attn_kernel == "einsum":
-        # two concurrent f32 [B, H, S, S] copies (scores + softmax) —
+    def scores_live(b: float, s: float) -> float:
+        # two concurrent f32 [b, H, S, S] copies (scores + softmax) —
         # the cliff that forces flash prefill past ctx ~4k
-        prefill_live += 2.0 * B * n_heads * float(prefill_s) ** 2 * 4
+        if attn_kernel != "einsum":
+            return 0.0
+        return 2.0 * b * n_heads * float(s) ** 2 * 4
+
+    prefill_s = ctx  # every phase's big pass is over the prompt
+    prefill_live = act_live(B, prefill_s) + scores_live(B, prefill_s)
     if phase == "serve":
         # admission prefill is tp-replicated per request (tp slots),
-        # not batch-wide; on one chip that is a 1-row pass
-        prefill_live = act_live(1, ctx)
+        # not batch-wide; on one chip that is a 1-row pass — but the
+        # einsum score matrix still scales with S^2 and dominates
+        prefill_live = act_live(1, ctx) + scores_live(1, ctx)
 
     oracle_live = 0.0
     if validate:

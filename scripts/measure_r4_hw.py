@@ -126,6 +126,14 @@ if not SMOKE:
         if np.isfinite(t_ms):
             print(f"    -> {B_S * N_NEW / t_ms * 1e3:,.0f} tok/s end to end",
                   flush=True)
+        if "spec_accept_rate" in row:
+            # the measured a_r the ~1.3x model (BASELINE.md) predicts from
+            print(
+                f"    -> measured acceptance rate "
+                f"{row['spec_accept_rate']:.3f} over {row['spec_rounds']} "
+                f"verify rounds",
+                flush=True,
+            )
     # continuous batching: sustained tokens/s under slot turnover (the
     # host_clock drain of a 2x-oversubscribed workload; dp=1, tp=1 on
     # the single chip), contiguous vs the paged pool at parity and at
@@ -156,6 +164,18 @@ if not SMOKE:
             print(
                 f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
                 f"({total_new} tokens drained)",
+                flush=True,
+            )
+        if "serve_occupancy" in row:
+            pages = (
+                f"  peak pages {row['serve_peak_pages']}"
+                f"/{row['serve_pages_capacity']}"
+                if "serve_peak_pages" in row
+                else ""
+            )
+            print(
+                f"    -> occupancy {row['serve_occupancy']:.3f}  deferrals "
+                f"{row['serve_admissions_deferred']}{pages}",
                 flush=True,
             )
 

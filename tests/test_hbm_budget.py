@@ -82,6 +82,25 @@ def test_64k_int8_gqa_fits_b8_unvalidated():
     assert r.fits
 
 
+def test_serve_einsum_scores_still_counted():
+    # the serve admission pass is 1-row, but its einsum score matrix is
+    # still two f32 [1, H, S, S] copies — 8 GiB at ctx=8192 (counted,
+    # still fits) and 32 GiB at 16k (the gate must reject); flash
+    # admission at the same shapes stays ~flat
+    e8 = decode_budget(
+        ctx=8192, batch=8, phase="serve", attn_kernel="einsum", **SHAPE
+    )
+    assert e8.components["act_peak"] > 8e9 and e8.fits
+    e16 = decode_budget(
+        ctx=16384, batch=8, phase="serve", attn_kernel="einsum", **SHAPE
+    )
+    assert not e16.fits
+    flash = decode_budget(
+        ctx=16384, batch=8, phase="serve", attn_kernel="flash", **SHAPE
+    )
+    assert flash.fits
+
+
 def test_speculate_counts_draft():
     base = decode_budget(
         ctx=2048, batch=8, phase="generate", n_new=64, layers=2,

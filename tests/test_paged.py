@@ -233,6 +233,10 @@ class TestBenchmarkMember:
             }
         )
         assert row["valid"], row["error"]
+        # the engine's drain stats ride the row (extra_row_fields)
+        assert 0.0 < row["serve_occupancy"] <= 1.0
+        assert row["serve_pages_capacity"] > 0
+        assert 0 < row["serve_peak_pages"] <= row["serve_pages_capacity"]
 
     def test_paged_requires_serve_phase(self):
         from ddlb_tpu.primitives.registry import load_impl_class

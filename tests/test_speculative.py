@@ -315,3 +315,97 @@ class TestSpeculateMember:
         with pytest.raises(ValueError, match="spmd/compute_only"):
             cls(16, 64, 64, dtype="float32", phase="speculate",
                 batch=8, vocab=64, n_heads=8)
+
+
+class TestAcceptanceStats:
+    """with_stats=True: the measured acceptance counters the benchmark
+    row reports. Invariant from the loop: every verify round emits
+    a + 1 tokens, so rounds + accepted == n_new - 1 exactly; and the
+    tokens are the SAME chain as the stats-free form."""
+
+    def test_stats_invariants_and_identical_tokens(self):
+        from ddlb_tpu.models.decode import init_cache, make_speculate_fn
+        from ddlb_tpu.models.transformer import init_params
+
+        n_new, k = 12, 4
+        cfg = _cfg(layers=2)
+        cfg_d = _cfg(layers=1)
+        B, S0 = 8, 8
+        mesh, params, prompt = _setup(cfg, B, S0)
+        params_d = init_params(cfg_d, pp=1, n_experts=2, seed=7)
+
+        spec_s, (sh_t, sh_d) = make_speculate_fn(
+            mesh, cfg, cfg_d, n_new=n_new, spec_k=k, with_stats=True
+        )
+        p = {kk: jax.device_put(v, sh_t[kk]) for kk, v in params.items()}
+        pd = {kk: jax.device_put(v, sh_d[kk]) for kk, v in params_d.items()}
+
+        def caches():
+            return (
+                init_cache(cfg, B, S0 + n_new + k, mesh=mesh),
+                init_cache(cfg_d, B, S0 + n_new + k, mesh=mesh),
+            )
+
+        c_t, c_d = caches()
+        toks_s, stats = jax.jit(spec_s)(p, pd, c_t, c_d, prompt)
+        rounds, accepted = int(stats["rounds"]), int(stats["accepted"])
+        assert rounds >= 1
+        assert 0 <= accepted <= rounds * k
+        assert rounds + accepted == n_new - 1
+
+        plain = _speculate(mesh, cfg, cfg_d, p, params_d, prompt, n_new, k)
+        np.testing.assert_array_equal(np.asarray(toks_s), plain)
+
+    def test_full_acceptance_counts_only_requested_tokens(self):
+        # draft == target: every proposal accepted, every round advances
+        # spec_k + 1 — including a FINAL round that overshoots n_new.
+        # The invariant must hold exactly (surplus tokens are sliced
+        # from the output, so they are not accepted work either).
+        from ddlb_tpu.models.decode import init_cache, make_speculate_fn
+
+        n_new, k = 12, 4  # rounds of 5: 5, 10, 15 > 11 -> overshoot
+        cfg = _cfg(layers=2)
+        B, S0 = 8, 8
+        mesh, params, prompt = _setup(cfg, B, S0)
+        spec_s, (sh_t, _) = make_speculate_fn(
+            mesh, cfg, cfg, n_new=n_new, spec_k=k, with_stats=True
+        )
+        p = {kk: jax.device_put(v, sh_t[kk]) for kk, v in params.items()}
+        toks, stats = jax.jit(spec_s)(
+            p, p,
+            init_cache(cfg, B, S0 + n_new + k, mesh=mesh),
+            init_cache(cfg, B, S0 + n_new + k, mesh=mesh),
+            prompt,
+        )
+        rounds, accepted = int(stats["rounds"]), int(stats["accepted"])
+        assert rounds + accepted == n_new - 1
+        # identical models accept everything: ceil((n_new-1)/(k+1)) rounds
+        assert rounds == -(-(n_new - 1) // (k + 1))
+        # and the tokens are still the target's own greedy chain
+        _, greedy = _greedy(mesh, cfg, params, prompt, n_new)
+        np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+    def test_worker_row_carries_acceptance_rate(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            {
+                "primitive": "transformer_decode",
+                "impl_id": "spmd_spec",
+                "base_implementation": "spmd",
+                "options": {
+                    "phase": "speculate", "n_new": 8, "spec_k": 2,
+                    "draft_layers": 1, "layers": 2, "batch": 8,
+                    "vocab": 64, "n_heads": 8, "attn_kernel": "einsum",
+                },
+                "m": 16, "n": 32, "k": 64, "dtype": "bfloat16",
+                "num_iterations": 1, "num_warmups": 0, "validate": True,
+                "time_measurement_backend": "host_clock",
+                "barrier_at_each_iteration": False,
+            }
+        )
+        assert row["valid"], row["error"]
+        assert 0.0 <= row["spec_accept_rate"] <= 1.0
+        assert row["spec_rounds"] + round(
+            row["spec_accept_rate"] * row["spec_rounds"] * 2
+        ) == 8 - 1
