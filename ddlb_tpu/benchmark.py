@@ -75,6 +75,9 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     from ddlb_tpu.runtime import Runtime
 
     runtime = Runtime()
+    # allocator high-water mark BEFORE this config touches the device:
+    # hbm_peak_gib is attached only if this config raises it (see below)
+    peak_at_entry = _device_hbm_peak()
     error: Optional[str] = None
     result = None
     impl = None
@@ -183,8 +186,32 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                 f"[ddlb_tpu] WARNING: extra_row_fields failed: "
                 f"{type(exc).__name__}: {exc}"
             )
+    peak = _device_hbm_peak()
+    if peak is not None and (peak_at_entry is None or peak > peak_at_entry):
+        # measured HBM peak next to the row: each hardware capture
+        # doubles as a calibration point for the static budget model
+        # (utils/hbm_budget.py) that right-sizes the long-context rows.
+        # The allocator's high-water mark is PROCESS-lifetime and never
+        # resets, so the field only lands when THIS config raised it —
+        # always true in the subprocess-per-config paths (hw batches,
+        # isolation='subprocess'), and only for the high-water config
+        # in an in-process sweep (other rows would inherit its value).
+        row["hbm_peak_gib"] = round(peak / 2**30, 3)
     del impl, result
     return row
+
+
+def _device_hbm_peak() -> Optional[int]:
+    """Device 0's peak allocated bytes, or None where the backend does
+    not report allocator stats (the CPU sim)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        peak = (stats or {}).get("peak_bytes_in_use")
+        return int(peak) if peak is not None else None
+    except Exception:
+        return None
 
 
 def make_result_row(

@@ -1098,15 +1098,18 @@ def make_speculate_fn(
     ``spec_k`` provisional rows past the accepted prefix; they are
     masked by position until overwritten).
 
-    ``with_stats=True`` returns ``(tokens, {"rounds", "accepted"})``
-    instead — the verify-round count and the summed batch-min accepted
-    proposals, so the benchmark row can report the MEASURED acceptance
-    rate ``accepted / (rounds * spec_k)`` next to the tokens/s the
-    ~1.3x speculation model predicts. ``accepted`` counts only tokens
-    inside the requested ``n_new`` — a final round that overshoots has
-    its surplus sliced from the output, so it is not accepted work
-    either — giving the exact invariant
-    ``rounds + accepted == n_new - 1`` in every acceptance regime.
+    ``with_stats=True`` returns ``(tokens, {"rounds", "accepted",
+    "proposals"})`` instead, so the benchmark row can report the
+    MEASURED acceptance rate ``accepted / proposals`` next to the
+    tokens/s the ~1.3x speculation model predicts. Both counters are
+    clipped to the requested ``n_new``: a final round that overshoots
+    has its surplus sliced from the output, so neither the surplus
+    acceptances nor the proposal slots that could never land inside
+    ``n_new`` are counted (``proposals`` adds ``min(spec_k,
+    remaining - 1)`` per round). This keeps the rate unbiased — a
+    draft identical to the target reports exactly 1.0 — and the
+    invariant ``rounds + accepted == n_new - 1`` exact in every
+    acceptance regime.
     """
     if n_new < 1:
         raise ValueError(f"n_new must be >= 1, got {n_new}")
@@ -1152,7 +1155,9 @@ def make_speculate_fn(
             return carry[3] < S0 + n_new
 
         def body(carry):
-            tokens, cache, cache_draft, ntok, rounds, accepted = carry
+            tokens, cache, cache_draft, ntok, rounds, accepted, props_n = (
+                carry
+            )
             # tokens[:, :ntok] are final; the last one is not yet in
             # either model's cache — both consume it first
             last = jax.lax.dynamic_slice(
@@ -1192,27 +1197,37 @@ def make_speculate_fn(
             tokens = jax.lax.dynamic_update_slice(tokens, g, (0, ntok))
             match = (props == g[:, :k]).astype(jnp.int32)
             a = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
-            # stats count only tokens inside the requested n_new: the
+            # stats count only work inside the requested n_new: the
             # final round can overshoot (ntok + a + 1 past the target)
-            # and its surplus tokens are sliced away below, so they are
-            # not "accepted" work either — this keeps the invariant
-            # rounds + accepted == n_new - 1 exact in every regime
-            emit = jnp.minimum(a + 1, S0 + n_new - ntok)
+            # and its surplus tokens are sliced away below, so neither
+            # the surplus acceptances nor the proposal slots that could
+            # never land count — acceptance stays unbiased (identical
+            # draft and target measure exactly 1.0) and
+            # rounds + accepted == n_new - 1 holds in every regime
+            remaining = S0 + n_new - ntok
+            emit = jnp.minimum(a + 1, remaining)
             return (
                 tokens, cache, cache_draft, ntok + a + 1,
                 rounds + 1, accepted + emit - 1,
+                props_n + jnp.minimum(jnp.int32(k), remaining - 1),
             )
 
-        tokens, cache, cache_draft, _, rounds, accepted = jax.lax.while_loop(
-            cond, body,
-            (
-                tokens, cache, cache_draft, jnp.int32(S0 + 1),
-                jnp.int32(0), jnp.int32(0),
-            ),
+        (tokens, cache, cache_draft, _, rounds, accepted, props_n) = (
+            jax.lax.while_loop(
+                cond, body,
+                (
+                    tokens, cache, cache_draft, jnp.int32(S0 + 1),
+                    jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                ),
+            )
         )
         out = jax.lax.dynamic_slice(tokens, (0, 0), (B, S0 + n_new))
         if with_stats:
-            return out, {"rounds": rounds, "accepted": accepted}
+            return out, {
+                "rounds": rounds,
+                "accepted": accepted,
+                "proposals": props_n,
+            }
         return out
 
     return generate, (sh_t, sh_d)

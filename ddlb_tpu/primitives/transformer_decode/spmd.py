@@ -197,10 +197,11 @@ class SPMDTransformerDecode(TransformerDecode):
         """Measured scheduling quantities next to the timing columns:
 
         - phase=speculate: the acceptance rate the ~1.3x model
-          (BASELINE.md) PREDICTS from — ``accepted / (rounds * spec_k)``
-          with ``accepted`` the batch-min leading-agreement count per
-          verify round (one extra run of the measured fn, same cost
-          class as a validation forward).
+          (BASELINE.md) PREDICTS from — ``accepted / proposals``, both
+          clipped to the requested n_new so the rate is unbiased (a
+          perfect draft measures 1.0; see make_speculate_fn). Costs one
+          extra run of the measured fn, same class as a validation
+          forward.
         - phase=serve: the engine's own drain stats (occupancy is the
           number continuous batching exists to raise; deferrals and
           peak pages are the paged pool's pressure gauges).
@@ -212,10 +213,12 @@ class SPMDTransformerDecode(TransformerDecode):
             _, stats = jax.block_until_ready(self.run())
             rounds = int(stats["rounds"])
             accepted = int(stats["accepted"])
+            proposals = int(stats["proposals"])
             return {
                 "spec_rounds": rounds,
+                "spec_proposals": proposals,
                 "spec_accept_rate": round(
-                    accepted / max(rounds * o["spec_k"], 1), 4
+                    accepted / max(proposals, 1), 4
                 ),
             }
         if o["phase"] == "serve":

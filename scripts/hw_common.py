@@ -61,11 +61,16 @@ def run_and_print(
     )
     t = row["median time (ms)"]
     unit = "GB/s" if row.get("unit") == "GB/s" else "TF"
+    hbm = (
+        f"  hbm-peak {row['hbm_peak_gib']:.2f} GiB"
+        if "hbm_peak_gib" in row
+        else ""
+    )
     print(
         f"{primitive:18s} {impl:10s} m={m:<6d} {label or options} -> "
         f"median {t:.3f} ms  {row['Throughput (TFLOPS)']:.1f} {unit}  "
         f"std {row['std time (ms)']:.3f}  valid={row['valid']} "
-        f"err={row['error'] or '-'}",
+        f"err={row['error'] or '-'}{hbm}",
         flush=True,
     )
     return row
