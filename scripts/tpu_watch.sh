@@ -43,11 +43,26 @@ commit_capture() {
     git commit -q -m "Hardware capture: $1" -- $staged 2>/dev/null || true
 }
 
+run_bench() {
+    timeout 1800 python bench.py \
+        > hwlogs/bench_live.out 2> hwlogs/bench_live.err
+    rc_bench=$?
+    echo "[$(date -u +%H:%M:%SZ)] bench rc=$rc_bench"
+    commit_capture "live bench.py headline"
+}
+
+attempts=0
 while true; do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
     out=$(timeout 90 python -c "$PROBE" 2>&1)
     if echo "$out" | grep -q "PROBE_OK tpu"; then
         echo "[$ts] relay UP: $out"
+        # bench.py FIRST: ~5 minutes, and it is the driver's named
+        # deliverable (a LIVE BENCH row). The r4 window lasted 82
+        # minutes total — banking the headline before the multi-hour
+        # batches means a short window still converts.
+        echo "[$ts] running bench.py (headline first)..."
+        run_bench
         echo "[$ts] running measure_r3_hw.py..."
         timeout 5400 python scripts/measure_r3_hw.py \
             > hwlogs/measure_r3_hw.out 2> hwlogs/measure_r3_hw.err
@@ -66,25 +81,35 @@ while true; do
         rc_hw=$?
         echo "[$(date -u +%H:%M:%SZ)] measure_r2_remaining rc=$rc_hw"
         commit_capture "r2 remaining long-context decode and ep rows"
-        echo "[$(date -u +%H:%M:%SZ)] running bench.py..."
-        timeout 3600 python bench.py \
-            > hwlogs/bench_live.out 2> hwlogs/bench_live.err
-        rc_bench=$?
-        echo "[$(date -u +%H:%M:%SZ)] bench rc=$rc_bench"
-        commit_capture "live bench.py headline"
-        # CAPTURED only on real success: bench must have emitted a live
-        # (non-fallback) TPU row — a relay that flapped mid-measurement
-        # sends us back to probing, not to a false success marker
+        # closing bench: refreshes the headline AND restores the
+        # end-of-window relay-liveness sentinel the success gate reads
+        # (the opening bench alone would let a mid-batch flap write a
+        # false CAPTURED on a stale live row)
+        echo "[$(date -u +%H:%M:%SZ)] re-running bench.py (closing sentinel)..."
+        run_bench
+        # CAPTURED only on real success: the CLOSING bench must have
+        # emitted a live (non-fallback) TPU row (the end-of-window
+        # liveness sentinel — a mid-batch flap fails it and sends us
+        # back to probing) AND every batch finished rc=0. Batches get
+        # at most two full attempts: a DETERMINISTIC failure (e.g. a
+        # real kernel-parity mismatch exits 1) must not re-burn 3-hour
+        # windows forever — after the second try the capture closes
+        # with the nonzero rcs recorded in the DONE line for the log.
+        attempts=$((attempts + 1))
+        batch_ok=1
+        [ "$rc_hw3" -eq 0 ] && [ "$rc_hw4" -eq 0 ] && [ "$rc_hw" -eq 0 ] \
+            || batch_ok=0
         if [ "$rc_bench" -eq 0 ] \
             && grep -q '"platform": "tpu"' hwlogs/bench_live.out \
-            && ! grep -q '"fallback_reason"' hwlogs/bench_live.out; then
-            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw" \
+            && ! grep -q '"fallback_reason"' hwlogs/bench_live.out \
+            && { [ "$batch_ok" -eq 1 ] || [ "$attempts" -ge 2 ]; }; then
+            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw attempts=$attempts" \
                 > hwlogs/CAPTURED
             git add -f hwlogs/CAPTURED 2>/dev/null
             git commit -q -m "Hardware capture complete" -- hwlogs 2>/dev/null || true
             exit 0
         fi
-        echo "[$ts] capture incomplete (rc_hw3=$rc_hw3 rc_bench=$rc_bench); resuming probe loop"
+        echo "[$ts] capture incomplete (rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw rc_bench=$rc_bench attempts=$attempts); resuming probe loop"
     else
         echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
     fi
