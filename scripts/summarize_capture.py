@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Digest hwlogs/rows.jsonl into judge-readable markdown tables.
+
+Every hardware batch banks its result rows (measured AND error) through
+``hw_common.run_isolated`` into ``hwlogs/rows.jsonl``. This script turns
+that record into ``hwlogs/SUMMARY.md`` — the watcher runs it right after
+a capture, so even a capture that lands minutes before the round buzzer
+commits its tables without a human (or a later session) in the loop.
+
+Zero dependencies beyond the stdlib; tolerant of partial captures (it
+summarizes whatever rows exist, flags error rows, and never fails).
+
+Usage: python scripts/summarize_capture.py [rows.jsonl] [out.md]
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except Exception:
+                    pass
+    except OSError:
+        pass
+    return rows
+
+
+def _f(row, key, fmt="{:.3f}", default="—"):
+    v = row.get(key)
+    if v is None:
+        return default
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if math.isnan(v):
+        return default
+    return fmt.format(v)
+
+
+def _phase(row) -> str:
+    opt = row.get("option", "")
+    for part in str(opt).split(";"):
+        if part.startswith("phase="):
+            return part[6:]
+    return ""
+
+
+def _opt_brief(row, keys) -> str:
+    opts = dict(
+        p.split("=", 1) for p in str(row.get("option", "")).split(";")
+        if "=" in p
+    )
+    return " ".join(
+        f"{k}={opts[k]}" for k in keys if k in opts and opts[k] not in
+        ("", "0", "False", "bf16", "contiguous", "einsum")
+    ) or "baseline"
+
+
+def _table(out, header, lines):
+    if not lines:
+        return
+    out.append(header)
+    out.append("")
+    out.extend(lines)
+    out.append("")
+
+
+def _dedup(rows):
+    """Last row per config wins: rows.jsonl is append-only across the
+    watcher's retry attempts (and survives machine resets via the
+    capture commits), so a config that OOMed on attempt 1 and measured
+    on attempt 2 must show its LATEST outcome, once."""
+    by_key = {}
+    for r in rows:
+        key = (
+            r.get("primitive"), r.get("base_implementation"),
+            r.get("m"), r.get("n"), r.get("k"), r.get("dtype"),
+            r.get("option"),
+        )
+        by_key[key] = r
+    return list(by_key.values())
+
+
+def summarize(rows) -> str:
+    banked = len(rows)
+    rows = _dedup(rows)
+    out = ["# Hardware capture summary", ""]
+    ok = [r for r in rows if not r.get("error")]
+    bad = [r for r in rows if r.get("error")]
+    out.append(
+        f"{banked} rows banked; {len(rows)} distinct configs "
+        f"({len(ok)} measured, {len(bad)} errors; later attempts "
+        f"supersede earlier rows of the same config)."
+    )
+    out.append("")
+
+    # serving / decode table
+    dec = [r for r in ok if r.get("primitive") == "transformer_decode"]
+    lines = []
+    for r in dec:
+        ph = _phase(r)
+        b = _opt_brief(r, ("batch",)).replace("batch=", "B")
+        med = _f(r, "median time (ms)")
+        extras = []
+        if "spec_accept_rate" in r:
+            extras.append(f"a_r={_f(r, 'spec_accept_rate')}")
+        if "serve_occupancy" in r:
+            extras.append(f"occ={_f(r, 'serve_occupancy')}")
+        if "serve_peak_pages" in r:
+            extras.append(
+                f"pages={r['serve_peak_pages']}/{r.get('serve_pages_capacity')}"
+            )
+        if "hbm_peak_gib" in r:
+            extras.append(f"hbm={_f(r, 'hbm_peak_gib', '{:.2f}')}GiB")
+        lines.append(
+            f"| {ph} | {r.get('m')} | {b} | "
+            f"{_opt_brief(r, ('kv_cache', 'n_kv_heads', 'mlp_kernel', 'decode_kernel', 'cache_layout', 'page_pool_frac', 'spec_k'))} | "
+            f"{med} | {_f(r, 'Throughput (TFLOPS)', '{:.1f}')} | "
+            f"{' '.join(extras) or '—'} | {r.get('valid')} |"
+        )
+    if lines:
+        lines = [
+            "| phase | ctx | batch | levers | median ms | T'put | extras | valid |",
+            "|---|---|---|---|---|---|---|---|",
+        ] + lines
+    _table(out, "## transformer_decode (serving)", lines)
+
+    # train steps
+    tr = [r for r in ok if r.get("primitive") == "transformer_step"]
+    lines = []
+    for r in tr:
+        lines.append(
+            f"| {r.get('m')} | {r.get('n')} | {r.get('k')} | "
+            f"{_opt_brief(r, ('mode', 'schedule', 'n_kv_heads', 'mlp_kernel', 'microbatches'))} | "
+            f"{_f(r, 'median time (ms)')} | "
+            f"{_f(r, 'Throughput (TFLOPS)', '{:.1f}')} | "
+            f"{_f(r, 'hbm_peak_gib', '{:.2f}')} | {r.get('valid')} |"
+        )
+    if lines:
+        lines = [
+            "| seq | d_model | d_ff | options | median ms | TFLOPS | hbm GiB | valid |",
+            "|---|---|---|---|---|---|---|---|",
+        ] + lines
+    _table(out, "## transformer_step (MFU curve / schedules)", lines)
+
+    # GEMM families (tile sweep etc.)
+    gemm = [
+        r for r in ok
+        if r.get("primitive") in ("tp_columnwise", "tp_rowwise",
+                                  "dp_allreduce", "ep_alltoall")
+    ]
+    lines = []
+    for r in gemm:
+        lines.append(
+            f"| {r.get('primitive')} | {r.get('base_implementation', r.get('implementation'))} | "
+            f"{r.get('m')}x{r.get('n')}x{r.get('k')} {r.get('dtype')} | "
+            f"{_opt_brief(r, ('kernel', 'quantize', 'tune', 'block_m', 'block_n', 'block_k', 'order', 'algorithm'))} | "
+            f"{_f(r, 'median time (ms)')} | "
+            f"{_f(r, 'Throughput (TFLOPS)', '{:.1f}')} | {r.get('valid')} |"
+        )
+    if lines:
+        lines = [
+            "| family | impl | shape | options | median ms | TFLOPS | valid |",
+            "|---|---|---|---|---|---|---|",
+        ] + lines
+    _table(out, "## GEMM families (incl. int8 tile sweep)", lines)
+
+    # collectives / attention
+    other = [
+        r for r in ok
+        if r.get("primitive") in ("collectives", "cp_ring_attention",
+                                  "pp_pipeline")
+    ]
+    lines = []
+    for r in other:
+        unit = r.get("unit", "TFLOPS")
+        lines.append(
+            f"| {r.get('primitive')} | {r.get('base_implementation', r.get('implementation'))} | "
+            f"{r.get('m')} | {_opt_brief(r, ('op', 'window', 'strategy', 'size', 'schedule'))} | "
+            f"{_f(r, 'median time (ms)')} | "
+            f"{_f(r, 'Throughput (TFLOPS)', '{:.1f}')} {unit} | {r.get('valid')} |"
+        )
+    if lines:
+        lines = [
+            "| family | impl | m | options | median ms | throughput | valid |",
+            "|---|---|---|---|---|---|---|",
+        ] + lines
+    _table(out, "## Collectives / attention / pipeline", lines)
+
+    if bad:
+        out.append("## Error rows")
+        out.append("")
+        for r in bad:
+            out.append(
+                f"- {r.get('primitive')}/{r.get('implementation')} "
+                f"m={r.get('m')} {_opt_brief(r, ('phase', 'kv_cache', 'mlp_kernel'))}: "
+                f"{str(r.get('error'))[:160]}"
+            )
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
+def main(argv) -> int:
+    src = argv[1] if len(argv) > 1 else os.path.join(
+        REPO, "hwlogs", "rows.jsonl"
+    )
+    dst = argv[2] if len(argv) > 2 else os.path.join(
+        REPO, "hwlogs", "SUMMARY.md"
+    )
+    rows = _load(src)
+    if not rows:
+        print(f"summarize_capture: no rows at {src}; nothing to do")
+        return 0
+    text = summarize(rows)
+    dst_dir = os.path.dirname(dst)
+    if dst_dir:
+        os.makedirs(dst_dir, exist_ok=True)
+    with open(dst, "w") as f:
+        f.write(text)
+    print(f"summarize_capture: {len(rows)} rows -> {dst}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -32,8 +32,9 @@ commit_capture() {
     # ANY pathspec matches nothing (an unmatched glob passes through
     # literally), which would silently drop every capture until all
     # four patterns exist
-    for f in hwlogs/*.out hwlogs/*.err bench_tpu_cache.json \
-             autotune_cache.json; do
+    python scripts/summarize_capture.py > /dev/null 2>&1 || true
+    for f in hwlogs/*.out hwlogs/*.err hwlogs/rows.jsonl hwlogs/SUMMARY.md \
+             bench_tpu_cache.json autotune_cache.json; do
         [ -e "$f" ] && git add -f "$f" 2>/dev/null
     done
     staged=$(git diff --cached --name-only -- \
@@ -91,17 +92,23 @@ while true; do
         # emitted a live (non-fallback) TPU row (the end-of-window
         # liveness sentinel — a mid-batch flap fails it and sends us
         # back to probing) AND every batch finished rc=0. Batches get
-        # at most two full attempts: a DETERMINISTIC failure (e.g. a
-        # real kernel-parity mismatch exits 1) must not re-burn 3-hour
-        # windows forever — after the second try the capture closes
-        # with the nonzero rcs recorded in the DONE line for the log.
-        attempts=$((attempts + 1))
+        # at most two COMPLETE attempts: ``attempts`` counts only
+        # windows whose closing bench was live — the relay survived to
+        # the end, so a batch failure in them is deterministic (e.g. a
+        # real kernel-parity mismatch exits 1) and must not re-burn
+        # 3-hour windows forever. Flap-truncated windows never count,
+        # so transient outages keep retrying.
         batch_ok=1
         [ "$rc_hw3" -eq 0 ] && [ "$rc_hw4" -eq 0 ] && [ "$rc_hw" -eq 0 ] \
             || batch_ok=0
+        closing_live=0
         if [ "$rc_bench" -eq 0 ] \
             && grep -q '"platform": "tpu"' hwlogs/bench_live.out \
-            && ! grep -q '"fallback_reason"' hwlogs/bench_live.out \
+            && ! grep -q '"fallback_reason"' hwlogs/bench_live.out; then
+            closing_live=1
+            attempts=$((attempts + 1))
+        fi
+        if [ "$closing_live" -eq 1 ] \
             && { [ "$batch_ok" -eq 1 ] || [ "$attempts" -ge 2 ]; }; then
             echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw attempts=$attempts" \
                 > hwlogs/CAPTURED

@@ -1,0 +1,111 @@
+"""The post-capture summarizer: banked rows become markdown tables.
+
+The watcher runs ``summarize_capture.py`` inside every commit_capture,
+so a relay window that closes minutes before the round buzzer still
+commits judge-readable tables. What matters: it digests every family's
+rows, keeps the collectives unit honest, surfaces the round-5
+instrumentation (acceptance rate, serve stats, hbm peak), lists error
+rows, and never crashes on partial/garbled input.
+"""
+
+import importlib.util
+import json
+import os
+
+_spec = importlib.util.spec_from_file_location(
+    "summarize_capture",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "summarize_capture.py",
+    ),
+)
+sc = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(sc)
+
+
+def _row(**kw):
+    base = {
+        "implementation": "spmd_hw", "base_implementation": "spmd",
+        "primitive": "transformer_decode", "m": 8192, "n": 2048, "k": 8192,
+        "dtype": "bfloat16", "median time (ms)": 1.234,
+        "std time (ms)": 0.01, "Throughput (TFLOPS)": 12.5,
+        "unit": "TFLOPS", "valid": True, "error": "",
+        "option": "phase=decode;kv_cache=int8;n_kv_heads=4;batch=8",
+    }
+    base.update(kw)
+    return base
+
+
+def test_summarize_all_sections(tmp_path):
+    rows = [
+        _row(hbm_peak_gib=4.21),
+        _row(
+            option="phase=speculate;spec_k=4;batch=8",
+            spec_accept_rate=0.71, spec_rounds=20, spec_proposals=70,
+        ),
+        _row(
+            option="phase=serve;cache_layout=paged;page_pool_frac=0.5;batch=8",
+            serve_occupancy=0.82, serve_prefix_hits=6,
+            serve_admissions_deferred=3, serve_peak_pages=10,
+            serve_pages_capacity=16,
+        ),
+        _row(
+            primitive="transformer_step", option="mode=train;microbatches=4",
+            **{"Throughput (TFLOPS)": 157.0},
+        ),
+        _row(
+            primitive="tp_columnwise", base_implementation="quantized",
+            option="kernel=pallas;quantize=static;block_m=1024",
+            **{"Throughput (TFLOPS)": 375.2},
+        ),
+        _row(
+            primitive="collectives", base_implementation="jax_spmd",
+            option="op=all_gather", unit="GB/s",
+            **{"Throughput (TFLOPS)": 93.0},
+        ),
+        _row(
+            option="phase=decode;batch=8",
+            error="JaxRuntimeError: RESOURCE_EXHAUSTED",
+            **{"median time (ms)": float("nan"),
+               "Throughput (TFLOPS)": float("nan")},
+        ),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text(
+        "\n".join(json.dumps(r) for r in rows) + "\ngarbage-line\n"
+    )
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    text = dst.read_text()
+    assert "7 rows banked; 7 distinct configs (6 measured, 1 errors" in text
+    assert "a_r=0.710" in text
+    assert "occ=0.820" in text and "pages=10/16" in text
+    assert "hbm=4.21GiB" in text
+    assert "93.0 GB/s" in text          # the honest unit rides through
+    assert "kernel=pallas" in text      # tile-sweep options visible
+    assert "RESOURCE_EXHAUSTED" in text  # error rows listed, not dropped
+
+
+def test_retry_supersedes_stale_error_row(tmp_path):
+    # attempt 1 OOMs, attempt 2 (the watcher's documented second full
+    # try) measures the SAME config: the summary must show the latest
+    # outcome once, not a contradictory error + measured pair
+    same = "phase=decode;kv_cache=int8;batch=8"
+    rows = [
+        _row(option=same, error="RESOURCE_EXHAUSTED",
+             **{"median time (ms)": float("nan")}),
+        _row(option=same, **{"median time (ms)": 2.5}),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    text = dst.read_text()
+    assert "2 rows banked; 1 distinct configs (1 measured, 0 errors" in text
+    assert "RESOURCE_EXHAUSTED" not in text
+
+
+def test_no_rows_is_a_noop(tmp_path):
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(tmp_path / "missing.jsonl"), str(dst)]) == 0
+    assert not dst.exists()
