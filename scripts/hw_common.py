@@ -122,12 +122,27 @@ def _forward_diagnostics(stdout):
             print(line, flush=True)
 
 
-def _bank_row(row):
+def _bank_row(row, config):
     """Append the row to hwlogs/rows.jsonl — the machine-readable record
     every hardware batch shares, which scripts/summarize_capture.py
-    digests into judge-readable tables after a capture. Best effort: a
-    logging failure must never fail a measurement."""
+    digests into judge-readable tables after a capture. ``bank_key``
+    identifies the CALLER's config: error rows format override-only
+    option strings while measured rows carry the DEFAULT-merged ones, so
+    the row's own 'option' field cannot pair a retry with the attempt-1
+    error it supersedes — the caller's config can, it is identical on
+    both paths. Best effort: a logging failure must never fail a
+    measurement."""
     try:
+        row["bank_key"] = json.dumps(
+            {
+                "primitive": config.get("primitive"),
+                "base_implementation": config.get("base_implementation"),
+                "m": config.get("m"), "n": config.get("n"),
+                "k": config.get("k"), "dtype": config.get("dtype"),
+                "options": config.get("options", {}),
+            },
+            sort_keys=True, default=str,
+        )
         path = os.path.join(REPO, "hwlogs", "rows.jsonl")
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "a") as f:
@@ -157,14 +172,17 @@ def run_isolated(config, timeout=1800.0):
     except subprocess.TimeoutExpired as exc:
         _forward_diagnostics(exc.stdout)
         return _bank_row(
-            _error_row(config, f"TimeoutError: worker exceeded {timeout:.0f}s")
+            _error_row(config, f"TimeoutError: worker exceeded {timeout:.0f}s"),
+            config,
         )
     except OSError as exc:
-        return _bank_row(_error_row(config, f"worker spawn failed: {exc}"))
+        return _bank_row(
+            _error_row(config, f"worker spawn failed: {exc}"), config
+        )
     _forward_diagnostics(out.stdout)
     for line in reversed(out.stdout.splitlines()):
         if line.startswith("ROW "):
-            return _bank_row(json.loads(line[4:]))
+            return _bank_row(json.loads(line[4:]), config)
     tail = (out.stderr or out.stdout or "").strip().splitlines()
     return _bank_row(
         _error_row(
@@ -172,5 +190,6 @@ def run_isolated(config, timeout=1800.0):
             "worker rc={} with no row: {}".format(
                 out.returncode, tail[-1] if tail else "no output"
             ),
-        )
+        ),
+        config,
     )

@@ -200,6 +200,23 @@ run(
     mode="train", attn_kernel="flash", batch=1, vocab=V, n_heads=HEADS,
     microbatches=1, pp=1, tp=1, dp=1,
 )
+# turn the trace into the attributed top-op table RIGHT HERE, so the
+# "where does the missing 20% MFU go" answer lands in this committed
+# log the same session the trace is taken (scripts/xprof_summary.py).
+# Soft-fail like every other call in this batch: check=False does not
+# cover timeouts, and an uncaught TimeoutExpired here would abort the
+# remaining sections and burn a capture attempt.
+import subprocess
+
+try:
+    subprocess.run(
+        [sys.executable, "scripts/xprof_summary.py",
+         "profiles/mfu_breakdown", "15"],
+        timeout=600, check=False,
+    )
+except subprocess.TimeoutExpired:
+    print("xprof_summary timed out after 600s; trace left for offline "
+          "analysis", flush=True)
 
 # -- 3) model schedules + GQA train row ---------------------------------------
 

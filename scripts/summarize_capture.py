@@ -85,10 +85,14 @@ def _dedup(rows):
     """Last row per config wins: rows.jsonl is append-only across the
     watcher's retry attempts (and survives machine resets via the
     capture commits), so a config that OOMed on attempt 1 and measured
-    on attempt 2 must show its LATEST outcome, once."""
+    on attempt 2 must show its LATEST outcome, once. Keyed on
+    ``bank_key`` — the caller's config as banked by hw_common, which is
+    identical for error and measured rows of the same config (the row's
+    own 'option' string is NOT: error rows format the override-only
+    options, measured rows the DEFAULT-merged set)."""
     by_key = {}
     for r in rows:
-        key = (
+        key = r.get("bank_key") or (
             r.get("primitive"), r.get("base_implementation"),
             r.get("m"), r.get("n"), r.get("k"), r.get("dtype"),
             r.get("option"),

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Top-op table from a jax.profiler trace, no TensorBoard UI needed.
+
+The r2 verdict asked where the MFU-headline train step's missing ~20%
+goes (remat recompute vs embed/CE vs bubbles); the trace row in
+measure_r3_hw.py §2b captures the xplane, and this script turns it into
+the attributed table IN THE BATCH LOG — so the answer lands committed
+(hwlogs/measure_r3_hw.out) the same session the trace is taken, instead
+of waiting for a human with a TensorBoard install.
+
+Method: parse the ``*.xplane.pb`` protobuf directly
+(tensorflow.tsl.profiler.protobuf.xplane_pb2 — the tensorboard profile
+plugin's converter needs a pywrap symbol this TF build lacks), pick the
+busiest device/XLA plane lines, and aggregate event durations by op
+name. Events on an XLA op line are sequential (no nesting), so total
+time per name is self time to the fidelity this table needs.
+
+Usage: python scripts/xprof_summary.py <profile_dir> [top_n]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+
+def _planes(path):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = sorted(
+        glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True)
+    )
+    for f in files:
+        xs = xplane_pb2.XSpace()
+        with open(f, "rb") as fh:
+            xs.ParseFromString(fh.read())
+        for plane in xs.planes:
+            yield plane
+
+
+def top_ops(profile_dir: str, top_n: int = 15):
+    """[(op name, total_ms, fraction-of-line)] for the busiest device
+    line across every xplane under ``profile_dir``."""
+    best = None  # (total_ps, line_name, {name: ps})
+    for plane in _planes(profile_dir):
+        pname = plane.name.lower()
+        md = {k: v.name for k, v in plane.event_metadata.items()}
+        for line in plane.lines:
+            lname = line.name.lower()
+            # TPU: plane '/device:TPU:0'; CPU sim: plane '/host:CPU'
+            # with the XLA module as a 'tf_xla-cpu-codegen/...' line
+            if (
+                "device:" not in pname
+                and "xla" not in pname
+                and "xla" not in lname
+                and "codegen" not in lname
+            ):
+                continue
+            agg = {}
+            for e in line.events:
+                name = md.get(e.metadata_id, str(e.metadata_id))
+                agg[name] = agg.get(name, 0) + e.duration_ps
+            total = sum(agg.values())
+            if total and (best is None or total > best[0]):
+                best = (total, f"{plane.name} / {line.name}", agg)
+    if best is None:
+        return None, []
+    total, line_name, agg = best
+    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top_n]
+    return line_name, [
+        (name, ps / 1e9, ps / total) for name, ps in rows
+    ]
+
+
+def main(argv) -> int:
+    if len(argv) < 2:
+        print("usage: xprof_summary.py <profile_dir> [top_n]")
+        return 2
+    profile_dir = argv[1]
+    top_n = int(argv[2]) if len(argv) > 2 else 15
+    try:
+        line_name, rows = top_ops(profile_dir, top_n)
+    except Exception as exc:  # missing TF proto, corrupt trace, ...
+        print(f"xprof_summary: cannot parse {profile_dir}: "
+              f"{type(exc).__name__}: {exc}")
+        return 1
+    if line_name is None:
+        print(f"xprof_summary: no device-plane events under {profile_dir}")
+        return 1
+    print(f"xprof top ops — {line_name}")
+    for name, ms, frac in rows:
+        print(f"  {frac:6.1%}  {ms:10.3f} ms  {name[:90]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

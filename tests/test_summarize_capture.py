@@ -89,12 +89,16 @@ def test_summarize_all_sections(tmp_path):
 def test_retry_supersedes_stale_error_row(tmp_path):
     # attempt 1 OOMs, attempt 2 (the watcher's documented second full
     # try) measures the SAME config: the summary must show the latest
-    # outcome once, not a contradictory error + measured pair
-    same = "phase=decode;kv_cache=int8;batch=8"
+    # outcome once, not a contradictory error + measured pair. The two
+    # rows' own 'option' strings DIFFER (error rows format only the
+    # caller's overrides, measured rows the DEFAULT-merged set) — the
+    # pairing works through hw_common's bank_key, the caller's config
+    key = '{"m": 8192, "options": {"kv_cache": "int8"}}'
     rows = [
-        _row(option=same, error="RESOURCE_EXHAUSTED",
-             **{"median time (ms)": float("nan")}),
-        _row(option=same, **{"median time (ms)": 2.5}),
+        _row(option="kv_cache=int8", error="RESOURCE_EXHAUSTED",
+             bank_key=key, **{"median time (ms)": float("nan")}),
+        _row(option="phase=decode;kv_cache=int8;n_new=32;batch=8",
+             bank_key=key, **{"median time (ms)": 2.5}),
     ]
     src = tmp_path / "rows.jsonl"
     src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")

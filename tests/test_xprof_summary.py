@@ -1,0 +1,42 @@
+"""The xprof trace digester that turns the MFU-breakdown capture into
+an attributed top-op table inside the committed batch log."""
+
+import importlib.util
+import os
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "xprof_summary",
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "xprof_summary.py",
+    ),
+)
+xp = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(xp)
+
+
+def test_top_ops_from_real_trace(tmp_path):
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    import jax
+    import jax.numpy as jnp
+
+    with jax.profiler.trace(str(tmp_path)):
+        x = jnp.ones((256, 256))
+        f = jax.jit(lambda a: (a @ a).sum())
+        for _ in range(3):
+            f(x).block_until_ready()
+
+    line_name, rows = xp.top_ops(str(tmp_path), top_n=5)
+    assert line_name is not None
+    assert rows and len(rows) <= 5
+    # fractions are of the busiest line's total: descending, in (0, 1]
+    fracs = [frac for _, _, frac in rows]
+    assert fracs == sorted(fracs, reverse=True)
+    assert all(0 < f <= 1 for f in fracs)
+    assert all(ms >= 0 for _, ms, _ in rows)
+
+
+def test_empty_dir_reports_cleanly(tmp_path):
+    assert xp.main(["x", str(tmp_path)]) == 1
