@@ -17,11 +17,18 @@ mechanics:
 - per-implementation isolation: the reference spawns a child process per
   implementation (benchmark.py:336-370) because CUDA backends poison each
   other; the TPU runtime owns its chips for the process lifetime, so the
-  default is in-process with ``jax.clear_caches()`` between implementations,
-  and ``isolation='subprocess'`` restores full process isolation where the
-  platform allows it — verified working on CPU simulation AND on the real
-  single-chip TPU (children run sequentially, each owning the chip for its
-  row; they pay a fresh compile, so the in-process default stays faster).
+  default is in-process with ``jax.clear_caches()`` at executable-signature
+  boundaries (configs sharing an executable run adjacently and keep the
+  warm cache — utils/compile_ahead.py), and ``isolation='subprocess'``
+  restores full process isolation where the platform allows it — verified
+  working on CPU simulation AND on the real single-chip TPU (children run
+  sequentially, each owning the chip for its row; they pay a fresh compile
+  unless the persistent cache answers, so the in-process default stays
+  faster);
+- compile-ahead: with ``DDLB_TPU_COMPILE_CACHE`` set, the in-process
+  runner AOT-compiles config N+1 on a background thread while config N's
+  timing loop runs on device, and every row records ``compile_time_s`` /
+  ``compile_cache_hit`` so the engine's win is visible in the CSV.
 """
 
 from __future__ import annotations
@@ -37,6 +44,12 @@ from ddlb_tpu.primitives.registry import (
     ALLOWED_PRIMITIVES,
     load_impl_class,
     throughput_unit,
+)
+from ddlb_tpu.utils.compile_ahead import (
+    CompileAheadScheduler,
+    compile_metrics,
+    executable_signature,
+    order_by_signature,
 )
 from ddlb_tpu.utils.timing import fence, measure_device_loop
 
@@ -72,8 +85,11 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
             f"Allowed: {TIMING_BACKENDS}"
         )
 
-    from ddlb_tpu.runtime import Runtime
+    from ddlb_tpu.runtime import Runtime, configure_compile_cache
 
+    # apply DDLB_TPU_COMPILE_CACHE even when a Runtime singleton predates
+    # the env var (idempotent; a no-op when unset)
+    configure_compile_cache()
     runtime = Runtime()
     # allocator high-water mark BEFORE this config touches the device:
     # hbm_peak_gib is attached only if this config raises it (see below)
@@ -96,67 +112,72 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         )
         t0[0] = t1
 
-    try:
-        impl_class = load_impl_class(primitive, base_impl)
-        # option merge: DEFAULT_OPTIONS ∪ overrides (reference
-        # benchmark.py:76-77); crash isolation covers construction too —
-        # a bad option or OOM becomes a row, not an aborted sweep
-        # (reference per-impl child process, benchmark.py:336-370).
-        _mark("setup begin (backend init + operand placement + prefill)")
-        impl = impl_class(m, n, k, dtype=dtype, **options)
-        option_repr = _format_options(impl.options)
-        _mark("setup done; warmup begin (first compile happens here)")
+    # compile accounting for the whole measured region (setup, warmup,
+    # timing loops, validation); a real with-block so the thread-local
+    # collector can never leak, even on BaseException (SystemExit,
+    # KeyboardInterrupt) escaping the crash-isolation except below
+    with compile_metrics() as _cm:
+        try:
+            impl_class = load_impl_class(primitive, base_impl)
+            # option merge: DEFAULT_OPTIONS ∪ overrides (reference
+            # benchmark.py:76-77); crash isolation covers construction too —
+            # a bad option or OOM becomes a row, not an aborted sweep
+            # (reference per-impl child process, benchmark.py:336-370).
+            _mark("setup begin (backend init + operand placement + prefill)")
+            impl = impl_class(m, n, k, dtype=dtype, **options)
+            option_repr = _format_options(impl.options)
+            _mark("setup done; warmup begin (first compile happens here)")
 
-        # warmup (reference benchmark.py:84-85)
-        for _ in range(num_warmups):
-            result = impl.run()
-        fence(result)
-        _mark("warmup done; measuring")
-
-        # profiler window (reference cudaProfilerStart/Stop window,
-        # benchmark.py:87-104 -> jax.profiler trace for xprof/tensorboard)
-        if profile_dir:
-            with jax.profiler.trace(profile_dir):
-                for _ in range(5):
-                    result = impl.run()
-                fence(result)
-            # re-warm after tracing overhead (reference benchmark.py:121-122)
+            # warmup (reference benchmark.py:84-85)
             for _ in range(num_warmups):
                 result = impl.run()
             fence(result)
+            _mark("warmup done; measuring")
 
-        times_ms = _timing_loop(
-            impl,
-            runtime,
-            num_iterations,
-            timing_backend,
-            barrier_each,
-            num_windows=config.get("device_loop_windows", 5),
-            min_window_s=config.get("device_loop_min_window_ms", 100.0) * 1e-3,
-        )
-        times_ms = _max_reduce_across_processes(times_ms, runtime)
-        _mark("measured; validation begin" if do_validate else "measured")
-
-        valid = True
-        if do_validate:
-            # a validation crash (e.g. the oracle OOMs at a context the
-            # measured step handles fine) must not discard the completed
-            # measurement: times stand, valid=False + error records why
-            try:
-                result = impl.run()
+            # profiler window (reference cudaProfilerStart/Stop window,
+            # benchmark.py:87-104 -> jax.profiler trace for xprof/tensorboard)
+            if profile_dir:
+                with jax.profiler.trace(profile_dir):
+                    for _ in range(5):
+                        result = impl.run()
+                    fence(result)
+                # re-warm after tracing overhead (reference benchmark.py:121-122)
+                for _ in range(num_warmups):
+                    result = impl.run()
                 fence(result)
-                valid = bool(impl.validate(result))
-            except Exception as exc:
-                error = f"validation crashed: {type(exc).__name__}: {exc}"
-                valid = False
-            if not valid:
-                # soft failure: recorded, not fatal (reference
-                # benchmark.py:242-245)
-                print(f"[ddlb_tpu] WARNING: validation failed for {impl_id}")
-    except Exception as exc:  # crash isolation: report as a row
-        error = f"{type(exc).__name__}: {exc}"
-        times_ms = np.array([float("nan")])
-        valid = False
+
+            times_ms = _timing_loop(
+                impl,
+                runtime,
+                num_iterations,
+                timing_backend,
+                barrier_each,
+                num_windows=config.get("device_loop_windows", 5),
+                min_window_s=config.get("device_loop_min_window_ms", 100.0) * 1e-3,
+            )
+            times_ms = _max_reduce_across_processes(times_ms, runtime)
+            _mark("measured; validation begin" if do_validate else "measured")
+
+            valid = True
+            if do_validate:
+                # a validation crash (e.g. the oracle OOMs at a context the
+                # measured step handles fine) must not discard the completed
+                # measurement: times stand, valid=False + error records why
+                try:
+                    result = impl.run()
+                    fence(result)
+                    valid = bool(impl.validate(result))
+                except Exception as exc:
+                    error = f"validation crashed: {type(exc).__name__}: {exc}"
+                    valid = False
+                if not valid:
+                    # soft failure: recorded, not fatal (reference
+                    # benchmark.py:242-245)
+                    print(f"[ddlb_tpu] WARNING: validation failed for {impl_id}")
+        except Exception as exc:  # crash isolation: report as a row
+            error = f"{type(exc).__name__}: {exc}"
+            times_ms = np.array([float("nan")])
+            valid = False
 
     # TFLOPS = flops / 1e9 / time_ms; GEMM primitives use the reference's
     # 2*m*n*k (benchmark.py:209-214), attention primitives override
@@ -174,6 +195,8 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         world_size=runtime.num_devices,
         num_processes=runtime.num_processes,
         platform=runtime.platform,
+        compile_time_s=round(_cm.compile_time_s, 4),
+        compile_cache_hit=_cm.cache_hit,
     )
     if impl is not None and np.isfinite(times_ms).any():
         # family-specific measured quantities (speculate acceptance
@@ -224,6 +247,8 @@ def make_result_row(
     world_size: int,
     num_processes: int,
     platform: str,
+    compile_time_s: float = float("nan"),
+    compile_cache_hit: bool = False,
 ) -> Dict[str, Any]:
     """The one result-row schema, shared by measured, crashed and
     timed-out workers so the CSV columns cannot drift apart.
@@ -269,6 +294,12 @@ def make_result_row(
         "barrier_at_each_iteration": config.get(
             "barrier_at_each_iteration", True
         ),
+        # what compilation cost this row and whether the persistent
+        # cache (DDLB_TPU_COMPILE_CACHE) served it — the compile-ahead
+        # engine's win, visible in every CSV; NaN/False on rows whose
+        # worker died before compiling anything
+        "compile_time_s": compile_time_s,
+        "compile_cache_hit": compile_cache_hit,
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
@@ -374,6 +405,8 @@ class PrimitiveBenchmarkRunner:
         resume: bool = False,
         device_loop_windows: int = 5,
         device_loop_min_window_ms: float = 100.0,
+        compile_ahead: bool = True,
+        group_by_signature: bool = True,
     ) -> None:
         if primitive not in self.ALLOWED_PRIMITIVES:
             raise ValueError(
@@ -403,6 +436,12 @@ class PrimitiveBenchmarkRunner:
         self.resume = resume
         self.device_loop_windows = device_loop_windows
         self.device_loop_min_window_ms = device_loop_min_window_ms
+        # compile-ahead engine knobs: overlap config N+1's XLA compile
+        # with config N's timing loop (in-process mode + persistent cache
+        # only — see _make_scheduler), and group same-signature configs
+        # adjacently so caches clear once per executable, not per row
+        self.compile_ahead = compile_ahead
+        self.group_by_signature = group_by_signature
         self._probed_world_size: Optional[int] = None  # subprocess probe cache
 
     def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -440,18 +479,21 @@ class PrimitiveBenchmarkRunner:
             # collective world
             raise ValueError("resume is single-process only")
         items = list(self.implementations.items())
-        iterator = items
-        if self.progress and is_primary:
-            try:
-                from tqdm import tqdm
-
-                iterator = tqdm(items, desc=f"{self.primitive} impls")
-            except ImportError:  # pragma: no cover
-                pass
+        # one signature computation per entry (load_impl_class + option
+        # merge each time): ordering, boundary detection and prefetch
+        # all read this dict
+        sigs = {
+            impl_id: self._signature_key(impl_id, spec)
+            for impl_id, spec in items
+        }
+        if self.group_by_signature:
+            # configs sharing an executable signature run adjacently so
+            # the isolation clear below fires once per signature group
+            items = order_by_signature(items, lambda i, _s: sigs[i])
 
         done = self._completed_rows() if self.resume else set()
-        rows: List[Dict[str, Any]] = []
-        for impl_id, spec in iterator:
+        pending: List[tuple] = []
+        for impl_id, spec in items:
             # key computation probes the device count — only pay that (and
             # only touch the backend) when there is a resume set to match
             if done and self._resume_key(impl_id, spec) in done:
@@ -462,7 +504,58 @@ class PrimitiveBenchmarkRunner:
                 if is_primary:
                     print(f"[ddlb_tpu] resume: skipping {impl_id} (in CSV)")
                 continue
+            pending.append((impl_id, spec))
+
+        scheduler = self._make_scheduler()
+        iterator = pending
+        if self.progress and is_primary:
+            try:
+                from tqdm import tqdm
+
+                iterator = tqdm(pending, desc=f"{self.primitive} impls")
+            except ImportError:  # pragma: no cover
+                pass
+
+        rows: List[Dict[str, Any]] = []
+        prev_sig = None
+        for idx, (impl_id, spec) in enumerate(iterator):
+            scheduler_busy = False
+            if scheduler is not None:
+                # reap this config's prefetch (launched during the
+                # previous row's timing loop) before touching caches —
+                # never clear under an active compile thread. Bounded:
+                # a prefetch wedged against a dying backend must not
+                # deadlock the sweep (no worker_timeout exists in-process)
+                scheduler.wait(timeout=scheduler.WAIT_TIMEOUT_S)
+                scheduler_busy = scheduler.busy
+                if scheduler_busy:
+                    print(
+                        "[ddlb_tpu] WARNING: compile-ahead prefetch still "
+                        "running after the bounded wait; skipping the "
+                        "cache clear this boundary (clearing under an "
+                        "active compile thread races the global caches)"
+                    )
+            sig = sigs[impl_id]
+            if (
+                self.isolation == "none"
+                and not scheduler_busy
+                and prev_sig is not None
+                and sig != prev_sig
+            ):
+                # cache-aware clearing: the cross-impl isolation contract
+                # now holds at executable-signature boundaries instead of
+                # per row — same-signature neighbors share the warm cache
+                # (the persistent disk cache is untouched by design)
+                import jax
+
+                jax.clear_caches()
+            prev_sig = sig
             config = self._worker_config(impl_id, spec)
+            if scheduler is not None and idx + 1 < len(pending):
+                # overlap: config N+1 compiles on a background thread
+                # while config N's timing loop owns the device
+                nxt_id, nxt_spec = pending[idx + 1]
+                scheduler.prefetch(self._worker_config(nxt_id, nxt_spec))
             row = self._run_one(config)
             rows.append(row)
             if is_primary:
@@ -471,14 +564,51 @@ class PrimitiveBenchmarkRunner:
                     # incremental append so a crash loses one row at most
                     # (reference benchmark.py:375-384)
                     self._append_csv(row)
+        if scheduler is not None:
+            scheduler.shutdown()
+            if is_primary and (
+                scheduler.prefetched or scheduler.failed or scheduler.skipped
+            ):
+                print(
+                    f"[ddlb_tpu] compile-ahead: {scheduler.prefetched} "
+                    f"prefetched, {scheduler.failed} failed, "
+                    f"{scheduler.skipped} skipped"
+                )
+        if (
+            self.isolation == "none"
+            and pending
+            and (scheduler is None or not scheduler.busy)
+        ):
+            # leave the process's caches clean for whatever runs next —
+            # the same end state the old per-row clearing guaranteed
+            # (skipped only if a wedged prefetch survived shutdown's
+            # bounded wait: clearing under it would race the caches)
+            import jax
+
+            jax.clear_caches()
         return pd.DataFrame(rows)
 
-    def _resume_key(self, impl_id: str, spec: Dict[str, Any]):
-        """Identity of one benchmark config, independent of the positional
-        ``impl_id`` numbering (which renumbers when the sweep is edited):
-        base implementation name + fully-merged option repr + shape/dtype.
-        Matches the ``option`` column the worker records (defaults merged
-        by OptionsManager)."""
+    def _make_scheduler(self) -> Optional[CompileAheadScheduler]:
+        """The compile-ahead scheduler, or None where it cannot help:
+        subprocess isolation (the parent must never touch the
+        accelerator — reference 'no CUDA init in parent',
+        cli/benchmark.py:126 — so children compile synchronously, still
+        sharing the persistent disk cache), or no persistent cache
+        configured (a prefetched executable has no channel to the
+        worker's fresh jit closures without the disk cache)."""
+        if not self.compile_ahead or self.isolation != "none":
+            return None
+        from ddlb_tpu.runtime import configure_compile_cache
+
+        if configure_compile_cache() is None:
+            return None
+        return CompileAheadScheduler()
+
+    def _merged_options(self, impl_id: str, spec: Dict[str, Any]):
+        """(base_implementation, DEFAULT-merged options) for one sweep
+        entry — the exact merge path the worker records (OptionsManager
+        over the class schema), shared by the resume key and the
+        executable signature so neither can drift from the CSV."""
         spec = dict(spec)
         base = spec.pop("implementation", impl_id.rsplit("_", 1)[0])
         # seed/mesh bind to named Primitive.__init__ params in the worker
@@ -490,13 +620,27 @@ class PrimitiveBenchmarkRunner:
             from ddlb_tpu.options import OptionsManager
 
             cls = load_impl_class(self.primitive, base)
-            # the exact merge path the worker records: OptionsManager.parse
-            # over the class schema (Primitive.__init__ -> options.py:40-52,
-            # family BASE_OPTIONS included via option_schema), so the
-            # formatted key cannot drift from the CSV 'option' column
             merged = OptionsManager(*cls.option_schema()).parse(spec)
         except Exception:
             merged = spec
+        return base, merged
+
+    def _signature_key(self, impl_id: str, spec: Dict[str, Any]):
+        """Executable-signature identity of one sweep entry: configs with
+        equal keys compile the same programs (measurement knobs live on
+        the runner, not in the spec), so they may share a warm cache."""
+        base, merged = self._merged_options(impl_id, spec)
+        return executable_signature(
+            self.primitive, base, merged, self.m, self.n, self.k, self.dtype
+        )
+
+    def _resume_key(self, impl_id: str, spec: Dict[str, Any]):
+        """Identity of one benchmark config, independent of the positional
+        ``impl_id`` numbering (which renumbers when the sweep is edited):
+        base implementation name + fully-merged option repr + shape/dtype.
+        Matches the ``option`` column the worker records (defaults merged
+        by OptionsManager via ``_merged_options``)."""
+        base, merged = self._merged_options(impl_id, spec)
         return (
             self.primitive,
             base,
@@ -709,11 +853,9 @@ class PrimitiveBenchmarkRunner:
                 proc.kill()
                 proc.join()
             return row
-        import jax
-
-        row = benchmark_worker(config)
-        jax.clear_caches()  # avoid cross-impl compilation-cache coupling
-        return row
+        # cross-impl cache isolation is the run() loop's job now: it
+        # clears at executable-signature boundaries instead of per row
+        return benchmark_worker(config)
 
     def _error_row(self, config: Dict[str, Any], error: str) -> Dict[str, Any]:
         """Error row for a worker that hung or died — the same schema as

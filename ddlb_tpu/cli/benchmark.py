@@ -205,6 +205,11 @@ def run_benchmark(config: Dict[str, Any]):
             device_loop_min_window_ms=cfg.get(
                 "device_loop_min_window_ms", 100.0
             ),
+            # compile-ahead engine knobs (benchmark.py): both default on;
+            # compile_ahead only engages when DDLB_TPU_COMPILE_CACHE is
+            # set and isolation is in-process
+            compile_ahead=cfg.get("compile_ahead", True),
+            group_by_signature=cfg.get("group_by_signature", True),
         )
         frames.append(runner.run())
 
@@ -286,6 +291,18 @@ def main(argv=None) -> None:
         "crashed rows are retried (give --csv a fixed path, not a "
         "{timestamp} one)",
     )
+    parser.add_argument(
+        "--no-compile-ahead", action="store_true",
+        help="disable background AOT compilation of the next config "
+        "(compile-ahead otherwise engages when DDLB_TPU_COMPILE_CACHE "
+        "is set and isolation is in-process)",
+    )
+    parser.add_argument(
+        "--no-signature-grouping", action="store_true",
+        help="keep the sweep's literal config order instead of grouping "
+        "configs that share an executable signature (grouping lets the "
+        "runner clear caches once per signature, not per row)",
+    )
     args = parser.parse_args(argv)
 
     impl_specs = args.impl or ["jax_spmd"]
@@ -312,6 +329,8 @@ def main(argv=None) -> None:
         "sim": args.sim,
         "worker_timeout": args.worker_timeout,
         "resume": args.resume,
+        "compile_ahead": not args.no_compile_ahead,
+        "group_by_signature": not args.no_signature_grouping,
     }
     run_benchmark(config)
 

@@ -96,6 +96,19 @@ def get_sim_device_count() -> int:
     return get_env(("DDLB_TPU_SIM_DEVICES",), 0, int)
 
 
+def get_compile_cache_dir() -> str:
+    """Persistent XLA compilation-cache directory ("" = disabled).
+
+    When set, the runtime points ``jax_compilation_cache_dir`` here so
+    repeated or resumed sweeps reuse compiled executables across
+    processes (and across ``jax.clear_caches()``) instead of re-paying
+    cold compiles — the compile-ahead engine's cross-process banking
+    layer (utils/compile_ahead.py). Follows the DDLB_TPU_* convention:
+    empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_COMPILE_CACHE", "").strip()
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

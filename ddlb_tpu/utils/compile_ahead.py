@@ -1,0 +1,358 @@
+"""Compile-ahead sweep engine: hide XLA compilation behind measurement.
+
+Round-5 review (VERDICT.md) showed the binding constraint on the paper's
+result table is sweep throughput: the only live hardware window ever was
+82 minutes, and every row paid a cold XLA compile before its first
+measured iteration. This module attacks that on three fronts, the same
+way T3 (PAPERS.md) hides collective latency behind compute:
+
+1. **Compile metrics** — per-row ``compile_time_s`` / ``compile_cache_hit``
+   accounting via JAX's monitoring events, so every CSV row shows what
+   the compile cost and whether the persistent cache paid it.
+   Thread-local: a background prefetch compiling on another thread never
+   pollutes the measuring row's numbers.
+2. **Executable signatures** — the identity under which two sweep configs
+   share a compiled executable (impl + merged options + shape + dtype,
+   modulo measurement knobs, which live outside the options dict).
+   ``order_by_signature`` groups a sweep so same-signature configs run
+   adjacently and the runner clears caches only at group boundaries,
+   preserving the cross-impl isolation contract at 1/N the compile cost.
+3. **CompileAheadScheduler** — AOT-lowers and compiles config N+1's
+   executables on a daemon thread while config N's timing loop runs on
+   device. XLA compilation is host-side C++ that releases the GIL, so
+   the overlap is real; the compiled artifact reaches the measuring
+   worker through the persistent compilation cache
+   (``DDLB_TPU_COMPILE_CACHE``, runtime.configure_compile_cache), which
+   survives both ``jax.clear_caches()`` and process boundaries. Without
+   a persistent cache the prefetch has no channel to the worker (each
+   worker re-jits fresh closures), so the runner only engages the
+   scheduler when the cache is configured. In subprocess-isolation mode
+   the parent must never touch the accelerator, so the runner falls back
+   to synchronous compiles in the child (which still hit the shared
+   disk cache).
+
+Known trade-off, documented rather than hidden: prefetching constructs
+the next impl, which places operands (and for the serving family runs
+its setup prefill) on device concurrently with the measured loop. On the
+CPU sim this is noise; on one real chip it can perturb the tail of the
+previous row's window and raises transient HBM pressure. The hardware
+batches therefore keep subprocess isolation (sync fallback) and bank
+compiles via the persistent cache instead.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Compile metrics: who paid for compilation, and did the cache answer
+# ---------------------------------------------------------------------------
+
+#: JAX monitoring event names (stable across the versions the fleet runs).
+#: backend_compile_duration wraps the whole compile-or-get-cached path —
+#: on a hit it measures retrieval+deserialize — so it alone is "time
+#: spent obtaining executables"; adding cache_retrieval_time_sec would
+#: double-count every hit.
+_COMPILE_DURATION_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+)
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_tls = threading.local()
+_listener_lock = threading.Lock()
+_listeners_installed = False
+
+
+class CompileMetrics:
+    """Accumulates compile cost observed on ONE thread inside a
+    ``compile_metrics()`` scope."""
+
+    def __init__(self) -> None:
+        self.compile_time_s = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+
+    @property
+    def cache_hit(self) -> bool:
+        """True when the persistent cache served every executable this
+        scope compiled (and there was at least one to serve)."""
+        return self.cache_hits > 0 and self.cache_misses == 0
+
+
+def _collectors() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = []
+        _tls.stack = stack
+    return stack
+
+
+def _on_event(event: str, **kwargs: Any) -> None:
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    if event == _CACHE_HIT_EVENT:
+        for c in stack:
+            c.cache_hits += 1
+    elif event == _CACHE_MISS_EVENT:
+        for c in stack:
+            c.cache_misses += 1
+
+
+def _on_event_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    if event in _COMPILE_DURATION_EVENTS:
+        for c in stack:
+            c.compile_time_s += float(duration_secs)
+
+
+def _install_listeners() -> None:
+    """Register the (process-global, idempotent) monitoring listeners."""
+    global _listeners_installed
+    with _listener_lock:
+        if _listeners_installed:
+            return
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_event_duration)
+        _listeners_installed = True
+
+
+@contextmanager
+def compile_metrics():
+    """Scope whose body's compile work (on THIS thread) is accounted.
+
+    Nests: an inner scope's compiles also count toward the outer one.
+    Thread-local by construction — a concurrent prefetch thread's
+    compiles land in that thread's own scopes (or nowhere), never here.
+    """
+    _install_listeners()
+    metrics = CompileMetrics()
+    stack = _collectors()
+    stack.append(metrics)
+    try:
+        yield metrics
+    finally:
+        stack.remove(metrics)
+
+
+# ---------------------------------------------------------------------------
+# Executable signatures and sweep grouping
+# ---------------------------------------------------------------------------
+
+
+def executable_signature(
+    primitive: str,
+    base_implementation: str,
+    options: Dict[str, Any],
+    m: int,
+    n: int,
+    k: int,
+    dtype: str,
+) -> Tuple:
+    """Identity under which two configs share compiled executables.
+
+    Measurement knobs (iterations, warmups, timing backend, windows)
+    live outside the options dict in this runner, so the signature is
+    exactly (impl, merged options, shape, dtype). ``seed``/``mesh`` bind
+    to named ``Primitive.__init__`` params and never change the program
+    being compiled — dropped, matching the runner's resume-key rules.
+    """
+    options = dict(options)
+    options.pop("seed", None)
+    options.pop("mesh", None)
+    opt_repr = ";".join(f"{k_}={v}" for k_, v in sorted(options.items())) or "-"
+    return (primitive, base_implementation, opt_repr, m, n, k, dtype)
+
+
+def config_signature(config: Dict[str, Any]) -> Tuple:
+    """``executable_signature`` of a benchmark_worker config dict."""
+    return executable_signature(
+        config["primitive"],
+        config.get("base_implementation", config.get("impl_id", "")),
+        config.get("options", {}),
+        config["m"],
+        config["n"],
+        config["k"],
+        config.get("dtype", "bfloat16"),
+    )
+
+
+def order_by_signature(
+    items: Sequence[Tuple[Any, Any]],
+    key_fn: Callable[[Any, Any], Any],
+) -> List[Tuple[Any, Any]]:
+    """Stable-group ``(id, spec)`` items so equal-signature entries are
+    adjacent: signatures keep first-appearance order, items keep their
+    relative order inside a group. A sweep with all-distinct signatures
+    (the common case) comes back unchanged."""
+    groups: Dict[Any, List[Tuple[Any, Any]]] = {}
+    order: List[Any] = []
+    for item_id, spec in items:
+        key = key_fn(item_id, spec)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append((item_id, spec))
+    return [item for key in order for item in groups[key]]
+
+
+# ---------------------------------------------------------------------------
+# AOT prefetch
+# ---------------------------------------------------------------------------
+
+
+def _aot_compile(fn, args) -> None:
+    """Lower+compile ``fn(*args)`` without executing it.
+
+    ``fn`` is usually a ``jax.jit`` object (``.lower`` exists); the f32/
+    f64 precision wrapper (primitives/base.with_matmul_precision) is a
+    plain callable, re-jitted here — that copy may not share a cache key
+    with the worker's inner jit, so prefetch is best-effort there.
+    """
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    fn.lower(*args).compile()
+
+
+def prefetch_compile(config: Dict[str, Any]) -> int:
+    """Compile everything a ``benchmark_worker`` run of ``config`` will
+    compile for its measured region, without running an iteration.
+
+    Builds the implementation (constructor-time compiles — e.g. the
+    serving family's setup prefill — happen here, exactly as they would
+    in the worker, and land in the persistent cache), then AOT-compiles
+    the step fn and, for the device_loop backend, the big/small
+    differential loops at the configured iteration count. Returns the
+    number of programs compiled (for logging/tests).
+    """
+    from ddlb_tpu.primitives.registry import load_impl_class
+    from ddlb_tpu.utils.timing import make_timed_loop
+
+    impl_class = load_impl_class(
+        config["primitive"], config["base_implementation"]
+    )
+    impl = impl_class(
+        config["m"],
+        config["n"],
+        config["k"],
+        dtype=config.get("dtype", "bfloat16"),
+        **dict(config.get("options", {})),
+    )
+    compiled = 0
+    try:
+        fn, args = impl.timed_call()
+        _aot_compile(fn, args)
+        compiled += 1
+        if config.get("time_measurement_backend") == "device_loop":
+            n = int(config.get("num_iterations", 50))
+            opts = getattr(impl, "xla_compiler_options", None)
+            big, cargs = make_timed_loop(fn, args, n, opts)
+            _aot_compile(big, cargs)
+            compiled += 1
+            small_n = max(1, n // 4)
+            if small_n != n:
+                small, _ = make_timed_loop(fn, args, small_n, opts)
+                _aot_compile(small, cargs)
+                compiled += 1
+    finally:
+        del impl  # free operands before the next measured config builds
+    return compiled
+
+
+class CompileAheadScheduler:
+    """One-config-lookahead background compiler.
+
+    ``prefetch(config)`` starts compiling on a daemon thread and returns
+    immediately; ``wait()`` joins the in-flight prefetch and reports
+    whether it succeeded. A prefetch failure is recorded and cleared —
+    the sweep falls back to a synchronous compile for that config, it
+    never aborts (the worker's own crash isolation still owns real
+    errors). One prefetch in flight at a time: scheduling a new one
+    first waits out (and thereby reaps) the previous thread, so a worker
+    failure can never leak a zombie compile thread across the sweep.
+    """
+
+    def __init__(
+        self, compile_fn: Callable[[Dict[str, Any]], Any] = prefetch_compile
+    ) -> None:
+        self._compile_fn = compile_fn
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        #: totals for the sweep log
+        self.prefetched = 0
+        self.failed = 0
+        self.skipped = 0
+
+    #: how long the sweep loop will block on an in-flight prefetch
+    #: before proceeding with a synchronous compile (big TPU programs
+    #: legitimately compile for minutes; a WEDGED backend hangs forever,
+    #: and an unbounded join would deadlock the whole sweep — the hang
+    #: class this codebase guards against everywhere else)
+    WAIT_TIMEOUT_S = 600.0
+
+    def prefetch(self, config: Dict[str, Any]) -> None:
+        self.wait(timeout=0.0)  # reap a finished thread, never block
+        if self._thread is not None:
+            # previous prefetch still compiling (possibly against a
+            # wedged backend): don't stack another thread behind it —
+            # the skipped config simply compiles synchronously
+            self.skipped += 1
+            return
+        self._error = None
+
+        def _work(cfg=dict(config)) -> None:
+            try:
+                with compile_metrics():  # isolate from any measuring scope
+                    self._compile_fn(cfg)
+            except BaseException as exc:  # recorded, reported by wait()
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=_work, name="ddlb-compile-ahead", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def busy(self) -> bool:
+        """True while a prefetch thread is alive (after a timed-out
+        ``wait``): callers must not mutate global JAX caches under it."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the in-flight prefetch. True = a prefetch completed
+        cleanly; False = none in flight, it failed, or it is still
+        running after ``timeout``."""
+        thread, self._thread = self._thread, None
+        if thread is None:
+            return False
+        thread.join(timeout)
+        if thread.is_alive():
+            # still compiling: put it back so shutdown()/next prefetch
+            # reaps it; the caller proceeds with a synchronous compile
+            self._thread = thread
+            return False
+        if self._error is not None:
+            self.failed += 1
+            print(
+                f"[ddlb_tpu] compile-ahead prefetch failed "
+                f"({type(self._error).__name__}: {self._error}); "
+                f"falling back to synchronous compile"
+            )
+            self._error = None
+            return False
+        self.prefetched += 1
+        return True
+
+    def shutdown(self) -> None:
+        """Reap any in-flight prefetch (bounded: the thread is a daemon,
+        so one wedged against a dead backend cannot hold the process)."""
+        self.wait(timeout=self.WAIT_TIMEOUT_S)

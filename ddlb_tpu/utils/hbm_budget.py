@@ -24,7 +24,11 @@ models/decode.py and models/transformer.py):
 
 - ``weights``: untied embed + LM head ``2 * V * D`` bf16, per layer
   q/o projections ``2 D^2`` + k/v ``2 D^2 * kv_frac`` bf16, routed MLP
-  ``2 D F`` (int8 under ``mlp_kernel=int8_weights``).
+  ``2 D F`` (int8 under ``mlp_kernel=int8_weights``). The speculate
+  phase adds the draft model explicitly: its OWN embed + LM head plus
+  ``draft_layers`` decoder layers (spmd.py builds the draft as a full
+  model via init_params, so total-scaling by ``(L+draft)/L`` undercounts
+  the draft's embed/head — ADVICE r5, ~67 MB at the r4 batch shape).
 - ``kv_cache``: ``layers * 2 * B * S_cache * h_kv * dh`` at 1 (int8,
   plus f32 per-(position, head) scales) or 2 (bf16) bytes.
 - ``prefill_live``: the prompt pass's dominant concurrent buffers —
@@ -120,12 +124,17 @@ def decode_budget(
     dh = D // n_heads
 
     w_bytes = 1 if mlp_kernel == "int8_weights" else 2
-    weights = (
-        2.0 * V * D * 2  # embed + untied head
-        + L * ((2.0 + 2.0 * kv_frac) * D * D * 2 + 2.0 * D * F * w_bytes)
-    )
+    embed_head = 2.0 * V * D * 2  # embed + untied head, bf16
+    per_layer = (2.0 + 2.0 * kv_frac) * D * D * 2 + 2.0 * D * F * w_bytes
+    weights = embed_head + L * per_layer
     if phase == "speculate":
-        weights *= (L + draft_layers) / L if L else 1.0
+        # the draft is a FULL model at draft_layers depth (spmd.py builds
+        # it via init_params on the draft config): its own embed + LM
+        # head plus draft_layers decoder layers. The old total-scaling
+        # form ``weights *= (L + draft_layers)/L`` credited the draft
+        # only ``draft_layers/L`` of an embed+head — a ~67 MB
+        # OOM-direction underestimate at the r4 batch shape (ADVICE r5).
+        weights += embed_head + draft_layers * per_layer
 
     # cache horizon per phase (spmd.py's init_cache calls)
     if phase == "decode":

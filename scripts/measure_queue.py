@@ -1,0 +1,802 @@
+#!/usr/bin/env python
+"""The one resumable hardware row queue (supersedes measure_r{2,3,4}_hw
+and measure_r2_remaining).
+
+Four generations of armed batch scripts each re-ran from their own top on
+every relay window, re-paying compiles and re-measuring banked rows. This
+queue replays the UNION of their row lists in **value order** (the
+verdict-demanded headline rows first — same rationale as the watcher's
+batch ordering), **checkpoints after every row** to
+``hwlogs/queue_state.json``, and **resumes mid-queue**: a short relay
+window drains the most-demanded rows first, and a second window starts
+where the first died instead of at the top.
+
+Compile banking: the queue exports ``DDLB_TPU_COMPILE_CACHE`` (default
+``hwlogs/compile_cache``) so every per-row child process reuses the
+persistent XLA compilation cache — a row retried after a flap, or a
+config sharing executables with an earlier row, skips the cold compile
+it already paid for (see ddlb_tpu/utils/compile_ahead.py; rows record
+``compile_time_s`` / ``compile_cache_hit``).
+
+Failure policy mirrors the watcher's: an errored row is retried on the
+next pass, but after MAX_ATTEMPTS failed attempts it is parked (a
+deterministically failing config must not re-burn capture windows).
+
+Usage: python scripts/measure_queue.py [--quick] [--smoke] [--list]
+           [--only SECTION_PREFIX] [--limit N] [--state PATH]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATE_PATH = os.path.join(REPO, "hwlogs", "queue_state.json")
+COMPILE_CACHE_DEFAULT = os.path.join(REPO, "hwlogs", "compile_cache")
+
+MAX_ATTEMPTS = 2
+
+V5E_HBM_GBPS = 819.0
+V5E_PEAK_BF16_TFLOPS = 197.0
+
+# the serving-table model (scripts/measure_r3_hw.py section 1)
+D, F, V, HEADS, B, LAYERS = 2048, 8192, 16384, 16, 8, 1
+DH = D // HEADS
+
+
+# ---------------------------------------------------------------------------
+# Queue construction: the union of the four batch lists, value-ordered
+# ---------------------------------------------------------------------------
+
+
+def _row(section, label, primitive, impl, m, n, k, derive=None,
+         proto_overrides=None, note=None, **options):
+    return {
+        "kind": "row",
+        "section": section,
+        "label": label,
+        "primitive": primitive,
+        "impl": impl,
+        "m": m,
+        "n": n,
+        "k": k,
+        "options": options,
+        "proto_overrides": proto_overrides or {},
+        "derive": derive,
+        "note": note,
+    }
+
+
+def _action(section, label, action):
+    return {
+        "kind": "action",
+        "section": section,
+        "label": label,
+        "action": action,
+    }
+
+
+def entry_key(entry) -> str:
+    """Stable checkpoint identity of one queue entry — the caller-config
+    form (same philosophy as hw_common's bank_key: options as the script
+    spells them, before DEFAULT merging)."""
+    if entry["kind"] == "action":
+        # label included: the generic "noop" skip marker appears once per
+        # skipped section and each must checkpoint independently
+        return json.dumps(
+            {"action": entry["action"], "label": entry["label"]},
+            sort_keys=True,
+        )
+    return json.dumps(
+        {
+            "primitive": entry["primitive"],
+            "impl": entry["impl"],
+            "m": entry["m"],
+            "n": entry["n"],
+            "k": entry["k"],
+            "options": entry["options"],
+            "proto_overrides": entry["proto_overrides"],
+        },
+        sort_keys=True,
+        default=str,
+    )
+
+
+def build_queue(quick: bool = False, smoke: bool = False):
+    """The full value-ordered entry list (pure: no JAX, no hardware —
+    the HBM budget model is plain arithmetic)."""
+    from ddlb_tpu.utils.hbm_budget import fit_batch
+
+    q = []
+    if smoke:
+        # plumbing test without the relay: one tiny roofline row (the
+        # least-demanding impl, runs on every backend), no TPU-only
+        # sections (kernel parity needs a real chip)
+        q.append(_row(
+            "smoke", "gemm roofline smoke 128^3", "tp_columnwise",
+            "compute_only", 128, 128, 128, size="unsharded",
+        ))
+        return q
+
+    # -- 1) r3 serving table: the oldest unmet verdict asks ------------------
+    contexts = (2048, 8192) if quick else (2048, 8192, 32768, 65536)
+    for ctx in contexts:
+        b_ctx, rep = fit_batch(
+            preferred_batch=B, ctx=ctx, d_model=D, d_ff=F, vocab=V,
+            n_heads=HEADS, layers=LAYERS, phase="decode", validate=True,
+        )
+        note = f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}"
+        if not rep.fits:
+            # recorded at build so the skip is visible in --list output
+            q.append(_action(
+                "r3-serving", f"SKIPPED ctx={ctx}: no batch fits", "noop"
+            ))
+            continue
+        levers = (
+            (f"bf16 cache, MHA @ {ctx} B={b_ctx}", {}),
+            (f"int8 cache, MHA @ {ctx} B={b_ctx}", {"kv_cache": "int8"}),
+            (f"bf16 cache, GQA4 @ {ctx} B={b_ctx}", {"n_kv_heads": 4}),
+            (f"int8 cache, GQA4 @ {ctx} B={b_ctx}",
+             {"n_kv_heads": 4, "kv_cache": "int8"}),
+            (f"int8 cache + int8 weights @ {ctx} B={b_ctx}",
+             {"kv_cache": "int8", "mlp_kernel": "int8_weights"}),
+        )
+        for label, extra in levers:
+            q.append(_row(
+                "r3-serving", label, "transformer_decode", "spmd",
+                ctx, D, F, derive="serving", note=note,
+                batch=b_ctx, vocab=V, n_heads=HEADS, phase="decode",
+                attn_kernel="flash", **extra,
+            ))
+            note = None  # budget line prints once per context
+    q.append(_row(
+        "r3-serving", "prefill 2k (flash)", "transformer_decode", "spmd",
+        2048, D, F, batch=B, vocab=V, n_heads=HEADS, phase="prefill",
+        attn_kernel="flash",
+    ))
+    n_new = 32
+    for lbl, extra in (
+        (f"generate 2k+{n_new} bf16 MHA", {}),
+        (f"generate 2k+{n_new} int8+GQA4",
+         {"kv_cache": "int8", "n_kv_heads": 4}),
+    ):
+        q.append(_row(
+            "r3-serving", lbl, "transformer_decode", "spmd", 2048, D, F,
+            derive="generate", batch=B, vocab=V, n_heads=HEADS,
+            phase="generate", n_new=n_new, attn_kernel="einsum", **extra,
+        ))
+
+    # -- 2) r3 int8 Pallas tile sweep + autotuned rows -----------------------
+    M = N = K = 8192
+    q.append(_row("r3-int8", "XLA int8 (reference)", "tp_columnwise",
+                  "quantized", M, N, K, kernel="xla", quantize="static"))
+    q.append(_row("r3-int8", "pallas int8 AUTOTUNED", "tp_columnwise",
+                  "quantized", M, N, K, kernel="pallas", quantize="static",
+                  tune=True))
+    q.append(_row("r3-int8", "pallas bf16 AUTOTUNED", "tp_columnwise",
+                  "pallas", M, N, K, tune=True))
+    tiles = (
+        [(1024, 1024, 1024), (512, 1024, 1024)]
+        if quick
+        else [
+            (1024, 1024, 1024), (512, 1024, 1024), (1024, 512, 1024),
+            (1024, 1024, 512), (512, 512, 2048), (2048, 1024, 512),
+            (512, 2048, 1024),
+        ]
+    )
+    for bm, bn, bk in tiles:
+        q.append(_row(
+            "r3-int8", f"pallas int8 tiles ({bm},{bn},{bk})",
+            "tp_columnwise", "quantized", M, N, K,
+            kernel="pallas", quantize="static",
+            block_m=bm, block_n=bn, block_k=bk,
+        ))
+
+    # -- 3) r4 MFU-vs-shape curve --------------------------------------------
+    curve = [
+        (2048, 2048, 8192, 16),
+        (4096, 2048, 8192, 16),  # the 0.80-MFU BASELINE.md point
+        (8192, 2048, 8192, 16),
+        (4096, 4096, 16384, 32),
+    ]
+    if not quick:
+        curve.append((8192, 4096, 16384, 32))
+    for seq, d, f, heads in curve:
+        q.append(_row(
+            "r4-mfu", f"train seq={seq} d={d} ff={f} h={heads}",
+            "transformer_step", "spmd", seq, d, f, derive="mfu",
+            proto_overrides={"validate": False},
+            mode="train", attn_kernel="flash", batch=1, vocab=V,
+            n_heads=heads, microbatches=1, pp=1, tp=1, dp=1,
+        ))
+
+    # -- 4) r4 compiled-vs-interpreted kernel parity (world=1 self-DMA) -----
+    q.append(_action(
+        "r4-parity", "compiled vs interpreted kernel parity",
+        "kernel_parity",
+    ))
+
+    # -- 5) r3 xprof trace of the MFU headline + top-op digest --------------
+    q.append(_row(
+        "r3-trace", "MFU-headline train step (xprof trace)",
+        "transformer_step", "spmd", 4096, D, F, derive="mfu",
+        proto_overrides={
+            "validate": False, "profile_dir": "profiles/mfu_breakdown",
+        },
+        mode="train", attn_kernel="flash", batch=1, vocab=V,
+        n_heads=HEADS, microbatches=1, pp=1, tp=1, dp=1,
+    ))
+    q.append(_action("r3-trace", "xprof top-op digest", "xprof_summary"))
+
+    # -- 6) r3 schedules + GQA train row -------------------------------------
+    model = dict(batch=4, vocab=V, n_heads=HEADS, microbatches=4,
+                 pp=1, tp=1, dp=1)
+    for sched in ("gpipe", "1f1b"):
+        q.append(_row(
+            "r3-sched",
+            f"train schedule={sched} (single chip: pp=1 degenerate)",
+            "transformer_step", "spmd", 2048, D, F,
+            mode="train", schedule=sched, attn_kernel="flash", **model,
+        ))
+    q.append(_row(
+        "r3-sched", "train GQA4 flash", "transformer_step", "spmd",
+        4096, D, F, mode="train", attn_kernel="flash", n_kv_heads=4,
+        batch=4, vocab=V, n_heads=HEADS, microbatches=1, pp=1, tp=1, dp=1,
+    ))
+
+    # -- 7) r4 speculative decoding + continuous batching --------------------
+    n_new = 64
+    for phase, extra in (
+        ("generate", {}),
+        ("speculate", {"spec_k": 4, "draft_layers": 1}),
+        ("speculate", {"spec_k": 8, "draft_layers": 1}),
+    ):
+        q.append(_row(
+            "r4-spec", f"{phase} 2k+{n_new} {extra or ''}",
+            "transformer_decode", "spmd", 2048, D, F, derive="speculate",
+            proto_overrides={"validate": False},
+            phase=phase, n_new=n_new, batch=8, vocab=V, n_heads=16,
+            layers=2, attn_kernel="einsum", **extra,
+        ))
+    n_req = 16
+    for lbl, extra in (
+        ("contiguous", {}),
+        ("paged 1.0", {"cache_layout": "paged", "page_pool_frac": 1.0}),
+        ("paged 0.5", {"cache_layout": "paged", "page_pool_frac": 0.5}),
+        ("paged 0.5 + fused kernel",
+         {"cache_layout": "paged", "page_pool_frac": 0.5,
+          "decode_kernel": "pallas"}),
+    ):
+        q.append(_row(
+            "r4-spec", f"serve {n_req} reqs @2k, n_new<={n_new} [{lbl}]",
+            "transformer_decode", "spmd", 2048, D, F, derive="serve",
+            proto_overrides={
+                "validate": False,
+                "time_measurement_backend": "host_clock",
+            },
+            phase="serve", n_new=n_new, n_requests=n_req, batch=8,
+            vocab=V, n_heads=16, layers=2, attn_kernel="einsum",
+            dp=1, tp=1, **extra,
+        ))
+
+    # -- 8) r4 fused decode-attention kernel A/B -----------------------------
+    for ctx in (8192, 32768, 65536):
+        b_ctx, rep = fit_batch(
+            preferred_batch=8, ctx=ctx, d_model=D, d_ff=F, vocab=V,
+            n_heads=HEADS, layers=LAYERS, phase="decode", validate=False,
+        )
+        note = f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}"
+        if not rep.fits:
+            q.append(_action(
+                "r4-decode", f"SKIPPED ctx={ctx}: no batch fits", "noop"
+            ))
+            continue
+        for lbl, extra in (
+            ("bf16 MHA", {}),
+            ("int8+GQA4", {"kv_cache": "int8", "n_kv_heads": 4}),
+        ):
+            for dk in ("einsum", "pallas"):
+                q.append(_row(
+                    "r4-decode",
+                    f"decode @{ctx} {lbl} kernel={dk} B={b_ctx}",
+                    "transformer_decode", "spmd", ctx, D, F, note=note,
+                    proto_overrides={"validate": False},
+                    phase="decode", batch=b_ctx, vocab=V, n_heads=HEADS,
+                    attn_kernel="flash", decode_kernel=dk, **extra,
+                ))
+                note = None
+
+    # -- 9) r4 windowed flash attention --------------------------------------
+    for w in (0, 4096):
+        q.append(_row(
+            "r4-window", f"flash seq=32k window={w or 'full'}",
+            "cp_ring_attention", "flash", 32768, 2048, 128,
+            proto_overrides={"validate": False},
+            window=w, block_q=1024, block_kv=1024,
+        ))
+
+    # -- 10) r4 HBM-copy roofline --------------------------------------------
+    for m_pay in (8192, 32768):
+        q.append(_row(
+            "r4-hbm", f"hbm copy roofline {m_pay}x8192 bf16",
+            "collectives", "compute_only", m_pay, 8, 8192,
+            derive="hbm_copy", size="unsharded",
+        ))
+
+    # -- 11) r2 forward-mode MLP kernel A/B ----------------------------------
+    model = dict(batch=1, vocab=V, n_heads=HEADS, microbatches=1)
+    for mlp in ("bf16", "int8", "int8_weights"):
+        q.append(_row(
+            "r2-mlp", f"forward mlp_kernel={mlp}", "transformer_step",
+            "spmd", 4096, 2048, 8192, mode="forward", mlp_kernel=mlp,
+            attn_kernel="flash", **model,
+        ))
+
+    # -- 12) r2 decode/prefill/ep rows (union of r2_hw + r2_remaining) ------
+    serve = dict(batch=8, vocab=V, n_heads=HEADS)
+    for ctx in (1024, 4096) if quick else (1024, 4096, 8192):
+        for mlp in ("bf16", "int8_weights"):
+            q.append(_row(
+                "r2-decode", f"decode ctx={ctx} mlp={mlp}",
+                "transformer_decode", "spmd", ctx, 2048, 8192,
+                phase="decode", mlp_kernel=mlp, **serve,
+            ))
+    q.append(_row(
+        "r2-decode", "prefill 1k", "transformer_decode", "spmd",
+        1024, 2048, 8192, phase="prefill", **serve,
+    ))
+    q.append(_row("r2-decode", "ep_alltoall jax_spmd", "ep_alltoall",
+                  "jax_spmd", 8192, 8192, 8192))
+    q.append(_row("r2-decode", "ep_alltoall quantized", "ep_alltoall",
+                  "quantized", 8192, 8192, 8192, quantize="static"))
+
+    # drop exact duplicates (r2_remaining rows re-listed by r2_hw etc.),
+    # first occurrence wins so value order is preserved
+    seen, unique = set(), []
+    for entry in q:
+        key = entry_key(entry)
+        if key in seen:
+            continue
+        seen.add(key)
+        unique.append(entry)
+    return unique
+
+
+# ---------------------------------------------------------------------------
+# Derived per-row prints (ported from the superseded batch scripts)
+# ---------------------------------------------------------------------------
+
+
+def _decode_bytes(ctx, b, n_kv, kv_cache, mlp_kernel, tp=1):
+    """HBM bytes read per decode step (the bandwidth model): K+V cache at
+    the context length + this chip's weights once (measure_r3_hw)."""
+    h_kv = n_kv or HEADS
+    kv_bytes = 1 if kv_cache == "int8" else 2
+    cache = 2 * LAYERS * b * ctx * h_kv * DH * kv_bytes
+    if kv_cache == "int8":
+        cache += 2 * LAYERS * b * ctx * h_kv * 4  # f32 scales
+    w_bytes = 1 if mlp_kernel == "int8_weights" else 2
+    kv_frac = h_kv / HEADS
+    weights = (
+        LAYERS * ((2 + 2 * kv_frac) * D * D * 2 + 2 * D * F * w_bytes / tp)
+        + D * V * 2
+    )
+    return cache + weights
+
+
+def _finite(x):
+    import math
+
+    try:
+        return math.isfinite(float(x))
+    except (TypeError, ValueError):
+        return False
+
+
+def _derive_print(entry, row):
+    """The batch scripts' per-row follow-up lines, keyed by entry."""
+    opts = entry.get("options", {})
+    t_ms = row.get("median time (ms)")
+    if not _finite(t_ms) or row.get("error"):
+        return
+    derive = entry.get("derive")
+    if derive == "serving":
+        b = opts.get("batch", B)
+        gb = _decode_bytes(
+            entry["m"], b, opts.get("n_kv_heads", 0),
+            opts.get("kv_cache", "bf16"), opts.get("mlp_kernel", "bf16"),
+        ) / 1e9
+        frac = gb / (t_ms / 1e3) / V5E_HBM_GBPS
+        print(
+            f"    -> {t_ms / b:.3f} ms/token  {b / t_ms * 1e3:,.0f} tok/s   "
+            f"bytes-read model {gb:.2f} GB/step  HBM fraction {frac:.2f}",
+            flush=True,
+        )
+    elif derive == "generate":
+        b, n_new = opts.get("batch", B), opts.get("n_new", 32)
+        print(
+            f"    -> {b * n_new / t_ms * 1e3:,.0f} generated tok/s end to end",
+            flush=True,
+        )
+    elif derive == "speculate":
+        b, n_new = opts.get("batch", 8), opts.get("n_new", 64)
+        print(f"    -> {b * n_new / t_ms * 1e3:,.0f} tok/s end to end",
+              flush=True)
+        if "spec_accept_rate" in row:
+            print(
+                f"    -> measured acceptance rate "
+                f"{row['spec_accept_rate']:.3f} over "
+                f"{row.get('spec_rounds')} verify rounds",
+                flush=True,
+            )
+    elif derive == "serve":
+        n_req, n_new = opts.get("n_requests", 16), opts.get("n_new", 64)
+        total_new = sum(1 + ((i + 3) % n_new) for i in range(n_req))
+        print(
+            f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
+            f"({total_new} tokens drained)",
+            flush=True,
+        )
+        if "serve_occupancy" in row:
+            pages = (
+                f"  peak pages {row['serve_peak_pages']}"
+                f"/{row.get('serve_pages_capacity')}"
+                if "serve_peak_pages" in row
+                else ""
+            )
+            print(
+                f"    -> occupancy {row['serve_occupancy']:.3f}  deferrals "
+                f"{row.get('serve_admissions_deferred')}{pages}",
+                flush=True,
+            )
+    elif derive == "hbm_copy":
+        gb = entry["m"] * 8192 * 2 / 1e9
+        print(
+            f"    -> payload {gb:.2f} GB  copy GB/s "
+            f"{gb / (t_ms / 1e3):,.0f}  (raw HBM r+w ~2x)",
+            flush=True,
+        )
+    elif derive == "mfu":
+        tf = row.get("Throughput (TFLOPS)")
+        if _finite(tf):
+            print(f"    -> MFU {tf / V5E_PEAK_BF16_TFLOPS:.3f}", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Actions (non-row work carried over from the batch scripts)
+# ---------------------------------------------------------------------------
+
+
+def _run_parity() -> bool:
+    """Compiled-vs-interpreted Pallas kernel parity at world=1 self-DMA
+    (measure_r4_hw section 2); needs a real TPU. Returns ok.
+
+    MUST run in a child process (``--parity-child``), never in the queue
+    driver: importing jax here initializes the TPU backend, and libtpu
+    locks the chip to this process for its lifetime — a driver that ran
+    parity inline would starve every later per-row child of the chip.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ddlb_tpu.ops.alltoall_matmul import alltoall_expert_matmul
+    from ddlb_tpu.ops.collective_matmul import ring_ag_matmul, ring_matmul_rs
+    from ddlb_tpu.runtime import shard_map_compat
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
+    rng = np.random.default_rng(11)
+    m, n, k = 256, 256, 256
+    a = jnp.asarray(rng.uniform(-1, 1, (m, k)), jnp.float32)
+    b = jnp.asarray(rng.uniform(-1, 1, (k, n)), jnp.float32)
+    w = jnp.asarray(rng.uniform(-1, 1, (1, k, n)), jnp.float32)
+
+    def both(tag, fn, in_specs, out_specs, *operands):
+        outs = {}
+        for mode, interp in (
+            ("compiled", None),
+            ("interpret", pltpu.InterpretParams()),
+        ):
+            f = jax.jit(
+                shard_map_compat(
+                    lambda *xs: fn(*xs, interp),
+                    mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                    check_vma=False,
+                )
+            )
+            placed = [
+                jax.device_put(o, NamedSharding(mesh, s))
+                for o, s in zip(operands, in_specs)
+            ]
+            outs[mode] = np.asarray(jax.block_until_ready(f(*placed)))
+        err = float(np.max(np.abs(outs["compiled"] - outs["interpret"])))
+        ok = err <= 1e-5
+        print(f"{tag}: max|compiled - interpret| = {err:.2e}  "
+              f"{'OK' if ok else 'MISMATCH'}", flush=True)
+        return ok
+
+    oks = [
+        both(
+            "ring_ag_matmul",
+            lambda a_s, b_r, ip: ring_ag_matmul(
+                a_s, b_r, axis_size=1, block_n=128, block_k=128, interpret=ip
+            ),
+            (P("tp", None), P(None, None)), P(None, None), a, b,
+        ),
+        both(
+            "ring_matmul_rs",
+            lambda a_s, b_s, ip: ring_matmul_rs(
+                a_s, b_s, axis_size=1, block_n=128, block_k=128, interpret=ip
+            ),
+            (P(None, "tp"), P("tp", None)), P("tp", None), a, b,
+        ),
+        both(
+            "alltoall_expert_matmul",
+            lambda a_s, w_s, ip: alltoall_expert_matmul(
+                a_s, w_s[0], axis_size=1, block_n=128, block_k=128,
+                interpret=ip,
+            ),
+            (P("tp", None), P("tp", None, None)), P("tp", None), a, w,
+        ),
+    ]
+    if not all(oks):
+        print("KERNEL PARITY FAILURE — do not trust sim-only rows",
+              flush=True)
+        return False
+    return True
+
+
+def _run_action(entry) -> bool:
+    action = entry["action"]
+    if action == "noop":
+        print(entry["label"], flush=True)
+        return True
+    if action == "kernel_parity":
+        # subprocess like every row: the driver must stay JAX-free (the
+        # TPU backend locks the chip to the first process that opens it,
+        # which would starve every later per-row child — the queue's
+        # whole reason to exist is not burning capture windows)
+        import subprocess
+
+        print("== compiled vs interpreted kernel parity ==", flush=True)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--parity-child"],
+                timeout=1800, capture_output=True, text=True, cwd=REPO,
+            )
+        except subprocess.TimeoutExpired:
+            print("kernel parity child hung >1800s (killed)", flush=True)
+            return False
+        sys.stdout.write(out.stdout)
+        if out.returncode != 0 and out.stderr:
+            sys.stdout.write(out.stderr[-2000:])
+        sys.stdout.flush()
+        return out.returncode == 0
+    if action == "xprof_summary":
+        # soft-fail like the r3 batch: a digest timeout must not burn the
+        # remaining queue (the trace stays on disk for offline analysis)
+        import subprocess
+
+        try:
+            rc = subprocess.run(
+                [sys.executable, "scripts/xprof_summary.py",
+                 "profiles/mfu_breakdown", "15"],
+                timeout=600, check=False, cwd=REPO,
+            ).returncode
+            return rc == 0
+        except subprocess.TimeoutExpired:
+            print("xprof_summary timed out after 600s; trace left for "
+                  "offline analysis", flush=True)
+            return False
+    raise ValueError(f"unknown action {action!r}")
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint state
+# ---------------------------------------------------------------------------
+
+
+def _load_state(path):
+    try:
+        with open(path) as f:
+            state = json.load(f)
+        return state if isinstance(state, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_state(path, state) -> None:
+    """Atomic replace: a kill mid-write (relay flap under the watcher's
+    timeout) must not corrupt the resume record."""
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Drive
+# ---------------------------------------------------------------------------
+
+
+def _run_row(entry, base_proto, run_fn):
+    """One measured row + the shared summary line (hw_common style)."""
+    config = {
+        "primitive": entry["primitive"],
+        "impl_id": f"{entry['impl']}_hw",
+        "base_implementation": entry["impl"],
+        "options": dict(entry["options"]),
+        "m": entry["m"],
+        "n": entry["n"],
+        "k": entry["k"],
+        **base_proto,
+        **entry["proto_overrides"],
+    }
+    row = run_fn(config)
+    t = row.get("median time (ms)", float("nan"))
+    unit = "GB/s" if row.get("unit") == "GB/s" else "TF"
+    hbm = (
+        f"  hbm-peak {row['hbm_peak_gib']:.2f} GiB"
+        if "hbm_peak_gib" in row
+        else ""
+    )
+    compile_s = row.get("compile_time_s")
+    comp = (
+        f"  compile {compile_s:.1f}s"
+        f"{' (cache hit)' if row.get('compile_cache_hit') else ''}"
+        if _finite(compile_s)
+        else ""
+    )
+    print(
+        f"{entry['primitive']:18s} {entry['impl']:10s} "
+        f"m={entry['m']:<6d} {entry['label']} -> "
+        f"median {t if _finite(t) else float('nan'):.3f} ms  "
+        f"{row.get('Throughput (TFLOPS)', float('nan')):.1f} {unit}  "
+        f"valid={row.get('valid')} err={row.get('error') or '-'}"
+        f"{hbm}{comp}",
+        flush=True,
+    )
+    _derive_print(entry, row)
+    return row
+
+
+def main(argv=None, run_fn=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--parity-child" in argv:
+        # the chip-owning child for the kernel_parity action (see
+        # _run_action); everything else stays in the JAX-free driver
+        return 0 if _run_parity() else 1
+    quick = "--quick" in argv
+    smoke = "--smoke" in argv
+    list_only = "--list" in argv
+
+    def _opt(flag, default=None):
+        if flag in argv:
+            return argv[argv.index(flag) + 1]
+        return default
+
+    only = _opt("--only")
+    limit = _opt("--limit")
+    limit = int(limit) if limit else None
+    # one state file per measurement MODE: quick (4 windows) and smoke
+    # (tiny sim rows) measure different things than the full protocol,
+    # so a row banked under a weaker mode must never mark the full
+    # protocol's row done (an explicit --state overrides)
+    mode_suffix = "_smoke" if smoke else "_quick" if quick else ""
+    default_state = os.path.join(
+        REPO, "hwlogs", f"queue_state{mode_suffix}.json"
+    )
+    state_path = _opt("--state", default_state)
+
+    if smoke:
+        # force the sim BEFORE any jax-touching import: with a hung relay
+        # plugin installed, an unpinned backend blocks on the exact
+        # condition smoke mode exists to avoid (measure_r4_hw lesson)
+        os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "1")
+    # compile banking across rows, retries and relay windows
+    os.environ.setdefault("DDLB_TPU_COMPILE_CACHE", COMPILE_CACHE_DEFAULT)
+
+    queue = build_queue(quick=quick, smoke=smoke)
+    if only and not smoke:
+        # smoke mode's tiny plumbing queue is its own section; a section
+        # filter forwarded by a deprecated shim must not empty it
+        queue = [e for e in queue if e["section"].startswith(only)]
+    state = _load_state(state_path)
+
+    if list_only:
+        for i, entry in enumerate(queue):
+            rec = state.get(entry_key(entry), {})
+            status = (
+                "done" if rec.get("done")
+                else f"failed x{rec['attempts']}" if rec.get("attempts")
+                else "pending"
+            )
+            print(f"{i:3d} [{entry['section']:10s}] {status:9s} "
+                  f"{entry['label']}")
+        return 0
+
+    from hw_common import proto
+
+    base_proto = proto(quick)
+    if run_fn is None:
+        from hw_common import run_isolated
+
+        run_fn = run_isolated
+
+    ran = failed = skipped = 0
+    parity_ok = True
+    for entry in queue:
+        key = entry_key(entry)
+        rec = state.get(key, {"attempts": 0, "done": False})
+        if rec.get("done"):
+            skipped += 1
+            continue
+        if rec.get("attempts", 0) >= MAX_ATTEMPTS:
+            print(f"[queue] parked after {rec['attempts']} failed attempts: "
+                  f"{entry['label']}", flush=True)
+            skipped += 1
+            continue
+        if limit is not None and ran >= limit:
+            break
+        if entry.get("note"):
+            print(entry["note"], flush=True)
+        ran += 1
+        if entry["kind"] == "action":
+            try:
+                ok = _run_action(entry)
+            except Exception as exc:
+                print(f"[queue] action {entry['action']} crashed: "
+                      f"{type(exc).__name__}: {exc}", flush=True)
+                ok = False
+            if entry["action"] == "kernel_parity" and not ok:
+                parity_ok = False
+            rec = {
+                "attempts": rec.get("attempts", 0) + 1,
+                "done": ok,
+                "label": entry["label"],
+            }
+        else:
+            row = _run_row(entry, base_proto, run_fn)
+            ok = not row.get("error")
+            rec = {
+                "attempts": rec.get("attempts", 0) + 1,
+                "done": ok,
+                "label": entry["label"],
+                "error": str(row.get("error") or ""),
+            }
+            if not ok:
+                failed += 1
+        state[key] = rec
+        # checkpoint after EVERY entry: a flap mid-queue loses nothing
+        _save_state(state_path, state)
+
+    print(
+        f"measure_queue: {ran} run, {failed} failed, {skipped} skipped "
+        f"(state: {state_path})",
+        flush=True,
+    )
+    # nonzero on ANY failed row this pass, not just parity: the watcher
+    # gates its CAPTURED sentinel on rc==0, so a clean-exit-with-errors
+    # would end the capture before the retry-then-park policy ever ran.
+    # Parked rows are skipped (not failed) on later passes, so a queue
+    # whose only failures are exhausted converges back to rc 0.
+    if not parity_ok or failed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

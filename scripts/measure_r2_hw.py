@@ -1,12 +1,7 @@
 #!/usr/bin/env python
-"""Round-2 hardware measurement batch (run when the TPU relay is up).
-
-Covers the rows BASELINE.md still owes from this round's features, in
-one session so medians are comparable: the transformer forward-mode MLP
-A/B (bf16 / int8 STE / int8_weights), the serving family's decode
-ms/token vs context length (bf16 vs int8_weights) and prefill, and the
-ep_alltoall quantized member. Prints one summary line per config;
-append results to BASELINE.md by hand (pinned-protocol medians).
+"""DEPRECATED shim: the round-2 batch now lives in the resumable row
+queue (scripts/measure_queue.py, sections ``r2-*``). This forwards so
+old watcher configs and runbooks keep working; flags pass through.
 
 Usage:  python scripts/measure_r2_hw.py [--quick]
 """
@@ -16,40 +11,14 @@ from __future__ import annotations
 import os
 import sys
 
-# runnable as `python scripts/<name>.py` from the repo root: the
-# script dir is sys.path[0], so add the repo root for ddlb_tpu
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import functools
+from measure_queue import main  # noqa: E402
 
-from hw_common import proto, run_and_print
-
-QUICK = "--quick" in sys.argv[1:]
-
-# one fresh process per config: a dozen in-process configs OOM the
-# chip (see hw_common.py) and a wedged backend poisons the session
-run = functools.partial(run_and_print, proto(QUICK))
-
-
-MODEL = dict(batch=1, vocab=16384, n_heads=16, microbatches=1)
-
-# 1) forward-mode MLP kernel A/B at the 0.80-MFU shape
-for mlp in ("bf16", "int8", "int8_weights"):
-    run(
-        "transformer_step", "spmd", 4096, 2048, 8192,
-        mode="forward", mlp_kernel=mlp, attn_kernel="flash", **MODEL,
+if __name__ == "__main__":
+    print(
+        "[deprecated] measure_r2_hw.py forwards to "
+        "measure_queue.py --only r2",
+        flush=True,
     )
-
-# 2) serving: decode ms/token vs context length, bf16 vs int8_weights
-SERVE = dict(batch=8, vocab=16384, n_heads=16)
-for ctx in (1024, 4096) if QUICK else (1024, 4096, 8192):
-    for mlp in ("bf16", "int8_weights"):
-        run(
-            "transformer_decode", "spmd", ctx, 2048, 8192,
-            phase="decode", mlp_kernel=mlp, **SERVE,
-        )
-run("transformer_decode", "spmd", 1024, 2048, 8192, phase="prefill", **SERVE)
-
-# 3) ep_alltoall quantized vs jax_spmd at the canonical shape
-run("ep_alltoall", "jax_spmd", 8192, 8192, 8192)
-run("ep_alltoall", "quantized", 8192, 8192, 8192, quantize="static")
+    sys.exit(main(["--only", "r2", *sys.argv[1:]]))

@@ -1,13 +1,8 @@
 #!/usr/bin/env python
-"""The round-2 rows the first live session could not land.
-
-The 2026-07-31 relay session measured the forward-mode MLP A/B trio and
-the ctx=1024 decode rows (BASELINE.md round-4 section), then lost the
-long-context decode rows to the full-score-matrix oracle OOM (fixed:
-``_oracle_attention`` q-chunking, models/decode.py) and the tail of the
-batch to a relay flap. This script reruns exactly the missing rows so
-the next session doesn't repeat the ~15 minutes of already-banked
-measurements.
+"""DEPRECATED shim: the "r2 remaining" rows are a subset of the resumable
+row queue's ``r2-*`` sections (scripts/measure_queue.py), whose
+checkpoint state makes per-round remainder scripts unnecessary — the
+queue itself skips rows already banked. Flags pass through.
 
 Usage:  python scripts/measure_r2_remaining.py [--quick]
 """
@@ -17,36 +12,14 @@ from __future__ import annotations
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import functools
+from measure_queue import main  # noqa: E402
 
-from hw_common import proto, run_and_print
-
-QUICK = "--quick" in sys.argv[1:]
-
-run = functools.partial(run_and_print, proto(QUICK))
-
-
-SERVE = dict(batch=8, vocab=16384, n_heads=16)
-for ctx in (4096,) if QUICK else (4096, 8192):
-    # pre-flight the arithmetic that ate these rows last session: with
-    # the q-chunked oracle both contexts fit at B=8 (~4-5 GiB peak,
-    # tests/test_hbm_budget.py); the printed line puts the budget next
-    # to the row so an OOM here falsifies the MODEL, not just the row
-    from ddlb_tpu.utils.hbm_budget import decode_budget
-
-    rep = decode_budget(
-        ctx=ctx, batch=8, d_model=2048, d_ff=8192, vocab=16384,
-        n_heads=16, layers=1, phase="decode", validate=True,
+if __name__ == "__main__":
+    print(
+        "[deprecated] measure_r2_remaining.py forwards to "
+        "measure_queue.py --only r2",
+        flush=True,
     )
-    print(f"[budget] ctx={ctx}: {rep.line()}", flush=True)
-    for mlp in ("bf16", "int8_weights"):
-        run(
-            "transformer_decode", "spmd", ctx, 2048, 8192,
-            phase="decode", mlp_kernel=mlp, **SERVE,
-        )
-run("transformer_decode", "spmd", 1024, 2048, 8192, phase="prefill", **SERVE)
-
-run("ep_alltoall", "jax_spmd", 8192, 8192, 8192)
-run("ep_alltoall", "quantized", 8192, 8192, 8192, quantize="static")
+    sys.exit(main(["--only", "r2", *sys.argv[1:]]))

@@ -1,25 +1,9 @@
 #!/usr/bin/env python
-"""Round-3 hardware measurement batch (run when the TPU relay is up).
+"""DEPRECATED shim: the round-3 batch (serving table, int8 tile sweep,
+xprof trace, schedules) now lives in the resumable row queue
+(scripts/measure_queue.py, sections ``r3-*``). Flags pass through.
 
-Three sections, one session so medians are comparable:
-
-1. **Serving table** (VERDICT r2 next-round #2/#3): decode ms/token and
-   tokens/s vs context {2k, 8k, 32k, 64k} across the fast-decode axes —
-   kv_cache bf16 vs int8, MHA vs GQA (n_kv_heads=4), int8_weights MLP —
-   plus one prefill row. Each row also prints the HBM bytes-read model
-   (cache + per-chip weights per step) and the implied bandwidth
-   fraction at the v5e's ~819 GB/s, the number the family exists to
-   measure.
-2. **int8 Pallas tile sweep** (VERDICT r2 next-round #7): the paired
-   same-session race — XLA int8 GEMM vs the Pallas kernel over tile
-   configs and quantize=static — to close or pin the 350.8-vs-381.9 TOPS
-   gap at the canonical 8192^3.
-3. **Pipeline schedules on the model** (VERDICT #4 rider): train-step
-   ms under schedule=gpipe vs 1f1b at equal microbatches (the schedule
-   tables predict equal ticks; this pins the wall-clock claim), plus
-   the flash GQA train row.
-
-Usage: python scripts/measure_r3_hw.py [--quick]
+Usage:  python scripts/measure_r3_hw.py [--quick]
 """
 
 from __future__ import annotations
@@ -27,209 +11,14 @@ from __future__ import annotations
 import os
 import sys
 
-# runnable as `python scripts/<name>.py` from the repo root: the
-# script dir is sys.path[0], so add the repo root for ddlb_tpu
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import functools
+from measure_queue import main  # noqa: E402
 
-from hw_common import proto, run_and_print
-
-QUICK = "--quick" in sys.argv[1:]
-
-V5E_HBM_GBPS = 819.0
-
-# one fresh process per config: a dozen in-process configs OOM the
-# chip (see hw_common.py) and a wedged backend poisons the session
-run = functools.partial(run_and_print, proto(QUICK))
-
-
-# -- 1) serving table ---------------------------------------------------------
-
-D, F, V, HEADS, B, LAYERS = 2048, 8192, 16384, 16, 8, 1
-DH = D // HEADS
-
-
-def decode_bytes(ctx, b, n_kv, kv_cache, mlp_kernel, tp=1):
-    """HBM bytes read per decode step (the bandwidth model): K+V cache at
-    the context length + this chip's weights once."""
-    h_kv = n_kv or HEADS
-    kv_bytes = 1 if kv_cache == "int8" else 2
-    cache = 2 * LAYERS * b * ctx * h_kv * DH * kv_bytes
-    if kv_cache == "int8":
-        cache += 2 * LAYERS * b * ctx * h_kv * 4  # f32 scales
-    w_bytes = 1 if mlp_kernel == "int8_weights" else 2
-    kv_frac = h_kv / HEADS
-    # param counts x bytes: q+out proj 2 D^2, k/v 2 D^2 * kv_frac,
-    # expert MLP 2 D F per chip, LM head D V (all bf16 except the MLP
-    # under int8_weights)
-    weights = (
-        LAYERS * ((2 + 2 * kv_frac) * D * D * 2 + 2 * D * F * w_bytes / tp)
-        + D * V * 2
-    )
-    return cache + weights
-
-
-def serving_row(ctx, b, label, **opts):
-    # attn_kernel governs the SETUP prefill (flash: no [B,H,S,S] scores —
-    # einsum prefill OOMs past ctx~4k); the measured decode step's
-    # einsum-vs-fused lever is decode_kernel (r4 batch section 1c)
-    row = run(
-        "transformer_decode", "spmd", ctx, D, F,
-        label=label, batch=b, vocab=V, n_heads=HEADS, phase="decode",
-        attn_kernel="flash", **opts,
-    )
-    t_ms = row["median time (ms)"]
-    toks = b / t_ms * 1e3
-    gb = decode_bytes(
-        ctx, b, opts.get("n_kv_heads", 0), opts.get("kv_cache", "bf16"),
-        opts.get("mlp_kernel", "bf16"),
-    ) / 1e9
-    frac = gb / (t_ms / 1e3) / V5E_HBM_GBPS
+if __name__ == "__main__":
     print(
-        f"    -> {t_ms / b:.3f} ms/token  {toks:,.0f} tok/s   "
-        f"bytes-read model {gb:.2f} GB/step  HBM fraction {frac:.2f}",
+        "[deprecated] measure_r3_hw.py forwards to "
+        "measure_queue.py --only r3",
         flush=True,
     )
-    return row
-
-
-CONTEXTS = (2048, 8192) if QUICK else (2048, 8192, 32768, 65536)
-for ctx in CONTEXTS:
-    # One batch per context, sized so the LEAST-capable lever row (bf16
-    # MHA, validated) fits the chip — the r2 live session lost every
-    # ctx>=4096 row to OOM/timeouts this gate now prevents, and one B
-    # per context keeps the lever A/B rows comparable. At 64k the model
-    # says B=8 cannot fit (prefill [B,S,F] live set + 4.3-GiB cache);
-    # B=4 fits WITH validation (tests/test_hbm_budget.py).
-    from ddlb_tpu.utils.hbm_budget import fit_batch
-
-    b_ctx, rep = fit_batch(
-        preferred_batch=B, ctx=ctx, d_model=D, d_ff=F, vocab=V,
-        n_heads=HEADS, layers=LAYERS, phase="decode", validate=True,
-    )
-    print(f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}", flush=True)
-    if not rep.fits:
-        print(f"[budget] ctx={ctx}: SKIPPED — no batch fits", flush=True)
-        continue
-    serving_row(ctx, b_ctx, f"bf16 cache, MHA @ {ctx} B={b_ctx}")
-    serving_row(
-        ctx, b_ctx, f"int8 cache, MHA @ {ctx} B={b_ctx}", kv_cache="int8"
-    )
-    serving_row(
-        ctx, b_ctx, f"bf16 cache, GQA4 @ {ctx} B={b_ctx}", n_kv_heads=4
-    )
-    serving_row(
-        ctx, b_ctx, f"int8 cache, GQA4 @ {ctx} B={b_ctx}",
-        n_kv_heads=4, kv_cache="int8",
-    )
-    serving_row(
-        ctx, b_ctx, f"int8 cache + int8 weights @ {ctx} B={b_ctx}",
-        kv_cache="int8", mlp_kernel="int8_weights",
-    )
-
-run(
-    "transformer_decode", "spmd", 2048, D, F,
-    label="prefill 2k (flash)", batch=B, vocab=V, n_heads=HEADS,
-    phase="prefill", attn_kernel="flash",
-)
-# end-to-end serving loop: prefill + N_NEW greedy tokens, one compiled call
-N_NEW = 32
-for opts, lbl in (
-    ({}, f"generate 2k+{N_NEW} bf16 MHA"),
-    ({"kv_cache": "int8", "n_kv_heads": 4}, f"generate 2k+{N_NEW} int8+GQA4"),
-):
-    r = run(
-        "transformer_decode", "spmd", 2048, D, F,
-        label=lbl, batch=B, vocab=V, n_heads=HEADS,
-        phase="generate", n_new=N_NEW, attn_kernel="einsum", **opts,
-    )
-    t_ms = r["median time (ms)"]
-    print(
-        f"    -> {B * N_NEW / t_ms * 1e3:,.0f} generated tok/s end to end",
-        flush=True,
-    )
-
-# -- 2) int8 Pallas tile sweep (paired, same session) -------------------------
-
-M = N = K = 8192
-run("tp_columnwise", "quantized", M, N, K, label="XLA int8 (reference)",
-    kernel="xla", quantize="static")
-# the autotuner's own answer, measured through the same impl path and
-# persisted to autotune_cache.json — the framework-property form of this
-# sweep (construction tunes; the measured row then uses the winner)
-run("tp_columnwise", "quantized", M, N, K, label="pallas int8 AUTOTUNED",
-    kernel="pallas", quantize="static", tune=True)
-run("tp_columnwise", "pallas", M, N, K, label="pallas bf16 AUTOTUNED",
-    tune=True)
-TILES = (
-    [(1024, 1024, 1024), (512, 1024, 1024)]
-    if QUICK
-    else [
-        (1024, 1024, 1024),
-        (512, 1024, 1024),
-        (1024, 512, 1024),
-        (1024, 1024, 512),
-        (512, 512, 2048),
-        (2048, 1024, 512),
-        (512, 2048, 1024),
-    ]
-)
-for bm, bn, bk in TILES:
-    run(
-        "tp_columnwise", "quantized", M, N, K,
-        label=f"pallas int8 tiles ({bm},{bn},{bk})",
-        kernel="pallas", quantize="static",
-        block_m=bm, block_n=bn, block_k=bk,
-    )
-
-# -- 2b) xprof trace of the MFU-headline train step (VERDICT r2 weak #8:
-# account where the 0.20 non-MFU fraction goes). NOTE the worker's
-# profiler traces 5 DEDICATED runs before the timed loop
-# (ddlb_tpu/benchmark.py:94-112) — the trace shows the same compiled
-# step the median measures, but the measured iterations themselves run
-# untraced, so per-op fractions from xprof apply to the median, not
-# trace-window wall time. Trace lands under profiles/mfu_breakdown. ------
-
-run(
-    "transformer_step", "spmd", 4096, D, F,
-    label="MFU-headline train step (xprof trace)",
-    proto_overrides={
-        "validate": False, "profile_dir": "profiles/mfu_breakdown"
-    },
-    mode="train", attn_kernel="flash", batch=1, vocab=V, n_heads=HEADS,
-    microbatches=1, pp=1, tp=1, dp=1,
-)
-# turn the trace into the attributed top-op table RIGHT HERE, so the
-# "where does the missing 20% MFU go" answer lands in this committed
-# log the same session the trace is taken (scripts/xprof_summary.py).
-# Soft-fail like every other call in this batch: check=False does not
-# cover timeouts, and an uncaught TimeoutExpired here would abort the
-# remaining sections and burn a capture attempt.
-import subprocess
-
-try:
-    subprocess.run(
-        [sys.executable, "scripts/xprof_summary.py",
-         "profiles/mfu_breakdown", "15"],
-        timeout=600, check=False,
-    )
-except subprocess.TimeoutExpired:
-    print("xprof_summary timed out after 600s; trace left for offline "
-          "analysis", flush=True)
-
-# -- 3) model schedules + GQA train row ---------------------------------------
-
-MODEL = dict(batch=4, vocab=V, n_heads=HEADS, microbatches=4, pp=1, tp=1, dp=1)
-for sched in ("gpipe", "1f1b"):
-    run(
-        "transformer_step", "spmd", 2048, D, F,
-        label=f"train schedule={sched} (single chip: pp=1 degenerate)",
-        mode="train", schedule=sched, attn_kernel="flash", **MODEL,
-    )
-run(
-    "transformer_step", "spmd", 4096, D, F,
-    label="train GQA4 flash", mode="train", attn_kernel="flash",
-    n_kv_heads=4, batch=4, vocab=V, n_heads=HEADS, microbatches=1,
-    pp=1, tp=1, dp=1,
-)
+    sys.exit(main(["--only", "r3", *sys.argv[1:]]))

@@ -1,22 +1,10 @@
 #!/usr/bin/env python
-"""Round-4 hardware measurement batch (run when the TPU relay is up).
+"""DEPRECATED shim: the round-4 batch (MFU curve, speculate/serve rows,
+decode-kernel A/B, windowed flash, HBM roofline, kernel parity) now
+lives in the resumable row queue (scripts/measure_queue.py, sections
+``r4-*``). Flags — including ``--smoke`` — pass through.
 
-Two sections, one session:
-
-1. **MFU-vs-shape curve** (VERDICT r3 next #6): the flagship train step
-   at growing (seq, d_model, heads) — does the 0.80 MFU point at
-   seq=4096/d2048 hold or improve at scale? The FLOP census is the
-   family's own ``flops()`` (transformer_step/base.py:216-228: fwd +
-   2x-bwd model matmuls, remat recompute NOT counted), so MFU here =
-   median TFLOPS / 197 peak on the same census BASELINE.md uses.
-2. **Compiled-vs-interpreted kernel parity** (VERDICT r3 weak #7): the
-   RDMA ring/a2a kernels take different code paths under
-   ``interpret=True`` (direct jnp vs emit_pipeline codegen); with one
-   real chip the compiled path runs at world=1 (self-DMA) — each kernel
-   is executed BOTH ways on identical operands and compared bitwise-ish
-   (f32 atol 1e-5), pinning the codegen the sim cannot see.
-
-Usage: python scripts/measure_r4_hw.py [--quick]
+Usage: python scripts/measure_r4_hw.py [--quick] [--smoke]
 """
 
 from __future__ import annotations
@@ -24,313 +12,14 @@ from __future__ import annotations
 import os
 import sys
 
-# runnable as `python scripts/measure_r4_hw.py` from the repo root: the
-# script dir is sys.path[0], so add the repo root for ddlb_tpu
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-QUICK = "--quick" in sys.argv[1:]
-# --smoke: tiny shapes on the CPU sim so the harness plumbing is testable
-# without the relay; the compiled kernel-parity section needs a real TPU
-# and is skipped. Forcing the sim BEFORE any jax-touching import matters:
-# with a hung relay plugin installed, an unpinned backend blocks on the
-# exact condition smoke mode exists to avoid.
-SMOKE = "--smoke" in sys.argv[1:]
-if SMOKE:
-    os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "1")
+from measure_queue import main  # noqa: E402
 
-import numpy as np
-
-from hw_common import proto, run_isolated
-
-V5E_PEAK_BF16_TFLOPS = 197.0
-
-# validate=False: the device-side f32 oracle is separately pinned; the
-# large shapes here would grind a host oracle for hours
-PROTO = proto(QUICK, validate=False)
-
-
-def run(primitive, impl, m, n, k, label="", proto_overrides=None, **options):
-    # one fresh process per config: a dozen in-process configs OOM the
-    # chip (see hw_common.py) and a wedged backend poisons the session
-    row = run_isolated(
-        {
-            "primitive": primitive,
-            "impl_id": f"{impl}_hw",
-            "base_implementation": impl,
-            "options": options,
-            "m": m,
-            "n": n,
-            "k": k,
-            **PROTO,
-            **(proto_overrides or {}),
-        }
-    )
-    t = row["median time (ms)"]
-    tf = row["Throughput (TFLOPS)"]
+if __name__ == "__main__":
     print(
-        f"{label or options}: median {t:.3f} ms  {tf:.1f} TF  "
-        f"MFU {tf / V5E_PEAK_BF16_TFLOPS:.3f}  "
-        f"std {row['std time (ms)']:.3f}  err={row['error'] or '-'}",
+        "[deprecated] measure_r4_hw.py forwards to "
+        "measure_queue.py --only r4",
         flush=True,
     )
-    return row
-
-
-# -- 1) MFU-vs-shape curve ----------------------------------------------------
-
-V = 64 if SMOKE else 16384
-# (seq, d_model, d_ff, heads) — first rows are the round-2 reference
-# points; the rest scale seq and width
-CURVE = [
-    (2048, 2048, 8192, 16),
-    (4096, 2048, 8192, 16),   # the 0.80-MFU BASELINE.md point
-    (8192, 2048, 8192, 16),
-    (4096, 4096, 16384, 32),
-]
-if not QUICK:
-    CURVE.append((8192, 4096, 16384, 32))
-if SMOKE:
-    CURVE = [(64, 32, 64, 4)]
-
-print("== MFU curve (train, flash attention, per-stage remat) ==", flush=True)
-for seq, d, f, heads in CURVE:
-    run(
-        "transformer_step", "spmd", seq, d, f,
-        label=f"train seq={seq} d={d} ff={f} h={heads}",
-        mode="train", attn_kernel="flash", batch=1, vocab=V,
-        n_heads=heads, microbatches=1, pp=1, tp=1, dp=1,
-    )
-
-# -- 1b) speculative decoding: generate vs speculate tokens/s ----------------
-# Same produced tokens (greedy spec-decode is lossless), so tokens/s is
-# directly comparable; the draft (1 of 2 layers) should lift the
-# bandwidth-bound loop whenever its acceptance rate beats the draft+
-# verify overhead.
-
-if not SMOKE:
-    D_S, F_S, V_S, B_S, N_NEW = 2048, 8192, 16384, 8, 64
-    for phase, extra in (
-        ("generate", {}),
-        ("speculate", {"spec_k": 4, "draft_layers": 1}),
-        ("speculate", {"spec_k": 8, "draft_layers": 1}),
-    ):
-        row = run(
-            "transformer_decode", "spmd", 2048, D_S, F_S,
-            label=f"{phase} 2k+{N_NEW} {extra or ''}",
-            phase=phase, n_new=N_NEW, batch=B_S, vocab=V_S,
-            n_heads=16, layers=2, attn_kernel="einsum", **extra,
-        )
-        t_ms = row["median time (ms)"]
-        if np.isfinite(t_ms):
-            print(f"    -> {B_S * N_NEW / t_ms * 1e3:,.0f} tok/s end to end",
-                  flush=True)
-        if "spec_accept_rate" in row:
-            # the measured a_r the ~1.3x model (BASELINE.md) predicts from
-            print(
-                f"    -> measured acceptance rate "
-                f"{row['spec_accept_rate']:.3f} over {row['spec_rounds']} "
-                f"verify rounds",
-                flush=True,
-            )
-    # continuous batching: sustained tokens/s under slot turnover (the
-    # host_clock drain of a 2x-oversubscribed workload; dp=1, tp=1 on
-    # the single chip), contiguous vs the paged pool at parity and at
-    # half capacity — the serve-side cost of pages (the per-step gather)
-    # and the memory lever, measured
-    N_REQ = 16
-    for lbl, extra in (
-        ("contiguous", {}),
-        ("paged 1.0", {"cache_layout": "paged", "page_pool_frac": 1.0}),
-        ("paged 0.5", {"cache_layout": "paged", "page_pool_frac": 0.5}),
-        ("paged 0.5 + fused kernel", {
-            "cache_layout": "paged", "page_pool_frac": 0.5,
-            "decode_kernel": "pallas",
-        }),
-    ):
-        row = run(
-            "transformer_decode", "spmd", 2048, D_S, F_S,
-            label=f"serve {N_REQ} reqs @2k, n_new<={N_NEW} [{lbl}]",
-            phase="serve", n_new=N_NEW, n_requests=N_REQ, batch=8,
-            vocab=V_S, n_heads=16, layers=2, attn_kernel="einsum",
-            dp=1, tp=1, **extra,
-            proto_overrides={"time_measurement_backend": "host_clock"},
-        )
-        t_ms = row["median time (ms)"]
-        if np.isfinite(t_ms):
-            # same workload definition as _serve_workload: stride-1 cycle
-            total_new = sum(1 + ((i + 3) % N_NEW) for i in range(N_REQ))
-            print(
-                f"    -> {total_new / t_ms * 1e3:,.0f} sustained tok/s "
-                f"({total_new} tokens drained)",
-                flush=True,
-            )
-        if "serve_occupancy" in row:
-            pages = (
-                f"  peak pages {row['serve_peak_pages']}"
-                f"/{row['serve_pages_capacity']}"
-                if "serve_peak_pages" in row
-                else ""
-            )
-            print(
-                f"    -> occupancy {row['serve_occupancy']:.3f}  deferrals "
-                f"{row['serve_admissions_deferred']}{pages}",
-                flush=True,
-            )
-
-# -- 1c) fused decode-attention kernel A/B -----------------------------------
-# The einsum decode path round-trips the [b, h_kv, G, 1, S] scores
-# through HBM; the fused kernel streams the cache once with online
-# softmax and in-kernel int8 dequant. The win should grow as the
-# fast-decode levers shrink the cache (scores become a larger fraction).
-
-if not SMOKE:
-    from ddlb_tpu.utils.hbm_budget import fit_batch
-
-    for ctx in (8192, 32768, 65536):
-        # one batch per context, sized so the worst lever (bf16 MHA)
-        # fits — at 64k the budget model says B=8 cannot (prefill
-        # [B,S,F] live set + 4.3-GiB cache; tests/test_hbm_budget.py),
-        # which is the OOM class that ate the r2 live session
-        b_ctx, rep = fit_batch(
-            preferred_batch=8, ctx=ctx, d_model=2048, d_ff=8192,
-            vocab=16384, n_heads=16, layers=1, phase="decode",
-            validate=False,
-        )
-        print(f"[budget] ctx={ctx}: batch={b_ctx}  {rep.line()}", flush=True)
-        if not rep.fits:
-            print(f"[budget] ctx={ctx}: SKIPPED — no batch fits", flush=True)
-            continue
-        for lbl, extra in (
-            ("bf16 MHA", {}),
-            ("int8+GQA4", {"kv_cache": "int8", "n_kv_heads": 4}),
-        ):
-            for dk in ("einsum", "pallas"):
-                # attn_kernel=flash is the SETUP prefill (einsum prefill
-                # OOMs past ctx~4k); decode_kernel is the measured lever
-                run(
-                    "transformer_decode", "spmd", ctx, 2048, 8192,
-                    label=f"decode @{ctx} {lbl} kernel={dk} B={b_ctx}",
-                    phase="decode", batch=b_ctx, vocab=16384, n_heads=16,
-                    attn_kernel="flash", decode_kernel=dk, **extra,
-                )
-
-# -- 1d) windowed flash attention: the band FLOP saving on the MXU -----------
-# At seq=32k a 4k window keeps ~1/8 of the causal tiles live; the flash
-# grid drops dead tiles on both edges, so throughput-at-census (the
-# windowed FLOP count) should hold while wall-clock falls ~8x.
-
-if not SMOKE:
-    for w in (0, 4096):
-        run(
-            "cp_ring_attention", "flash", 32768, 2048, 128,
-            label=f"flash seq=32k window={w or 'full'}",
-            window=w, block_q=1024, block_kv=1024,
-        )
-
-# -- 1e) measured HBM-copy bandwidth (collectives compute_only) --------------
-# One chip cannot exercise the wire, but it CAN measure the HBM copy
-# roofline the collectives family reads its GB/s against — and this row
-# calibrates the ~819 GB/s spec number the serving bytes-model divides
-# by. Throughput column = payload GB/s (collectives/base.py convention);
-# the copy engine reads+writes, so raw HBM traffic is 2x the number.
-
-if not SMOKE:
-    for m_pay in (8192, 32768):
-        row = run(
-            "collectives", "compute_only", m_pay, 8, 8192,
-            label=f"hbm copy roofline {m_pay}x8192 bf16",
-            size="unsharded",
-            proto_overrides={"validate": True},
-        )
-        t_ms = row["median time (ms)"]
-        if np.isfinite(t_ms):
-            gb = m_pay * 8192 * 2 / 1e9
-            print(
-                f"    -> payload {gb:.2f} GB  copy GB/s "
-                f"{gb / (t_ms / 1e3):,.0f}  (raw HBM r+w ~2x)",
-                flush=True,
-            )
-
-# -- 2) compiled-vs-interpreted kernel parity (world=1 self-DMA) --------------
-
-print("== compiled vs interpreted kernel parity ==", flush=True)
-
-
-def _parity():
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental.pallas import tpu as pltpu
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    from ddlb_tpu.ops.alltoall_matmul import alltoall_expert_matmul
-    from ddlb_tpu.ops.collective_matmul import ring_ag_matmul, ring_matmul_rs
-
-    mesh = Mesh(np.array(jax.devices()[:1]), ("tp",))
-    rng = np.random.default_rng(11)
-    m, n, k = 256, 256, 256
-    a = jnp.asarray(rng.uniform(-1, 1, (m, k)), jnp.float32)
-    b = jnp.asarray(rng.uniform(-1, 1, (k, n)), jnp.float32)
-    w = jnp.asarray(rng.uniform(-1, 1, (1, k, n)), jnp.float32)
-
-    def both(tag, fn, in_specs, out_specs, *operands):
-        outs = {}
-        for mode, interp in (
-            ("compiled", None),
-            ("interpret", pltpu.InterpretParams()),
-        ):
-            f = jax.jit(
-                jax.shard_map(
-                    lambda *xs: fn(*xs, interp),
-                    mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                    check_vma=False,
-                )
-            )
-            placed = [
-                jax.device_put(o, NamedSharding(mesh, s))
-                for o, s in zip(operands, in_specs)
-            ]
-            outs[mode] = np.asarray(jax.block_until_ready(f(*placed)))
-        err = float(np.max(np.abs(outs["compiled"] - outs["interpret"])))
-        ok = err <= 1e-5
-        print(f"{tag}: max|compiled - interpret| = {err:.2e}  "
-              f"{'OK' if ok else 'MISMATCH'}", flush=True)
-        return ok
-
-    oks = [
-        both(
-            "ring_ag_matmul",
-            lambda a_s, b_r, ip: ring_ag_matmul(
-                a_s, b_r, axis_size=1, block_n=128, block_k=128, interpret=ip
-            ),
-            (P("tp", None), P(None, None)), P(None, None), a, b,
-        ),
-        both(
-            "ring_matmul_rs",
-            lambda a_s, b_s, ip: ring_matmul_rs(
-                a_s, b_s, axis_size=1, block_n=128, block_k=128, interpret=ip
-            ),
-            (P(None, "tp"), P("tp", None)), P("tp", None), a, b,
-        ),
-        both(
-            "alltoall_expert_matmul",
-            lambda a_s, w_s, ip: alltoall_expert_matmul(
-                a_s, w_s[0], axis_size=1, block_n=128, block_k=128,
-                interpret=ip,
-            ),
-            (P("tp", None), P("tp", None, None)), P("tp", None), a, w,
-        ),
-    ]
-    if not all(oks):
-        print("KERNEL PARITY FAILURE — do not trust sim-only rows",
-              flush=True)
-        sys.exit(1)
-
-
-if SMOKE:
-    print("smoke mode: compiled kernel parity needs a real TPU — skipped",
-          flush=True)
-else:
-    _parity()
-print("measure_r4_hw: done", flush=True)
+    sys.exit(main(["--only", "r4", *sys.argv[1:]]))

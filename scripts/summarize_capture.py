@@ -81,6 +81,14 @@ def _table(out, header, lines):
     out.append("")
 
 
+def _opt_dict(row):
+    """The row's option string as a dict (``"-"`` and garbage -> {})."""
+    return dict(
+        p.split("=", 1) for p in str(row.get("option", "")).split(";")
+        if "=" in p
+    )
+
+
 def _dedup(rows):
     """Last row per config wins: rows.jsonl is append-only across the
     watcher's retry attempts (and survives machine resets via the
@@ -89,16 +97,77 @@ def _dedup(rows):
     ``bank_key`` — the caller's config as banked by hw_common, which is
     identical for error and measured rows of the same config (the row's
     own 'option' string is NOT: error rows format the override-only
-    options, measured rows the DEFAULT-merged set)."""
-    by_key = {}
+    options, measured rows the DEFAULT-merged set).
+
+    Rows banked before bank_key existed have only their option strings,
+    so the fallback normalizes: within one (primitive, impl, shape,
+    dtype) group, an EARLIER error row whose override-only option dict
+    is a subset of a LATER measured row's DEFAULT-merged dict collapses
+    onto that retry (the retry supersedes it) — and only then: the
+    error row's ABSENT keys mean "defaults", so a subset match against
+    a row carrying non-default extras could be a different config; the
+    retry direction (non-empty overrides, error first, success later,
+    exactly one candidate) is the pairing the append-only log actually
+    produces. It remains a heuristic — without the option schema the
+    script cannot tell merged defaults from overrides in the superset
+    row, so a lever config CAN absorb a sibling's error when it is the
+    group's only subset match; rows banked since bank_key exist pair
+    exactly and never take this path. Equal option strings always pair,
+    as before."""
+    keyed = {}       # bank_key -> row (exact pairing, the normal path)
+    fallback = {}    # group -> [(opt_dict, row), ...] in file order
+    order = []       # (kind, key) so output order stays stable
     for r in rows:
-        key = r.get("bank_key") or (
+        key = r.get("bank_key")
+        if key:
+            if key not in keyed:
+                order.append(("bank", key))
+            keyed[key] = r
+            continue
+        group = (
             r.get("primitive"), r.get("base_implementation"),
             r.get("m"), r.get("n"), r.get("k"), r.get("dtype"),
-            r.get("option"),
         )
-        by_key[key] = r
-    return list(by_key.values())
+        entries = fallback.setdefault(group, [])
+        if not entries:
+            order.append(("group", group))
+        opts = _opt_dict(r)
+        # equal option strings always pair (the pre-bank_key exact dedup,
+        # last wins) and take precedence over the subset heuristic — a
+        # retry of a measured row must still collapse even when an
+        # unrelated error row happens to subset-match it too
+        equal = [
+            i for i, (prev_opts, _) in enumerate(entries)
+            if prev_opts == opts
+        ]
+        if equal:
+            entries[equal[-1]] = (opts, r)
+            continue
+        # a strict subset pairs only as error -> its retry
+        candidates = [
+            i for i, (prev_opts, prev_row) in enumerate(entries)
+            if prev_row.get("error")
+            and not r.get("error")
+            # an EMPTY override dict would subset-match every config
+            # in the group — too promiscuous to pair on
+            and prev_opts
+            and prev_opts.items() < opts.items()
+        ]
+        if len(candidates) == 1:
+            i = candidates[0]
+            # keep the MORE-complete option dict (the DEFAULT-merged
+            # side) as the entry's identity, the later row as its value
+            merged = max(entries[i][0], opts, key=len)
+            entries[i] = (merged, r)
+        else:
+            entries.append((opts, r))
+    out = []
+    for kind, key in order:
+        if kind == "bank":
+            out.append(keyed[key])
+        else:
+            out.extend(row for _, row in fallback[key])
+    return out
 
 
 def summarize(rows) -> str:

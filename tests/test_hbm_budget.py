@@ -120,3 +120,26 @@ def test_report_line_is_printable():
     assert r.limit == DEFAULT_LIMIT
     line = r.line()
     assert "total" in line and "GiB" in line
+
+
+def test_speculate_draft_counts_full_embed_and_head():
+    """ADVICE r5: the draft is a FULL model (spmd.py builds it via
+    init_params) — its own embed + LM head plus draft_layers decoder
+    layers, counted explicitly. The old total-scaling form credited only
+    draft_layers/L of an embed+head (~67 MB short at this shape)."""
+    kw = {k: v for k, v in SHAPE.items() if k != "layers"}
+    base = decode_budget(
+        ctx=2048, batch=8, phase="generate", n_new=64, layers=2, **kw
+    )
+    spec = decode_budget(
+        ctx=2048, batch=8, phase="speculate", n_new=64, spec_k=4,
+        draft_layers=1, layers=2, **kw,
+    )
+    embed_head = 2 * 16384 * 2048 * 2
+    per_layer = 4 * 2048 * 2048 * 2 + 2 * 2048 * 8192 * 2
+    assert spec.components["weights"] == (
+        base.components["weights"] + embed_head + per_layer
+    )
+    # the fixed arithmetic moves the estimate UP (the OOM direction)
+    scaled = base.components["weights"] * (2 + 1) / 2
+    assert spec.components["weights"] - scaled == embed_head / 2

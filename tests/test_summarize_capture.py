@@ -113,3 +113,86 @@ def test_no_rows_is_a_noop(tmp_path):
     dst = tmp_path / "SUMMARY.md"
     assert sc.main(["x", str(tmp_path / "missing.jsonl"), str(dst)]) == 0
     assert not dst.exists()
+
+
+def test_keyless_error_row_collapses_onto_its_retry(tmp_path):
+    """Rows banked before bank_key existed pair through the normalized
+    fallback: an earlier error row's override-only option dict is a
+    subset of its retry's DEFAULT-merged dict, so the pair collapses to
+    one config — while a different lever config at the same shape stays
+    distinct (its extras are non-default values, not merged defaults)."""
+    rows = [
+        _row(option="kv_cache=int8", error="RESOURCE_EXHAUSTED",
+             **{"median time (ms)": float("nan")}),
+        _row(option="phase=decode;kv_cache=int8;n_new=32;batch=8",
+             **{"median time (ms)": 2.5}),
+        _row(option="phase=decode;kv_cache=bf16;n_new=32;batch=8",
+             **{"median time (ms)": 3.5}),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    text = dst.read_text()
+    assert "3 rows banked; 2 distinct configs (2 measured, 0 errors" in text
+    assert "RESOURCE_EXHAUSTED" not in text
+
+
+def test_keyless_late_error_row_never_steals_a_measured_config(tmp_path):
+    """The override-only subset relation is ambiguous in the other
+    direction — an error row AFTER a measured superset row could be a
+    different config whose absent keys mean defaults — so it must stay
+    its own entry (the append-only log only guarantees error-then-retry
+    ordering for the same config)."""
+    rows = [
+        _row(option="phase=decode;kv_cache=int8;n_kv_heads=4;batch=8",
+             **{"median time (ms)": 2.5}),
+        _row(option="phase=decode;batch=8", error="RESOURCE_EXHAUSTED",
+             **{"median time (ms)": float("nan")}),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    text = dst.read_text()
+    assert "2 rows banked; 2 distinct configs (1 measured, 1 errors" in text
+    assert "RESOURCE_EXHAUSTED" in text
+
+
+def test_keyless_empty_override_error_row_stays_distinct(tmp_path):
+    """An all-defaults error row ('-' option string) subset-matches every
+    config in its group — too promiscuous to pair on, so it must stay
+    its own entry rather than vanish into an arbitrary lever row."""
+    rows = [
+        _row(option="-", error="RESOURCE_EXHAUSTED",
+             **{"median time (ms)": float("nan")}),
+        _row(option="phase=decode;kv_cache=int8;batch=8",
+             **{"median time (ms)": 2.5}),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    assert "2 distinct configs (1 measured, 1 errors" in dst.read_text()
+
+
+def test_keyless_equal_string_retry_wins_over_subset_ambiguity(tmp_path):
+    """An exact option-string match pairs unconditionally (last wins),
+    even when an unrelated error row also subset-matches the retry —
+    the equal match takes precedence over the subset heuristic."""
+    rows = [
+        _row(option="phase=decode;kv_cache=int8;batch=8",
+             **{"median time (ms)": 9.9}),
+        _row(option="kv_cache=int8", error="RESOURCE_EXHAUSTED",
+             **{"median time (ms)": float("nan")}),
+        _row(option="phase=decode;kv_cache=int8;batch=8",
+             **{"median time (ms)": 2.5}),
+    ]
+    src = tmp_path / "rows.jsonl"
+    src.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    dst = tmp_path / "SUMMARY.md"
+    assert sc.main(["x", str(src), str(dst)]) == 0
+    text = dst.read_text()
+    # retry replaced its equal-string predecessor; the error row stays
+    assert "3 rows banked; 2 distinct configs (1 measured, 1 errors" in text
+    assert "2.500" in text and "9.900" not in text
