@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.native import now_ns, robust_stats
 from ddlb_tpu.primitives.registry import (
     ALLOWED_PRIMITIVES,
@@ -106,17 +107,22 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     # crashed/hung children on every exit path.
     def _mark(stage: str, t0=[now_ns()]) -> None:
         t1 = now_ns()
-        print(
-            f"[ddlb_tpu] worker: {stage} (+{(t1 - t0[0]) * 1e-9:.1f}s)",
-            flush=True,
+        telemetry.log(
+            f"worker: {stage}", elapsed_s=round((t1 - t0[0]) * 1e-9, 1)
         )
         t0[0] = t1
 
     # compile accounting for the whole measured region (setup, warmup,
     # timing loops, validation); a real with-block so the thread-local
     # collector can never leak, even on BaseException (SystemExit,
-    # KeyboardInterrupt) escaping the crash-isolation except below
-    with compile_metrics() as _cm:
+    # KeyboardInterrupt) escaping the crash-isolation except below.
+    # The metrics scope rides along: barrier wait, loop overhead, HBM
+    # high-water and collective wire bytes recorded anywhere under this
+    # row land in its result columns (telemetry.ROW_METRIC_DEFAULTS).
+    with compile_metrics() as _cm, telemetry.metrics_scope() as _ms, \
+            telemetry.span(
+                "worker.row", cat="row", impl=impl_id, primitive=primitive
+            ):
         try:
             impl_class = load_impl_class(primitive, base_impl)
             # option merge: DEFAULT_OPTIONS ∪ overrides (reference
@@ -124,38 +130,57 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
             # a bad option or OOM becomes a row, not an aborted sweep
             # (reference per-impl child process, benchmark.py:336-370).
             _mark("setup begin (backend init + operand placement + prefill)")
-            impl = impl_class(m, n, k, dtype=dtype, **options)
+            with telemetry.span("worker.setup", cat="setup", impl=impl_id):
+                impl = impl_class(m, n, k, dtype=dtype, **options)
             option_repr = _format_options(impl.options)
+            wire = getattr(impl, "wire_bytes", None)
+            if callable(wire):
+                # bytes one device moves per collective op — primitive
+                # metadata, snapshotted into the row's collective_bytes
+                try:
+                    telemetry.record_max("collective_bytes", float(wire()))
+                except Exception:
+                    pass
             _mark("setup done; warmup begin (first compile happens here)")
 
             # warmup (reference benchmark.py:84-85)
-            for _ in range(num_warmups):
-                result = impl.run()
-            fence(result)
+            with telemetry.span("worker.warmup", cat="warmup", impl=impl_id):
+                for _ in range(num_warmups):
+                    result = impl.run()
+                fence(result)
             _mark("warmup done; measuring")
 
             # profiler window (reference cudaProfilerStart/Stop window,
             # benchmark.py:87-104 -> jax.profiler trace for xprof/tensorboard)
             if profile_dir:
-                with jax.profiler.trace(profile_dir):
-                    for _ in range(5):
+                with telemetry.span(
+                    "worker.profile", cat="profile", impl=impl_id
+                ):
+                    with jax.profiler.trace(profile_dir):
+                        for _ in range(5):
+                            result = impl.run()
+                        fence(result)
+                    # re-warm after tracing overhead (reference
+                    # benchmark.py:121-122)
+                    for _ in range(num_warmups):
                         result = impl.run()
                     fence(result)
-                # re-warm after tracing overhead (reference benchmark.py:121-122)
-                for _ in range(num_warmups):
-                    result = impl.run()
-                fence(result)
 
-            times_ms = _timing_loop(
-                impl,
-                runtime,
-                num_iterations,
-                timing_backend,
-                barrier_each,
-                num_windows=config.get("device_loop_windows", 5),
-                min_window_s=config.get("device_loop_min_window_ms", 100.0) * 1e-3,
-            )
-            times_ms = _max_reduce_across_processes(times_ms, runtime)
+            with telemetry.span(
+                "worker.timing", cat="timing", impl=impl_id,
+                backend=timing_backend,
+            ):
+                times_ms = _timing_loop(
+                    impl,
+                    runtime,
+                    num_iterations,
+                    timing_backend,
+                    barrier_each,
+                    num_windows=config.get("device_loop_windows", 5),
+                    min_window_s=config.get("device_loop_min_window_ms", 100.0)
+                    * 1e-3,
+                )
+                times_ms = _max_reduce_across_processes(times_ms, runtime)
             _mark("measured; validation begin" if do_validate else "measured")
 
             valid = True
@@ -163,21 +188,35 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                 # a validation crash (e.g. the oracle OOMs at a context the
                 # measured step handles fine) must not discard the completed
                 # measurement: times stand, valid=False + error records why
-                try:
-                    result = impl.run()
-                    fence(result)
-                    valid = bool(impl.validate(result))
-                except Exception as exc:
-                    error = f"validation crashed: {type(exc).__name__}: {exc}"
-                    valid = False
+                with telemetry.span(
+                    "worker.validate", cat="validate", impl=impl_id
+                ):
+                    try:
+                        result = impl.run()
+                        fence(result)
+                        valid = bool(impl.validate(result))
+                    except Exception as exc:
+                        error = (
+                            f"validation crashed: {type(exc).__name__}: {exc}"
+                        )
+                        valid = False
                 if not valid:
                     # soft failure: recorded, not fatal (reference
                     # benchmark.py:242-245)
-                    print(f"[ddlb_tpu] WARNING: validation failed for {impl_id}")
+                    telemetry.warn(f"validation failed for {impl_id}")
         except Exception as exc:  # crash isolation: report as a row
             error = f"{type(exc).__name__}: {exc}"
             times_ms = np.array([float("nan")])
             valid = False
+        # allocator high-water: recorded while the row's scope is still
+        # active so it lands in the hbm_high_water_bytes column (same
+        # raised-by-THIS-config rule as hbm_peak_gib below)
+        peak = _device_hbm_peak()
+        peak_raised = peak is not None and (
+            peak_at_entry is None or peak > peak_at_entry
+        )
+        if peak_raised:
+            telemetry.record_max("hbm_high_water_bytes", peak)
 
     # TFLOPS = flops / 1e9 / time_ms; GEMM primitives use the reference's
     # 2*m*n*k (benchmark.py:209-214), attention primitives override
@@ -197,6 +236,7 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         platform=runtime.platform,
         compile_time_s=round(_cm.compile_time_s, 4),
         compile_cache_hit=_cm.cache_hit,
+        metrics=_ms.row_fields(),
     )
     if impl is not None and np.isfinite(times_ms).any():
         # family-specific measured quantities (speculate acceptance
@@ -205,12 +245,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         try:
             row.update(impl.extra_row_fields())
         except Exception as exc:
-            print(
-                f"[ddlb_tpu] WARNING: extra_row_fields failed: "
-                f"{type(exc).__name__}: {exc}"
+            telemetry.warn(
+                f"extra_row_fields failed: {type(exc).__name__}: {exc}"
             )
-    peak = _device_hbm_peak()
-    if peak is not None and (peak_at_entry is None or peak > peak_at_entry):
+    if peak_raised:
         # measured HBM peak next to the row: each hardware capture
         # doubles as a calibration point for the static budget model
         # (utils/hbm_budget.py) that right-sizes the long-context rows.
@@ -249,6 +287,7 @@ def make_result_row(
     platform: str,
     compile_time_s: float = float("nan"),
     compile_cache_hit: bool = False,
+    metrics: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The one result-row schema, shared by measured, crashed and
     timed-out workers so the CSV columns cannot drift apart.
@@ -257,7 +296,17 @@ def make_result_row(
     (ddlb_tpu/native/host_runtime.cpp); median and p95 are
     jitter-resistant additions over the reference's four. Error rows
     carry NaN times -> all-NaN stats by the native contract.
+
+    ``metrics`` is the worker's telemetry snapshot; only the fixed
+    ``telemetry.ROW_METRIC_DEFAULTS`` keys land as columns (defaults on
+    rows that never recorded them — error rows included — so the CSV
+    header is identical on every path).
     """
+    metric_fields = dict(telemetry.ROW_METRIC_DEFAULTS)
+    if metrics:
+        metric_fields.update(
+            {k: metrics[k] for k in metric_fields if k in metrics}
+        )
     tflops = flop_count / 1e9 / times_ms
     stats = robust_stats(times_ms)
     return {
@@ -300,6 +349,10 @@ def make_result_row(
         # worker died before compiling anything
         "compile_time_s": compile_time_s,
         "compile_cache_hit": compile_cache_hit,
+        # the telemetry attribution columns: where the row's overhead
+        # went (barrier wait, device_loop dispatch slack, HBM high-water,
+        # collective wire bytes) — ISSUE 2's measurement layer
+        **metric_fields,
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
@@ -502,7 +555,7 @@ class PrimitiveBenchmarkRunner:
                 # for this (impl, shape, dtype) are skipped, so an
                 # interrupted sweep restarts where it stopped
                 if is_primary:
-                    print(f"[ddlb_tpu] resume: skipping {impl_id} (in CSV)")
+                    telemetry.log(f"resume: skipping {impl_id} (in CSV)")
                 continue
             pending.append((impl_id, spec))
 
@@ -529,11 +582,11 @@ class PrimitiveBenchmarkRunner:
                 scheduler.wait(timeout=scheduler.WAIT_TIMEOUT_S)
                 scheduler_busy = scheduler.busy
                 if scheduler_busy:
-                    print(
-                        "[ddlb_tpu] WARNING: compile-ahead prefetch still "
-                        "running after the bounded wait; skipping the "
-                        "cache clear this boundary (clearing under an "
-                        "active compile thread races the global caches)"
+                    telemetry.warn(
+                        "compile-ahead prefetch still running after the "
+                        "bounded wait; skipping the cache clear this "
+                        "boundary (clearing under an active compile "
+                        "thread races the global caches)"
                     )
             sig = sigs[impl_id]
             if (
@@ -559,19 +612,33 @@ class PrimitiveBenchmarkRunner:
             row = self._run_one(config)
             rows.append(row)
             if is_primary:
-                print(pd.DataFrame([row]).to_string(index=False))
+                # mirror=False: the row is already in the CSV and the
+                # worker.row span — echoing the table into the trace
+                # would duplicate the whole results file as event payload
+                telemetry.log(
+                    pd.DataFrame([row]).to_string(index=False), mirror=False
+                )
                 if self.output_csv:
                     # incremental append so a crash loses one row at most
                     # (reference benchmark.py:375-384)
-                    self._append_csv(row)
+                    with telemetry.span("runner.csv_append", cat="csv"):
+                        self._append_csv(row)
         if scheduler is not None:
             scheduler.shutdown()
+            # sweep-level compile-ahead effectiveness into the global
+            # registry: hit/miss counts for the prefetch ratio the trace
+            # report surfaces next to overlap efficiency
+            telemetry.record(
+                "compile_ahead.prefetched", scheduler.prefetched
+            )
+            telemetry.record("compile_ahead.failed", scheduler.failed)
+            telemetry.record("compile_ahead.skipped", scheduler.skipped)
             if is_primary and (
                 scheduler.prefetched or scheduler.failed or scheduler.skipped
             ):
-                print(
-                    f"[ddlb_tpu] compile-ahead: {scheduler.prefetched} "
-                    f"prefetched, {scheduler.failed} failed, "
+                telemetry.log(
+                    f"compile-ahead: {scheduler.prefetched} prefetched, "
+                    f"{scheduler.failed} failed, "
                     f"{scheduler.skipped} skipped"
                 )
         if (
@@ -586,6 +653,13 @@ class PrimitiveBenchmarkRunner:
             import jax
 
             jax.clear_caches()
+        if is_primary:
+            # join per-process trace shards (this process's, and the
+            # subprocess-isolation children's) into the Perfetto-loadable
+            # trace.json; a no-op when DDLB_TPU_TRACE is unset
+            merged = telemetry.merge_trace()
+            if merged:
+                telemetry.log(f"trace merged: {merged}")
         return pd.DataFrame(rows)
 
     def _make_scheduler(self) -> Optional[CompileAheadScheduler]:
@@ -674,9 +748,8 @@ class PrimitiveBenchmarkRunner:
                 n = int(override)
             except ValueError:
                 n = 0
-                print(
-                    f"[ddlb_tpu] WARNING: ignoring non-integer "
-                    f"DDLB_TPU_WORLD_SIZE={override!r}"
+                telemetry.warn(
+                    f"ignoring non-integer DDLB_TPU_WORLD_SIZE={override!r}"
                 )
             if n > 0:  # 0 = disabled, the DDLB_TPU_* env convention
                 return n
@@ -694,9 +767,9 @@ class PrimitiveBenchmarkRunner:
                     cached = 0
                 if cached > 0:  # a corrupt/zero file never becomes a key
                     self._probed_world_size = cached
-                    print(
-                        f"[ddlb_tpu] resume world_size={cached} from "
-                        f"{cache_path} — delete it if the topology changed"
+                    telemetry.log(
+                        f"resume world_size={cached} from {cache_path} — "
+                        f"delete it if the topology changed"
                     )
             if self._probed_world_size is None:
                 import subprocess
@@ -726,11 +799,11 @@ class PrimitiveBenchmarkRunner:
                         except OSError:
                             pass
                 except Exception:
-                    print(
-                        "[ddlb_tpu] WARNING: could not probe the device "
-                        "count for the resume key; completed-row matching "
-                        "will ignore world_size — do not resume a sweep "
-                        "recorded on a different topology"
+                    telemetry.warn(
+                        "could not probe the device count for the resume "
+                        "key; completed-row matching will ignore "
+                        "world_size — do not resume a sweep recorded on "
+                        "a different topology"
                     )
                     self._probed_world_size = -1  # probe failed, don't retry
             return (
@@ -799,63 +872,70 @@ class PrimitiveBenchmarkRunner:
 
     def _run_one(self, config: Dict[str, Any]) -> Dict[str, Any]:
         if self.isolation == "subprocess":
-            # full per-implementation process isolation (reference
-            # spawn-per-impl, benchmark.py:336-370)
-            import multiprocessing as mp
-            import queue as queue_mod
-
-            import time as time_mod
-
-            ctx = mp.get_context("spawn")
-            queue = ctx.Queue()
-            proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
-            proc.start()
-            # failure detection: the reference blocks forever on a hung
-            # child (queue.get with no timeout, benchmark.py:369 —
-            # SURVEY.md section 5 "no retries, no timeouts"). Poll in
-            # short slices so a child that DIES without posting a row
-            # (segfault, OOM-kill) is reported immediately as a crash, and
-            # one that HANGS is killed at worker_timeout.
-            deadline = (
-                time_mod.monotonic() + self.worker_timeout
-                if self.worker_timeout
-                else None
-            )
-            row = None
-            while row is None:
-                try:
-                    row = queue.get(timeout=1.0)
-                except queue_mod.Empty:
-                    if not proc.is_alive():
-                        # died; drain once in case the row raced the exit
-                        try:
-                            row = queue.get(timeout=1.0)
-                        except queue_mod.Empty:
-                            return self._error_row(
-                                config,
-                                f"WorkerDied: exit code {proc.exitcode} "
-                                f"with no result",
-                            )
-                        break
-                    if deadline and time_mod.monotonic() > deadline:
-                        proc.kill()
-                        proc.join()
-                        return self._error_row(
-                            config,
-                            f"TimeoutError: worker exceeded "
-                            f"{self.worker_timeout}s (killed)",
-                        )
-            # a child can also hang in interpreter teardown (runtime/atexit
-            # finalizers) after delivering its row — bound the join even
-            # when no worker_timeout was configured
-            proc.join(self.worker_timeout or 60.0)
-            if proc.is_alive():
-                proc.kill()
-                proc.join()
-            return row
+            with telemetry.span(
+                "runner.subprocess_row", cat="row",
+                impl=config.get("impl_id", ""),
+            ):
+                return self._run_one_subprocess(config)
         # cross-impl cache isolation is the run() loop's job now: it
         # clears at executable-signature boundaries instead of per row
         return benchmark_worker(config)
+
+    def _run_one_subprocess(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        # full per-implementation process isolation (reference
+        # spawn-per-impl, benchmark.py:336-370)
+        import multiprocessing as mp
+        import queue as queue_mod
+
+        import time as time_mod
+
+        ctx = mp.get_context("spawn")
+        queue = ctx.Queue()
+        proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
+        proc.start()
+        # failure detection: the reference blocks forever on a hung
+        # child (queue.get with no timeout, benchmark.py:369 —
+        # SURVEY.md section 5 "no retries, no timeouts"). Poll in
+        # short slices so a child that DIES without posting a row
+        # (segfault, OOM-kill) is reported immediately as a crash, and
+        # one that HANGS is killed at worker_timeout.
+        deadline = (
+            time_mod.monotonic() + self.worker_timeout
+            if self.worker_timeout
+            else None
+        )
+        row = None
+        while row is None:
+            try:
+                row = queue.get(timeout=1.0)
+            except queue_mod.Empty:
+                if not proc.is_alive():
+                    # died; drain once in case the row raced the exit
+                    try:
+                        row = queue.get(timeout=1.0)
+                    except queue_mod.Empty:
+                        return self._error_row(
+                            config,
+                            f"WorkerDied: exit code {proc.exitcode} "
+                            f"with no result",
+                        )
+                    break
+                if deadline and time_mod.monotonic() > deadline:
+                    proc.kill()
+                    proc.join()
+                    return self._error_row(
+                        config,
+                        f"TimeoutError: worker exceeded "
+                        f"{self.worker_timeout}s (killed)",
+                    )
+        # a child can also hang in interpreter teardown (runtime/atexit
+        # finalizers) after delivering its row — bound the join even
+        # when no worker_timeout was configured
+        proc.join(self.worker_timeout or 60.0)
+        if proc.is_alive():
+            proc.kill()
+            proc.join()
+        return row
 
     def _error_row(self, config: Dict[str, Any], error: str) -> Dict[str, Any]:
         """Error row for a worker that hung or died — the same schema as

@@ -109,6 +109,19 @@ def get_compile_cache_dir() -> str:
     return os.environ.get("DDLB_TPU_COMPILE_CACHE", "").strip()
 
 
+def get_trace_dir() -> str:
+    """Structured-trace output directory ("" = tracing disabled).
+
+    When set, ``ddlb_tpu.telemetry`` spans are written as Chrome
+    ``trace_event`` JSON lines to a per-process shard under this
+    directory (``trace-<host>-p<rank>-<pid>.jsonl``); the sweep runner
+    (or ``scripts/trace_report.py``) merges shards into a
+    Perfetto/``chrome://tracing``-loadable ``trace.json``. Follows the
+    DDLB_TPU_* convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_TRACE", "").strip()
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

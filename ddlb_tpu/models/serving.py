@@ -48,6 +48,7 @@ in-engine batch axis IS the slot axis).
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -57,6 +58,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.models.decode import (
     init_cache,
     init_paged_cache,
@@ -605,6 +607,12 @@ class ContinuousBatchingEngine:
         return b
 
     def _admit(self, slot: int, req_idx: int) -> None:
+        with telemetry.span(
+            "serve.admit", cat="serve", slot=slot, request=req_idx
+        ):
+            self._admit_inner(slot, req_idx)
+
+    def _admit_inner(self, slot: int, req_idx: int) -> None:
         req = self._requests[req_idx]
         S0 = req.prompt.size
         assert S0 + req.max_new <= self.S_max  # screened in submit()
@@ -773,6 +781,11 @@ class ContinuousBatchingEngine:
         active = [s for s in range(self.B) if self._slot_req[s] is not None]
         if not active:
             return 0
+        # no per-tick span: a locked trace write per decoded token would
+        # perturb the measured loop this engine runs inside — ticks are
+        # counted into the metrics registry and summarized as one
+        # instant at the end of run() instead
+        t0 = time.perf_counter()
         logits, self.cache = self._decode(
             self.params,
             self.cache,
@@ -780,6 +793,8 @@ class ContinuousBatchingEngine:
             jnp.asarray(self.pos),
         )
         nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        telemetry.record("serve.decode_s", time.perf_counter() - t0)
+        telemetry.record("serve.ticks", 1)
         self.stats.steps += 1
         self.stats.lane_ticks_total += self.B
         self.stats.lane_ticks_active += len(active)
@@ -793,9 +808,18 @@ class ContinuousBatchingEngine:
 
     def run(self) -> List[Completion]:
         """Admit + step until the queue and all slots drain."""
-        while True:
-            self.admit_ready()
-            if self.step() == 0 and not self._queue:
-                return self.completions
+        with telemetry.span("serve.run", cat="serve"):
+            try:
+                while True:
+                    self.admit_ready()
+                    if self.step() == 0 and not self._queue:
+                        return self.completions
+            finally:
+                telemetry.instant(
+                    "serve.ticks", cat="serve",
+                    steps=self.stats.steps,
+                    generated=self.stats.generated,
+                    admissions=self.stats.admissions,
+                )
 
 

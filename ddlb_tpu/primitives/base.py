@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.options import OptionsManager
 from ddlb_tpu.runtime import Runtime
 
@@ -274,8 +275,8 @@ class Primitive(ABC):
             want = expected[shard.index]
             if not np.allclose(got, want, rtol=0.0, atol=atol):
                 max_err = float(np.max(np.abs(got - want))) if got.size else 0.0
-                print(
-                    f"[ddlb_tpu] validation FAILED for {type(self).__name__} "
+                telemetry.log(
+                    f"validation FAILED for {type(self).__name__} "
                     f"shard {shard.index}: max|err|={max_err:.3e} > atol={atol:.3e}"
                 )
                 ok = False

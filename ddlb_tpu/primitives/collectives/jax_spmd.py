@@ -24,6 +24,8 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu import telemetry
+
 from ddlb_tpu.primitives.collectives.base import Collectives
 
 
@@ -50,8 +52,8 @@ class JaxSPMDCollectives(Collectives):
                 # same loud degenerate-case note as transport_mesh: a
                 # sweep must not record a "hierarchical" row that
                 # silently measured rs_ag on a one-slice world
-                print(
-                    "[ddlb_tpu] strategy='hierarchical' on a single "
+                telemetry.log(
+                    "strategy='hierarchical' on a single "
                     "slice: the dcn axis has extent 1 — this row "
                     "measures the rs_ag decomposition"
                 )

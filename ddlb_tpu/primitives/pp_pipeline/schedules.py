@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.primitives.base import jnp_dtype, validation_atol
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
 from ddlb_tpu.utils.pipeline_schedule import (
@@ -260,8 +261,8 @@ class SchedulePPPipeline(PPPipeline):
                 s = c * d + p
                 err = np.max(np.abs(got[p * v + c] - want[s]))
                 if not err <= atol:
-                    print(
-                        f"[ddlb_tpu] schedule grad validation FAILED "
+                    telemetry.log(
+                        f"schedule grad validation FAILED "
                         f"stage {s}: max|err|={err:.3e} > atol={atol:.3e}"
                     )
                     ok = False

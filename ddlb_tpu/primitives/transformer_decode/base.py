@@ -37,6 +37,7 @@ from typing import Tuple
 
 import numpy as np
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -382,8 +383,8 @@ class TransformerDecode(Primitive):
             # as the int8 MLP note above.
             atol = max(atol, 1e-2)
         if logits.shape != expected.shape:
-            print(
-                f"[ddlb_tpu] validation FAILED for {type(self).__name__}: "
+            telemetry.log(
+                f"validation FAILED for {type(self).__name__}: "
                 f"shape {logits.shape} != {expected.shape}"
             )
             return False
@@ -417,12 +418,12 @@ class TransformerDecode(Primitive):
 
         done = getattr(self, "_serve_completions", None)
         if not done:
-            print("[ddlb_tpu] serve validation FAILED: no completions")
+            telemetry.log("serve validation FAILED: no completions")
             return False
         workload = self._serve_workload()
         if len(done) != len(workload):
-            print(
-                f"[ddlb_tpu] serve validation FAILED: {len(done)} "
+            telemetry.log(
+                f"serve validation FAILED: {len(done)} "
                 f"completions != {len(workload)} requests"
             )
             return False
@@ -441,8 +442,8 @@ class TransformerDecode(Primitive):
                 if c.finished_by == "max_new" and (
                     c.tokens.size != S0 + max_new
                 ):
-                    print(
-                        f"[ddlb_tpu] serve validation FAILED: request "
+                    telemetry.log(
+                        f"serve validation FAILED: request "
                         f"{c.request_index} length {c.tokens.size} != "
                         f"{S0 + max_new}"
                     )
@@ -464,8 +465,8 @@ class TransformerDecode(Primitive):
                     if got != want:
                         top2 = np.sort(logits)[-2:]
                         if float(top2[1] - top2[0]) >= tie_tol:
-                            print(
-                                f"[ddlb_tpu] serve validation FAILED: "
+                            telemetry.log(
+                                f"serve validation FAILED: "
                                 f"request {c.request_index} slot {c.slot} "
                                 f"leaves the oracle chain at step {t}"
                             )
@@ -502,8 +503,8 @@ class TransformerDecode(Primitive):
         B, S0 = prompt.shape
         n_new = self.options["n_new"]
         if result.shape != (B, S0 + n_new):
-            print(
-                f"[ddlb_tpu] generate validation FAILED: shape "
+            telemetry.log(
+                f"generate validation FAILED: shape "
                 f"{result.shape} != {(B, S0 + n_new)}"
             )
             return False
@@ -534,12 +535,12 @@ class TransformerDecode(Primitive):
             got = np.asarray(shard.data)
             rows = shard.index[0]
             if not (got[:, :S0] == prompt[rows]).all():
-                print(
-                    "[ddlb_tpu] generate validation FAILED: prompt mangled"
+                telemetry.log(
+                    "generate validation FAILED: prompt mangled"
                 )
                 ok = False
             if ((got < 0) | (got >= self.options["vocab"])).any():
-                print("[ddlb_tpu] generate validation FAILED: token range")
+                telemetry.log("generate validation FAILED: token range")
                 ok = False
             # only the FIRST divergence per row is checkable: a forgiven
             # tie-flip changes that row's context, so later steps
@@ -552,8 +553,8 @@ class TransformerDecode(Primitive):
             )[:, 0]
             hard = any_m & (row_gap >= tie_tol)
             if hard.any():
-                print(
-                    f"[ddlb_tpu] generate validation FAILED: shard "
+                telemetry.log(
+                    f"generate validation FAILED: shard "
                     f"{shard.index}: {int(hard.sum())} rows leave the "
                     f"oracle chain at a non-tie position"
                 )

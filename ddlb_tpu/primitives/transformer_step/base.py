@@ -31,6 +31,7 @@ from typing import Tuple
 
 import numpy as np
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -321,8 +322,8 @@ class TransformerStep(Primitive):
         expected = self._oracle_loss()
         ok = np.isfinite(loss) and abs(loss - expected) <= atol
         if not ok:
-            print(
-                f"[ddlb_tpu] validation FAILED for {type(self).__name__}: "
+            telemetry.log(
+                f"validation FAILED for {type(self).__name__}: "
                 f"loss={loss:.6f} oracle={expected:.6f} atol={atol:g}"
             )
         return ok

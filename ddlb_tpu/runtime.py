@@ -24,9 +24,10 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional, Sequence, Tuple
 
-from ddlb_tpu import envs
+from ddlb_tpu import envs, telemetry
 
 _SIM_FLAG = "--xla_force_host_platform_device_count"
 
@@ -144,6 +145,9 @@ class Runtime:
             )
             self._distributed = True
 
+        #: (jitted psum, operand) built lazily by the first barrier();
+        #: cached so repeat barriers time only execution, never re-trace
+        self._barrier_call = None
         self.devices = tuple(jax.devices())
         self.local_devices = tuple(jax.local_devices())
         self.num_devices = len(self.devices)
@@ -201,7 +205,12 @@ class Runtime:
             shape = (self.num_devices,) if len(axis_names) == 1 else None
         if shape is None:
             raise ValueError("shape required for multi-axis meshes")
-        return jax.make_mesh(shape, tuple(axis_names), devices=self.devices)
+        with telemetry.span(
+            "runtime.mesh_build", cat="runtime", axes=",".join(axis_names)
+        ):
+            return jax.make_mesh(
+                shape, tuple(axis_names), devices=self.devices
+            )
 
     def transport_mesh(self, axis_names=("tp",), transport: str = "ici"):
         """1-D mesh whose ring-neighbor structure rides the chosen
@@ -229,10 +238,10 @@ class Runtime:
             # runtime exposes no device.slice_index): the dcn and ici
             # layouts are identical, so say so rather than let a sweep
             # record a 'dcn' row that silently measured the ici ordering
-            print(
-                "[ddlb_tpu] WARNING: transport='dcn' requested but the "
-                "device topology shows a single slice — dcn and ici mesh "
-                "layouts are identical here"
+            telemetry.warn(
+                "transport='dcn' requested but the device topology shows "
+                "a single slice — dcn and ici mesh layouts are identical "
+                "here"
             )
         n = self.num_devices
         order = sorted(range(n), key=lambda i: (self.slice_ids[i], i))
@@ -294,21 +303,40 @@ class Runtime:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        mesh = self.mesh(("_barrier",))
-        ones = jax.device_put(
-            jnp.ones((self.num_devices,), jnp.int32),
-            NamedSharding(mesh, P("_barrier")),
-        )
+        with telemetry.span("runtime.barrier", cat="barrier"):
+            if self._barrier_call is None:
+                # built once per process: a fresh closure would re-trace
+                # on every barrier, and its jit/compile cost would land
+                # in barrier_wait_s — which must measure WAIT (the
+                # cross-process skew the MAX-reduce hides), not compile
+                # time, which compile_time_s already accounts
+                mesh = self.mesh(("_barrier",))
+                ones = jax.device_put(
+                    jnp.ones((self.num_devices,), jnp.int32),
+                    NamedSharding(mesh, P("_barrier")),
+                )
 
-        def _sum(x):
-            return shard_map_compat(
-                lambda v: jax.lax.psum(v, "_barrier"),
-                mesh=mesh,
-                in_specs=P("_barrier"),
-                out_specs=P(),
-            )(x)
+                def _sum(x):
+                    return shard_map_compat(
+                        lambda v: jax.lax.psum(v, "_barrier"),
+                        mesh=mesh,
+                        in_specs=P("_barrier"),
+                        out_specs=P(),
+                    )(x)
 
-        jax.jit(_sum)(ones).block_until_ready()
+                fn = jax.jit(_sum)
+                fn(ones).block_until_ready()  # warm: compile not counted
+                self._barrier_call = (fn, ones)
+            fn, ones = self._barrier_call
+            # dispatch outside the timed window: if a jax.clear_caches()
+            # (signature-boundary isolation) dropped the executable, the
+            # recompile happens during dispatch and must not count as
+            # wait; the barrier WAIT is the device-completion block
+            out = fn(ones)
+            t0 = time.perf_counter()
+            out.block_until_ready()
+            # summed per row into the ``barrier_wait_s`` CSV column
+            telemetry.record("barrier_wait_s", time.perf_counter() - t0)
 
     def __repr__(self) -> str:
         return (

@@ -1,0 +1,79 @@
+"""Rank-tagged structured logger for multi-process benchmark output.
+
+The runner's diagnostics used to be bare ``print`` calls: on a
+multi-process pod N ranks interleave identical lines with no way to tell
+whose backend warned, and downstream tooling (hw_common's child-
+diagnostic forwarding, summarize_capture) has to substring-match free
+text. ``log`` keeps the human-readable line but makes it attributable
+and machine-parseable:
+
+- every line starts ``[ddlb_tpu][p<rank>]`` — the ``[ddlb_tpu]`` prefix
+  is load-bearing (scripts/hw_common._forward_diagnostics surfaces
+  child lines by that exact prefix), the rank tag is the attribution;
+- structured ``key=value`` fields append after the message, sorted, so
+  a grep-consumer and a human read the same line;
+- multi-line messages (result tables) get the prefix on every line;
+- when tracing is enabled, each log line is mirrored into the trace as
+  an instant event, so Perfetto shows the warnings on the span timeline.
+
+Zero-dependency and lazy: rank is re-read per call (``envs`` reads the
+environment lazily so spawn-time env changes are honored).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+from ddlb_tpu import envs
+from ddlb_tpu.telemetry import trace
+
+
+def log(
+    msg: str, *, level: str = "info", mirror: bool = True, **fields: Any
+) -> None:
+    """Emit one rank-tagged diagnostic line (flushed to stdout).
+
+    ``level`` other than "info" is rendered as an uppercase prefix
+    (``WARNING: ...``), preserving the grep surface of the bare-print
+    era. ``fields`` append as sorted ``key=value`` pairs.
+    ``mirror=False`` skips the trace instant — for bulk echoes (result
+    tables) whose payload would bloat the merged trace for no
+    attribution value.
+    """
+    rank = envs.get_process_id()
+    prefix = f"[ddlb_tpu][p{rank}]"
+    body = str(msg)
+    if level != "info":
+        body = f"{level.upper()}: {body}"
+    if fields:
+        kv = " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        body = f"{body}  {kv}"
+    text = "\n".join(f"{prefix} {line}" for line in body.splitlines() or [""])
+    print(text, flush=True)
+    if not mirror:
+        return
+    # fields are caller-chosen: names colliding with instant()'s own
+    # parameters must not turn a diagnostic into a TypeError crash
+    reserved = {"name", "cat", "level", "message"}
+    safe = {
+        (f"field_{k}" if k in reserved else k): v for k, v in fields.items()
+    }
+    trace.instant("log", cat="log", level=level, message=str(msg), **safe)
+
+
+def warn(msg: str, **fields: Any) -> None:
+    """``log(..., level="warning")`` shorthand."""
+    log(msg, level="warning", **fields)
+
+
+def error(msg: str, **fields: Any) -> None:
+    """``log(..., level="error")`` shorthand (still stdout: the capture
+    pipelines — hw_common, the watcher — forward child stdout)."""
+    log(msg, level="error", **fields)
+
+
+def _self_test() -> bool:  # pragma: no cover - debugging hook
+    log("logger self-test", level="info", answer=42)
+    sys.stdout.flush()
+    return True

@@ -36,6 +36,8 @@ from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddlb_tpu import telemetry
+
 _REPO_DIR = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
@@ -155,10 +157,9 @@ def autotune(
             if np.isfinite(med) and med > 0:
                 results.append((med, tuple(cand)))
         except Exception as exc:  # unbuildable candidate (VMEM, shape)
-            print(
-                f"[ddlb_tpu] autotune: skipping {kernel} blocks {cand}: "
-                f"{type(exc).__name__}: {exc}",
-                flush=True,
+            telemetry.log(
+                f"autotune: skipping {kernel} blocks {cand}: "
+                f"{type(exc).__name__}: {exc}"
             )
     if not results:
         raise ValueError(
@@ -177,10 +178,9 @@ def autotune(
         "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     _save_cache(path, cache)
-    print(
-        f"[ddlb_tpu] autotune: {key} -> blocks {best} "
-        f"({best_ms:.3f} ms/iter over {len(results)} candidates)",
-        flush=True,
+    telemetry.log(
+        f"autotune: {key} -> blocks {best} "
+        f"({best_ms:.3f} ms/iter over {len(results)} candidates)"
     )
     return best
 

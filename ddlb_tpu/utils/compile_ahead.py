@@ -43,8 +43,11 @@ compiles via the persistent cache instead.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ddlb_tpu import telemetry
 
 # ---------------------------------------------------------------------------
 # Compile metrics: who paid for compilation, and did the cache answer
@@ -103,6 +106,13 @@ def _on_event(event: str, **kwargs: Any) -> None:
 
 
 def _on_event_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
+    if event in _COMPILE_DURATION_EVENTS:
+        # the only observer of XLA compile cost is this listener, so the
+        # trace's compile phase is emitted here: a back-dated complete
+        # span (no-op when DDLB_TPU_TRACE is unset)
+        telemetry.completed_event(
+            "xla_compile", float(duration_secs), cat="compile"
+        )
     stack = getattr(_tls, "stack", None)
     if not stack:
         return
@@ -310,11 +320,27 @@ class CompileAheadScheduler:
         self._error = None
 
         def _work(cfg=dict(config)) -> None:
+            t0 = time.perf_counter()
             try:
-                with compile_metrics():  # isolate from any measuring scope
-                    self._compile_fn(cfg)
+                # the prefetch span is what trace_report's overlap-
+                # efficiency metric intersects with timing spans: it must
+                # cover exactly the background compile work
+                with telemetry.span(
+                    "compile_ahead.prefetch",
+                    cat="compile",
+                    impl=str(cfg.get("impl_id", "")),
+                ):
+                    with compile_metrics():  # isolate from measuring scope
+                        self._compile_fn(cfg)
             except BaseException as exc:  # recorded, reported by wait()
                 self._error = exc
+            finally:
+                # global registry (this thread has no row scope): total
+                # background compile seconds, for the sweep-level
+                # prefetch-overlap ratio
+                telemetry.record(
+                    "compile_ahead.prefetch_s", time.perf_counter() - t0
+                )
 
         self._thread = threading.Thread(
             target=_work, name="ddlb-compile-ahead", daemon=True
@@ -342,8 +368,8 @@ class CompileAheadScheduler:
             return False
         if self._error is not None:
             self.failed += 1
-            print(
-                f"[ddlb_tpu] compile-ahead prefetch failed "
+            telemetry.warn(
+                f"compile-ahead prefetch failed "
                 f"({type(self._error).__name__}: {self._error}); "
                 f"falling back to synchronous compile"
             )

@@ -23,6 +23,7 @@ from typing import Any, Callable, Tuple
 
 import numpy as np
 
+from ddlb_tpu import telemetry
 from ddlb_tpu.native import now_ns
 
 
@@ -141,16 +142,17 @@ def measure_device_loop(
 
     def _build_loops(n):
         """(loop_big, loop_small | None, call_args, small), warm-compiled."""
-        small_n = max(1, n // 4)
-        if small_n == n:
-            small_n = 0
-        big, cargs = make_timed_loop(fn, args, n, compiler_options)
-        sm = None
-        if small_n:
-            sm, _ = make_timed_loop(fn, args, small_n, compiler_options)
-            float(sm(*cargs))  # warm compile
-        float(big(*cargs))  # warm compile
-        return big, sm, cargs, small_n
+        with telemetry.span("device_loop.build", cat="compile", n=n):
+            small_n = max(1, n // 4)
+            if small_n == n:
+                small_n = 0
+            big, cargs = make_timed_loop(fn, args, n, compiler_options)
+            sm = None
+            if small_n:
+                sm, _ = make_timed_loop(fn, args, small_n, compiler_options)
+                float(sm(*cargs))  # warm compile
+            float(big(*cargs))  # warm compile
+            return big, sm, cargs, small_n
 
     def _run_once(loop, cargs):
         t0 = _now_s()
@@ -213,8 +215,8 @@ def measure_device_loop(
             break
         if factor > 1:
             num_iterations *= factor
-            print(
-                f"[ddlb_tpu] device_loop: window below the "
+            telemetry.log(
+                f"device_loop: window below the "
                 f"{min_window_s * 1e3:.0f} ms floor; scaling to "
                 f"{num_iterations} iterations per window"
             )
@@ -224,11 +226,15 @@ def measure_device_loop(
 
     windows = np.empty(num_windows, dtype=np.float64)
     underflows = 0
+    overheads = []
     for w in range(num_windows):
-        t_small = (
-            _run_once(loop_small, call_args) if loop_small is not None else 0.0
-        )
-        t_big = _run_once(loop_big, call_args)
+        with telemetry.span("device_loop.window", cat="timing", window=w):
+            t_small = (
+                _run_once(loop_small, call_args)
+                if loop_small is not None
+                else 0.0
+            )
+            t_big = _run_once(loop_big, call_args)
         per_iter = (t_big - t_small) * 1e3 / (num_iterations - small)
         if per_iter <= 0.0:
             # host-noise underflow (the small window hit a jitter spike);
@@ -236,11 +242,24 @@ def measure_device_loop(
             # is always positive
             underflows += 1
             per_iter = t_big * 1e3 / num_iterations
+        else:
+            # the two-window overhead estimate: t_big = overhead + N*p, so
+            # the slack the differential cancelled out of THIS window is
+            # t_big - N*p — dispatch, fence and relay RPC cost per window
+            overheads.append(t_big - num_iterations * per_iter * 1e-3)
         windows[w] = per_iter
     if underflows:
-        print(
-            f"[ddlb_tpu] WARNING: device_loop differential underflow in "
+        telemetry.warn(
+            f"device_loop differential underflow in "
             f"{underflows}/{num_windows} windows; those report the "
             f"overhead-inclusive window average instead"
         )
+    # surfaced in the result row (``loop_overhead_s``) via the runner's
+    # metrics scope: the measured per-window dispatch/fence/RPC slack the
+    # differential removed — exactly the overhead the host_clock backend
+    # would have paid inside its numbers
+    telemetry.record_max(
+        "loop_overhead_s",
+        max(0.0, float(np.median(overheads))) if overheads else 0.0,
+    )
     return windows

@@ -134,6 +134,35 @@ def _security_checks(path: Path, tree: ast.Module) -> list:
     return out
 
 
+#: package subtrees exempt from the bare-print ban: the CLI is the
+#: user-facing stdout surface (results tables ARE its output), and the
+#: telemetry logger is the one place a print legitimately lives (it is
+#: what everything else must call instead)
+_PRINT_EXEMPT_DIRS = {"cli", "telemetry"}
+
+
+def _print_checks(path: Path, tree: ast.Module) -> list:
+    """Ban bare ``print(`` in package code (ISSUE 2 satellite): on a
+    multi-process pod untagged prints interleave unattributably, and the
+    capture pipelines substring-match free text. Package diagnostics go
+    through ``ddlb_tpu.telemetry.log`` (rank-tagged, trace-mirrored);
+    scripts/ and tests/ are exempt (they are single-process drivers whose
+    stdout is the artifact)."""
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            out.append(
+                f"{path}:{node.lineno}: print: bare print() in package "
+                f"code — use ddlb_tpu.telemetry.log (rank-tagged, "
+                f"machine-parseable)"
+            )
+    return out
+
+
 def _docstring_checks(path: Path, tree: ast.Module) -> list:
     """pydocstyle-lite floor for the PACKAGE (not tests/scripts): every
     module needs a docstring, and every public class needs one UNLESS it
@@ -171,6 +200,8 @@ def check_file(path: Path) -> list:
     extra = _security_checks(path, tree)
     if path.parts[:1] == ("ddlb_tpu",) or "/ddlb_tpu/" in str(path):
         extra += _docstring_checks(path, tree)
+        if not (set(path.parts) & _PRINT_EXEMPT_DIRS):
+            extra += _print_checks(path, tree)
     if _has_star_import(tree):
         return extra
     bound = _module_bindings(tree)

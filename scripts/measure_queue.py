@@ -35,6 +35,11 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+# telemetry is deliberately JAX-free (like this driver: the TPU backend
+# must never initialize in the queue process) — spans around each row
+# attempt and parking decision make a capture window's trace attributable
+from ddlb_tpu import telemetry  # noqa: E402
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATE_PATH = os.path.join(REPO, "hwlogs", "queue_state.json")
 COMPILE_CACHE_DEFAULT = os.path.join(REPO, "hwlogs", "compile_cache")
@@ -747,6 +752,10 @@ def main(argv=None, run_fn=None) -> int:
         if rec.get("attempts", 0) >= MAX_ATTEMPTS:
             print(f"[queue] parked after {rec['attempts']} failed attempts: "
                   f"{entry['label']}", flush=True)
+            telemetry.instant(
+                "queue.parked", cat="queue", label=entry["label"],
+                attempts=rec["attempts"],
+            )
             skipped += 1
             continue
         if limit is not None and ran >= limit:
@@ -754,25 +763,34 @@ def main(argv=None, run_fn=None) -> int:
         if entry.get("note"):
             print(entry["note"], flush=True)
         ran += 1
+        attempt = rec.get("attempts", 0) + 1
         if entry["kind"] == "action":
-            try:
-                ok = _run_action(entry)
-            except Exception as exc:
-                print(f"[queue] action {entry['action']} crashed: "
-                      f"{type(exc).__name__}: {exc}", flush=True)
-                ok = False
+            with telemetry.span(
+                "queue.action", cat="queue", section=entry["section"],
+                label=entry["label"], attempt=attempt,
+            ):
+                try:
+                    ok = _run_action(entry)
+                except Exception as exc:
+                    print(f"[queue] action {entry['action']} crashed: "
+                          f"{type(exc).__name__}: {exc}", flush=True)
+                    ok = False
             if entry["action"] == "kernel_parity" and not ok:
                 parity_ok = False
             rec = {
-                "attempts": rec.get("attempts", 0) + 1,
+                "attempts": attempt,
                 "done": ok,
                 "label": entry["label"],
             }
         else:
-            row = _run_row(entry, base_proto, run_fn)
+            with telemetry.span(
+                "queue.row", cat="queue", section=entry["section"],
+                label=entry["label"], attempt=attempt,
+            ):
+                row = _run_row(entry, base_proto, run_fn)
             ok = not row.get("error")
             rec = {
-                "attempts": rec.get("attempts", 0) + 1,
+                "attempts": attempt,
                 "done": ok,
                 "label": entry["label"],
                 "error": str(row.get("error") or ""),
@@ -788,6 +806,11 @@ def main(argv=None, run_fn=None) -> int:
         f"(state: {state_path})",
         flush=True,
     )
+    # per-row children wrote their own shards (DDLB_TPU_TRACE propagates
+    # through the environment); join them into the loadable trace.json
+    merged = telemetry.merge_trace()
+    if merged:
+        print(f"[queue] trace merged: {merged}", flush=True)
     # nonzero on ANY failed row this pass, not just parity: the watcher
     # gates its CAPTURED sentinel on rc==0, so a clean-exit-with-errors
     # would end the capture before the retry-then-park policy ever ran.

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Aggregate a DDLB_TPU_TRACE directory into a run report.
+
+The span tracer (ddlb_tpu/telemetry) writes per-process Chrome
+trace_event shards; this script merges them (producing the
+Perfetto-loadable ``trace.json`` if the runner did not already) and
+answers the attribution questions ISSUE 2 exists for:
+
+- **per-phase breakdown** — where a sweep's wall-clock went, by span
+  category (compile / timing / barrier / validate / setup / warmup /
+  serve / queue / csv). Categories overlap by nesting (a barrier inside
+  the timing loop counts in both), so rows are independent totals, not
+  a partition;
+- **top spans** — the individual spans that ate the clock, aggregated
+  by name (count, total, max);
+- **prefetch overlap efficiency** — how much of the compile-ahead
+  engine's background compile time (``compile_ahead.prefetch`` spans)
+  actually hid under measurement (``timing``-category spans) instead of
+  extending the critical path — the T3-style overlap ratio PR 1 had no
+  way to measure;
+- optional **xprof join** (``--xprof <profile_dir>``): the
+  scripts/xprof_summary.py top-op table appended to the same report, so
+  one committed artifact carries host-side phases AND device-side ops.
+
+Usage: python scripts/trace_report.py <trace_dir> [--top N] [--json]
+           [--xprof PROFILE_DIR]
+
+Zero-dependency (stdlib only; the xprof join needs TF and degrades to
+an actionable message without it — see xprof_summary.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from ddlb_tpu.telemetry import trace as ttrace  # noqa: E402
+
+
+def _complete_spans(events):
+    return [
+        e for e in events
+        if e.get("ph") == "X"
+        and isinstance(e.get("dur"), (int, float))
+        and isinstance(e.get("ts"), (int, float))
+    ]
+
+
+def phase_breakdown(events):
+    """{category: {"total_ms", "count"}} over complete spans, plus the
+    wall-clock extent of the whole trace."""
+    spans = _complete_spans(events)
+    phases = {}
+    for e in spans:
+        cat = e.get("cat") or "uncategorized"
+        rec = phases.setdefault(cat, {"total_ms": 0.0, "count": 0})
+        rec["total_ms"] += e["dur"] / 1e3
+        rec["count"] += 1
+    wall_ms = 0.0
+    if spans:
+        t0 = min(e["ts"] for e in spans)
+        t1 = max(e["ts"] + e["dur"] for e in spans)
+        wall_ms = (t1 - t0) / 1e3
+    return phases, wall_ms
+
+
+def top_spans(events, top_n=10):
+    """[(name, count, total_ms, max_ms)] sorted by total duration."""
+    agg = {}
+    for e in _complete_spans(events):
+        rec = agg.setdefault(e.get("name", "?"), [0, 0.0, 0.0])
+        rec[0] += 1
+        rec[1] += e["dur"] / 1e3
+        rec[2] = max(rec[2], e["dur"] / 1e3)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])[:top_n]
+    return [(name, c, t, m) for name, (c, t, m) in rows]
+
+
+def _interval_overlap(a, bs):
+    """Length of interval ``a`` covered by the union of intervals ``bs``."""
+    a0, a1 = a
+    clipped = sorted(
+        (max(a0, b0), min(a1, b1)) for b0, b1 in bs if b1 > a0 and b0 < a1
+    )
+    covered = 0.0
+    cursor = a0
+    for b0, b1 in clipped:
+        b0 = max(b0, cursor)
+        if b1 > b0:
+            covered += b1 - b0
+            cursor = b1
+    return covered
+
+
+def prefetch_overlap(events):
+    """(prefetch_total_ms, overlapped_ms, ratio | None).
+
+    A prefetch span is 'hidden' where it runs concurrently with a
+    timing-category span (the measured loop owns the device, the
+    compile thread owns the host) — the overlap ratio is the fraction
+    of background compile time that cost no sweep wall-clock.
+    """
+    spans = _complete_spans(events)
+    prefetch = [
+        (e["ts"], e["ts"] + e["dur"])
+        for e in spans
+        if e.get("name") == "compile_ahead.prefetch"
+    ]
+    timing = [
+        (e["ts"], e["ts"] + e["dur"])
+        for e in spans
+        if e.get("cat") == "timing"
+    ]
+    if not prefetch:
+        return None
+    total = sum(b - a for a, b in prefetch) / 1e3
+    overlapped = sum(_interval_overlap(p, timing) for p in prefetch) / 1e3
+    ratio = overlapped / total if total > 0 else 0.0
+    return {"prefetch_ms": total, "overlapped_ms": overlapped,
+            "ratio": ratio}
+
+
+def build_report(trace_dir, top_n=10, xprof_dir=None):
+    """The full report as one JSON-able dict."""
+    merged = ttrace.merge_trace(trace_dir)
+    events = ttrace.read_events(trace_dir)
+    phases, wall_ms = phase_breakdown(events)
+    report = {
+        "trace_dir": os.path.abspath(trace_dir),
+        "merged_trace": merged,
+        "events": len(events),
+        "processes": len({e.get("pid") for e in events}),
+        "wall_ms": wall_ms,
+        "phases": phases,
+        "top_spans": [
+            {"name": n, "count": c, "total_ms": t, "max_ms": m}
+            for n, c, t, m in top_spans(events, top_n)
+        ],
+        "prefetch_overlap": prefetch_overlap(events),
+    }
+    if xprof_dir:
+        report["xprof"] = _xprof_join(xprof_dir, top_n)
+    return report
+
+
+def _xprof_join(profile_dir, top_n):
+    """xprof_summary's top-op table, or its actionable error."""
+    try:
+        import xprof_summary
+
+        line, rows = xprof_summary.top_ops(profile_dir, top_n)
+        if line is None:
+            return {"error": f"no device-plane events under {profile_dir}"}
+        return {
+            "line": line,
+            "ops": [
+                {"name": name, "total_ms": ms, "fraction": frac}
+                for name, ms, frac in rows
+            ],
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def print_report(report):
+    print(f"trace report — {report['trace_dir']}")
+    print(
+        f"  {report['events']} events from {report['processes']} "
+        f"process(es); wall {report['wall_ms']:.1f} ms"
+    )
+    if report.get("merged_trace"):
+        print(f"  merged Chrome trace: {report['merged_trace']} "
+              f"(load in Perfetto / chrome://tracing)")
+    print("\nper-phase breakdown (categories overlap by nesting):")
+    phases = report["phases"]
+    wall = report["wall_ms"] or float("inf")
+    for cat, rec in sorted(phases.items(), key=lambda kv: -kv[1]["total_ms"]):
+        print(
+            f"  {cat:14s} {rec['total_ms']:10.1f} ms  "
+            f"{rec['total_ms'] / wall:6.1%} of wall  x{rec['count']}"
+        )
+    print("\ntop spans by total time:")
+    for row in report["top_spans"]:
+        print(
+            f"  {row['total_ms']:10.1f} ms  x{row['count']:<4d} "
+            f"max {row['max_ms']:8.1f} ms  {row['name']}"
+        )
+    ov = report.get("prefetch_overlap")
+    if ov:
+        print(
+            f"\ncompile-ahead prefetch overlap: {ov['overlapped_ms']:.1f} / "
+            f"{ov['prefetch_ms']:.1f} ms hidden under measurement "
+            f"({ov['ratio']:.1%} efficient)"
+        )
+    xp = report.get("xprof")
+    if xp:
+        print("\nxprof top ops:")
+        if "error" in xp:
+            print(f"  unavailable: {xp['error']}")
+        else:
+            print(f"  line: {xp['line']}")
+            for op in xp["ops"]:
+                print(
+                    f"  {op['fraction']:6.1%}  {op['total_ms']:10.3f} ms  "
+                    f"{op['name'][:80]}"
+                )
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+
+    def _opt(flag, default=None):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                raise SystemExit(f"trace_report: {flag} needs a value")
+            value = argv[i + 1]
+            del argv[i: i + 2]
+            return value
+        return default
+
+    top_n = int(_opt("--top", "10"))
+    xprof_dir = _opt("--xprof")
+    if not argv:
+        print(
+            "usage: trace_report.py <trace_dir> [--top N] [--json] "
+            "[--xprof PROFILE_DIR]"
+        )
+        return 2
+    trace_dir = argv[0]
+    if not os.path.isdir(trace_dir):
+        print(f"trace_report: no such directory: {trace_dir}")
+        return 2
+    report = build_report(trace_dir, top_n=top_n, xprof_dir=xprof_dir)
+    if not report["events"]:
+        print(
+            f"trace_report: no trace events under {trace_dir} — was the "
+            f"run started with DDLB_TPU_TRACE={trace_dir}?"
+        )
+        return 1
+    if as_json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print_report(report)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

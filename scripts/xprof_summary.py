@@ -15,18 +15,46 @@ busiest device/XLA plane lines, and aggregate event durations by op
 name. Events on an XLA op line are sequential (no nesting), so total
 time per name is self time to the fidelity this table needs.
 
-Usage: python scripts/xprof_summary.py <profile_dir> [top_n]
+The xplane proto ships with TensorFlow, which many benchmark hosts do
+not have: the import is guarded (``XplaneUnavailableError`` with an
+actionable message instead of a raw ImportError), and ``--json`` emits
+the table — or the error — as one machine-parseable JSON object for
+``scripts/trace_report.py`` and other tooling to join.
+
+Usage: python scripts/xprof_summary.py <profile_dir> [top_n] [--json]
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 import sys
 
 
+class XplaneUnavailableError(RuntimeError):
+    """The TF xplane protobuf package is not importable on this host."""
+
+
+def _import_xplane_pb2():
+    """The xplane_pb2 module, or an actionable XplaneUnavailableError —
+    never a raw ImportError deep inside a batch log."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+        return xplane_pb2
+    except ImportError as exc:
+        raise XplaneUnavailableError(
+            "parsing *.xplane.pb needs the TensorFlow profiler protobuf "
+            "(tensorflow.tsl.profiler.protobuf.xplane_pb2), which this "
+            "machine does not have. Install a CPU-only TF wheel "
+            "(pip install tensorflow-cpu) on an analysis host and re-run "
+            "there — the profile dir is plain files and copies freely. "
+            f"Original error: {exc}"
+        ) from exc
+
+
 def _planes(path):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    xplane_pb2 = _import_xplane_pb2()
 
     files = sorted(
         glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True)
@@ -74,20 +102,40 @@ def top_ops(profile_dir: str, top_n: int = 15):
 
 
 def main(argv) -> int:
-    if len(argv) < 2:
-        print("usage: xprof_summary.py <profile_dir> [top_n]")
+    args = [a for a in argv[1:] if a != "--json"]
+    as_json = "--json" in argv[1:]
+    if not args:
+        print("usage: xprof_summary.py <profile_dir> [top_n] [--json]")
         return 2
-    profile_dir = argv[1]
-    top_n = int(argv[2]) if len(argv) > 2 else 15
+    profile_dir = args[0]
+    top_n = int(args[1]) if len(args) > 1 else 15
     try:
         line_name, rows = top_ops(profile_dir, top_n)
     except Exception as exc:  # missing TF proto, corrupt trace, ...
-        print(f"xprof_summary: cannot parse {profile_dir}: "
-              f"{type(exc).__name__}: {exc}")
+        msg = (f"xprof_summary: cannot parse {profile_dir}: "
+               f"{type(exc).__name__}: {exc}")
+        if as_json:
+            print(json.dumps({"error": msg, "profile_dir": profile_dir}))
+        else:
+            print(msg)
         return 1
     if line_name is None:
-        print(f"xprof_summary: no device-plane events under {profile_dir}")
+        msg = f"xprof_summary: no device-plane events under {profile_dir}"
+        if as_json:
+            print(json.dumps({"error": msg, "profile_dir": profile_dir}))
+        else:
+            print(msg)
         return 1
+    if as_json:
+        print(json.dumps({
+            "profile_dir": profile_dir,
+            "line": line_name,
+            "ops": [
+                {"name": name, "total_ms": ms, "fraction": frac}
+                for name, ms, frac in rows
+            ],
+        }))
+        return 0
     print(f"xprof top ops — {line_name}")
     for name, ms, frac in rows:
         print(f"  {frac:6.1%}  {ms:10.3f} ms  {name[:90]}")

@@ -1,0 +1,348 @@
+"""Telemetry subsystem: span nesting/ordering, shard merge across
+subprocesses, trace-file schema, metrics registry, and the metric
+columns in runner rows (ISSUE 2 acceptance surface)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ddlb_tpu import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+REQUIRED_ROW_KEYS = (
+    "barrier_wait_s",
+    "hbm_high_water_bytes",
+    "loop_overhead_s",
+    "collective_bytes",
+)
+
+
+@pytest.fixture
+def trace_dir(tmp_path, monkeypatch):
+    """Point DDLB_TPU_TRACE at a fresh dir for the duration of a test.
+
+    The tracer singleton keys on (dir, pid), so a new tmp dir per test
+    guarantees a fresh shard without touching telemetry internals.
+    """
+    d = tmp_path / "trace"
+    monkeypatch.setenv("DDLB_TPU_TRACE", str(d))
+    return d
+
+
+def _span_events(directory):
+    return [
+        e for e in telemetry.read_events(str(directory)) if e.get("ph") == "X"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def test_span_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("DDLB_TPU_TRACE", raising=False)
+    with telemetry.span("nothing", cat="x"):
+        assert telemetry.current_depth() == 0  # no stack when disabled
+    assert telemetry.get_tracer() is None
+    assert telemetry.merge_trace() is None
+
+
+def test_span_nesting_and_ordering(trace_dir):
+    with telemetry.span("outer", cat="a", tag="o"):
+        assert telemetry.current_depth() == 1
+        with telemetry.span("inner", cat="b"):
+            assert telemetry.current_depth() == 2
+    assert telemetry.current_depth() == 0
+
+    events = _span_events(trace_dir)
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    # nesting depth recorded; inner closed first (JSONL order), and its
+    # [ts, ts+dur] interval is contained in outer's
+    assert outer["args"]["depth"] == 0
+    assert inner["args"]["depth"] == 1
+    assert events.index(inner) < events.index(outer)
+    assert inner["ts"] >= outer["ts"] - 1.0  # µs clock granularity slack
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert outer["args"]["tag"] == "o"
+
+
+def test_trace_schema(trace_dir):
+    with telemetry.span("s", cat="phase", extra=1):
+        pass
+    telemetry.instant("marker", note="hi")
+    telemetry.completed_event("late", 0.25, cat="compile")
+    events = telemetry.read_events(str(trace_dir))
+    assert events, "no events written"
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        assert isinstance(e.get("args", {}), dict)
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float)) and e["ts"] > 0
+            assert e["args"]["rank"] == 0
+            assert e["args"]["host"]
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    late = [e for e in events if e["name"] == "late"][0]
+    assert late["dur"] == pytest.approx(0.25e6)
+    # rank-tagged process metadata for the merged multi-process view
+    meta = [e for e in events if e["ph"] == "M"]
+    assert any(m["args"]["name"].startswith("p0@") for m in meta)
+
+
+def test_subprocess_shard_merge(trace_dir):
+    """isolation='subprocess' contract: children write their own shards;
+    the parent merges every shard into one Chrome trace.json."""
+    with telemetry.span("parent_span", cat="row"):
+        pass
+    child = (
+        "import os\n"
+        "from ddlb_tpu import telemetry\n"
+        "with telemetry.span('child_span', cat='row'):\n"
+        "    pass\n"
+    )
+    env = dict(os.environ, DDLB_TPU_TRACE=str(trace_dir))
+    out = subprocess.run(
+        [sys.executable, "-c", child], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    shards = list(trace_dir.glob("trace-*.jsonl"))
+    assert len(shards) == 2, [s.name for s in shards]
+
+    merged = telemetry.merge_trace(str(trace_dir))
+    assert merged and os.path.basename(merged) == "trace.json"
+    with open(merged) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"parent_span", "child_span"} <= names
+    pids = {
+        e["pid"] for e in doc["traceEvents"]
+        if e["name"] in ("parent_span", "child_span")
+    }
+    assert len(pids) == 2  # genuinely two processes on one timeline
+
+
+def test_unwritable_trace_dir_disables_tracing(tmp_path, monkeypatch, capsys):
+    """Telemetry must never abort the sweep it observes: an unwritable
+    DDLB_TPU_TRACE degrades to one warning + tracing off, not an OSError
+    escaping from span exits."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where the trace dir should be")
+    monkeypatch.setenv("DDLB_TPU_TRACE", str(blocker / "sub"))
+    with telemetry.span("survives", cat="x"):
+        pass
+    telemetry.log("still logs fine")
+    assert telemetry.get_tracer() is None
+    out = capsys.readouterr().out
+    assert "tracing disabled" in out
+    # warned once, not per span
+    assert out.count("tracing disabled") == 1
+    assert "still logs fine" in out
+
+
+def test_corrupt_shard_lines_are_skipped(trace_dir):
+    with telemetry.span("good", cat="x"):
+        pass
+    shard = next(trace_dir.glob("trace-*.jsonl"))
+    with open(shard, "a") as f:
+        f.write("{truncated-by-a-kill\n")
+    events = telemetry.read_events(str(trace_dir))
+    assert any(e["name"] == "good" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_scope_counters_and_gauges():
+    with telemetry.metrics_scope() as outer:
+        telemetry.record("c", 1.0)
+        with telemetry.metrics_scope() as inner:
+            telemetry.record("c", 2.0)
+            telemetry.record_max("g", 5.0)
+            telemetry.record_max("g", 3.0)  # lower: gauge keeps the max
+        telemetry.record("c", 0.5)
+    assert inner.snapshot() == {"c": 2.0, "g": 5.0}
+    assert outer.snapshot()["c"] == pytest.approx(3.5)  # nesting sums up
+    assert outer.snapshot()["g"] == 5.0
+
+
+def test_metrics_row_fields_defaults_and_types():
+    with telemetry.metrics_scope() as scope:
+        telemetry.record("barrier_wait_s", 0.125)
+        telemetry.record_max("hbm_high_water_bytes", 12345.0)
+    fields = scope.row_fields()
+    assert set(fields) == set(telemetry.ROW_METRIC_DEFAULTS)
+    assert fields["barrier_wait_s"] == pytest.approx(0.125)
+    assert fields["hbm_high_water_bytes"] == 12345
+    assert isinstance(fields["hbm_high_water_bytes"], int)
+    assert fields["loop_overhead_s"] == 0.0  # never recorded -> default
+
+
+def test_metrics_global_registry_receives_all_threads():
+    import threading
+
+    telemetry.record("global_probe", 1.0)
+
+    def _bg():
+        telemetry.record("global_probe", 2.0)
+
+    t = threading.Thread(target=_bg)
+    t.start()
+    t.join()
+    assert telemetry.global_snapshot()["global_probe"] >= 3.0
+
+
+# ---------------------------------------------------------------------------
+# runner rows carry the metric columns (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _worker_config(**over):
+    cfg = {
+        "primitive": "tp_columnwise",
+        "impl_id": "compute_only_0",
+        "base_implementation": "compute_only",
+        "options": {"size": "unsharded"},
+        "m": 64, "n": 64, "k": 64,
+        "num_iterations": 2,
+        "num_warmups": 1,
+        "validate": False,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_runner_rows_carry_metric_columns():
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(_worker_config())
+    for key in REQUIRED_ROW_KEYS:
+        assert key in row, f"row missing {key}"
+    assert row["barrier_wait_s"] >= 0.0
+    assert row["error"] == ""
+
+
+def test_device_loop_rows_record_loop_overhead():
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(_worker_config(
+        time_measurement_backend="device_loop",
+        num_iterations=4,
+        device_loop_windows=2,
+        device_loop_min_window_ms=0.0,
+    ))
+    assert row["error"] == ""
+    assert np.isfinite(row["loop_overhead_s"])
+    assert row["loop_overhead_s"] >= 0.0
+
+
+def test_error_rows_carry_metric_columns_too():
+    """The CSV header is fixed by the first row written: crashed rows
+    must carry the same metric columns (at defaults)."""
+    from ddlb_tpu.benchmark import make_result_row
+
+    row = make_result_row(
+        _worker_config(),
+        times_ms=np.array([float("nan")]),
+        flop_count=float("nan"),
+        option_repr="-",
+        valid=False,
+        error="WorkerDied: test",
+        world_size=-1,
+        num_processes=1,
+        platform="unknown",
+    )
+    for key in REQUIRED_ROW_KEYS:
+        assert row[key] == telemetry.ROW_METRIC_DEFAULTS[key]
+
+
+def test_collective_rows_record_wire_bytes():
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(_worker_config(
+        primitive="collectives",
+        impl_id="jax_spmd_0",
+        base_implementation="jax_spmd",
+        options={"op": "all_gather"},
+        m=64, n=8, k=64,
+    ))
+    if row["error"]:
+        pytest.skip(f"collective impl unavailable here: {row['error']}")
+    assert row["collective_bytes"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# worker spans land in the trace with the phase categories the report
+# aggregates (compile / timing / barrier / validate)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_emits_phase_spans(trace_dir):
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(_worker_config(validate=True))
+    assert row["error"] == ""
+    cats = {e.get("cat") for e in _span_events(trace_dir)}
+    for needed in ("setup", "warmup", "timing", "barrier", "validate", "row"):
+        assert needed in cats, f"missing phase category {needed} in {cats}"
+
+
+# ---------------------------------------------------------------------------
+# structured logger
+# ---------------------------------------------------------------------------
+
+
+def test_log_is_rank_tagged_and_forwardable(capsys):
+    telemetry.log("hello world", key="v")
+    out = capsys.readouterr().out
+    # hw_common._forward_diagnostics surfaces child lines by this exact
+    # prefix — the rank tag must not break it
+    assert out.startswith("[ddlb_tpu]")
+    assert "[p0]" in out
+    assert "hello world" in out and "key=v" in out
+
+
+def test_log_multiline_prefixes_every_line(capsys):
+    telemetry.log("line1\nline2")
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    assert all(ln.startswith("[ddlb_tpu][p0]") for ln in lines)
+
+
+def test_warn_level_prefix(capsys):
+    telemetry.warn("something odd")
+    assert "WARNING: something odd" in capsys.readouterr().out
+
+
+def test_log_mirrors_into_trace(trace_dir, capsys):
+    telemetry.log("traced line", field=3)
+    events = telemetry.read_events(str(trace_dir))
+    logs = [e for e in events if e.get("cat") == "log"]
+    assert logs and logs[-1]["args"]["message"] == "traced line"
+
+
+def test_log_reserved_field_names_do_not_crash(trace_dir, capsys):
+    """Caller-chosen field names colliding with the trace event's own
+    keys must never turn a diagnostic into a TypeError."""
+    telemetry.log("collide", name="x", cat="y", message="z", level="w")
+    out = capsys.readouterr().out
+    assert "collide" in out and "name=x" in out
+    logs = [
+        e for e in telemetry.read_events(str(trace_dir))
+        if e.get("cat") == "log"
+    ]
+    assert logs[-1]["args"]["field_name"] == "x"
+    assert logs[-1]["args"]["message"] == "collide"
