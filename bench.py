@@ -59,8 +59,20 @@ import subprocess
 import sys
 import time
 
+#: fallback peaks when the spec registry is unavailable; the worker
+#: prefers the runtime-detected entry from ddlb_tpu.perfmodel.specs so a
+#: v4/v5p/v6e capture gets the right denominator automatically. Values
+#: MUST equal the registry's v5e entry — two sources for one chip would
+#: let identical captures drift depending on which import path won.
 V5E_PEAK_BF16_TFLOPS = 197.0
-V5E_PEAK_INT8_TOPS = 394.5
+V5E_PEAK_INT8_TOPS = 394.0
+
+#: roofline-fraction regression gate: a fresh TPU headline whose
+#: roofline_frac drops more than this RELATIVE fraction below the most
+#: recent cached capture is flagged (stderr + "roofline_regression" in
+#: the artifact; never a non-zero exit — the bench contract). Override
+#: via DDLB_TPU_BENCH_ROOFLINE_TOL.
+ROOFLINE_REGRESSION_TOL = 0.15
 
 #: the pinned measurement protocol (BASELINE.md methodology) — one source
 #: for the headline race AND the int8 sidecar, so the two stay comparable
@@ -278,6 +290,9 @@ def _main_guarded() -> None:
         row, reason = _run_worker(env, worker_timeout)
         if row is not None:
             if row.get("platform") == "tpu" and row.get("valid"):
+                # the roofline gate reads the PREVIOUS capture, so it
+                # must run before this row lands in the cache
+                _check_roofline_regression(row)
                 _save_tpu_cache(row)
             print(json.dumps(row), flush=True)
             return
@@ -384,6 +399,40 @@ def _main_guarded() -> None:
     )
 
 
+def _check_roofline_regression(row: dict) -> None:
+    """The roofline_frac regression gate (the perfmodel's analogue of the
+    cache staleness guard): a fresh capture whose achieved fraction of
+    the analytical lower bound fell more than the relative tolerance
+    below the most recent comparable capture gets flagged in the
+    artifact — latency alone can look fine while a chip downgrade or a
+    scheduling regression eats the roofline margin. Soft by contract
+    (annotate, warn, exit 0)."""
+    frac = row.get("roofline_frac")
+    if not isinstance(frac, (int, float)) or not math.isfinite(frac):
+        return
+    prev = [
+        e
+        for e in _load_tpu_cache()
+        if e.get("metric") == row.get("metric")
+        and e.get("world_size") == row.get("world_size")
+        and isinstance(e.get("roofline_frac"), (int, float))
+        and math.isfinite(e["roofline_frac"])
+    ]
+    if not prev:
+        return
+    last = float(prev[-1]["roofline_frac"])
+    tol = _env_float("DDLB_TPU_BENCH_ROOFLINE_TOL", ROOFLINE_REGRESSION_TOL)
+    if frac < last * (1.0 - tol):
+        row["roofline_regression"] = True
+        row["roofline_frac_prev"] = last
+        print(
+            f"[bench] ROOFLINE REGRESSION: roofline_frac {frac:.4f} is "
+            f">{tol:.0%} below the previous capture's {last:.4f} "
+            f"(captured {prev[-1].get('captured_at')})",
+            file=sys.stderr,
+        )
+
+
 # ---------------------------------------------------------------------------
 # Worker: the actual measurement (runs in its own process under a timeout)
 # ---------------------------------------------------------------------------
@@ -422,7 +471,7 @@ def _device_oracle_err(impl) -> float:
     return float(_max_err(result, a, b))
 
 
-def _bench_int8_extra(m, n, k, n_dev):
+def _bench_int8_extra(m, n, k, n_dev, peak_int8_tops=V5E_PEAK_INT8_TOPS):
     """Measure the int8 quantized member and device-validate it.
 
     Returns extra JSON fields for the headline line (the int8 MXU path is
@@ -461,7 +510,7 @@ def _bench_int8_extra(m, n, k, n_dev):
     valid = bool(np.isfinite(err)) and err <= quantization_atol(k)
     return {
         "int8_tops": round(tops, 2),
-        "int8_vs_peak": round(tops / (V5E_PEAK_INT8_TOPS * n_dev), 4),
+        "int8_vs_peak": round(tops / (peak_int8_tops * n_dev), 4),
         "int8_valid": valid,
     }
 
@@ -513,6 +562,24 @@ def _bench_validate(base_impl, options, m, n, k) -> bool:
     return ok
 
 
+def _chip_peaks(runtime):
+    """(bf16 TFLOP/s, int8 TOP/s) per chip from the perfmodel spec
+    registry (runtime-detected, DDLB_TPU_CHIP-overridable), with the
+    pinned v5e constants as the fallback so a registry problem can never
+    take down the headline."""
+    try:
+        spec = runtime.chip_spec
+        # peak_flops applies the registry's own dtype fallback rules
+        # (e.g. v4 has no int8 MXU mode: int8 runs at the bf16 rate) —
+        # never substitute another chip's constant for a missing entry
+        return (
+            spec.peak_tflops["bfloat16"],
+            spec.peak_flops("int8") / 1e12,
+        )
+    except Exception:
+        return V5E_PEAK_BF16_TFLOPS, V5E_PEAK_INT8_TOPS
+
+
 def worker_main() -> None:
     # Runtime applies DDLB_TPU_SIM_DEVICES before the first backend query
     # (a bare jax.devices() would lock in the hardware platform first)
@@ -521,6 +588,7 @@ def worker_main() -> None:
     runtime = Runtime()
     n_dev = runtime.num_devices
     platform = runtime.platform
+    peak_bf16_tflops, peak_int8_tops = _chip_peaks(runtime)
     from ddlb_tpu.benchmark import benchmark_worker
 
     shape = os.environ.get("DDLB_TPU_BENCH_SHAPE", DEFAULT_SHAPE)
@@ -602,7 +670,7 @@ def worker_main() -> None:
     # cpu platform (sim) report 0.0 so the driver never records a bogus
     # "MXU fraction" from a host GEMM
     vs_baseline = (
-        round(tflops / (V5E_PEAK_BF16_TFLOPS * n_dev), 4)
+        round(tflops / (peak_bf16_tflops * n_dev), 4)
         if row["platform"] == "tpu"
         else 0.0
     )
@@ -619,6 +687,15 @@ def worker_main() -> None:
         "implementation": row["implementation"],
         "valid": valid,
     }
+    # the analytical-perfmodel verdict rides the artifact so the parent's
+    # regression gate (and the driver's history) can track the achieved
+    # fraction of the predicted lower bound next to raw latency; only
+    # finite values land (the artifact line must stay strict-JSON clean)
+    frac = row.get("roofline_frac")
+    if isinstance(frac, float) and math.isfinite(frac):
+        headline["roofline_frac"] = round(frac, 4)
+        headline["bound"] = row.get("bound", "")
+        headline["chip"] = row.get("chip", "")
     # The validated primary line goes out FIRST — the parent parses the
     # LAST metric line, so if the sidecar below dies non-pythonically
     # (device halt, OOM kill) the already-measured headline survives.
@@ -631,7 +708,7 @@ def worker_main() -> None:
         "DDLB_TPU_BENCH_SKIP_INT8"
     ):
         try:
-            extra = _bench_int8_extra(m, n, k, n_dev)
+            extra = _bench_int8_extra(m, n, k, n_dev, peak_int8_tops)
         except Exception as exc:
             print(f"[bench] int8 sidecar errored: {type(exc).__name__}: {exc}")
             extra = {}

@@ -56,6 +56,43 @@ from ddlb_tpu.utils.timing import fence, measure_device_loop
 
 TIMING_BACKENDS = ("host_clock", "device_loop")
 
+#: the analytical-perfmodel columns every row carries (measured, crashed
+#: and timed-out alike — the CSV header is fixed by the first row
+#: written): the predicted lower bound, the achieved fraction of it, the
+#: dominating roofline term, and the spec the prediction was made
+#: against. Defaults fill rows whose worker died before an impl existed.
+PERF_ROW_DEFAULTS: Dict[str, Any] = {
+    "predicted_s": float("nan"),
+    "roofline_frac": float("nan"),
+    "bound": "",
+    "chip": "",
+}
+
+
+def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
+    """The perfmodel columns for one row: the impl's ``cost_model()``
+    verdict plus ``roofline_frac`` against the measured MEDIAN (the
+    jitter-robust statistic the headline bench also pins). A model
+    failure must never discard a completed measurement — it degrades to
+    the default columns with a warning."""
+    if impl is None:
+        return {}
+    try:
+        est = impl.cost_model()
+    except Exception as exc:
+        telemetry.warn(
+            f"perfmodel cost estimate failed: {type(exc).__name__}: {exc}"
+        )
+        return {}
+    finite = times_ms[np.isfinite(times_ms)]
+    measured_s = float(np.median(finite)) * 1e-3 if finite.size else float("nan")
+    return {
+        "predicted_s": est.predicted_s,
+        "roofline_frac": est.roofline_frac(measured_s),
+        "bound": est.bound,
+        "chip": est.chip,
+    }
+
 
 # ---------------------------------------------------------------------------
 # Worker: one implementation, one shape (reference _benchmark_worker_entry,
@@ -237,6 +274,11 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         compile_time_s=round(_cm.compile_time_s, 4),
         compile_cache_hit=_cm.cache_hit,
         metrics=_ms.row_fields(),
+        # the analytical lower bound rides EVERY row that constructed an
+        # impl — including error rows (the prediction is shape-only, so a
+        # timing/validation crash still gets predicted_s and bound; only
+        # roofline_frac needs the measurement and degrades to NaN)
+        perf=_perfmodel_fields(impl, times_ms),
     )
     if impl is not None and np.isfinite(times_ms).any():
         # family-specific measured quantities (speculate acceptance
@@ -288,6 +330,7 @@ def make_result_row(
     compile_time_s: float = float("nan"),
     compile_cache_hit: bool = False,
     metrics: Optional[Dict[str, Any]] = None,
+    perf: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """The one result-row schema, shared by measured, crashed and
     timed-out workers so the CSV columns cannot drift apart.
@@ -307,6 +350,9 @@ def make_result_row(
         metric_fields.update(
             {k: metrics[k] for k in metric_fields if k in metrics}
         )
+    perf_fields = dict(PERF_ROW_DEFAULTS)
+    if perf:
+        perf_fields.update({k: perf[k] for k in perf_fields if k in perf})
     tflops = flop_count / 1e9 / times_ms
     stats = robust_stats(times_ms)
     return {
@@ -353,6 +399,10 @@ def make_result_row(
         # went (barrier wait, device_loop dispatch slack, HBM high-water,
         # collective wire bytes) — ISSUE 2's measurement layer
         **metric_fields,
+        # the analytical-perfmodel columns (ISSUE 3): the predicted
+        # lower bound for this config, the fraction of it achieved, and
+        # the roofline term that dominates (compute/comm/hbm)
+        **perf_fields,
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)

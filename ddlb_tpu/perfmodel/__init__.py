@@ -1,0 +1,46 @@
+"""Analytical performance model: hardware specs and per-family cost models.
+
+The measured-roofline story (the ``compute_only`` members, the
+collectives family) answers "how fast did the hardware go"; this
+subsystem answers the other half of the ROADMAP's "fast as the hardware
+allows": **how fast could it have gone**. Two cooperating pieces, both
+zero-dependency at import time (stdlib only — importable from the
+JAX-free process tiers like ``bench.py``'s parent and ``scripts/lint.py``):
+
+- ``specs`` — the hardware registry: per-chip MXU peak FLOP/s by dtype,
+  HBM bandwidth/capacity, ICI/DCN link bandwidth, for TPU v4/v5e/v5p/v6e
+  plus a calibrated ``cpu-sim`` entry; auto-detected from the PJRT
+  ``device_kind`` with a ``DDLB_TPU_CHIP`` env override;
+- ``cost`` — closed-form per-primitive-family cost models (GEMM time
+  from ``flops()``/peak, collective time from ``wire_bytes()`` over the
+  bandwidth-optimal ring formula, decode time from the HBM byte census)
+  combined per implementation schedule into a predicted lower bound.
+
+Every benchmark row gains ``predicted_s`` / ``roofline_frac`` / ``bound``
+columns from this model (``benchmark.make_result_row``), ranked per
+family by ``scripts/perf_report.py`` and regression-gated by ``bench.py``.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.perfmodel.cost import (
+    FAMILY_COST_MODELS,
+    CostEstimate,
+    estimate,
+)
+from ddlb_tpu.perfmodel.specs import (
+    CHIP_SPECS,
+    ChipSpec,
+    detect_spec,
+    get_spec,
+)
+
+__all__ = [
+    "CHIP_SPECS",
+    "ChipSpec",
+    "CostEstimate",
+    "FAMILY_COST_MODELS",
+    "detect_spec",
+    "estimate",
+    "get_spec",
+]

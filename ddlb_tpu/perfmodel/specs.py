@@ -1,0 +1,226 @@
+"""Hardware spec registry: per-chip peaks, bandwidths, and detection.
+
+One ``ChipSpec`` per accelerator generation the framework targets — TPU
+v4 / v5e / v5p / v6e from Google's published per-chip numbers, plus a
+calibrated ``cpu-sim`` entry for the virtual-device test topology — so
+every analytical cost term (``perfmodel.cost``) and capacity gate
+(``utils/hbm_budget``) reads one source of truth instead of scattering
+hard-coded constants (the old ``bench.py`` ``V5E_PEAK_BF16_TFLOPS`` /
+``hbm_budget.V5E_HBM_BYTES`` pattern).
+
+Conventions (documented here once, relied on everywhere):
+
+- ``peak_tflops`` maps *operand dtype name* to MXU peak in TFLOP/s.
+  float32/float64 map to the 3-pass bf16x3 decomposition rate
+  (``bf16 / 3``) — deliberately optimistic (the framework's f32 contract
+  runs the 6-pass ``highest`` mode), so predictions stay lower bounds.
+  Integer dtypes map to the int8 peak where the chip has one.
+- ``ici_bw_gbs`` is the per-chip, per-direction bandwidth one 1-D ring
+  neighbor hop can use (one ICI link), in GB/s — the denominator of the
+  ring collective formulas. Multi-link torus routing can beat it; a
+  lower bound must not assume it.
+- ``dcn_bw_gbs`` is the per-chip share of the host NIC for cross-slice
+  traffic (the ``transport='dcn'`` mesh layout).
+- ``hbm_bw_gbs`` / ``hbm_gib`` are the published per-chip HBM numbers.
+- ``cpu-sim`` is calibrated *optimistic* (a host CPU cannot reach 1
+  TFLOP/s dense or 100 GB/s effective copy at benchmark shapes), so the
+  ``roofline_frac`` invariant ``(0, 1]`` holds on the simulated topology
+  too — the entry exists to keep the model's plumbing testable, not to
+  model a CPU accurately.
+
+Zero-dependency at import: JAX is only touched inside ``detect_spec``
+when no ``device_kind`` is supplied, so the JAX-free tiers (``bench.py``
+parent, ``scripts/lint.py``, ``utils/hbm_budget``) can import freely.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Mapping, Optional
+
+GB = 1e9
+GiB = float(1 << 30)
+
+#: env override: force a registry entry by name regardless of detection
+CHIP_ENV = "DDLB_TPU_CHIP"
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Published per-chip capability numbers (see module conventions)."""
+
+    name: str
+    peak_tflops: Mapping[str, float]  # dtype name -> TFLOP/s
+    hbm_gib: float
+    hbm_bw_gbs: float
+    ici_bw_gbs: float  # per-direction ring-neighbor link, GB/s
+    dcn_bw_gbs: float
+    aliases: tuple = field(default=())
+
+    # -- derived, in SI units the cost model consumes ------------------------
+
+    def peak_flops(self, dtype: str) -> float:
+        """MXU peak in FLOP/s for operands of ``dtype`` (see conventions:
+        f32/f64 at the bf16x3 rate, unknown dtypes at the bf16 rate)."""
+        table = self.peak_tflops
+        if dtype in table:
+            return table[dtype] * 1e12
+        if dtype in ("float32", "float64"):
+            return table["bfloat16"] / 3.0 * 1e12
+        if dtype in ("int8", "int32", "int64"):
+            return table.get("int8", table["bfloat16"]) * 1e12
+        return table["bfloat16"] * 1e12
+
+    @property
+    def hbm_bytes(self) -> float:
+        return self.hbm_gib * GiB
+
+    @property
+    def hbm_bw(self) -> float:
+        return self.hbm_bw_gbs * GB
+
+    def link_bw(self, transport: str = "ici") -> float:
+        """Ring-neighbor bandwidth in bytes/s for a transport layer."""
+        if transport == "dcn":
+            return self.dcn_bw_gbs * GB
+        return self.ici_bw_gbs * GB
+
+
+#: the registry. TPU numbers are Google's published per-chip figures
+#: (cloud.google.com/tpu/docs/system-architecture): bf16 peak, HBM
+#: capacity/BW; ICI per-link one-direction rates are total-ICI divided
+#: by link count (v4 2400 Gbps/6, v5e 1600/4, v5p 4800/6, v6e 3584/4).
+CHIP_SPECS: Dict[str, ChipSpec] = {
+    spec.name: spec
+    for spec in (
+        ChipSpec(
+            name="v4",
+            peak_tflops={"bfloat16": 275.0, "float16": 275.0},
+            hbm_gib=32.0,
+            hbm_bw_gbs=1228.0,
+            ici_bw_gbs=50.0,
+            dcn_bw_gbs=6.25,
+            aliases=("tpu v4", "tpu_v4"),
+        ),
+        ChipSpec(
+            name="v5e",
+            peak_tflops={
+                "bfloat16": 197.0,
+                "float16": 197.0,
+                "int8": 394.0,
+            },
+            hbm_gib=16.0,
+            hbm_bw_gbs=819.0,
+            ici_bw_gbs=50.0,
+            dcn_bw_gbs=6.25,
+            aliases=("v5 lite", "v5litepod", "tpu v5 lite", "tpu v5e"),
+        ),
+        ChipSpec(
+            name="v5p",
+            peak_tflops={
+                "bfloat16": 459.0,
+                "float16": 459.0,
+                "int8": 918.0,
+            },
+            hbm_gib=95.0,
+            hbm_bw_gbs=2765.0,
+            ici_bw_gbs=100.0,
+            dcn_bw_gbs=12.5,
+            aliases=("tpu v5p", "tpu v5"),
+        ),
+        ChipSpec(
+            name="v6e",
+            peak_tflops={
+                "bfloat16": 918.0,
+                "float16": 918.0,
+                "int8": 1836.0,
+            },
+            hbm_gib=32.0,
+            hbm_bw_gbs=1640.0,
+            ici_bw_gbs=112.0,
+            dcn_bw_gbs=12.5,
+            aliases=("v6 lite", "trillium", "tpu v6 lite", "tpu v6e"),
+        ),
+        # Calibrated virtual-device entry (see module conventions): all
+        # rates are strict over-estimates of a host CPU so predictions
+        # stay lower bounds on the 8-device test sim.
+        ChipSpec(
+            name="cpu-sim",
+            peak_tflops={
+                "bfloat16": 1.0,
+                "float16": 1.0,
+                "float32": 1.0,
+                "float64": 1.0,
+                "int8": 1.0,
+            },
+            hbm_gib=16.0,
+            hbm_bw_gbs=100.0,
+            ici_bw_gbs=100.0,
+            dcn_bw_gbs=10.0,
+            aliases=("cpu", "sim", "host"),
+        ),
+    )
+}
+
+_ALIASES = {
+    alias: spec.name
+    for spec in CHIP_SPECS.values()
+    for alias in (spec.name, *spec.aliases)
+}
+
+
+def get_spec(name: str) -> ChipSpec:
+    """Registry lookup by canonical name or alias (case-insensitive)."""
+    key = _ALIASES.get(str(name).strip().lower())
+    if key is None:
+        raise KeyError(
+            f"Unknown chip {name!r}. Registered: {sorted(CHIP_SPECS)}"
+        )
+    return CHIP_SPECS[key]
+
+
+def _from_device_kind(device_kind: str) -> Optional[ChipSpec]:
+    """Map a PJRT ``device_kind`` string to a registry entry.
+
+    Real strings look like ``"TPU v4"``, ``"TPU v5 lite"``, ``"TPU v5p"``,
+    ``"TPU v6 lite"``; matched longest-alias-first so ``"v5 lite"`` never
+    falls into ``"v5"``'s (v5p) bucket.
+    """
+    kind = str(device_kind or "").strip().lower()
+    if not kind:
+        return None
+    if kind in _ALIASES:
+        return CHIP_SPECS[_ALIASES[kind]]
+    for alias in sorted(_ALIASES, key=len, reverse=True):
+        if alias in kind:
+            return CHIP_SPECS[_ALIASES[alias]]
+    return None
+
+
+def detect_spec(
+    device_kind: Optional[str] = None, platform: Optional[str] = None
+) -> ChipSpec:
+    """The spec for the current environment.
+
+    Priority: the ``DDLB_TPU_CHIP`` env override (unknown names raise —
+    a silently-wrong denominator is worse than a crash); the supplied
+    PJRT ``device_kind``; a live ``jax.devices()[0].device_kind`` query
+    when neither is given (the only JAX touch in this module); the
+    ``cpu-sim`` entry for anything that is not a recognized TPU.
+    """
+    override = os.environ.get(CHIP_ENV, "")
+    if override:
+        return get_spec(override)
+    if device_kind is None and platform is None:
+        try:
+            import jax
+
+            dev = jax.devices()[0]
+            device_kind = getattr(dev, "device_kind", "")
+            platform = dev.platform
+        except Exception:
+            return CHIP_SPECS["cpu-sim"]
+    if platform is not None and platform != "tpu":
+        return CHIP_SPECS["cpu-sim"]
+    return _from_device_kind(device_kind or "") or CHIP_SPECS["cpu-sim"]

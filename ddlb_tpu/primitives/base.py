@@ -117,6 +117,25 @@ def validation_atol(dtype: str, k: int) -> float:
 class Primitive(ABC):
     """Base for all benchmarkable primitives."""
 
+    #: how the analytical cost model (perfmodel.cost) combines this
+    #: implementation's roofline terms: "sequential" (collective and
+    #: GEMM back to back — the default), "overlap" (comm/compute
+    #: pipelined: the max() lower bound — overlap/pallas/ring/pipeline
+    #: members), "compute_only" (no collective runs: comm term dropped)
+    COST_SCHEDULE: str = "sequential"
+
+    #: dtype the cost model prices the MXU term at; None = the operand
+    #: dtype. The quantized members override to "int8" — their GEMMs run
+    #: the 2x int8 roofline, so pricing them at the operand peak would
+    #: fake a perfect (clamped) roofline_frac. Wire dtype is separate:
+    #: family bases count operand-dtype bytes and quantized members that
+    #: genuinely move int8 override wire_bytes() themselves.
+    COST_DTYPE = None
+
+    def cost_dtype(self) -> str:
+        """The dtype whose MXU peak prices this impl's compute term."""
+        return self.COST_DTYPE or self.dtype
+
     #: option schema discovered reflectively by the runner
     #: (reference ddlb/benchmark.py:76-77, 107-110)
     DEFAULT_OPTIONS: Dict[str, Any] = {}
@@ -201,6 +220,18 @@ class Primitive(ABC):
         (reference TFLOPS formula 2*m*n*k, ddlb/benchmark.py:209-214;
         attention-family primitives override)."""
         return 2.0 * self.m * self.n * self.k
+
+    def cost_model(self):
+        """Analytical lower bound for this config against the detected
+        chip (``perfmodel.cost.CostEstimate``): the family's registered
+        model combined per ``COST_SCHEDULE``. The runner derives every
+        row's ``predicted_s`` / ``roofline_frac`` / ``bound`` columns
+        from this hook; families/implementations override the inputs
+        (``flops``, ``wire_bytes``, ``hbm_bytes``, ``COST_SCHEDULE``)
+        rather than the hook itself."""
+        from ddlb_tpu.perfmodel.cost import estimate
+
+        return estimate(self)
 
     def extra_row_fields(self) -> dict:
         """Family-specific measured quantities merged into the result
@@ -304,8 +335,16 @@ class ComputeOnlyKSharded:
     (reference compute_only, TPColumnwise/compute_only.py:8-55).
     """
 
+    #: no collective runs: the cost model drops the comm term, and the
+    #: family base's wire census must not be inherited (a compute_only
+    #: row reporting collective_bytes would claim traffic it never moves)
+    COST_SCHEDULE = "compute_only"
+
     DEFAULT_OPTIONS = {"size": "sharded"}
     ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
+
+    def wire_bytes(self) -> float:
+        return 0.0
 
     def _input_setup(self) -> None:
         import jax

@@ -52,6 +52,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive, jnp_dtype
 
 COLLECTIVE_OPS = (
@@ -107,11 +108,11 @@ class Collectives(Primitive):
 
     def wire_bytes(self) -> float:
         """Bytes one device sends over the interconnect under a ring
-        algorithm for this op (the busbw numerator)."""
+        algorithm for this op (the busbw numerator). Itemsize rule
+        (f64 -> 4: device arrays are f32 unless x64 is enabled) shared
+        with the perfmodel cost layer via ``wire_itemsize``."""
         d = self.num_partitions
-        isz = np.dtype(jnp_dtype(self.dtype)).itemsize
-        if self.dtype == "float64":
-            isz = 4  # device arrays are f32 unless x64 is enabled
+        isz = wire_itemsize(self.dtype)
         shard = (self.m // d) * self.k * isz
         if d == 1:
             return 0.0

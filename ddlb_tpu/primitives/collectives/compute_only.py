@@ -20,11 +20,17 @@ from __future__ import annotations
 
 import numpy as np
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.collectives.base import Collectives
 from ddlb_tpu.primitives.base import jnp_dtype
 
 
 class ComputeOnlyCollectives(Collectives):
+    #: no wire runs; the cost model prices the copy against the HBM
+    #: roofline instead (2x the payload: the copy engine reads and
+    #: writes it — perfmodel.cost._collective_cost)
+    COST_SCHEDULE = "compute_only"
+
     DEFAULT_OPTIONS = {"size": "sharded"}
     ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
 
@@ -52,15 +58,27 @@ class ComputeOnlyCollectives(Collectives):
         jax.block_until_ready(self.a)
 
     def wire_bytes(self) -> float:
-        isz = np.dtype(jnp_dtype(self.dtype)).itemsize
-        if self.dtype == "float64":
-            isz = 4
+        # no collective runs: like every compute_only member the wire
+        # census is zero (the collective_bytes telemetry column must not
+        # claim traffic a copy never moves); the payload lives in
+        # hbm_bytes(), where the copy roofline actually reads it
+        return 0.0
+
+    def hbm_bytes(self) -> float:
+        """Payload bytes of the measured copy — the numerator of this
+        member's GB/s Throughput convention AND the perfmodel's HBM-copy
+        floor (which charges 2x: the copy engine reads and writes it)."""
         rows = (
             self.m // self.num_partitions
             if self.options["size"] == "sharded"
             else self.m
         )
-        return float(rows * self.k * isz)
+        return float(rows * self.k * wire_itemsize(self.dtype))
+
+    def flops(self) -> float:
+        # the family's GB/s Throughput convention (1000 * payload bytes)
+        # keyed off the COPY payload, since this member's wire is zero
+        return 1000.0 * self.hbm_bytes()
 
     def validate(self, result) -> bool:
         import jax

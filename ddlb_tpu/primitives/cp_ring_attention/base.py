@@ -24,9 +24,12 @@ half is kept in the count like flash-attention convention reports it — the
 
 from __future__ import annotations
 
+import math
+
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive
 
 #: additive mask sentinel shared by every implementation (large-negative
@@ -121,6 +124,27 @@ class CPRingAttention(Primitive):
             pairs = w * self.m - w * (w - 1) / 2.0
             return 4.0 * pairs * self.n
         return 2.0 * self.m * self.m * self.n
+
+    def wire_bytes(self) -> float:
+        """Per-device ring bytes — each device forwards its local K and V
+        shards ``[m/d, h_kv, k]`` around the ring, one hop per step. Full
+        causal attention needs all ``d-1`` hops; a sliding window of
+        ``window`` positions only needs the hops whose chunks intersect
+        the band (``ceil(window / (m/d))``), which is exactly why the
+        ring members skip hops entirely behind it. GQA shrinks the
+        payload by ``kv_heads / num_heads``. compute_only overrides to
+        0; ulysses (head-sharded all-to-all) overrides with its own
+        census."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        chunk = self.m // d
+        hops = d - 1
+        w = self.options["window"]
+        if w and w < self.m:
+            hops = min(d - 1, math.ceil(w / chunk))
+        shard_kv = 2.0 * chunk * self.kv_heads * self.k
+        return shard_kv * wire_itemsize(self.dtype) * hops
 
     def _host_qkv(self):
         rng = np.random.default_rng(self.seed)

@@ -21,6 +21,13 @@ from ddlb_tpu.primitives.cp_ring_attention.base import (
 
 
 class ComputeOnlyCPRingAttention(CPRingAttention):
+    #: no collective runs: the perfmodel drops the comm term (and the
+    #: family wire census must not be inherited — see primitives/base.py)
+    COST_SCHEDULE = "compute_only"
+
+    def wire_bytes(self) -> float:
+        return 0.0
+
     DEFAULT_OPTIONS = {"size": "sharded"}
     ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
 

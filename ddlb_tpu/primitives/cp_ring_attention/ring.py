@@ -23,6 +23,10 @@ from ddlb_tpu.primitives.cp_ring_attention.base import (
 
 
 class RingCPRingAttention(CPRingAttention):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {"skip_masked_blocks": True}
     ALLOWED_VALUES = {"skip_masked_blocks": [True, False]}
 

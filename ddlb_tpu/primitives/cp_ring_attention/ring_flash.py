@@ -30,6 +30,10 @@ from ddlb_tpu.primitives.cp_ring_attention.base import CPRingAttention
 
 
 class RingFlashCPRingAttention(CPRingAttention):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "block_q": 1024,
         "block_kv": 1024,

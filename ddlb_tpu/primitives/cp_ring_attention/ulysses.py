@@ -36,6 +36,19 @@ class UlyssesCPRingAttention(CPRingAttention):
         "block_kv": (8, None),
     }
 
+    def wire_bytes(self) -> float:
+        """Ulysses moves a2a traffic, not the ring census the family base
+        counts: Q/K/V head-reshard out plus the output's reshard back,
+        each keeping the diagonal chunk local (``(d-1)/d``)."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        from ddlb_tpu.perfmodel.cost import wire_itemsize
+
+        local = (self.m // d) * self.k  # rows * head_dim per head
+        elems = local * (2 * self.num_heads + 2 * self.kv_heads)  # Q,out + K,V
+        return elems * wire_itemsize(self.dtype) * (d - 1) / d
+
     def _check_shapes(self) -> None:
         super()._check_shapes()
         d = self.num_partitions

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -34,6 +35,19 @@ class DPAllReduce(Primitive):
     """ABC for data-parallel GEMM+AR implementations."""
 
     primitive_name = "dp_allreduce"
+
+    def wire_bytes(self) -> float:
+        """Per-device ring bytes of the family's collective — the AR of
+        the ``[m, n]`` gradient: reduce-scatter + all-gather, each
+        moving ``(m*n/d) * (d-1)`` elements per device (the classic
+        ``2 * (S/d) * (d-1)`` ring all-reduce). compute_only overrides
+        to 0."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        return float(
+            2.0 * (self.m * self.n // d) * wire_itemsize(self.dtype) * (d - 1)
+        )
 
     #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
     #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh

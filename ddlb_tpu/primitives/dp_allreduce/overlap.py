@@ -30,6 +30,10 @@ from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
 
 
 class OverlapDPAllReduce(DPAllReduce):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "coll_pipeline",
         "s": 8,

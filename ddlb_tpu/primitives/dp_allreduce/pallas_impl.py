@@ -26,6 +26,10 @@ from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
 
 
 class PallasDPAllReduce(DPAllReduce):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "xla_collective",
         "block_m": 1024,

@@ -32,6 +32,7 @@ from typing import Tuple
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -39,6 +40,18 @@ class EPAllToAll(Primitive):
     """ABC for expert-parallel all-to-all + expert-GEMM implementations."""
 
     primitive_name = "ep_alltoall"
+
+    def wire_bytes(self) -> float:
+        """Per-device bytes of the family's two all-to-alls — dispatch
+        moves ``(d-1)/d`` of each device's ``[m/d, k]`` token shard,
+        combine the same fraction of its ``[m/d, n]`` outputs (an A2A
+        keeps the diagonal chunk local). compute_only overrides to 0."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        isz = wire_itemsize(self.dtype)
+        per_dev_elems = (self.m // d) * (self.k + self.n)
+        return float(per_dev_elems * isz) * (d - 1) / d
 
     #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
     #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh

@@ -22,6 +22,10 @@ from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 
 
 class OverlapEPAllToAll(EPAllToAll):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {"algorithm": "coll_pipeline", "s": 4}
     ALLOWED_VALUES = {
         "algorithm": ["default", "coll_pipeline"],

@@ -31,6 +31,10 @@ from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 
 
 class PallasEPAllToAll(EPAllToAll):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     # default matches the sibling tp pallas members (xla_collective), so
     # the family's shared 'pallas' option surface behaves uniformly in
     # sweeps; the RDMA program is the explicit algorithm=a2a_rdma choice

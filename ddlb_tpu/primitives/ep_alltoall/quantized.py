@@ -35,6 +35,21 @@ from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
 
 
 class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
+    def wire_bytes(self) -> float:
+        """Dispatch moves int8 tokens (1 byte/elem — the halved-wire
+        win), combine returns operand-dtype outputs; both keep the
+        diagonal chunk local. Per-row scales are excluded like the
+        tp_columnwise member's."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        from ddlb_tpu.perfmodel.cost import wire_itemsize
+
+        per_dev = (self.m // d) * (
+            self.k * 1 + self.n * wire_itemsize(self.dtype)
+        )
+        return per_dev * (d - 1) / d
+
     def _check_shapes(self) -> None:
         super()._check_shapes()
         self._check_quantized_options()

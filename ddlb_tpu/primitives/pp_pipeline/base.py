@@ -33,6 +33,7 @@ from typing import Tuple
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive, validation_atol
 
 
@@ -62,6 +63,18 @@ class PPPipeline(Primitive):
 
     def flops(self) -> float:
         return 2.0 * self.m * self.k * self.n * self.num_stages
+
+    def wire_bytes(self) -> float:
+        """Per-device activation-hop bytes: every microbatch's ``[·, n]``
+        activation crosses each stage boundary exactly once, so each
+        device (except the last) forwards ``m * n`` elements total over
+        its outbound ICI link regardless of the microbatch count — the
+        send-port census the ppermute chain pays. The final result
+        broadcast is counted by the schedule, not this floor.
+        compute_only overrides to 0."""
+        if self.num_partitions <= 1:
+            return 0.0
+        return float(self.m * self.n * wire_itemsize(self.dtype))
 
     def _host_chain_operands(self) -> Tuple[np.ndarray, np.ndarray]:
         """Seeded tokens ``[m, k]`` and stage weights ``[d, k, n]`` scaled

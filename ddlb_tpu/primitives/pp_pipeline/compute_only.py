@@ -19,6 +19,13 @@ from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
 
 
 class ComputeOnlyPPPipeline(PPPipeline):
+    #: no collective runs: the perfmodel drops the comm term (and the
+    #: family wire census must not be inherited — see primitives/base.py)
+    COST_SCHEDULE = "compute_only"
+
+    def wire_bytes(self) -> float:
+        return 0.0
+
     DEFAULT_OPTIONS = {"size": "sharded"}
     ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
 

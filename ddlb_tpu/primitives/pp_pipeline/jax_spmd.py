@@ -31,6 +31,10 @@ from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
 
 
 class JaxSPMDPPPipeline(PPPipeline):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {"microbatches": 4}
     ALLOWED_VALUES = {"microbatches": (1, None)}
 

@@ -53,6 +53,10 @@ from ddlb_tpu.utils.pipeline_schedule import (
 
 
 class SchedulePPPipeline(PPPipeline):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {"schedule": "1f1b", "microbatches": 4, "virtual": 1}
     ALLOWED_VALUES = {
         "schedule": list(SCHEDULES),

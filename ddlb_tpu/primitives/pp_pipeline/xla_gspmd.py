@@ -23,6 +23,10 @@ from ddlb_tpu.runtime import as_auto_mesh
 
 
 class XLAGSPMDPPPipeline(GSPMDOptionsMixin, PPPipeline):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     def _input_setup(self) -> None:
         self.mesh = as_auto_mesh(self.mesh)
         super()._input_setup()

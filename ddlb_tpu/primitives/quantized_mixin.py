@@ -17,6 +17,11 @@ QUANTIZABLE_DTYPES = ("float32", "float16", "bfloat16")
 
 
 class QuantizedGEMMMixin:
+    #: the perfmodel prices the GEMM term at the int8 MXU peak (the 2x
+    #: roofline these members exist for); wire censuses stay per-member —
+    #: only the collectives that genuinely move int8 override wire_bytes
+    COST_DTYPE = "int8"
+
     DEFAULT_OPTIONS = {
         "kernel": "xla",
         "quantize": "static",

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -19,6 +20,20 @@ class TPColumnwise(Primitive):
     """ABC for AG+GEMM implementations."""
 
     primitive_name = "tp_columnwise"
+
+    def wire_bytes(self) -> float:
+        """Per-device ring bytes of the family's collective — the AG of
+        A ``[m, k]``: each device sends its ``[m/d, k]`` shard ``d-1``
+        times (the bandwidth-optimal ring all-gather). Family-level so
+        every member (jax_spmd, xla_gspmd, overlap, pallas, quantized)
+        reports the same ``collective_bytes`` and comm cost term;
+        compute_only overrides to 0."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        return float(
+            (self.m // d) * self.k * wire_itemsize(self.dtype) * (d - 1)
+        )
 
     #: ici/dcn transport sweep axis — the TPU analogue of the reference's
     #: collective-backend option (nccl/ucc/tl-*, TPColumnwise/pytorch.py:

@@ -17,6 +17,13 @@ from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
 
 
 class ComputeOnlyTPColumnwise(TPColumnwise):
+    #: no collective runs: the perfmodel drops the comm term (and the
+    #: family wire census must not be inherited — see primitives/base.py)
+    COST_SCHEDULE = "compute_only"
+
+    def wire_bytes(self) -> float:
+        return 0.0
+
     DEFAULT_OPTIONS = {"size": "sharded"}
     ALLOWED_VALUES = {"size": ["sharded", "unsharded"]}
 

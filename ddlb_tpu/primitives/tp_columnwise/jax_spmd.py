@@ -20,6 +20,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class JaxSPMDTPColumnwise(TPColumnwise):
@@ -43,7 +44,7 @@ class JaxSPMDTPColumnwise(TPColumnwise):
                 return jax.lax.all_gather(partial, "tp", axis=0, tiled=True)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None), P(None, None)),

@@ -36,9 +36,14 @@ from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import native
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class OverlapTPColumnwise(TPColumnwise):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "coll_pipeline",
         "s": 8,
@@ -79,7 +84,7 @@ class OverlapTPColumnwise(TPColumnwise):
             "p2p_pipeline": self._build_p2p_pipeline,
         }[algo]
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 build(),
                 mesh=self.mesh,
                 in_specs=(P("tp", None), P(None, None)),

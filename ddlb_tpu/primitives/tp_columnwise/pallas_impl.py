@@ -29,6 +29,10 @@ from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
 
 
 class PallasTPColumnwise(TPColumnwise):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "xla_collective",
         "order": "AG_before",

@@ -32,6 +32,16 @@ from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
 
 
 class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
+    def wire_bytes(self) -> float:
+        """The gathered shard travels as int8 (1 byte/elem), not the
+        operand dtype the family base counts — the halved-wire win this
+        member exists for; the per-row f32 scales ride along but are
+        m/d floats against an m/d x k payload, excluded from the floor."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        return float((self.m // d) * self.k * (d - 1))  # int8: 1 B/elem
+
     def _check_shapes(self) -> None:
         super()._check_shapes()
         self._check_quantized_options()

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import Primitive
 
 
@@ -24,6 +25,19 @@ class TPRowwise(Primitive):
     """ABC for GEMM+RS implementations."""
 
     primitive_name = "tp_rowwise"
+
+    def wire_bytes(self) -> float:
+        """Per-device ring bytes of the family's collective — the RS of
+        the ``[m, n]`` product (wire dtype = operand dtype, the ring
+        partial-sum convention of ``accum_wire_dtypes``): each device
+        sends ``(m*n/d) * (d-1)`` elements under the bandwidth-optimal
+        ring reduce-scatter. compute_only overrides to 0."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        return float(
+            (self.m * self.n // d) * wire_itemsize(self.dtype) * (d - 1)
+        )
 
     #: ici/dcn transport sweep axis (see tp_columnwise/base.py; SURVEY.md
     #: section 2.4 backend-axis mapping); ordering by runtime.transport_mesh

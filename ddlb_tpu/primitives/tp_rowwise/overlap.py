@@ -33,6 +33,10 @@ from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 
 
 class OverlapTPRowwise(TPRowwise):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "coll_pipeline",
         "s": 8,

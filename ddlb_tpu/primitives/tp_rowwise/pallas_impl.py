@@ -22,6 +22,10 @@ from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 
 
 class PallasTPRowwise(TPRowwise):
+    #: comm/compute pipelined: the perfmodel combines roofline terms as
+    #: max(compute, comm) — the analytical overlap lower bound
+    COST_SCHEDULE = "overlap"
+
     DEFAULT_OPTIONS = {
         "algorithm": "xla_collective",
         "block_m": 1024,

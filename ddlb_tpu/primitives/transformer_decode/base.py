@@ -258,6 +258,62 @@ class TransformerDecode(Primitive):
         )
         return prefill + decode
 
+    def hbm_bytes(self) -> float:
+        """HBM traffic floor of one measured call, in bytes — the
+        bandwidth denominator of the perfmodel's serving roofline.
+
+        Every decode step re-reads the weights and the K/V cache (the
+        byte census ``utils/hbm_budget`` already maintains for the
+        capacity gate — reused here so the two models cannot drift);
+        prefill reads the weights once and writes the cache; the loop
+        phases pay one prefill plus ``n_new - 1`` steps, and serve pays
+        the census over its whole drained workload. Activation traffic
+        is deliberately excluded: it is a fusion-dependent overhead
+        term, not part of the floor.
+        """
+        from ddlb_tpu.utils.hbm_budget import decode_budget
+
+        o = self.options
+        # speculate reads the TARGET-model census (phase="generate"
+        # sizing): the budget's speculate entry adds the draft's
+        # weights/cache for capacity, but the verify-pass floor re-reads
+        # only the target (draft re-reads are draft_layers-deep overhead,
+        # excluded like other overhead terms)
+        budget_phase = "generate" if o["phase"] == "speculate" else o["phase"]
+        rep = decode_budget(
+            ctx=self.m,
+            d_model=self.n,
+            d_ff=self.k,
+            vocab=o["vocab"],
+            n_heads=o["n_heads"],
+            batch=o["batch"],
+            n_kv_heads=o["n_kv_heads"],
+            layers=o["layers"],
+            kv_cache=o["kv_cache"],
+            mlp_kernel=o["mlp_kernel"],
+            attn_kernel=o["attn_kernel"],
+            phase=budget_phase,
+            validate=False,
+            n_new=o["n_new"],
+            spec_k=o["spec_k"],
+            draft_layers=o["draft_layers"],
+        )
+        per_pass = rep.components["weights"] + rep.components["kv_cache"]
+        if o["phase"] in ("decode", "prefill"):
+            return per_pass
+        if o["phase"] == "serve":
+            total_tokens = sum(mx for _, mx in self._serve_workload())
+            return total_tokens * per_pass
+        if o["phase"] == "speculate":
+            # the floor is the ALL-ACCEPTED best case: each target chunk
+            # forward verifies spec_k drafts + 1 bonus token, so the
+            # target re-reads weights+cache ceil(n_new/(spec_k+1)) times
+            # — this is precisely speculation's bandwidth win over
+            # phase=generate's n_new re-reads
+            passes = -(-o["n_new"] // (o["spec_k"] + 1))
+            return passes * per_pass
+        return o["n_new"] * per_pass  # generate: prefill + n_new-1 steps
+
     def _model_config(self):
         from ddlb_tpu.models.transformer import TransformerConfig
         from ddlb_tpu.primitives.base import jnp_dtype

@@ -14,6 +14,10 @@ from ddlb_tpu.primitives.transformer_decode.spmd import SPMDTransformerDecode
 
 
 class ComputeOnlyTransformerDecode(SPMDTransformerDecode):
+    #: no collective runs: the perfmodel drops the comm term (and the
+    #: family wire census must not be inherited — see primitives/base.py)
+    COST_SCHEDULE = "compute_only"
+
     def _mesh_factors(self):
         if self.options["dp"] or self.options["tp"]:
             raise ValueError(

@@ -40,6 +40,14 @@ class TransformerStep(Primitive):
 
     primitive_name = "transformer_step"
 
+    #: perfmodel stance: the family's analytical bound is the pure
+    #: model-FLOPs floor (``flops()`` over the whole mesh at MXU peak —
+    #: the MFU denominator as a time; perfmodel.cost._model_step_cost).
+    #: No ``wire_bytes()`` census is defined: collective traffic depends
+    #: on every axis of the (dp, tp, pp) factorization, and pricing one
+    #: layout would misstate the others — so the step's roofline_frac
+    #: reads directly as measured MFU, comparable across factorizations.
+
     # family-level (BASE_) so the xla_gspmd member's mixin DEFAULT_OPTIONS
     # layers its compiler knobs on top without re-declaring the model axes
     BASE_OPTIONS = {

@@ -15,6 +15,10 @@ from ddlb_tpu.primitives.transformer_step.base import TransformerStep
 
 
 class ComputeOnlyTransformerStep(TransformerStep):
+    #: no collective runs: the perfmodel drops the comm term (and the
+    #: family wire census must not be inherited — see primitives/base.py)
+    COST_SCHEDULE = "compute_only"
+
     # the roofline runs the oracle's einsum formulation (reference_loss):
     # default and label say so (see xla_gspmd for the rationale)
     DEFAULT_OPTIONS = {"attn_kernel": "einsum"}

@@ -152,6 +152,13 @@ class Runtime:
         self.local_devices = tuple(jax.local_devices())
         self.num_devices = len(self.devices)
         self.platform = self.devices[0].platform if self.devices else "none"
+        #: PJRT chip identity string ("TPU v5 lite", ...) — the input to
+        #: the perfmodel spec registry's auto-detection (chip_spec)
+        self.device_kind = (
+            str(getattr(self.devices[0], "device_kind", ""))
+            if self.devices
+            else ""
+        )
         self.slice_ids = self._slice_assignment()
         self.num_slices = len(set(self.slice_ids)) if self.slice_ids else 1
 
@@ -184,6 +191,20 @@ class Runtime:
             return tuple(int(d.process_index) for d in self.devices)
         return tuple(
             int(getattr(d, "slice_index", None) or 0) for d in self.devices
+        )
+
+    @property
+    def chip_spec(self):
+        """The perfmodel hardware spec for this runtime's chips
+        (``perfmodel.specs.ChipSpec``): the ``DDLB_TPU_CHIP`` env
+        override when set, else auto-detected from the PJRT
+        ``device_kind``; non-TPU platforms (the CPU sim) resolve to the
+        calibrated ``cpu-sim`` entry. Resolved per access so a test's
+        env override takes effect without resetting the singleton."""
+        from ddlb_tpu.perfmodel.specs import detect_spec
+
+        return detect_spec(
+            device_kind=self.device_kind, platform=self.platform
         )
 
     # -- mesh construction ---------------------------------------------------

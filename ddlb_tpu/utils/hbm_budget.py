@@ -46,14 +46,28 @@ models/decode.py and models/transformer.py):
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Dict, Optional
+
+from ddlb_tpu.perfmodel.specs import get_spec
 
 GiB = float(1 << 30)
 
-#: v5e physical HBM; the gate keeps 10% headroom (the model is planning,
-#: not allocation — fusion/scheduling can move peak by that much)
-V5E_HBM_BYTES = 16 * GiB
+
+def default_limit(chip: Optional[str] = None) -> float:
+    """The budget gate's HBM ceiling for ``chip`` (default: the
+    ``DDLB_TPU_CHIP`` env override, else v5e — the relay fleet's part),
+    read from the perfmodel spec registry so capacity and cost model can
+    never drift. Keeps 10% headroom: the model is planning, not
+    allocation — fusion/scheduling can move peak by that much."""
+    spec = get_spec(chip or os.environ.get("DDLB_TPU_CHIP") or "v5e")
+    return 0.9 * spec.hbm_bytes
+
+
+#: v5e physical HBM from the spec registry (compat re-export: the
+#: calibration tests and the measurement batches read these names)
+V5E_HBM_BYTES = get_spec("v5e").hbm_bytes
 DEFAULT_LIMIT = 0.9 * V5E_HBM_BYTES
 
 _SLACK = 0.5 * GiB
@@ -107,7 +121,7 @@ def decode_budget(
     draft_layers: int = 1,
     page_pool_frac: float = 1.0,
     cache_layout: str = "contiguous",
-    limit: float = DEFAULT_LIMIT,
+    limit: Optional[float] = None,
 ) -> BudgetReport:
     """Model the HBM peak of one ``transformer_decode`` config.
 
@@ -118,6 +132,10 @@ def decode_budget(
     serve sizes the engine pool. Single-chip (tp=1) weights — the
     measurement batches this gates run on one chip.
     """
+    if limit is None:
+        # resolved per call (not at import) so a DDLB_TPU_CHIP override
+        # re-sizes the gate to the chip the sweep actually targets
+        limit = default_limit()
     D, F, V, B, L = d_model, d_ff, vocab, batch, layers
     h_kv = n_kv_heads or n_heads
     kv_frac = h_kv / n_heads

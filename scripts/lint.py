@@ -219,6 +219,33 @@ def check_file(path: Path) -> list:
     ]
 
 
+def _cost_model_coverage() -> list:
+    """Perfmodel invariant (ISSUE 3 satellite): every registered
+    primitive family must resolve a cost model, so a newly added family
+    can never ship rows with a silent ``predicted_s=None``. Both modules
+    are JAX-free by design, so this import is safe from the lint tier;
+    an import failure is itself a finding (the invariant would otherwise
+    vanish with the import)."""
+    repo = Path(__file__).resolve().parent.parent
+    if str(repo) not in sys.path:
+        sys.path.insert(0, str(repo))
+    try:
+        from ddlb_tpu.perfmodel.cost import FAMILY_COST_MODELS
+        from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+    except Exception as exc:
+        return [
+            f"perfmodel: cost-model coverage check failed to import: "
+            f"{type(exc).__name__}: {exc}"
+        ]
+    return [
+        f"perfmodel: primitive family '{fam}' has no cost model in "
+        f"ddlb_tpu/perfmodel/cost.py FAMILY_COST_MODELS (rows would "
+        f"carry silent predicted_s defaults)"
+        for fam in ALLOWED_PRIMITIVES
+        if fam not in FAMILY_COST_MODELS
+    ]
+
+
 def main(argv) -> int:
     targets = []
     for arg in argv or ["."]:
@@ -233,6 +260,10 @@ def main(argv) -> int:
             print(f"lint: no such file or directory: {arg}", file=sys.stderr)
             return 2
     problems = []
+    # repo-level invariants (not per-file): run once whenever the lint
+    # sweep covers the package (the Makefile target always does)
+    if any("ddlb_tpu" in p.parts for p in targets):
+        problems.extend(_cost_model_coverage())
     for path in targets:
         if "__pycache__" in path.parts:
             continue

@@ -1,25 +1,10 @@
 #!/usr/bin/env python
-"""DEPRECATED shim: the "r2 remaining" rows are a subset of the resumable
-row queue's ``r2-*`` sections (scripts/measure_queue.py), whose
-checkpoint state makes per-round remainder scripts unnecessary — the
-queue itself skips rows already banked. Flags pass through.
+"""RETIRED: use ``python scripts/measure_queue.py --only r2`` (the resumable row queue).
 
-Usage:  python scripts/measure_r2_remaining.py [--quick]
+This per-round batch script was folded into the queue in PR 1 and the
+forwarding shim retired in PR 3 — the queue checkpoint makes per-round
+entry points redundant.
 """
-
-from __future__ import annotations
-
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from measure_queue import main  # noqa: E402
-
-if __name__ == "__main__":
-    print(
-        "[deprecated] measure_r2_remaining.py forwards to "
-        "measure_queue.py --only r2",
-        flush=True,
-    )
-    sys.exit(main(["--only", "r2", *sys.argv[1:]]))
+raise SystemExit(
+    "measure_r2*: retired — run `python scripts/measure_queue.py --only r2`"
+)

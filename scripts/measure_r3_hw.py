@@ -1,24 +1,10 @@
 #!/usr/bin/env python
-"""DEPRECATED shim: the round-3 batch (serving table, int8 tile sweep,
-xprof trace, schedules) now lives in the resumable row queue
-(scripts/measure_queue.py, sections ``r3-*``). Flags pass through.
+"""RETIRED: use ``python scripts/measure_queue.py --only r3`` (the resumable row queue).
 
-Usage:  python scripts/measure_r3_hw.py [--quick]
+This per-round batch script was folded into the queue in PR 1 and the
+forwarding shim retired in PR 3 — the queue checkpoint makes per-round
+entry points redundant.
 """
-
-from __future__ import annotations
-
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from measure_queue import main  # noqa: E402
-
-if __name__ == "__main__":
-    print(
-        "[deprecated] measure_r3_hw.py forwards to "
-        "measure_queue.py --only r3",
-        flush=True,
-    )
-    sys.exit(main(["--only", "r3", *sys.argv[1:]]))
+raise SystemExit(
+    "measure_r3*: retired — run `python scripts/measure_queue.py --only r3`"
+)

@@ -1,25 +1,10 @@
 #!/usr/bin/env python
-"""DEPRECATED shim: the round-4 batch (MFU curve, speculate/serve rows,
-decode-kernel A/B, windowed flash, HBM roofline, kernel parity) now
-lives in the resumable row queue (scripts/measure_queue.py, sections
-``r4-*``). Flags — including ``--smoke`` — pass through.
+"""RETIRED: use ``python scripts/measure_queue.py --only r4`` (the resumable row queue).
 
-Usage: python scripts/measure_r4_hw.py [--quick] [--smoke]
+This per-round batch script was folded into the queue in PR 1 and the
+forwarding shim retired in PR 3 — the queue checkpoint makes per-round
+entry points redundant.
 """
-
-from __future__ import annotations
-
-import os
-import sys
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from measure_queue import main  # noqa: E402
-
-if __name__ == "__main__":
-    print(
-        "[deprecated] measure_r4_hw.py forwards to "
-        "measure_queue.py --only r4",
-        flush=True,
-    )
-    sys.exit(main(["--only", "r4", *sys.argv[1:]]))
+raise SystemExit(
+    "measure_r4*: retired — run `python scripts/measure_queue.py --only r4`"
+)

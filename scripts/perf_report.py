@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Roofline ranking report over benchmark CSVs (the perfmodel consumer).
+
+Reads one or more result CSVs written by ``PrimitiveBenchmarkRunner``
+(which stamps every row with the analytical-perfmodel columns
+``predicted_s`` / ``roofline_frac`` / ``bound`` / ``chip``) and ranks the
+implementations of each primitive family by achieved roofline fraction —
+the "how far from the hardware limit" verdict the raw latency table
+cannot give, because a slower impl at a higher fraction of ITS bound
+(e.g. a comm-bound ring on a thin link) is doing its job better than a
+faster one leaving MXU cycles on the floor.
+
+Usage:
+    python scripts/perf_report.py results/*.csv [--json] [--metric median]
+
+Per (primitive, implementation, option) group the report shows the
+median roofline fraction, the median predicted and measured times, the
+dominating bound, and how many rows measured vs errored. ``--json``
+emits the same structure machine-readably (the driver/CI consumer).
+Rows predating the perfmodel columns (old CSVs) are skipped with a note
+rather than crashing the report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+#: columns the report needs; CSVs missing them predate the perfmodel
+REQUIRED = ("primitive", "implementation", "option", "roofline_frac")
+
+
+def load_rows(paths):
+    """All rows of all CSVs as a list of dicts (pandas-free on purpose:
+    the report must run on the JSON/CI tier where only stdlib is
+    guaranteed), plus the list of skipped pre-perfmodel files."""
+    import csv
+
+    rows, skipped = [], []
+    for path in paths:
+        with open(path, newline="") as f:
+            reader = csv.DictReader(f)
+            header = reader.fieldnames or []
+            if any(col not in header for col in REQUIRED):
+                skipped.append(path)
+                continue
+            rows.extend(reader)
+    return rows, skipped
+
+
+def _fnum(value):
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    return v if math.isfinite(v) else None
+
+
+def _median(values):
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def summarize(rows):
+    """Per-family ranking: one entry per (implementation, option) group,
+    sorted by median roofline fraction descending; error rows counted
+    but excluded from the statistics (their fraction is NaN by schema)."""
+    groups = {}
+    for row in rows:
+        key = (
+            row.get("primitive", ""),
+            row.get("base_implementation") or row.get("implementation", ""),
+            row.get("option", ""),
+        )
+        groups.setdefault(key, []).append(row)
+
+    families = {}
+    for (primitive, impl, option), grp in groups.items():
+        errored = sum(1 for r in grp if (r.get("error") or "").strip())
+        fracs = [_fnum(r.get("roofline_frac")) for r in grp]
+        fracs = [v for v in fracs if v is not None]
+        bounds = [r.get("bound", "") for r in grp if r.get("bound")]
+        entry = {
+            "implementation": impl,
+            "option": option,
+            "rows": len(grp),
+            "errors": errored,
+            "roofline_frac": _median(fracs),
+            "predicted_ms": _median(
+                [
+                    None if v is None else v * 1e3
+                    for v in (_fnum(r.get("predicted_s")) for r in grp)
+                ]
+            ),
+            "measured_ms": _median(
+                [_fnum(r.get("median time (ms)")) for r in grp]
+            ),
+            "bound": max(set(bounds), key=bounds.count) if bounds else "",
+            "chip": next((r.get("chip") for r in grp if r.get("chip")), ""),
+        }
+        families.setdefault(primitive, []).append(entry)
+
+    for primitive in families:
+        families[primitive].sort(
+            key=lambda e: (
+                e["roofline_frac"] is None,
+                -(e["roofline_frac"] or 0.0),
+            )
+        )
+    return families
+
+
+def render_text(families, skipped):
+    lines = []
+    for primitive in sorted(families):
+        entries = families[primitive]
+        chip = next((e["chip"] for e in entries if e["chip"]), "?")
+        lines.append(f"== {primitive} (chip: {chip}) ==")
+        lines.append(
+            f"{'rank':>4}  {'impl':<14} {'roofline':>9} {'bound':>8} "
+            f"{'pred ms':>10} {'meas ms':>10} {'rows':>5} {'err':>4}  option"
+        )
+        for rank, e in enumerate(entries, 1):
+            frac = (
+                # 4 significant digits, not fixed decimals: cpu-sim
+                # fractions are deliberately tiny (optimistic peaks)
+                f"{e['roofline_frac']:.4g}"
+                if e["roofline_frac"] is not None
+                else "-"
+            )
+            pred = (
+                f"{e['predicted_ms']:.4f}"
+                if e["predicted_ms"] is not None
+                else "-"
+            )
+            meas = (
+                f"{e['measured_ms']:.4f}"
+                if e["measured_ms"] is not None
+                else "-"
+            )
+            lines.append(
+                f"{rank:>4}  {e['implementation']:<14} {frac:>9} "
+                f"{e['bound']:>8} {pred:>10} {meas:>10} "
+                f"{e['rows']:>5} {e['errors']:>4}  {e['option']}"
+            )
+        lines.append("")
+    for path in skipped:
+        lines.append(
+            f"note: {path} predates the perfmodel columns — skipped "
+            f"(re-run the sweep to get roofline_frac)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("csvs", nargs="+", help="result CSV path(s)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the ranking as JSON instead of the text table",
+    )
+    args = parser.parse_args(argv)
+
+    missing = [p for p in args.csvs if not os.path.exists(p)]
+    if missing:
+        print(f"perf_report: no such file: {missing}", file=sys.stderr)
+        return 2
+    rows, skipped = load_rows(args.csvs)
+    if not rows and skipped:
+        print(
+            "perf_report: every input predates the perfmodel columns "
+            f"({REQUIRED}): {skipped}",
+            file=sys.stderr,
+        )
+        return 2
+    families = summarize(rows)
+    if args.json:
+        print(
+            json.dumps(
+                {"families": families, "skipped": skipped}, indent=1,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(render_text(families, skipped))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -392,3 +392,46 @@ def test_bench_cache_age_unparseable_counts_as_stale():
     assert bench._cache_age_days(
         {"captured_at": "2026-08-01T00:00:00Z"}
     ) < 30.0
+
+
+def test_roofline_regression_gate(tmp_path, monkeypatch):
+    """A fresh capture whose roofline_frac drops more than the tolerance
+    below the previous cached capture gets soft-flagged (annotated, not
+    failed — the bench contract is always rc=0); a within-tolerance or
+    fraction-less row passes untouched."""
+    bench = _load_bench_module()
+    cache = tmp_path / "bench_tpu_cache.json"
+    prev = {
+        "metric": "tp_columnwise_gemm_pallas_8192x8192x8192_bf16",
+        "world_size": 1,
+        "roofline_frac": 0.80,
+        "captured_at": "2026-08-01T00:00:00Z",
+    }
+    cache.write_text(json.dumps([prev]))
+    monkeypatch.setattr(bench, "CACHE_PATH", str(cache))
+
+    fresh = dict(prev, roofline_frac=0.60, captured_at=None)
+    bench._check_roofline_regression(fresh)
+    assert fresh["roofline_regression"] is True
+    assert fresh["roofline_frac_prev"] == 0.80
+
+    ok = dict(prev, roofline_frac=0.75)
+    bench._check_roofline_regression(ok)
+    assert "roofline_regression" not in ok
+
+    # env-tunable tolerance: 30% makes the 0.60 row acceptable
+    monkeypatch.setenv("DDLB_TPU_BENCH_ROOFLINE_TOL", "0.30")
+    loose = dict(prev, roofline_frac=0.60)
+    bench._check_roofline_regression(loose)
+    assert "roofline_regression" not in loose
+
+    # no fraction (pre-perfmodel row or cpu fallback): a no-op
+    bare = {"metric": prev["metric"], "world_size": 1}
+    bench._check_roofline_regression(bare)
+    assert "roofline_regression" not in bare
+
+    # a different shape's capture is not a comparator
+    other = dict(prev, metric="tp_columnwise_gemm_pallas_512x512x512_bf16",
+                 roofline_frac=0.10)
+    bench._check_roofline_regression(other)
+    assert "roofline_regression" not in other

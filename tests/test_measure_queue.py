@@ -166,25 +166,25 @@ def test_smoke_queue_runs_without_hardware(tmp_path, monkeypatch):
     assert ran == ["tp_columnwise"]
 
 
-def test_deprecated_shims_forward_to_queue(tmp_path):
-    """Each measure_r* script still answers, forwarding into the queue
-    (--list touches no backend, so this stays fast)."""
-    for script, marker in (
-        ("measure_r2_hw.py", "r2-"),
-        ("measure_r2_remaining.py", "r2-"),
-        ("measure_r3_hw.py", "r3-"),
-        ("measure_r4_hw.py", "r4-"),
+def test_retired_shims_exit_with_pointer(tmp_path):
+    """The measure_r* entry points are retired: each exits non-zero with
+    a pointer to the queue command that replaced it (no forwarding, no
+    backend touch — an old runbook gets an actionable message, never a
+    silent half-run)."""
+    for script, section in (
+        ("measure_r2_hw.py", "r2"),
+        ("measure_r2_remaining.py", "r2"),
+        ("measure_r3_hw.py", "r3"),
+        ("measure_r4_hw.py", "r4"),
     ):
         out = subprocess.run(
-            [sys.executable, os.path.join("scripts", script), "--list",
-             "--state", str(tmp_path / "s.json")],
+            [sys.executable, os.path.join("scripts", script)],
             cwd=REPO, capture_output=True, text=True, timeout=120,
         )
-        assert out.returncode == 0, out.stderr[-2000:]
-        assert "deprecated" in out.stdout
-        listed = [ln for ln in out.stdout.splitlines() if "[" in ln]
-        assert listed, out.stdout
-        assert all(marker in ln for ln in listed if "pending" in ln)
+        assert out.returncode != 0
+        combined = out.stdout + out.stderr
+        assert "retired" in combined
+        assert f"measure_queue.py --only {section}" in combined
 
 
 def test_parked_only_failures_converge_to_rc_zero(tmp_path, monkeypatch):
