@@ -35,11 +35,14 @@ from __future__ import annotations
 
 import os
 import socket
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ddlb_tpu import telemetry
+from ddlb_tpu import faults, telemetry
+from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.faults.classify import TRANSIENT, classify_error
 from ddlb_tpu.native import now_ns, robust_stats
 from ddlb_tpu.primitives.registry import (
     ALLOWED_PRIMITIVES,
@@ -117,6 +120,11 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     do_validate = config.get("validate", True)
     profile_dir = config.get("profile_dir")
 
+    # which retry attempt this run is (the self-healing runner threads it
+    # through the config): fault-plan rules gate on it (fail_attempts),
+    # and it lands in the row's ``retries`` column
+    fault_attempt = int(config.get("fault_attempt", 0) or 0)
+
     if timing_backend not in TIMING_BACKENDS:
         raise ValueError(
             f"Unknown timing backend '{timing_backend}'. "
@@ -147,6 +155,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         telemetry.log(
             f"worker: {stage}", elapsed_s=round((t1 - t0[0]) * 1e-9, 1)
         )
+        # liveness beat at every phase boundary: a subprocess parent with
+        # worker_timeout extends a beating child's deadline instead of
+        # killing a slow-but-alive row (ddlb_tpu/faults/heartbeat.py)
+        heartbeat.beat()
         t0[0] = t1
 
     # compile accounting for the whole measured region (setup, warmup,
@@ -156,11 +168,18 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
     # The metrics scope rides along: barrier wait, loop overhead, HBM
     # high-water and collective wire bytes recorded anywhere under this
     # row land in its result columns (telemetry.ROW_METRIC_DEFAULTS).
+    # the fault scope rides along: injection sites below see this row's
+    # retry attempt + impl identity, and the sites that actually fired
+    # are collected into the row's ``fault_injected`` column
     with compile_metrics() as _cm, telemetry.metrics_scope() as _ms, \
+            faults.scope(
+                attempt=fault_attempt, impl=impl_id, primitive=primitive
+            ) as _fs, \
             telemetry.span(
                 "worker.row", cat="row", impl=impl_id, primitive=primitive
             ):
         try:
+            faults.inject("worker.setup")
             impl_class = load_impl_class(primitive, base_impl)
             # option merge: DEFAULT_OPTIONS ∪ overrides (reference
             # benchmark.py:76-77); crash isolation covers construction too —
@@ -176,11 +195,17 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                 # metadata, snapshotted into the row's collective_bytes
                 try:
                     telemetry.record_max("collective_bytes", float(wire()))
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # metadata-only: never fail the measurement, but a
+                    # family whose wire_bytes() breaks must be visible
+                    telemetry.warn(
+                        f"wire_bytes() failed for {impl_id}: "
+                        f"{type(exc).__name__}: {exc}"
+                    )
             _mark("setup done; warmup begin (first compile happens here)")
 
             # warmup (reference benchmark.py:84-85)
+            faults.inject("worker.warmup")
             with telemetry.span("worker.warmup", cat="warmup", impl=impl_id):
                 for _ in range(num_warmups):
                     result = impl.run()
@@ -203,6 +228,7 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                         result = impl.run()
                     fence(result)
 
+            faults.inject("worker.timing")
             with telemetry.span(
                 "worker.timing", cat="timing", impl=impl_id,
                 backend=timing_backend,
@@ -225,12 +251,18 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                 # a validation crash (e.g. the oracle OOMs at a context the
                 # measured step handles fine) must not discard the completed
                 # measurement: times stand, valid=False + error records why
+                faults.inject("worker.validate")
                 with telemetry.span(
                     "worker.validate", cat="validate", impl=impl_id
                 ):
                     try:
                         result = impl.run()
                         fence(result)
+                        # corrupted-numerics site: the array comes back
+                        # wrong and validate() must catch it — the
+                        # deterministic stand-in for silent data
+                        # corruption
+                        result = faults.corrupt("worker.result", result)
                         valid = bool(impl.validate(result))
                     except Exception as exc:
                         error = (
@@ -274,6 +306,13 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         compile_time_s=round(_cm.compile_time_s, 4),
         compile_cache_hit=_cm.cache_hit,
         metrics=_ms.row_fields(),
+        # the robustness columns (ISSUE 4): which retry attempt this row
+        # came from, which fault-plan sites fired under it, and the
+        # transient-vs-deterministic class of its error (the retry/park
+        # decision, recorded so every failure is attributable)
+        retries=fault_attempt,
+        fault_injected=",".join(dict.fromkeys(_fs.fired)),
+        error_class=classify_error(error or "", valid),
         # the analytical lower bound rides EVERY row that constructed an
         # impl — including error rows (the prediction is shape-only, so a
         # timing/validation crash still gets predicted_s and bound; only
@@ -331,6 +370,10 @@ def make_result_row(
     compile_cache_hit: bool = False,
     metrics: Optional[Dict[str, Any]] = None,
     perf: Optional[Dict[str, Any]] = None,
+    retries: int = 0,
+    fault_injected: str = "",
+    error_class: str = "",
+    quarantined: bool = False,
 ) -> Dict[str, Any]:
     """The one result-row schema, shared by measured, crashed and
     timed-out workers so the CSV columns cannot drift apart.
@@ -403,6 +446,14 @@ def make_result_row(
         # lower bound for this config, the fraction of it achieved, and
         # the roofline term that dominates (compute/comm/hbm)
         **perf_fields,
+        # the robustness columns (ISSUE 4), identical on every path so
+        # the CSV header cannot drift: how many retries this row took,
+        # which fault-plan sites fired, the error's transient-vs-
+        # deterministic class, and whether the impl was quarantined
+        "retries": int(retries),
+        "fault_injected": fault_injected,
+        "error_class": error_class,
+        "quarantined": bool(quarantined),
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
@@ -425,6 +476,10 @@ def _timing_loop(
             t0 = now_ns()
             fence(impl.run())
             times[i] = (now_ns() - t0) * 1e-6
+            # per-iteration liveness beat: a long timing loop must not
+            # look hung to a heartbeat-aware parent (one is-None check
+            # when no channel is installed)
+            heartbeat.beat()
         return times
     if backend == "host_clock":
         # sync once, run N iterations back to back, sync, divide
@@ -473,8 +528,63 @@ def _format_options(options: Dict[str, Any]) -> str:
     return ";".join(f"{k}={v}" for k, v in sorted(options.items())) or "-"
 
 
-def _subprocess_worker(config, queue):  # pragma: no cover - child process
-    queue.put(benchmark_worker(config))
+def _row_has_measurement(row: Dict[str, Any]) -> bool:
+    """True when the row carries finite measured times — e.g. a
+    validation-phase crash AFTER a completed timing loop (the worker's
+    'times stand' contract). Such a row must never be retried: a retry
+    would discard a real measurement to re-pay the full row cost for
+    the same validation answer."""
+    try:
+        return bool(np.isfinite(float(row.get("median time (ms)"))))
+    except (TypeError, ValueError):
+        return False
+
+
+def _merge_fault_markers(row, markers: List[str]):
+    """Fold the child's announced-fired sites into the row's
+    ``fault_injected`` column (markers first, deduplicated) — the
+    attribution channel for faults that killed the child before it
+    could post a row."""
+    if markers and isinstance(row, dict):
+        fired = [
+            s for s in str(row.get("fault_injected") or "").split(",") if s
+        ]
+        row["fault_injected"] = ",".join(dict.fromkeys(markers + fired))
+    return row
+
+
+def _subprocess_worker(
+    config, queue, heartbeat_channel=None
+):  # pragma: no cover - child process
+    """Subprocess-isolation child entry: benchmark one config, post the
+    row. Installs the parent's heartbeat channel (so phase marks extend
+    the child's deadline) and hosts the subprocess-lifecycle injection
+    sites — ``subprocess.entry`` (hang / abrupt exit / OOM-style
+    SIGKILL before any work) and ``subprocess.result`` (corrupted-result
+    numerics on the posted row). A fired fault is announced to the
+    parent as a queue marker BEFORE it executes, so even a fault that
+    kills this process leaves its site attributable in the parent's
+    error row (the brief sleep lets the queue's feeder thread flush the
+    marker ahead of an abrupt ``os._exit``/SIGKILL)."""
+    if heartbeat_channel is not None:
+        heartbeat.set_channel(heartbeat_channel)
+
+    def _announce(site: str, kind: str) -> None:
+        queue.put({"__fault_marker__": site, "kind": kind})
+        if kind in ("exit", "kill", "hang"):
+            time.sleep(0.25)
+
+    faults.set_fire_listener(_announce)
+    with faults.scope(
+        attempt=int(config.get("fault_attempt", 0) or 0),
+        impl=config.get("impl_id"),
+        primitive=config.get("primitive"),
+    ):
+        faults.inject("subprocess.entry")
+        row = benchmark_worker(config)
+        row = faults.corrupt_row("subprocess.result", row)
+    faults.set_fire_listener(None)
+    queue.put(row)
 
 
 # ---------------------------------------------------------------------------
@@ -510,6 +620,9 @@ class PrimitiveBenchmarkRunner:
         device_loop_min_window_ms: float = 100.0,
         compile_ahead: bool = True,
         group_by_signature: bool = True,
+        max_retries: Optional[int] = None,
+        retry_backoff_s: float = 0.5,
+        quarantine_after: Optional[int] = None,
     ) -> None:
         if primitive not in self.ALLOWED_PRIMITIVES:
             raise ValueError(
@@ -545,6 +658,27 @@ class PrimitiveBenchmarkRunner:
         # adjacently so caches clear once per executable, not per row
         self.compile_ahead = compile_ahead
         self.group_by_signature = group_by_signature
+        # self-healing knobs (ISSUE 4): transient failures retry with
+        # exponential backoff + jitter up to max_retries; an impl whose
+        # configs fail quarantine_after times IN A ROW stops being run
+        # and its remaining configs emit cheap quarantined rows. Both
+        # default from the environment (DDLB_TPU_MAX_RETRIES /
+        # DDLB_TPU_QUARANTINE_AFTER; 0 disables either).
+        from ddlb_tpu.envs import get_max_retries, get_quarantine_after
+
+        self.max_retries = (
+            get_max_retries() if max_retries is None else int(max_retries)
+        )
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.quarantine_after = (
+            get_quarantine_after()
+            if quarantine_after is None
+            else int(quarantine_after)
+        )
+        #: per-base-implementation consecutive-failure strikes; reaching
+        #: quarantine_after moves the impl into _quarantined
+        self._strikes: Dict[str, int] = {}
+        self._quarantined: set = set()
         self._probed_world_size: Optional[int] = None  # subprocess probe cache
 
     def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
@@ -659,7 +793,7 @@ class PrimitiveBenchmarkRunner:
                 # while config N's timing loop owns the device
                 nxt_id, nxt_spec = pending[idx + 1]
                 scheduler.prefetch(self._worker_config(nxt_id, nxt_spec))
-            row = self._run_one(config)
+            row = self._run_one_healed(config)
             rows.append(row)
             if is_primary:
                 # mirror=False: the row is already in the CSV and the
@@ -920,6 +1054,98 @@ class PrimitiveBenchmarkRunner:
             )
         return keys
 
+    def _run_one_healed(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """One config under the self-healing policy: quarantine check,
+        then run with per-row retries — only failures the classifier
+        calls transient (``ddlb_tpu/faults/classify.py``) are retried,
+        with exponential backoff + deterministic jitter; deterministic
+        failures are recorded immediately (a retry re-pays the full cost
+        for the same answer). Returns exactly one row — the first clean
+        attempt or the last failed one, with ``retries`` set to the
+        attempts consumed and ``fault_injected`` accumulated across
+        them."""
+        base = config.get("base_implementation", config.get("impl_id", ""))
+        if base in self._quarantined:
+            # graceful degradation: a cheap classified row instead of
+            # another guaranteed timeout/crash burning worker_timeout
+            telemetry.record("runner.quarantine_skips")
+            row = self._error_row(
+                config,
+                f"skipped: quarantined after {self.quarantine_after} "
+                f"consecutive failures of '{base}'",
+            )
+            row["quarantined"] = True
+            row["error_class"] = "quarantined"
+            return row
+        delays = faults.backoff_delays(
+            self.retry_backoff_s, self.max_retries,
+            seed=str(config.get("impl_id", "")),
+        )
+        fired: List[str] = []
+        attempt = 0
+        while True:
+            config["fault_attempt"] = attempt
+            row = self._run_one(config)
+            error = str(row.get("error") or "")
+            valid = bool(row.get("valid", True))
+            cls = str(row.get("error_class") or "") or classify_error(
+                error, valid
+            )
+            row["error_class"] = cls
+            if row.get("fault_injected"):
+                fired.extend(str(row["fault_injected"]).split(","))
+            if (
+                error
+                and cls == TRANSIENT
+                and attempt < self.max_retries
+                and not _row_has_measurement(row)
+            ):
+                delay = delays[attempt]
+                telemetry.record("runner.retries")
+                with telemetry.span(
+                    "runner.retry", cat="retry",
+                    impl=config.get("impl_id", ""), attempt=attempt + 1,
+                    error=error[:200],
+                ):
+                    telemetry.warn(
+                        f"transient failure on {config.get('impl_id')} "
+                        f"(attempt {attempt + 1}/{self.max_retries + 1}): "
+                        f"{error[:200]} — retrying in {delay:.2f}s"
+                    )
+                    time.sleep(delay)
+                attempt += 1
+                continue
+            break
+        row["retries"] = attempt
+        # fault attribution survives recovery: sites that fired on
+        # discarded attempts stay visible on the final (possibly clean)
+        # row, so a chaos CSV shows WHERE the recovered fault hit
+        row["fault_injected"] = ",".join(dict.fromkeys(s for s in fired if s))
+        self._note_outcome(base, failed=bool(error))
+        return row
+
+    def _note_outcome(self, base: str, failed: bool) -> None:
+        """Quarantine bookkeeping: consecutive failed rows per base
+        implementation; a clean row resets the strike count."""
+        if self.quarantine_after <= 0:
+            return
+        if not failed:
+            self._strikes[base] = 0
+            return
+        strikes = self._strikes.get(base, 0) + 1
+        self._strikes[base] = strikes
+        if strikes >= self.quarantine_after and base not in self._quarantined:
+            self._quarantined.add(base)
+            telemetry.record("runner.quarantined_impls")
+            telemetry.instant(
+                "runner.quarantine", cat="retry", impl=base, strikes=strikes
+            )
+            telemetry.warn(
+                f"quarantining implementation '{base}' after {strikes} "
+                f"consecutive failures — its remaining configs will be "
+                f"skipped with 'quarantined' rows"
+            )
+
     def _run_one(self, config: Dict[str, Any]) -> Dict[str, Any]:
         if self.isolation == "subprocess":
             with telemetry.span(
@@ -935,57 +1161,115 @@ class PrimitiveBenchmarkRunner:
         # full per-implementation process isolation (reference
         # spawn-per-impl, benchmark.py:336-370)
         import multiprocessing as mp
-        import queue as queue_mod
-
-        import time as time_mod
 
         ctx = mp.get_context("spawn")
         queue = ctx.Queue()
-        proc = ctx.Process(target=_subprocess_worker, args=(config, queue))
-        proc.start()
-        # failure detection: the reference blocks forever on a hung
-        # child (queue.get with no timeout, benchmark.py:369 —
-        # SURVEY.md section 5 "no retries, no timeouts"). Poll in
-        # short slices so a child that DIES without posting a row
-        # (segfault, OOM-kill) is reported immediately as a crash, and
-        # one that HANGS is killed at worker_timeout.
-        deadline = (
-            time_mod.monotonic() + self.worker_timeout
-            if self.worker_timeout
-            else None
+        # heartbeat channel: the child stamps monotonic beats at every
+        # phase boundary and timing iteration (faults/heartbeat.py); the
+        # kill rule below measures silence since the LAST beat, so a
+        # slow-but-alive child extends its own deadline while a wedged
+        # one dies exactly worker_timeout after its last sign of life.
+        # lock=False is load-bearing: a locked Value SIGKILLed mid-beat
+        # (the OOM-killer class this machinery models) would orphan the
+        # lock and deadlock the parent's next read — an aligned 8-byte
+        # store needs no lock for a liveness stamp
+        heartbeat_channel = ctx.Value("d", 0.0, lock=False)
+        proc = ctx.Process(
+            target=_subprocess_worker,
+            args=(config, queue, heartbeat_channel),
         )
+        proc.start()
+        return self._await_worker_row(config, proc, queue, heartbeat_channel)
+
+    def _await_worker_row(
+        self, config, proc, queue, heartbeat_channel
+    ) -> Dict[str, Any]:
+        """The hung/dead-child policy, factored off the spawn so tests
+        can drive it with a scripted child. Polls in short slices: a
+        child that DIES without posting a row (segfault, OOM-kill) is
+        reported immediately as a crash; one that goes SILENT — no row,
+        no heartbeat — for worker_timeout is killed (the reference
+        blocks forever here: queue.get with no timeout, benchmark.py:369,
+        SURVEY.md section 5 "no retries, no timeouts")."""
+        import queue as queue_mod
+
+        # monotonic throughout: heartbeat stamps are time.monotonic()
+        # (system-wide, same host), so the silence computation below can
+        # never be broken by an NTP step mid-capture
+        start = time.monotonic()
+        fault_markers: List[str] = []
         row = None
         while row is None:
             try:
                 row = queue.get(timeout=1.0)
+                if isinstance(row, dict) and "__fault_marker__" in row:
+                    # the child announces a fired lifecycle fault BEFORE
+                    # executing it, so attribution survives even when
+                    # the fault kills the child without a result row
+                    fault_markers.append(str(row["__fault_marker__"]))
+                    row = None
+                    continue
             except queue_mod.Empty:
                 if not proc.is_alive():
-                    # died; drain once in case the row raced the exit
+                    # died; drain in case the row (or a fired-fault
+                    # marker) raced the exit
                     try:
-                        row = queue.get(timeout=1.0)
+                        while row is None or (
+                            isinstance(row, dict)
+                            and "__fault_marker__" in row
+                        ):
+                            if row is not None:
+                                fault_markers.append(
+                                    str(row["__fault_marker__"])
+                                )
+                            row = queue.get(timeout=1.0)
                     except queue_mod.Empty:
-                        return self._error_row(
-                            config,
-                            f"WorkerDied: exit code {proc.exitcode} "
-                            f"with no result",
+                        return _merge_fault_markers(
+                            self._error_row(
+                                config,
+                                f"WorkerDied: exit code {proc.exitcode} "
+                                f"with no result",
+                            ),
+                            fault_markers,
                         )
                     break
-                if deadline and time_mod.monotonic() > deadline:
-                    proc.kill()
-                    proc.join()
-                    return self._error_row(
-                        config,
-                        f"TimeoutError: worker exceeded "
-                        f"{self.worker_timeout}s (killed)",
+                if self.worker_timeout:
+                    last_sign = max(
+                        start, heartbeat.last_beat(heartbeat_channel)
                     )
+                    if time.monotonic() - last_sign > self.worker_timeout:
+                        proc.kill()
+                        proc.join()
+                        # a killed child's queue feeder thread may hold
+                        # buffered data; close + cancel_join_thread so
+                        # the parent's interpreter exit can never block
+                        # on it
+                        queue.close()
+                        queue.cancel_join_thread()
+                        beat = heartbeat.last_beat(heartbeat_channel) > 0
+                        return _merge_fault_markers(
+                            self._error_row(
+                                config,
+                                f"TimeoutError: worker silent for "
+                                f"{self.worker_timeout}s "
+                                f"{'since last heartbeat' if beat else 'with no heartbeat'}"
+                                f" (killed)",
+                            ),
+                            fault_markers,
+                        )
         # a child can also hang in interpreter teardown (runtime/atexit
         # finalizers) after delivering its row — bound the join even
-        # when no worker_timeout was configured
+        # when no worker_timeout was configured, and bound the kill's
+        # own join + release the queue the same way as the timeout path
+        # (an unbounded join here would re-open the exact drain-race
+        # hang the loop above closed)
         proc.join(self.worker_timeout or 60.0)
         if proc.is_alive():
             proc.kill()
-            proc.join()
-        return row
+            proc.join(10.0)
+            queue.close()
+            queue.cancel_join_thread()
+        return _merge_fault_markers(row, fault_markers)
 
     def _error_row(self, config: Dict[str, Any], error: str) -> Dict[str, Any]:
         """Error row for a worker that hung or died — the same schema as
@@ -1006,6 +1290,8 @@ class PrimitiveBenchmarkRunner:
             world_size=-1,  # unknown: the worker died before reporting
             num_processes=get_num_processes(),
             platform="unknown",
+            retries=int(config.get("fault_attempt", 0) or 0),
+            error_class=classify_error(error, valid=False),
         )
 
     def _append_csv(self, row: Dict[str, Any]) -> None:

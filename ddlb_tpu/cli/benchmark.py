@@ -210,6 +210,12 @@ def run_benchmark(config: Dict[str, Any]):
             # set and isolation is in-process
             compile_ahead=cfg.get("compile_ahead", True),
             group_by_signature=cfg.get("group_by_signature", True),
+            # self-healing knobs (ISSUE 4): None defers to the
+            # DDLB_TPU_MAX_RETRIES / DDLB_TPU_QUARANTINE_AFTER env
+            # defaults resolved in the runner
+            max_retries=cfg.get("max_retries"),
+            retry_backoff_s=cfg.get("retry_backoff_s", 0.5),
+            quarantine_after=cfg.get("quarantine_after"),
         )
         frames.append(runner.run())
 

@@ -122,6 +122,42 @@ def get_trace_dir() -> str:
     return os.environ.get("DDLB_TPU_TRACE", "").strip()
 
 
+def get_fault_plan() -> str:
+    """Fault-injection plan ("" = injection disabled).
+
+    Inline JSON or a path to a JSON file describing seeded fault rules
+    (``ddlb_tpu.faults.plan``). When set, the named injection sites
+    threaded through the stack (compile, worker phases, collective
+    entry, subprocess lifecycle) consult the plan; unset keeps the
+    zero-overhead fast path. Follows the DDLB_TPU_* convention:
+    empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_FAULT_PLAN", "").strip()
+
+
+def get_max_retries() -> int:
+    """Default per-row retry budget for transient failures (default 2).
+
+    The self-healing sweep runner retries a row classified transient
+    (``ddlb_tpu.faults.classify``) up to this many times with
+    exponential backoff + jitter before recording the error row. 0
+    disables retries; an explicit runner argument overrides.
+    """
+    return get_env(("DDLB_TPU_MAX_RETRIES",), 2, int)
+
+
+def get_quarantine_after() -> int:
+    """Consecutive failed rows before an implementation is quarantined
+    (default 3; 0 disables quarantine).
+
+    Once an implementation's configs fail this many times in a row
+    (after their retry budgets), the runner stops spawning workers for
+    its remaining configs and emits cheap ``skipped: quarantined`` rows
+    instead — graceful degradation in place of N timeouts.
+    """
+    return get_env(("DDLB_TPU_QUARANTINE_AFTER",), 3, int)
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

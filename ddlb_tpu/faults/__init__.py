@@ -1,0 +1,70 @@
+"""Fault injection, error classification, and worker heartbeats.
+
+The robustness layer (ISSUE 4): the reference harness blocks forever on
+a hung child and has "no retries, no timeouts" (SURVEY.md section 5);
+this repo's failure machinery (``worker_timeout``, WorkerDied detection,
+the queue's retry-then-park policy) existed but was untestable — nothing
+could provoke a failure deterministically. Three cooperating pieces, all
+zero-dependency (stdlib only, importable from the JAX-free process
+tiers):
+
+- ``inject`` / ``corrupt`` / ``scope`` (faults.plan): named injection
+  sites threaded through the stack (compile, worker phases, collective
+  entry, subprocess lifecycle), driven by a **seeded, deterministic
+  fault plan** from ``DDLB_TPU_FAULT_PLAN`` (inline JSON or a file
+  path). Zero overhead when the knob is unset: the fast path is one
+  global ``is None`` check.
+- ``classify_error`` (faults.classify): the transient-vs-deterministic
+  split the self-healing runner and the hardware row queue share — only
+  transients (TimeoutError, WorkerDied, RESOURCE_EXHAUSTED, ...) are
+  worth a retry; deterministic failures (ValueError, validation
+  mismatch) park immediately instead of burning capture windows.
+- ``heartbeat`` (faults.heartbeat): a cheap shared-memory beat channel
+  from subprocess workers, so a slow-but-alive child extends its
+  deadline at every phase boundary while a truly hung one is killed
+  ``worker_timeout`` seconds after its last sign of life.
+
+The consumers are ``benchmark.PrimitiveBenchmarkRunner`` (per-row retry
+with exponential backoff + jitter, per-impl quarantine) and
+``scripts/measure_queue.py`` (classifier-aware parking);
+``scripts/chaos_sweep.py`` is the end-to-end demonstration, and
+``docs/source/robustness.rst`` the operator guide.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.faults.classify import (
+    DETERMINISTIC,
+    TRANSIENT,
+    classify_error,
+)
+from ddlb_tpu.faults.plan import (
+    FaultPlan,
+    FaultRule,
+    active,
+    backoff_delays,
+    corrupt,
+    corrupt_row,
+    inject,
+    load_plan,
+    reset,
+    scope,
+    set_fire_listener,
+)
+
+__all__ = [
+    "DETERMINISTIC",
+    "FaultPlan",
+    "FaultRule",
+    "TRANSIENT",
+    "active",
+    "backoff_delays",
+    "classify_error",
+    "corrupt",
+    "corrupt_row",
+    "inject",
+    "load_plan",
+    "reset",
+    "scope",
+    "set_fire_listener",
+]

@@ -1,0 +1,64 @@
+"""Error classification: transient (retry) vs deterministic (park).
+
+One shared split for every failure-policy consumer — the self-healing
+sweep runner retries only transients, and the hardware row queue parks
+deterministic failures immediately instead of burning its MAX_ATTEMPTS
+passes on a config that can never succeed. The classes:
+
+- **transient**: the failure came from the environment, not the config —
+  a hung/killed worker (``TimeoutError``, ``WorkerDied``), allocator
+  pressure that a retry with a clean process may dodge
+  (``RESOURCE_EXHAUSTED``), transport/runtime flaps (``UNAVAILABLE``,
+  ``DEADLINE_EXCEEDED``, broken pipes, spawn failures). Worth a retry
+  with backoff.
+- **deterministic**: the config itself is wrong or produces wrong
+  numbers — ``ValueError``/``TypeError`` from option or shape checks, a
+  validation mismatch, corrupted-result numerics. A retry re-pays the
+  full cost for the same answer; park immediately.
+
+Classification is substring-based over the recorded error string (the
+rows and the queue state both carry stringified errors, not exception
+objects), with the transient patterns checked first; an unrecognized
+error is deterministic — the conservative default for wall-clock, since
+a wrongly-parked row costs one manual retry while a wrongly-retried one
+burns a capture window. JAX-free, importable from every process tier.
+"""
+
+from __future__ import annotations
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+
+#: substrings marking an error as environment-caused and retryable;
+#: checked against the stringified error (exception class names prefix
+#: the message everywhere this repo records one)
+TRANSIENT_PATTERNS = (
+    "TimeoutError",
+    "WorkerDied",
+    "worker spawn failed",
+    "RESOURCE_EXHAUSTED",
+    "DEADLINE_EXCEEDED",
+    "UNAVAILABLE",
+    "ABORTED",
+    "DATA_LOSS",
+    "ConnectionError",
+    "ConnectionResetError",
+    "BrokenPipeError",
+    "EOFError",
+    "heartbeat",
+)
+
+
+def classify_error(error: str, valid: bool = True) -> str:
+    """``TRANSIENT``, ``DETERMINISTIC``, or ``""`` for a clean row.
+
+    ``valid=False`` with an empty error string is the runner's soft
+    validation failure — deterministic (same inputs, same mismatch).
+    """
+    error = str(error or "").strip()
+    if not error:
+        return "" if valid else DETERMINISTIC
+    for pattern in TRANSIENT_PATTERNS:
+        if pattern in error:
+            return TRANSIENT
+    return DETERMINISTIC

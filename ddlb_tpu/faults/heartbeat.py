@@ -1,0 +1,58 @@
+"""Worker heartbeat channel: liveness beats over shared memory.
+
+The hung-worker policy before this module had one clock: kill the child
+``worker_timeout`` seconds after it STARTED. That conflates two very
+different children — a slow-but-alive one (a ctx=8192 row legitimately
+compiling for minutes) and a truly hung one (wedged in a collective) —
+and sizing the timeout for the slow case means paying the whole budget
+for every hang.
+
+The channel is a ``multiprocessing.Value('d')`` holding the child's
+last-beat ``time.monotonic()`` stamp — CLOCK_MONOTONIC is system-wide
+on the platforms the fleet runs, so parent and child (same host by
+construction) read one comparable clock, immune to the NTP steps a
+multi-hour capture window will see. The child beats at every phase
+boundary
+(``benchmark_worker``'s stage marks) and every host-clock timing
+iteration — progress points, deliberately NOT a timer thread, because a
+daemon timer keeps beating inside a process whose main thread is wedged,
+which would defeat hang detection entirely. The parent's kill rule
+becomes: dead when ``now - max(start, last_beat) > worker_timeout`` — a
+beating child extends its own deadline, a silent one is killed exactly
+``worker_timeout`` after its last sign of life.
+
+The ``Value`` is created with ``lock=False``: beats are single aligned
+8-byte stores, and a LOCKED value would let a child SIGKILLed mid-beat
+orphan the lock and deadlock the parent's next read — the exact
+unbounded-hang class this channel exists to eliminate. The no-channel
+fast path (every in-process run) is one ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+_channel: Optional[Any] = None
+
+
+def set_channel(channel: Any) -> None:
+    """Install this process's beat channel (the subprocess worker entry
+    does this with the ``Value`` its parent passed); ``None`` detaches."""
+    global _channel
+    _channel = channel
+    if channel is not None:
+        beat()
+
+
+def beat() -> None:
+    """Record a liveness beat (no-op without a channel)."""
+    channel = _channel
+    if channel is not None:
+        channel.value = time.monotonic()
+
+
+def last_beat(channel: Any) -> float:
+    """The child's last beat as ``time.monotonic()`` seconds (0.0 =
+    never beat)."""
+    return float(channel.value)

@@ -1,0 +1,387 @@
+"""Seeded fault plan: named injection sites, deterministic firing.
+
+A plan is JSON — inline in ``DDLB_TPU_FAULT_PLAN`` or a path to a file —
+of the form::
+
+    {"seed": 0, "rules": [
+      {"site": "subprocess.entry", "kind": "hang",
+       "match": {"impl": "jax_spmd_0"}, "fail_attempts": 1},
+      {"site": "worker.warmup", "kind": "transient_error",
+       "match": {"impl": "overlap_0"}},
+      {"site": "worker.validate", "kind": "corrupt",
+       "match": {"impl": "xla_gspmd"}, "fail_attempts": 99}
+    ]}
+
+Rule fields (all optional except ``site`` and ``kind``):
+
+- ``site``: injection-site name, matched with ``fnmatch`` so
+  ``"worker.*"`` covers every worker phase;
+- ``kind``: one of ``hang`` (sleep ``duration_s``, default 3600 — the
+  parent's ``worker_timeout`` is what kills it), ``exit`` (abrupt
+  ``os._exit(exit_code)``, no row posted), ``kill`` (SIGKILL to self,
+  the OOM-killer signature), ``transient_error`` (raises
+  ``TimeoutError`` — the retryable class), ``deterministic_error``
+  (raises ``ValueError`` — parks immediately), ``corrupt`` (consumed by
+  ``corrupt``/``corrupt_row`` at result-carrying sites; ``inject``
+  ignores it);
+- ``match``: substring filters on the active scope's context, e.g.
+  ``{"impl": "overlap"}`` / ``{"primitive": "tp_"}``;
+- ``probability``: firing probability per eligible call (default 1.0),
+  decided by a **deterministic stream** seeded from
+  ``(plan seed, site, call index)`` — same seed, same injections, in
+  any process;
+- ``at``: explicit 0-based per-site call indices to fire on (overrides
+  ``probability``);
+- ``fail_attempts``: fire only while the row's retry attempt (from the
+  active ``scope``) is below this (default 1: the first attempt faults,
+  the retry runs clean — the transient-recovery shape). Set it high to
+  model a deterministic, never-recovering fault;
+- ``duration_s`` / ``exit_code``: kind parameters.
+
+Determinism contract: firing depends only on (plan seed, site name,
+per-site call index within the process, rule match, attempt). A retried
+subprocess worker is a fresh process whose site counters restart at
+zero, so ``fail_attempts`` — not counter state — is what lets a
+transient fault clear on the retry.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from contextlib import contextmanager
+
+from ddlb_tpu import envs, telemetry
+
+_UNSET = object()
+
+_lock = threading.Lock()
+_plan: Any = _UNSET  # _UNSET -> not loaded yet; None -> no plan active
+_counts: Dict[str, int] = {}
+_tls = threading.local()
+#: optional process-wide hook called as fn(site, kind) when a rule
+#: fires — the subprocess worker uses it to announce a fired lifecycle
+#: fault to its parent BEFORE the fault kills the process
+_fire_listener: Optional[Any] = None
+
+
+def set_fire_listener(fn) -> None:
+    """Install (or clear, with None) the fired-rule announcement hook."""
+    global _fire_listener
+    _fire_listener = fn
+
+
+class FaultRule:
+    """One plan rule; see the module docstring for field semantics."""
+
+    def __init__(self, spec: Dict[str, Any]) -> None:
+        if "site" not in spec or "kind" not in spec:
+            raise ValueError(f"fault rule needs 'site' and 'kind': {spec!r}")
+        self.site = str(spec["site"])
+        self.kind = str(spec["kind"])
+        if self.kind not in (
+            "hang", "exit", "kill", "transient_error",
+            "deterministic_error", "corrupt",
+        ):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        self.match = {str(k): str(v) for k, v in spec.get("match", {}).items()}
+        self.probability = float(spec.get("probability", 1.0))
+        self.at = spec.get("at")
+        if self.at is not None:
+            self.at = [int(i) for i in self.at]
+        self.fail_attempts = int(spec.get("fail_attempts", 1))
+        self.duration_s = float(spec.get("duration_s", 3600.0))
+        self.exit_code = int(spec.get("exit_code", 1))
+
+    def matches(self, site: str, context: Dict[str, str]) -> bool:
+        if not fnmatch.fnmatchcase(site, self.site):
+            return False
+        for key, needle in self.match.items():
+            if needle not in context.get(key, ""):
+                return False
+        return True
+
+    def fires(self, seed: int, site: str, count: int, attempt: int) -> bool:
+        """Deterministic firing decision for per-site call ``count``."""
+        if attempt >= self.fail_attempts:
+            return False
+        if self.at is not None:
+            return count in self.at
+        if self.probability >= 1.0:
+            return True
+        # str seeds hash via SHA-512 in CPython's Random — stable across
+        # processes and runs, unlike hash() (which is salted)
+        rng = random.Random(f"{seed}:{site}:{count}")
+        return rng.random() < self.probability
+
+
+class FaultPlan:
+    """A parsed plan: seed + ordered rule list (first match wins)."""
+
+    def __init__(self, spec: Dict[str, Any]) -> None:
+        self.seed = int(spec.get("seed", 0))
+        self.rules: List[FaultRule] = [
+            FaultRule(r) for r in spec.get("rules", [])
+        ]
+
+    def pick(
+        self, site: str, count: int, context: Dict[str, str], attempt: int,
+        kinds: Optional[tuple] = None,
+    ) -> Optional[FaultRule]:
+        """First rule that matches ``site``/``context`` and fires at this
+        call index, restricted to ``kinds`` when given."""
+        for rule in self.rules:
+            if kinds is not None and rule.kind not in kinds:
+                continue
+            if rule.matches(site, context) and rule.fires(
+                self.seed, site, count, attempt
+            ):
+                return rule
+        return None
+
+
+def load_plan(text: Optional[str] = None) -> Optional[FaultPlan]:
+    """Parse a plan from ``text`` (inline JSON or a file path), defaulting
+    to ``DDLB_TPU_FAULT_PLAN``; caches the result. Returns None (and
+    keeps the zero-overhead fast path) when the knob is unset/empty. A
+    malformed plan raises: a chaos run silently running fault-free would
+    defeat its purpose."""
+    global _plan
+    with _lock:
+        if text is None and _plan is not _UNSET:
+            return _plan
+        raw = text if text is not None else envs.get_fault_plan()
+        raw = (raw or "").strip()
+        if not raw:
+            _plan = None
+            return None
+        if not raw.lstrip().startswith("{"):
+            with open(raw, encoding="utf-8") as f:
+                raw = f.read()
+        _plan = FaultPlan(json.loads(raw))
+        return _plan
+
+
+def reset() -> None:
+    """Drop the cached plan, per-site counters, and any fire listener
+    (test helper)."""
+    global _plan, _fire_listener
+    with _lock:
+        _plan = _UNSET
+        _counts.clear()
+        _fire_listener = None
+
+
+def active() -> bool:
+    """True when a fault plan is loaded (loading it on first call)."""
+    return load_plan() is not None
+
+
+# ---------------------------------------------------------------------------
+# Scope: retry-attempt / impl context + fired-site collection
+# ---------------------------------------------------------------------------
+
+
+class _Scope:
+    """One active frame: match context plus the sites that fired in it."""
+
+    def __init__(self, context: Dict[str, str], attempt: int) -> None:
+        self.context = context
+        self.attempt = attempt
+        self.fired: List[str] = []
+
+
+def _frames() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = []
+        _tls.stack = stack
+    return stack
+
+
+@contextmanager
+def scope(
+    attempt: int = 0, **context: Any
+) -> Iterator[_Scope]:
+    """Frame under which injection sites see this row's retry ``attempt``
+    and match ``context`` (impl=..., primitive=...), and which collects
+    the names of sites that fired — the row's ``fault_injected`` column.
+    Nests: an inner frame shadows context, fired sites land in every
+    active frame."""
+    frame = _Scope(
+        {k: str(v) for k, v in context.items() if v is not None},
+        int(attempt),
+    )
+    stack = _frames()
+    stack.append(frame)
+    try:
+        yield frame
+    finally:
+        stack.remove(frame)
+
+
+def _active_frame() -> Optional[_Scope]:
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _next_count(site: str) -> int:
+    with _lock:
+        count = _counts.get(site, 0)
+        _counts[site] = count + 1
+    return count
+
+
+def _fired(site: str, rule: FaultRule) -> None:
+    telemetry.record("fault.injected")
+    telemetry.instant(
+        "fault.inject", cat="fault", site=site, kind=rule.kind
+    )
+    telemetry.warn(f"fault injected: kind={rule.kind} at site={site}")
+    for frame in _frames():
+        frame.fired.append(site)
+    listener = _fire_listener
+    if listener is not None:
+        try:
+            listener(site, rule.kind)
+        except Exception as exc:
+            telemetry.warn(
+                f"fault fire listener failed: {type(exc).__name__}: {exc}"
+            )
+
+
+# ---------------------------------------------------------------------------
+# Injection entry points
+# ---------------------------------------------------------------------------
+
+
+def _resolve(site: str, context: Dict[str, Any], kinds: tuple, fire=True):
+    """Shared slow path: the firing rule for this call of ``site`` under
+    the active scope's context, or None. Callers already checked that a
+    plan might be active (the ``is None`` fast path). ``fire=False``
+    defers the fired-bookkeeping to the caller — for faults that may
+    turn out inapplicable (corruption of an unsupported value type),
+    which must never be RECORDED as injected without actually
+    happening."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = load_plan()
+    if plan is None:
+        return None
+    frame = _active_frame()
+    ctx = dict(frame.context) if frame else {}
+    for key, value in context.items():
+        if value is not None:
+            ctx[key] = str(value)
+    rule = plan.pick(
+        site, _next_count(site), ctx, frame.attempt if frame else 0,
+        kinds=kinds,
+    )
+    if rule is not None and fire:
+        _fired(site, rule)
+    return rule
+
+
+def inject(site: str, **context: Any) -> None:
+    """Injection site: no-op unless a loaded plan has a firing rule here,
+    in which case the configured fault happens (raise / hang / abrupt
+    process death). The no-plan fast path is one ``is None`` check."""
+    if _plan is None:
+        return
+    rule = _resolve(
+        site, context,
+        ("hang", "exit", "kill", "transient_error", "deterministic_error"),
+    )
+    if rule is None:
+        return
+    if rule.kind == "hang":
+        time.sleep(rule.duration_s)
+        return
+    if rule.kind == "exit":
+        os._exit(rule.exit_code)
+    if rule.kind == "kill":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    if rule.kind == "transient_error":
+        raise TimeoutError(
+            f"injected transient fault at {site} (a retry should clear it)"
+        )
+    raise ValueError(f"injected deterministic fault at {site}")
+
+
+def _corrupt_value(value: Any) -> Any:
+    """``3x + 1`` elementwise, through tuple/list pytree structure —
+    breaks exact AND tolerance-based validation for any nonzero
+    result."""
+    if isinstance(value, (tuple, list)):
+        return type(value)(_corrupt_value(v) for v in value)
+    return value * 3 + 1
+
+
+def corrupt(site: str, value: Any, **context: Any) -> Any:
+    """Result-carrying injection site: returns ``value`` untouched unless
+    a ``corrupt`` rule fires, in which case the result comes back
+    numerically wrong so the validation layer must catch it. The site is
+    recorded as fired ONLY when the corruption actually applied — a
+    value the transform cannot touch is passed through with a loud
+    warning, never silently claimed as injected."""
+    if _plan is None:
+        return value
+    rule = _resolve(site, context, ("corrupt",), fire=False)
+    if rule is None:
+        return value
+    try:
+        corrupted = _corrupt_value(value)
+    except TypeError:
+        telemetry.warn(
+            f"corrupt rule at {site} cannot corrupt a "
+            f"{type(value).__name__}; value passed through UNCORRUPTED"
+        )
+        return value
+    _fired(site, rule)
+    return corrupted
+
+
+def corrupt_row(site: str, row: Dict[str, Any], **context: Any) -> Dict[str, Any]:
+    """Row-carrying injection site (the subprocess worker's posted
+    result): when a ``corrupt`` rule fires, the row's timing statistics
+    are replaced with NaN and it is marked invalid with an attributable
+    error — the "corrupted-result numerics" failure a flaky transport
+    produces, made deterministic."""
+    if _plan is None:
+        return row
+    if _resolve(site, context, ("corrupt",)) is None:
+        return row
+    for key in row:
+        if key.endswith("time (ms)") or key.startswith("Throughput"):
+            row[key] = float("nan")
+    row["valid"] = False
+    row["error"] = f"CorruptedResult: injected numerics corruption at {site}"
+    from ddlb_tpu.faults.classify import classify_error
+
+    row["error_class"] = classify_error(row["error"], valid=False)
+    fired = str(row.get("fault_injected") or "")
+    row["fault_injected"] = f"{fired},{site}" if fired else site
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Retry backoff
+# ---------------------------------------------------------------------------
+
+
+def backoff_delays(base_s: float, retries: int, seed: str = "") -> List[float]:
+    """The runner's retry schedule: exponential backoff with full jitter
+    (``base * 2^i * (1 + U[0,1))``), deterministically seeded so a
+    replayed sweep waits the same way. Pure so tests can pin it."""
+    rng = random.Random(f"backoff:{seed}")
+    return [
+        base_s * (2 ** i) * (1.0 + rng.random()) for i in range(retries)
+    ]

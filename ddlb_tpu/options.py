@@ -9,6 +9,7 @@ duplication explicitly) into one module.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Any, Dict, Mapping, Optional
 
 # Keys consumed by the benchmark layer, silently ignored by primitives
@@ -112,5 +113,15 @@ class EnvVarGuard:
     def __del__(self) -> None:  # pragma: no cover - GC timing dependent
         try:
             self.restore()
-        except Exception:
-            pass
+        except Exception as exc:
+            # __del__ must not raise, but a swallowed restore failure
+            # would leak env mutations into later rows — log it unless
+            # the interpreter is already tearing down (where the logger
+            # itself may be half-collected)
+            if not sys.is_finalizing():
+                from ddlb_tpu import telemetry
+
+                telemetry.warn(
+                    f"EnvVarGuard restore failed during GC: "
+                    f"{type(exc).__name__}: {exc}"
+                )

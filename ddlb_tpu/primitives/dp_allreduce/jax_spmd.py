@@ -19,6 +19,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class JaxSPMDDPAllReduce(DPAllReduce):
@@ -49,8 +50,11 @@ class JaxSPMDDPAllReduce(DPAllReduce):
             )  # [m/d, n] reduced rows
             return jax.lax.all_gather(shard, "tp", axis=0, tiled=True)
 
+        # shard_map_compat: jax.shard_map where available, the pre-0.5
+        # experimental entry point otherwise (ROADMAP open item — this
+        # unlocks the family on the jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

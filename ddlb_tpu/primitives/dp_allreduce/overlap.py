@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu import native
 from ddlb_tpu.primitives.base import accum_wire_dtypes
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class OverlapDPAllReduce(DPAllReduce):
@@ -72,8 +73,11 @@ class OverlapDPAllReduce(DPAllReduce):
             "coll_pipeline": self._build_coll_pipeline,
             "p2p_pipeline": self._build_p2p_pipeline,
         }[algo]
+        # shard_map_compat: jax.shard_map where available, the pre-0.5
+        # experimental entry point otherwise (ROADMAP open item — this
+        # unlocks the family on the jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 build(),
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

@@ -27,7 +27,7 @@ import threading
 import time
 from typing import Optional, Sequence, Tuple
 
-from ddlb_tpu import envs, telemetry
+from ddlb_tpu import envs, faults, telemetry
 
 _SIM_FLAG = "--xla_force_host_platform_device_count"
 
@@ -78,8 +78,13 @@ def configure_compile_cache() -> Optional[str]:
             from jax._src import compilation_cache
 
             compilation_cache.reset_cache()
-        except Exception:
-            pass  # older/newer layouts re-read the config themselves
+        except Exception as exc:
+            # older/newer layouts re-read the config themselves; logged
+            # (not swallowed) so a layout where they DON'T is visible
+            telemetry.log(
+                f"compilation cache reset unavailable "
+                f"({type(exc).__name__}: {exc}); relying on config re-read"
+            )
     return path
 
 
@@ -222,6 +227,10 @@ class Runtime:
         """
         import jax
 
+        # collective-infrastructure injection site: a mesh build is the
+        # first thing every impl's setup does, so a fault here models a
+        # backend that died before any collective ran
+        faults.inject("runtime.mesh")
         if shape is None:
             shape = (self.num_devices,) if len(axis_names) == 1 else None
         if shape is None:
@@ -299,8 +308,15 @@ class Runtime:
                     (1, per), (self.num_slices, 1), devices=self.devices
                 )
                 return jax.sharding.Mesh(arr, tuple(axis_names))
-            except Exception:
-                pass  # simulated slices: PJRT lacks real slice topology
+            except Exception as exc:
+                # simulated slices: PJRT lacks real slice topology, so
+                # fall through to the grouped reshape — logged so a
+                # REAL pod landing here (losing the hierarchical
+                # layout) is diagnosable
+                telemetry.log(
+                    f"hybrid mesh fell back to grouped reshape "
+                    f"({type(exc).__name__}: {exc})"
+                )
         order = sorted(
             range(self.num_devices), key=lambda i: (self.slice_ids[i], i)
         )
@@ -324,6 +340,11 @@ class Runtime:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # collective-entry injection site: the barrier is the one
+        # collective EVERY timing path crosses, so a fault here models a
+        # wedged transport mid-sweep (e.g. hang = a peer that never
+        # arrives; the subprocess parent's heartbeat kill recovers it)
+        faults.inject("runtime.barrier")
         with telemetry.span("runtime.barrier", cat="barrier"):
             if self._barrier_call is None:
                 # built once per process: a fresh closure would re-trace

@@ -65,8 +65,11 @@ def _save_cache(path: str, data: Dict[str, Any]) -> None:
         with open(tmp, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
         os.replace(tmp, path)
-    except Exception:
-        pass
+    except Exception as exc:
+        telemetry.warn(
+            f"autotune cache write to {path} failed: "
+            f"{type(exc).__name__}: {exc}"
+        )
 
 
 def make_key(

@@ -47,7 +47,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ddlb_tpu import telemetry
+from ddlb_tpu import faults, telemetry
 
 # ---------------------------------------------------------------------------
 # Compile metrics: who paid for compilation, and did the cache answer
@@ -228,6 +228,10 @@ def _aot_compile(fn, args) -> None:
     """
     import jax
 
+    # compile-phase injection site: a transient fault here models the
+    # flaky-compile class (XLA OOM during lowering, a compile-server
+    # flap) that poisoned real capture windows
+    faults.inject("compile.aot")
     if not hasattr(fn, "lower"):
         fn = jax.jit(fn)
     fn.lower(*args).compile()
@@ -247,6 +251,7 @@ def prefetch_compile(config: Dict[str, Any]) -> int:
     from ddlb_tpu.primitives.registry import load_impl_class
     from ddlb_tpu.utils.timing import make_timed_loop
 
+    faults.inject("compile.prefetch", impl=config.get("impl_id"))
     impl_class = load_impl_class(
         config["primitive"], config["base_implementation"]
     )

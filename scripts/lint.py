@@ -163,6 +163,56 @@ def _print_checks(path: Path, tree: ast.Module) -> list:
     return out
 
 
+def _swallow_checks(path: Path, tree: ast.Module) -> list:
+    """Ban silent broad-exception swallows in package code (ISSUE 4
+    satellite): an ``except Exception: pass`` (or bare ``except:``)
+    whose body does nothing turns a real failure into an invisible one —
+    exactly the class the fault-injection harness exists to provoke.
+    Every handler must re-raise, return an error value, or log via
+    telemetry (any non-pass body satisfies the check); narrow exception
+    types (``OSError``, ``ValueError``) remain legitimate control
+    flow."""
+
+    def _names(node):
+        if node is None:
+            return ["<bare>"]
+        elts = node.elts if isinstance(node, ast.Tuple) else [node]
+        out = []
+        for e in elts:
+            if isinstance(e, ast.Name):
+                out.append(e.id)
+            elif isinstance(e, ast.Attribute):
+                out.append(e.attr)
+            else:
+                out.append("?")
+        return out
+
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        silent = all(
+            isinstance(stmt, ast.Pass)
+            or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis
+            )
+            for stmt in node.body
+        )
+        names = _names(node.type)
+        broad = node.type is None or any(
+            n in ("Exception", "BaseException") for n in names
+        )
+        if silent and broad:
+            problems.append(
+                f"{path}:{node.lineno}: swallow: silent "
+                f"'except {', '.join(names)}: pass' — re-raise, return "
+                f"an error row, or log via ddlb_tpu.telemetry"
+            )
+    return problems
+
+
 def _docstring_checks(path: Path, tree: ast.Module) -> list:
     """pydocstyle-lite floor for the PACKAGE (not tests/scripts): every
     module needs a docstring, and every public class needs one UNLESS it
@@ -200,6 +250,7 @@ def check_file(path: Path) -> list:
     extra = _security_checks(path, tree)
     if path.parts[:1] == ("ddlb_tpu",) or "/ddlb_tpu/" in str(path):
         extra += _docstring_checks(path, tree)
+        extra += _swallow_checks(path, tree)
         if not (set(path.parts) & _PRINT_EXEMPT_DIRS):
             extra += _print_checks(path, tree)
     if _has_star_import(tree):

@@ -39,6 +39,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 # must never initialize in the queue process) — spans around each row
 # attempt and parking decision make a capture window's trace attributable
 from ddlb_tpu import telemetry  # noqa: E402
+# the transient-vs-deterministic split shared with the sweep runner
+# (also JAX-free): deterministic failures park IMMEDIATELY instead of
+# burning a second capture-window pass on a config that cannot succeed
+from ddlb_tpu.faults.classify import DETERMINISTIC, classify_error  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATE_PATH = os.path.join(REPO, "hwlogs", "queue_state.json")
@@ -610,6 +614,16 @@ def _run_action(entry) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _is_parked(rec) -> bool:
+    """Parked = exhausted its attempt budget, OR explicitly parked early
+    (deterministic failure). A separate flag keeps the persisted attempt
+    count truthful: an early-parked entry records how many passes
+    actually ran, not a fabricated MAX_ATTEMPTS."""
+    return not rec.get("done") and (
+        bool(rec.get("parked")) or rec.get("attempts", 0) >= MAX_ATTEMPTS
+    )
+
+
 def _load_state(path):
     try:
         with open(path) as f:
@@ -678,6 +692,28 @@ def _run_row(entry, base_proto, run_fn):
     return row
 
 
+def _print_parked_summary(queue, state) -> None:
+    """End-of-run table of parked entries with their persisted reasons
+    (last error + transient/deterministic class), so a parked row is
+    diagnosable from the run log alone."""
+    parked = []
+    for entry in queue:
+        rec = state.get(entry_key(entry), {})
+        if _is_parked(rec):
+            parked.append((entry, rec))
+    if not parked:
+        return
+    print(f"\n== parked entries ({len(parked)}) ==", flush=True)
+    print(f"{'label':<44} {'att':>3} {'class':<13} last error")
+    for entry, rec in parked:
+        print(
+            f"{entry['label'][:44]:<44} {rec.get('attempts', 0):>3} "
+            f"{(rec.get('error_class') or '-'):<13} "
+            f"{(rec.get('error') or '-')[:90]}",
+            flush=True,
+        )
+
+
 def main(argv=None, run_fn=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if "--parity-child" in argv:
@@ -726,6 +762,7 @@ def main(argv=None, run_fn=None) -> int:
             rec = state.get(entry_key(entry), {})
             status = (
                 "done" if rec.get("done")
+                else f"parked x{rec['attempts']}" if _is_parked(rec)
                 else f"failed x{rec['attempts']}" if rec.get("attempts")
                 else "pending"
             )
@@ -749,9 +786,9 @@ def main(argv=None, run_fn=None) -> int:
         if rec.get("done"):
             skipped += 1
             continue
-        if rec.get("attempts", 0) >= MAX_ATTEMPTS:
-            print(f"[queue] parked after {rec['attempts']} failed attempts: "
-                  f"{entry['label']}", flush=True)
+        if _is_parked(rec):
+            print(f"[queue] parked after {rec['attempts']} failed "
+                  f"attempt(s): {entry['label']}", flush=True)
             telemetry.instant(
                 "queue.parked", cat="queue", label=entry["label"],
                 attempts=rec["attempts"],
@@ -788,15 +825,39 @@ def main(argv=None, run_fn=None) -> int:
                 label=entry["label"], attempt=attempt,
             ):
                 row = _run_row(entry, base_proto, run_fn)
-            ok = not row.get("error")
+            err = str(row.get("error") or "")
+            ok = not err
+            # the park reason is PERSISTED (last error + its class) so a
+            # parked entry is diagnosable from queue_state.json and the
+            # end-of-run summary, without grepping capture logs
+            cls = str(row.get("error_class") or "") or classify_error(
+                err, valid=bool(row.get("valid", True))
+            )
             rec = {
                 "attempts": attempt,
                 "done": ok,
                 "label": entry["label"],
-                "error": str(row.get("error") or ""),
+                "error": err,
+                "error_class": cls,
             }
             if not ok:
                 failed += 1
+                if cls == DETERMINISTIC and attempt < MAX_ATTEMPTS:
+                    # a deterministic failure (bad option, validation
+                    # mismatch) returns the same answer on every pass:
+                    # park now instead of re-burning MAX_ATTEMPTS
+                    # relay windows on it (attempts stays truthful —
+                    # the parked flag is what later passes honor)
+                    rec["parked"] = True
+                    print(
+                        f"[queue] parking immediately (deterministic "
+                        f"failure): {entry['label']} — {err[:120]}",
+                        flush=True,
+                    )
+                    telemetry.instant(
+                        "queue.parked", cat="queue", label=entry["label"],
+                        attempts=attempt, error_class=cls,
+                    )
         state[key] = rec
         # checkpoint after EVERY entry: a flap mid-queue loses nothing
         _save_state(state_path, state)
@@ -806,6 +867,7 @@ def main(argv=None, run_fn=None) -> int:
         f"(state: {state_path})",
         flush=True,
     )
+    _print_parked_summary(queue, state)
     # per-row children wrote their own shards (DDLB_TPU_TRACE propagates
     # through the environment); join them into the loadable trace.json
     merged = telemetry.merge_trace()

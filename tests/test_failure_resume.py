@@ -258,6 +258,103 @@ def test_resume_different_shape_not_skipped(tmp_path):
     assert len(df) == 1  # same impl, new shape -> runs
 
 
+def test_resume_across_retried_row(tmp_path, monkeypatch):
+    """ISSUE 4: a row that RECOVERED via the self-healing retry path
+    (retries > 0, valid=True) is a completed measurement — resume must
+    skip it, not re-run it; and the recorded row carries the retry
+    attribution columns."""
+    import json
+
+    from ddlb_tpu import faults
+
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        retry_backoff_s=0.01,
+        **SHAPE,
+    )
+    monkeypatch.setenv(
+        "DDLB_TPU_FAULT_PLAN",
+        json.dumps({"seed": 0, "rules": [
+            {"site": "worker.warmup", "kind": "transient_error",
+             "fail_attempts": 1},
+        ]}),
+    )
+    faults.reset()
+    try:
+        df1 = PrimitiveBenchmarkRunner(
+            "tp_columnwise", max_retries=1, **common
+        ).run()
+    finally:
+        monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+        faults.reset()
+    assert len(df1) == 1
+    assert df1.iloc[0]["valid"] == True  # noqa: E712
+    assert df1.iloc[0]["retries"] == 1
+    assert df1.iloc[0]["fault_injected"] == "worker.warmup"
+
+    # the recovered row is complete: a fault-free resume skips it
+    df2 = PrimitiveBenchmarkRunner(
+        "tp_columnwise", resume=True, **common
+    ).run()
+    assert len(df2) == 0
+
+    import pandas as pd
+
+    on_disk = pd.read_csv(csv)
+    assert len(on_disk) == 1  # exactly one recorded row for the config
+    assert int(on_disk.iloc[0]["retries"]) == 1
+
+
+def test_resume_retries_row_with_exhausted_retries(tmp_path, monkeypatch):
+    """A row whose retry budget ran out (error recorded) is NOT complete:
+    resume runs it again, and the clean re-run supersedes it."""
+    import json
+
+    from ddlb_tpu import faults
+
+    csv = str(tmp_path / "sweep.csv")
+    common = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        output_csv=csv,
+        progress=False,
+        retry_backoff_s=0.01,
+        **SHAPE,
+    )
+    monkeypatch.setenv(
+        "DDLB_TPU_FAULT_PLAN",
+        json.dumps({"seed": 0, "rules": [
+            {"site": "worker.warmup", "kind": "transient_error",
+             "fail_attempts": 99},
+        ]}),
+    )
+    faults.reset()
+    try:
+        df1 = PrimitiveBenchmarkRunner(
+            "tp_columnwise", max_retries=1, **common
+        ).run()
+    finally:
+        monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+        faults.reset()
+    assert df1.iloc[0]["retries"] == 1
+    assert "injected transient fault" in df1.iloc[0]["error"]
+
+    df2 = PrimitiveBenchmarkRunner(
+        "tp_columnwise", resume=True, **common
+    ).run()
+    assert len(df2) == 1  # retried on resume, not skipped
+    assert df2.iloc[0]["error"] == ""
+    assert df2.iloc[0]["valid"] == True  # noqa: E712
+
+
 @pytest.mark.slow
 def test_hung_worker_killed(tmp_path):
     """A worker spinning far past the timeout becomes an error row instead

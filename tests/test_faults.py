@@ -1,0 +1,543 @@
+"""Fault-injection harness + self-healing runner (ISSUE 4).
+
+What matters: a seeded plan injects deterministically (same seed, same
+sites); the runner retries only transient failures, on the documented
+backoff schedule; deterministic failures are classified and recorded
+without retry; an impl failing repeatedly is quarantined with cheap
+classified rows; and the heartbeat channel extends a slow-but-alive
+child's deadline while a silent hang is killed at worker_timeout.
+"""
+
+import json
+import queue as queue_mod
+import time
+
+import numpy as np
+import pytest
+
+from ddlb_tpu import faults
+from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner, make_result_row
+from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.faults.classify import (
+    DETERMINISTIC,
+    TRANSIENT,
+    classify_error,
+)
+from ddlb_tpu.faults.plan import FaultPlan, backoff_delays
+
+SHAPE = dict(m=128, n=32, k=64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan(monkeypatch):
+    """Each test starts and ends with no cached plan or site counters."""
+    monkeypatch.delenv("DDLB_TPU_FAULT_PLAN", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _set_plan(monkeypatch, rules, seed=0):
+    monkeypatch.setenv(
+        "DDLB_TPU_FAULT_PLAN", json.dumps({"seed": seed, "rules": rules})
+    )
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# Plan mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_plan_determinism_same_seed_same_sites():
+    """Probabilistic rules fire on the same call indices for the same
+    seed, in any process — and on different ones for a different seed."""
+    def pattern(seed):
+        plan = FaultPlan(
+            {"seed": seed,
+             "rules": [{"site": "s", "kind": "hang", "probability": 0.5,
+                        "fail_attempts": 99}]}
+        )
+        return [
+            plan.pick("s", count, {}, attempt=0) is not None
+            for count in range(200)
+        ]
+
+    a, b, c = pattern(7), pattern(7), pattern(8)
+    assert a == b
+    assert a != c
+    assert 50 < sum(a) < 150  # it is actually probabilistic
+
+
+def test_plan_env_gating_and_zero_overhead(monkeypatch):
+    # unset -> inject is a no-op (and stays one cached None check)
+    faults.inject("worker.setup")
+    assert not faults.active()
+    _set_plan(monkeypatch, [
+        {"site": "worker.setup", "kind": "deterministic_error"}
+    ])
+    assert faults.active()
+    with pytest.raises(ValueError, match="injected deterministic"):
+        faults.inject("worker.setup")
+
+
+def test_plan_file_form(tmp_path, monkeypatch):
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps({"seed": 1, "rules": [
+        {"site": "x", "kind": "transient_error"}
+    ]}))
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", str(path))
+    faults.reset()
+    with pytest.raises(TimeoutError, match="injected transient"):
+        faults.inject("x")
+
+
+def test_rule_site_glob_and_match_filters(monkeypatch):
+    _set_plan(monkeypatch, [
+        {"site": "worker.*", "kind": "deterministic_error",
+         "match": {"impl": "overlap"}, "fail_attempts": 99},
+    ])
+    # context mismatch: no fire
+    with faults.scope(impl="jax_spmd_0"):
+        faults.inject("worker.setup")
+    # glob + substring context match: fires
+    with faults.scope(impl="overlap_3"):
+        with pytest.raises(ValueError):
+            faults.inject("worker.timing")
+
+
+def test_fail_attempts_gates_on_retry_attempt(monkeypatch):
+    """The transient-recovery shape: attempt 0 faults, attempt 1 clean."""
+    _set_plan(monkeypatch, [
+        {"site": "s", "kind": "transient_error", "fail_attempts": 1}
+    ])
+    with faults.scope(attempt=0):
+        with pytest.raises(TimeoutError):
+            faults.inject("s")
+    with faults.scope(attempt=1):
+        faults.inject("s")  # no raise
+
+
+def test_scope_collects_fired_sites(monkeypatch):
+    _set_plan(monkeypatch, [
+        {"site": "a", "kind": "transient_error", "fail_attempts": 99}
+    ])
+    with faults.scope() as fs:
+        with pytest.raises(TimeoutError):
+            faults.inject("a")
+        faults.inject("b")  # no rule: not recorded
+    assert fs.fired == ["a"]
+
+
+def test_corrupt_array_and_row(monkeypatch):
+    _set_plan(monkeypatch, [
+        {"site": "worker.result", "kind": "corrupt", "fail_attempts": 99},
+        {"site": "subprocess.result", "kind": "corrupt", "fail_attempts": 99},
+    ])
+    arr = np.ones(4)
+    out = faults.corrupt("worker.result", arr)
+    assert not np.allclose(out, arr)
+    assert np.allclose(faults.corrupt("other.site", arr), arr)
+
+    row = {"median time (ms)": 1.0, "Throughput (TFLOPS)": 2.0,
+           "valid": True, "error": ""}
+    row = faults.corrupt_row("subprocess.result", row)
+    assert row["valid"] is False
+    assert "CorruptedResult" in row["error"]
+    assert np.isnan(row["median time (ms)"])
+    assert row["error_class"] == DETERMINISTIC
+
+
+def test_corrupt_pytree_and_inapplicable_value(monkeypatch):
+    """Corruption reaches tuple/list leaves; a value it cannot touch is
+    passed through WITHOUT being recorded as injected (a chaos CSV must
+    never claim a fault that did not happen)."""
+    _set_plan(monkeypatch, [
+        {"site": "worker.result", "kind": "corrupt", "fail_attempts": 99},
+    ])
+    with faults.scope() as fs:
+        a, b = faults.corrupt("worker.result", (np.ones(2), [np.ones(3)]))
+    assert not np.allclose(a, np.ones(2))
+    assert not np.allclose(b[0], np.ones(3))
+    assert fs.fired == ["worker.result"]
+    with faults.scope() as fs:
+        out = faults.corrupt("worker.result", object())
+    assert fs.fired == []  # inapplicable: passed through, not claimed
+
+
+def test_fire_listener_announces_fired_rules(monkeypatch):
+    _set_plan(monkeypatch, [
+        {"site": "subprocess.entry", "kind": "transient_error",
+         "fail_attempts": 99},
+    ])
+    announced = []
+    faults.set_fire_listener(lambda site, kind: announced.append((site, kind)))
+    with pytest.raises(TimeoutError):
+        faults.inject("subprocess.entry")
+    assert announced == [("subprocess.entry", "transient_error")]
+
+
+def test_malformed_plan_raises(monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", '{"rules": [{"kind": "hang"}]}')
+    faults.reset()
+    with pytest.raises(ValueError, match="site"):
+        faults.active()
+
+
+# ---------------------------------------------------------------------------
+# Classification + backoff
+# ---------------------------------------------------------------------------
+
+
+def test_classify_error_split():
+    assert classify_error("") == ""
+    assert classify_error("", valid=False) == DETERMINISTIC  # validation
+    assert classify_error("TimeoutError: worker silent for 25s") == TRANSIENT
+    assert classify_error("WorkerDied: exit code -9 with no result") == TRANSIENT
+    assert classify_error("RESOURCE_EXHAUSTED: out of memory") == TRANSIENT
+    assert classify_error("ValueError: m=96 must be divisible") == DETERMINISTIC
+    assert classify_error("validation crashed: TypeError: x") == DETERMINISTIC
+    assert classify_error("SomethingNovel: who knows") == DETERMINISTIC
+
+
+def test_backoff_schedule_exponential_with_jitter():
+    delays = backoff_delays(0.5, 4, seed="impl_0")
+    assert delays == backoff_delays(0.5, 4, seed="impl_0")  # deterministic
+    assert delays != backoff_delays(0.5, 4, seed="impl_1")
+    for i, d in enumerate(delays):
+        assert 0.5 * 2 ** i <= d < 0.5 * 2 ** i * 2  # base*2^i * (1+U[0,1))
+
+
+# ---------------------------------------------------------------------------
+# Self-healing runner (stubbed worker: no device work)
+# ---------------------------------------------------------------------------
+
+
+def _stub_row(config, error="", valid=True, error_class=None):
+    return make_result_row(
+        config,
+        times_ms=np.array([1.0]) if not error else np.array([float("nan")]),
+        flop_count=1e9,
+        option_repr="-",
+        valid=valid,
+        error=error,
+        world_size=8,
+        num_processes=1,
+        platform="cpu",
+        error_class=(
+            classify_error(error, valid) if error_class is None else error_class
+        ),
+    )
+
+
+def _runner(**over):
+    kwargs = dict(
+        implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+        dtype="float32",
+        progress=False,
+        retry_backoff_s=0.01,
+        **SHAPE,
+    )
+    kwargs.update(over)
+    return PrimitiveBenchmarkRunner("tp_columnwise", **kwargs)
+
+
+def test_transient_failures_retry_then_succeed(monkeypatch):
+    calls = []
+
+    def worker(config):
+        calls.append(config.get("fault_attempt"))
+        if len(calls) < 3:
+            return _stub_row(config, error="TimeoutError: flaky", valid=False)
+        return _stub_row(config)
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    sleeps = []
+    monkeypatch.setattr("ddlb_tpu.benchmark.time.sleep", sleeps.append)
+    df = _runner(max_retries=2).run()
+    assert calls == [0, 1, 2]  # attempt number threaded into the config
+    row = df.iloc[0]
+    assert row["valid"] == True  # noqa: E712
+    assert row["retries"] == 2
+    assert row["error"] == ""
+    # the documented schedule: exponential backoff with jitter
+    assert sleeps == backoff_delays(0.01, 2, seed="jax_spmd_0")[:2]
+
+
+def test_deterministic_failure_not_retried(monkeypatch):
+    calls = []
+
+    def worker(config):
+        calls.append(1)
+        return _stub_row(config, error="ValueError: bad option", valid=False)
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    df = _runner(max_retries=3).run()
+    assert len(calls) == 1  # no retry burned on a deterministic failure
+    row = df.iloc[0]
+    assert row["retries"] == 0
+    assert row["error_class"] == DETERMINISTIC
+
+
+def test_completed_measurement_not_retried_on_validation_crash(monkeypatch):
+    """A validation-phase crash AFTER a completed timing loop keeps the
+    measurement ('times stand') — even a transient-looking error must
+    not discard it for a full-row re-run."""
+    calls = []
+
+    def worker(config):
+        calls.append(1)
+        # finite times + transient-pattern error = the oracle-OOM shape
+        return _stub_row(
+            config,
+            error="validation crashed: XlaRuntimeError: RESOURCE_EXHAUSTED",
+            valid=False,
+        ) | {"median time (ms)": 1.0}
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    df = _runner(max_retries=3).run()
+    assert len(calls) == 1  # the measurement stood; no retry
+    assert df.iloc[0]["retries"] == 0
+    assert df.iloc[0]["error_class"] == TRANSIENT
+
+
+def test_retries_exhaust_and_record_last_error(monkeypatch):
+    def worker(config):
+        return _stub_row(config, error="TimeoutError: always", valid=False)
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    monkeypatch.setattr("ddlb_tpu.benchmark.time.sleep", lambda _s: None)
+    df = _runner(max_retries=2).run()
+    row = df.iloc[0]
+    assert row["retries"] == 2
+    assert row["error_class"] == TRANSIENT
+    assert "TimeoutError" in row["error"]
+
+
+def test_quarantine_after_consecutive_failures(monkeypatch):
+    ran = []
+
+    def worker(config):
+        ran.append(config["impl_id"])
+        return _stub_row(config, error="TimeoutError: dead impl", valid=False)
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    # identical specs so signature grouping cannot reorder the sweep
+    impls = {
+        f"jax_spmd_{i}": {"implementation": "jax_spmd"} for i in range(4)
+    }
+    df = _runner(
+        implementations=impls, max_retries=0, quarantine_after=2
+    ).run()
+    # only the first two configs ever spawned workers
+    assert ran == ["jax_spmd_0", "jax_spmd_1"]
+    assert list(df["quarantined"]) == [False, False, True, True]
+    for _, row in df[df["quarantined"]].iterrows():
+        assert "quarantined" in row["error"]
+        assert row["error_class"] == "quarantined"
+        assert row["valid"] == False  # noqa: E712
+    # the CSV-schema columns exist on every path
+    for col in ("retries", "fault_injected", "error_class", "quarantined"):
+        assert col in df.columns
+
+
+def test_success_resets_quarantine_strikes(monkeypatch):
+    calls = {"n": 0}
+
+    def worker(config):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            return _stub_row(config)  # one success between failures
+        return _stub_row(config, error="TimeoutError: x", valid=False)
+
+    monkeypatch.setattr("ddlb_tpu.benchmark.benchmark_worker", worker)
+    impls = {
+        f"jax_spmd_{i}": {"implementation": "jax_spmd"} for i in range(4)
+    }
+    df = _runner(
+        implementations=impls, max_retries=0, quarantine_after=2
+    ).run()
+    # fail, success, fail, fail -> strikes never reach 2 consecutively
+    # until the very last row, so nothing was quarantined
+    assert not df["quarantined"].any()
+    assert calls["n"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats: deadline extension vs hang kill (scripted child)
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """Alive until killed OR joined (a real child exits right after
+    posting its row, so the post-row bounded join observes it dead)."""
+
+    def __init__(self):
+        self.killed = False
+        self.joined = False
+        self.exitcode = None
+
+    def is_alive(self):
+        return not (self.killed or self.joined)
+
+    def kill(self):
+        self.killed = True
+
+    def join(self, timeout=None):
+        self.joined = True
+
+
+class _FakeQueue:
+    """queue.Queue plus the mp.Queue release surface, delivering ``row``
+    only after ``ready_at`` (wall clock)."""
+
+    def __init__(self, row=None, ready_at=None):
+        self.row = row
+        self.ready_at = ready_at
+        self.closed = False
+        self.join_cancelled = False
+
+    def get(self, timeout=1.0):
+        time.sleep(timeout)
+        if (
+            self.row is not None
+            and self.ready_at is not None
+            and time.time() >= self.ready_at
+        ):
+            return self.row
+        raise queue_mod.Empty
+
+    def close(self):
+        self.closed = True
+
+    def cancel_join_thread(self):
+        self.join_cancelled = True
+
+
+class _Channel:
+    def __init__(self, value=0.0):
+        self.value = value
+
+
+class _BeatingChannel:
+    """A child that beats continuously (always alive, just slow)."""
+
+    @property
+    def value(self):
+        return time.monotonic()
+
+
+def test_silent_hang_killed_at_worker_timeout():
+    runner = _runner(isolation="subprocess", worker_timeout=1.5)
+    proc, q = _FakeProc(), _FakeQueue()
+    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
+    t0 = time.time()
+    row = runner._await_worker_row(config, proc, q, _Channel(0.0))
+    assert proc.killed
+    assert time.time() - t0 < 10.0
+    assert "TimeoutError" in row["error"]
+    assert "no heartbeat" in row["error"]
+    assert row["error_class"] == TRANSIENT
+    # the killed child's queue is released so interpreter exit can never
+    # block on its feeder thread
+    assert q.closed and q.join_cancelled
+
+
+def test_heartbeat_extends_deadline_past_worker_timeout():
+    """A child that is slower than worker_timeout but keeps beating is
+    NOT killed: the row arrives after ~2x the timeout."""
+    runner = _runner(isolation="subprocess", worker_timeout=1.5)
+    proc = _FakeProc()
+    q = _FakeQueue(row={"valid": True, "error": ""}, ready_at=time.time() + 3.0)
+    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
+    row = runner._await_worker_row(config, proc, q, _BeatingChannel())
+    assert not proc.killed
+    assert row == {"valid": True, "error": ""}
+
+
+def test_fault_marker_attributes_child_killing_fault():
+    """A child that announces a fired lifecycle fault and then dies
+    without a row leaves the site in the error row's fault_injected."""
+    runner = _runner(isolation="subprocess", worker_timeout=5.0)
+    proc, q = _FakeProc(), _FakeQueue()
+    # scripted child: marker posted, then death with nothing else queued
+    q.row = None
+    marker = {"__fault_marker__": "subprocess.entry", "kind": "exit"}
+    delivered = [marker]
+
+    def scripted_get(timeout=1.0):
+        if delivered:
+            return delivered.pop(0)
+        proc.joined = True  # child gone after executing the fault
+        raise queue_mod.Empty
+
+    q.get = scripted_get
+    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
+    row = runner._await_worker_row(config, proc, q, _Channel(0.0))
+    assert "WorkerDied" in row["error"]
+    assert row["fault_injected"] == "subprocess.entry"
+    assert row["error_class"] == TRANSIENT
+
+
+def test_heartbeat_channel_beats():
+    channel = _Channel(0.0)
+    heartbeat.set_channel(channel)
+    try:
+        assert channel.value > 0  # set_channel beats immediately
+        before = channel.value
+        time.sleep(0.01)
+        heartbeat.beat()
+        assert heartbeat.last_beat(channel) > before
+    finally:
+        heartbeat.set_channel(None)
+
+
+# ---------------------------------------------------------------------------
+# Worker integration (in-process: injection -> row columns)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_row_carries_fault_columns(monkeypatch):
+    _set_plan(monkeypatch, [
+        {"site": "worker.warmup", "kind": "transient_error",
+         "fail_attempts": 99},
+    ])
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker({
+        "primitive": "tp_columnwise",
+        "impl_id": "jax_spmd_0",
+        "base_implementation": "jax_spmd",
+        "options": {},
+        "dtype": "float32",
+        "num_iterations": 2,
+        "num_warmups": 1,
+        "fault_attempt": 3,
+        **SHAPE,
+    })
+    assert row["fault_injected"] == "worker.warmup"
+    assert row["error_class"] == TRANSIENT
+    assert row["retries"] == 3
+    assert "injected transient fault" in row["error"]
+
+
+def test_plain_sweep_schema_unchanged_except_new_columns(monkeypatch):
+    """With no plan, rows differ from the pre-ISSUE-4 schema only by the
+    four robustness columns (all defaults)."""
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker({
+        "primitive": "tp_columnwise",
+        "impl_id": "compute_only_0",
+        "base_implementation": "compute_only",
+        "options": {},
+        "dtype": "float32",
+        "num_iterations": 2,
+        "num_warmups": 1,
+        **SHAPE,
+    })
+    assert row["retries"] == 0
+    assert row["fault_injected"] == ""
+    assert row["error_class"] == ""
+    assert row["quarantined"] is False
+    assert row["valid"] is True

@@ -153,6 +153,33 @@ def test_failed_rows_retry_then_park(tmp_path, monkeypatch):
     assert first["attempts"] == mq.MAX_ATTEMPTS and not first["done"]
 
 
+def test_deterministic_failure_parks_immediately(tmp_path, monkeypatch):
+    """ISSUE 4: a deterministic failure (the classifier's split) parks on
+    its FIRST pass — with a truthful attempt count and the persisted
+    reason — instead of burning a second capture window."""
+    monkeypatch.setenv("DDLB_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
+    state = tmp_path / "state.json"
+    attempts = []
+
+    def bad_option(config):
+        attempts.append(1)
+        row = _error_row(config)
+        row["error"] = "ValueError: m=96 must be divisible by 8"
+        return row
+
+    args = ["--state", str(state), "--limit", "1", "--only", "r4-hbm"]
+    assert mq.main(args, run_fn=bad_option) == 1
+    st = json.loads(state.read_text())
+    rec = next(iter(st.values()))
+    assert rec["parked"] is True
+    assert rec["attempts"] == 1  # truthful: one pass actually ran
+    assert rec["error_class"] == "deterministic"
+    assert "ValueError" in rec["error"]
+    # the parked entry is skipped on the next pass (the NEXT row runs)
+    assert mq.main(args, run_fn=bad_option) == 1
+    assert len(attempts) == 2  # second call was the second r4-hbm row
+
+
 def test_smoke_queue_runs_without_hardware(tmp_path, monkeypatch):
     monkeypatch.setenv("DDLB_TPU_COMPILE_CACHE", str(tmp_path / "cc"))
     state = tmp_path / "state.json"
