@@ -24,7 +24,14 @@ mechanics:
   working on CPU simulation AND on the real single-chip TPU (children run
   sequentially, each owning the chip for its row; they pay a fresh compile
   unless the persistent cache answers, so the in-process default stays
-  faster);
+  faster). Subprocess rows run on the persistent warm-worker pool
+  (``ddlb_tpu/pool.py``): one long-lived child per environment
+  signature, leased and reused across rows, so process spawn, JAX
+  import, PJRT init and mesh build are paid once per sweep instead of
+  once per row — ``worker_pool=False`` (or ``pool_max_rows=1``) keeps
+  spawn-per-row as the degenerate case, and every row records
+  ``worker_reused`` / ``worker_setup_s`` so the amortization is visible
+  in the CSV;
 - compile-ahead: with ``DDLB_TPU_COMPILE_CACHE`` set, the in-process
   runner AOT-compiles config N+1 on a background thread while config N's
   timing loop runs on device, and every row records ``compile_time_s`` /
@@ -454,6 +461,12 @@ def make_result_row(
         "fault_injected": fault_injected,
         "error_class": error_class,
         "quarantined": bool(quarantined),
+        # the warm-worker-pool columns (ISSUE 5), defaults here so the
+        # schema is identical on every path (in-process rows, pooled
+        # rows, error rows); the subprocess dispatcher overwrites them
+        # with the lease's actual reuse state and setup cost
+        "worker_reused": False,
+        "worker_setup_s": float("nan"),
         "option": option_repr,
         "valid": valid,
         # always present so the CSV header (fixed by the first row written)
@@ -540,51 +553,10 @@ def _row_has_measurement(row: Dict[str, Any]) -> bool:
         return False
 
 
-def _merge_fault_markers(row, markers: List[str]):
-    """Fold the child's announced-fired sites into the row's
-    ``fault_injected`` column (markers first, deduplicated) — the
-    attribution channel for faults that killed the child before it
-    could post a row."""
-    if markers and isinstance(row, dict):
-        fired = [
-            s for s in str(row.get("fault_injected") or "").split(",") if s
-        ]
-        row["fault_injected"] = ",".join(dict.fromkeys(markers + fired))
-    return row
-
-
-def _subprocess_worker(
-    config, queue, heartbeat_channel=None
-):  # pragma: no cover - child process
-    """Subprocess-isolation child entry: benchmark one config, post the
-    row. Installs the parent's heartbeat channel (so phase marks extend
-    the child's deadline) and hosts the subprocess-lifecycle injection
-    sites — ``subprocess.entry`` (hang / abrupt exit / OOM-style
-    SIGKILL before any work) and ``subprocess.result`` (corrupted-result
-    numerics on the posted row). A fired fault is announced to the
-    parent as a queue marker BEFORE it executes, so even a fault that
-    kills this process leaves its site attributable in the parent's
-    error row (the brief sleep lets the queue's feeder thread flush the
-    marker ahead of an abrupt ``os._exit``/SIGKILL)."""
-    if heartbeat_channel is not None:
-        heartbeat.set_channel(heartbeat_channel)
-
-    def _announce(site: str, kind: str) -> None:
-        queue.put({"__fault_marker__": site, "kind": kind})
-        if kind in ("exit", "kill", "hang"):
-            time.sleep(0.25)
-
-    faults.set_fire_listener(_announce)
-    with faults.scope(
-        attempt=int(config.get("fault_attempt", 0) or 0),
-        impl=config.get("impl_id"),
-        primitive=config.get("primitive"),
-    ):
-        faults.inject("subprocess.entry")
-        row = benchmark_worker(config)
-        row = faults.corrupt_row("subprocess.result", row)
-    faults.set_fire_listener(None)
-    queue.put(row)
+# The subprocess-isolation child entry lives in ``ddlb_tpu/pool.py``
+# (``_pool_child_main``): one long-lived dispatch loop per leased
+# worker, hosting the same per-row ``subprocess.entry`` /
+# ``subprocess.result`` fault surface the old spawn-per-row child did.
 
 
 # ---------------------------------------------------------------------------
@@ -623,6 +595,8 @@ class PrimitiveBenchmarkRunner:
         max_retries: Optional[int] = None,
         retry_backoff_s: float = 0.5,
         quarantine_after: Optional[int] = None,
+        worker_pool: Optional[bool] = None,
+        pool_max_rows: Optional[int] = None,
     ) -> None:
         if primitive not in self.ALLOWED_PRIMITIVES:
             raise ValueError(
@@ -680,6 +654,26 @@ class PrimitiveBenchmarkRunner:
         self._strikes: Dict[str, int] = {}
         self._quarantined: set = set()
         self._probed_world_size: Optional[int] = None  # subprocess probe cache
+        # warm-worker-pool knobs (ISSUE 5): default from the environment
+        # (DDLB_TPU_WORKER_POOL / DDLB_TPU_POOL_MAX_ROWS); worker_pool
+        # off degenerates to spawn-per-row (pool_max_rows=1)
+        from ddlb_tpu.envs import get_pool_max_rows, get_worker_pool
+
+        self.worker_pool = (
+            get_worker_pool() if worker_pool is None else bool(worker_pool)
+        )
+        self.pool_max_rows = (
+            get_pool_max_rows()
+            if pool_max_rows is None
+            else int(pool_max_rows)
+        )
+        #: the lease manager, created lazily on the first subprocess row
+        #: and shut down at the end of run()
+        self._pool = None
+        #: config N+1, handed to the leased worker with config N so its
+        #: compile-ahead thread can prefetch (pool-mode analogue of the
+        #: in-process scheduler)
+        self._pool_prefetch: Optional[Dict[str, Any]] = None
 
     def _worker_config(self, impl_id: str, spec: Dict[str, Any]) -> Dict[str, Any]:
         spec = dict(spec)
@@ -755,58 +749,17 @@ class PrimitiveBenchmarkRunner:
 
         rows: List[Dict[str, Any]] = []
         prev_sig = None
-        for idx, (impl_id, spec) in enumerate(iterator):
-            scheduler_busy = False
-            if scheduler is not None:
-                # reap this config's prefetch (launched during the
-                # previous row's timing loop) before touching caches —
-                # never clear under an active compile thread. Bounded:
-                # a prefetch wedged against a dying backend must not
-                # deadlock the sweep (no worker_timeout exists in-process)
-                scheduler.wait(timeout=scheduler.WAIT_TIMEOUT_S)
-                scheduler_busy = scheduler.busy
-                if scheduler_busy:
-                    telemetry.warn(
-                        "compile-ahead prefetch still running after the "
-                        "bounded wait; skipping the cache clear this "
-                        "boundary (clearing under an active compile "
-                        "thread races the global caches)"
-                    )
-            sig = sigs[impl_id]
-            if (
-                self.isolation == "none"
-                and not scheduler_busy
-                and prev_sig is not None
-                and sig != prev_sig
-            ):
-                # cache-aware clearing: the cross-impl isolation contract
-                # now holds at executable-signature boundaries instead of
-                # per row — same-signature neighbors share the warm cache
-                # (the persistent disk cache is untouched by design)
-                import jax
-
-                jax.clear_caches()
-            prev_sig = sig
-            config = self._worker_config(impl_id, spec)
-            if scheduler is not None and idx + 1 < len(pending):
-                # overlap: config N+1 compiles on a background thread
-                # while config N's timing loop owns the device
-                nxt_id, nxt_spec = pending[idx + 1]
-                scheduler.prefetch(self._worker_config(nxt_id, nxt_spec))
-            row = self._run_one_healed(config)
-            rows.append(row)
-            if is_primary:
-                # mirror=False: the row is already in the CSV and the
-                # worker.row span — echoing the table into the trace
-                # would duplicate the whole results file as event payload
-                telemetry.log(
-                    pd.DataFrame([row]).to_string(index=False), mirror=False
-                )
-                if self.output_csv:
-                    # incremental append so a crash loses one row at most
-                    # (reference benchmark.py:375-384)
-                    with telemetry.span("runner.csv_append", cat="csv"):
-                        self._append_csv(row)
+        try:
+            rows = self._run_pending(
+                pending, iterator, sigs, scheduler, prev_sig, is_primary, pd
+            )
+        finally:
+            if self._pool is not None:
+                # retire the leased worker(s): bounded (sentinel, join,
+                # kill on teardown hang); a crashed sweep must not leak
+                # a chip-holding child past its runner
+                self._pool.shutdown()
+                self._pool = None
         if scheduler is not None:
             scheduler.shutdown()
             # sweep-level compile-ahead effectiveness into the global
@@ -845,6 +798,75 @@ class PrimitiveBenchmarkRunner:
             if merged:
                 telemetry.log(f"trace merged: {merged}")
         return pd.DataFrame(rows)
+
+    def _run_pending(
+        self, pending, iterator, sigs, scheduler, prev_sig, is_primary, pd
+    ) -> List[Dict[str, Any]]:
+        """The sweep's row loop, factored so run() can bound the pool's
+        lifetime with one try/finally around it."""
+        rows: List[Dict[str, Any]] = []
+        for idx, (impl_id, spec) in enumerate(iterator):
+            scheduler_busy = False
+            if scheduler is not None:
+                # reap this config's prefetch (launched during the
+                # previous row's timing loop) before touching caches —
+                # never clear under an active compile thread. Bounded:
+                # a prefetch wedged against a dying backend must not
+                # deadlock the sweep (no worker_timeout exists in-process)
+                scheduler.wait(timeout=scheduler.WAIT_TIMEOUT_S)
+                scheduler_busy = scheduler.busy
+                if scheduler_busy:
+                    telemetry.warn(
+                        "compile-ahead prefetch still running after the "
+                        "bounded wait; skipping the cache clear this "
+                        "boundary (clearing under an active compile "
+                        "thread races the global caches)"
+                    )
+            sig = sigs[impl_id]
+            if (
+                self.isolation == "none"
+                and not scheduler_busy
+                and prev_sig is not None
+                and sig != prev_sig
+            ):
+                # cache-aware clearing: the cross-impl isolation contract
+                # now holds at executable-signature boundaries instead of
+                # per row — same-signature neighbors share the warm cache
+                # (the persistent disk cache is untouched by design)
+                import jax
+
+                jax.clear_caches()
+            prev_sig = sig
+            config = self._worker_config(impl_id, spec)
+            if scheduler is not None and idx + 1 < len(pending):
+                # overlap: config N+1 compiles on a background thread
+                # while config N's timing loop owns the device
+                nxt_id, nxt_spec = pending[idx + 1]
+                scheduler.prefetch(self._worker_config(nxt_id, nxt_spec))
+            self._pool_prefetch = None
+            if self.isolation == "subprocess" and idx + 1 < len(pending):
+                # pool-mode compile-ahead: the NEXT config rides along
+                # with this row's request, and the leased worker's own
+                # background thread prefetch-compiles it into the
+                # persistent cache (ignored without a cache configured
+                # — utils/compile_ahead.make_worker_scheduler)
+                nxt_id, nxt_spec = pending[idx + 1]
+                self._pool_prefetch = self._worker_config(nxt_id, nxt_spec)
+            row = self._run_one_healed(config)
+            rows.append(row)
+            if is_primary:
+                # mirror=False: the row is already in the CSV and the
+                # worker.row span — echoing the table into the trace
+                # would duplicate the whole results file as event payload
+                telemetry.log(
+                    pd.DataFrame([row]).to_string(index=False), mirror=False
+                )
+                if self.output_csv:
+                    # incremental append so a crash loses one row at most
+                    # (reference benchmark.py:375-384)
+                    with telemetry.span("runner.csv_append", cat="csv"):
+                        self._append_csv(row)
+        return rows
 
     def _make_scheduler(self) -> Optional[CompileAheadScheduler]:
         """The compile-ahead scheduler, or None where it cannot help:
@@ -1158,118 +1180,28 @@ class PrimitiveBenchmarkRunner:
         return benchmark_worker(config)
 
     def _run_one_subprocess(self, config: Dict[str, Any]) -> Dict[str, Any]:
-        # full per-implementation process isolation (reference
-        # spawn-per-impl, benchmark.py:336-370)
-        import multiprocessing as mp
+        """One row on the warm-worker pool (full per-row process
+        isolation is the ``pool_max_rows=1`` degenerate case; the
+        reference's spawn-per-impl, benchmark.py:336-370, is what the
+        pool amortizes). The lease reuses a live child whose environment
+        signature matches; a hung/dead child (the hung/dead policy lives
+        in ``pool.await_row``: heartbeat-aware per-row deadline, kill on
+        silence) becomes an error row here, its fault markers merged,
+        and the dead lease respawns for the retry the self-healing
+        policy will issue."""
+        from ddlb_tpu.pool import WorkerPool, run_one_row
 
-        ctx = mp.get_context("spawn")
-        queue = ctx.Queue()
-        # heartbeat channel: the child stamps monotonic beats at every
-        # phase boundary and timing iteration (faults/heartbeat.py); the
-        # kill rule below measures silence since the LAST beat, so a
-        # slow-but-alive child extends its own deadline while a wedged
-        # one dies exactly worker_timeout after its last sign of life.
-        # lock=False is load-bearing: a locked Value SIGKILLed mid-beat
-        # (the OOM-killer class this machinery models) would orphan the
-        # lock and deadlock the parent's next read — an aligned 8-byte
-        # store needs no lock for a liveness stamp
-        heartbeat_channel = ctx.Value("d", 0.0, lock=False)
-        proc = ctx.Process(
-            target=_subprocess_worker,
-            args=(config, queue, heartbeat_channel),
+        if self._pool is None:
+            # worker_pool=False is exactly spawn-per-row: a pool whose
+            # workers retire after every single row
+            self._pool = WorkerPool(
+                max_rows=self.pool_max_rows if self.worker_pool else 1,
+                worker_timeout=self.worker_timeout,
+            )
+        return run_one_row(
+            self._pool, config, self._error_row,
+            prefetch=self._pool_prefetch,
         )
-        proc.start()
-        return self._await_worker_row(config, proc, queue, heartbeat_channel)
-
-    def _await_worker_row(
-        self, config, proc, queue, heartbeat_channel
-    ) -> Dict[str, Any]:
-        """The hung/dead-child policy, factored off the spawn so tests
-        can drive it with a scripted child. Polls in short slices: a
-        child that DIES without posting a row (segfault, OOM-kill) is
-        reported immediately as a crash; one that goes SILENT — no row,
-        no heartbeat — for worker_timeout is killed (the reference
-        blocks forever here: queue.get with no timeout, benchmark.py:369,
-        SURVEY.md section 5 "no retries, no timeouts")."""
-        import queue as queue_mod
-
-        # monotonic throughout: heartbeat stamps are time.monotonic()
-        # (system-wide, same host), so the silence computation below can
-        # never be broken by an NTP step mid-capture
-        start = time.monotonic()
-        fault_markers: List[str] = []
-        row = None
-        while row is None:
-            try:
-                row = queue.get(timeout=1.0)
-                if isinstance(row, dict) and "__fault_marker__" in row:
-                    # the child announces a fired lifecycle fault BEFORE
-                    # executing it, so attribution survives even when
-                    # the fault kills the child without a result row
-                    fault_markers.append(str(row["__fault_marker__"]))
-                    row = None
-                    continue
-            except queue_mod.Empty:
-                if not proc.is_alive():
-                    # died; drain in case the row (or a fired-fault
-                    # marker) raced the exit
-                    try:
-                        while row is None or (
-                            isinstance(row, dict)
-                            and "__fault_marker__" in row
-                        ):
-                            if row is not None:
-                                fault_markers.append(
-                                    str(row["__fault_marker__"])
-                                )
-                            row = queue.get(timeout=1.0)
-                    except queue_mod.Empty:
-                        return _merge_fault_markers(
-                            self._error_row(
-                                config,
-                                f"WorkerDied: exit code {proc.exitcode} "
-                                f"with no result",
-                            ),
-                            fault_markers,
-                        )
-                    break
-                if self.worker_timeout:
-                    last_sign = max(
-                        start, heartbeat.last_beat(heartbeat_channel)
-                    )
-                    if time.monotonic() - last_sign > self.worker_timeout:
-                        proc.kill()
-                        proc.join()
-                        # a killed child's queue feeder thread may hold
-                        # buffered data; close + cancel_join_thread so
-                        # the parent's interpreter exit can never block
-                        # on it
-                        queue.close()
-                        queue.cancel_join_thread()
-                        beat = heartbeat.last_beat(heartbeat_channel) > 0
-                        return _merge_fault_markers(
-                            self._error_row(
-                                config,
-                                f"TimeoutError: worker silent for "
-                                f"{self.worker_timeout}s "
-                                f"{'since last heartbeat' if beat else 'with no heartbeat'}"
-                                f" (killed)",
-                            ),
-                            fault_markers,
-                        )
-        # a child can also hang in interpreter teardown (runtime/atexit
-        # finalizers) after delivering its row — bound the join even
-        # when no worker_timeout was configured, and bound the kill's
-        # own join + release the queue the same way as the timeout path
-        # (an unbounded join here would re-open the exact drain-race
-        # hang the loop above closed)
-        proc.join(self.worker_timeout or 60.0)
-        if proc.is_alive():
-            proc.kill()
-            proc.join(10.0)
-            queue.close()
-            queue.cancel_join_thread()
-        return _merge_fault_markers(row, fault_markers)
 
     def _error_row(self, config: Dict[str, Any], error: str) -> Dict[str, Any]:
         """Error row for a worker that hung or died — the same schema as

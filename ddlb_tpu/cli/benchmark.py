@@ -216,6 +216,11 @@ def run_benchmark(config: Dict[str, Any]):
             max_retries=cfg.get("max_retries"),
             retry_backoff_s=cfg.get("retry_backoff_s", 0.5),
             quarantine_after=cfg.get("quarantine_after"),
+            # warm-worker-pool knobs (ISSUE 5): None defers to the
+            # DDLB_TPU_WORKER_POOL / DDLB_TPU_POOL_MAX_ROWS env
+            # defaults (pool on; unlimited rows per worker)
+            worker_pool=cfg.get("worker_pool"),
+            pool_max_rows=cfg.get("pool_max_rows"),
         )
         frames.append(runner.run())
 
@@ -304,6 +309,24 @@ def main(argv=None) -> None:
         "is set and isolation is in-process)",
     )
     parser.add_argument(
+        "--worker-pool", dest="worker_pool", action="store_true",
+        default=None,
+        help="run subprocess-isolation rows on the persistent warm-"
+        "worker pool (default: on, env DDLB_TPU_WORKER_POOL) — one "
+        "long-lived child per environment signature instead of a fresh "
+        "spawn per row",
+    )
+    parser.add_argument(
+        "--no-worker-pool", dest="worker_pool", action="store_false",
+        help="force spawn-per-row (equivalent to --pool-max-rows 1); "
+        "use when suspecting cross-row state leakage",
+    )
+    parser.add_argument(
+        "--pool-max-rows", type=int, default=None, metavar="N",
+        help="recycle a pool worker after N rows (default 0 = "
+        "unlimited, env DDLB_TPU_POOL_MAX_ROWS; 1 = spawn-per-row)",
+    )
+    parser.add_argument(
         "--no-signature-grouping", action="store_true",
         help="keep the sweep's literal config order instead of grouping "
         "configs that share an executable signature (grouping lets the "
@@ -337,6 +360,8 @@ def main(argv=None) -> None:
         "resume": args.resume,
         "compile_ahead": not args.no_compile_ahead,
         "group_by_signature": not args.no_signature_grouping,
+        "worker_pool": args.worker_pool,
+        "pool_max_rows": args.pool_max_rows,
     }
     run_benchmark(config)
 

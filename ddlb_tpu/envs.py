@@ -158,6 +158,31 @@ def get_quarantine_after() -> int:
     return get_env(("DDLB_TPU_QUARANTINE_AFTER",), 3, int)
 
 
+def get_worker_pool() -> bool:
+    """Whether subprocess-isolation rows run on the persistent warm-
+    worker pool (default on; ``DDLB_TPU_WORKER_POOL=0`` disables).
+
+    On: the runner leases one long-lived child per environment
+    signature and streams row configs to it (``ddlb_tpu.pool``),
+    amortizing process spawn, JAX import, PJRT init and mesh build
+    across the sweep. Off: every row pays a fresh spawn — equivalent to
+    ``pool_max_rows=1``, kept for suspected cross-row state leakage.
+    """
+    return get_env(("DDLB_TPU_WORKER_POOL",), 1, int) != 0
+
+
+def get_pool_max_rows() -> int:
+    """Rows a pool worker may run before being recycled (default 0 =
+    unlimited; ``DDLB_TPU_POOL_MAX_ROWS``).
+
+    1 is the spawn-per-row degenerate case (one fresh process per row,
+    byte-identical CSV schema); small values bound cross-row state
+    accumulation (jit-cache growth, allocator high-water creep) on long
+    hardware sweeps.
+    """
+    return get_env(("DDLB_TPU_POOL_MAX_ROWS",), 0, int)
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

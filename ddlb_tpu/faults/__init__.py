@@ -48,6 +48,7 @@ from ddlb_tpu.faults.plan import (
     inject,
     load_plan,
     reset,
+    reset_counts,
     scope,
     set_fire_listener,
 )
@@ -65,6 +66,7 @@ __all__ = [
     "inject",
     "load_plan",
     "reset",
+    "reset_counts",
     "scope",
     "set_fire_listener",
 ]

@@ -36,6 +36,14 @@ from typing import Any, Optional
 _channel: Optional[Any] = None
 
 
+def new_channel(ctx: Any) -> Any:
+    """A fresh beat channel for one worker process (``ctx`` is a
+    multiprocessing context). The one construction site, so every
+    consumer (the warm-worker pool's leases) inherits the load-bearing
+    ``lock=False`` choice documented above instead of re-deriving it."""
+    return ctx.Value("d", 0.0, lock=False)
+
+
 def set_channel(channel: Any) -> None:
     """Install this process's beat channel (the subprocess worker entry
     does this with the ``Value`` its parent passed); ``None`` detaches."""

@@ -178,6 +178,17 @@ def reset() -> None:
         _fire_listener = None
 
 
+def reset_counts() -> None:
+    """Restart every per-site call counter at zero, keeping the loaded
+    plan. The determinism contract above assumes one row == one fresh
+    process; a REUSED warm-pool worker (ddlb_tpu/pool.py) runs many
+    rows in one process, so its dispatch loop calls this at every row
+    boundary — a seeded plan then injects identically whether a row ran
+    pooled or spawn-per-row."""
+    with _lock:
+        _counts.clear()
+
+
 def active() -> bool:
     """True when a fault plan is loaded (loading it on first call)."""
     return load_plan() is not None

@@ -1,0 +1,725 @@
+"""Persistent warm-worker pool: amortize spawn/init across sweep rows.
+
+Before this module, ``isolation='subprocess'`` paid a full child-process
+lifecycle for EVERY row: Python interpreter start, JAX import, PJRT
+client init, mesh build — seconds of fixed setup per row on the CPU sim
+and much more against a remote TPU relay, dwarfing the measurement
+itself on cartesian sweeps (ISSUE 5; the same amortize-the-fixed-cost
+argument T3 and HiCCL make for collective launch overhead). The pool
+replaces spawn-per-row with **one long-lived child per environment
+signature**: the parent leases a worker, streams row configs to it over
+a request queue, and reuses it across every row whose environment is
+compatible — keeping the JAX runtime, the PJRT client, the process's
+jit caches and the persistent compile cache warm between rows.
+
+Design points, each load-bearing:
+
+- **Environment signature** (``pool_signature``): the env vars that are
+  baked into a child at spawn and cannot change afterwards — the
+  simulated world (``DDLB_TPU_SIM_DEVICES``/``_SLICES``), the
+  distributed topology, process-level XLA flags (``XLA_FLAGS`` is read
+  once at backend creation — primitives/xla_options.py), the compile
+  cache, trace dir and fault plan. A lease under a different signature
+  retires the old worker and spawns a fresh one. Per-executable
+  ``compiler_options`` (the xla_options sweep axis) deliberately do NOT
+  key the signature: jit-level options need no new process.
+- **Per-row isolation contract preserved**: the dispatch loop clears
+  the child's in-memory jit caches at executable-signature boundaries
+  (``config_signature``) — exactly the granularity the in-process
+  runner uses — so same-signature neighbors share a warm cache and
+  different ones cannot leak state. The persistent disk cache is
+  untouched by design. Operators who suspect cross-row leakage anyway
+  can force spawn-per-row back with ``pool_max_rows=1`` (the degenerate
+  case this pool keeps byte-compatible).
+- **Fault machinery composes** (ISSUE 4): the heartbeat deadline is
+  per ROW (silence measured from dispatch, ``max(start, last_beat)``),
+  a hung/SIGKILLed worker is killed and marked dead so the next lease
+  respawns, and the killed worker's row is retried by the runner on
+  that fresh lease; lifecycle faults announce queue markers before
+  executing so attribution survives child death. Quarantine and retry
+  policy stay in the runner, unaffected.
+- **Compile-ahead targets the leased worker** (PR 1): each row request
+  may carry the NEXT row's config; the child prefetch-compiles it on a
+  background thread while the current row's timing loop owns the
+  device, landing executables in the persistent cache the same process
+  reads back one row later (utils/compile_ahead.make_worker_scheduler).
+- The parent side is deliberately JAX-free (importable from bench.py
+  and the queue driver, which must never initialize a backend); all
+  accelerator work happens in the child.
+
+``scripts/lint.py`` bans direct ``ctx.Process(`` construction in the
+package outside this file, so future row execution cannot silently
+regress to cold spawns.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from ddlb_tpu import envs, faults, telemetry
+from ddlb_tpu.faults import heartbeat
+
+#: env vars that are baked into a worker at spawn time; a change in any
+#: of them makes a live worker unusable for the next row (see module
+#: docstring for why per-jit compiler_options are deliberately absent)
+SIGNATURE_ENV_KEYS = (
+    "DDLB_TPU_SIM_DEVICES",
+    "DDLB_TPU_SIM_SLICES",
+    "DDLB_TPU_NUM_PROCESSES",
+    "DDLB_TPU_PROCESS_ID",
+    "DDLB_TPU_COORD_ADDR",
+    "DDLB_TPU_COMPILE_CACHE",
+    "DDLB_TPU_TRACE",
+    "DDLB_TPU_FAULT_PLAN",
+    "DDLB_TPU_CHIP",
+    "XLA_FLAGS",
+    "JAX_PLATFORMS",
+    "LIBTPU_INIT_ARGS",
+)
+
+
+def pool_signature(extra: Optional[Dict[str, Any]] = None) -> Tuple:
+    """The environment signature a worker is leased under: a snapshot of
+    the spawn-time env vars (world size / sim topology, process-level
+    XLA flags, compile cache, fault plan) plus caller extras."""
+    items = tuple((k, os.environ.get(k, "")) for k in SIGNATURE_ENV_KEYS)
+    return items + (tuple(sorted((extra or {}).items())),)
+
+
+class AwaitResult(NamedTuple):
+    """Outcome of waiting on a worker's response queue for one request.
+
+    ``row`` is the posted result (a row dict, or a ``run_call`` return
+    value) — None when the worker died, hung past the deadline, or the
+    call errored, in which case ``error`` says why. ``markers`` are the
+    fault sites the child announced before executing them (attribution
+    for faults that killed it). ``worker_dead`` means the lease must
+    respawn before the next row. ``partial`` is the last intermediate
+    result the child posted (``post_partial``) — the salvage channel for
+    a worker that produced a headline and then hung in a sidecar."""
+
+    row: Optional[Any]
+    error: str
+    markers: List[str]
+    worker_dead: bool
+    partial: Optional[Any] = None
+
+
+def _release_queue(queue: Any) -> None:
+    """Close an mp.Queue whose reader/writer may be a killed process:
+    close + cancel_join_thread so the parent's interpreter exit can
+    never block on the feeder thread of a dead child's queue."""
+    try:
+        queue.close()
+        queue.cancel_join_thread()
+    except (OSError, ValueError, AttributeError):
+        pass  # already released, or a test fake without the surface
+
+
+def _classify_message(msg, markers: List[str], message_sink):
+    """Sort one response-queue message: ('consumed', None) for markers /
+    ready lines, ('partial', v), ('call_error', str), or
+    ('terminal', payload) for a row or call result."""
+    if isinstance(msg, dict):
+        if "__fault_marker__" in msg:
+            markers.append(str(msg["__fault_marker__"]))
+            return "consumed", None
+        if "__pool_ready__" in msg:
+            if message_sink is not None:
+                message_sink(msg)
+            return "consumed", None
+        if "__pool_partial__" in msg:
+            return "partial", msg["__pool_partial__"]
+        if "__pool_call_error__" in msg:
+            return "call_error", str(msg["__pool_call_error__"])
+        if "__pool_call_result__" in msg:
+            return "terminal", msg["__pool_call_result__"]
+    return "terminal", msg
+
+
+def await_row(
+    proc,
+    queue,
+    heartbeat_channel,
+    worker_timeout: Optional[float] = None,
+    message_sink: Optional[Callable[[Dict[str, Any]], None]] = None,
+    join_grace: float = 10.0,
+    hard_timeout: Optional[float] = None,
+) -> AwaitResult:
+    """The hung/dead-child policy for one dispatched request (the former
+    runner ``_await_worker_row``, factored here so every consumer — the
+    sweep runner, the hardware queue, bench — shares ONE policy and
+    tests can drive it with scripted children). Polls in short slices: a
+    child that DIES without posting a result (segfault, OOM-kill) is
+    reported immediately; one that goes SILENT — no result, no heartbeat
+    — for ``worker_timeout`` seconds is killed (the heartbeat deadline
+    is per row: silence is measured from THIS dispatch, and a beating
+    child extends its own deadline; faults/heartbeat.py).
+    ``hard_timeout`` additionally caps total WALL time for the request,
+    beats or no beats — the hardware queue's old per-attempt budget,
+    which a beating-but-unbounded row must not escape. Monotonic clocks
+    throughout, immune to NTP steps mid-capture."""
+    import queue as queue_mod
+
+    start = time.monotonic()
+    markers: List[str] = []
+    partial = None
+    while True:
+        # wall cap checked every iteration, not just on queue-Empty: a
+        # child streaming partials/markers faster than once per second
+        # must not escape the budget
+        if (
+            hard_timeout
+            and time.monotonic() - start > hard_timeout
+            and proc.is_alive()
+        ):
+            proc.kill()
+            proc.join(join_grace)
+            _release_queue(queue)
+            return AwaitResult(
+                None,
+                f"TimeoutError: worker exceeded {hard_timeout:.0f}s"
+                f" (killed)",
+                markers,
+                True,
+                partial,
+            )
+        try:
+            msg = queue.get(timeout=1.0)
+        except queue_mod.Empty:
+            if not proc.is_alive():
+                # died; drain in case the result (or a fired-fault
+                # marker) raced the exit
+                try:
+                    while True:
+                        msg = queue.get(timeout=1.0)
+                        kind, payload = _classify_message(
+                            msg, markers, message_sink
+                        )
+                        if kind == "terminal":
+                            return AwaitResult(
+                                payload, "", markers, False, partial
+                            )
+                        if kind == "call_error":
+                            return AwaitResult(
+                                None, payload, markers, False, partial
+                            )
+                        if kind == "partial":
+                            partial = payload
+                except queue_mod.Empty:
+                    return AwaitResult(
+                        None,
+                        f"WorkerDied: exit code {proc.exitcode} "
+                        f"with no result",
+                        markers,
+                        True,
+                        partial,
+                    )
+            if worker_timeout:
+                last_sign = max(
+                    start, heartbeat.last_beat(heartbeat_channel)
+                )
+                if time.monotonic() - last_sign > worker_timeout:
+                    proc.kill()
+                    proc.join(join_grace)
+                    # a killed child's queue feeder thread may hold
+                    # buffered data; release it so the parent's
+                    # interpreter exit can never block on it
+                    _release_queue(queue)
+                    beat = heartbeat.last_beat(heartbeat_channel) > 0
+                    return AwaitResult(
+                        None,
+                        f"TimeoutError: worker silent for "
+                        f"{worker_timeout}s "
+                        f"{'since last heartbeat' if beat else 'with no heartbeat'}"
+                        f" (killed)",
+                        markers,
+                        True,
+                        partial,
+                    )
+            continue
+        kind, payload = _classify_message(msg, markers, message_sink)
+        if kind == "terminal":
+            return AwaitResult(payload, "", markers, False, partial)
+        if kind == "call_error":
+            return AwaitResult(None, payload, markers, False, partial)
+        if kind == "partial":
+            partial = payload
+
+
+def merge_fault_markers(row, markers: List[str]):
+    """Fold announced-fired fault sites into the row's
+    ``fault_injected`` column (markers first, deduplicated) — the
+    attribution channel for faults that killed the child before it
+    could post a row."""
+    if markers and isinstance(row, dict):
+        fired = [
+            s for s in str(row.get("fault_injected") or "").split(",") if s
+        ]
+        row["fault_injected"] = ",".join(dict.fromkeys(markers + fired))
+    return row
+
+
+def run_one_row(
+    pool: "WorkerPool",
+    config: Dict[str, Any],
+    error_row_fn: Callable[[Dict[str, Any], str], Dict[str, Any]],
+    prefetch: Optional[Dict[str, Any]] = None,
+    hard_timeout: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Lease → dispatch → attribute: the ONE row-execution path every
+    pool consumer shares (the sweep runner and the hardware queue's
+    ``PooledRunner``), so reuse/setup attribution, fault-marker merging
+    and the invalidate-on-transient policy cannot drift between them.
+    ``error_row_fn(config, error)`` builds the dead/hung-worker row."""
+    from ddlb_tpu.faults.classify import TRANSIENT, classify_error
+
+    worker = pool.lease(pool_signature())
+    reused = worker.rows_run > 0
+    outcome = worker.run_row(
+        config, prefetch=prefetch, hard_timeout=hard_timeout
+    )
+    if outcome.row is None:
+        row = error_row_fn(config, outcome.error)
+    else:
+        row = outcome.row
+    row = merge_fault_markers(row, outcome.markers)
+    if isinstance(row, dict):
+        # the pool's amortization, visible per row (on error rows too):
+        # did this row reuse a warm process, and what did its setup cost
+        # when it did not
+        row["worker_reused"] = bool(reused)
+        setup = 0.0 if reused else worker.setup_s
+        # NaN (worker died before reporting) passes through unrounded
+        row["worker_setup_s"] = round(setup, 4) if setup == setup else setup
+        error = str(row.get("error") or "")
+        if error and classify_error(
+            error, bool(row.get("valid", True))
+        ) == TRANSIENT:
+            # a transient failure (RESOURCE_EXHAUSTED, timeout kill,
+            # worker death) may have wedged the child's backend: retire
+            # the lease so the retry runs on a fresh one
+            pool.invalidate()
+    return row
+
+
+# ---------------------------------------------------------------------------
+# Child side: the dispatch loop
+# ---------------------------------------------------------------------------
+
+#: set while a ``run_call`` target executes in the child: posts
+#: intermediate results back to the parent (see ``post_partial``)
+_partial_sink: Optional[Callable[[Any], None]] = None
+
+
+def post_partial(value: Any) -> None:
+    """From inside a ``run_call`` target: post an intermediate result to
+    the leasing parent. If the target later hangs or dies, the parent's
+    ``AwaitResult.partial`` still carries the last posted value (bench
+    uses this so a wedged int8 sidecar cannot erase a measured
+    headline). No-op outside a pool worker."""
+    sink = _partial_sink
+    if sink is not None:
+        sink(value)
+
+
+def _run_call(req: Dict[str, Any], response_queue) -> None:
+    """Execute a ``{"kind": "call"}`` request: import ``module:function``
+    and post its return value (or the exception) back."""
+    global _partial_sink
+    target = str(req.get("target", ""))
+    module_name, _, fn_name = target.partition(":")
+    _partial_sink = lambda v: response_queue.put({"__pool_partial__": v})
+    try:
+        fn = getattr(importlib.import_module(module_name), fn_name)
+        result = fn(**(req.get("kwargs") or {}))
+    except Exception as exc:
+        response_queue.put(
+            {"__pool_call_error__": f"{type(exc).__name__}: {exc}"}
+        )
+        return
+    finally:
+        _partial_sink = None
+    response_queue.put({"__pool_call_result__": result})
+
+
+def _pool_child_main(
+    request_queue, response_queue, heartbeat_channel, quiet: bool = False
+):  # pragma: no cover - child process
+    """Worker child entry: initialize the runtime ONCE, then loop on the
+    request queue running one benchmark row (or call) per request until
+    the shutdown sentinel (``None``). Hosts the same per-row fault
+    surface the old spawn-per-row child did — ``subprocess.entry``
+    (hang / abrupt exit / OOM-style SIGKILL) and ``subprocess.result``
+    (corrupted numerics), each announced to the parent as a queue marker
+    BEFORE executing so a fault that kills this process stays
+    attributable (the brief sleep lets the queue's feeder thread flush
+    the marker ahead of an abrupt ``os._exit``/SIGKILL)."""
+    if quiet:
+        # the leasing parent's stdout is a one-line artifact (bench):
+        # route the child's prints/diagnostics to stderr instead
+        sys.stdout = sys.stderr
+    heartbeat.set_channel(heartbeat_channel)
+    t0 = time.monotonic()
+    from ddlb_tpu.runtime import Runtime, configure_compile_cache
+
+    configure_compile_cache()
+    runtime = Runtime()
+    heartbeat.beat()
+    ready = {"__pool_ready__": True, "setup_s": time.monotonic() - t0}
+    ready.update(runtime.info())
+    response_queue.put(ready)
+
+    from ddlb_tpu.benchmark import benchmark_worker
+    from ddlb_tpu.utils.compile_ahead import (
+        config_signature,
+        make_worker_scheduler,
+    )
+
+    def _announce(site: str, kind: str) -> None:
+        response_queue.put({"__fault_marker__": site, "kind": kind})
+        if kind in ("exit", "kill", "hang"):
+            time.sleep(0.25)
+
+    scheduler = None
+    scheduler_init = False
+    prev_sig = None
+    while True:
+        req = request_queue.get()
+        if not isinstance(req, dict):  # None = shutdown sentinel
+            break
+        heartbeat.beat()  # per-row deadline starts counting from receipt
+        if req.get("kind") == "call":
+            _run_call(req, response_queue)
+            continue
+        config = req.get("config") or {}
+        if not scheduler_init:
+            # lazily, once: None without a persistent compile cache
+            # (same rule as the in-process runner — without the disk
+            # cache a prefetched executable has no channel to the next
+            # row's fresh jit closures)
+            scheduler = make_worker_scheduler()
+            scheduler_init = True
+        scheduler_busy = False
+        if scheduler is not None:
+            # reap the previous row's prefetch before touching caches —
+            # never clear under an active compile thread
+            scheduler.wait(timeout=scheduler.WAIT_TIMEOUT_S)
+            scheduler_busy = scheduler.busy
+        sig = config_signature(config)
+        if prev_sig is not None and sig != prev_sig and not scheduler_busy:
+            # the cross-row isolation contract, at the same granularity
+            # as the in-process runner: clear the in-memory jit caches
+            # at executable-signature boundaries (the persistent disk
+            # cache is untouched by design)
+            import jax
+
+            jax.clear_caches()
+        prev_sig = sig
+        if scheduler is not None and req.get("prefetch"):
+            # compile-ahead in the leased worker: the NEXT row's
+            # executables compile on a background thread while this
+            # row's timing loop owns the device, landing in the
+            # persistent cache THIS process reads back one row later
+            scheduler.prefetch(req["prefetch"])
+        # per-site fault counters restart at zero for every row — the
+        # plan's determinism contract assumes one row == one fresh
+        # process, and a reused worker must inject exactly what a
+        # spawn-per-row child would (faults.plan.reset_counts)
+        faults.reset_counts()
+        faults.set_fire_listener(_announce)
+        try:
+            with faults.scope(
+                attempt=int(config.get("fault_attempt", 0) or 0),
+                impl=config.get("impl_id"),
+                primitive=config.get("primitive"),
+            ):
+                faults.inject("subprocess.entry")
+                row = benchmark_worker(config)
+                row = faults.corrupt_row("subprocess.result", row)
+        finally:
+            faults.set_fire_listener(None)
+        response_queue.put(row)
+    if scheduler is not None:
+        scheduler.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Parent side: leases
+# ---------------------------------------------------------------------------
+
+
+class PoolWorker:
+    """One leased child process: its queues, heartbeat channel, row
+    budget and liveness. Constructed by ``WorkerPool._spawn`` only."""
+
+    def __init__(
+        self,
+        signature: Tuple,
+        proc,
+        request_queue,
+        response_queue,
+        heartbeat_channel,
+        worker_timeout: Optional[float] = None,
+        max_rows: int = 0,
+    ) -> None:
+        self.signature = signature
+        self.proc = proc
+        self.request_queue = request_queue
+        self.response_queue = response_queue
+        self.heartbeat_channel = heartbeat_channel
+        self.worker_timeout = worker_timeout
+        self.max_rows = int(max_rows or 0)
+        #: rows dispatched to this worker (not necessarily completed)
+        self.rows_run = 0
+        #: the child's self-reported init cost (JAX import + PJRT client
+        #: + device list), from its ready message; NaN until ready
+        self.setup_s = float("nan")
+        self.ready_info: Optional[Dict[str, Any]] = None
+        self._dead = False
+        self._retired = False
+
+    def alive(self) -> bool:
+        return not self._dead and self.proc.is_alive()
+
+    def _on_message(self, msg: Dict[str, Any]) -> None:
+        """Consume a ``__pool_ready__`` line whenever the await loop (or
+        ``wait_ready``) encounters one."""
+        self.setup_s = float(msg.get("setup_s", float("nan")))
+        self.ready_info = dict(msg)
+
+    def wait_ready(self, timeout: float = 120.0) -> Optional[Dict[str, Any]]:
+        """Block until the child posts its ready message (platform,
+        device count, setup_s) — the pool's backend probe. Returns the
+        info dict, or None if the child died or the timeout passed."""
+        import queue as queue_mod
+
+        if self.ready_info is not None:
+            return self.ready_info
+        deadline = time.monotonic() + timeout
+        markers: List[str] = []
+        while time.monotonic() < deadline:
+            try:
+                msg = self.response_queue.get(timeout=1.0)
+            except queue_mod.Empty:
+                if not self.proc.is_alive():
+                    self._dead = True
+                    return None
+                continue
+            _classify_message(msg, markers, self._on_message)
+            if self.ready_info is not None:
+                return self.ready_info
+        return None
+
+    def run_row(
+        self,
+        config: Dict[str, Any],
+        prefetch: Optional[Dict[str, Any]] = None,
+        hard_timeout: Optional[float] = None,
+    ) -> AwaitResult:
+        """Dispatch one benchmark row config; block for its result under
+        the per-row heartbeat deadline (plus the optional
+        ``hard_timeout`` wall cap). ``prefetch`` is the NEXT row's
+        config for the child's compile-ahead thread."""
+        self.rows_run += 1
+        req: Dict[str, Any] = {"kind": "row", "config": dict(config)}
+        if prefetch:
+            req["prefetch"] = dict(prefetch)
+        self.request_queue.put(req)
+        result = await_row(
+            self.proc,
+            self.response_queue,
+            self.heartbeat_channel,
+            self.worker_timeout,
+            message_sink=self._on_message,
+            hard_timeout=hard_timeout,
+        )
+        if result.worker_dead:
+            self._dead = True
+            self._retired = True  # killed/exited: nothing left to retire
+        elif self.max_rows > 0 and self.rows_run >= self.max_rows:
+            # row budget spent: retire NOW so the chip/devices free
+            # before the next lease spawns (pool_max_rows=1 thereby
+            # behaves exactly like the old spawn-per-row path)
+            self.retire()
+        return result
+
+    def run_call(
+        self,
+        target: str,
+        kwargs: Optional[Dict[str, Any]] = None,
+        timeout: Optional[float] = None,
+    ) -> AwaitResult:
+        """Dispatch a ``module:function`` call (bench's headline path);
+        ``timeout`` overrides the worker's row deadline for this call."""
+        self.rows_run += 1
+        self.request_queue.put(
+            {"kind": "call", "target": target, "kwargs": dict(kwargs or {})}
+        )
+        result = await_row(
+            self.proc,
+            self.response_queue,
+            self.heartbeat_channel,
+            self.worker_timeout if timeout is None else timeout,
+            message_sink=self._on_message,
+        )
+        if result.worker_dead:
+            self._dead = True
+            self._retired = True
+        return result
+
+    def retire(
+        self, timeout: Optional[float] = None, graceful: bool = True
+    ) -> None:
+        """Shut the child down and release the queues. Idempotent.
+
+        Graceful (healthy worker): shutdown sentinel, bounded join
+        (capped at 60 s — teardown of an idle child is quick; a longer
+        ``worker_timeout`` must not stretch a planned recycle), kill if
+        it hangs in teardown (runtime/atexit finalizers). Non-graceful
+        (a worker being invalidated as hung/wedged): kill immediately —
+        a sentinel would sit unread behind whatever wedged it, and the
+        join would burn the caller's whole timeout budget."""
+        if self._retired:
+            return
+        self._retired = True
+        self._dead = True
+        try:
+            if graceful and self.proc.is_alive():
+                self.request_queue.put(None)
+                self.proc.join(min(timeout or 60.0, 60.0))
+            if self.proc.is_alive():
+                self.proc.kill()
+                self.proc.join(10.0)
+        finally:
+            _release_queue(self.response_queue)
+            _release_queue(self.request_queue)
+
+
+class WorkerPool:
+    """Lease manager: at most ONE live worker at a time (a TPU child
+    locks the chip for its process lifetime, so a second live worker
+    could never initialize), keyed by environment signature and recycled
+    after ``max_rows`` rows (0 = unlimited; 1 = the spawn-per-row
+    degenerate case). ``lease`` reuses the live worker when the
+    signature matches and the row budget allows, and otherwise retires
+    it and spawns fresh — emitting ``pool.lease`` / ``pool.reuse`` /
+    ``pool.respawn`` telemetry so a trace shows exactly where spawn cost
+    was paid."""
+
+    def __init__(
+        self,
+        max_rows: Optional[int] = None,
+        worker_timeout: Optional[float] = None,
+        quiet_child: bool = False,
+    ) -> None:
+        self.max_rows = (
+            envs.get_pool_max_rows() if max_rows is None else int(max_rows)
+        )
+        self.worker_timeout = worker_timeout
+        self.quiet_child = quiet_child
+        self._worker: Optional[PoolWorker] = None
+        #: lifetime counters for the sweep log / tests
+        self.spawns = 0
+        self.reuses = 0
+        self.respawns = 0
+
+    def lease(self, signature: Tuple) -> PoolWorker:
+        """A worker compatible with ``signature``: the live one when it
+        matches (and has row budget left), else a fresh spawn."""
+        worker = self._worker
+        with telemetry.span("pool.lease", cat="pool"):
+            if (
+                worker is not None
+                and worker.alive()
+                and worker.signature == signature
+                and (self.max_rows <= 0 or worker.rows_run < self.max_rows)
+            ):
+                self.reuses += 1
+                telemetry.record("pool.reuses")
+                telemetry.instant(
+                    "pool.reuse", cat="pool", rows_run=worker.rows_run
+                )
+                return worker
+            respawn = worker is not None
+            # budget-exhausted workers self-retire right after their
+            # last row (chip release), so check the row budget BEFORE
+            # liveness or a planned recycle would masquerade as "dead"
+            reason = (
+                "first"
+                if worker is None
+                else "signature"
+                if worker.signature != signature
+                else "recycled"
+                if self.max_rows > 0 and worker.rows_run >= self.max_rows
+                else "dead"
+                if not worker.alive()
+                else "recycled"
+            )
+            if worker is not None:
+                worker.retire(timeout=self.worker_timeout)
+                self._worker = None
+            with telemetry.span(
+                "pool.respawn" if respawn else "pool.spawn",
+                cat="pool",
+                reason=reason,
+            ):
+                self._worker = self._spawn(signature)
+            self.spawns += 1
+            telemetry.record("pool.spawns")
+            if respawn:
+                self.respawns += 1
+                telemetry.record("pool.respawns")
+            return self._worker
+
+    def _spawn(self, signature: Tuple) -> PoolWorker:
+        """Start one worker child (spawn context: forked JAX state is
+        unusable). The ONLY Process construction site for row execution
+        in the package — scripts/lint.py enforces it."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        request_queue = ctx.Queue()
+        response_queue = ctx.Queue()
+        channel = heartbeat.new_channel(ctx)
+        proc = ctx.Process(
+            target=_pool_child_main,
+            args=(request_queue, response_queue, channel, self.quiet_child),
+            # daemon: a crashed parent can never orphan a chip-holding
+            # child (daemons are terminated at parent exit)
+            daemon=True,
+        )
+        proc.start()
+        return PoolWorker(
+            signature,
+            proc,
+            request_queue,
+            response_queue,
+            channel,
+            worker_timeout=self.worker_timeout,
+            max_rows=self.max_rows,
+        )
+
+    def invalidate(self) -> None:
+        """Retire the live worker so the next lease spawns fresh — the
+        caller's remedy after a row whose transient failure (e.g.
+        RESOURCE_EXHAUSTED) may have wedged the child's backend. The
+        suspect worker is killed outright (non-graceful): a wedged
+        child would never read a shutdown sentinel, and a bounded join
+        on it would stall the capture window for nothing."""
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            telemetry.record("pool.invalidations")
+            worker.retire(timeout=self.worker_timeout, graceful=False)
+
+    def shutdown(self) -> None:
+        """Gracefully retire whatever is live (the healthy-end-of-sweep
+        path: the child gets to flush trace shards and reap its
+        compile-ahead thread); idempotent, bounded."""
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.retire(timeout=self.worker_timeout)

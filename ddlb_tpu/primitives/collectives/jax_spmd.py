@@ -25,6 +25,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import telemetry
+from ddlb_tpu.runtime import shard_map_compat
 
 from ddlb_tpu.primitives.collectives.base import Collectives
 
@@ -97,8 +98,10 @@ class JaxSPMDCollectives(Collectives):
             "all_to_all": P("tp", None),
             "ppermute": P("tp", None),
         }[op]
+        # shard_map_compat: jax.shard_map where it exists, the pre-0.5
+        # experimental entry point otherwise (jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None),),
@@ -126,7 +129,7 @@ class JaxSPMDCollectives(Collectives):
             return jax.lax.all_gather(part, "ici", axis=0, tiled=True)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(("dcn", "ici"), None),),

@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.ops.ring_collectives import ring_all_gather, ring_reduce_scatter
 from ddlb_tpu.primitives.collectives.base import Collectives
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class PallasCollectives(Collectives):
@@ -70,8 +71,10 @@ class PallasCollectives(Collectives):
             "all_reduce": P(None, None),
             "reduce_scatter": P("tp", None),
         }[op]
+        # shard_map_compat: jax.shard_map where it exists, the pre-0.5
+        # experimental entry point otherwise (jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None),),

@@ -198,6 +198,18 @@ class Runtime:
             int(getattr(d, "slice_index", None) or 0) for d in self.devices
         )
 
+    def info(self) -> dict:
+        """Plain-data snapshot of this runtime's world — what a warm
+        pool worker reports in its ready message so a JAX-free parent
+        (bench.py, the queue driver) can probe the backend without ever
+        creating one itself."""
+        return {
+            "platform": self.platform,
+            "num_devices": self.num_devices,
+            "num_processes": self.num_processes,
+            "device_kind": self.device_kind,
+        }
+
     @property
     def chip_spec(self):
         """The perfmodel hardware spec for this runtime's chips

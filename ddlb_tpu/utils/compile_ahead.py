@@ -283,6 +283,25 @@ def prefetch_compile(config: Dict[str, Any]) -> int:
     return compiled
 
 
+def make_worker_scheduler() -> Optional["CompileAheadScheduler"]:
+    """The compile-ahead scheduler for a leased pool worker, or None
+    when it cannot help. Inside a warm worker (ddlb_tpu/pool.py) the
+    'parent must never touch the accelerator' objection to subprocess-
+    mode prefetch disappears — the prefetch runs in the SAME process
+    that will measure the next row — but the persistent-cache rule
+    stands: the prefetch re-jits fresh closures, so without the disk
+    cache (``DDLB_TPU_COMPILE_CACHE``) the compiled artifact has no
+    channel to the next row's own jit calls and the thread would be
+    pure waste. The prefetch thereby targets the leased worker's cache
+    dir: executables land where the very process that compiled them
+    reads them back one row later."""
+    from ddlb_tpu.runtime import configure_compile_cache
+
+    if configure_compile_cache() is None:
+        return None
+    return CompileAheadScheduler()
+
+
 class CompileAheadScheduler:
     """One-config-lookahead background compiler.
 

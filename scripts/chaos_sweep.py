@@ -22,8 +22,13 @@ self-healing runner must survive:
 
 The sweep must still produce a COMPLETE CSV: every config present, every
 row either measured or classified, transients recovered with
-``retries > 0``. Exit code 0 iff every assertion holds — this script is
-the executable acceptance test for ISSUE 4 (its log is banked at
+``retries > 0``. The whole battery runs TWICE — spawn-per-row, then on
+the warm-worker pool (``DDLB_TPU_WORKER_POOL=1``, ISSUE 5) — asserting
+in the pooled pass that zero rows are lost, that a killed worker's
+in-flight row is retried on a FRESH lease (``worker_reused=False`` on
+the recovered row), and that reuse attribution is truthful. Exit code 0
+iff every assertion holds in both modes — this script is the executable
+acceptance test for ISSUEs 4 and 5 (its log is banked at
 ``docs/chaos_demo.log``).
 
 Usage: python scripts/chaos_sweep.py [--seed 0] [--csv PATH]
@@ -86,27 +91,24 @@ def load_impl_map() -> dict:
     return assign_impl_ids(generate_config_combinations(cfg["implementations"]))
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--seed", type=int, default=0)
-    parser.add_argument("--csv", default=None)
-    parser.add_argument(
-        "--timeout", type=float, default=25.0,
-        help="worker_timeout: silence budget before a child is killed",
-    )
-    args = parser.parse_args(argv)
+def run_pass(seed: int, csv: str, timeout: float, pooled: bool) -> list:
+    """One full chaos pass (spawn-per-row or pooled); returns the list
+    of failed assertions. The pooled pass additionally asserts that a
+    killed worker's in-flight row was retried on a FRESH lease and that
+    reuse attribution (``worker_reused``) is truthful."""
+    from ddlb_tpu import faults
 
-    csv = args.csv or os.path.join(
-        REPO, "results", f"chaos_sweep_seed{args.seed}.csv"
-    )
     if os.path.exists(csv):
         os.remove(csv)  # completeness is asserted against THIS run
 
-    plan = build_plan(args.seed)
+    plan = build_plan(seed)
     os.environ["DDLB_TPU_FAULT_PLAN"] = json.dumps(plan)
+    faults.reset()  # reload the plan + site counters for this pass
 
     impl_map = load_impl_map()
-    print(f"chaos_sweep: seed={args.seed}  {len(impl_map)} configs  "
+    mode = "pooled (DDLB_TPU_WORKER_POOL=1)" if pooled else "spawn-per-row"
+    print(f"\n==== chaos pass [{mode}] ====", flush=True)
+    print(f"chaos_sweep: seed={seed}  {len(impl_map)} configs  "
           f"{len(plan['rules'])} fault rules  csv={csv}", flush=True)
 
     from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
@@ -120,18 +122,19 @@ def main(argv=None) -> int:
         num_warmups=1,
         validate=True,
         isolation="subprocess",   # hang/exit/kill need a killable child
-        worker_timeout=args.timeout,
+        worker_timeout=timeout,
         max_retries=2,
         retry_backoff_s=0.2,
         quarantine_after=2,
         output_csv=csv,
         progress=False,
+        worker_pool=pooled,
     )
     df = runner.run()
 
     print("\n== chaos sweep outcome ==", flush=True)
     cols = ["implementation", "valid", "retries", "fault_injected",
-            "error_class", "quarantined", "error"]
+            "error_class", "quarantined", "worker_reused", "error"]
     print(df[cols].to_string(index=False), flush=True)
 
     failures = []
@@ -196,11 +199,61 @@ def main(argv=None) -> int:
     kinds = {rule["kind"] for rule in plan["rules"]}
     check(len(kinds) >= 4, f"distinct fault kinds injected: {sorted(kinds)}")
 
+    if pooled:
+        print("\n== warm-worker-pool assertions ==", flush=True)
+        check(
+            {"worker_reused", "worker_setup_s"} <= set(on_disk.columns),
+            "worker_reused / worker_setup_s columns present on every row",
+        )
+        r = by_impl.get("jax_spmd_0")
+        check(
+            r is not None and bool(r["valid"])
+            and not bool(r["worker_reused"]),
+            "jax_spmd_0: killed worker's in-flight row retried on a "
+            "FRESH lease (worker_reused=False on the recovered row)",
+        )
+        check(
+            bool(on_disk["worker_reused"].any()),
+            "at least one row reused a warm worker (the pool actually "
+            "pooled under fault load)",
+        )
+        quarantined_rows = on_disk[on_disk["quarantined"].astype(bool)]
+        check(
+            not quarantined_rows["worker_reused"].astype(bool).any(),
+            "quarantined rows never touched a worker "
+            "(worker_reused=False: quarantine unaffected by the pool)",
+        )
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--csv", default=None)
+    parser.add_argument(
+        "--timeout", type=float, default=25.0,
+        help="worker_timeout: silence budget before a child is killed",
+    )
+    args = parser.parse_args(argv)
+
+    csv = args.csv or os.path.join(
+        REPO, "results", f"chaos_sweep_seed{args.seed}.csv"
+    )
+    root, ext = os.path.splitext(csv)
+    pooled_csv = f"{root}_pooled{ext}"
+
+    # both execution modes must survive the same six fault kinds: the
+    # spawn-per-row baseline, and the warm-worker pool (a killed worker
+    # must cost its in-flight row ONE retry on a fresh lease, nothing
+    # else)
+    failures = run_pass(args.seed, csv, args.timeout, pooled=False)
+    failures += run_pass(args.seed, pooled_csv, args.timeout, pooled=True)
+
     if failures:
         print(f"\nchaos_sweep: {len(failures)} assertion(s) FAILED", flush=True)
         return 1
-    print("\nchaos_sweep: complete CSV, every fault recovered or "
-          "classified — OK", flush=True)
+    print("\nchaos_sweep: complete CSV in both modes, every fault "
+          "recovered or classified — OK", flush=True)
     return 0
 
 

@@ -152,13 +152,51 @@ def _bank_row(row, config):
     return row
 
 
+class PooledRunner:
+    """``run_isolated``'s contract on a leased warm worker (ISSUE 5):
+    one long-lived child per environment signature instead of a cold
+    spawn per attempt, so a capture window pays JAX import + PJRT init
+    once per queue pass instead of once per row. Failure policy is
+    identical — a crashed/hung/silent worker becomes an error row, and
+    a row whose failure the classifier calls transient (the
+    RESOURCE_EXHAUSTED wedge this module exists for) retires the lease
+    so the retry runs on a fresh process. The leased child clears its
+    jit caches at executable-signature boundaries (ddlb_tpu/pool.py),
+    which bounds the monotonic HBM creep that motivated spawn-per-row;
+    ``DDLB_TPU_POOL_MAX_ROWS`` caps rows per process outright. Every
+    row — measured or error — is banked to hwlogs/rows.jsonl with
+    ``worker_reused`` / ``worker_setup_s`` attribution."""
+
+    def __init__(self, timeout=1800.0):
+        from ddlb_tpu.pool import WorkerPool
+
+        # timeout doubles as the per-attempt HARD wall cap (run_isolated
+        # parity: a beating-but-unbounded row must still die at the
+        # budget, or one pathological entry wedges the capture window)
+        self._timeout = timeout
+        self._pool = WorkerPool(worker_timeout=timeout)
+
+    def __call__(self, config):
+        from ddlb_tpu.pool import run_one_row
+
+        row = run_one_row(
+            self._pool, config, _error_row, hard_timeout=self._timeout
+        )
+        return _bank_row(row, config)
+
+    def shutdown(self):
+        self._pool.shutdown()
+
+
 def run_isolated(config, timeout=1800.0):
     """Run one benchmark_worker config in a fresh child process.
 
     Returns the worker's result row; a crashed, hung, or silent child
     becomes an error row (same soft-failure contract as the sweep
     runner's subprocess mode). Every row — measured or error — is also
-    banked to hwlogs/rows.jsonl.
+    banked to hwlogs/rows.jsonl. ``PooledRunner`` is the warm-worker
+    form the queue prefers; this stays as the spawn-per-attempt
+    fallback (``DDLB_TPU_WORKER_POOL=0``).
     """
     child = _CHILD.format(repo=REPO)
     try:

@@ -213,6 +213,37 @@ def _swallow_checks(path: Path, tree: ast.Module) -> list:
     return problems
 
 
+def _process_spawn_checks(path: Path, tree: ast.Module) -> list:
+    """Ban direct multiprocessing ``Process`` construction in package
+    code outside ``pool.py`` (ISSUE 5 satellite): the warm-worker pool
+    is the one spawner for row/worker processes, so future row
+    execution cannot silently regress to cold spawn-per-row (and every
+    spawn inherits the pool's heartbeat channel, daemon flag, and
+    queue-release discipline)."""
+    if path.name == "pool.py":
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        named = (
+            fn.attr
+            if isinstance(fn, ast.Attribute)
+            else fn.id
+            if isinstance(fn, ast.Name)
+            else None
+        )
+        if named == "Process":
+            out.append(
+                f"{path}:{node.lineno}: process: direct Process() "
+                f"construction — worker processes must come from "
+                f"ddlb_tpu/pool.py (WorkerPool), so row execution "
+                f"cannot regress to cold spawn-per-row"
+            )
+    return out
+
+
 def _docstring_checks(path: Path, tree: ast.Module) -> list:
     """pydocstyle-lite floor for the PACKAGE (not tests/scripts): every
     module needs a docstring, and every public class needs one UNLESS it
@@ -251,6 +282,7 @@ def check_file(path: Path) -> list:
     if path.parts[:1] == ("ddlb_tpu",) or "/ddlb_tpu/" in str(path):
         extra += _docstring_checks(path, tree)
         extra += _swallow_checks(path, tree)
+        extra += _process_spawn_checks(path, tree)
         if not (set(path.parts) & _PRINT_EXEMPT_DIRS):
             extra += _print_checks(path, tree)
     if _has_star_import(tree):

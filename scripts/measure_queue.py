@@ -773,10 +773,24 @@ def main(argv=None, run_fn=None) -> int:
     from hw_common import proto
 
     base_proto = proto(quick)
+    pooled_runner = None
     if run_fn is None:
-        from hw_common import run_isolated
+        from ddlb_tpu.envs import get_worker_pool
 
-        run_fn = run_isolated
+        if get_worker_pool():
+            # warm-worker pool (ISSUE 5): ONE leased child per
+            # environment signature serves every row this pass —
+            # JAX import + PJRT init paid once per capture window, not
+            # once per attempt; transient failures retire the lease so
+            # retries get a fresh process (hw_common.PooledRunner)
+            from hw_common import PooledRunner
+
+            pooled_runner = PooledRunner()
+            run_fn = pooled_runner
+        else:
+            from hw_common import run_isolated
+
+            run_fn = run_isolated
 
     ran = failed = skipped = 0
     parity_ok = True
@@ -862,6 +876,11 @@ def main(argv=None, run_fn=None) -> int:
         # checkpoint after EVERY entry: a flap mid-queue loses nothing
         _save_state(state_path, state)
 
+    if pooled_runner is not None:
+        # bounded retire of the leased worker (sentinel, join, kill on
+        # teardown hang); pool children are daemons, so even a crashed
+        # driver cannot orphan a chip-holding child
+        pooled_runner.shutdown()
     print(
         f"measure_queue: {ran} run, {failed} failed, {skipped} skipped "
         f"(state: {state_path})",

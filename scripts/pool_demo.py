@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Warm-worker-pool demo: pooled vs spawn-per-row on the config.json matrix.
+
+The executable acceptance evidence for ISSUE 5: runs the SHIPPED
+``scripts/config.json`` implementation matrix (every impl block, at a
+small CPU-sim shape so the demo is runnable anywhere) twice under
+``isolation='subprocess'`` —
+
+- **spawn-per-row** (``worker_pool=False``): every row pays a fresh
+  child process — Python start, JAX import, PJRT client init, mesh
+  build — before measuring anything;
+- **pooled** (``worker_pool=True``): ONE leased child serves every row,
+  paying that fixed setup once.
+
+Both passes must produce identical row counts and identical measurement
+columns (the pool changes WHERE rows run, never what they record), and
+the pooled pass must cut end-to-end wall time by >= 2x. The banked log
+is ``docs/pool_demo.log``.
+
+Usage: python scripts/pool_demo.py [--csv-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# simulated mesh, set before anything touches JAX (children inherit)
+os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "8")
+
+M, N, K = 128, 64, 64  # small: every impl in config.json accepts it
+
+
+def load_impl_map() -> dict:
+    """config.json's implementation matrix, expanded exactly as the CLI
+    front door expands it."""
+    from ddlb_tpu.cli.benchmark import (
+        assign_impl_ids,
+        generate_config_combinations,
+    )
+
+    with open(os.path.join(REPO, "scripts", "config.json")) as f:
+        cfg = json.load(f)["benchmark"]
+    return assign_impl_ids(generate_config_combinations(cfg["implementations"]))
+
+
+def run_pass(impl_map: dict, csv: str, pooled: bool):
+    """One full subprocess-isolation sweep; returns (wall_s, DataFrame)."""
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    if os.path.exists(csv):
+        os.remove(csv)
+    mode = "pooled" if pooled else "spawn-per-row"
+    print(f"\n==== {mode} pass ({len(impl_map)} configs) ====", flush=True)
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise",
+        m=M, n=N, k=K,
+        implementations=impl_map,
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        validate=True,
+        isolation="subprocess",
+        output_csv=csv,
+        progress=False,
+        worker_pool=pooled,
+    )
+    t0 = time.monotonic()
+    df = runner.run()
+    wall = time.monotonic() - t0
+    spawned = int((~df["worker_reused"].astype(bool)).sum())
+    setup = float(df["worker_setup_s"].sum())
+    print(
+        f"{mode}: {len(df)} rows in {wall:.1f}s — {spawned} worker "
+        f"spawn(s), {setup:.1f}s total worker setup",
+        flush=True,
+    )
+    return wall, df
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--csv-dir", default=os.path.join(REPO, "results"),
+        help="where the two comparison CSVs land",
+    )
+    args = parser.parse_args(argv)
+
+    impl_map = load_impl_map()
+    spawn_csv = os.path.join(args.csv_dir, "pool_demo_spawn_per_row.csv")
+    pooled_csv = os.path.join(args.csv_dir, "pool_demo_pooled.csv")
+
+    wall_spawn, df_spawn = run_pass(impl_map, spawn_csv, pooled=False)
+    wall_pooled, df_pooled = run_pass(impl_map, pooled_csv, pooled=True)
+
+    import pandas as pd
+
+    on_disk_spawn = pd.read_csv(spawn_csv)
+    on_disk_pooled = pd.read_csv(pooled_csv)
+
+    failures = []
+
+    def check(ok: bool, what: str) -> None:
+        print(f"  {'PASS' if ok else 'FAIL'}  {what}", flush=True)
+        if not ok:
+            failures.append(what)
+
+    print("\n== comparison ==", flush=True)
+    check(
+        len(on_disk_spawn) == len(impl_map)
+        and len(on_disk_pooled) == len(impl_map),
+        f"identical row counts: {len(on_disk_spawn)} == "
+        f"{len(on_disk_pooled)} == {len(impl_map)} configs",
+    )
+    check(
+        on_disk_spawn.columns.tolist() == on_disk_pooled.columns.tolist(),
+        "identical measurement columns in both CSVs",
+    )
+    check(
+        bool(df_spawn["valid"].all()) and bool(df_pooled["valid"].all()),
+        "every row measured valid in both modes",
+    )
+    check(
+        not df_spawn["worker_reused"].any(),
+        "spawn-per-row: no row reused a worker (degenerate case honest)",
+    )
+    check(
+        int(df_pooled["worker_reused"].sum()) == len(impl_map) - 1,
+        "pooled: one spawn, every later row reused the warm worker",
+    )
+    speedup = wall_spawn / wall_pooled if wall_pooled > 0 else float("inf")
+    print(
+        f"\nend-to-end wall time: spawn-per-row {wall_spawn:.1f}s, "
+        f"pooled {wall_pooled:.1f}s -> {speedup:.2f}x speedup",
+        flush=True,
+    )
+    check(speedup >= 2.0, f"pooled >= 2x faster end to end ({speedup:.2f}x)")
+
+    if failures:
+        print(f"\npool_demo: {len(failures)} assertion(s) FAILED", flush=True)
+        return 1
+    print("\npool_demo: identical results, fixed setup amortized — OK",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -428,37 +428,63 @@ class _BeatingChannel:
 
 
 def test_silent_hang_killed_at_worker_timeout():
-    runner = _runner(isolation="subprocess", worker_timeout=1.5)
+    """The per-row deadline policy (now shared via pool.await_row): a
+    silent child is killed worker_timeout after dispatch, and the
+    runner's error row classifies it transient."""
+    from ddlb_tpu import pool as pool_mod
+
     proc, q = _FakeProc(), _FakeQueue()
-    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
     t0 = time.time()
-    row = runner._await_worker_row(config, proc, q, _Channel(0.0))
+    res = pool_mod.await_row(proc, q, _Channel(0.0), worker_timeout=1.5)
     assert proc.killed
     assert time.time() - t0 < 10.0
-    assert "TimeoutError" in row["error"]
-    assert "no heartbeat" in row["error"]
-    assert row["error_class"] == TRANSIENT
+    assert res.row is None and res.worker_dead
+    assert "TimeoutError" in res.error
+    assert "no heartbeat" in res.error
     # the killed child's queue is released so interpreter exit can never
     # block on its feeder thread
     assert q.closed and q.join_cancelled
+    runner = _runner(isolation="subprocess", worker_timeout=1.5)
+    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
+    row = runner._error_row(config, res.error)
+    assert row["error_class"] == TRANSIENT
 
 
 def test_heartbeat_extends_deadline_past_worker_timeout():
     """A child that is slower than worker_timeout but keeps beating is
     NOT killed: the row arrives after ~2x the timeout."""
-    runner = _runner(isolation="subprocess", worker_timeout=1.5)
+    from ddlb_tpu import pool as pool_mod
+
     proc = _FakeProc()
     q = _FakeQueue(row={"valid": True, "error": ""}, ready_at=time.time() + 3.0)
-    config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
-    row = runner._await_worker_row(config, proc, q, _BeatingChannel())
+    res = pool_mod.await_row(proc, q, _BeatingChannel(), worker_timeout=1.5)
     assert not proc.killed
-    assert row == {"valid": True, "error": ""}
+    assert not res.worker_dead
+    assert res.row == {"valid": True, "error": ""}
+
+
+def test_hard_timeout_kills_even_a_beating_child():
+    """The hardware queue's per-attempt wall budget: a child that beats
+    forever but never posts a row still dies at hard_timeout (heartbeats
+    must not let one unbounded row wedge a capture window)."""
+    from ddlb_tpu import pool as pool_mod
+
+    proc, q = _FakeProc(), _FakeQueue()
+    t0 = time.time()
+    res = pool_mod.await_row(
+        proc, q, _BeatingChannel(), worker_timeout=60.0, hard_timeout=1.5
+    )
+    assert proc.killed
+    assert time.time() - t0 < 10.0
+    assert res.row is None and res.worker_dead
+    assert "exceeded" in res.error
 
 
 def test_fault_marker_attributes_child_killing_fault():
     """A child that announces a fired lifecycle fault and then dies
     without a row leaves the site in the error row's fault_injected."""
-    runner = _runner(isolation="subprocess", worker_timeout=5.0)
+    from ddlb_tpu import pool as pool_mod
+
     proc, q = _FakeProc(), _FakeQueue()
     # scripted child: marker posted, then death with nothing else queued
     q.row = None
@@ -472,9 +498,15 @@ def test_fault_marker_attributes_child_killing_fault():
         raise queue_mod.Empty
 
     q.get = scripted_get
+    res = pool_mod.await_row(proc, q, _Channel(0.0), worker_timeout=5.0)
+    assert res.row is None and res.worker_dead
+    assert "WorkerDied" in res.error
+    assert res.markers == ["subprocess.entry"]
+    runner = _runner(isolation="subprocess", worker_timeout=5.0)
     config = runner._worker_config("jax_spmd_0", {"implementation": "jax_spmd"})
-    row = runner._await_worker_row(config, proc, q, _Channel(0.0))
-    assert "WorkerDied" in row["error"]
+    row = pool_mod.merge_fault_markers(
+        runner._error_row(config, res.error), res.markers
+    )
     assert row["fault_injected"] == "subprocess.entry"
     assert row["error_class"] == TRANSIENT
 

@@ -1,0 +1,292 @@
+"""Warm-worker pool (ISSUE 5): lease reuse, recycling, fault recovery.
+
+What matters: a lease is keyed by environment signature (same signature
+reuses the live worker, a mismatch forces a fresh one); ``pool_max_rows``
+recycles workers on schedule, with 1 the spawn-per-row degenerate case
+whose CSV schema is byte-identical to the pooled one; a killed/hung
+worker's row is retried on a FRESH lease; and every row — measured and
+error alike — carries truthful ``worker_reused`` / ``worker_setup_s``
+columns. Lease mechanics run against stub workers (no processes);
+recovery and schema tests drive real spawned children on the CPU sim.
+"""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+
+from ddlb_tpu import faults
+from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+from ddlb_tpu.pool import SIGNATURE_ENV_KEYS, WorkerPool, pool_signature
+
+SHAPE = dict(m=128, n=32, k=64)
+
+
+def _runner(**over):
+    kwargs = dict(
+        implementations={
+            "compute_only_0": {"implementation": "compute_only"},
+            "jax_spmd_0": {"implementation": "jax_spmd"},
+        },
+        dtype="float32",
+        num_iterations=2,
+        num_warmups=1,
+        progress=False,
+        isolation="subprocess",
+        retry_backoff_s=0.05,
+        **SHAPE,
+    )
+    kwargs.update(over)
+    return PrimitiveBenchmarkRunner("tp_columnwise", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Lease mechanics (stub workers: no processes spawned)
+# ---------------------------------------------------------------------------
+
+
+class _StubWorker:
+    """The lease-relevant surface of PoolWorker, no process behind it."""
+
+    def __init__(self, signature):
+        self.signature = signature
+        self.rows_run = 0
+        self.retired = False
+
+    def alive(self):
+        return not self.retired
+
+    def retire(self, timeout=None, graceful=True):
+        self.retired = True
+        self.retired_gracefully = graceful
+
+
+@pytest.fixture()
+def stub_pool(monkeypatch):
+    spawned = []
+
+    def fake_spawn(self, signature):
+        worker = _StubWorker(signature)
+        spawned.append(worker)
+        return worker
+
+    monkeypatch.setattr(WorkerPool, "_spawn", fake_spawn)
+    pool = WorkerPool(max_rows=0, worker_timeout=None)
+    pool.spawned = spawned
+    return pool
+
+
+def test_same_signature_reuses_live_worker(stub_pool):
+    sig = pool_signature()
+    w1 = stub_pool.lease(sig)
+    w1.rows_run += 1
+    w2 = stub_pool.lease(sig)
+    assert w2 is w1
+    assert stub_pool.spawns == 1 and stub_pool.reuses == 1
+    assert len(stub_pool.spawned) == 1
+
+
+def test_signature_mismatch_forces_new_lease(stub_pool):
+    w1 = stub_pool.lease(pool_signature())
+    w2 = stub_pool.lease(pool_signature(extra={"mode": "other"}))
+    assert w2 is not w1
+    assert w1.retired  # the incompatible worker was torn down first
+    assert stub_pool.spawns == 2 and stub_pool.respawns == 1
+
+
+def test_env_change_changes_signature(monkeypatch):
+    """Every spawn-baked env var participates in the signature, so e.g.
+    switching the fault plan or the simulated world between rows can
+    never hit a stale worker."""
+    base = pool_signature()
+    for key in SIGNATURE_ENV_KEYS:
+        monkeypatch.setenv(key, "changed-for-test")
+        assert pool_signature() != base, key
+        monkeypatch.delenv(key)
+
+
+def test_pool_max_rows_recycles(stub_pool):
+    stub_pool.max_rows = 2
+    sig = pool_signature()
+    w1 = stub_pool.lease(sig)
+    w1.rows_run = 2  # budget spent
+    w2 = stub_pool.lease(sig)
+    assert w2 is not w1
+    assert w1.retired
+    assert stub_pool.respawns == 1
+
+
+def test_dead_worker_respawned(stub_pool):
+    sig = pool_signature()
+    w1 = stub_pool.lease(sig)
+    w1.retired = True  # killed by the deadline policy
+    w2 = stub_pool.lease(sig)
+    assert w2 is not w1
+    assert stub_pool.respawns == 1
+
+
+def test_invalidate_then_fresh_lease(stub_pool):
+    sig = pool_signature()
+    w1 = stub_pool.lease(sig)
+    stub_pool.invalidate()
+    assert w1.retired
+    w2 = stub_pool.lease(sig)
+    assert w2 is not w1
+
+
+# ---------------------------------------------------------------------------
+# Real pooled sweeps (spawned children on the CPU sim)
+# ---------------------------------------------------------------------------
+
+
+def test_pooled_sweep_reuses_worker_and_attributes_setup(tmp_path):
+    """One spawn serves the whole sweep: the first row pays worker
+    setup, later rows carry worker_reused=True / worker_setup_s=0."""
+    csv = str(tmp_path / "pooled.csv")
+    df = _runner(output_csv=csv, worker_pool=True).run()
+    assert len(df) == 2
+    assert df["valid"].all(), list(df["error"])
+    first, second = df.iloc[0], df.iloc[1]
+    assert first["worker_reused"] == False  # noqa: E712
+    assert first["worker_setup_s"] > 0
+    assert second["worker_reused"] == True  # noqa: E712
+    assert second["worker_setup_s"] == 0.0
+
+
+def test_spawn_per_row_schema_identical(tmp_path):
+    """worker_pool=False (the pool_max_rows=1 degenerate case) spawns
+    per row and its CSV schema is byte-identical to the pooled one."""
+    pooled_csv = str(tmp_path / "pooled.csv")
+    spawn_csv = str(tmp_path / "spawn.csv")
+    _runner(output_csv=pooled_csv, worker_pool=True).run()
+    df = _runner(output_csv=spawn_csv, worker_pool=False).run()
+    assert not df["worker_reused"].any()  # every row paid a fresh spawn
+    assert (df["worker_setup_s"] > 0).all()
+    pooled_header = pd.read_csv(pooled_csv, nrows=0).columns.tolist()
+    spawn_header = pd.read_csv(spawn_csv, nrows=0).columns.tolist()
+    assert pooled_header == spawn_header
+
+
+def test_heartbeat_kill_respawns_and_retries(tmp_path, monkeypatch):
+    """A worker hung mid-row is killed at the per-row deadline and the
+    row retried on a FRESH lease — the pooled form of the ISSUE 4
+    contract (zero rows lost, truthful attribution)."""
+    plan = {
+        "seed": 0,
+        "rules": [
+            {"site": "subprocess.entry", "kind": "hang",
+             "match": {"impl": "jax_spmd_0"}, "fail_attempts": 1},
+        ],
+    }
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps(plan))
+    faults.reset()
+    try:
+        df = _runner(
+            output_csv=str(tmp_path / "chaos.csv"),
+            worker_pool=True,
+            worker_timeout=6.0,
+            max_retries=1,
+        ).run()
+    finally:
+        monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+        faults.reset()
+    assert len(df) == 2  # zero rows lost
+    row = df[df["implementation"] == "jax_spmd_0"].iloc[0]
+    assert row["valid"] == True  # noqa: E712
+    assert row["retries"] == 1
+    assert "subprocess.entry" in str(row["fault_injected"])
+    # the recovered attempt ran on a fresh lease, not the killed worker
+    assert row["worker_reused"] == False  # noqa: E712
+
+
+def test_error_rows_carry_pool_columns(tmp_path, monkeypatch):
+    """A worker that dies on every attempt still yields a row with the
+    pool columns — the CSV header cannot drift between happy and error
+    paths."""
+    plan = {
+        "seed": 0,
+        "rules": [
+            {"site": "subprocess.entry", "kind": "exit",
+             "match": {"impl": "jax_spmd_0"}, "fail_attempts": 99},
+        ],
+    }
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps(plan))
+    faults.reset()
+    try:
+        df = _runner(
+            implementations={"jax_spmd_0": {"implementation": "jax_spmd"}},
+            output_csv=str(tmp_path / "err.csv"),
+            worker_pool=True,
+            max_retries=0,
+        ).run()
+    finally:
+        monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+        faults.reset()
+    row = df.iloc[0]
+    assert "WorkerDied" in row["error"]
+    assert "worker_reused" in df.columns and "worker_setup_s" in df.columns
+    assert row["worker_reused"] == False  # noqa: E712
+
+
+def test_reused_worker_resets_fault_counters_per_row(tmp_path, monkeypatch):
+    """Determinism contract across execution modes: an ``at: [0]`` rule
+    keys on the per-site call index, which the plan defines per ROW
+    PROCESS — a reused worker must reset its counters at every row
+    boundary so the same seeded plan injects identically pooled and
+    spawn-per-row (both rows fault here, not just the warm worker's
+    first)."""
+    plan = {
+        "seed": 0,
+        "rules": [
+            # deterministic kind: classified rows keep the lease warm
+            # (a transient would invalidate it, masking the reuse path)
+            {"site": "worker.warmup", "kind": "deterministic_error",
+             "at": [0], "fail_attempts": 99},
+        ],
+    }
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps(plan))
+    faults.reset()
+    try:
+        df = _runner(
+            output_csv=str(tmp_path / "det.csv"),
+            worker_pool=True,
+            max_retries=0,
+        ).run()
+    finally:
+        monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+        faults.reset()
+    assert len(df) == 2
+    for _, row in df.iterrows():
+        assert "worker.warmup" in str(row["fault_injected"]), (
+            row["implementation"], row["fault_injected"])
+    # and the second row genuinely ran on the reused worker
+    assert df.iloc[1]["worker_reused"] == True  # noqa: E712
+
+
+def test_worker_pool_env_defaults(monkeypatch):
+    from ddlb_tpu.envs import get_pool_max_rows, get_worker_pool
+
+    monkeypatch.delenv("DDLB_TPU_WORKER_POOL", raising=False)
+    monkeypatch.delenv("DDLB_TPU_POOL_MAX_ROWS", raising=False)
+    assert get_worker_pool() is True  # default on
+    assert get_pool_max_rows() == 0  # unlimited
+    monkeypatch.setenv("DDLB_TPU_WORKER_POOL", "0")
+    monkeypatch.setenv("DDLB_TPU_POOL_MAX_ROWS", "1")
+    assert get_worker_pool() is False
+    assert get_pool_max_rows() == 1
+    runner = _runner(worker_pool=None, pool_max_rows=None)
+    assert runner.worker_pool is False
+    assert runner.pool_max_rows == 1
+
+
+def test_pool_prefetch_rides_requests(tmp_path, monkeypatch):
+    """With a persistent compile cache configured, the runner hands the
+    NEXT config to the leased worker so its compile-ahead thread can
+    prefetch (the cache dir afterwards holds banked executables)."""
+    cache = tmp_path / "cc"
+    monkeypatch.setenv("DDLB_TPU_COMPILE_CACHE", str(cache))
+    df = _runner(worker_pool=True).run()
+    assert df["valid"].all(), list(df["error"])
+    # the worker's compiles (prefetch or row) banked into the cache dir
+    assert cache.exists() and any(os.scandir(cache))
