@@ -408,6 +408,7 @@ def _main_guarded() -> None:
         if row.get("platform") == "tpu" and row.get("valid"):
             _check_roofline_regression(row)
             _save_tpu_cache(row)
+        _bank_headline(row)
         print(json.dumps(row), flush=True)
         return
 
@@ -512,36 +513,88 @@ def _main_guarded() -> None:
     )
 
 
+def _bank_headline(row: dict) -> None:
+    """Bank the headline artifact into the perf-observatory history
+    (``DDLB_TPU_HISTORY``; env-gated no-op by default, best effort
+    always) so ``scripts/observatory_report.py`` sees bench captures
+    next to sweep rows."""
+    try:
+        from ddlb_tpu.observatory import store
+
+        store.bank_row(row, kind="bench")
+    except Exception as exc:  # pragma: no cover - import/disk failure
+        print(f"[bench] history bank failed: {exc}", file=sys.stderr)
+
+
+def _history_baseline(row: dict):
+    """(median, mad, n) of ``roofline_frac`` over the observatory
+    history's previous bench captures of this metric/world — the robust
+    baseline layer of the regression gate. None when the history is
+    disabled, unreadable, or has fewer than 3 comparable captures (a
+    2-sample median is no steadier than the last-capture rule)."""
+    try:
+        from ddlb_tpu.observatory import regress, store
+
+        fracs = [
+            float(r["row"]["roofline_frac"])
+            for r in store.load_history()
+            if r.get("kind") == "bench"
+            and r["row"].get("metric") == row.get("metric")
+            and r["row"].get("world_size") == row.get("world_size")
+            # same gating as the cache baseline (_save_tpu_cache is
+            # valid-TPU-only): an invalid or CPU-fallback capture that
+            # _bank_headline recorded must never shape the baseline
+            and bool(r["row"].get("valid"))
+            and r["row"].get("platform", "tpu") == "tpu"
+            and isinstance(r["row"].get("roofline_frac"), (int, float))
+            and math.isfinite(r["row"]["roofline_frac"])
+        ]
+    except Exception:  # pragma: no cover - corrupt bank must not gate
+        return None
+    if len(fracs) < 3:
+        return None
+    med = regress.median(fracs)
+    return med, regress.mad(fracs, med), len(fracs)
+
+
 def _check_roofline_regression(row: dict) -> None:
     """The roofline_frac regression gate (the perfmodel's analogue of the
     cache staleness guard): a fresh capture whose achieved fraction of
     the analytical lower bound fell more than the relative tolerance
-    below the most recent comparable capture gets flagged in the
-    artifact — latency alone can look fine while a chip downgrade or a
-    scheduling regression eats the roofline margin. Soft by contract
-    (annotate, warn, exit 0)."""
+    below the baseline gets flagged in the artifact — latency alone can
+    look fine while a chip downgrade or a scheduling regression eats the
+    roofline margin. The baseline is the observatory history's per-metric
+    median (+ MAD context) when ``DDLB_TPU_HISTORY`` holds >= 3 bench
+    captures — robust to one lucky/unlucky window — and the most recent
+    cached capture otherwise. Soft by contract (annotate, warn, exit 0).
+    """
     frac = row.get("roofline_frac")
     if not isinstance(frac, (int, float)) or not math.isfinite(frac):
         return
-    prev = [
-        e
-        for e in _load_tpu_cache()
-        if e.get("metric") == row.get("metric")
-        and e.get("world_size") == row.get("world_size")
-        and isinstance(e.get("roofline_frac"), (int, float))
-        and math.isfinite(e["roofline_frac"])
-    ]
-    if not prev:
-        return
-    last = float(prev[-1]["roofline_frac"])
     tol = _env_float("DDLB_TPU_BENCH_ROOFLINE_TOL", ROOFLINE_REGRESSION_TOL)
-    if frac < last * (1.0 - tol):
+    hist = _history_baseline(row)
+    if hist is not None:
+        baseline, mad, n = hist
+        source = f"history median of {n} captures (MAD {mad:.4f})"
+    else:
+        prev = [
+            e
+            for e in _load_tpu_cache()
+            if e.get("metric") == row.get("metric")
+            and e.get("world_size") == row.get("world_size")
+            and isinstance(e.get("roofline_frac"), (int, float))
+            and math.isfinite(e["roofline_frac"])
+        ]
+        if not prev:
+            return
+        baseline = float(prev[-1]["roofline_frac"])
+        source = f"previous capture ({prev[-1].get('captured_at')})"
+    if frac < baseline * (1.0 - tol):
         row["roofline_regression"] = True
-        row["roofline_frac_prev"] = last
+        row["roofline_frac_prev"] = baseline
         print(
             f"[bench] ROOFLINE REGRESSION: roofline_frac {frac:.4f} is "
-            f">{tol:.0%} below the previous capture's {last:.4f} "
-            f"(captured {prev[-1].get('captured_at')})",
+            f">{tol:.0%} below the {source}'s {baseline:.4f}",
             file=sys.stderr,
         )
 

@@ -49,6 +49,8 @@ import numpy as np
 
 from ddlb_tpu import faults, telemetry
 from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.observatory import attribution as overlap_attribution
+from ddlb_tpu.observatory import live, store
 from ddlb_tpu.faults.classify import TRANSIENT, classify_error
 from ddlb_tpu.native import now_ns, robust_stats
 from ddlb_tpu.primitives.registry import (
@@ -70,21 +72,29 @@ TIMING_BACKENDS = ("host_clock", "device_loop")
 #: and timed-out alike — the CSV header is fixed by the first row
 #: written): the predicted lower bound, the achieved fraction of it, the
 #: dominating roofline term, and the spec the prediction was made
-#: against. Defaults fill rows whose worker died before an impl existed.
+#: against — plus the observatory's measured-overlap attribution set
+#: (``measured_overlap_frac`` and the per-phase compute/comm/idle
+#: breakdown, ISSUE 6). Defaults fill rows whose worker died before an
+#: impl existed.
 PERF_ROW_DEFAULTS: Dict[str, Any] = {
     "predicted_s": float("nan"),
     "roofline_frac": float("nan"),
     "bound": "",
     "chip": "",
+    **overlap_attribution.ATTRIBUTION_ROW_DEFAULTS,
 }
 
 
 def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
     """The perfmodel columns for one row: the impl's ``cost_model()``
     verdict plus ``roofline_frac`` against the measured MEDIAN (the
-    jitter-robust statistic the headline bench also pins). A model
-    failure must never discard a completed measurement — it degrades to
-    the default columns with a warning."""
+    jitter-robust statistic the headline bench also pins), and the
+    observatory's measured-overlap attribution — the achieved overlap
+    fraction and per-phase compute/comm/idle breakdown derived by
+    joining the measurement against the model's ``COST_SCHEDULE`` terms
+    (``ddlb_tpu/observatory/attribution.py``). A model failure must
+    never discard a completed measurement — it degrades to the default
+    columns with a warning."""
     if impl is None:
         return {}
     try:
@@ -101,6 +111,9 @@ def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
         "roofline_frac": est.roofline_frac(measured_s),
         "bound": est.bound,
         "chip": est.chip,
+        **overlap_attribution.attribute(
+            est, getattr(impl, "COST_SCHEDULE", "sequential"), measured_s
+        ),
     }
 
 
@@ -166,6 +179,9 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         # worker_timeout extends a beating child's deadline instead of
         # killing a slow-but-alive row (ddlb_tpu/faults/heartbeat.py)
         heartbeat.beat()
+        # the same phase boundary feeds the live dashboard's "current
+        # row" line (a no-op env check unless DDLB_TPU_LIVE is set)
+        live.post_event("row_phase", stage=stage, impl=impl_id)
         t0[0] = t1
 
     # compile accounting for the whole measured region (setup, warmup,
@@ -749,6 +765,10 @@ class PrimitiveBenchmarkRunner:
 
         rows: List[Dict[str, Any]] = []
         prev_sig = None
+        if is_primary and pending:
+            live.post_event(
+                "sweep_start", total=len(pending), primitive=self.primitive
+            )
         try:
             rows = self._run_pending(
                 pending, iterator, sigs, scheduler, prev_sig, is_primary, pd
@@ -791,6 +811,8 @@ class PrimitiveBenchmarkRunner:
 
             jax.clear_caches()
         if is_primary:
+            if pending:
+                live.post_event("sweep_done", rows=len(rows))
             # join per-process trace shards (this process's, and the
             # subprocess-isolation children's) into the Perfetto-loadable
             # trace.json; a no-op when DDLB_TPU_TRACE is unset
@@ -838,6 +860,11 @@ class PrimitiveBenchmarkRunner:
                 jax.clear_caches()
             prev_sig = sig
             config = self._worker_config(impl_id, spec)
+            if is_primary:
+                live.post_event(
+                    "row_start", impl=impl_id, primitive=self.primitive,
+                    m=self.m, n=self.n, k=self.k,
+                )
             if scheduler is not None and idx + 1 < len(pending):
                 # overlap: config N+1 compiles on a background thread
                 # while config N's timing loop owns the device
@@ -855,6 +882,22 @@ class PrimitiveBenchmarkRunner:
             row = self._run_one_healed(config)
             rows.append(row)
             if is_primary:
+                # cross-run memory + live feed (both env-gated no-ops by
+                # default): bank the row into the history store, and
+                # post the completion with its predicted-vs-measured
+                # fields for the dashboard's rolling view
+                store.bank_row(row)
+                live.post_event(
+                    "row_done", impl=impl_id, primitive=self.primitive,
+                    median_ms=row.get("median time (ms)"),
+                    predicted_s=row.get("predicted_s"),
+                    roofline_frac=row.get("roofline_frac"),
+                    measured_overlap_frac=row.get("measured_overlap_frac"),
+                    error=str(row.get("error") or "")[:200],
+                    quarantined=bool(row.get("quarantined")),
+                    retries=row.get("retries"),
+                    worker_reused=row.get("worker_reused"),
+                )
                 # mirror=False: the row is already in the CSV and the
                 # worker.row span — echoing the table into the trace
                 # would duplicate the whole results file as event payload

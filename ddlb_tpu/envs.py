@@ -183,6 +183,33 @@ def get_pool_max_rows() -> int:
     return get_env(("DDLB_TPU_POOL_MAX_ROWS",), 0, int)
 
 
+def get_history_dir() -> str:
+    """Run-history bank directory ("" = banking disabled).
+
+    When set, every runner path (sweep runner, pooled hardware queue,
+    bench headline) appends its result rows to
+    ``<dir>/history.jsonl`` — the perf observatory's cross-run store
+    (``ddlb_tpu.observatory.store``), keyed by chip + family + impl +
+    config signature + git rev. ``scripts/observatory_report.py``
+    compares runs against it. Follows the DDLB_TPU_* convention:
+    empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_HISTORY", "").strip()
+
+
+def get_live_path() -> str:
+    """Live sweep-stream file ("" = stream disabled).
+
+    When set, the runner, the worker pool and the hardware queue append
+    one JSON event line per row dispatch/phase/completion and worker
+    lifecycle change (``ddlb_tpu.observatory.live``);
+    ``scripts/sweep_dash.py`` tails it to render the live dashboard.
+    Strictly append-only observation: the measured path never reads it.
+    Follows the DDLB_TPU_* convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_LIVE", "").strip()
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

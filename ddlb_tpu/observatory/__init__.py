@@ -1,0 +1,54 @@
+"""Perf observatory: cross-run memory for measured-vs-predicted results.
+
+The telemetry subsystem (ISSUE 2) records what each row *did* and the
+perfmodel (ISSUE 3) predicts what it *should* do; this package joins the
+two ACROSS runs — the persistent bank the ROADMAP's fusion work and
+perfmodel-guided autotuning both consume (ISSUE 6). Four cooperating
+pieces, all zero-dependency (stdlib only — importable from the JAX-free
+process tiers, same contract as telemetry and perfmodel):
+
+- **run-history store** (``observatory.store``): every runner path — the
+  sweep runner, the warm-worker pool consumers, ``measure_queue``,
+  ``bench.py`` — banks its rows into an append-only JSONL history under
+  ``DDLB_TPU_HISTORY``, keyed by chip spec + family + impl + config
+  signature + git rev, so "is this slower than last week" stops being a
+  CSV-eyeballing question;
+- **measured-overlap attribution** (``observatory.attribution``): joins
+  a row's measured time against its perfmodel ``COST_SCHEDULE`` terms to
+  derive ``measured_overlap_frac`` (the *achieved* compute/communication
+  overlap fraction T3, arxiv 2401.16677, motivates — not just
+  end-to-end time) and a per-phase compute/comm/idle breakdown, emitted
+  as row columns next to ``roofline_frac`` on EVERY row;
+- **regression detection** (``observatory.regress``): the current run
+  against per-key history (median + MAD, perfmodel prior as the
+  fallback when history is empty), ranked — the engine behind
+  ``scripts/observatory_report.py`` and the history layer of bench.py's
+  roofline gate;
+- **live sweep stream** (``observatory.live``): an append-only event
+  stream (``DDLB_TPU_LIVE``) fed by the pool's heartbeat and the
+  runner's row completions, consumed by the ``scripts/sweep_dash.py``
+  TUI — per-worker state, rows done/parked/quarantined, the current
+  row's phase, rolling predicted-vs-measured.
+
+Everything is env-gated with the package's "" = disabled convention and
+best-effort by contract: observability must never abort or perturb the
+measurement it observes.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.observatory.attribution import (
+    ATTRIBUTION_ROW_DEFAULTS,
+    attribute,
+)
+from ddlb_tpu.observatory.live import post_event
+from ddlb_tpu.observatory.store import bank_row, load_history, row_key
+
+__all__ = [
+    "ATTRIBUTION_ROW_DEFAULTS",
+    "attribute",
+    "bank_row",
+    "load_history",
+    "post_event",
+    "row_key",
+]

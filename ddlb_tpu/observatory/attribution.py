@@ -1,0 +1,169 @@
+"""Measured-overlap attribution: join a measurement to its cost model.
+
+The perfmodel's ``roofline_frac`` says how close a row came to its
+combined lower bound, but for an overlap member that one number hides
+the question the ROADMAP's fusion work actually asks: *how much of the
+theoretically hideable communication time did this implementation
+actually hide?* T3 (arxiv 2401.16677) makes the case that the achieved
+overlap fraction — not end-to-end latency — is the metric that makes
+overlap regressions actionable, and "Fused Computation-Collective
+Operations" (arxiv 2305.06942) shows per-phase attribution is what
+turns a regression flag into a diagnosis.
+
+Definitions, from the same closed-form terms the perfmodel computes
+(``compute_s`` / ``comm_s`` / ``hbm_s``, each a per-call lower bound):
+
+- ``t_serial  = max(compute + comm, hbm)`` — the sequential schedule's
+  floor (collective and GEMM back to back);
+- ``t_overlap = max(compute, comm, hbm)`` — the perfect-overlap floor;
+- ``hideable  = t_serial - t_overlap`` — the communication (or compute)
+  time a perfect pipeline hides entirely;
+- ``measured_overlap_frac = (t_serial - measured) / hideable`` clamped
+  into [0, 1] — 1.0 means the member achieved the analytical overlap
+  bound, 0.0 means it ran no better than the sequential schedule.
+  Defined only for ``COST_SCHEDULE == "overlap"`` members with a
+  nonzero hideable window (a 1-device collective has nothing to hide);
+  NaN otherwise, so the column is trustworthy on every row;
+- per-phase breakdown: ``phase_compute_s`` / ``phase_comm_s`` are the
+  model's phase floors, and ``phase_idle_s = max(0, measured -
+  t_overlap)`` is the time no roofline term explains — launch overhead,
+  scheduling bubbles, idle wait. Predicted-vs-measured divergence is
+  thereby a first-class field on the row itself: a regression that
+  grows ``phase_idle_s`` is overhead, one that shrinks
+  ``measured_overlap_frac`` is lost pipelining.
+
+Zero-dependency and duck-typed like ``perfmodel.cost``: ``attribute``
+takes anything exposing ``compute_s`` / ``comm_s`` / ``hbm_s`` (a
+``CostEstimate`` or a test stub), so the JAX-free tiers and tests can
+drive it with hand-computed terms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_NAN = float("nan")
+
+#: the attribution columns every result row carries (CSV header is fixed
+#: by the first row written, so defaults must exist on measured, crashed
+#: and quarantined rows alike — NaN marks "no measurement/model here")
+ATTRIBUTION_ROW_DEFAULTS: Dict[str, Any] = {
+    "measured_overlap_frac": _NAN,
+    "phase_compute_s": _NAN,
+    "phase_comm_s": _NAN,
+    "phase_idle_s": _NAN,
+}
+
+
+def _term(est: Any, name: str) -> float:
+    value = getattr(est, name, None)
+    if value is None and isinstance(est, dict):
+        value = est.get(name)
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return 0.0
+    return value if value == value and value >= 0.0 else 0.0
+
+
+def attribute(est: Any, schedule: str, measured_s: float) -> Dict[str, Any]:
+    """The attribution columns for one row.
+
+    ``est`` duck-types the perfmodel estimate (``compute_s`` /
+    ``comm_s`` / ``hbm_s`` attributes or dict keys, seconds per call);
+    ``schedule`` is the impl's ``COST_SCHEDULE``; ``measured_s`` the
+    measured median. Returns the ``ATTRIBUTION_ROW_DEFAULTS`` key set,
+    with NaN wherever the quantity is undefined (no measurement, no
+    hideable window, non-overlap schedule for the overlap fraction).
+    """
+    compute = _term(est, "compute_s")
+    comm = _term(est, "comm_s")
+    hbm = _term(est, "hbm_s")
+    out = dict(ATTRIBUTION_ROW_DEFAULTS)
+    if compute or comm or hbm:
+        out["phase_compute_s"] = compute
+        out["phase_comm_s"] = comm
+    measured_ok = (
+        isinstance(measured_s, (int, float))
+        and measured_s == measured_s  # not NaN
+        and measured_s > 0.0
+    )
+    if not measured_ok:
+        return out
+    t_serial = max(compute + comm, hbm)
+    t_overlap = max(compute, comm, hbm)
+    if t_overlap > 0.0:
+        out["phase_idle_s"] = max(0.0, float(measured_s) - t_overlap)
+    hideable = t_serial - t_overlap
+    if schedule == "overlap" and hideable > 0.0:
+        frac = (t_serial - float(measured_s)) / hideable
+        out["measured_overlap_frac"] = min(1.0, max(0.0, frac))
+    return out
+
+
+def rows_from_events(events) -> list:
+    """Per-row span groups from a trace-event list: one record per
+    ``worker.row`` span, with every complete span CONTAINED in it (same
+    pid + tid, [ts, ts+dur] within the row's interval) aggregated into a
+    per-category phase breakdown.
+
+    This is the warm-pool-aware grouping ``scripts/trace_report.py``
+    uses: a long-lived pool worker emits MANY rows into one process
+    shard, so per-row aggregation must group by row span, not by pid
+    (the pre-pool assumption of one row per process). The tid filter
+    keeps a background prefetch compile (same pid, its own thread) out
+    of the row it merely overlaps in time.
+    """
+    import bisect
+
+    spans = [
+        e
+        for e in events
+        if e.get("ph") == "X"
+        and isinstance(e.get("ts"), (int, float))
+        and isinstance(e.get("dur"), (int, float))
+    ]
+    # bucket once by (pid, tid), sorted by start time: each row span
+    # then scans only its bisected candidate window instead of the
+    # whole trace (a pooled sweep has hundreds of rows over tens of
+    # thousands of spans — the naive product is minutes of Python)
+    buckets: Dict[tuple, list] = {}
+    for e in spans:
+        buckets.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+    starts: Dict[tuple, list] = {}
+    for key, bucket in buckets.items():
+        bucket.sort(key=lambda e: e["ts"])
+        starts[key] = [e["ts"] for e in bucket]
+    rows = []
+    for row_span in spans:
+        if row_span.get("name") != "worker.row":
+            continue
+        r0 = row_span["ts"]
+        r1 = r0 + row_span["dur"]
+        args = row_span.get("args") or {}
+        phases: Dict[str, float] = {}
+        key = (row_span.get("pid"), row_span.get("tid"))
+        bucket = buckets[key]
+        # µs clock granularity slack, matching the span tests
+        lo = bisect.bisect_left(starts[key], r0 - 1.0)
+        for e in bucket[lo:]:
+            if e["ts"] > r1 + 1.0:
+                break  # sorted by start: nothing later can be contained
+            if e is row_span:
+                continue
+            if e["ts"] + e["dur"] > r1 + 1.0:
+                continue
+            cat = e.get("cat") or "uncategorized"
+            phases[cat] = phases.get(cat, 0.0) + e["dur"] / 1e3
+        rows.append(
+            {
+                "impl": args.get("impl", ""),
+                "primitive": args.get("primitive", ""),
+                "pid": row_span.get("pid"),
+                "ts_us": r0,
+                "dur_ms": row_span["dur"] / 1e3,
+                "phases": phases,
+            }
+        )
+    rows.sort(key=lambda r: r["ts_us"])
+    return rows

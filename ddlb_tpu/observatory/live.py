@@ -1,0 +1,209 @@
+"""Live sweep stream: the event feed behind ``scripts/sweep_dash.py``.
+
+A sweep's progress is visible today only as interleaved log lines; this
+module gives the dashboard a machine-readable stream without adding a
+server, a socket, or ANY cost to the measured path: when
+``DDLB_TPU_LIVE`` names a file, instrumented sites append one flushed
+JSON line per event (O_APPEND — atomic for these line sizes, so the
+runner, the pool parent and the queue driver can share one stream); when
+unset, ``post_event`` is one dict lookup and returns. The dashboard
+process tails the file — strictly read-only, a separate process, so it
+cannot perturb row timings (the acceptance bar: timing deltas vs
+dashboard-off within noise).
+
+Event kinds currently posted:
+
+- ``sweep_start`` / ``sweep_done`` — the runner's row count bookends;
+- ``row_start`` / ``row_phase`` / ``row_done`` — per row: identity at
+  dispatch, the worker's phase marks while it runs
+  (setup/warmup/measure/validate — the heartbeat-adjacent stage marks
+  ``benchmark_worker`` already logs), and the measured outcome with the
+  predicted-vs-measured fields (``predicted_s``, ``roofline_frac``,
+  ``measured_overlap_frac``) at completion;
+- ``worker_spawn`` / ``worker_ready`` / ``worker_beat`` /
+  ``worker_dead`` — the pool's lease lifecycle and the parent-observed
+  heartbeat age, so the dashboard shows per-worker liveness exactly as
+  the kill policy sees it;
+- ``queue_parked`` — the hardware queue's park decisions.
+
+``fold`` turns an event list into the dashboard's render state; it
+lives here (not in the script) so tests pin the folding semantics and
+the ``--html`` snapshot renders from the same state as the TUI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ddlb_tpu import envs, telemetry
+from ddlb_tpu.observatory.regress import finite as _finite
+
+_post_failed: Optional[str] = None
+
+
+def post_event(kind: str, **fields: Any) -> bool:
+    """Append one event line to the live stream; returns whether it was
+    written (False when disabled — the overwhelmingly common case — or
+    on a write failure, which warns once and never raises)."""
+    global _post_failed
+    path = envs.get_live_path()
+    if not path:
+        return False
+    event = {"ts": time.time(), "pid": os.getpid(), "kind": kind}
+    event.update(fields)
+    try:
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(event, default=str) + "\n")
+    except OSError as exc:
+        if _post_failed != path:
+            _post_failed = path
+            telemetry.warn(
+                f"live stream {path} is not writable ({exc}); "
+                f"dashboard events disabled for this process"
+            )
+        return False
+    return True
+
+
+def read_events(path: str, offset: int = 0) -> tuple:
+    """(events, new_offset) from ``path`` starting at byte ``offset`` —
+    the dashboard's incremental tail. Corrupt/partial lines are skipped
+    (a line mid-append on the final read simply lands next poll)."""
+    events: List[Dict[str, Any]] = []
+    try:
+        # errors="replace": a torn multibyte character mid-append must
+        # not crash the tail — it can only sit on the PARTIAL last line
+        # (newlines are single-byte), which is deferred below anyway,
+        # so consumed complete lines always decoded cleanly
+        with open(path, encoding="utf-8", errors="replace") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return events, offset
+    consumed = 0
+    for line in data.splitlines(keepends=True):
+        if not line.endswith("\n"):
+            break  # partial tail line: re-read it next poll
+        consumed += len(line.encode("utf-8"))
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            event = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(event, dict) and "kind" in event:
+            events.append(event)
+    return events, offset + consumed
+
+
+def fold(
+    events: List[Dict[str, Any]],
+    state: Optional[Dict[str, Any]] = None,
+    recent: int = 12,
+) -> Dict[str, Any]:
+    """Fold events into (or onto) the dashboard render state:
+
+    - ``totals``: rows done / errors / quarantined / parked / retries,
+      plus the sweep's announced row count;
+    - ``workers``: per child pid — lifecycle state, setup cost, the
+      last parent-observed heartbeat age;
+    - ``current``: per source pid — the row in flight (identity,
+      dispatch time, latest phase mark), cleared by its ``row_done``;
+    - ``recent``: the last N completed rows with their
+      predicted-vs-measured fields;
+    - ``fracs``: every finite ``roofline_frac`` / ``overlap`` pair seen,
+      for the rolling predicted-vs-measured summary.
+    """
+    if state is None:
+        state = {
+            "totals": {
+                "total": 0, "done": 0, "errors": 0, "quarantined": 0,
+                "parked": 0, "retries": 0,
+            },
+            "workers": {},
+            "current": {},
+            "recent": [],
+            "fracs": [],
+            "sweep_done": False,
+            "last_ts": 0.0,
+        }
+    totals = state["totals"]
+    for e in events:
+        kind = e.get("kind")
+        ts = _finite(e.get("ts")) or 0.0
+        state["last_ts"] = max(state["last_ts"], ts)
+        src = e.get("pid")
+        if kind == "sweep_start":
+            totals["total"] += int(e.get("total") or 0)
+        elif kind == "sweep_done":
+            state["sweep_done"] = True
+        elif kind == "row_start":
+            state["current"][src] = {
+                "impl": e.get("impl"),
+                "primitive": e.get("primitive"),
+                "m": e.get("m"), "n": e.get("n"), "k": e.get("k"),
+                "stage": "dispatched",
+                "since": ts,
+            }
+        elif kind == "row_phase":
+            # phase marks come from the WORKER — in pooled/subprocess
+            # mode a different pid than the runner that posted
+            # row_start — so match by pid first, then by impl id
+            current = state["current"].get(src)
+            if current is None:
+                impl = e.get("impl")
+                for entry in state["current"].values():
+                    if impl is not None and entry.get("impl") == impl:
+                        current = entry
+                        break
+            if current is not None:
+                current["stage"] = e.get("stage")
+        elif kind == "row_done":
+            state["current"].pop(src, None)
+            totals["done"] += 1
+            if e.get("error"):
+                totals["errors"] += 1
+            if e.get("quarantined"):
+                totals["quarantined"] += 1
+            totals["retries"] += int(e.get("retries") or 0)
+            frac = _finite(e.get("roofline_frac"))
+            overlap = _finite(e.get("measured_overlap_frac"))
+            if frac is not None or overlap is not None:
+                state["fracs"].append({"roofline": frac, "overlap": overlap})
+            state["recent"].append(e)
+            del state["recent"][:-recent]
+        elif kind == "worker_spawn":
+            state["workers"][e.get("worker")] = {
+                "state": "spawning",
+                "reason": e.get("reason"),
+                "setup_s": None,
+                "beat_age_s": None,
+                "since": ts,
+            }
+        elif kind == "worker_ready":
+            worker = state["workers"].setdefault(
+                e.get("worker"), {"since": ts}
+            )
+            worker["state"] = "ready"
+            worker["setup_s"] = _finite(e.get("setup_s"))
+            worker["platform"] = e.get("platform")
+        elif kind == "worker_beat":
+            worker = state["workers"].setdefault(
+                e.get("worker"), {"state": "busy", "since": ts}
+            )
+            worker["beat_age_s"] = _finite(e.get("age_s"))
+        elif kind == "worker_dead":
+            worker = state["workers"].setdefault(
+                e.get("worker"), {"since": ts}
+            )
+            worker["state"] = "dead"
+            worker["error"] = str(e.get("error") or "")[:120]
+        elif kind == "queue_parked":
+            totals["parked"] += 1
+    return state

@@ -1,0 +1,196 @@
+"""Regression detection: the current run against the per-key history.
+
+The detector the history store exists for: given the current run's rows
+and the bank's earlier records, flag and RANK the rows that got slower.
+Robust statistics by construction — capture windows on the shared relay
+see cold-cache outliers and congestion spikes, so the baseline is the
+per-key **median** and the noise scale the per-key **MAD** (median
+absolute deviation), never mean/std:
+
+- a row regresses when its measured median exceeds the history median
+  by more than ``z_tol`` robust deviations AND by more than
+  ``min_excess`` relatively (the z-score alone would flag microsecond
+  jitter on keys whose history is unnaturally tight — the MAD is
+  floored at ``rel_floor`` of the median for the same reason);
+- when the key has NO history (first capture of a new config, a wiped
+  bank), the **perfmodel prior** takes over: the row's own
+  ``predicted_s`` is the analytical lower bound, and a row measuring
+  more than ``prior_factor`` times its prediction is flagged as a
+  prior-only advisory — ranked after every history-backed finding,
+  because a lower bound is a much weaker baseline than a measured
+  median;
+- findings are ranked by robust z (history-backed) then by
+  measured/predicted ratio (prior-only), worst first.
+
+Consumed by ``scripts/observatory_report.py`` (the CLI) and by
+``bench.py``'s roofline gate (the headline's history layer). Stdlib
+only, like the rest of the package.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+from ddlb_tpu.observatory.store import row_key
+
+#: detector defaults (every one overridable by the callers' knobs)
+Z_TOL = 3.5          # robust deviations above the history median
+MIN_EXCESS = 0.10    # AND at least 10% slower than the median
+REL_FLOOR = 0.05     # MAD floor, as a fraction of the median
+PRIOR_FACTOR = 5.0   # prior-only: measured > 5x the analytical bound
+
+MEASURE_COLUMN = "median time (ms)"
+
+
+def median(values: List[float]) -> float:
+    """Plain median (stdlib-only tier; statistics.median allocates the
+    same sort)."""
+    ordered = sorted(values)
+    n = len(ordered)
+    if not n:
+        return float("nan")
+    mid = n // 2
+    if n % 2:
+        return float(ordered[mid])
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def mad(values: List[float], center: Optional[float] = None) -> float:
+    """Median absolute deviation around ``center`` (default: the
+    median) — the robust noise scale."""
+    if not values:
+        return float("nan")
+    c = median(values) if center is None else center
+    return median([abs(v - c) for v in values])
+
+
+def finite(value: Any) -> Optional[float]:
+    """``value`` as a finite float, else None — the one
+    coerce-anything-measured helper the observatory shares (records are
+    a mix of JSON numbers, CSV strings, and NaN error rows)."""
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return None
+    return f if math.isfinite(f) else None
+
+
+def baselines(
+    records: List[Dict[str, Any]],
+    metric: str = MEASURE_COLUMN,
+    exclude_run: Optional[str] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-key robust baseline over history records: ``{key: {median,
+    mad, n, runs}}`` for every key with at least one finite ``metric``
+    sample. ``exclude_run`` drops the current run's own records so a
+    run never baselines against itself."""
+    samples: Dict[str, List[float]] = {}
+    runs: Dict[str, set] = {}
+    for record in records:
+        if record.get("kind", "row") != "row":
+            continue
+        if exclude_run and record.get("run_id") == exclude_run:
+            continue
+        row = record.get("row") or {}
+        value = finite(row.get(metric))
+        if value is None:
+            continue
+        key = record.get("key") or row_key(row)
+        samples.setdefault(key, []).append(value)
+        runs.setdefault(key, set()).add(record.get("run_id"))
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, values in samples.items():
+        m = median(values)
+        out[key] = {
+            "median": m,
+            "mad": mad(values, m),
+            "n": len(values),
+            "runs": len(runs[key]),
+        }
+    return out
+
+
+def detect(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    metric: str = MEASURE_COLUMN,
+    exclude_run: Optional[str] = None,
+    z_tol: float = Z_TOL,
+    min_excess: float = MIN_EXCESS,
+    rel_floor: float = REL_FLOOR,
+    prior_factor: float = PRIOR_FACTOR,
+) -> List[Dict[str, Any]]:
+    """Regression findings for ``current_rows`` against ``history``,
+    ranked worst first (history-backed findings by robust z, then
+    prior-only advisories by measured/predicted ratio).
+
+    Each finding carries the evidence a report needs: the key's
+    identity columns, measured vs baseline, the robust z, the slowdown
+    ratio, and ``source`` (``history`` | ``perfmodel_prior``).
+    """
+    base = baselines(history, metric=metric, exclude_run=exclude_run)
+    findings: List[Dict[str, Any]] = []
+    for row in current_rows:
+        measured = finite(row.get(metric))
+        if measured is None:
+            continue  # error rows have no measurement to regress
+        key = row_key(row)
+        ident = {
+            "implementation": row.get("implementation"),
+            "base_implementation": row.get("base_implementation"),
+            "primitive": row.get("primitive"),
+            "option": row.get("option"),
+            "m": row.get("m"),
+            "n": row.get("n"),
+            "k": row.get("k"),
+            "chip": row.get("chip"),
+        }
+        stats = base.get(key)
+        if stats is not None:
+            baseline = stats["median"]
+            if baseline <= 0.0:
+                continue
+            scale = max(stats["mad"], rel_floor * baseline)
+            z = (measured - baseline) / scale if scale > 0 else float("inf")
+            ratio = measured / baseline
+            if z > z_tol and ratio > 1.0 + min_excess:
+                findings.append(
+                    {
+                        **ident,
+                        "key": key,
+                        "source": "history",
+                        "measured_ms": measured,
+                        "baseline_ms": baseline,
+                        "mad_ms": stats["mad"],
+                        "history_n": stats["n"],
+                        "history_runs": stats["runs"],
+                        "ratio": ratio,
+                        "z": z,
+                    }
+                )
+            continue
+        # perfmodel prior: no history for this key — the analytical
+        # lower bound is the only baseline available
+        predicted_s = finite(row.get("predicted_s"))
+        if predicted_s is None or predicted_s <= 0.0:
+            continue
+        predicted_ms = predicted_s * 1e3
+        ratio = measured / predicted_ms
+        if ratio > prior_factor:
+            findings.append(
+                {
+                    **ident,
+                    "key": key,
+                    "source": "perfmodel_prior",
+                    "measured_ms": measured,
+                    "baseline_ms": predicted_ms,
+                    "ratio": ratio,
+                    "z": float("nan"),
+                }
+            )
+    history_backed = [f for f in findings if f["source"] == "history"]
+    prior_only = [f for f in findings if f["source"] != "history"]
+    history_backed.sort(key=lambda f: -f["z"])
+    prior_only.sort(key=lambda f: -f["ratio"])
+    return history_backed + prior_only

@@ -1,0 +1,179 @@
+"""Run-history store: append-only JSONL bank of result rows across runs.
+
+Every runner path — the sweep runner (in-process and pooled), the
+hardware queue's ``PooledRunner``/``run_isolated``, and ``bench.py``'s
+headline — banks its rows here automatically when ``DDLB_TPU_HISTORY``
+points at a directory (the package's "" = disabled convention; the
+un-gated fast path is one env lookup). The bank is what turns isolated
+captures into a longitudinal record: the regression detector
+(``observatory.regress`` / ``scripts/observatory_report.py``) compares
+a run against the per-key history, and the ROADMAP's autotuning work
+reads winners back per chip spec.
+
+Format: one JSON line per banked row in ``<dir>/history.jsonl`` —
+
+- ``key``: the stable cross-run identity (chip spec + family + base
+  implementation + merged option string + shape/dtype + world size),
+  computed from the row's own columns so every banking path derives it
+  identically;
+- ``run_id``: groups one driver process's rows (``DDLB_TPU_RUN_ID``
+  override for multi-process captures that must share an id);
+- ``git_rev``: the repo revision the row was measured at, so a
+  regression report can say WHICH commit moved a number;
+- ``banked_at``: epoch seconds; ``kind``: ``row`` (runner schema) or
+  ``bench`` (headline artifact schema);
+- ``row``: the full result row, untouched.
+
+Append-only with one flushed line per row (the crash-safety contract of
+the incremental CSV and the trace shards: a killed run loses at most
+the row in flight), and best-effort by construction — a full disk or an
+unwritable directory warns once and disables, never aborts the sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+from typing import Any, Dict, List, Optional
+
+from ddlb_tpu import envs, telemetry
+
+HISTORY_FILENAME = "history.jsonl"
+
+#: the row columns that form the cross-run identity. Everything that
+#: changes what is being measured is in; everything that is a
+#: measurement outcome (times, validity, retries) is out.
+KEY_COLUMNS = (
+    "chip",
+    "primitive",
+    "base_implementation",
+    "option",
+    "m",
+    "n",
+    "k",
+    "dtype",
+    "world_size",
+    "time_measurement_backend",
+)
+
+_run_id: Optional[str] = None
+_git_rev: Optional[str] = None
+_bank_failed: Optional[str] = None
+
+
+def run_id() -> str:
+    """This driver process's run identity: ``DDLB_TPU_RUN_ID`` when set
+    (multi-process captures that must bank under one id), else a
+    timestamp+pid string generated once per process."""
+    global _run_id
+    env = os.environ.get("DDLB_TPU_RUN_ID", "").strip()
+    if env:
+        return env
+    if _run_id is None:
+        _run_id = time.strftime(
+            "%Y%m%dT%H%M%SZ", time.gmtime()
+        ) + f"-p{os.getpid()}"
+    return _run_id
+
+
+def git_rev() -> str:
+    """The repo's short revision, cached per process; "" when the repo
+    state is unreadable (a deployment from a tarball must still bank)."""
+    global _git_rev
+    if _git_rev is None:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=repo,
+                capture_output=True,
+                text=True,
+                timeout=10,
+            )
+            _git_rev = out.stdout.strip() if out.returncode == 0 else ""
+        except (OSError, subprocess.TimeoutExpired):
+            _git_rev = ""
+    return _git_rev
+
+
+def row_key(row: Dict[str, Any]) -> str:
+    """The stable cross-run identity of one result row, as a sorted JSON
+    string of ``KEY_COLUMNS`` (missing columns key as None — a row from
+    an older schema still lands in a consistent bucket)."""
+    return json.dumps(
+        {col: row.get(col) for col in KEY_COLUMNS},
+        sort_keys=True,
+        default=str,
+    )
+
+
+def history_path(directory: Optional[str] = None) -> Optional[str]:
+    """The history file path, or None when banking is disabled."""
+    directory = directory or envs.get_history_dir()
+    if not directory:
+        return None
+    return os.path.join(directory, HISTORY_FILENAME)
+
+
+def bank_row(
+    row: Dict[str, Any],
+    kind: str = "row",
+    run: Optional[str] = None,
+    directory: Optional[str] = None,
+) -> bool:
+    """Append one result row to the history bank; returns whether it was
+    banked (False when disabled or on a write failure — best effort, a
+    history problem must never fail the measurement it records)."""
+    global _bank_failed
+    path = history_path(directory)
+    if path is None or not isinstance(row, dict):
+        return False
+    record = {
+        "key": row_key(row),
+        "run_id": run or run_id(),
+        "git_rev": git_rev(),
+        "banked_at": time.time(),
+        "kind": kind,
+        "row": row,
+    }
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+    except OSError as exc:
+        if _bank_failed != path:  # one warning per path, not per row
+            _bank_failed = path
+            telemetry.warn(
+                f"history bank {path} is not writable ({exc}); "
+                f"run-history disabled for this process"
+            )
+        return False
+    return True
+
+
+def load_history(directory: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Every record in the bank, oldest first. Corrupt lines (a process
+    killed mid-write) are skipped — the same tolerance as the trace
+    reader. Empty list when banking is disabled or the file is absent."""
+    path = history_path(directory)
+    if path is None or not os.path.exists(path):
+        return []
+    records: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(record, dict) and isinstance(
+                record.get("row"), dict
+            ):
+                records.append(record)
+    return records

@@ -62,6 +62,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from ddlb_tpu import envs, faults, telemetry
 from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.observatory import live
 
 #: env vars that are baked into a worker at spawn time; a change in any
 #: of them makes a live worker unusable for the next row (see module
@@ -74,6 +75,7 @@ SIGNATURE_ENV_KEYS = (
     "DDLB_TPU_COORD_ADDR",
     "DDLB_TPU_COMPILE_CACHE",
     "DDLB_TPU_TRACE",
+    "DDLB_TPU_LIVE",
     "DDLB_TPU_FAULT_PLAN",
     "DDLB_TPU_CHIP",
     "XLA_FLAGS",
@@ -180,6 +182,10 @@ def await_row(
             proc.kill()
             proc.join(join_grace)
             _release_queue(queue)
+            live.post_event(
+                "worker_dead", worker=getattr(proc, "pid", None),
+                error=f"wall cap {hard_timeout:.0f}s exceeded (killed)",
+            )
             return AwaitResult(
                 None,
                 f"TimeoutError: worker exceeded {hard_timeout:.0f}s"
@@ -211,6 +217,10 @@ def await_row(
                         if kind == "partial":
                             partial = payload
                 except queue_mod.Empty:
+                    live.post_event(
+                        "worker_dead", worker=getattr(proc, "pid", None),
+                        error=f"exit code {proc.exitcode} with no result",
+                    )
                     return AwaitResult(
                         None,
                         f"WorkerDied: exit code {proc.exitcode} "
@@ -219,10 +229,20 @@ def await_row(
                         True,
                         partial,
                     )
+            # the dashboard's per-worker liveness line: the heartbeat
+            # age exactly as the kill policy below sees it (env-gated
+            # no-op by default; one line per 1 s poll slice when on)
+            last_sign = max(
+                start,
+                heartbeat.last_beat(heartbeat_channel)
+                if heartbeat_channel is not None
+                else 0.0,
+            )
+            live.post_event(
+                "worker_beat", worker=getattr(proc, "pid", None),
+                age_s=round(time.monotonic() - last_sign, 1),
+            )
             if worker_timeout:
-                last_sign = max(
-                    start, heartbeat.last_beat(heartbeat_channel)
-                )
                 if time.monotonic() - last_sign > worker_timeout:
                     proc.kill()
                     proc.join(join_grace)
@@ -230,7 +250,14 @@ def await_row(
                     # buffered data; release it so the parent's
                     # interpreter exit can never block on it
                     _release_queue(queue)
-                    beat = heartbeat.last_beat(heartbeat_channel) > 0
+                    beat = (
+                        heartbeat_channel is not None
+                        and heartbeat.last_beat(heartbeat_channel) > 0
+                    )
+                    live.post_event(
+                        "worker_dead", worker=getattr(proc, "pid", None),
+                        error=f"silent for {worker_timeout}s (killed)",
+                    )
                     return AwaitResult(
                         None,
                         f"TimeoutError: worker silent for "
@@ -491,6 +518,10 @@ class PoolWorker:
         ``wait_ready``) encounters one."""
         self.setup_s = float(msg.get("setup_s", float("nan")))
         self.ready_info = dict(msg)
+        live.post_event(
+            "worker_ready", worker=getattr(self.proc, "pid", None),
+            setup_s=self.setup_s, platform=msg.get("platform"),
+        )
 
     def wait_ready(self, timeout: float = 120.0) -> Optional[Dict[str, Any]]:
         """Block until the child posts its ready message (platform,
@@ -669,6 +700,13 @@ class WorkerPool:
                 reason=reason,
             ):
                 self._worker = self._spawn(signature)
+            live.post_event(
+                "worker_spawn",
+                worker=getattr(
+                    getattr(self._worker, "proc", None), "pid", None
+                ),
+                reason=reason,
+            )
             self.spawns += 1
             telemetry.record("pool.spawns")
             if respawn:

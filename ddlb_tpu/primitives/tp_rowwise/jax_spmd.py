@@ -14,6 +14,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class JaxSPMDTPRowwise(TPRowwise):
@@ -30,7 +31,7 @@ class JaxSPMDTPRowwise(TPRowwise):
             )  # [m/d, n]
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu import native
 from ddlb_tpu.primitives.base import accum_wire_dtypes as _accum_dtypes
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class OverlapTPRowwise(TPRowwise):
@@ -76,7 +77,7 @@ class OverlapTPRowwise(TPRowwise):
             "p2p_pipeline": self._build_p2p_pipeline,
         }[algo]
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 build(),
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

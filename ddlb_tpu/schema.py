@@ -1,0 +1,99 @@
+"""The ONE registry of result-row columns, with a docstring per column.
+
+Before this module the row column set was re-stated ad hoc wherever
+rows are built or amended — ``benchmark.make_result_row``'s literal,
+``telemetry.ROW_METRIC_DEFAULTS``, ``benchmark.PERF_ROW_DEFAULTS`` (and
+the observatory's attribution defaults folded into it), the pool's
+reuse columns, hw_common's bank key, and the expectations hard-coded in
+tests — with nothing forcing the restatements to agree (ISSUE 6
+satellite). This registry is the source of truth: ``scripts/lint.py``
+statically collects every column the runner paths write (the
+``make_result_row`` literal, the ``*_ROW_DEFAULTS`` dicts, and every
+``row["..."] = ...`` assignment in benchmark.py / pool.py /
+scripts/hw_common.py) and fails when one is missing here or documented
+with an empty string — a new column cannot ship undocumented.
+
+Stdlib-only and import-free by design, so the lint tier, tests, and
+JAX-free drivers can all read it.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: column name -> one-line docstring. Grouped by the subsystem that
+#: writes the column; ordering is documentation, not the CSV order (the
+#: CSV header is fixed by the first row written).
+ROW_COLUMNS: Dict[str, str] = {
+    # -- identity (make_result_row) ------------------------------------
+    "implementation": "sweep impl id (base implementation + position)",
+    "primitive": "primitive family name (registry.ALLOWED_PRIMITIVES)",
+    "base_implementation": "implementation class key within the family",
+    "option": "DEFAULT-merged option string, ';'-joined k=v pairs",
+    "m": "GEMM/problem M dimension",
+    "n": "GEMM/problem N dimension",
+    "k": "GEMM/problem K dimension",
+    "dtype": "operand dtype name",
+    "unit": "what the Throughput column measures (TFLOPS or GB/s)",
+    # -- measurement statistics (native robust_stats over times_ms) ----
+    "mean time (ms)": "mean per-call latency over the timing loop",
+    "std time (ms)": "standard deviation of per-call latency",
+    "min time (ms)": "fastest timed call",
+    "max time (ms)": "slowest timed call",
+    "median time (ms)": "median per-call latency — the pinned statistic",
+    "p95 time (ms)": "95th-percentile per-call latency",
+    "Throughput (TFLOPS)": "mean flops()/time throughput (family unit)",
+    "Throughput std (TFLOPS)": "std of the per-sample throughput",
+    # -- environment ----------------------------------------------------
+    "world_size": "device count the row ran across (-1: died unreported)",
+    "num_processes": "participating host processes",
+    "hostname": "host that produced the row",
+    "platform": "JAX backend platform (tpu / cpu / 'unknown' on death)",
+    "time_measurement_backend": "host_clock or device_loop",
+    "barrier_at_each_iteration": "whether each timed call barriered first",
+    # -- compile-ahead engine (PR 1) ------------------------------------
+    "compile_time_s": "XLA compile seconds attributed to this row",
+    "compile_cache_hit": "persistent compile cache served this row",
+    # -- telemetry metric snapshot (telemetry.ROW_METRIC_DEFAULTS) ------
+    "barrier_wait_s": "summed Runtime.barrier() wait during the row",
+    "loop_overhead_s": "device_loop dispatch/fence slack (two-window est)",
+    "hbm_high_water_bytes": "allocator peak when THIS config raised it",
+    "collective_bytes": "per-device wire bytes/op from wire_bytes()",
+    "hbm_peak_gib": "allocator peak in GiB (only when raised by this row)",
+    # -- analytical perfmodel (PR 3) ------------------------------------
+    "predicted_s": "closed-form lower bound for this config (seconds)",
+    "roofline_frac": "predicted_s / measured median, clamped to (0, 1]",
+    "bound": "dominating roofline term: compute / comm / hbm",
+    "chip": "hardware spec the prediction was made against",
+    # -- observatory measured-overlap attribution (ISSUE 6) -------------
+    "measured_overlap_frac": (
+        "achieved overlap fraction: (serial floor - measured) / hideable,"
+        " in [0, 1]; NaN off overlap members"
+    ),
+    "phase_compute_s": "model compute-phase floor (MXU term, seconds)",
+    "phase_comm_s": "model comm-phase floor (wire term, seconds)",
+    "phase_idle_s": "measured time no roofline term explains (overhead)",
+    # -- robustness / self-healing (PR 4) -------------------------------
+    "retries": "retry attempts this row consumed before its final state",
+    "fault_injected": "fault-plan sites that fired under this row (csv)",
+    "error_class": "transient / deterministic / quarantined / '' (clean)",
+    "quarantined": "row skipped because its impl was quarantined",
+    # -- warm-worker pool (PR 5) ----------------------------------------
+    "worker_reused": "row ran on an already-warm pool worker",
+    "worker_setup_s": "child init cost when this row paid the spawn",
+    # -- validation / outcome -------------------------------------------
+    "valid": "validation verdict (soft: recorded, never fatal)",
+    "error": "error string; empty on measured rows",
+    # -- hardware-batch banking (scripts/hw_common.py) ------------------
+    "bank_key": "caller-config identity JSON for hwlogs/rows.jsonl dedup",
+    # -- family extras (impl.extra_row_fields; only on measured rows of
+    #    the family, never part of the fixed CSV header contract) -------
+    "spec_accept_rate": "speculative decoding measured acceptance rate",
+    "spec_rounds": "speculative decoding verify rounds measured",
+    "spec_proposals": "speculative decoding proposed-token count",
+    "serve_occupancy": "serving engine mean batch-slot occupancy",
+    "serve_admissions_deferred": "serving admissions deferred by HBM gate",
+    "serve_peak_pages": "serving paged-KV peak pages in use",
+    "serve_pages_capacity": "serving paged-KV pool capacity",
+    "serve_prefix_hits": "serving shared-prefix cache hits",
+}

@@ -149,6 +149,16 @@ def _bank_row(row, config):
             f.write(json.dumps(row, default=float) + "\n")
     except Exception:
         pass
+    try:
+        # the observatory's cross-run bank rides the same call (env-gated
+        # no-op unless DDLB_TPU_HISTORY is set): hardware-batch rows and
+        # sweep rows land in ONE history, so observatory_report.py can
+        # compare a capture window against every earlier one
+        from ddlb_tpu.observatory import store
+
+        store.bank_row(row)
+    except Exception:
+        pass
     return row
 
 

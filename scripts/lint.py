@@ -329,6 +329,112 @@ def _cost_model_coverage() -> list:
     ]
 
 
+#: the runner-path files whose row-column writes the schema check scans:
+#: the one row constructor + every site that amends rows after the fact
+#: (repo-relative). A new runner path that writes columns must be added
+#: here — and its columns to ddlb_tpu/schema.py.
+_ROW_WRITER_FILES = (
+    "ddlb_tpu/benchmark.py",
+    "ddlb_tpu/pool.py",
+    "ddlb_tpu/telemetry/metrics.py",
+    "ddlb_tpu/observatory/attribution.py",
+    "scripts/hw_common.py",
+)
+
+
+def _written_row_columns(tree: ast.Module) -> set:
+    """Every row-column name a file writes, statically:
+
+    - keys of the dict literal ``make_result_row`` returns (the one
+      row constructor);
+    - keys of module-level ``*_ROW_DEFAULTS`` / ``ROW_METRIC_DEFAULTS``
+      dict literals (merged into every row);
+    - every ``row["<name>"] = ...`` subscript assignment (the
+      amend-after-build sites: pool reuse columns, hbm peak, bank key).
+    """
+    columns: set = set()
+
+    def _dict_keys(node):
+        return {
+            key.value
+            for key in getattr(node, "keys", [])
+            if isinstance(key, ast.Constant) and isinstance(key.value, str)
+        }
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "make_result_row":
+            for ret in ast.walk(node):
+                if isinstance(ret, ast.Return) and isinstance(
+                    ret.value, ast.Dict
+                ):
+                    columns |= _dict_keys(ret.value)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            # one node can be BOTH cases at once (`row["x"] = {...}`):
+            # check the defaults-dict names and the row subscripts
+            # independently, never as an either/or
+            if isinstance(node.value, ast.Dict):
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+                if any(
+                    n.endswith("_ROW_DEFAULTS") or n == "ROW_METRIC_DEFAULTS"
+                    for n in names
+                ):
+                    columns |= _dict_keys(node.value)
+            for target in targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "row"
+                    and isinstance(target.slice, ast.Constant)
+                    and isinstance(target.slice.value, str)
+                ):
+                    columns.add(target.slice.value)
+    return columns
+
+
+def _row_schema_coverage() -> list:
+    """Row-schema invariant (ISSUE 6 satellite): every column a runner
+    path writes must appear in the ``ddlb_tpu/schema.py`` registry with
+    a non-empty docstring — the column set was previously re-stated ad
+    hoc in benchmark.py, pool.py, hw_common.py and tests, with nothing
+    keeping the statements in agreement."""
+    repo = Path(__file__).resolve().parent.parent
+    if str(repo) not in sys.path:
+        sys.path.insert(0, str(repo))
+    try:
+        from ddlb_tpu.schema import ROW_COLUMNS
+    except Exception as exc:
+        return [
+            f"schema: row-column registry failed to import: "
+            f"{type(exc).__name__}: {exc}"
+        ]
+    problems = []
+    for rel in _ROW_WRITER_FILES:
+        path = repo / rel
+        if not path.exists():
+            problems.append(f"schema: row-writer file {rel} is missing")
+            continue
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"), str(path))
+        except SyntaxError:
+            continue  # the per-file pass reports the syntax error
+        for column in sorted(_written_row_columns(tree)):
+            doc = ROW_COLUMNS.get(column)
+            if doc is None:
+                problems.append(
+                    f"schema: {rel} writes row column {column!r} that is "
+                    f"not registered in ddlb_tpu/schema.py ROW_COLUMNS"
+                )
+            elif not str(doc).strip():
+                problems.append(
+                    f"schema: ddlb_tpu/schema.py ROW_COLUMNS[{column!r}] "
+                    f"has an empty docstring"
+                )
+    return problems
+
+
 def main(argv) -> int:
     targets = []
     for arg in argv or ["."]:
@@ -347,6 +453,7 @@ def main(argv) -> int:
     # sweep covers the package (the Makefile target always does)
     if any("ddlb_tpu" in p.parts for p in targets):
         problems.extend(_cost_model_coverage())
+        problems.extend(_row_schema_coverage())
     for path in targets:
         if "__pycache__" in path.parts:
             continue

@@ -43,6 +43,9 @@ from ddlb_tpu import telemetry  # noqa: E402
 # (also JAX-free): deterministic failures park IMMEDIATELY instead of
 # burning a second capture-window pass on a config that cannot succeed
 from ddlb_tpu.faults.classify import DETERMINISTIC, classify_error  # noqa: E402
+# the live sweep stream (also JAX-free, env-gated): park decisions feed
+# the scripts/sweep_dash.py dashboard next to the pool's worker events
+from ddlb_tpu.observatory import live  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STATE_PATH = os.path.join(REPO, "hwlogs", "queue_state.json")
@@ -807,6 +810,10 @@ def main(argv=None, run_fn=None) -> int:
                 "queue.parked", cat="queue", label=entry["label"],
                 attempts=rec["attempts"],
             )
+            live.post_event(
+                "queue_parked", label=entry["label"],
+                attempts=rec["attempts"],
+            )
             skipped += 1
             continue
         if limit is not None and ran >= limit:
@@ -870,6 +877,10 @@ def main(argv=None, run_fn=None) -> int:
                     )
                     telemetry.instant(
                         "queue.parked", cat="queue", label=entry["label"],
+                        attempts=attempt, error_class=cls,
+                    )
+                    live.post_event(
+                        "queue_parked", label=entry["label"],
                         attempts=attempt, error_class=cls,
                     )
         state[key] = rec

@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""Perf-observatory demo: history, regression report, live dashboard.
+
+The executable acceptance evidence for ISSUE 6, banked at
+``docs/observatory_demo.log``. Everything runs on the CPU sim with the
+SHIPPED ``scripts/config.json`` implementation matrix at a small shape
+(the pool_demo protocol), so it is reproducible anywhere:
+
+1. **Two banked baseline runs**: the pooled sweep runs twice with
+   ``DDLB_TPU_HISTORY`` set — every row auto-banks into
+   ``history.jsonl`` keyed by chip + impl + config + git rev. The FIRST
+   pass also runs with ``DDLB_TPU_LIVE`` set AND the
+   ``scripts/sweep_dash.py`` dashboard attached as a live tail
+   (separate read-only process), and its per-row medians are compared
+   against the SECOND pass (dashboard off): the timing deltas must be
+   within CPU-sim noise — the dashboard observes without perturbing.
+2. **A seeded regression**: the current run is banked as a copy of
+   pass 2's rows with ONE implementation's measured times multiplied by
+   3 (synthetic by design — the detector is what's under test, and a
+   real slowdown of exactly known size cannot be injected honestly).
+3. **Detection**: ``scripts/observatory_report.py`` compares the
+   seeded run against the two banked baselines — the seeded row must be
+   detected AND ranked first.
+4. **Dashboard artifacts**: the final live-stream state is rendered as
+   a text frame and as the static ``--html`` snapshot
+   (``hwlogs/observatory_dash.html``).
+
+Usage: python scripts/observatory_demo.py [--out-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# simulated mesh, set before anything touches JAX (children inherit)
+os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "8")
+
+M, N, K = 128, 64, 64  # small: every impl in config.json accepts it
+SEED_FACTOR = 3.0
+
+
+def seeded_impl(impl_map) -> str:
+    """The impl the demo slows down: the matrix's last overlap member
+    (the family whose regressions the observatory exists to catch)."""
+    overlap = [i for i in impl_map if i.startswith("overlap")]
+    return overlap[-1] if overlap else sorted(impl_map)[-1]
+
+
+def load_impl_map() -> dict:
+    from ddlb_tpu.cli.benchmark import (
+        assign_impl_ids,
+        generate_config_combinations,
+    )
+
+    with open(os.path.join(REPO, "scripts", "config.json")) as f:
+        cfg = json.load(f)["benchmark"]
+    return assign_impl_ids(generate_config_combinations(cfg["implementations"]))
+
+
+def run_pass(impl_map, label):
+    """One pooled subprocess-isolation sweep; returns (wall_s, df)."""
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    print(f"\n==== {label} ({len(impl_map)} configs, pooled) ====",
+          flush=True)
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise", m=M, n=N, k=K,
+        implementations=impl_map,
+        dtype="float32", num_iterations=30, num_warmups=3,
+        validate=False, isolation="subprocess", progress=False,
+        worker_pool=True,
+        # one aggregate timing window per row (sync, N back-to-back
+        # calls, sync): the jitter-resistant protocol on a contended
+        # CPU sim, where per-iteration 8-way barriers amplify
+        # scheduler noise far above any observer effect
+        barrier_at_each_iteration=False,
+    )
+    t0 = time.monotonic()
+    df = runner.run()
+    wall = time.monotonic() - t0
+    errors = int((df["error"].astype(str) != "").sum())
+    print(f"{label}: {len(df)} rows in {wall:.1f}s, {errors} error(s)",
+          flush=True)
+    return wall, df
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--out-dir", default=os.path.join(REPO, "hwlogs"),
+        help="where the HTML snapshot lands",
+    )
+    args = parser.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="observatory_demo_")
+    hist_dir = os.path.join(workdir, "history")
+    live_path = os.path.join(workdir, "live.jsonl")
+    impl_map = load_impl_map()
+    failures = []
+
+    def check(ok, what):
+        print(f"  {'PASS' if ok else 'FAIL'}  {what}", flush=True)
+        if not ok:
+            failures.append(what)
+
+    # -- pass 0: unbanked warmup AND the noise reference: two
+    # dashboard-off passes (this and pass 2) bound the machine's own
+    # pass-to-pass jitter, which the attached pass is judged against ---
+    _, df_ref = run_pass(impl_map, "pass 0: warmup / noise reference")
+
+    # -- pass 1: dashboard ON (live stream + a real attached tail) ----------
+    os.environ["DDLB_TPU_HISTORY"] = hist_dir
+    os.environ["DDLB_TPU_RUN_ID"] = "baseline-1"
+    os.environ["DDLB_TPU_LIVE"] = live_path
+    open(live_path, "w").close()
+    dash = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "sweep_dash.py"),
+         live_path, "--interval", "0.5"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    time.sleep(2.0)  # let the tail's interpreter start before measuring
+    try:
+        wall_on, df_on = run_pass(impl_map, "pass 1: dashboard ATTACHED")
+    finally:
+        try:
+            dash.wait(timeout=30)  # exits on sweep_done in piped mode
+        except subprocess.TimeoutExpired:
+            dash.kill()
+    print(f"dashboard process exited rc={dash.returncode}", flush=True)
+
+    # -- pass 2: dashboard OFF ----------------------------------------------
+    os.environ["DDLB_TPU_RUN_ID"] = "baseline-2"
+    os.environ.pop("DDLB_TPU_LIVE")
+    wall_off, df_off = run_pass(impl_map, "pass 2: dashboard off")
+
+    # -- dashboard perturbation check ---------------------------------------
+    import math
+
+    med_ref = df_ref.set_index("implementation")["median time (ms)"]
+    med_on = df_on.set_index("implementation")["median time (ms)"]
+    med_off = df_off.set_index("implementation")["median time (ms)"]
+    # the MEDIAN of per-row ratios, not the sum: a real observer
+    # overhead would shift every row systematically, while one row's
+    # scheduler hiccup (routine on a shared CPU sim) dominates a sum
+    agg = float((med_on / med_off).median())
+    noise = float((med_ref / med_off).median())  # two dashboard-OFF passes
+    print(
+        f"\n== dashboard perturbation check ==\n"
+        f"median per-row ratio: attached/off {agg:.3f} "
+        f"(rows [{(med_on / med_off).min():.2f}, "
+        f"{(med_on / med_off).max():.2f}])\n"
+        f"machine noise reference (two dashboard-off passes): "
+        f"median per-row ratio {noise:.3f}",
+        flush=True,
+    )
+    # within noise = the attached pass's systematic shift is no more
+    # than 1.5x what the machine shows between two dashboard-OFF
+    # passes, floored at 25% absolute (this container's CPU-sim medians
+    # routinely move that much between identical passes — the printed
+    # reference ratio documents the machine's noise in every banked log)
+    bound = max(1.5 * abs(math.log(noise)), math.log(1.25))
+    check(
+        abs(math.log(agg)) <= bound,
+        f"timing deltas vs dashboard-off within noise "
+        f"(|log median ratio| {abs(math.log(agg)):.3f} <= bound "
+        f"{bound:.3f})",
+    )
+
+    # -- seeded regression run ----------------------------------------------
+    from ddlb_tpu.observatory import store
+
+    seed_impl = seeded_impl(impl_map)
+
+    print(
+        f"\n== seeding a regression: {seed_impl} x{SEED_FACTOR:.0f} "
+        f"slower, banked as run 'seeded-3' ==",
+        flush=True,
+    )
+    seeded_rows = 0
+    for _, row in df_off.iterrows():
+        banked = dict(row)
+        if banked["implementation"] == seed_impl:
+            for col in banked:
+                if col.endswith("time (ms)"):
+                    banked[col] = float(banked[col]) * SEED_FACTOR
+            seeded_rows += 1
+        store.bank_row(banked, run="seeded-3")
+    check(seeded_rows == 1, f"seeded exactly one impl ({seed_impl})")
+
+    # -- detection ----------------------------------------------------------
+    print("\n== observatory_report.py on the seeded run ==", flush=True)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "observatory_report.py"),
+         "--history", hist_dir, "--run", "seeded-3"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    sys.stdout.write(out.stdout)
+    check(out.returncode == 1, "report exits 1 (regressions found)")
+    ranked_first = [
+        line for line in out.stdout.splitlines()
+        if f" {seed_impl} " in f" {line} " and line.lstrip().startswith("1 ")
+    ]
+    check(bool(ranked_first),
+          f"seeded slowdown ({seed_impl}) detected and ranked FIRST")
+    n_found = [
+        int(line.split()[0])
+        for line in out.stdout.splitlines()
+        if line.strip().endswith("regression(s), worst first:")
+    ]
+    check(n_found == [1], "no false positives among the unseeded rows")
+
+    # -- dashboard artifacts -------------------------------------------------
+    print("\n== final dashboard frame (sweep_dash.py --once) ==", flush=True)
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "sweep_dash.py"),
+         live_path, "--once"],
+        timeout=120, cwd=REPO,
+    )
+    snap = os.path.join(args.out_dir, "observatory_dash.html")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "sweep_dash.py"),
+         live_path, "--html", snap],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    sys.stdout.write(out.stdout)
+    check(
+        out.returncode == 0 and os.path.getsize(snap) > 500,
+        f"static HTML snapshot banked at {os.path.relpath(snap, REPO)}",
+    )
+    hist_records = len(store.load_history(hist_dir))
+    print(
+        f"\nhistory bank: {hist_records} rows across 3 runs "
+        f"({len(impl_map)} configs x 2 baselines + 1 seeded)",
+        flush=True,
+    )
+
+    if failures:
+        print(f"\nobservatory_demo: {len(failures)} check(s) FAILED")
+        return 1
+    print("\nobservatory_demo: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

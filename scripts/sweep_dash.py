@@ -1,0 +1,380 @@
+#!/usr/bin/env python
+"""Live sweep dashboard: tail the DDLB_TPU_LIVE stream and render it.
+
+The observatory's TUI (ISSUE 6): point a sweep (runner, pool, queue) at
+a live stream file with ``DDLB_TPU_LIVE=<file>``, then run this script
+against the same file from another terminal. It is a strictly read-only
+tail of an append-only file — the dashboard can never perturb the row
+timings it watches (the acceptance bar: timing deltas vs dashboard-off
+within noise).
+
+Shown, from the folded event state (``ddlb_tpu/observatory/live.py``):
+
+- sweep progress: rows done / total, errors, quarantined, parked,
+  retries;
+- per-worker state: the pool's lease lifecycle (spawning / ready /
+  busy / dead), child setup cost, and the parent-observed heartbeat age
+  — liveness exactly as the kill policy sees it;
+- the current row: implementation, shape, and its latest phase mark
+  (setup / warmup / measuring / validating) with time in phase;
+- recent rows and the rolling predicted-vs-measured view: median
+  roofline fraction and median measured overlap fraction, so an overlap
+  regression is visible WHILE the sweep runs instead of in tomorrow's
+  CSV diff.
+
+Renderers:
+
+- **curses TUI** (default on a tty): full-screen, refreshed every
+  ``--interval`` seconds. Keys: ``q`` quit, ``r`` rebuild state from
+  the whole file (after truncation/rotation), ``h`` dump an HTML
+  snapshot next to the live file.
+- **plain text** (``--once``, piped output, or no curses): one frame to
+  stdout — what the demo and tests drive.
+- **static HTML** (``--html OUT``): a self-contained snapshot for
+  hwlogs — stat tiles + worker/row tables, light & dark via CSS custom
+  properties, status conveyed by icon + label (never color alone).
+
+Usage: python scripts/sweep_dash.py [LIVE_FILE] [--once] [--html OUT]
+           [--interval S] [--follow]
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlb_tpu.observatory import live  # noqa: E402
+from ddlb_tpu.observatory.regress import finite, median  # noqa: E402
+
+
+def _fmt(value, spec="{:.3f}", missing="-"):
+    f = finite(value)
+    return missing if f is None else spec.format(f)
+
+
+def _age(seconds):
+    if seconds is None or seconds < 0:
+        return "-"
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    return f"{seconds / 60:.1f}m"
+
+
+def _rolling(state):
+    """(median roofline_frac, median overlap_frac, n) over completions."""
+    rf = [f["roofline"] for f in state["fracs"] if f.get("roofline") is not None]
+    ov = [f["overlap"] for f in state["fracs"] if f.get("overlap") is not None]
+    return (
+        median(rf) if rf else None,
+        median(ov) if ov else None,
+        len(state["fracs"]),
+    )
+
+
+def render_text(state, width=96):
+    """The one frame both text modes (and the curses body) share."""
+    totals = state["totals"]
+    now = time.time()
+    lines = []
+    total = totals["total"] or "?"
+    lines.append(
+        f"sweep: {totals['done']}/{total} rows done"
+        f"{'  [sweep complete]' if state.get('sweep_done') else ''}"
+    )
+    lines.append(
+        f"  errors {totals['errors']}  quarantined {totals['quarantined']}"
+        f"  parked {totals['parked']}  retries {totals['retries']}"
+    )
+    rf, ov, n = _rolling(state)
+    lines.append(
+        f"  rolling pred-vs-measured (n={n}): "
+        f"median roofline_frac {_fmt(rf)}  median overlap_frac {_fmt(ov)}"
+    )
+    lines.append("")
+    lines.append("workers:")
+    if not state["workers"]:
+        lines.append("  (none seen — in-process sweep or no pool events yet)")
+    for worker, info in sorted(state["workers"].items(), key=lambda kv: str(kv[0])):
+        beat = _age(info.get("beat_age_s"))
+        setup = _fmt(info.get("setup_s"), "{:.1f}s")
+        lines.append(
+            f"  pid {worker}: {info.get('state', '?'):9s} setup {setup:>6s}"
+            f"  beat-age {beat:>5s}"
+            f"{'  ' + str(info.get('error', ''))[:40] if info.get('state') == 'dead' else ''}"
+        )
+    lines.append("")
+    lines.append("current row:")
+    if not state["current"]:
+        lines.append("  (idle)")
+    for src, cur in state["current"].items():
+        since = now - cur["since"] if cur.get("since") else None
+        shape = f"{cur.get('m')}x{cur.get('n')}x{cur.get('k')}"
+        lines.append(
+            f"  {cur.get('impl')} [{cur.get('primitive')} {shape}] — "
+            f"{str(cur.get('stage'))[:52]}  ({_age(since)} in row)"
+        )
+    lines.append("")
+    lines.append(
+        f"  {'impl':<18} {'median ms':>10} {'pred ms':>9} "
+        f"{'roofline':>8} {'overlap':>8}  flags"
+    )
+    for e in state["recent"]:
+        pred = e.get("predicted_s")
+        pred_ms = pred * 1e3 if isinstance(pred, (int, float)) else None
+        flags = []
+        if e.get("error"):
+            flags.append("ERROR")
+        if e.get("quarantined"):
+            flags.append("quarantined")
+        if e.get("retries"):
+            flags.append(f"retries={e['retries']}")
+        if e.get("worker_reused"):
+            flags.append("reused")
+        lines.append(
+            f"  {str(e.get('impl'))[:18]:<18} "
+            f"{_fmt(e.get('median_ms')):>10} {_fmt(pred_ms):>9} "
+            f"{_fmt(e.get('roofline_frac')):>8} "
+            f"{_fmt(e.get('measured_overlap_frac')):>8}  "
+            f"{' '.join(flags)}"
+        )
+    return "\n".join(line[:width] for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# HTML snapshot (static, self-contained — the hwlogs artifact)
+# ---------------------------------------------------------------------------
+
+_HTML_HEAD = """<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<title>sweep dashboard snapshot</title>
+<style>
+.viz-root {
+  color-scheme: light;
+  --surface-1: #fcfcfb; --surface-2: #f4f4f2;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --border: #d9d8d4;
+  --status-good: #0ca30c; --status-critical: #d03b3b;
+  --status-warning: #fab219;
+  background: var(--surface-1); color: var(--text-primary);
+  font: 14px/1.5 system-ui, sans-serif; padding: 24px; margin: 0;
+}
+@media (prefers-color-scheme: dark) {
+  :root:where(:not([data-theme="light"])) .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19; --surface-2: #242422;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --border: #3a3a37;
+  }
+}
+.viz-root h1 { font-size: 18px; margin: 0 0 4px; }
+.viz-root .sub { color: var(--text-secondary); margin: 0 0 20px; }
+.tiles { display: flex; gap: 12px; flex-wrap: wrap; margin: 0 0 24px; }
+.tile { background: var(--surface-2); border: 1px solid var(--border);
+  border-radius: 8px; padding: 12px 18px; min-width: 120px; }
+.tile .v { font-size: 28px; font-weight: 600; }
+.tile .l { color: var(--text-secondary); font-size: 12px; }
+table { border-collapse: collapse; margin: 0 0 24px; min-width: 60%; }
+caption { text-align: left; font-weight: 600; padding: 0 0 6px; }
+th { text-align: left; color: var(--text-secondary); font-weight: 500; }
+th, td { padding: 4px 14px 4px 0; border-bottom: 1px solid var(--border); }
+td.num, th.num { text-align: right; }
+.status { white-space: nowrap; }
+.status.good { color: var(--status-good); }
+.status.bad { color: var(--status-critical); }
+.status.warn { color: var(--status-warning); }
+</style></head><body class="viz-root">
+"""
+
+
+def render_html(state, source=""):
+    """A self-contained static snapshot: a stat-tile row + the worker
+    and recent-row tables. No charts — headline numbers are stat tiles
+    (the honest form for a handful of KPIs); status is icon + label,
+    never color alone; text wears ink tokens, light & dark both ship."""
+    esc = html_mod.escape
+    totals = state["totals"]
+    rf, ov, n = _rolling(state)
+    out = [_HTML_HEAD]
+    out.append("<h1>Sweep dashboard snapshot</h1>")
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    out.append(
+        f'<p class="sub">{esc(source)} &middot; rendered {stamp}'
+        f"{' &middot; sweep complete' if state.get('sweep_done') else ''}</p>"
+    )
+    tiles = [
+        (f"{totals['done']}/{totals['total'] or '?'}", "rows done"),
+        (str(totals["errors"]), "errors"),
+        (str(totals["quarantined"]), "quarantined"),
+        (str(totals["parked"]), "parked"),
+        (str(totals["retries"]), "retries"),
+        (_fmt(rf), f"median roofline frac (n={n})"),
+        (_fmt(ov), "median overlap frac"),
+    ]
+    out.append('<div class="tiles">')
+    for value, label in tiles:
+        out.append(
+            f'<div class="tile"><div class="v">{esc(value)}</div>'
+            f'<div class="l">{esc(label)}</div></div>'
+        )
+    out.append("</div>")
+
+    out.append('<table><caption>Workers</caption>')
+    out.append(
+        "<tr><th>pid</th><th>state</th><th class=num>setup</th>"
+        "<th class=num>beat age</th><th>note</th></tr>"
+    )
+    for worker, info in sorted(state["workers"].items(), key=lambda kv: str(kv[0])):
+        st = str(info.get("state", "?"))
+        cls, icon = {
+            "ready": ("good", "&#10003;"),
+            "busy": ("good", "&#10003;"),
+            "dead": ("bad", "&#10007;"),
+        }.get(st, ("warn", "&#8230;"))
+        out.append(
+            f"<tr><td>{esc(str(worker))}</td>"
+            f'<td class="status {cls}">{icon} {esc(st)}</td>'
+            f'<td class=num>{_fmt(info.get("setup_s"), "{:.1f}s")}</td>'
+            f'<td class=num>{esc(_age(info.get("beat_age_s")))}</td>'
+            f'<td>{esc(str(info.get("error", "") or ""))}</td></tr>'
+        )
+    out.append("</table>")
+
+    out.append('<table><caption>Recent rows</caption>')
+    out.append(
+        "<tr><th>impl</th><th class=num>median ms</th>"
+        "<th class=num>predicted ms</th><th class=num>roofline frac</th>"
+        "<th class=num>overlap frac</th><th>status</th></tr>"
+    )
+    for e in state["recent"]:
+        pred = e.get("predicted_s")
+        pred_ms = pred * 1e3 if isinstance(pred, (int, float)) else None
+        if e.get("error"):
+            status = '<td class="status bad">&#10007; error</td>'
+        elif e.get("quarantined"):
+            status = '<td class="status warn">&#9888; quarantined</td>'
+        else:
+            status = '<td class="status good">&#10003; measured</td>'
+        out.append(
+            f"<tr><td>{esc(str(e.get('impl')))}</td>"
+            f"<td class=num>{_fmt(e.get('median_ms'))}</td>"
+            f"<td class=num>{_fmt(pred_ms)}</td>"
+            f"<td class=num>{_fmt(e.get('roofline_frac'))}</td>"
+            f"<td class=num>{_fmt(e.get('measured_overlap_frac'))}</td>"
+            f"{status}</tr>"
+        )
+    out.append("</table></body></html>\n")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+
+def _load_state(path):
+    events, offset = live.read_events(path)
+    return live.fold(events), offset
+
+
+def run_curses(path, interval):  # pragma: no cover - interactive
+    """Full-screen tail. q quit; r rebuild from byte 0; h HTML dump."""
+    import curses
+
+    def _main(screen):
+        curses.curs_set(0)
+        screen.nodelay(True)
+        state, offset = _load_state(path)
+        last = 0.0
+        while True:
+            key = screen.getch()
+            if key in (ord("q"), ord("Q")):
+                return
+            if key in (ord("r"), ord("R")):
+                state, offset = _load_state(path)
+            if key in (ord("h"), ord("H")):
+                snap = path + ".html"
+                with open(snap, "w", encoding="utf-8") as f:
+                    f.write(render_html(state, source=path))
+            if time.monotonic() - last >= interval:
+                events, offset = live.read_events(path, offset)
+                state = live.fold(events, state)
+                height, width = screen.getmaxyx()
+                screen.erase()
+                header = f" sweep_dash — {path}  (q quit, r reload, h html)"
+                screen.addnstr(0, 0, header, width - 1, curses.A_REVERSE)
+                body = render_text(state, width=width - 1)
+                for i, line in enumerate(body.splitlines()):
+                    if i + 1 >= height:
+                        break
+                    screen.addnstr(i + 1, 0, line, width - 1)
+                screen.refresh()
+                last = time.monotonic()
+            time.sleep(0.05)
+
+    curses.wrapper(_main)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    once = "--once" in argv
+    follow = "--follow" in argv
+    argv = [a for a in argv if a not in ("--once", "--follow")]
+
+    def _opt(flag, default=None):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                raise SystemExit(f"sweep_dash: {flag} needs a value")
+            value = argv[i + 1]
+            del argv[i: i + 2]
+            return value
+        return default
+
+    html_out = _opt("--html")
+    interval = float(_opt("--interval", "1.0"))
+    path = argv[0] if argv else os.environ.get("DDLB_TPU_LIVE", "")
+    if not path:
+        print(
+            "usage: sweep_dash.py <live_file> [--once] [--html OUT] "
+            "[--interval S] [--follow]   (or set DDLB_TPU_LIVE)"
+        )
+        return 2
+    if not os.path.exists(path):
+        print(f"sweep_dash: no live stream at {path} — start the sweep "
+              f"with DDLB_TPU_LIVE={path}")
+        return 1
+
+    if html_out:
+        state, _ = _load_state(path)
+        with open(html_out, "w", encoding="utf-8") as f:
+            f.write(render_html(state, source=path))
+        print(f"sweep_dash: HTML snapshot written to {html_out}")
+        return 0
+    if once or not sys.stdout.isatty():
+        state, offset = _load_state(path)
+        if once:
+            print(render_text(state))
+            return 0
+        # piped follow mode: append one frame per interval (no ANSI)
+        while True:
+            print(render_text(state), "\n", flush=True)
+            if state.get("sweep_done") and not follow:
+                return 0
+            time.sleep(interval)
+            events, offset = live.read_events(path, offset)
+            state = live.fold(events, state)
+    try:
+        run_curses(path, interval)
+    except Exception as exc:  # curses unavailable (no TERM, etc.)
+        print(f"sweep_dash: curses unavailable ({exc}); one plain frame:")
+        state, _ = _load_state(path)
+        print(render_text(state))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

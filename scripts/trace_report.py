@@ -13,6 +13,13 @@ answers the attribution questions ISSUE 2 exists for:
   a partition;
 - **top spans** — the individual spans that ate the clock, aggregated
   by name (count, total, max);
+- **per-row breakdown** — each ``worker.row`` span with its nested
+  phase spans aggregated by category. Grouped by ROW SPAN, not by pid:
+  a warm pool worker (PR 5) emits many rows into one process shard, so
+  the pre-pool one-row-per-process assumption would smear every row's
+  phases together (the grouping lives in
+  ``ddlb_tpu/observatory/attribution.rows_from_events`` and is shared
+  with the observatory);
 - **prefetch overlap efficiency** — how much of the compile-ahead
   engine's background compile time (``compile_ahead.prefetch`` spans)
   actually hid under measurement (``timing``-category spans) instead of
@@ -38,6 +45,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+from ddlb_tpu.observatory.attribution import rows_from_events  # noqa: E402
 from ddlb_tpu.telemetry import trace as ttrace  # noqa: E402
 
 
@@ -140,6 +148,9 @@ def build_report(trace_dir, top_n=10, xprof_dir=None):
             {"name": n, "count": c, "total_ms": t, "max_ms": m}
             for n, c, t, m in top_spans(events, top_n)
         ],
+        # grouped by worker.row span (NOT by pid): one warm pool worker
+        # emits many rows into a single process shard
+        "rows": rows_from_events(events),
         "prefetch_overlap": prefetch_overlap(events),
     }
     if xprof_dir:
@@ -189,6 +200,23 @@ def print_report(report):
             f"  {row['total_ms']:10.1f} ms  x{row['count']:<4d} "
             f"max {row['max_ms']:8.1f} ms  {row['name']}"
         )
+    rows = report.get("rows") or []
+    if rows:
+        print(
+            f"\nper-row phase breakdown ({len(rows)} row(s), grouped by "
+            f"row span — pool workers emit many rows per process):"
+        )
+        for row in rows:
+            phases = "  ".join(
+                f"{cat} {ms:.1f}"
+                for cat, ms in sorted(
+                    row["phases"].items(), key=lambda kv: -kv[1]
+                )
+            )
+            print(
+                f"  {row['dur_ms']:10.1f} ms  pid {row['pid']}  "
+                f"{row['impl'] or '?'}: {phases or '(no nested spans)'}"
+            )
     ov = report.get("prefetch_overlap")
     if ov:
         print(

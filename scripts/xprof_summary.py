@@ -67,10 +67,10 @@ def _planes(path):
             yield plane
 
 
-def top_ops(profile_dir: str, top_n: int = 15):
-    """[(op name, total_ms, fraction-of-line)] for the busiest device
-    line across every xplane under ``profile_dir``."""
-    best = None  # (total_ps, line_name, {name: ps})
+def _busiest_line(profile_dir: str):
+    """(line_name, {name: [total_ps, count]}, window_ns) for the busiest
+    device/XLA line across every xplane, or (None, {}, None)."""
+    best = None  # (total_ps, line_name, {name: [ps, count]}, window_ns)
     for plane in _planes(profile_dir):
         pname = plane.name.lower()
         md = {k: v.name for k, v in plane.event_metadata.items()}
@@ -86,19 +86,95 @@ def top_ops(profile_dir: str, top_n: int = 15):
             ):
                 continue
             agg = {}
+            first_ps = last_ps = None
             for e in line.events:
                 name = md.get(e.metadata_id, str(e.metadata_id))
-                agg[name] = agg.get(name, 0) + e.duration_ps
-            total = sum(agg.values())
+                rec = agg.setdefault(name, [0, 0])
+                rec[0] += e.duration_ps
+                rec[1] += 1
+                end_ps = e.offset_ps + e.duration_ps
+                if first_ps is None or e.offset_ps < first_ps:
+                    first_ps = e.offset_ps
+                if last_ps is None or end_ps > last_ps:
+                    last_ps = end_ps
+            total = sum(ps for ps, _ in agg.values())
             if total and (best is None or total > best[0]):
-                best = (total, f"{plane.name} / {line.name}", agg)
+                # epoch-comparable window: line timestamp_ns + the event
+                # offsets — what the observatory joins host spans against
+                window = (
+                    [
+                        line.timestamp_ns + first_ps / 1e3,
+                        line.timestamp_ns + last_ps / 1e3,
+                    ]
+                    if first_ps is not None
+                    else None
+                )
+                best = (total, f"{plane.name} / {line.name}", agg, window)
     if best is None:
+        return None, {}, None
+    return best[1], best[2], best[3]
+
+
+def top_ops(profile_dir: str, top_n: int = 15):
+    """[(op name, total_ms, fraction-of-line)] for the busiest device
+    line across every xplane under ``profile_dir``."""
+    line_name, agg, _ = _busiest_line(profile_dir)
+    if line_name is None:
         return None, []
-    total, line_name, agg = best
-    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top_n]
+    total = sum(ps for ps, _ in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_n]
     return line_name, [
-        (name, ps / 1e9, ps / total) for name, ps in rows
+        (name, ps / 1e9, ps / total) for name, (ps, _) in rows
     ]
+
+
+def _empty_doc(profile_dir: str, error: str = "") -> dict:
+    """The well-formed JSON document shape on EVERY exit path — the
+    TF-absent guard included — so the observatory and trace_report can
+    consume ``--json`` output without special-casing failure (ISSUE 6
+    satellite): all join fields present, empty."""
+    return {
+        "profile_dir": profile_dir,
+        "error": error,
+        "line": None,
+        "ops": [],
+        "window_ns": None,
+        "device_busy_ms": 0.0,
+        "event_count": 0,
+    }
+
+
+def device_summary(profile_dir: str, top_n: int = 15) -> dict:
+    """The ``--json`` document with the span-join fields the observatory
+    consumes: the busiest line's per-op table (with counts), the line's
+    event window in epoch-comparable nanoseconds (host telemetry spans
+    carry epoch-µs ``ts``, so ``window_ns / 1e3`` joins directly), the
+    line's busy total and event count. Raises on unparseable traces —
+    ``main`` maps every failure onto the same well-formed empty doc."""
+    line_name, agg, window = _busiest_line(profile_dir)
+    if line_name is None:
+        return _empty_doc(
+            profile_dir, f"no device-plane events under {profile_dir}"
+        )
+    total = sum(ps for ps, _ in agg.values())
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_n]
+    return {
+        "profile_dir": profile_dir,
+        "error": "",
+        "line": line_name,
+        "ops": [
+            {
+                "name": name,
+                "total_ms": ps / 1e9,
+                "fraction": ps / total,
+                "count": count,
+            }
+            for name, (ps, count) in rows
+        ],
+        "window_ns": window,
+        "device_busy_ms": total / 1e9,
+        "event_count": sum(count for _, count in agg.values()),
+    }
 
 
 def main(argv) -> int:
@@ -110,35 +186,35 @@ def main(argv) -> int:
     profile_dir = args[0]
     top_n = int(args[1]) if len(args) > 1 else 15
     try:
-        line_name, rows = top_ops(profile_dir, top_n)
+        doc = device_summary(profile_dir, top_n)
     except Exception as exc:  # missing TF proto, corrupt trace, ...
         msg = (f"xprof_summary: cannot parse {profile_dir}: "
                f"{type(exc).__name__}: {exc}")
         if as_json:
-            print(json.dumps({"error": msg, "profile_dir": profile_dir}))
+            # the guard contract: a TF-less host still emits the full
+            # well-formed document, just empty, so downstream JSON
+            # consumers never special-case the failure shape
+            print(json.dumps(_empty_doc(profile_dir, msg)))
         else:
             print(msg)
         return 1
-    if line_name is None:
-        msg = f"xprof_summary: no device-plane events under {profile_dir}"
+    if doc["line"] is None:
+        # device_summary already emitted the well-formed empty doc with
+        # the no-device-events message in doc["error"]
         if as_json:
-            print(json.dumps({"error": msg, "profile_dir": profile_dir}))
+            print(json.dumps(doc))
         else:
-            print(msg)
+            print(f"xprof_summary: {doc['error']}")
         return 1
     if as_json:
-        print(json.dumps({
-            "profile_dir": profile_dir,
-            "line": line_name,
-            "ops": [
-                {"name": name, "total_ms": ms, "fraction": frac}
-                for name, ms, frac in rows
-            ],
-        }))
+        print(json.dumps(doc))
         return 0
-    print(f"xprof top ops — {line_name}")
-    for name, ms, frac in rows:
-        print(f"  {frac:6.1%}  {ms:10.3f} ms  {name[:90]}")
+    print(f"xprof top ops — {doc['line']}")
+    for op in doc["ops"]:
+        print(
+            f"  {op['fraction']:6.1%}  {op['total_ms']:10.3f} ms  "
+            f"x{op['count']:<5d} {op['name'][:84]}"
+        )
     return 0
 
 

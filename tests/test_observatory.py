@@ -1,0 +1,579 @@
+"""Perf observatory (ISSUE 6): run-history store, measured-overlap
+attribution columns on every row, the regression detector + report CLI,
+the live sweep stream + dashboard renderers, and the bench gate's
+history layer."""
+
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ddlb_tpu.observatory import attribution, live, regress, store
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _row(impl="overlap_0", ms=1.0, **over):
+    row = {
+        "implementation": impl,
+        "primitive": "tp_columnwise",
+        "base_implementation": impl.rsplit("_", 1)[0],
+        "option": "algorithm=default",
+        "m": 64, "n": 64, "k": 64,
+        "dtype": "float32",
+        "world_size": 8,
+        "chip": "cpu-sim",
+        "time_measurement_backend": "host_clock",
+        "median time (ms)": ms,
+        "predicted_s": 1e-6,
+        "error": "",
+    }
+    row.update(over)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# measured-overlap attribution
+# ---------------------------------------------------------------------------
+
+
+class _Est:
+    def __init__(self, compute_s=0.0, comm_s=0.0, hbm_s=0.0):
+        self.compute_s, self.comm_s, self.hbm_s = compute_s, comm_s, hbm_s
+
+
+def test_attribution_hand_computed_overlap():
+    """compute 2s + comm 1s, measured 2.2s: serial floor 3s, overlap
+    floor 2s, hideable 1s -> 80% of the hideable window was hidden."""
+    out = attribution.attribute(_Est(2.0, 1.0), "overlap", 2.2)
+    assert out["measured_overlap_frac"] == pytest.approx(0.8)
+    assert out["phase_compute_s"] == 2.0
+    assert out["phase_comm_s"] == 1.0
+    assert out["phase_idle_s"] == pytest.approx(0.2)
+
+
+def test_attribution_clamps():
+    # measured below the overlap floor (noise): clamp to 1, idle 0
+    out = attribution.attribute(_Est(2.0, 1.0), "overlap", 1.9)
+    assert out["measured_overlap_frac"] == 1.0
+    assert out["phase_idle_s"] == 0.0
+    # measured above the serial floor: nothing was hidden
+    out = attribution.attribute(_Est(2.0, 1.0), "overlap", 5.0)
+    assert out["measured_overlap_frac"] == 0.0
+    assert out["phase_idle_s"] == pytest.approx(3.0)
+
+
+def test_attribution_degenerate_and_non_overlap():
+    # no comm term (1-device collective): nothing hideable -> NaN
+    out = attribution.attribute(_Est(2.0, 0.0), "overlap", 2.5)
+    assert math.isnan(out["measured_overlap_frac"])
+    assert out["phase_idle_s"] == pytest.approx(0.5)
+    # sequential member: phases attributed, overlap frac undefined
+    out = attribution.attribute(_Est(2.0, 1.0), "sequential", 3.5)
+    assert math.isnan(out["measured_overlap_frac"])
+    assert out["phase_compute_s"] == 2.0
+    # no measurement: everything NaN but the model floors
+    out = attribution.attribute(_Est(2.0, 1.0), "overlap", float("nan"))
+    assert math.isnan(out["measured_overlap_frac"])
+    assert math.isnan(out["phase_idle_s"])
+    assert out["phase_comm_s"] == 1.0
+
+
+def test_runner_rows_carry_attribution_columns():
+    """Every overlap-member row — measured AND error paths — carries
+    measured_overlap_frac and the per-phase breakdown (the ISSUE 6
+    acceptance criterion)."""
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    cols = tuple(attribution.ATTRIBUTION_ROW_DEFAULTS)
+    row = benchmark_worker({
+        "primitive": "tp_columnwise", "impl_id": "overlap_0",
+        "base_implementation": "overlap",
+        "options": {"algorithm": "default"},
+        "m": 64, "n": 64, "k": 64, "dtype": "float32",
+        "num_iterations": 2, "num_warmups": 1, "validate": False,
+    })
+    assert row["error"] == ""
+    for col in cols:
+        assert col in row
+    assert 0.0 <= row["measured_overlap_frac"] <= 1.0
+    assert row["phase_comm_s"] > 0.0
+    assert row["phase_idle_s"] >= 0.0
+    # error path (impl construction fails): columns present, NaN
+    err = benchmark_worker({
+        "primitive": "tp_columnwise", "impl_id": "overlap_1",
+        "base_implementation": "overlap",
+        "options": {"algorithm": "no_such_algorithm"},
+        "m": 64, "n": 64, "k": 64, "dtype": "float32",
+    })
+    assert err["error"]
+    for col in cols:
+        assert col in err
+        assert math.isnan(err[col])
+
+
+# ---------------------------------------------------------------------------
+# run-history store
+# ---------------------------------------------------------------------------
+
+
+def test_store_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv("DDLB_TPU_HISTORY", raising=False)
+    assert store.bank_row(_row()) is False
+    assert store.load_history() == []
+
+
+def test_store_roundtrip_and_key(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    assert store.bank_row(_row(ms=1.5), run="runA") is True
+    assert store.bank_row(_row(ms=2.5), run="runB") is True
+    records = store.load_history()
+    assert len(records) == 2
+    rec = records[0]
+    assert rec["run_id"] == "runA"
+    assert rec["kind"] == "row"
+    assert rec["row"]["median time (ms)"] == 1.5
+    # key: stable identity, identical across runs of the same config,
+    # different when the config differs
+    assert rec["key"] == records[1]["key"]
+    assert store.row_key(_row(m=128)) != rec["key"]
+    key = json.loads(rec["key"])
+    assert key["chip"] == "cpu-sim"
+    assert key["base_implementation"] == "overlap"
+
+
+def test_store_skips_corrupt_lines(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path))
+    store.bank_row(_row())
+    path = store.history_path()
+    with open(path, "a") as f:
+        f.write('{"truncated mid-wri\n')
+    store.bank_row(_row())
+    assert len(store.load_history()) == 2
+
+
+def test_sweep_runner_banks_rows_automatically(tmp_path, monkeypatch):
+    """The acceptance wiring: a plain in-process sweep with
+    DDLB_TPU_HISTORY set banks every row (error rows included) with no
+    caller changes."""
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise", 64, 32, 64,
+        implementations={
+            "jax_spmd_0": {"implementation": "jax_spmd"},
+            "overlap_1": {
+                "implementation": "overlap", "algorithm": "no_such_algo",
+            },
+        },
+        dtype="float32", num_iterations=2, num_warmups=1,
+        validate=False, progress=False, max_retries=0,
+    )
+    df = runner.run()
+    assert len(df) == 2
+    records = store.load_history()
+    assert len(records) == 2
+    banked = {r["row"]["implementation"]: r["row"] for r in records}
+    assert banked["jax_spmd_0"]["error"] == ""
+    assert banked["overlap_1"]["error"]  # the error row banked too
+    assert len({r["run_id"] for r in records}) == 1
+
+
+# ---------------------------------------------------------------------------
+# regression detection
+# ---------------------------------------------------------------------------
+
+
+def test_median_and_mad():
+    assert regress.median([3.0, 1.0, 2.0]) == 2.0
+    assert regress.median([1.0, 2.0, 3.0, 4.0]) == 2.5
+    assert regress.mad([1.0, 2.0, 3.0, 100.0]) == 1.0  # outlier-immune
+    assert math.isnan(regress.median([]))
+
+
+def _history(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    for run in ("run1", "run2"):
+        store.bank_row(_row("overlap_0", 1.0), run=run)
+        store.bank_row(_row("jax_spmd_1", 2.0), run=run)
+    return store.load_history()
+
+
+def test_detect_seeded_slowdown_ranked_first(tmp_path, monkeypatch):
+    history = _history(tmp_path, monkeypatch)
+    current = [
+        _row("jax_spmd_1", 2.6),   # 1.3x — a lesser regression
+        _row("overlap_0", 3.0),    # the seeded 3x slowdown
+    ]
+    findings = regress.detect(current, history)
+    assert len(findings) == 2
+    assert findings[0]["implementation"] == "overlap_0"  # ranked first
+    assert findings[0]["ratio"] == pytest.approx(3.0)
+    assert findings[0]["source"] == "history"
+    assert findings[0]["z"] > findings[1]["z"]
+
+
+def test_detect_within_noise_is_clean(tmp_path, monkeypatch):
+    history = _history(tmp_path, monkeypatch)
+    current = [_row("overlap_0", 1.04), _row("jax_spmd_1", 2.05)]
+    assert regress.detect(current, history) == []
+
+
+def test_detect_excludes_current_run(tmp_path, monkeypatch):
+    """A run must not baseline against its own banked rows: the current
+    run's slow rows are already IN the bank (auto-banking), and leaving
+    them in would dilute the baseline toward the regression itself."""
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    store.bank_row(_row("overlap_0", 1.0), run="run1")
+    store.bank_row(_row("overlap_0", 3.0), run="run3")  # current, banked
+    history = store.load_history()
+    current = [_row("overlap_0", 3.0)]
+    # self-contaminated baseline (median of 1.0 and 3.0) hides the 3x
+    assert regress.detect(current, history) == []
+    # excluded: the baseline is run1's 1.0 and the slowdown is flagged
+    findings = regress.detect(current, history, exclude_run="run3")
+    assert len(findings) == 1 and findings[0]["ratio"] == pytest.approx(3.0)
+
+
+def test_detect_perfmodel_prior_fallback(tmp_path, monkeypatch):
+    """No history at all: the analytical lower bound is the baseline
+    and a grossly-off row still gets flagged, ranked after any
+    history-backed findings."""
+    history = _history(tmp_path, monkeypatch)
+    current = [
+        _row("overlap_0", 3.0),  # history-backed 3x
+        # new config never banked: 10 ms vs a 1 ms analytical floor
+        _row("pallas_9", 10.0, option="kernel=pallas",
+             **{"predicted_s": 1e-3}),
+    ]
+    findings = regress.detect(current, history)
+    assert [f["source"] for f in findings] == ["history", "perfmodel_prior"]
+    assert findings[1]["implementation"] == "pallas_9"
+    assert findings[1]["ratio"] == pytest.approx(10.0)
+    # and a new config within prior_factor of its bound stays clean
+    ok = _row("pallas_9", 10.0, option="kernel=pallas",
+              **{"predicted_s": 5e-3})
+    assert regress.detect([ok], history) == []
+
+
+def test_error_rows_never_regress(tmp_path, monkeypatch):
+    history = _history(tmp_path, monkeypatch)
+    nan_row = _row("overlap_0", float("nan"), error="CrashError: boom")
+    assert regress.detect([nan_row], history) == []
+
+
+# ---------------------------------------------------------------------------
+# observatory_report.py CLI
+# ---------------------------------------------------------------------------
+
+
+def test_report_cli_detects_and_ranks(tmp_path, monkeypatch):
+    """The ISSUE 6 acceptance criterion, end to end: two banked CPU-sim
+    runs, a third with a seeded slowdown — the report detects it, ranks
+    it first, and exits 1."""
+    _history(tmp_path, monkeypatch)
+    store.bank_row(_row("overlap_0", 3.0), run="run3")   # seeded 3x
+    store.bank_row(_row("jax_spmd_1", 2.02), run="run3")  # in the noise
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "observatory_report.py")],
+        env=dict(os.environ, DDLB_TPU_HISTORY=str(tmp_path / "hist")),
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    lines = [l for l in out.stdout.splitlines() if " overlap_0 " in l]
+    assert lines and lines[0].lstrip().startswith("1 ")  # ranked first
+    assert "jax_spmd_1" not in out.stdout  # noise row not flagged
+
+
+def test_report_cli_json_and_csv_current(tmp_path, monkeypatch):
+    _history(tmp_path, monkeypatch)
+    # current run as a sweep CSV (stringly-typed like pandas writes it)
+    csv_path = tmp_path / "current.csv"
+    row = _row("overlap_0", 4.0)
+    with open(csv_path, "w") as f:
+        f.write(",".join(row.keys()) + "\n")
+        f.write(",".join(str(v) for v in row.values()) + "\n")
+    rep = _load_script("observatory_report")
+    report = rep.build_report(
+        str(tmp_path / "hist"), {"current": str(csv_path)}
+    )
+    assert report["current_rows"] == 1
+    assert len(report["findings"]) == 1
+    assert report["findings"][0]["source"] == "history"  # key matched CSV
+    json.dumps(report)  # JSON-clean
+
+
+def test_report_csv_mode_excludes_its_own_banked_copies(
+    tmp_path, monkeypatch
+):
+    """A sweep run with history ON banks the very rows its CSV holds:
+    --current CSV must not let the run baseline against itself (the
+    2x regression would otherwise hide inside its own diluted
+    median)."""
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    store.bank_row(_row("overlap_0", 1.0), run="old")
+    slow = _row("overlap_0", 2.0)
+    store.bank_row(slow, run="current")  # the CSV's own banked copy
+    csv_path = tmp_path / "current.csv"
+    with open(csv_path, "w") as f:
+        f.write(",".join(slow.keys()) + "\n")
+        f.write(",".join(str(v) for v in slow.values()) + "\n")
+    rep = _load_script("observatory_report")
+    report = rep.build_report(
+        str(tmp_path / "hist"), {"current": str(csv_path)}
+    )
+    assert len(report["findings"]) == 1  # baseline = old run's 1.0 only
+    assert report["findings"][0]["ratio"] == pytest.approx(2.0)
+
+
+def test_report_cli_no_history_is_usage_error(tmp_path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "observatory_report.py")],
+        env={k: v for k, v in os.environ.items() if k != "DDLB_TPU_HISTORY"},
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert out.returncode == 2
+    assert "DDLB_TPU_HISTORY" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# live stream + dashboard
+# ---------------------------------------------------------------------------
+
+
+def _seed_live(monkeypatch, tmp_path):
+    path = str(tmp_path / "live.jsonl")
+    monkeypatch.setenv("DDLB_TPU_LIVE", path)
+    live.post_event("sweep_start", total=3, primitive="tp_columnwise")
+    live.post_event("worker_spawn", worker=999, reason="first")
+    live.post_event("worker_ready", worker=999, setup_s=1.5, platform="cpu")
+    live.post_event("row_start", impl="overlap_0",
+                    primitive="tp_columnwise", m=64, n=64, k=64)
+    live.post_event("row_phase", impl="overlap_0", stage="measuring")
+    live.post_event("worker_beat", worker=999, age_s=0.5)
+    live.post_event("row_done", impl="overlap_0", median_ms=1.2,
+                    predicted_s=1e-4, roofline_frac=0.4,
+                    measured_overlap_frac=0.7, error="", retries=0,
+                    quarantined=False, worker_reused=True)
+    live.post_event("row_done", impl="jax_spmd_1", median_ms=2.0,
+                    predicted_s=2e-4, roofline_frac=0.2,
+                    error="RuntimeError: boom", retries=1)
+    live.post_event("queue_parked", label="bad", attempts=2)
+    live.post_event("worker_dead", worker=999, error="silent (killed)")
+    return path
+
+
+def test_live_disabled_is_noop(monkeypatch, tmp_path):
+    monkeypatch.delenv("DDLB_TPU_LIVE", raising=False)
+    assert live.post_event("row_done") is False
+
+
+def test_live_post_read_fold(monkeypatch, tmp_path):
+    path = _seed_live(monkeypatch, tmp_path)
+    events, offset = live.read_events(path)
+    assert offset == os.path.getsize(path)
+    assert [e["kind"] for e in events[:3]] == [
+        "sweep_start", "worker_spawn", "worker_ready",
+    ]
+    state = live.fold(events)
+    assert state["totals"] == {
+        "total": 3, "done": 2, "errors": 1, "quarantined": 0,
+        "parked": 1, "retries": 1,
+    }
+    assert state["workers"][999]["state"] == "dead"
+    assert state["workers"][999]["setup_s"] == 1.5
+    assert state["current"] == {}  # row_done cleared it
+    assert len(state["recent"]) == 2
+    # incremental tail: fold new events onto the same state
+    live.post_event("row_start", impl="x_2", primitive="tp_columnwise",
+                    m=1, n=1, k=1)
+    more, offset2 = live.read_events(path, offset)
+    assert [e["kind"] for e in more] == ["row_start"]
+    state = live.fold(more, state)
+    assert list(state["current"].values())[0]["impl"] == "x_2"
+
+
+def test_fold_matches_phase_marks_across_pids(monkeypatch, tmp_path):
+    """row_start is posted by the RUNNER, row_phase by the pool WORKER
+    (a different pid): the fold must still attach the stage to the
+    in-flight row, by impl id."""
+    events = [
+        {"ts": 1.0, "pid": 100, "kind": "row_start", "impl": "overlap_0",
+         "primitive": "tp_columnwise", "m": 64, "n": 64, "k": 64},
+        {"ts": 2.0, "pid": 200, "kind": "row_phase", "impl": "overlap_0",
+         "stage": "warmup done; measuring"},
+    ]
+    state = live.fold(events)
+    assert state["current"][100]["stage"] == "warmup done; measuring"
+
+
+def test_live_tolerates_torn_multibyte_tail(monkeypatch, tmp_path):
+    path = _seed_live(monkeypatch, tmp_path)
+    with open(path, "ab") as f:
+        f.write('{"kind": "row_done", "error": "x —'.encode()[:-1])
+    events, offset = live.read_events(path)  # must not raise
+    assert offset < os.path.getsize(path)
+    assert all("—" not in str(e.get("error", "")) for e in events)
+
+
+def test_live_partial_tail_line_deferred(monkeypatch, tmp_path):
+    path = _seed_live(monkeypatch, tmp_path)
+    with open(path, "a") as f:
+        f.write('{"kind": "row_done", "half')  # no newline: in-flight
+    events, offset = live.read_events(path)
+    assert all(e["kind"] != "row_done" or "half" not in str(e)
+               for e in events)
+    assert offset < os.path.getsize(path)  # the partial line waits
+
+
+def test_dashboard_text_and_html(monkeypatch, tmp_path, capsys):
+    path = _seed_live(monkeypatch, tmp_path)
+    dash = _load_script("sweep_dash")
+    state = live.fold(live.read_events(path)[0])
+    text = dash.render_text(state)
+    assert "1/3 rows done" not in text  # 2 done of 3
+    assert "2/3 rows done" in text
+    assert "parked 1" in text
+    assert "overlap_0" in text and "0.700" in text
+    assert "pid 999" in text and "dead" in text
+    html_doc = dash.render_html(state, source=path)
+    assert html_doc.startswith("<!DOCTYPE html>")
+    assert "2/3" in html_doc and "quarantined" in html_doc
+    assert "&#10007; error" in html_doc  # status = icon + label
+    # the CLI: --once prints a frame; --html writes the snapshot
+    assert dash.main([path, "--once"]) == 0
+    assert "rows done" in capsys.readouterr().out
+    snap = tmp_path / "snap.html"
+    assert dash.main([path, "--html", str(snap)]) == 0
+    assert snap.stat().st_size > 500
+
+
+def test_dashboard_missing_stream(tmp_path, capsys):
+    dash = _load_script("sweep_dash")
+    assert dash.main([str(tmp_path / "absent.jsonl"), "--once"]) == 1
+    assert dash.main([]) == 2 if not os.environ.get("DDLB_TPU_LIVE") else True
+
+
+def test_pooled_sweep_feeds_live_stream(tmp_path, monkeypatch):
+    """The dashboard's acceptance surface: a POOLED sweep (one warm
+    child) posts worker lifecycle + row completions into the stream."""
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    path = str(tmp_path / "live.jsonl")
+    monkeypatch.setenv("DDLB_TPU_LIVE", path)
+    runner = PrimitiveBenchmarkRunner(
+        "tp_columnwise", 64, 32, 64,
+        implementations={
+            "compute_only_0": {
+                "implementation": "compute_only", "size": "unsharded",
+            },
+            "compute_only_1": {
+                "implementation": "compute_only", "size": "unsharded",
+            },
+        },
+        dtype="float32", num_iterations=2, num_warmups=1, validate=False,
+        isolation="subprocess", progress=False, worker_pool=True,
+    )
+    df = runner.run()
+    assert len(df) == 2
+    events, _ = live.read_events(path)
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("row_done") == 2
+    assert "sweep_start" in kinds and "sweep_done" in kinds
+    assert "worker_spawn" in kinds and "worker_ready" in kinds
+    # phase marks arrive from the CHILD process (env inherited at spawn)
+    child_pids = {e["pid"] for e in events if e["kind"] == "row_phase"}
+    assert child_pids and child_pids != {os.getpid()}
+    state = live.fold(events)
+    assert state["totals"]["done"] == 2
+    assert state["sweep_done"] is True
+    ready = [w for w in state["workers"].values()
+             if w.get("setup_s") is not None]
+    assert ready and ready[0]["setup_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# xprof --json span-join contract (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_xprof_json_empty_doc_is_well_formed(tmp_path, monkeypatch, capsys):
+    """TF absent: --json must still emit the FULL document shape, empty,
+    so observatory consumers never special-case the failure."""
+    import builtins
+
+    real_import = builtins.__import__
+
+    def _no_tf(name, *a, **kw):
+        if name.startswith("tensorflow"):
+            raise ImportError("No module named 'tensorflow'")
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", _no_tf)
+    xp = _load_script("xprof_summary")
+    assert xp.main(["x", str(tmp_path), "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["line"] is None
+    assert doc["ops"] == []
+    assert doc["window_ns"] is None
+    assert doc["device_busy_ms"] == 0.0
+    assert doc["event_count"] == 0
+    assert "XplaneUnavailable" in doc["error"]
+
+
+# ---------------------------------------------------------------------------
+# bench gate history layer
+# ---------------------------------------------------------------------------
+
+
+def test_bench_gate_uses_history_median(tmp_path, monkeypatch, capsys):
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate_test", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path / "hist"))
+    head = {
+        "metric": "tp_columnwise_gemm_pallas_8192x8192x8192_bf16",
+        "world_size": 1, "roofline_frac": 0.80,
+        "platform": "tpu", "valid": True,
+    }
+    # one outlier capture among five: the MEDIAN baseline (0.80) must
+    # win over the last-capture rule (which would compare against 0.30
+    # and see no regression)
+    for frac, run in ((0.80, "r1"), (0.81, "r2"), (0.79, "r3"),
+                      (0.80, "r4"), (0.30, "r5")):
+        store.bank_row(dict(head, roofline_frac=frac), kind="bench",
+                       run=run)
+    # an INVALID capture and a CPU-fallback capture also land in the
+    # bank (_bank_headline is unconditional on the success path) but
+    # must never shape the baseline — same gating as the cache layer
+    store.bank_row(dict(head, roofline_frac=0.99, valid=False),
+                   kind="bench", run="bad1")
+    store.bank_row(dict(head, roofline_frac=0.01, platform="cpu"),
+                   kind="bench", run="bad2")
+    fresh = dict(head, roofline_frac=0.55)
+    bench._check_roofline_regression(fresh)
+    assert fresh.get("roofline_regression") is True
+    assert fresh["roofline_frac_prev"] == pytest.approx(0.80)
+    assert "history median" in capsys.readouterr().err
+    # within tolerance of the median: clean
+    ok = dict(head, roofline_frac=0.75)
+    bench._check_roofline_regression(ok)
+    assert "roofline_regression" not in ok

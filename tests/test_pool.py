@@ -264,6 +264,37 @@ def test_reused_worker_resets_fault_counters_per_row(tmp_path, monkeypatch):
     assert df.iloc[1]["worker_reused"] == True  # noqa: E712
 
 
+def test_await_row_silent_kill_without_heartbeat_channel():
+    """await_row advertises itself as the one shared hung/dead-child
+    policy; a caller without a beat channel (heartbeat_channel=None)
+    must still get the TimeoutError AwaitResult back from the
+    silent-kill path, never an AttributeError after the kill."""
+    import queue as queue_mod
+
+    from ddlb_tpu.pool import await_row
+
+    class _SilentProc:
+        pid = 12345
+
+        def is_alive(self):
+            return True
+
+        def kill(self):
+            self.killed = True
+
+        def join(self, timeout=None):
+            pass
+
+    proc = _SilentProc()
+    result = await_row(
+        proc, queue_mod.Queue(), None, worker_timeout=1.5
+    )
+    assert proc.killed
+    assert result.worker_dead
+    assert result.row is None
+    assert "with no heartbeat" in result.error
+
+
 def test_worker_pool_env_defaults(monkeypatch):
     from ddlb_tpu.envs import get_pool_max_rows, get_worker_pool
 

@@ -205,6 +205,47 @@ def test_metrics_global_registry_receives_all_threads():
     assert telemetry.global_snapshot()["global_probe"] >= 3.0
 
 
+def test_metrics_scopes_do_not_leak_across_pool_threads():
+    """The pool-worker layout (ISSUE 6 satellite): a long-lived child
+    runs row N's metrics scope on its dispatch thread WHILE the
+    compile-ahead scheduler prefetch-compiles row N+1 on a background
+    thread — whatever the background thread records (its own scope or
+    scopeless) must never land in the row's scope, and consecutive row
+    scopes on the same thread must start empty (a reused worker runs
+    many rows per process)."""
+    import threading
+
+    start = threading.Barrier(2, timeout=30)
+    row_done = threading.Event()
+
+    def _prefetch_thread():
+        start.wait()  # guaranteed concurrent with the row scope below
+        with telemetry.metrics_scope() as prefetch_scope:
+            for _ in range(50):
+                telemetry.record("barrier_wait_s", 1.0)
+                telemetry.record_max("hbm_high_water_bytes", 999.0)
+        telemetry.record("barrier_wait_s", 7.0)  # scopeless recording
+        assert prefetch_scope.snapshot()["barrier_wait_s"] == 50.0
+        row_done.wait(timeout=30)
+
+    t = threading.Thread(target=_prefetch_thread)
+    t.start()
+    with telemetry.metrics_scope() as row1:
+        start.wait()
+        telemetry.record("barrier_wait_s", 0.25)
+    row_done.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    # the row's scope saw ONLY the row thread's recording — none of the
+    # background thread's 57.0 worth of counts, no gauge bleed
+    assert row1.snapshot() == {"barrier_wait_s": 0.25}
+    # and the NEXT row on this thread starts from zero
+    with telemetry.metrics_scope() as row2:
+        pass
+    assert row2.snapshot() == {}
+    assert row2.row_fields()["barrier_wait_s"] == 0.0
+
+
 # ---------------------------------------------------------------------------
 # runner rows carry the metric columns (acceptance criterion)
 # ---------------------------------------------------------------------------

@@ -69,6 +69,48 @@ def test_phase_breakdown_and_top_spans(tmp_path):
     assert len(doc["traceEvents"]) == 4
 
 
+def test_per_row_breakdown_groups_by_row_span_not_pid(tmp_path):
+    """The warm-pool satellite (ISSUE 6): ONE process shard carries TWO
+    rows (a reused pool worker), so per-row phase aggregation must group
+    by worker.row span containment, never by pid — and a background
+    prefetch compile on another thread of the same pid must not be
+    attributed to the row it merely overlaps in time."""
+    def _row_ev(name, cat, ts, dur, tid=1, **args):
+        e = _ev(name, cat, ts, dur)
+        e["tid"] = tid
+        e["args"].update(args)
+        return e
+
+    d = str(tmp_path / "t")
+    _shard(d, [
+        # row 1: [0, 1000] with timing 700 + validate 200
+        _row_ev("worker.row", "row", 0.0, 1000.0, impl="jax_spmd_0"),
+        _row_ev("worker.timing", "timing", 50.0, 700.0),
+        _row_ev("worker.validate", "validate", 760.0, 200.0),
+        # row 2, SAME pid (pool reuse): [2000, 2600] with timing 500
+        _row_ev("worker.row", "row", 2000.0, 600.0, impl="overlap_1"),
+        _row_ev("worker.timing", "timing", 2050.0, 500.0),
+        # prefetch on another thread, overlapping row 2 in time:
+        # must not land in either row's phases
+        _row_ev("compile_ahead.prefetch", "compile", 2000.0, 500.0, tid=2),
+    ])
+    report = tr.build_report(d)
+    rows = report["rows"]
+    assert [r["impl"] for r in rows] == ["jax_spmd_0", "overlap_1"]
+    assert rows[0]["phases"]["timing"] == pytest.approx(0.7)
+    assert rows[0]["phases"]["validate"] == pytest.approx(0.2)
+    assert rows[1]["phases"] == {"timing": pytest.approx(0.5)}
+    assert "compile" not in rows[1]["phases"]
+    # the text report prints the per-row section
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        tr.print_report(report)
+    assert "per-row phase breakdown (2 row(s)" in buf.getvalue()
+
+
 def test_prefetch_overlap_ratio(tmp_path):
     d = str(tmp_path / "t")
     # prefetch [0, 1000] vs timing [500, 1500]: 500 µs hidden of 1000
