@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test native bench lint clean
+.PHONY: test native bench lint analyze analyze-fast clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -16,14 +16,22 @@ native:
 bench:
 	$(PYTHON) bench.py
 
-# pyflakes when installed (dev extra); otherwise the in-repo
-# undefined-name checker — an undefined name fails the build either way
-# (never a bare syntax check).
-lint:
+# Static analysis: the ddlb_tpu/analysis rule engine (rule catalog in
+# docs/source/static_analysis.rst). Exit 1 on any non-baselined error;
+# pyflakes additionally runs when installed (dev extra) — an undefined
+# name fails the build either way (never a bare syntax check).
+analyze:
 	@if $(PYTHON) -c "import pyflakes" 2>/dev/null; then \
 		$(PYTHON) -m pyflakes ddlb_tpu tests scripts bench.py __graft_entry__.py; \
 	fi
-	@$(PYTHON) scripts/lint.py ddlb_tpu tests scripts bench.py __graft_entry__.py
+	@$(PYTHON) scripts/analyze.py
+
+# fast pre-commit surface: only files changed vs the merge-base
+analyze-fast:
+	@$(PYTHON) scripts/analyze.py --changed-only
+
+# `make lint` is the historical name — it delegates to the analyzer
+lint: analyze
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

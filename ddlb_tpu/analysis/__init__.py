@@ -1,0 +1,56 @@
+"""Static-analysis rule engine for the framework's hard-won invariants.
+
+Six PRs of hardening produced a set of correctness rules that used to
+live only in reviewers' heads and in an ad-hoc ``scripts/lint.py``:
+monotonic clocks in deadline code, the lock-free heartbeat construction,
+every ``DDLB_TPU_*`` env read routed through ``envs.py``, fault-injection
+sites that actually exist (so seeded chaos plans never silently no-op),
+telemetry span/metric names the report joins can rely on, and the
+in-flight ``jax.shard_map`` -> ``runtime.shard_map_compat`` migration.
+This package machine-checks all of them:
+
+- ``core``: the engine — each file is parsed ONCE into a shared
+  AST/token context (``FileContext``), then every registered rule runs
+  over it; findings carry ``file:line:col``, severity, and a stable
+  snippet key. Inline suppression via ``# ddlb: ignore[rule-id]``
+  (unused suppressions are themselves findings, DDLB100).
+- ``rules_style``: the checks ported from the old ``scripts/lint.py``
+  (undefined names, dangerous calls, bare print, docstrings,
+  ``Process()`` construction) under stable DDLB0xx ids.
+- ``rules_domain``: the DDLB1xx invariant rules (legacy shard_map,
+  wall-clock deadlines, raw env reads, fault-site registry, locked sync
+  primitives, telemetry-name registry, silent swallows).
+- ``rules_project``: repo-level rules needing cross-file state
+  (cost-model coverage, row-schema coverage).
+- ``baseline``: the committed grandfather file
+  (``analysis_baseline.json``) — known findings are masked, STALE
+  entries are errors, so the baseline can only ever shrink.
+- ``output``: text / JSON / SARIF 2.1.0 rendering plus the DDLB101
+  per-family migration inventory.
+
+``scripts/analyze.py`` is the CLI (``make analyze`` / ``make lint``);
+``docs/source/static_analysis.rst`` is the rule catalog.
+
+Zero third-party dependencies (stdlib + the package's own JAX-free
+modules), so the lint tier never needs an accelerator backend.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    all_rules,
+    analyze,
+    build_context,
+)
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "Rule",
+    "all_rules",
+    "analyze",
+    "build_context",
+]

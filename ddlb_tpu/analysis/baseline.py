@@ -1,0 +1,152 @@
+"""Committed baseline of grandfathered findings — shrink-only by design.
+
+``analysis_baseline.json`` at the repo root records the error findings
+that predate a rule (today: the ~37 legacy ``jax.shard_map`` sites the
+DDLB101 migration inventory tracks). The contract:
+
+- **Masking.** A current finding whose ``(rule, path, stripped source
+  line)`` key appears in the baseline (with remaining count) is marked
+  ``baselined`` — visible in every output mode, excluded from the exit
+  code. Keying on the stripped source line instead of the line NUMBER
+  means unrelated edits above a grandfathered site don't un-mask it.
+- **Stale entries are errors.** A baseline entry that matches no
+  current finding (the site was fixed, moved, or rewritten) is itself
+  reported (``DDLB110 stale-baseline``) — the fix and the baseline
+  shrink land in the same commit, so the file can only ever shrink.
+- **Growth is refused.** ``scripts/analyze.py --update-baseline``
+  rewrites the file from the current findings but refuses any key whose
+  count would GROW unless ``--allow-baseline-growth`` is passed — new
+  violations get fixed or suppressed with a reviewed inline comment,
+  never silently grandfathered.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import List, Optional, Sequence, Set, Tuple
+
+from ddlb_tpu.analysis.core import Finding
+
+BASELINE_NAME = "analysis_baseline.json"
+STALE_BASELINE_ID = "DDLB110"
+STALE_BASELINE_NAME = "stale-baseline"
+
+Key = Tuple[str, str, str]  # (rule, path, snippet)
+
+
+def load(path: Path) -> Counter:
+    """The baseline as a Counter of finding keys; empty when the file
+    does not exist (a new checkout starts strict). A malformed file
+    raises — a silently ignored baseline would un-mask everything."""
+    if not path.exists():
+        return Counter()
+    doc = json.loads(path.read_text(encoding="utf-8"))
+    counts: Counter = Counter()
+    for entry in doc.get("findings", []):
+        key = (
+            str(entry["rule"]),
+            str(entry["path"]),
+            str(entry.get("snippet", "")),
+        )
+        counts[key] += int(entry.get("count", 1))
+    return counts
+
+
+def apply(
+    findings: Sequence[Finding],
+    baseline: Counter,
+    path: Path,
+    analyzed: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Mark baselined error findings in place; return stale-baseline
+    findings for entries nothing matched (shrink enforcement).
+
+    ``analyzed`` restricts staleness to baseline entries whose file was
+    actually in this sweep — a ``--changed-only`` run must not report
+    the untouched backlog as stale (only the full sweep, where
+    ``analyzed=None``, can prove an entry dead — including entries for
+    deleted files)."""
+    remaining = Counter(baseline)
+    for f in findings:
+        if f.severity != "error" or f.suppressed:
+            continue
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            f.baselined = True
+    stale: List[Finding] = []
+    for (rule, rel, snippet), count in sorted(remaining.items()):
+        if count > 0 and (analyzed is None or rel in analyzed):
+            stale.append(
+                Finding(
+                    STALE_BASELINE_ID,
+                    path.name,
+                    1,
+                    1,
+                    f"stale baseline entry: {rule} at {rel} "
+                    f"({snippet!r} x{count}) matches no current finding "
+                    f"— the site was fixed; shrink the baseline "
+                    f"(scripts/analyze.py --update-baseline)",
+                )
+            )
+    return stale
+
+
+#: meta-findings about the analysis itself — never baselineable. A
+#: stale entry appended by ``apply`` must not re-enter the file the
+#: update is about to shrink, and a dead suppression is fixed by
+#: deleting the comment, not by grandfathering it.
+_META_RULES = (STALE_BASELINE_ID, "DDLB100")
+
+
+def _aggregate(findings: Sequence[Finding]) -> Counter:
+    counts: Counter = Counter()
+    for f in findings:
+        if (
+            f.severity == "error"
+            and not f.suppressed
+            and f.rule not in _META_RULES
+        ):
+            counts[f.key()] += 1
+    return counts
+
+
+def update(
+    findings: Sequence[Finding], path: Path, allow_growth: bool = False
+) -> List[str]:
+    """Rewrite the baseline from the current unsuppressed error
+    findings. Returns the list of GROWN keys when growth was refused
+    (and writes nothing); an empty list means the file was written."""
+    new = _aggregate(findings)
+    old = load(path)
+    grown = sorted(
+        f"{rule} {rel} ({snippet!r}): {old.get((rule, rel, snippet), 0)} "
+        f"-> {count}"
+        for (rule, rel, snippet), count in new.items()
+        if count > old.get((rule, rel, snippet), 0)
+    )
+    if grown and not allow_growth and old:
+        return grown
+    entries = [
+        {"rule": rule, "path": rel, "snippet": snippet, "count": count}
+        for (rule, rel, snippet), count in sorted(new.items())
+    ]
+    doc = {
+        "version": 1,
+        "comment": (
+            "Grandfathered static-analysis findings (ddlb_tpu/analysis). "
+            "Shrink-only: stale entries are errors (DDLB110), growth "
+            "needs --allow-baseline-growth. Regenerate with "
+            "scripts/analyze.py --update-baseline."
+        ),
+        "findings": entries,
+    }
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(
+        json.dumps(doc, indent=1, sort_keys=False) + "\n", encoding="utf-8"
+    )
+    tmp.replace(path)
+    return []
+
+

@@ -1,0 +1,373 @@
+"""Analysis engine: one parse per file, pluggable rules, suppressions.
+
+The engine's contract:
+
+- **Parse once.** ``build_context`` turns a file into a ``FileContext``
+  holding the source, its lines, the AST, a by-type node index, and the
+  inline-suppression map. Every rule reads the same context — adding a
+  rule never adds a parse.
+- **Rules are small objects.** A rule declares an ``id`` (``DDLB101``),
+  a kebab ``name`` (SARIF), a severity, a one-line rationale, a
+  ``scope(ctx)`` predicate, and ``check(ctx)`` yielding findings.
+  Project rules implement ``check_project(contexts)`` instead and run
+  once per invocation (cross-file invariants).
+- **Suppression.** ``# ddlb: ignore[DDLB101]`` (comma lists allowed) on
+  the finding's line suppresses it; a suppression that suppressed
+  nothing is itself a finding (``DDLB100``) so dead ignores can't
+  accumulate.
+- **Severity.** ``error`` findings fail the build unless suppressed or
+  baselined (``ddlb_tpu.analysis.baseline``); ``warn`` findings are
+  advisory.
+
+Scope conventions mirror the old lint: *package* rules apply to files
+whose path contains a ``ddlb_tpu`` component (so fixture trees under a
+tmp dir behave like the real package); universal rules apply everywhere
+``scripts/analyze.py`` is pointed.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+#: suppression-comment pattern; the marker is the word "ddlb", a colon,
+#: "ignore", then one or more bracketed comma-separated rule ids
+_SUPPRESS_RE = re.compile(r"#\s*ddlb:\s*ignore\[([A-Za-z0-9_,\s]+)\]")
+
+SEVERITIES = ("error", "warn")
+
+
+class Finding:
+    """One rule violation at a location.
+
+    ``snippet`` is the stripped source line — the line-drift-stable key
+    the baseline matches on (a finding survives unrelated edits above
+    it). ``suppressed``/``baselined`` are set by the engine/baseline
+    layers; both keep the finding visible to ``--json``/SARIF consumers
+    while excluding it from the exit code.
+    """
+
+    def __init__(
+        self,
+        rule: str,
+        path: str,
+        line: int,
+        col: int,
+        message: str,
+        severity: str = "error",
+        snippet: str = "",
+    ) -> None:
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.severity = severity
+        self.snippet = snippet
+        self.suppressed = False
+        self.baselined = False
+
+    @property
+    def counts(self) -> bool:
+        """True when this finding should fail the build."""
+        return (
+            self.severity == "error"
+            and not self.suppressed
+            and not self.baselined
+        )
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: rule + path + stripped source line."""
+        return (self.rule, self.path, self.snippet)
+
+    def legacy_str(self) -> str:
+        """The old ``scripts/lint.py`` one-line format (shim compat)."""
+        return f"{self.path}:{self.line}: {self.message}"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"Finding({self.rule} {self.path}:{self.line}:{self.col} "
+            f"{self.severity} {self.message!r})"
+        )
+
+
+class FileContext:
+    """Everything the rules need about one file, computed exactly once."""
+
+    def __init__(self, path: Path, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel  # repo-relative posix path (or the input as given)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=str(path))
+        except SyntaxError as exc:
+            self.syntax_error = exc
+        #: line -> rule ids a ``# ddlb: ignore[...]`` comment names there
+        self.suppressions: Dict[int, Set[str]] = {}
+        #: (line, rule) pairs that actually suppressed a finding
+        self.used_suppressions: Set[Tuple[int, str]] = set()
+        self._index: Optional[Dict[type, List[ast.AST]]] = None
+        self._collect_suppressions()
+
+    @property
+    def parts(self) -> Tuple[str, ...]:
+        return self.path.parts
+
+    def in_package(self) -> bool:
+        """Whether this file belongs to the ``ddlb_tpu`` package tree
+        (true for fixture trees containing a ``ddlb_tpu`` component)."""
+        return "ddlb_tpu" in self.parts
+
+    def nodes(self, *types: type) -> Iterator[ast.AST]:
+        """All AST nodes of the given types, from the shared one-walk
+        index (empty when the file failed to parse)."""
+        if self.tree is None:
+            return iter(())
+        if self._index is None:
+            index: Dict[type, List[ast.AST]] = {}
+            for node in ast.walk(self.tree):
+                index.setdefault(type(node), []).append(node)
+            self._index = index
+        out: List[ast.AST] = []
+        for t in types:
+            for bucket_type, bucket in self._index.items():
+                if issubclass(bucket_type, t):
+                    out.extend(bucket)
+        return iter(out)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _collect_suppressions(self) -> None:
+        """Comment tokens only (a suppression spelled inside a string
+        literal must not suppress anything); regex fallback if the
+        tokenizer chokes on a file that nevertheless parsed."""
+        comments: List[Tuple[int, str]] = []
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.string))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            comments = [
+                (i + 1, line)
+                for i, line in enumerate(self.lines)
+                if "#" in line
+            ]
+        for lineno, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if m:
+                ids = {
+                    part.strip()
+                    for part in m.group(1).split(",")
+                    if part.strip()
+                }
+                self.suppressions.setdefault(lineno, set()).update(ids)
+
+
+class Rule:
+    """Base class for per-file rules; subclasses override ``check``."""
+
+    id: str = "DDLB000"
+    name: str = "unnamed-rule"
+    severity: str = "error"
+    rationale: str = ""
+
+    def scope(self, ctx: FileContext) -> bool:
+        """Whether this rule runs on ``ctx`` (default: every file)."""
+        return True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: FileContext, line: int, col: int, message: str
+    ) -> Finding:
+        return Finding(
+            self.id,
+            ctx.rel,
+            line,
+            col,
+            message,
+            severity=self.severity,
+            snippet=ctx.line_text(line),
+        )
+
+
+class ProjectRule(Rule):
+    """A repo-level rule: runs once over every context (cross-file
+    state), not per file. ``check_project`` replaces ``check``."""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+UNUSED_SUPPRESSION_ID = "DDLB100"
+UNUSED_SUPPRESSION_NAME = "unused-suppression"
+
+
+def all_rules() -> List[Rule]:
+    """Every registered rule instance, stable-ordered by id. Imported
+    lazily so ``core`` has no import cycle with the rule modules."""
+    from ddlb_tpu.analysis import rules_domain, rules_project, rules_style
+
+    rules: List[Rule] = []
+    for module in (rules_style, rules_domain, rules_project):
+        rules.extend(module.RULES)
+    return sorted(rules, key=lambda r: r.id)
+
+
+def repo_root() -> Path:
+    """The repository root (the directory holding ``ddlb_tpu/``)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def relativize(path: Path, root: Optional[Path] = None) -> str:
+    """The repo-relative posix path when the file lives under ``root``,
+    else the path as given (fixture trees keep their tmp prefix)."""
+    path = Path(path)
+    root = root or repo_root()
+    try:
+        return path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def build_context(path: Path, root: Optional[Path] = None) -> FileContext:
+    """Parse ``path`` once into a ``FileContext``."""
+    path = Path(path)
+    source = path.read_text(encoding="utf-8")
+    return FileContext(path, relativize(path, root), source)
+
+
+def _apply_suppressions(ctx: FileContext, findings: List[Finding]) -> None:
+    for f in findings:
+        ids = ctx.suppressions.get(f.line, ())
+        if f.rule in ids:
+            f.suppressed = True
+            ctx.used_suppressions.add((f.line, f.rule))
+
+
+def _unused_suppression_findings(ctx: FileContext) -> List[Finding]:
+    out = []
+    for lineno, ids in sorted(ctx.suppressions.items()):
+        for rule_id in sorted(ids):
+            if (lineno, rule_id) not in ctx.used_suppressions:
+                out.append(
+                    Finding(
+                        UNUSED_SUPPRESSION_ID,
+                        ctx.rel,
+                        lineno,
+                        1,
+                        f"unused suppression: no {rule_id} finding on "
+                        f"this line — remove the '# ddlb: ignore' comment",
+                        severity="error",
+                        snippet=ctx.line_text(lineno),
+                    )
+                )
+    return out
+
+
+def analyze(
+    paths: Sequence[Path],
+    rules: Optional[Sequence[Rule]] = None,
+    root: Optional[Path] = None,
+    project_rules: bool = True,
+) -> List[Finding]:
+    """Run the rule battery over ``paths`` (files, pre-expanded).
+
+    Returns every finding — including suppressed ones — sorted by
+    location; callers filter on ``Finding.counts`` / render as needed.
+    ``project_rules=False`` skips the repo-level rules (the
+    ``--changed-only`` fast path still runs them by default because
+    they are cheap and their state is global).
+    """
+    rules = list(rules if rules is not None else all_rules())
+    per_file = [r for r in rules if not isinstance(r, ProjectRule)]
+    project = [r for r in rules if isinstance(r, ProjectRule)]
+    contexts: List[FileContext] = []
+    findings: List[Finding] = []
+    for path in paths:
+        ctx = build_context(Path(path), root=root)
+        contexts.append(ctx)
+        file_findings: List[Finding] = []
+        if ctx.syntax_error is not None:
+            exc = ctx.syntax_error
+            file_findings.append(
+                Finding(
+                    "DDLB001",
+                    ctx.rel,
+                    exc.lineno or 1,
+                    (exc.offset or 1),
+                    f"syntax error: {exc.msg}",
+                    severity="error",
+                    snippet=ctx.line_text(exc.lineno or 1),
+                )
+            )
+        else:
+            for rule in per_file:
+                if rule.scope(ctx):
+                    file_findings.extend(rule.check(ctx))
+        _apply_suppressions(ctx, file_findings)
+        findings.extend(file_findings)
+    if project_rules:
+        project_findings: List[Finding] = []
+        for rule in project:
+            project_findings.extend(rule.check_project(contexts))
+        by_rel = {ctx.rel: ctx for ctx in contexts}
+        root_dir = root or repo_root()
+        for f in project_findings:
+            ctx = by_rel.get(f.path)
+            if ctx is None:
+                # a project rule may anchor findings at files outside
+                # this sweep (e.g. a row-writer file on a changed-only
+                # run) — their inline suppressions still apply, but
+                # their unused suppressions are only the FULL sweep's
+                # business (the context is not appended to `contexts`)
+                candidate = root_dir / f.path
+                if candidate.is_file():
+                    ctx = by_rel[f.path] = build_context(
+                        candidate, root=root_dir
+                    )
+            if ctx is not None:
+                _apply_suppressions(ctx, [f])
+        findings.extend(project_findings)
+    for ctx in contexts:
+        findings.extend(_unused_suppression_findings(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def expand_targets(targets: Sequence[str]) -> List[Path]:
+    """Directories recurse to ``*.py`` (skipping ``__pycache__``); file
+    arguments must exist. Raises ``FileNotFoundError`` for a missing
+    target — analyzing nothing must never look like a clean pass."""
+    out: List[Path] = []
+    for arg in targets:
+        p = Path(arg)
+        if p.is_dir():
+            out.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py" and p.exists():
+            out.append(p)
+        else:
+            raise FileNotFoundError(arg)
+    return out

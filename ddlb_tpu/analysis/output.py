@@ -1,0 +1,201 @@
+"""Finding renderers: text, JSON, SARIF 2.1.0, and the DDLB101 inventory.
+
+Pure functions from findings to strings/documents — the CLI
+(``scripts/analyze.py``) owns stdout and exit codes. SARIF output
+targets the 2.1.0 schema (one run, one driver, per-rule metadata from
+the registered rule objects; suppressed/baselined results carry SARIF
+``suppressions`` entries so code-scanning UIs show them greyed instead
+of dropped).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Any, Dict, List, Sequence
+
+from ddlb_tpu.analysis.core import Finding, Rule, all_rules
+from ddlb_tpu.analysis.rules_domain import family_of
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def text_line(f: Finding) -> str:
+    mark = ""
+    if f.suppressed:
+        mark = " (suppressed)"
+    elif f.baselined:
+        mark = " (baselined)"
+    return (
+        f"{f.path}:{f.line}:{f.col}: {f.severity}[{f.rule}] "
+        f"{f.message}{mark}"
+    )
+
+
+def render_text(
+    findings: Sequence[Finding], show_masked: bool = False
+) -> List[str]:
+    """One line per ACTIONABLE finding (masked ones only on request)."""
+    return [
+        text_line(f)
+        for f in findings
+        if show_masked or not (f.suppressed or f.baselined)
+    ]
+
+
+def render_json(findings: Sequence[Finding]) -> Dict[str, Any]:
+    return {
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "severity": f.severity,
+                "message": f.message,
+                "snippet": f.snippet,
+                "suppressed": f.suppressed,
+                "baselined": f.baselined,
+            }
+            for f in findings
+        ],
+        "counts": {
+            "total": len(findings),
+            "errors": sum(1 for f in findings if f.counts),
+            "suppressed": sum(1 for f in findings if f.suppressed),
+            "baselined": sum(1 for f in findings if f.baselined),
+        },
+    }
+
+
+def _rule_metadata() -> List[Dict[str, Any]]:
+    rules_meta = []
+    for rule in all_rules():
+        rules_meta.append(
+            {
+                "id": rule.id,
+                "name": rule.name,
+                "shortDescription": {"text": rule.rationale or rule.name},
+                "defaultConfiguration": {
+                    "level": "error" if rule.severity == "error" else "warning"
+                },
+            }
+        )
+    return rules_meta
+
+
+def render_sarif(findings: Sequence[Finding]) -> Dict[str, Any]:
+    """A single-run SARIF 2.1.0 document."""
+    results = []
+    for f in findings:
+        result: Dict[str, Any] = {
+            "ruleId": f.rule,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {
+                            "uri": f.path,
+                            "uriBaseId": "SRCROOT",
+                        },
+                        "region": {
+                            "startLine": max(1, f.line),
+                            "startColumn": max(1, f.col),
+                        },
+                    }
+                }
+            ],
+        }
+        suppressions = []
+        if f.suppressed:
+            suppressions.append(
+                {"kind": "inSource", "justification": "ddlb: ignore comment"}
+            )
+        if f.baselined:
+            suppressions.append(
+                {
+                    "kind": "external",
+                    "justification": "analysis_baseline.json",
+                }
+            )
+        if suppressions:
+            result["suppressions"] = suppressions
+        results.append(result)
+    known_ids = {r.id for r in all_rules()}
+    extra_ids = sorted(
+        {f.rule for f in findings if f.rule not in known_ids}
+    )
+    rules_meta = _rule_metadata() + [
+        {
+            "id": rule_id,
+            "name": {
+                "DDLB001": "syntax-error",
+                "DDLB100": "unused-suppression",
+                "DDLB110": "stale-baseline",
+            }.get(rule_id, rule_id.lower()),
+            "shortDescription": {"text": rule_id},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rule_id in extra_ids
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "ddlb-analyze",
+                        "informationUri": (
+                            "docs/source/static_analysis.rst"
+                        ),
+                        "version": "1.0.0",
+                        "rules": rules_meta,
+                    }
+                },
+                # SRCROOT deliberately unresolved (SARIF §3.14.14): the
+                # consumer roots the repo-relative URIs at its checkout
+                "results": results,
+            }
+        ],
+    }
+
+
+def shard_map_inventory(findings: Sequence[Finding]) -> List[str]:
+    """The DDLB101 per-family migration inventory the ROADMAP item
+    needs: counts INCLUDE baselined findings (they are the backlog),
+    sorted largest-first."""
+    counts: Counter = Counter()
+    for f in findings:
+        if f.rule == "DDLB101" and not f.suppressed:
+            counts[family_of(f.path)] += 1
+    if not counts:
+        return []
+    total = sum(counts.values())
+    lines = [
+        f"shard_map migration inventory: {total} legacy site(s) "
+        f"remaining (DDLB101, incl. baselined):"
+    ]
+    for family, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
+        lines.append(f"  {family:32s} {n}")
+    return lines
+
+
+def dump_json(doc: Dict[str, Any]) -> str:
+    return json.dumps(doc, indent=2, sort_keys=False) + "\n"
+
+
+# re-exported for the CLI's --list-rules mode
+__all__ = [
+    "Rule",
+    "dump_json",
+    "render_json",
+    "render_sarif",
+    "render_text",
+    "shard_map_inventory",
+    "text_line",
+]

@@ -1,0 +1,509 @@
+"""The DDLB1xx invariant rules: six PRs of hardening, machine-checked.
+
+Each rule encodes one invariant the repo learned the hard way, with the
+PR that motivated it:
+
+- **DDLB101 legacy-shard-map**: ``jax.shard_map(`` (or the experimental
+  import) outside ``runtime.py`` — the fleet's jax 0.4.x lacks
+  ``jax.shard_map``, so every legacy site is a family that silently
+  fails there. Findings feed the per-family migration inventory the
+  ROADMAP item tracks (PRs 3-6 established ``runtime.shard_map_compat``).
+- **DDLB102 wall-clock-deadline**: ``time.time()`` in deadline/timeout
+  code (pool, heartbeat, benchmark await loops) — PR 5's NTP-step
+  hardening made these paths monotonic end to end; one wall-clock
+  deadline reintroduces the multi-hour-capture kill bug.
+- **DDLB103 raw-env-read**: ``os.environ``/``os.getenv`` reads of
+  ``DDLB_TPU_*`` outside ``envs.py`` — the env surface is the sweep
+  resume/signature contract; stray reads dodge the accessor docs, the
+  pool's signature keys, and test monkeypatching.
+- **DDLB104 unknown-fault-site**: ``faults.inject("site")`` literals and
+  fault-plan ``site`` globs cross-checked against
+  ``faults.plan.SITES`` — a typo'd site means a seeded chaos plan
+  silently injects nothing (PR 4's whole point inverted).
+- **DDLB105 locked-sync-primitive**: ``multiprocessing`` ``Value``/
+  ``Array`` without ``lock=False`` — a child SIGKILLed mid-beat orphans
+  the lock and deadlocks the parent's next read (the PR 5 heartbeat
+  lesson; ``heartbeat.new_channel`` is the one blessed constructor).
+- **DDLB106 unregistered-telemetry-name**: span/instant/metric name
+  literals must appear in ``telemetry.names`` — ``trace_report`` /
+  ``observatory.fold()`` join by name, and a rename used to just make
+  reports silently emptier (PRs 2/6).
+- **DDLB107 silent-swallow**: broad ``except`` whose body swallows
+  without telemetry — the failure class the fault harness exists to
+  provoke (ported from the PR 4 lint satellite).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Iterable, List, Optional
+
+from ddlb_tpu.analysis.core import FileContext, Finding, Rule
+from ddlb_tpu.faults.plan import SITES as FAULT_SITES
+from ddlb_tpu.telemetry.names import all_names as telemetry_names
+
+
+def _rel_endswith(ctx: FileContext, suffixes: tuple) -> bool:
+    rel = ctx.rel.replace("\\", "/")
+    return any(rel == s or rel.endswith("/" + s) for s in suffixes)
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class LegacyShardMapRule(Rule):
+    """``jax.shard_map`` call sites pending the compat migration."""
+
+    id = "DDLB101"
+    name = "legacy-shard-map"
+    rationale = (
+        "jax 0.4.x has no jax.shard_map; runtime.shard_map_compat is "
+        "the one version bridge, and each legacy site is a family dead "
+        "on the old-jax fleet (ROADMAP: finish the migration)"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and ctx.path.name != "runtime.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "shard_map"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "jax"
+            ):
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"legacy jax.shard_map call in "
+                        f"{family_of(ctx.rel)} — migrate to "
+                        f"runtime.shard_map_compat (jax 0.4.x "
+                        f"compatibility)",
+                    )
+                )
+        for node in ctx.nodes(ast.ImportFrom):
+            if node.module == "jax.experimental.shard_map":
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        "direct jax.experimental.shard_map import — only "
+                        "runtime.shard_map_compat may touch the legacy "
+                        "entry point",
+                    )
+                )
+        return out
+
+
+def family_of(rel: str) -> str:
+    """The migration-inventory bucket for a path: the primitive family
+    dir, the model module, or the module stem."""
+    parts = rel.replace("\\", "/").split("/")
+    if "primitives" in parts:
+        i = parts.index("primitives")
+        if i + 1 < len(parts) - 1:
+            return parts[i + 1]
+    if "models" in parts:
+        return "models/" + parts[-1].removesuffix(".py")
+    return parts[-1].removesuffix(".py")
+
+
+#: the deadline/timeout code paths PR 5 made monotonic end to end
+_DEADLINE_FILES = (
+    "ddlb_tpu/pool.py",
+    "ddlb_tpu/faults/heartbeat.py",
+    "ddlb_tpu/benchmark.py",
+    "ddlb_tpu/utils/timing.py",
+)
+
+
+class WallClockDeadlineRule(Rule):
+    """``time.time()`` in deadline code: NTP steps break the kill math."""
+
+    id = "DDLB102"
+    name = "wall-clock-deadline"
+    rationale = (
+        "heartbeat ages and worker deadlines compare instants hours "
+        "apart; an NTP step under a wall clock kills a healthy worker "
+        "or spares a hung one (PR 5 hardening) — observatory "
+        "timestamping stays wall-clock by design and is out of scope"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and _rel_endswith(ctx, _DEADLINE_FILES)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "time"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+            ):
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        "wall clock time.time() in a deadline/timeout "
+                        "path — use time.monotonic() (NTP-step immune; "
+                        "PR 5 heartbeat hardening)",
+                    )
+                )
+        return out
+
+
+#: files allowed to read DDLB_TPU_* raw: the accessor layer itself, and
+#: the launcher (which assembles whole child environments)
+_ENV_EXEMPT = ("ddlb_tpu/envs.py", "ddlb_tpu/cli/launch.py")
+
+
+class RawEnvReadRule(Rule):
+    """Raw ``DDLB_TPU_*`` env reads outside the ``envs.py`` accessors."""
+
+    id = "DDLB103"
+    name = "raw-env-read"
+    rationale = (
+        "envs.py is the documented, monkeypatchable accessor surface "
+        "and the pool's signature-key contract; a stray raw read is a "
+        "knob that resume keys and tests cannot see"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and not _rel_endswith(ctx, _ENV_EXEMPT)
+
+    def _is_environ(self, node: ast.AST) -> bool:
+        """``os.environ`` (attribute) or a bare ``environ`` import."""
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "os"
+        ) or (isinstance(node, ast.Name) and node.id == "environ")
+
+    def _module_str_constants(self, ctx: FileContext) -> dict:
+        """Module-level ``NAME = "DDLB_TPU_X"`` bindings, so the
+        ``CHIP_ENV = "DDLB_TPU_CHIP"`` indirection class is caught
+        too (one assignment only; rebound names are skipped)."""
+        consts: dict = {}
+        rebound: set = set()
+        tree = ctx.tree
+        assert tree is not None
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Constant
+            ) and isinstance(node.value.value, str):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        if target.id in consts:
+                            rebound.add(target.id)
+                        consts[target.id] = node.value.value
+        return {
+            k: v
+            for k, v in consts.items()
+            if k not in rebound and v.startswith("DDLB_TPU_")
+        }
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        consts = self._module_str_constants(ctx)
+
+        def env_name(node: Optional[ast.AST]) -> Optional[str]:
+            value = _const_str(node) if node is not None else None
+            if value is None and isinstance(node, ast.Name):
+                value = consts.get(node.id)
+            if value is not None and value.startswith("DDLB_TPU_"):
+                return value
+            return None
+
+        def hit(node: ast.AST, var: str) -> None:
+            out.append(
+                self.finding(
+                    ctx, node.lineno, node.col_offset + 1,
+                    f"raw read of {var} — add/use an accessor in "
+                    f"ddlb_tpu/envs.py (the documented, monkeypatchable "
+                    f"env surface)",
+                )
+            )
+
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            name = env_name(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            # os.environ.get(...) / os.getenv(...)
+            if isinstance(fn, ast.Attribute) and (
+                (fn.attr == "get" and self._is_environ(fn.value))
+                or (
+                    fn.attr == "getenv"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "os"
+                )
+            ):
+                hit(node, name)
+        for node in ctx.nodes(ast.Subscript):
+            if not isinstance(node.ctx, ast.Load):
+                continue  # writes/deletes configure the env; reads leak
+            name = env_name(node.slice)
+            if name is not None and self._is_environ(node.value):
+                hit(node, name)
+        for node in ctx.nodes(ast.Compare):
+            if len(node.ops) == 1 and isinstance(
+                node.ops[0], (ast.In, ast.NotIn)
+            ):
+                name = env_name(node.left)
+                if name is not None and self._is_environ(
+                    node.comparators[0]
+                ):
+                    hit(node, name)
+        return out
+
+
+class UnknownFaultSiteRule(Rule):
+    """Injection-site literals and plan globs must hit the registry."""
+
+    id = "DDLB104"
+    name = "unknown-fault-site"
+    rationale = (
+        "a typo'd site (or a plan glob matching zero sites) makes a "
+        "seeded chaos plan silently inject NOTHING — the battery passes "
+        "without testing anything (PR 4's contract inverted)"
+    )
+
+    #: call attrs whose first string arg is a site name
+    _SITE_CALLS = ("inject", "corrupt", "corrupt_row")
+
+    def scope(self, ctx: FileContext) -> bool:
+        # the faults package defines the sites; tests exercise fake ones
+        return (
+            ctx.in_package() or "scripts" in ctx.parts
+        ) and "faults" not in ctx.parts and "tests" not in ctx.parts
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in self._SITE_CALLS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id in ("faults", "plan")
+            ):
+                continue
+            site = _const_str(node.args[0]) if node.args else None
+            if site is not None and site not in FAULT_SITES:
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"fault site '{site}' is not registered in "
+                        f"ddlb_tpu/faults/plan.py SITES — a plan "
+                        f"targeting it would nominally exist but the "
+                        f"analyzer cannot prove it; register the site",
+                    )
+                )
+        # fault-plan dict literals: {"site": <glob>, "kind": ...}
+        for node in ctx.nodes(ast.Dict):
+            keys = {
+                _const_str(k): v
+                for k, v in zip(node.keys, node.values)
+                if k is not None
+            }
+            if "site" not in keys or "kind" not in keys:
+                continue
+            glob = _const_str(keys["site"])
+            if glob is None:
+                continue
+            if not fnmatch.filter(FAULT_SITES, glob):
+                out.append(
+                    self.finding(
+                        ctx, keys["site"].lineno,
+                        keys["site"].col_offset + 1,
+                        f"fault-plan site glob '{glob}' matches zero "
+                        f"registered injection sites — the rule would "
+                        f"never fire (see faults/plan.py SITES)",
+                    )
+                )
+        return out
+
+
+class LockedSyncPrimitiveRule(Rule):
+    """``mp.Value``/``Array`` without ``lock=False``: SIGKILL-orphanable."""
+
+    id = "DDLB105"
+    name = "locked-sync-primitive"
+    rationale = (
+        "a child SIGKILLed mid-write orphans the primitive's lock and "
+        "the parent's next read deadlocks forever — the exact unbounded "
+        "hang the heartbeat channel exists to eliminate; "
+        "heartbeat.new_channel is the blessed lock-free constructor"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            named = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id
+                if isinstance(fn, ast.Name)
+                else None
+            )
+            if named not in ("Value", "Array"):
+                continue
+            # the mp signature starts with a 1-2 char typecode string
+            typecode = _const_str(node.args[0]) if node.args else None
+            if typecode is None or len(typecode) > 2:
+                continue
+            lock_false = any(
+                kw.arg == "lock"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False
+                for kw in node.keywords
+            )
+            if not lock_false:
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"multiprocessing {named}() without lock=False — "
+                        f"a SIGKILLed child can orphan the lock and "
+                        f"deadlock the parent; use "
+                        f"faults.heartbeat.new_channel or pass "
+                        f"lock=False explicitly",
+                    )
+                )
+        return out
+
+
+class UnregisteredTelemetryNameRule(Rule):
+    """Span/metric name literals must be in ``telemetry.names``."""
+
+    id = "DDLB106"
+    name = "unregistered-telemetry-name"
+    rationale = (
+        "trace_report and observatory.fold() join spans/metrics by "
+        "name; an unregistered (or renamed) name makes those joins "
+        "silently miss instead of failing loudly"
+    )
+
+    _NAME_CALLS = (
+        "span", "instant", "record", "record_max", "completed_event",
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        # the telemetry package itself (registry + logger mirror) is the
+        # implementation layer the registry describes
+        return (
+            ctx.in_package() or "scripts" in ctx.parts
+        ) and "telemetry" not in ctx.parts and "tests" not in ctx.parts
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        registry = telemetry_names()
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in self._NAME_CALLS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "telemetry"
+            ):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            # a conditional of two literals checks both arms
+            candidates = (
+                [arg.body, arg.orelse]
+                if isinstance(arg, ast.IfExp)
+                else [arg]
+            )
+            for cand in candidates:
+                name = _const_str(cand)
+                if name is not None and name not in registry:
+                    out.append(
+                        self.finding(
+                            ctx, node.lineno, node.col_offset + 1,
+                            f"telemetry name '{name}' is not registered "
+                            f"in ddlb_tpu/telemetry/names.py — report "
+                            f"joins would silently miss it",
+                        )
+                    )
+        return out
+
+
+class SilentSwallowRule(Rule):
+    """Broad ``except`` whose body swallows without telemetry."""
+
+    id = "DDLB107"
+    name = "silent-swallow"
+    rationale = (
+        "an 'except Exception: pass' turns a real failure into an "
+        "invisible one — exactly the class the fault-injection harness "
+        "exists to provoke; narrow exception types remain legitimate "
+        "control flow"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        def _names(node):
+            if node is None:
+                return ["<bare>"]
+            elts = node.elts if isinstance(node, ast.Tuple) else [node]
+            out = []
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    out.append(e.id)
+                elif isinstance(e, ast.Attribute):
+                    out.append(e.attr)
+                else:
+                    out.append("?")
+            return out
+
+        problems: List[Finding] = []
+        for node in ctx.nodes(ast.ExceptHandler):
+            silent = all(
+                isinstance(stmt, ast.Pass)
+                or (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is Ellipsis
+                )
+                for stmt in node.body
+            )
+            names = _names(node.type)
+            broad = node.type is None or any(
+                n in ("Exception", "BaseException") for n in names
+            )
+            if silent and broad:
+                problems.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"swallow: silent 'except {', '.join(names)}: "
+                        f"pass' — re-raise, return an error row, or log "
+                        f"via ddlb_tpu.telemetry",
+                    )
+                )
+        return problems
+
+
+RULES = [
+    LegacyShardMapRule(),
+    WallClockDeadlineRule(),
+    RawEnvReadRule(),
+    UnknownFaultSiteRule(),
+    LockedSyncPrimitiveRule(),
+    UnregisteredTelemetryNameRule(),
+    SilentSwallowRule(),
+]

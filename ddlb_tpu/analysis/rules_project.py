@@ -1,0 +1,218 @@
+"""Repo-level rules: invariants that span files, run once per sweep.
+
+- **DDLB007 cost-model-coverage**: every registered primitive family
+  must resolve a cost model, so a newly added family can never ship
+  rows with a silent ``predicted_s=None`` (PR 3 satellite). Both
+  modules are JAX-free by design, so the import is safe from the lint
+  tier; an import failure is itself a finding.
+- **DDLB108 row-schema-coverage**: every column a runner path writes
+  must appear in the ``ddlb_tpu/schema.py`` registry with a non-empty
+  docstring (PR 6 satellite) — the column set was previously re-stated
+  ad hoc in benchmark.py, pool.py, hw_common.py and tests, with nothing
+  keeping the statements in agreement.
+
+Project rules run whenever the analyzed file set touches the package
+(the Makefile targets always do); their findings anchor at the file
+that owns the invariant so suppressions/baselines behave normally.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence
+
+from ddlb_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectRule,
+    build_context,
+    repo_root,
+)
+
+
+def _covers_package(contexts: Sequence[FileContext]) -> bool:
+    return any(ctx.in_package() for ctx in contexts)
+
+
+class CostModelCoverageRule(ProjectRule):
+    """Every registered primitive family resolves a perfmodel."""
+
+    id = "DDLB007"
+    name = "cost-model-coverage"
+    rationale = (
+        "a family missing from FAMILY_COST_MODELS ships rows with "
+        "silent predicted_s defaults — the roofline gate then never "
+        "fires for it"
+    )
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        if not _covers_package(contexts):
+            return []
+        anchor = "ddlb_tpu/perfmodel/cost.py"
+        try:
+            from ddlb_tpu.perfmodel.cost import FAMILY_COST_MODELS
+            from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+        except Exception as exc:
+            return [
+                Finding(
+                    self.id, anchor, 1, 1,
+                    f"perfmodel: cost-model coverage check failed to "
+                    f"import: {type(exc).__name__}: {exc}",
+                )
+            ]
+        return [
+            Finding(
+                self.id, anchor, 1, 1,
+                f"perfmodel: primitive family '{fam}' has no cost model "
+                f"in ddlb_tpu/perfmodel/cost.py FAMILY_COST_MODELS "
+                f"(rows would carry silent predicted_s defaults)",
+            )
+            for fam in ALLOWED_PRIMITIVES
+            if fam not in FAMILY_COST_MODELS
+        ]
+
+
+#: the runner-path files whose row-column writes the schema check scans:
+#: the one row constructor + every site that amends rows after the fact
+#: (repo-relative). A new runner path that writes columns must be added
+#: here — and its columns to ddlb_tpu/schema.py.
+ROW_WRITER_FILES = (
+    "ddlb_tpu/benchmark.py",
+    "ddlb_tpu/pool.py",
+    "ddlb_tpu/telemetry/metrics.py",
+    "ddlb_tpu/observatory/attribution.py",
+    "scripts/hw_common.py",
+)
+
+
+def written_row_columns(tree: ast.Module) -> Dict[str, int]:
+    """Every row-column name a file writes, statically, with the line of
+    the first write:
+
+    - keys of the dict literal ``make_result_row`` returns (the one
+      row constructor);
+    - keys of module-level ``*_ROW_DEFAULTS`` / ``ROW_METRIC_DEFAULTS``
+      dict literals (merged into every row);
+    - every ``row["<name>"] = ...`` subscript assignment (the
+      amend-after-build sites: pool reuse columns, hbm peak, bank key).
+    """
+    columns: Dict[str, int] = {}
+
+    def _dict_keys(node):
+        return {
+            key.value: key.lineno
+            for key in getattr(node, "keys", [])
+            if isinstance(key, ast.Constant) and isinstance(key.value, str)
+        }
+
+    def _add(mapping: Dict[str, int]) -> None:
+        for name, lineno in mapping.items():
+            columns.setdefault(name, lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "make_result_row":
+            for ret in ast.walk(node):
+                if isinstance(ret, ast.Return) and isinstance(
+                    ret.value, ast.Dict
+                ):
+                    _add(_dict_keys(ret.value))
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            # one node can be BOTH cases at once (`row["x"] = {...}`):
+            # check the defaults-dict names and the row subscripts
+            # independently, never as an either/or
+            if isinstance(node.value, ast.Dict):
+                names = [t.id for t in targets if isinstance(t, ast.Name)]
+                if any(
+                    n.endswith("_ROW_DEFAULTS") or n == "ROW_METRIC_DEFAULTS"
+                    for n in names
+                ):
+                    _add(_dict_keys(node.value))
+            for target in targets:
+                if (
+                    isinstance(target, ast.Subscript)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "row"
+                    and isinstance(target.slice, ast.Constant)
+                    and isinstance(target.slice.value, str)
+                ):
+                    columns.setdefault(target.slice.value, target.lineno)
+    return columns
+
+
+class RowSchemaCoverageRule(ProjectRule):
+    """Every written row column is registered and documented."""
+
+    id = "DDLB108"
+    name = "row-schema-coverage"
+    rationale = (
+        "an unregistered column is a CSV contract change nothing "
+        "reviews; the schema registry is what keeps benchmark.py, "
+        "pool.py, hw_common.py and the tests stating the same row shape"
+    )
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        if not _covers_package(contexts):
+            return []
+        try:
+            from ddlb_tpu.schema import ROW_COLUMNS
+        except Exception as exc:
+            return [
+                Finding(
+                    self.id, "ddlb_tpu/schema.py", 1, 1,
+                    f"schema: row-column registry failed to import: "
+                    f"{type(exc).__name__}: {exc}",
+                )
+            ]
+        root = repo_root()
+        by_rel = {ctx.rel: ctx for ctx in contexts}
+        problems: List[Finding] = []
+        for rel in ROW_WRITER_FILES:
+            ctx = by_rel.get(rel)
+            if ctx is None:
+                path = root / rel
+                if not path.exists():
+                    problems.append(
+                        Finding(
+                            self.id, rel, 1, 1,
+                            f"schema: row-writer file {rel} is missing",
+                        )
+                    )
+                    continue
+                ctx = build_context(path, root=root)
+            if ctx.tree is None:
+                continue  # the per-file pass reports the syntax error
+            for column, lineno in sorted(
+                written_row_columns(ctx.tree).items()
+            ):
+                doc = ROW_COLUMNS.get(column)
+                if doc is None:
+                    problems.append(
+                        Finding(
+                            self.id, rel, lineno, 1,
+                            f"schema: {rel} writes row column {column!r} "
+                            f"that is not registered in "
+                            f"ddlb_tpu/schema.py ROW_COLUMNS",
+                            snippet=ctx.line_text(lineno),
+                        )
+                    )
+                elif not str(doc).strip():
+                    problems.append(
+                        Finding(
+                            self.id, rel, lineno, 1,
+                            f"schema: ddlb_tpu/schema.py "
+                            f"ROW_COLUMNS[{column!r}] has an empty "
+                            f"docstring",
+                            snippet=ctx.line_text(lineno),
+                        )
+                    )
+        return problems
+
+
+RULES = [CostModelCoverageRule(), RowSchemaCoverageRule()]

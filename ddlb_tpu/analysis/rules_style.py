@@ -1,0 +1,312 @@
+"""Style/correctness rules ported from the old ``scripts/lint.py``.
+
+Same findings, same scopes, now as engine rules with stable ids so they
+participate in suppression, baselining, and SARIF output:
+
+- **DDLB002 undefined-name**: module-global references nothing binds —
+  the pyflakes-floor check (``make lint`` must never degrade to a bare
+  syntax check). Files with wildcard imports are skipped.
+- **DDLB003 forbidden-call**: the bandit-lite battery — string
+  ``eval``/``exec``, pickle deserialization, ``os.system``,
+  ``shell=True``.
+- **DDLB004 bare-print**: package diagnostics go through
+  ``ddlb_tpu.telemetry.log`` (rank-tagged, machine-parseable); ``cli/``
+  and ``telemetry/`` are the exempt stdout surfaces.
+- **DDLB005 missing-docstring**: pydocstyle-lite floor for package
+  modules and public classes (sole-public-class modules carry the prose
+  at module level).
+- **DDLB006 process-spawn**: worker processes come from
+  ``ddlb_tpu/pool.py`` only, so row execution cannot silently regress
+  to cold spawn-per-row.
+
+(DDLB001 syntax-error is emitted by the engine itself; DDLB107/DDLB108
+— the swallow and row-schema ports — live with the domain rules they
+became.)
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import symtable
+from typing import Iterable, List
+
+from ddlb_tpu.analysis.core import FileContext, Finding, Rule
+
+_MODULE_DUNDERS = {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__builtins__", "__loader__", "__path__", "__annotations__",
+    "__all__", "__debug__", "__class__",
+}
+
+
+def _module_bindings(tree: ast.Module) -> set:
+    """Every name the module's global namespace can bind at runtime."""
+    names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            names.add(node.id)
+        elif isinstance(node, (ast.Global, ast.Nonlocal)):
+            names.update(node.names)
+        elif isinstance(node, (ast.MatchAs, ast.MatchStar)):
+            if node.name:  # match-case capture patterns bind raw strings
+                names.add(node.name)
+        elif isinstance(node, ast.MatchMapping) and node.rest:
+            names.add(node.rest)
+        elif hasattr(ast, "TypeAlias") and isinstance(
+            node, ast.TypeAlias
+        ):  # PEP 695 `type X = ...`
+            names.add(node.name.id)
+    return names
+
+
+def _global_refs(table: symtable.SymbolTable, out: set) -> None:
+    """Names referenced as globals anywhere in the scope tree; scope
+    resolution is symtable's, so parameters, locals, closures and class
+    scopes are never reported."""
+    is_module = table.get_type() == "module"
+    for sym in table.get_symbols():
+        if not sym.is_referenced() or sym.is_imported():
+            continue
+        if is_module:
+            if not sym.is_assigned():
+                out.add(sym.get_name())
+        elif sym.is_global() and not sym.is_assigned():
+            out.add(sym.get_name())
+    for child in table.get_children():
+        _global_refs(child, out)
+
+
+class UndefinedNameRule(Rule):
+    """Module-global references that nothing binds (pyflakes floor)."""
+
+    id = "DDLB002"
+    name = "undefined-name"
+    rationale = (
+        "an undefined name fails the build even on a checkout without "
+        "pyflakes (the lint tier must never degrade to compileall)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        assert tree is not None
+        if any(
+            isinstance(n, ast.ImportFrom)
+            and any(a.name == "*" for a in n.names)
+            for n in ctx.nodes(ast.ImportFrom)
+        ):
+            return []  # wildcard import: globals unknowable statically
+        try:
+            table = symtable.symtable(ctx.source, str(ctx.path), "exec")
+        except SyntaxError:  # pragma: no cover - ast parsed, so unlikely
+            return []
+        known = _module_bindings(tree) | _MODULE_DUNDERS | set(dir(builtins))
+        refs: set = set()
+        _global_refs(table, refs)
+        lines = {}
+        cols = {}
+        for node in ctx.nodes(ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id not in lines:
+                lines[node.id] = node.lineno
+                cols[node.id] = node.col_offset + 1
+        return [
+            self.finding(
+                ctx, lines.get(name, 1), cols.get(name, 1),
+                f"undefined name '{name}'",
+            )
+            for name in sorted(refs - known)
+        ]
+
+
+_FORBIDDEN_CALLS = {
+    "eval": "eval() on a string",
+    "exec": "exec() on a string",
+}
+_FORBIDDEN_ATTRS = {
+    ("pickle", "load"): "pickle.load (arbitrary code on untrusted data)",
+    ("pickle", "loads"): "pickle.loads (arbitrary code on untrusted data)",
+    ("os", "system"): "os.system (shell injection; use subprocess lists)",
+}
+
+
+class ForbiddenCallRule(Rule):
+    """Dangerous-call patterns with no legitimate use in this codebase."""
+
+    id = "DDLB003"
+    name = "forbidden-call"
+    rationale = (
+        "subprocess always runs argv lists here; nothing evals strings "
+        "or loads pickles — a new hit is either a bug or needs an "
+        "explicit suppression with a justification"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_CALLS:
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"security: {_FORBIDDEN_CALLS[fn.id]}",
+                    )
+                )
+            if isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ):
+                why = _FORBIDDEN_ATTRS.get((fn.value.id, fn.attr))
+                if why:
+                    out.append(
+                        self.finding(
+                            ctx, node.lineno, node.col_offset + 1,
+                            f"security: {why}",
+                        )
+                    )
+            for kw in node.keywords:
+                if (
+                    kw.arg == "shell"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    out.append(
+                        self.finding(
+                            ctx, node.lineno, node.col_offset + 1,
+                            "security: shell=True (use an argv list)",
+                        )
+                    )
+        return out
+
+
+#: package subtrees exempt from the bare-print ban: the CLI is the
+#: user-facing stdout surface, and the telemetry logger is the one place
+#: a print legitimately lives (it is what everything else must call)
+_PRINT_EXEMPT_DIRS = {"cli", "telemetry"}
+
+
+class BarePrintRule(Rule):
+    """Bare ``print(`` in package code interleaves unattributably."""
+
+    id = "DDLB004"
+    name = "bare-print"
+    rationale = (
+        "on a multi-process pod untagged prints interleave "
+        "unattributably and the capture pipelines substring-match free "
+        "text; package diagnostics go through ddlb_tpu.telemetry.log"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and not (
+            set(ctx.parts) & _PRINT_EXEMPT_DIRS
+        )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return [
+            self.finding(
+                ctx, node.lineno, node.col_offset + 1,
+                "print: bare print() in package code — use "
+                "ddlb_tpu.telemetry.log (rank-tagged, machine-parseable)",
+            )
+            for node in ctx.nodes(ast.Call)
+            if isinstance(node.func, ast.Name) and node.func.id == "print"
+        ]
+
+
+class DocstringRule(Rule):
+    """pydocstyle-lite presence floor for package modules/classes."""
+
+    id = "DDLB005"
+    name = "missing-docstring"
+    rationale = (
+        "every package module needs a docstring; every public class "
+        "needs one unless it is its module's only public class (the "
+        "one-member-class-per-file pattern carries the prose at module "
+        "level)"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package()
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        tree = ctx.tree
+        assert tree is not None
+        out: List[Finding] = []
+        module_doc = ast.get_docstring(tree)
+        if not module_doc:
+            out.append(
+                self.finding(ctx, 1, 1, "docstring: module has no docstring")
+            )
+        public_classes = [
+            n
+            for n in ctx.nodes(ast.ClassDef)
+            if not n.name.startswith("_")
+        ]
+        sole = len(public_classes) == 1 and bool(module_doc)
+        for node in public_classes:
+            if not ast.get_docstring(node) and not sole:
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"docstring: public class '{node.name}' has no "
+                        f"docstring",
+                    )
+                )
+        return out
+
+
+class ProcessSpawnRule(Rule):
+    """Direct ``Process()`` construction outside the warm-worker pool."""
+
+    id = "DDLB006"
+    name = "process-spawn"
+    rationale = (
+        "the warm-worker pool is the one spawner for row/worker "
+        "processes — every spawn inherits its heartbeat channel, daemon "
+        "flag, and queue-release discipline"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and ctx.path.name != "pool.py"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            named = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id
+                if isinstance(fn, ast.Name)
+                else None
+            )
+            if named == "Process":
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        "process: direct Process() construction — worker "
+                        "processes must come from ddlb_tpu/pool.py "
+                        "(WorkerPool), so row execution cannot regress "
+                        "to cold spawn-per-row",
+                    )
+                )
+        return out
+
+
+RULES = [
+    UndefinedNameRule(),
+    ForbiddenCallRule(),
+    BarePrintRule(),
+    DocstringRule(),
+    ProcessSpawnRule(),
+]

@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ddlb_tpu import faults, telemetry
+from ddlb_tpu import envs, faults, telemetry
 from ddlb_tpu.faults import heartbeat
 from ddlb_tpu.observatory import attribution as overlap_attribution
 from ddlb_tpu.observatory import live, store
@@ -991,7 +991,7 @@ class PrimitiveBenchmarkRunner:
             return sim
         # explicit override: on flaky hardware the 120 s probe below is
         # pure cost when the operator already knows the topology
-        override = os.environ.get("DDLB_TPU_WORLD_SIZE", "")
+        override = envs.get_world_size_override()
         if override:
             try:
                 n = int(override)

@@ -210,6 +210,56 @@ def get_live_path() -> str:
     return os.environ.get("DDLB_TPU_LIVE", "").strip()
 
 
+def get_chip_override() -> str:
+    """Chip-spec name override ("" = auto-detect from PJRT).
+
+    When set, ``perfmodel.specs.detect_spec`` and the HBM budget gate
+    resolve this name in the hardware registry instead of querying the
+    backend — unknown names raise there (a silently-wrong roofline
+    denominator is worse than a crash). Follows the DDLB_TPU_*
+    convention: empty/unset auto-detects.
+    """
+    return os.environ.get("DDLB_TPU_CHIP", "").strip()
+
+
+def get_autotune_cache_path() -> str:
+    """Autotune-cache JSON path override ("" = the repo-root default).
+
+    ``utils.autotune`` persists tuned Pallas block sizes here, keyed by
+    (kernel, shape, dtype, device kind); tests point it at a tmp file.
+    """
+    return os.environ.get("DDLB_TPU_AUTOTUNE_CACHE", "").strip()
+
+
+def get_run_id_override() -> str:
+    """Observatory run-id override ("" = generate per process).
+
+    Multi-process captures that must bank under one history id set
+    this; otherwise ``observatory.store`` stamps a timestamp+pid id
+    once per driver process.
+    """
+    return os.environ.get("DDLB_TPU_RUN_ID", "").strip()
+
+
+def get_world_size_override() -> str:
+    """Device-count override for subprocess-isolation resume keys
+    ("" = probe; returned raw because the runner warns on a non-integer
+    value rather than silently dropping it).
+
+    On flaky hardware the 120 s world-size probe is pure cost when the
+    operator already knows the topology; "0" keeps the DDLB_TPU_*
+    convention (disabled).
+    """
+    return os.environ.get("DDLB_TPU_WORLD_SIZE", "").strip()
+
+
+def get_no_native() -> bool:
+    """Whether the native host-runtime library is force-disabled
+    (``DDLB_TPU_NO_NATIVE=1``; used by tests to cover the pure-Python
+    fallbacks)."""
+    return bool(os.environ.get("DDLB_TPU_NO_NATIVE"))
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

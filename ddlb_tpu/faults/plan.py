@@ -59,6 +59,29 @@ from contextlib import contextmanager
 
 from ddlb_tpu import envs, telemetry
 
+#: The registry of injection sites that actually exist in the code —
+#: one entry per ``faults.inject``/``corrupt``/``corrupt_row`` call site
+#: threaded through the stack. A plan rule whose ``site`` glob matches
+#: none of these would silently never fire (the seeded chaos battery
+#: would "pass" without injecting anything), so the static analyzer
+#: (DDLB104, ``ddlb_tpu/analysis``) cross-checks every site literal and
+#: plan glob against this dict. Adding an injection site means adding
+#: its name here — the analyzer fails otherwise.
+SITES: Dict[str, str] = {
+    "compile.aot": "AOT compile of one executable (utils/compile_ahead)",
+    "compile.prefetch": "background compile-ahead prefetch of config N+1",
+    "worker.setup": "benchmark_worker input/mesh setup phase",
+    "worker.warmup": "benchmark_worker warmup iterations",
+    "worker.timing": "benchmark_worker timed measurement loop",
+    "worker.validate": "benchmark_worker result validation phase",
+    "worker.result": "result-array corruption before validation",
+    "runtime.mesh": "Runtime mesh construction",
+    "runtime.barrier": "Runtime cross-process barrier",
+    "subprocess.entry": "pool child dispatch-loop row entry",
+    "subprocess.result": "row dict corruption before posting to parent",
+}
+
+
 _UNSET = object()
 
 _lock = threading.Lock()

@@ -11,7 +11,6 @@ without a C++ toolchain — ``available()`` reports which path is live.
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import Dict, Optional
 
 import numpy as np
@@ -32,7 +31,9 @@ def _load() -> Optional[ctypes.CDLL]:
     if _tried:
         return _lib
     _tried = True
-    if os.environ.get("DDLB_TPU_NO_NATIVE"):
+    from ddlb_tpu import envs
+
+    if envs.get_no_native():
         return None
     from ddlb_tpu.native.build import build
 

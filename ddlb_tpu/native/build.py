@@ -23,7 +23,9 @@ _lock = threading.Lock()
 
 def build(force: bool = False) -> Optional[str]:
     """Return the path to the built library, or None if unavailable."""
-    if os.environ.get("DDLB_TPU_NO_NATIVE"):
+    from ddlb_tpu import envs
+
+    if envs.get_no_native():
         return None
     with _lock:
         if not os.path.exists(SOURCE):

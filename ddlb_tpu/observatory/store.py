@@ -68,7 +68,9 @@ def run_id() -> str:
     (multi-process captures that must bank under one id), else a
     timestamp+pid string generated once per process."""
     global _run_id
-    env = os.environ.get("DDLB_TPU_RUN_ID", "").strip()
+    from ddlb_tpu import envs
+
+    env = envs.get_run_id_override()
     if env:
         return env
     if _run_id is None:

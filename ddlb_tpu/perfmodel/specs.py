@@ -35,14 +35,14 @@ parent, ``scripts/lint.py``, ``utils/hbm_budget``) can import freely.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Mapping, Optional
 
 GB = 1e9
 GiB = float(1 << 30)
 
-#: env override: force a registry entry by name regardless of detection
+#: env override name: force a registry entry regardless of detection
+#: (read via ``envs.get_chip_override`` — the one accessor surface)
 CHIP_ENV = "DDLB_TPU_CHIP"
 
 
@@ -209,7 +209,9 @@ def detect_spec(
     when neither is given (the only JAX touch in this module); the
     ``cpu-sim`` entry for anything that is not a recognized TPU.
     """
-    override = os.environ.get(CHIP_ENV, "")
+    from ddlb_tpu import envs
+
+    override = envs.get_chip_override()
     if override:
         return get_spec(override)
     if device_kind is None and platform is None:

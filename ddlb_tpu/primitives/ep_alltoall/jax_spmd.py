@@ -15,6 +15,7 @@ from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class JaxSPMDEPAllToAll(EPAllToAll):
@@ -44,8 +45,11 @@ class JaxSPMDEPAllToAll(EPAllToAll):
             )
             return y.reshape(d * g, self.n)
 
+        # shard_map_compat: jax.shard_map where it exists, the pre-0.5
+        # experimental entry point otherwise (ROADMAP open item — this
+        # unlocks the family on the jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None), P("tp", None, None)),

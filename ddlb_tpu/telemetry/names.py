@@ -1,0 +1,89 @@
+"""Registry of telemetry span, instant, and metric names.
+
+``scripts/trace_report.py`` groups rows by the ``worker.row`` span,
+``observatory.attribution`` joins phase spans against perfmodel terms,
+and ``observatory.fold`` matches live events to runner posts — all by
+NAME. A renamed span used to break those joins silently: the report
+just showed less, with nothing pointing at the rename. Every name
+emitted via ``telemetry.span`` / ``instant`` / ``record`` /
+``record_max`` / ``completed_event`` is therefore declared here, and
+the static analyzer (DDLB106, ``ddlb_tpu/analysis``) fails on any
+literal not in the registry — renaming a span now forces the registry
+(and so the greppable join surface) to move with it.
+
+Three dicts, name -> one-line meaning. Dynamic names (f-strings) are
+not statically checkable and are deliberately rare; the analyzer skips
+them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: timed regions (``telemetry.span`` / ``completed_event``)
+SPAN_NAMES: Dict[str, str] = {
+    "compile_ahead.prefetch": "background prefetch-compile of config N+1",
+    "device_loop.build": "differential device-loop executable build",
+    "device_loop.window": "one timed device-loop window",
+    "pool.lease": "warm-worker pool lease acquisition",
+    "pool.respawn": "pool worker respawn after death/recycle",
+    "pool.spawn": "pool worker cold spawn",
+    "queue.action": "measure_queue per-attempt action",
+    "queue.row": "measure_queue one queue-row attempt",
+    "runner.csv_append": "incremental CSV append of one result row",
+    "runner.retry": "backoff + re-dispatch of a transient-failed row",
+    "runner.subprocess_row": "subprocess-isolated row round trip",
+    "runtime.barrier": "cross-process barrier collective",
+    "runtime.mesh_build": "device mesh construction",
+    "serve.admit": "serving engine admission of one request batch",
+    "serve.run": "serving engine full run loop",
+    "worker.profile": "benchmark_worker optional profiling phase",
+    "worker.row": "benchmark_worker one full row (the report join key)",
+    "worker.setup": "benchmark_worker input/mesh setup phase",
+    "worker.timing": "benchmark_worker timed measurement loop",
+    "worker.validate": "benchmark_worker result validation phase",
+    "worker.warmup": "benchmark_worker warmup iterations",
+    "xla_compile": "XLA compile observed via the monitoring listener",
+}
+
+#: zero-duration markers (``telemetry.instant``)
+INSTANT_NAMES: Dict[str, str] = {
+    "fault.inject": "a fault rule fired at an injection site",
+    "log": "rank-tagged log line mirrored into the trace",
+    "pool.reuse": "a row dispatched onto an already-warm pool worker",
+    "queue.parked": "measure_queue parked a row (deterministic failure)",
+    "runner.quarantine": "an impl crossed the consecutive-failure gate",
+    "serve.ticks": "serving engine decode-tick marker",
+}
+
+#: counters / gauges (``telemetry.record`` / ``record_max``)
+METRIC_NAMES: Dict[str, str] = {
+    "barrier_wait_s": "seconds spent waiting in Runtime.barrier",
+    "collective_bytes": "modeled collective wire bytes for the row",
+    "compile_ahead.failed": "prefetch compiles that raised",
+    "compile_ahead.prefetch_s": "seconds spent prefetch-compiling",
+    "compile_ahead.prefetched": "prefetch compiles completed",
+    "compile_ahead.skipped": "prefetch compiles skipped (cache hit)",
+    "fault.injected": "fault rules fired",
+    "hbm_high_water_bytes": "device memory high-water mark",
+    "loop_overhead_s": "host-side loop overhead estimate",
+    "pool.invalidations": "pool leases invalidated (suspect worker killed)",
+    "pool.respawns": "pool workers respawned after death",
+    "pool.reuses": "rows served by an already-warm pool worker",
+    "pool.spawns": "pool workers spawned",
+    "runner.quarantine_skips": "rows skipped because their impl is quarantined",
+    "runner.quarantined_impls": "impls quarantined this run",
+    "runner.retries": "row retry attempts dispatched",
+    "serve.decode_s": "seconds in serving decode ticks",
+    "serve.ticks": "serving decode ticks executed",
+}
+
+
+def all_names() -> Dict[str, str]:
+    """Union of every registered name (collisions are fine: a span and
+    a metric may legitimately share a name, e.g. ``serve.ticks``)."""
+    out: Dict[str, str] = {}
+    out.update(METRIC_NAMES)
+    out.update(INSTANT_NAMES)
+    out.update(SPAN_NAMES)
+    return out

@@ -46,7 +46,9 @@ DEFAULT_CACHE_PATH = os.path.join(_REPO_DIR, "autotune_cache.json")
 
 
 def cache_path() -> str:
-    return os.environ.get("DDLB_TPU_AUTOTUNE_CACHE", DEFAULT_CACHE_PATH)
+    from ddlb_tpu import envs
+
+    return envs.get_autotune_cache_path() or DEFAULT_CACHE_PATH
 
 
 def _load_cache(path: str) -> Dict[str, Any]:

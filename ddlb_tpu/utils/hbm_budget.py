@@ -46,7 +46,6 @@ models/decode.py and models/transformer.py):
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -61,7 +60,9 @@ def default_limit(chip: Optional[str] = None) -> float:
     read from the perfmodel spec registry so capacity and cost model can
     never drift. Keeps 10% headroom: the model is planning, not
     allocation — fusion/scheduling can move peak by that much."""
-    spec = get_spec(chip or os.environ.get("DDLB_TPU_CHIP") or "v5e")
+    from ddlb_tpu import envs
+
+    spec = get_spec(chip or envs.get_chip_override() or "v5e")
     return 0.9 * spec.hbm_bytes
 
 

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Static-analysis CLI for the ddlb_tpu rule engine (make analyze/lint).
+
+Runs every registered rule (``ddlb_tpu/analysis``) over the repo's
+Python tree, applies inline suppressions and the committed baseline,
+and exits 1 on any non-baselined error. Output modes: human text
+(default, with the DDLB101 shard_map migration inventory), ``--json``,
+and ``--sarif`` (SARIF 2.1.0 for code-scanning UIs).
+
+Common invocations::
+
+    python scripts/analyze.py                  # full repo, text
+    python scripts/analyze.py --changed-only   # pre-commit fast path
+    python scripts/analyze.py --sarif > out.sarif
+    python scripts/analyze.py --update-baseline  # after fixing sites
+
+The baseline (``analysis_baseline.json``) is shrink-only: stale entries
+are DDLB110 errors, and ``--update-baseline`` refuses growth without
+``--allow-baseline-growth`` (new violations get fixed or suppressed
+with a reviewed ``# ddlb: ignore[rule-id]`` comment, never silently
+grandfathered).
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from ddlb_tpu.analysis import core  # noqa: E402
+from ddlb_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from ddlb_tpu.analysis import output  # noqa: E402
+
+#: the default analysis sweep — same surface as the old make lint
+DEFAULT_TARGETS = (
+    "ddlb_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py",
+)
+
+
+def _changed_files(ref: str) -> list:
+    """Python files changed vs the merge-base with ``ref`` plus the
+    working tree — the fast pre-commit surface. Falls back through
+    origin/main -> main -> HEAD~1 when ``ref`` is empty; an
+    unresolvable base raises (analyzing nothing must never look like a
+    clean pass)."""
+    candidates = [ref] if ref else ["origin/main", "main", "HEAD~1"]
+    base = None
+    for cand in candidates:
+        proc = subprocess.run(
+            ["git", "merge-base", "HEAD", cand],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        if proc.returncode == 0:
+            base = proc.stdout.strip()
+            break
+    if base is None:
+        raise ValueError(
+            f"cannot resolve a merge base against "
+            f"{' / '.join(candidates)} — fix the ref or run the full "
+            f"sweep"
+        )
+    names = set()
+    diffs = [
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "diff", "--name-only", f"{base}..HEAD"],
+    ]
+    for cmd in diffs:
+        proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True)
+        if proc.returncode == 0:
+            names.update(
+                line.strip()
+                for line in proc.stdout.splitlines()
+                if line.strip().endswith(".py")
+            )
+    return sorted(
+        REPO / name for name in names if (REPO / name).exists()
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="analyze.py",
+        description="ddlb_tpu static analysis (rule catalog: "
+        "docs/source/static_analysis.rst)",
+    )
+    parser.add_argument(
+        "targets", nargs="*",
+        help=f"files/dirs to analyze (default: {' '.join(DEFAULT_TARGETS)})",
+    )
+    mode = parser.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    mode.add_argument(
+        "--sarif", action="store_true", help="SARIF 2.1.0 document"
+    )
+    parser.add_argument(
+        "--baseline", default=str(REPO / baseline_mod.BASELINE_NAME),
+        help="baseline file (default: analysis_baseline.json at the "
+        "repo root)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (every grandfathered finding counts)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings "
+        "(shrink-only unless --allow-baseline-growth)",
+    )
+    parser.add_argument(
+        "--allow-baseline-growth", action="store_true",
+        help="let --update-baseline add entries (reviewed exception)",
+    )
+    parser.add_argument(
+        "--changed-only", nargs="?", const="", metavar="REF",
+        default=None,
+        help="analyze only files changed vs the merge-base with REF "
+        "(default origin/main, then main, then HEAD~1) plus the "
+        "working tree — the pre-commit fast path",
+    )
+    parser.add_argument(
+        "--show-masked", action="store_true",
+        help="also print suppressed/baselined findings in text mode",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in core.all_rules():
+            kind = (
+                "project" if isinstance(rule, core.ProjectRule) else "file"
+            )
+            print(f"{rule.id}  {rule.severity:5s} {kind:7s} {rule.name}")
+            print(f"        {rule.rationale}")
+        return 0
+
+    if args.changed_only is not None:
+        if args.update_baseline:
+            # the baseline is written from the analyzed findings; a
+            # subset sweep would silently drop every untouched entry
+            print(
+                "analyze: --update-baseline requires the full sweep "
+                "(drop --changed-only)",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            paths = _changed_files(args.changed_only)
+        except ValueError as exc:
+            print(f"analyze: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            print("analyze: no changed Python files")
+            return 0
+    else:
+        targets = args.targets or [
+            str(REPO / t) for t in DEFAULT_TARGETS
+        ]
+        try:
+            paths = core.expand_targets(targets)
+        except FileNotFoundError as exc:
+            # a missing target must fail like pyflakes would, not lint
+            # nothing and exit 0
+            print(
+                f"analyze: no such file or directory: {exc.args[0]}",
+                file=sys.stderr,
+            )
+            return 2
+
+    findings = core.analyze(paths, root=REPO)
+
+    baseline_path = Path(args.baseline)
+    if not args.no_baseline:
+        known = baseline_mod.load(baseline_path)
+        # staleness is provable only by the FULL sweep; a changed-only
+        # run must not report the untouched backlog as stale
+        analyzed = None
+        if args.changed_only is not None:
+            analyzed = {core.relativize(p, root=REPO) for p in paths}
+        findings.extend(
+            baseline_mod.apply(
+                findings, known, baseline_path, analyzed=analyzed
+            )
+        )
+
+    if args.update_baseline:
+        grown = baseline_mod.update(
+            findings, baseline_path,
+            allow_growth=args.allow_baseline_growth,
+        )
+        if grown:
+            print(
+                "analyze: baseline would GROW — fix or suppress these "
+                "instead (or pass --allow-baseline-growth):",
+                file=sys.stderr,
+            )
+            for line in grown:
+                print(f"  {line}", file=sys.stderr)
+            return 1
+        print(f"analyze: baseline written to {baseline_path}")
+        # fresh mask so the exit code reflects the file just written
+        for f in findings:
+            f.baselined = False
+        findings = [
+            f for f in findings
+            if f.rule != baseline_mod.STALE_BASELINE_ID
+        ]
+        baseline_mod.apply(
+            findings, baseline_mod.load(baseline_path), baseline_path
+        )
+
+    errors = sum(1 for f in findings if f.counts)
+
+    if args.json:
+        print(output.dump_json(output.render_json(findings)), end="")
+    elif args.sarif:
+        print(output.dump_json(output.render_sarif(findings)), end="")
+    else:
+        for line in output.render_text(
+            findings, show_masked=args.show_masked
+        ):
+            print(line)
+        for line in output.shard_map_inventory(findings):
+            print(line)
+        masked = sum(
+            1 for f in findings if f.suppressed or f.baselined
+        )
+        if errors:
+            print(
+                f"analyze: {errors} error(s) in {len(paths)} file(s) "
+                f"({masked} masked)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"analyze: {len(paths)} files clean "
+                f"({masked} masked finding(s))"
+            )
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,469 +1,77 @@
 #!/usr/bin/env python
-"""Undefined-name lint with zero third-party dependencies.
+"""Thin shim over the ``ddlb_tpu/analysis`` rule engine (legacy entry).
 
-``make lint`` prefers pyflakes (dev extra); on a checkout without it this
-checker is the floor instead of a bare syntax check, so an undefined name
-fails the build either way (VERDICT r3 missing #4 / next #8: ``make
-lint`` must never silently degrade to ``compileall``).
+Every check that used to live here — the undefined-name floor, the
+bandit-lite battery, the bare-print / silent-swallow / ``Process()``
+bans, docstring presence, cost-model and row-schema coverage — is now a
+registered rule in ``ddlb_tpu.analysis`` (DDLB002-DDLB007, DDLB107,
+DDLB108), running alongside the domain invariants (DDLB101-DDLB106)
+with suppressions, a baseline, and SARIF output. ``make lint`` invokes
+``scripts/analyze.py``; this module stays for callers of the old
+interface:
 
-Method: per file, collect every module-level binding (imports, assigns,
-defs, classes) with ``ast``, then walk ``symtable`` scopes; a symbol
-referenced as global that is neither a module binding, a builtin, nor a
-module dunder is reported. Files with wildcard imports skip the check
-(their global namespace is unknowable statically). This is deliberately
-a subset of pyflakes — no unused-import or redefinition warnings — and
-conservative: scope kinds symtable can't resolve are never reported.
+- ``check_file(path)`` returns the legacy one-line problem strings for
+  one file (per-file rules only);
+- ``main(argv)`` lints the given targets with the legacy output format
+  and exit codes (0 clean / 1 problems / 2 missing target).
+
+New tooling should call ``scripts/analyze.py`` (or the
+``ddlb_tpu.analysis`` API) directly — it adds the baseline layer,
+``--changed-only``, ``--json`` and SARIF.
 """
 
 from __future__ import annotations
 
-import ast
-import builtins
 import sys
-import symtable
 from pathlib import Path
 
-MODULE_DUNDERS = {
-    "__file__", "__name__", "__doc__", "__package__", "__spec__",
-    "__builtins__", "__loader__", "__path__", "__annotations__",
-    "__all__", "__debug__", "__class__",
-}
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-
-def _module_bindings(tree: ast.Module) -> set:
-    """Every name the module's global namespace can bind at runtime."""
-    names: set = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                names.add(a.asname or a.name.split(".")[0])
-        elif isinstance(node, ast.ImportFrom):
-            for a in node.names:
-                if a.name != "*":
-                    names.add(a.asname or a.name)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                               ast.ClassDef)):
-            names.add(node.name)
-        elif isinstance(node, ast.Name) and isinstance(
-            node.ctx, (ast.Store, ast.Del)
-        ):
-            names.add(node.id)
-        elif isinstance(node, (ast.Global, ast.Nonlocal)):
-            names.update(node.names)
-        elif isinstance(node, (ast.MatchAs, ast.MatchStar)):
-            if node.name:  # match-case capture patterns bind raw strings
-                names.add(node.name)
-        elif isinstance(node, ast.MatchMapping) and node.rest:
-            names.add(node.rest)
-        elif hasattr(ast, "TypeAlias") and isinstance(
-            node, ast.TypeAlias
-        ):  # PEP 695 `type X = ...`
-            names.add(node.name.id)
-    return names
-
-
-def _has_star_import(tree: ast.Module) -> bool:
-    return any(
-        isinstance(n, ast.ImportFrom) and any(a.name == "*" for a in n.names)
-        for n in ast.walk(tree)
-    )
-
-
-def _global_refs(table: symtable.SymbolTable, out: set) -> None:
-    """Names referenced as globals anywhere in the scope tree: unassigned
-    global references in nested scopes, plus module-scope references that
-    nothing assigns or imports. Scope resolution is symtable's, so
-    parameters, locals, closures and class scopes are never reported."""
-    is_module = table.get_type() == "module"
-    for sym in table.get_symbols():
-        if not sym.is_referenced() or sym.is_imported():
-            continue
-        if is_module:
-            if not sym.is_assigned():
-                out.add(sym.get_name())
-        elif sym.is_global() and not sym.is_assigned():
-            out.add(sym.get_name())
-    for child in table.get_children():
-        _global_refs(child, out)
-
-
-#: bandit-lite: call patterns that have no legitimate use in this
-#: codebase (subprocess always runs argv lists here; nothing evals
-#: strings or loads pickles). A new hit is either a bug or needs an
-#: explicit entry in the allowlist below with a justification.
-_FORBIDDEN_CALLS = {
-    "eval": "eval() on a string",
-    "exec": "exec() on a string",
-}
-_FORBIDDEN_ATTRS = {
-    ("pickle", "load"): "pickle.load (arbitrary code on untrusted data)",
-    ("pickle", "loads"): "pickle.loads (arbitrary code on untrusted data)",
-    ("os", "system"): "os.system (shell injection; use subprocess lists)",
-}
-
-
-def _security_checks(path: Path, tree: ast.Module) -> list:
-    """The dangerous-call subset of bandit that matters for a benchmark
-    framework: string eval/exec, pickle deserialization, shell=True.
-    (VERDICT r4 missing #4: the reference's .lintrunner battery includes
-    bandit; this is the zero-dependency floor for its findings class.)"""
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Name) and fn.id in _FORBIDDEN_CALLS:
-            out.append(
-                f"{path}:{node.lineno}: security: "
-                f"{_FORBIDDEN_CALLS[fn.id]}"
-            )
-        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
-            why = _FORBIDDEN_ATTRS.get((fn.value.id, fn.attr))
-            if why:
-                out.append(f"{path}:{node.lineno}: security: {why}")
-        for kw in node.keywords:
-            if (
-                kw.arg == "shell"
-                and isinstance(kw.value, ast.Constant)
-                and kw.value.value is True
-            ):
-                out.append(
-                    f"{path}:{node.lineno}: security: shell=True "
-                    f"(use an argv list)"
-                )
-    return out
-
-
-#: package subtrees exempt from the bare-print ban: the CLI is the
-#: user-facing stdout surface (results tables ARE its output), and the
-#: telemetry logger is the one place a print legitimately lives (it is
-#: what everything else must call instead)
-_PRINT_EXEMPT_DIRS = {"cli", "telemetry"}
-
-
-def _print_checks(path: Path, tree: ast.Module) -> list:
-    """Ban bare ``print(`` in package code (ISSUE 2 satellite): on a
-    multi-process pod untagged prints interleave unattributably, and the
-    capture pipelines substring-match free text. Package diagnostics go
-    through ``ddlb_tpu.telemetry.log`` (rank-tagged, trace-mirrored);
-    scripts/ and tests/ are exempt (they are single-process drivers whose
-    stdout is the artifact)."""
-    out = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            out.append(
-                f"{path}:{node.lineno}: print: bare print() in package "
-                f"code — use ddlb_tpu.telemetry.log (rank-tagged, "
-                f"machine-parseable)"
-            )
-    return out
-
-
-def _swallow_checks(path: Path, tree: ast.Module) -> list:
-    """Ban silent broad-exception swallows in package code (ISSUE 4
-    satellite): an ``except Exception: pass`` (or bare ``except:``)
-    whose body does nothing turns a real failure into an invisible one —
-    exactly the class the fault-injection harness exists to provoke.
-    Every handler must re-raise, return an error value, or log via
-    telemetry (any non-pass body satisfies the check); narrow exception
-    types (``OSError``, ``ValueError``) remain legitimate control
-    flow."""
-
-    def _names(node):
-        if node is None:
-            return ["<bare>"]
-        elts = node.elts if isinstance(node, ast.Tuple) else [node]
-        out = []
-        for e in elts:
-            if isinstance(e, ast.Name):
-                out.append(e.id)
-            elif isinstance(e, ast.Attribute):
-                out.append(e.attr)
-            else:
-                out.append("?")
-        return out
-
-    problems = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        silent = all(
-            isinstance(stmt, ast.Pass)
-            or (
-                isinstance(stmt, ast.Expr)
-                and isinstance(stmt.value, ast.Constant)
-                and stmt.value.value is Ellipsis
-            )
-            for stmt in node.body
-        )
-        names = _names(node.type)
-        broad = node.type is None or any(
-            n in ("Exception", "BaseException") for n in names
-        )
-        if silent and broad:
-            problems.append(
-                f"{path}:{node.lineno}: swallow: silent "
-                f"'except {', '.join(names)}: pass' — re-raise, return "
-                f"an error row, or log via ddlb_tpu.telemetry"
-            )
-    return problems
-
-
-def _process_spawn_checks(path: Path, tree: ast.Module) -> list:
-    """Ban direct multiprocessing ``Process`` construction in package
-    code outside ``pool.py`` (ISSUE 5 satellite): the warm-worker pool
-    is the one spawner for row/worker processes, so future row
-    execution cannot silently regress to cold spawn-per-row (and every
-    spawn inherits the pool's heartbeat channel, daemon flag, and
-    queue-release discipline)."""
-    if path.name == "pool.py":
-        return []
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        named = (
-            fn.attr
-            if isinstance(fn, ast.Attribute)
-            else fn.id
-            if isinstance(fn, ast.Name)
-            else None
-        )
-        if named == "Process":
-            out.append(
-                f"{path}:{node.lineno}: process: direct Process() "
-                f"construction — worker processes must come from "
-                f"ddlb_tpu/pool.py (WorkerPool), so row execution "
-                f"cannot regress to cold spawn-per-row"
-            )
-    return out
-
-
-def _docstring_checks(path: Path, tree: ast.Module) -> list:
-    """pydocstyle-lite floor for the PACKAGE (not tests/scripts): every
-    module needs a docstring, and every public class needs one UNLESS it
-    is its module's only public class and the module docstring exists —
-    the one-member-class-per-file pattern here carries the design prose
-    at module level, and duplicating it on the class would be noise.
-    Function-level coverage is a judgment call the full pydocstyle dev
-    extra makes; this presence tier is the non-negotiable floor."""
-    out = []
-    module_doc = ast.get_docstring(tree)
-    if not module_doc:
-        out.append(f"{path}:1: docstring: module has no docstring")
-    public_classes = [
-        n
-        for n in ast.walk(tree)
-        if isinstance(n, ast.ClassDef) and not n.name.startswith("_")
-    ]
-    sole = len(public_classes) == 1 and bool(module_doc)
-    for node in public_classes:
-        if not ast.get_docstring(node) and not sole:
-            out.append(
-                f"{path}:{node.lineno}: docstring: public class "
-                f"'{node.name}' has no docstring"
-            )
-    return out
+from ddlb_tpu.analysis import core  # noqa: E402
 
 
 def check_file(path: Path) -> list:
-    src = path.read_text(encoding="utf-8")
-    try:
-        tree = ast.parse(src, filename=str(path))
-        table = symtable.symtable(src, str(path), "exec")
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
-    extra = _security_checks(path, tree)
-    if path.parts[:1] == ("ddlb_tpu",) or "/ddlb_tpu/" in str(path):
-        extra += _docstring_checks(path, tree)
-        extra += _swallow_checks(path, tree)
-        extra += _process_spawn_checks(path, tree)
-        if not (set(path.parts) & _PRINT_EXEMPT_DIRS):
-            extra += _print_checks(path, tree)
-    if _has_star_import(tree):
-        return extra
-    bound = _module_bindings(tree)
-    known = bound | MODULE_DUNDERS | set(dir(builtins))
-    refs: set = set()
-    _global_refs(table, refs)
-    # line numbers only for reporting (first Load of the name anywhere)
-    lines = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-            lines.setdefault(node.id, node.lineno)
-    return extra + [
-        f"{path}:{lines.get(name, 1)}: undefined name '{name}'"
-        for name in sorted(refs - known)
-    ]
-
-
-def _cost_model_coverage() -> list:
-    """Perfmodel invariant (ISSUE 3 satellite): every registered
-    primitive family must resolve a cost model, so a newly added family
-    can never ship rows with a silent ``predicted_s=None``. Both modules
-    are JAX-free by design, so this import is safe from the lint tier;
-    an import failure is itself a finding (the invariant would otherwise
-    vanish with the import)."""
-    repo = Path(__file__).resolve().parent.parent
-    if str(repo) not in sys.path:
-        sys.path.insert(0, str(repo))
-    try:
-        from ddlb_tpu.perfmodel.cost import FAMILY_COST_MODELS
-        from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
-    except Exception as exc:
-        return [
-            f"perfmodel: cost-model coverage check failed to import: "
-            f"{type(exc).__name__}: {exc}"
-        ]
-    return [
-        f"perfmodel: primitive family '{fam}' has no cost model in "
-        f"ddlb_tpu/perfmodel/cost.py FAMILY_COST_MODELS (rows would "
-        f"carry silent predicted_s defaults)"
-        for fam in ALLOWED_PRIMITIVES
-        if fam not in FAMILY_COST_MODELS
-    ]
-
-
-#: the runner-path files whose row-column writes the schema check scans:
-#: the one row constructor + every site that amends rows after the fact
-#: (repo-relative). A new runner path that writes columns must be added
-#: here — and its columns to ddlb_tpu/schema.py.
-_ROW_WRITER_FILES = (
-    "ddlb_tpu/benchmark.py",
-    "ddlb_tpu/pool.py",
-    "ddlb_tpu/telemetry/metrics.py",
-    "ddlb_tpu/observatory/attribution.py",
-    "scripts/hw_common.py",
-)
-
-
-def _written_row_columns(tree: ast.Module) -> set:
-    """Every row-column name a file writes, statically:
-
-    - keys of the dict literal ``make_result_row`` returns (the one
-      row constructor);
-    - keys of module-level ``*_ROW_DEFAULTS`` / ``ROW_METRIC_DEFAULTS``
-      dict literals (merged into every row);
-    - every ``row["<name>"] = ...`` subscript assignment (the
-      amend-after-build sites: pool reuse columns, hbm peak, bank key).
-    """
-    columns: set = set()
-
-    def _dict_keys(node):
-        return {
-            key.value
-            for key in getattr(node, "keys", [])
-            if isinstance(key, ast.Constant) and isinstance(key.value, str)
-        }
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.FunctionDef) and node.name == "make_result_row":
-            for ret in ast.walk(node):
-                if isinstance(ret, ast.Return) and isinstance(
-                    ret.value, ast.Dict
-                ):
-                    columns |= _dict_keys(ret.value)
-        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
-            targets = (
-                node.targets if isinstance(node, ast.Assign) else [node.target]
-            )
-            # one node can be BOTH cases at once (`row["x"] = {...}`):
-            # check the defaults-dict names and the row subscripts
-            # independently, never as an either/or
-            if isinstance(node.value, ast.Dict):
-                names = [t.id for t in targets if isinstance(t, ast.Name)]
-                if any(
-                    n.endswith("_ROW_DEFAULTS") or n == "ROW_METRIC_DEFAULTS"
-                    for n in names
-                ):
-                    columns |= _dict_keys(node.value)
-            for target in targets:
-                if (
-                    isinstance(target, ast.Subscript)
-                    and isinstance(target.value, ast.Name)
-                    and target.value.id == "row"
-                    and isinstance(target.slice, ast.Constant)
-                    and isinstance(target.slice.value, str)
-                ):
-                    columns.add(target.slice.value)
-    return columns
-
-
-def _row_schema_coverage() -> list:
-    """Row-schema invariant (ISSUE 6 satellite): every column a runner
-    path writes must appear in the ``ddlb_tpu/schema.py`` registry with
-    a non-empty docstring — the column set was previously re-stated ad
-    hoc in benchmark.py, pool.py, hw_common.py and tests, with nothing
-    keeping the statements in agreement."""
-    repo = Path(__file__).resolve().parent.parent
-    if str(repo) not in sys.path:
-        sys.path.insert(0, str(repo))
-    try:
-        from ddlb_tpu.schema import ROW_COLUMNS
-    except Exception as exc:
-        return [
-            f"schema: row-column registry failed to import: "
-            f"{type(exc).__name__}: {exc}"
-        ]
-    problems = []
-    for rel in _ROW_WRITER_FILES:
-        path = repo / rel
-        if not path.exists():
-            problems.append(f"schema: row-writer file {rel} is missing")
-            continue
-        try:
-            tree = ast.parse(path.read_text(encoding="utf-8"), str(path))
-        except SyntaxError:
-            continue  # the per-file pass reports the syntax error
-        for column in sorted(_written_row_columns(tree)):
-            doc = ROW_COLUMNS.get(column)
-            if doc is None:
-                problems.append(
-                    f"schema: {rel} writes row column {column!r} that is "
-                    f"not registered in ddlb_tpu/schema.py ROW_COLUMNS"
-                )
-            elif not str(doc).strip():
-                problems.append(
-                    f"schema: ddlb_tpu/schema.py ROW_COLUMNS[{column!r}] "
-                    f"has an empty docstring"
-                )
-    return problems
+    """Legacy single-file interface: one problem string per finding
+    (per-file rules only; suppressed findings excluded)."""
+    findings = core.analyze([Path(path)], root=REPO, project_rules=False)
+    return [f.legacy_str() for f in findings if not f.suppressed]
 
 
 def main(argv) -> int:
     targets = []
     for arg in argv or ["."]:
         p = Path(arg)
-        if p.is_dir():
-            targets.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py" and p.exists():
-            targets.append(p)
+        if p.is_dir() or (p.suffix == ".py" and p.exists()):
+            targets.append(arg)
         else:
             # a missing target must fail like pyflakes would, not lint
             # nothing and exit 0
             print(f"lint: no such file or directory: {arg}", file=sys.stderr)
             return 2
-    problems = []
-    # repo-level invariants (not per-file): run once whenever the lint
-    # sweep covers the package (the Makefile target always does)
-    if any("ddlb_tpu" in p.parts for p in targets):
-        problems.extend(_cost_model_coverage())
-        problems.extend(_row_schema_coverage())
-    for path in targets:
-        if "__pycache__" in path.parts:
-            continue
-        problems.extend(check_file(path))
+    paths = core.expand_targets(targets)
+    findings = core.analyze(paths, root=REPO)
+    # legacy surface: no baseline layer — mask exactly the findings the
+    # committed baseline grandfathers so `lint` and `analyze` agree
+    from ddlb_tpu.analysis import baseline as baseline_mod
+
+    baseline_path = REPO / baseline_mod.BASELINE_NAME
+    findings.extend(
+        baseline_mod.apply(
+            findings, baseline_mod.load(baseline_path), baseline_path,
+            # partial target lists must not report the untouched
+            # backlog as stale (analyze.py's full sweep is the gate)
+            analyzed={core.relativize(p, root=REPO) for p in paths},
+        )
+    )
+    problems = [f.legacy_str() for f in findings if f.counts]
     for line in problems:
         print(line)
     if problems:
         print(f"lint: {len(problems)} problem(s)", file=sys.stderr)
         return 1
-    print(f"lint: {len(targets)} files clean")
+    print(f"lint: {len(paths)} files clean")
     return 0
 
 

@@ -1,0 +1,633 @@
+"""The static-analysis engine and every rule (ISSUE 7).
+
+Per-rule fixture snippets (positive + negative + suppressed), the
+suppression/baseline machinery (incl. unused-suppression and
+stale-baseline findings, growth refusal), SARIF 2.1.0 document shape,
+the DDLB101 migration inventory, the legacy lint shim, and an
+integration test asserting ``scripts/analyze.py`` exits 0 on the repo
+itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from ddlb_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from ddlb_tpu.analysis import core, output  # noqa: E402
+from ddlb_tpu.analysis.rules_domain import family_of  # noqa: E402
+
+
+def run_on(tmp_path, rel, src, project_rules=False):
+    """Write one fixture file and run the per-file battery on it."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(src)
+    return core.analyze([path], root=REPO, project_rules=project_rules)
+
+
+def rule_ids(findings, *, counting_only=True):
+    return sorted(
+        f.rule
+        for f in findings
+        if not counting_only or f.counts
+    )
+
+
+DOC = '"""Doc."""\n'
+
+
+# ---------------------------------------------------------------------------
+# engine: suppression, unused suppression, ordering
+# ---------------------------------------------------------------------------
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    findings = run_on(tmp_path, "ddlb_tpu/foo.py", "def broken(:\n")
+    assert rule_ids(findings) == ["DDLB001"]
+
+
+def test_inline_suppression_masks_and_is_used(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py",
+        DOC + 'print("hi")  # ddlb: ignore[DDLB004]\n',
+    )
+    assert rule_ids(findings) == []  # suppressed, nothing else fires
+    (f,) = [f for f in findings if f.rule == "DDLB004"]
+    assert f.suppressed and not f.counts
+
+
+def test_unused_suppression_is_an_error(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py",
+        DOC + 'x = 1  # ddlb: ignore[DDLB004]\n',
+    )
+    assert rule_ids(findings) == ["DDLB100"]
+
+
+def test_suppression_inside_string_literal_does_not_apply(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py",
+        DOC + 'y = "# ddlb: ignore[DDLB004]"; print(y)\n',
+    )
+    assert "DDLB004" in rule_ids(findings)
+
+
+def test_findings_sorted_by_location(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py",
+        DOC + 'print("a")\nprint("b")\n',
+    )
+    lines = [f.line for f in findings if f.rule == "DDLB004"]
+    assert lines == sorted(lines) == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# ported style rules (DDLB002-DDLB006)
+# ---------------------------------------------------------------------------
+
+
+def test_undefined_name_positive_negative(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py", DOC + "x = totally_undefined\n"
+    )
+    assert "DDLB002" in rule_ids(findings)
+    findings = run_on(
+        tmp_path, "ddlb_tpu/ok.py", DOC + "y = 1\nx = y\n"
+    )
+    assert "DDLB002" not in rule_ids(findings)
+
+
+def test_forbidden_calls(tmp_path):
+    src = DOC + (
+        "import pickle, subprocess\n"
+        "eval('1')\n"
+        "pickle.loads(b'')\n"
+        "subprocess.run('x', shell=True)\n"
+    )
+    findings = run_on(tmp_path, "scripts/foo.py", src)
+    assert rule_ids(findings).count("DDLB003") == 3
+
+
+def test_bare_print_scope(tmp_path):
+    src = DOC + 'print("hi")\n'
+    assert "DDLB004" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", src))
+    for exempt in ("ddlb_tpu/cli/foo.py", "ddlb_tpu/telemetry/foo.py",
+                   "scripts/foo.py"):
+        assert "DDLB004" not in rule_ids(run_on(tmp_path, exempt, src))
+
+
+def test_docstring_rule(tmp_path):
+    findings = run_on(tmp_path, "ddlb_tpu/foo.py", "x = 1\n")
+    assert "DDLB005" in rule_ids(findings)
+    findings = run_on(
+        tmp_path, "ddlb_tpu/ok.py",
+        DOC + "class Sole:\n    pass\n",  # sole public class: module doc
+    )
+    assert "DDLB005" not in rule_ids(findings)
+
+
+def test_process_spawn_rule(tmp_path):
+    src = DOC + "import multiprocessing as mp\np = mp.Process()\n"
+    assert "DDLB006" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", src))
+    assert "DDLB006" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/pool.py", src)
+    )
+
+
+# ---------------------------------------------------------------------------
+# domain rules (DDLB101-DDLB107)
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_shard_map_positive_negative(tmp_path):
+    pos = DOC + (
+        "import jax\n"
+        "f = jax.shard_map(lambda x: x, mesh=None, in_specs=(),"
+        " out_specs=())\n"
+    )
+    findings = run_on(tmp_path, "ddlb_tpu/primitives/foo/bar.py", pos)
+    assert "DDLB101" in rule_ids(findings)
+    neg = DOC + (
+        "from ddlb_tpu.runtime import shard_map_compat\n"
+        "f = shard_map_compat(lambda x: x, None, (), ())\n"
+    )
+    assert "DDLB101" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/primitives/foo/bar.py", neg)
+    )
+    # runtime.py itself owns the compat shim
+    assert "DDLB101" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/runtime.py", pos)
+    )
+
+
+def test_legacy_shard_map_experimental_import(tmp_path):
+    src = DOC + "from jax.experimental.shard_map import shard_map\n"
+    assert "DDLB101" in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/models/foo.py", src)
+    )
+
+
+def test_wall_clock_deadline_scope(tmp_path):
+    src = DOC + "import time\nt = time.time()\n"
+    assert "DDLB102" in rule_ids(run_on(tmp_path, "ddlb_tpu/pool.py", src))
+    assert "DDLB102" in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/faults/heartbeat.py", src)
+    )
+    # monotonic is the required clock
+    ok = DOC + "import time\nt = time.monotonic()\n"
+    assert "DDLB102" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/pool.py", ok)
+    )
+    # observatory timestamping (any non-deadline file) is out of scope
+    assert "DDLB102" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/observatory/store.py", src)
+    )
+
+
+def test_raw_env_read_forms(tmp_path):
+    src = DOC + (
+        "import os\n"
+        'a = os.environ.get("DDLB_TPU_FOO")\n'
+        'b = os.getenv("DDLB_TPU_BAR")\n'
+        'c = os.environ["DDLB_TPU_BAZ"]\n'
+        'd = "DDLB_TPU_QUX" in os.environ\n'
+    )
+    findings = run_on(tmp_path, "ddlb_tpu/foo.py", src)
+    assert rule_ids(findings).count("DDLB103") == 4
+
+
+def test_raw_env_read_constant_indirection(tmp_path):
+    src = DOC + (
+        "import os\n"
+        'CHIP_ENV = "DDLB_TPU_CHIP"\n'
+        "x = os.environ.get(CHIP_ENV, '')\n"
+    )
+    assert "DDLB103" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", src))
+
+
+def test_raw_env_write_and_exempt_files_ok(tmp_path):
+    write = DOC + 'import os\nos.environ["DDLB_TPU_FOO"] = "1"\n'
+    assert "DDLB103" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/foo.py", write)
+    )
+    read = DOC + 'import os\nv = os.environ.get("DDLB_TPU_FOO")\n'
+    assert "DDLB103" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/envs.py", read)
+    )
+    assert "DDLB103" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/cli/launch.py", read)
+    )
+
+
+def test_unknown_fault_site_literal(tmp_path):
+    bad = DOC + (
+        "from ddlb_tpu import faults\n"
+        'faults.inject("worker.nonexistent_phase")\n'
+    )
+    assert "DDLB104" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", bad))
+    ok = DOC + (
+        "from ddlb_tpu import faults\n"
+        'faults.inject("worker.setup")\n'
+    )
+    assert "DDLB104" not in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", ok))
+
+
+def test_fault_plan_glob_must_match_a_site(tmp_path):
+    bad = DOC + 'plan = {"site": "zz.*", "kind": "hang"}\n'
+    assert "DDLB104" in rule_ids(run_on(tmp_path, "scripts/foo.py", bad))
+    ok = DOC + 'plan = {"site": "worker.*", "kind": "hang"}\n'
+    assert "DDLB104" not in rule_ids(run_on(tmp_path, "scripts/foo.py", ok))
+
+
+def test_locked_sync_primitive(tmp_path):
+    bad = DOC + (
+        "import multiprocessing as mp\n"
+        'v = mp.Value("d", 0.0)\n'
+        'w = mp.Value("d", 0.0, lock=True)\n'
+    )
+    findings = run_on(tmp_path, "ddlb_tpu/foo.py", bad)
+    assert rule_ids(findings).count("DDLB105") == 2
+    ok = DOC + (
+        "import multiprocessing as mp\n"
+        'v = mp.Value("d", 0.0, lock=False)\n'
+        "other = mp.Value\n"
+    )
+    assert "DDLB105" not in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", ok))
+
+
+def test_unregistered_telemetry_name(tmp_path):
+    bad = DOC + (
+        "from ddlb_tpu import telemetry\n"
+        'with telemetry.span("totally.made_up"):\n    pass\n'
+    )
+    assert "DDLB106" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", bad))
+    ok = DOC + (
+        "from ddlb_tpu import telemetry\n"
+        'with telemetry.span("worker.row"):\n    pass\n'
+        'telemetry.record("runner.retries")\n'
+        "telemetry.span(dynamic_name)\n"  # dynamic: skipped
+        "dynamic_name = 'x'\n"
+    )
+    assert "DDLB106" not in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", ok))
+
+
+def test_silent_swallow(tmp_path):
+    bad = DOC + "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert "DDLB107" in rule_ids(run_on(tmp_path, "ddlb_tpu/foo.py", bad))
+    narrow = DOC + "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+    assert "DDLB107" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/foo.py", narrow)
+    )
+    logged = DOC + (
+        "from ddlb_tpu import telemetry\n"
+        "try:\n    x = 1\nexcept Exception:\n    telemetry.warn('x')\n"
+    )
+    assert "DDLB107" not in rule_ids(
+        run_on(tmp_path, "ddlb_tpu/foo.py", logged)
+    )
+
+
+# ---------------------------------------------------------------------------
+# project rules (DDLB007, DDLB108)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_coverage_fires_on_gap(monkeypatch):
+    from ddlb_tpu.analysis.rules_project import CostModelCoverageRule
+    from ddlb_tpu.perfmodel.cost import FAMILY_COST_MODELS
+
+    ctxs = [core.build_context(REPO / "ddlb_tpu" / "schema.py", root=REPO)]
+    assert list(CostModelCoverageRule().check_project(ctxs)) == []
+    monkeypatch.delitem(FAMILY_COST_MODELS, "tp_columnwise")
+    findings = list(CostModelCoverageRule().check_project(ctxs))
+    assert findings and findings[0].rule == "DDLB007"
+    assert "tp_columnwise" in findings[0].message
+
+
+def test_row_schema_coverage_fires_on_unregistered_column(monkeypatch):
+    from ddlb_tpu.analysis.rules_project import RowSchemaCoverageRule
+    from ddlb_tpu.schema import ROW_COLUMNS
+
+    ctxs = [core.build_context(REPO / "ddlb_tpu" / "schema.py", root=REPO)]
+    assert list(RowSchemaCoverageRule().check_project(ctxs)) == []
+    monkeypatch.delitem(ROW_COLUMNS, "retries")
+    findings = list(RowSchemaCoverageRule().check_project(ctxs))
+    assert findings and all(f.rule == "DDLB108" for f in findings)
+    assert any("'retries'" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# baseline: masking, stale entries, shrink-only updates
+# ---------------------------------------------------------------------------
+
+
+def _print_findings(tmp_path, n=1):
+    body = "".join(f'print("{i}")\n' for i in range(n))
+    return run_on(tmp_path, "ddlb_tpu/foo.py", DOC + body)
+
+
+def test_baseline_masks_known_findings(tmp_path):
+    findings = _print_findings(tmp_path)
+    bl = tmp_path / "baseline.json"
+    assert baseline_mod.update(findings, bl) == []
+    fresh = _print_findings(tmp_path)
+    stale = baseline_mod.apply(fresh, baseline_mod.load(bl), bl)
+    assert stale == []
+    assert all(f.baselined for f in fresh if f.rule == "DDLB004")
+    assert not any(f.counts for f in fresh)
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    findings = _print_findings(tmp_path)
+    bl = tmp_path / "baseline.json"
+    baseline_mod.update(findings, bl)
+    # same offending line, different line NUMBER
+    drifted = run_on(
+        tmp_path, "ddlb_tpu/foo.py", DOC + "x = 1\ny = 2\n" + 'print("0")\n'
+    )
+    baseline_mod.apply(drifted, baseline_mod.load(bl), bl)
+    assert all(f.baselined for f in drifted if f.rule == "DDLB004")
+
+
+def test_stale_baseline_entry_is_an_error(tmp_path):
+    findings = _print_findings(tmp_path)
+    bl = tmp_path / "baseline.json"
+    baseline_mod.update(findings, bl)
+    clean = run_on(tmp_path, "ddlb_tpu/foo.py", DOC + "x = 1\n")
+    stale = baseline_mod.apply(clean, baseline_mod.load(bl), bl)
+    assert len(stale) == 1
+    assert stale[0].rule == baseline_mod.STALE_BASELINE_ID
+    assert stale[0].counts
+
+
+def test_stale_baseline_skipped_for_unanalyzed_files(tmp_path):
+    """A subset sweep (--changed-only) must not report the untouched
+    backlog as stale — only the full sweep can prove an entry dead."""
+    findings = _print_findings(tmp_path)
+    bl = tmp_path / "baseline.json"
+    baseline_mod.update(findings, bl)
+    entry_path = findings[0].path
+    # the baselined file is NOT in the analyzed subset: no staleness
+    stale = baseline_mod.apply(
+        [], baseline_mod.load(bl), bl, analyzed={"some/other.py"}
+    )
+    assert stale == []
+    # the full sweep (analyzed=None) still enforces shrinkage
+    stale = baseline_mod.apply([], baseline_mod.load(bl), bl)
+    assert len(stale) == 1
+    # and a subset that DOES cover the file enforces it too
+    stale = baseline_mod.apply(
+        [], baseline_mod.load(bl), bl, analyzed={entry_path}
+    )
+    assert len(stale) == 1
+
+
+def test_baseline_update_refuses_growth(tmp_path):
+    bl = tmp_path / "baseline.json"
+    baseline_mod.update(_print_findings(tmp_path, n=1), bl)
+    grown = baseline_mod.update(_print_findings(tmp_path, n=2), bl)
+    assert grown  # refused: returns the grown keys, writes nothing
+    assert len(baseline_mod.load(bl)) == 1
+    # explicit override allows it
+    assert baseline_mod.update(
+        _print_findings(tmp_path, n=2), bl, allow_growth=True
+    ) == []
+    assert sum(baseline_mod.load(bl).values()) == 2
+
+
+def test_update_after_fix_shrinks_cleanly(tmp_path):
+    """The documented workflow: fix a baselined site, re-run
+    --update-baseline — the stale DDLB110 meta-finding appended by
+    apply() must neither trip the growth refusal nor be written into
+    the new baseline."""
+    bl = tmp_path / "baseline.json"
+    baseline_mod.update(_print_findings(tmp_path, n=2), bl)
+    # one of the two sites got fixed
+    fixed = _print_findings(tmp_path, n=1)
+    fixed.extend(baseline_mod.apply(fixed, baseline_mod.load(bl), bl))
+    assert any(
+        f.rule == baseline_mod.STALE_BASELINE_ID for f in fixed
+    )
+    assert baseline_mod.update(fixed, bl) == []  # shrink accepted
+    new = baseline_mod.load(bl)
+    assert sum(new.values()) == 1
+    assert not any(
+        rule == baseline_mod.STALE_BASELINE_ID for (rule, _p, _s) in new
+    )
+
+
+def test_project_finding_suppression_outside_analyzed_set(tmp_path):
+    """A ``# ddlb: ignore`` on a project-rule finding's line applies
+    even when that file is not in the analyzed subset (the
+    --changed-only case)."""
+    root = tmp_path
+    writer = root / "ddlb_tpu" / "benchmark.py"
+    writer.parent.mkdir(parents=True)
+    writer.write_text(
+        DOC + 'row = {}\nrow["x"] = 1  # ddlb: ignore[DDLB555]\n'
+    )
+    other = root / "ddlb_tpu" / "other.py"
+    other.write_text(DOC)
+
+    class FakeProjectRule(core.ProjectRule):
+        id = "DDLB555"
+        name = "fake-project-rule"
+
+        def check_project(self, contexts):
+            return [
+                core.Finding(
+                    self.id, "ddlb_tpu/benchmark.py", 3, 1, "fake"
+                )
+            ]
+
+    findings = core.analyze(
+        [other], rules=[FakeProjectRule()], root=root
+    )
+    (f,) = [f for f in findings if f.rule == "DDLB555"]
+    assert f.suppressed and not f.counts
+
+
+def test_repo_baseline_is_current():
+    """The committed baseline matches the tree exactly: no stale entries
+    (shrink enforcement) and nothing new un-baselined. Keyed on content,
+    so this is the 'baseline only ever shrinks' lint."""
+    paths = core.expand_targets(
+        [str(REPO / t) for t in
+         ("ddlb_tpu", "tests", "scripts", "bench.py", "__graft_entry__.py")]
+    )
+    findings = core.analyze(paths, root=REPO)
+    bl_path = REPO / baseline_mod.BASELINE_NAME
+    stale = baseline_mod.apply(findings, baseline_mod.load(bl_path), bl_path)
+    assert stale == [], [s.message for s in stale]
+    leftovers = [output.text_line(f) for f in findings if f.counts]
+    assert leftovers == []
+
+
+# ---------------------------------------------------------------------------
+# output: SARIF validity, JSON, inventory
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_document_shape(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py",
+        DOC + 'print("a")  # ddlb: ignore[DDLB004]\nprint("b")\n',
+    )
+    doc = output.render_sarif(findings)
+    # round-trips as JSON
+    doc = json.loads(json.dumps(doc))
+    assert doc["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in doc["$schema"]
+    (run,) = doc["runs"]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "ddlb-analyze"
+    rule_meta_ids = {r["id"] for r in driver["rules"]}
+    assert {"DDLB101", "DDLB104", "DDLB106", "DDLB004"} <= rule_meta_ids
+    for meta in driver["rules"]:
+        assert meta["shortDescription"]["text"]
+        assert meta["defaultConfiguration"]["level"] in ("error", "warning")
+    results = run["results"]
+    assert results, "findings must appear as results"
+    for res in results:
+        assert res["ruleId"] in rule_meta_ids | {"DDLB100", "DDLB110"}
+        assert res["level"] in ("error", "warning")
+        (loc,) = res["locations"]
+        region = loc["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+    # the suppressed finding carries a SARIF suppressions entry
+    suppressed = [r for r in results if r.get("suppressions")]
+    assert len(suppressed) == 1
+    assert suppressed[0]["suppressions"][0]["kind"] == "inSource"
+
+
+def test_json_output_counts(tmp_path):
+    findings = run_on(
+        tmp_path, "ddlb_tpu/foo.py", DOC + 'print("a")\n'
+    )
+    doc = output.render_json(findings)
+    assert doc["counts"]["errors"] == len(
+        [f for f in findings if f.counts]
+    )
+    assert all(
+        set(f) >= {"rule", "path", "line", "col", "severity", "message"}
+        for f in doc["findings"]
+    )
+
+
+def test_shard_map_inventory_groups_by_family():
+    assert family_of("ddlb_tpu/primitives/ep_alltoall/overlap.py") == (
+        "ep_alltoall"
+    )
+    assert family_of("ddlb_tpu/models/decode.py") == "models/decode"
+    f = core.Finding(
+        "DDLB101", "ddlb_tpu/primitives/tp_rowwise/quantized.py", 1, 1, "m"
+    )
+    f.baselined = True  # inventory must count the baselined backlog
+    lines = output.shard_map_inventory([f])
+    assert lines and "1 legacy site(s)" in lines[0]
+    assert any("tp_rowwise" in line for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# legacy shim + CLI integration
+# ---------------------------------------------------------------------------
+
+
+def test_lint_shim_check_file(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_shim", REPO / "scripts" / "lint.py"
+    )
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    path = tmp_path / "ddlb_tpu" / "foo.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(DOC + 'print("hi")\n')
+    problems = lint.check_file(path)
+    assert any("bare print()" in p for p in problems)
+
+
+@pytest.mark.parametrize("flags", [[], ["--json"], ["--sarif"]])
+def test_analyze_cli_clean_on_repo(flags):
+    """The acceptance gate: the repo analyzes clean (exit 0) in every
+    output mode, and the machine formats parse."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "analyze.py"), *flags],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONDONTWRITEBYTECODE": "1"},
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    if flags == ["--json"]:
+        doc = json.loads(proc.stdout)
+        assert doc["counts"]["errors"] == 0
+        assert doc["counts"]["baselined"] >= 1  # the DDLB101 backlog
+    elif flags == ["--sarif"]:
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+    else:
+        assert "files clean" in proc.stdout
+        assert "shard_map migration inventory" in proc.stdout
+
+
+def test_analyze_cli_changed_only_runs():
+    """--changed-only completes and reports (the pre-commit fast path);
+    exit 0/1 both acceptable mid-edit — 2+ means the mode itself broke."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "scripts" / "analyze.py"),
+            "--changed-only",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert proc.returncode in (0, 1), proc.stdout + proc.stderr
+    assert "analyze" in proc.stdout + proc.stderr
+
+
+def test_analyze_cli_refuses_subset_baseline_update():
+    """--changed-only --update-baseline would silently drop every
+    untouched baseline entry; the CLI must refuse the combination."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "scripts" / "analyze.py"),
+            "--changed-only", "--update-baseline",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "full sweep" in proc.stderr
+
+
+def test_analyze_cli_changed_only_bad_ref_fails_loudly():
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "scripts" / "analyze.py"),
+            "--changed-only", "no-such-ref-zzz",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 2
+    assert "merge base" in proc.stderr
+
+
+def test_analyze_cli_list_rules():
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "scripts" / "analyze.py"),
+            "--list-rules",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0
+    for rule_id in ("DDLB101", "DDLB102", "DDLB103", "DDLB104", "DDLB105",
+                    "DDLB106", "DDLB107", "DDLB108"):
+        assert rule_id in proc.stdout
