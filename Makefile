@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test native bench lint analyze analyze-fast clean
+.PHONY: test native bench lint analyze analyze-fast chaos-launch clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -32,6 +32,13 @@ analyze-fast:
 
 # `make lint` is the historical name — it delegates to the analyzer
 lint: analyze
+
+# multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
+# supervised launcher (detection, attribution, world relaunch, zero rows
+# lost) — the executable acceptance test for the distributed-resilience
+# layer (docs/source/robustness.rst)
+chaos-launch:
+	$(PYTHON) scripts/chaos_launch.py
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

@@ -114,10 +114,16 @@ def family_of(rel: str) -> str:
     return parts[-1].removesuffix(".py")
 
 
-#: the deadline/timeout code paths PR 5 made monotonic end to end
+#: the deadline/timeout code paths PR 5 made monotonic end to end —
+#: plus the distributed-resilience layer (the supervised launcher's
+#: watchdog math and the flight recorder's cross-rank-comparable
+#: stamps, ISSUE 8), which compares instants across processes on one
+#: host and therefore MUST stay on the system-wide monotonic clock
 _DEADLINE_FILES = (
     "ddlb_tpu/pool.py",
     "ddlb_tpu/faults/heartbeat.py",
+    "ddlb_tpu/faults/flightrec.py",
+    "ddlb_tpu/cli/launch.py",
     "ddlb_tpu/benchmark.py",
     "ddlb_tpu/utils/timing.py",
 )

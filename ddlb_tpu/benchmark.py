@@ -48,7 +48,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ddlb_tpu import envs, faults, telemetry
-from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.faults import flightrec, heartbeat
 from ddlb_tpu.observatory import attribution as overlap_attribution
 from ddlb_tpu.observatory import live, store
 from ddlb_tpu.faults.classify import TRANSIENT, classify_error
@@ -182,6 +182,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         # the same phase boundary feeds the live dashboard's "current
         # row" line (a no-op env check unless DDLB_TPU_LIVE is set)
         live.post_event("row_phase", stage=stage, impl=impl_id)
+        # ... and the flight recorder's sequenced record: in a launched
+        # world, per-rank phase marks bracket the collective entries so
+        # a post-mortem shows the last phase every rank reached
+        flightrec.mark("worker.phase", stage=stage, impl=impl_id)
         t0[0] = t1
 
     # compile accounting for the whole measured region (setup, warmup,
@@ -549,7 +553,16 @@ def _max_reduce_across_processes(times_ms: np.ndarray, runtime) -> np.ndarray:
         return times_ms
     from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(times_ms)
+    # the one cross-process collective OUTSIDE the jitted impl programs:
+    # injectable (a plan can wedge/kill a specific rank mid-allgather)
+    # and flight-recorded (a rank that never arrives leaves its peers
+    # in-flight here — named by scripts/flight_report.py)
+    faults.inject("runtime.collective")
+    with flightrec.record(
+        "runtime.collective",
+        payload_bytes=int(times_ms.size * 8 * runtime.num_processes),
+    ):
+        gathered = multihost_utils.process_allgather(times_ms)
     return np.max(gathered, axis=0)
 
 

@@ -1,4 +1,4 @@
-"""Multi-process launcher: the framework's `mpirun` analogue.
+"""Multi-process launcher: the framework's supervised ``mpirun`` analogue.
 
 The reference's L5 entry is ``mpirun -np N python scripts/run_benchmark.py``
 (/root/reference/scripts/run_benchmark.py:10-32, README.md:80-153) — the
@@ -17,26 +17,140 @@ DCN stand-in, runtime.transport_mesh) run without hardware. Example::
         python -m ddlb_tpu.cli.benchmark --primitive tp_columnwise \
         --impl jax_spmd -m 1024 -n 256 -k 512
 
-Child stdout/stderr are drained concurrently (a blocked pipe would
-stall the lock-step collective world) and printed with a ``[p{rank}]``
-prefix once all children exit, rank 0 last so its result table ends the
-output; the launcher's exit code is the first non-zero child code.
+Two modes:
+
+- **Plain** (default): child stdout/stderr are drained concurrently (a
+  blocked pipe would stall the lock-step collective world) and printed
+  with a ``[p{rank}]`` prefix once all children exit, rank 0 last so its
+  result table ends the output. The exit code is the first non-zero
+  child code, with signal deaths mapped to ``128 + signum`` and the
+  signal named in the summary line.
+- **Supervised** (``--supervise``): the distributed-resilience layer.
+  One rank dying or wedging leaves every peer blocked in a collective
+  forever, so the supervisor watches each rank's *signs of life* — its
+  file-based progress beats (``DDLB_TPU_BEAT_FILE``, written by
+  ``faults.heartbeat``) and its streamed output (printed live with the
+  ``[p{rank}]`` prefix) — and on ``--silence-timeout`` seconds of world
+  silence, or an asymmetric rank death, performs a **coordinated
+  abort**: SIGTERM to every rank (the flight recorder's dump-on-signal
+  trigger, ``faults.flightrec``), a bounded grace, then SIGKILL. The
+  per-attempt flight files are joined (``flightrec.analyze_run``) to
+  name the lagging rank and divergence site, the attempt is persisted
+  to ``<run-dir>/attempts.json``, and — when the failure classifies
+  *transient* (``faults.classify``: silence kills, asymmetric deaths,
+  coordinator/bootstrap flaps in the output tail) — the **whole world
+  is relaunched** with backoff on a fresh coordinator port, up to
+  ``--world-retries`` times, with ``DDLB_TPU_WORLD_ATTEMPT`` exported
+  so seeded fault plans can model world-level transient recovery.
+
+Monotonic clocks only in the watchdog math (this file is on the static
+analyzer's wall-clock ban list, DDLB102): beat stamps are CLOCK_MONOTONIC
+on the same host by construction.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
+import json
 import os
+import signal as signal_mod
 import socket
 import subprocess
 import sys
-from typing import List, Optional
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+#: seconds between watchdog polls, and the SIGTERM->SIGKILL grace in
+#: which a wedged rank may still flush its flight-recorder dump
+POLL_S = 0.25
+TERM_GRACE_S = 5.0
+#: after the first non-zero rank death, how long peers get to exit on
+#: their own before the death is called ASYMMETRIC and the world is
+#: aborted — a bad config kills every rank within this window
+#: (symmetric: classify, don't relaunch blindly), while peers wedged in
+#: a collective the dead rank never joins stay alive past it forever
+DEATH_GRACE_S = 2.0
 
 
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _rc_info(rc: Optional[int]) -> tuple:
+    """(mapped exit code, human summary) for one child's returncode —
+    a signal-killed child has a NEGATIVE returncode, which must become
+    a truthful nonzero exit (``128 + signum``, the shell convention)
+    with the signal named, never the raw number."""
+    if rc is None:
+        return 1, "still running"
+    if rc < 0:
+        try:
+            name = signal_mod.Signals(-rc).name
+        except ValueError:
+            name = f"signal {-rc}"
+        return 128 - rc, f"terminated by {name} (exit code {128 - rc})"
+    return rc, f"exit code {rc}"
+
+
+def _child_env(
+    rank: int,
+    processes: int,
+    coordinator: str,
+    devices_per_process: int,
+    slices: int,
+    env: Optional[dict],
+    attempt_dir: Optional[str] = None,
+    attempt: int = 0,
+) -> dict:
+    """One rank's environment: the bootstrap vars every mode sets, the
+    CPU-sim world when requested, and — under supervision — the beat
+    file, flight-recorder dir and world-attempt counter."""
+    child_env = dict(os.environ if env is None else env)
+    child_env.update(
+        {
+            "DDLB_TPU_NUM_PROCESSES": str(processes),
+            "DDLB_TPU_PROCESS_ID": str(rank),
+            "DDLB_TPU_COORD_ADDR": coordinator,
+        }
+    )
+    if devices_per_process:
+        # CPU-sim world: force the cpu platform in every child (the
+        # reference parent also never touches the accelerator,
+        # cli/benchmark.py:126)
+        child_env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+                "DDLB_TPU_SIM_DEVICES": "0",  # flag set directly:
+                "XLA_FLAGS": (
+                    child_env.get("XLA_FLAGS", "")
+                    + f" --xla_force_host_platform_device_count="
+                    f"{devices_per_process}"
+                ).strip(),
+            }
+        )
+    if slices:
+        child_env["DDLB_TPU_SIM_SLICES"] = str(slices)
+    if attempt_dir:
+        child_env.update(
+            {
+                "DDLB_TPU_FLIGHTREC": attempt_dir,
+                "DDLB_TPU_BEAT_FILE": os.path.join(
+                    attempt_dir, f"beat-p{rank}"
+                ),
+                "DDLB_TPU_WORLD_ATTEMPT": str(attempt),
+                # live streaming is a supervision feature: a child whose
+                # stdout sits in a 4 KB block buffer looks silent (and
+                # prints nothing useful) right up to the abort
+                "PYTHONUNBUFFERED": "1",
+            }
+        )
+    return child_env
 
 
 def launch(
@@ -47,43 +161,21 @@ def launch(
     coordinator: Optional[str] = None,
     env: Optional[dict] = None,
 ) -> int:
-    """Fan ``command`` out over ``processes`` local processes; returns the
-    first non-zero child exit code (0 if all succeed)."""
+    """Plain mode: fan ``command`` out over ``processes`` local
+    processes; returns the first non-zero child exit code (0 if all
+    succeed), signal deaths mapped to ``128 + signum``."""
     if processes < 1:
         raise ValueError(f"processes must be >= 1, got {processes}")
     coordinator = coordinator or f"127.0.0.1:{_free_port()}"
     procs = []
     for rank in range(processes):
-        child_env = dict(os.environ if env is None else env)
-        child_env.update(
-            {
-                "DDLB_TPU_NUM_PROCESSES": str(processes),
-                "DDLB_TPU_PROCESS_ID": str(rank),
-                "DDLB_TPU_COORD_ADDR": coordinator,
-            }
-        )
-        if devices_per_process:
-            # CPU-sim world: force the cpu platform in every child (the
-            # reference parent also never touches the accelerator,
-            # cli/benchmark.py:126)
-            child_env.update(
-                {
-                    "JAX_PLATFORMS": "cpu",
-                    "PALLAS_AXON_POOL_IPS": "",
-                    "DDLB_TPU_SIM_DEVICES": "0",  # flag set directly:
-                    "XLA_FLAGS": (
-                        child_env.get("XLA_FLAGS", "")
-                        + f" --xla_force_host_platform_device_count="
-                        f"{devices_per_process}"
-                    ).strip(),
-                }
-            )
-        if slices:
-            child_env["DDLB_TPU_SIM_SLICES"] = str(slices)
         procs.append(
             subprocess.Popen(
                 command,
-                env=child_env,
+                env=_child_env(
+                    rank, processes, coordinator, devices_per_process,
+                    slices, env,
+                ),
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
@@ -93,8 +185,6 @@ def launch(
     # through collectives, so one child blocked on a full 64 KB pipe
     # (rank 0 prints per-row tables) stalls every other rank and a
     # sequential communicate() would deadlock the whole launch.
-    import threading
-
     buffers: List[List[str]] = [[] for _ in range(processes)]
 
     def _drain(rank: int) -> None:
@@ -115,8 +205,332 @@ def launch(
         procs[rank].wait()
         for line in buffers[rank]:
             print(f"[p{rank}] {line}")
-        if procs[rank].returncode and rc == 0:
-            rc = procs[rank].returncode
+        mapped, summary = _rc_info(procs[rank].returncode)
+        if mapped:
+            print(f"[p{rank}] {summary}")
+        if mapped and rc == 0:
+            rc = mapped
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# Supervised mode: cross-rank watchdog + classifier-gated world relaunch
+# ---------------------------------------------------------------------------
+
+
+class _Rank:
+    """One supervised rank: its process, streamed-output bookkeeping,
+    and the beat file the watchdog reads."""
+
+    def __init__(self, rank: int, proc, beat_path: str) -> None:
+        self.rank = rank
+        self.proc = proc
+        self.beat_path = beat_path
+        self.spawned = time.monotonic()
+        #: monotonic stamp of the last streamed output line
+        self.last_output = self.spawned
+        self.tail: collections.deque = collections.deque(maxlen=80)
+
+    def last_sign(self) -> float:
+        """The rank's most recent sign of life: spawn, output, or file
+        beat — the same max-of-signals rule the pool's heartbeat kill
+        policy uses, cross-process."""
+        from ddlb_tpu.faults import heartbeat
+
+        return max(
+            self.spawned,
+            self.last_output,
+            heartbeat.read_file_beat(self.beat_path),
+        )
+
+
+def _stream_output(state: _Rank) -> None:
+    """Live prefixed streaming (the supervised replacement for plain
+    mode's after-exit printing): every child line is printed the moment
+    it arrives — a wedged world's partial output is often the only
+    diagnostic — and counts as a sign of life."""
+    for line in state.proc.stdout:
+        line = line.rstrip("\n")
+        state.last_output = time.monotonic()
+        state.tail.append(line)
+        print(f"[p{state.rank}] {line}", flush=True)
+
+
+def _abort_world(ranks: List[_Rank]) -> None:
+    """Coordinated abort: SIGTERM everyone (the flight recorder's
+    dump-on-signal trigger), one bounded grace for handlers/teardown,
+    then SIGKILL whatever is left. The whole world dies together — a
+    half-aborted world would leave survivors wedged in collectives."""
+    for state in ranks:
+        if state.proc.poll() is None:
+            state.proc.terminate()
+    deadline = time.monotonic() + TERM_GRACE_S
+    for state in ranks:
+        while state.proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+    for state in ranks:
+        if state.proc.poll() is None:
+            state.proc.kill()
+            state.proc.wait()
+
+
+def _watch_world(
+    ranks: List[_Rank], silence_timeout: float
+) -> tuple:
+    """The cross-rank watchdog loop: returns ``(abort_error,
+    culprit_rank, silence_age_s)`` — all None/0 when every rank exited
+    on its own. Two abort triggers:
+
+    - **asymmetric death**: a rank exited non-zero while peers are
+      still in flight — those peers are (or will be) blocked in a
+      collective the dead rank never joins;
+    - **world silence**: a rank produced no beat and no output for
+      ``silence_timeout`` seconds — the wedged-collective signature
+      (every rank's beats stop together; the flight recorder, not the
+      watchdog, says who diverged).
+    """
+    first_death: Optional[float] = None
+    while True:
+        running = [s for s in ranks if s.proc.poll() is None]
+        if not running:
+            return None, None, 0.0
+        failed = [
+            s for s in ranks
+            if s.proc.poll() is not None and s.proc.returncode != 0
+        ]
+        if failed:
+            if first_death is None:
+                first_death = time.monotonic()
+            if time.monotonic() - first_death > DEATH_GRACE_S:
+                state = failed[0]
+                _, summary = _rc_info(state.proc.returncode)
+                return (
+                    f"WorkerDied: rank {state.rank} {summary} with "
+                    f"{len(running)} rank(s) still in flight",
+                    state.rank,
+                    0.0,
+                )
+        if silence_timeout:
+            now = time.monotonic()
+            ages = [(now - s.last_sign(), s) for s in running]
+            age, state = max(ages, key=lambda pair: pair[0])
+            if age > silence_timeout:
+                return (
+                    f"TimeoutError: rank {state.rank} silent for "
+                    f"{age:.1f}s (no beat, no output) — aborting the "
+                    f"world",
+                    state.rank,
+                    age,
+                )
+        time.sleep(POLL_S)
+
+
+def _classify_attempt(
+    abort_error: Optional[str], ranks: List[_Rank]
+) -> tuple:
+    """(error string, error class) for a failed attempt. Abort errors
+    carry their own classifiable shape (TimeoutError / WorkerDied →
+    transient). A symmetric failure (every rank exited, some non-zero,
+    no abort) is classified from the failing ranks' output tails — a
+    coordinator/bootstrap flap leaves its transient signature there,
+    while a bad config's ValueError matches nothing and parks."""
+    from ddlb_tpu.faults.classify import classify_error
+
+    if abort_error:
+        return abort_error, classify_error(abort_error)
+    failed = [s for s in ranks if s.proc.returncode != 0]
+    if not failed:
+        return "", ""
+    state = failed[0]
+    _, summary = _rc_info(state.proc.returncode)
+    error = f"rank {state.rank} {summary}"
+    # classify from each failing rank's FINAL non-empty output line —
+    # the exception line a Python traceback ends with — not the whole
+    # 80-line tail: a broad transient pattern ('coordinator', 'failed
+    # to connect') matching benign earlier text (a logged-and-recovered
+    # reconnect warning, a traceback frame quoting
+    # coordinator_address=...) must not relaunch a world that failed
+    # deterministically
+    tail = "\n".join(
+        next((ln for ln in reversed(s.tail) if ln.strip()), "")
+        for s in failed
+    )
+    return error, classify_error(tail.strip() or error)
+
+
+def _persist_attempts(run_dir: str, records: List[Dict[str, Any]]) -> None:
+    """Atomic write of the world-attempt record (crash-safe: a killed
+    supervisor leaves the previous complete record, never a torn one)."""
+    path = os.path.join(run_dir, "attempts.json")
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(records, f, indent=1, default=str)
+    os.replace(tmp, path)
+
+
+def launch_supervised(
+    command: List[str],
+    processes: int,
+    devices_per_process: int = 0,
+    slices: int = 0,
+    env: Optional[dict] = None,
+    silence_timeout: float = 60.0,
+    world_retries: int = 2,
+    relaunch_backoff_s: float = 1.0,
+    run_dir: Optional[str] = None,
+) -> int:
+    """Supervised mode: launch, watch, abort, attribute, relaunch.
+    Returns 0 when an attempt completes cleanly, else the mapped exit
+    code of the final failed attempt. Every attempt gets its own
+    ``<run_dir>/attempt-N`` flight/beat directory and a line in
+    ``<run_dir>/attempts.json``."""
+    from ddlb_tpu import telemetry
+    from ddlb_tpu.faults import flightrec
+    from ddlb_tpu.faults.classify import TRANSIENT
+    from ddlb_tpu.faults.plan import backoff_delays
+
+    if processes < 1:
+        raise ValueError(f"processes must be >= 1, got {processes}")
+    run_dir = run_dir or tempfile.mkdtemp(prefix="ddlb_launch_")
+    os.makedirs(run_dir, exist_ok=True)
+    delays = backoff_delays(
+        relaunch_backoff_s, world_retries, seed=os.path.basename(run_dir)
+    )
+    records: List[Dict[str, Any]] = []
+    rc = 1
+    for attempt in range(world_retries + 1):
+        attempt_dir = os.path.join(run_dir, f"attempt-{attempt}")
+        os.makedirs(attempt_dir, exist_ok=True)
+        coordinator = f"127.0.0.1:{_free_port()}"
+        print(
+            f"[launcher] attempt {attempt}: {processes} rank(s), "
+            f"coordinator {coordinator}, run dir {attempt_dir}",
+            flush=True,
+        )
+        started = time.monotonic()
+        ranks: List[_Rank] = []
+        for rank in range(processes):
+            proc = subprocess.Popen(
+                command,
+                env=_child_env(
+                    rank, processes, coordinator, devices_per_process,
+                    slices, env, attempt_dir=attempt_dir, attempt=attempt,
+                ),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+            ranks.append(
+                _Rank(rank, proc, os.path.join(attempt_dir, f"beat-p{rank}"))
+            )
+        threads = [
+            threading.Thread(target=_stream_output, args=(s,), daemon=True)
+            for s in ranks
+        ]
+        for t in threads:
+            t.start()
+        telemetry.record("launch.world_attempts")
+        abort_error, culprit, silence_age = _watch_world(
+            ranks, silence_timeout
+        )
+        if abort_error:
+            print(f"[launcher] {abort_error}", flush=True)
+            telemetry.instant(
+                "launch.abort", cat="launch", rank=culprit,
+                error=abort_error[:200],
+            )
+            _abort_world(ranks)
+        for t in threads:
+            t.join(timeout=5.0)
+        error, error_class = _classify_attempt(abort_error, ranks)
+        if error and culprit is None:
+            failed = [
+                s.rank for s in ranks
+                if s.proc.returncode not in (0, None)
+            ]
+            culprit = failed[0] if failed else None
+        report = flightrec.analyze_run(attempt_dir, expected_ranks=processes)
+        if error and report.get("lagging_ranks"):
+            # the flight recorder's sequence join beats the watchdog's
+            # beat-age guess at naming the diverging rank (every rank's
+            # beats stop together once the world wedges in a collective)
+            culprit = report["lagging_ranks"][0]
+        rank_rcs = []
+        rc = 0
+        for state in ranks:
+            mapped, summary = _rc_info(state.proc.returncode)
+            if mapped:
+                print(f"[p{state.rank}] {summary}", flush=True)
+            if mapped and rc == 0:
+                rc = mapped
+            rank_rcs.append(
+                {"rank": state.rank, "returncode": state.proc.returncode,
+                 "exit": mapped}
+            )
+        if culprit is not None:
+            # the culprit's own exit code is the informative one — the
+            # supervisor SIGTERMed the innocent peers itself, and their
+            # 143s would otherwise shadow it in rank order
+            for entry in rank_rcs:
+                if entry["rank"] == culprit and entry["exit"]:
+                    rc = entry["exit"]
+                    break
+        if error and not rc:
+            rc = 1  # an aborted world must never report success
+        records.append(
+            {
+                "attempt": attempt,
+                "outcome": "ok" if not error else "failed",
+                "error": error,
+                "error_class": error_class,
+                "culprit_rank": culprit,
+                "silence_age_s": round(silence_age, 2),
+                "silence_timeout_s": silence_timeout,
+                "duration_s": round(time.monotonic() - started, 2),
+                "coordinator": coordinator,
+                "ranks": rank_rcs,
+                "flight_headline": report.get("headline"),
+                "divergence_site": report.get("divergence_site"),
+            }
+        )
+        _persist_attempts(run_dir, records)
+        if not error:
+            print(
+                f"[launcher] attempt {attempt} completed cleanly "
+                f"({records[-1]['duration_s']}s)",
+                flush=True,
+            )
+            return 0
+        print(
+            f"[launcher] post-mortem: {report.get('headline')}",
+            flush=True,
+        )
+        if error_class != TRANSIENT:
+            print(
+                f"[launcher] failure classified "
+                f"{error_class or 'deterministic'} — not relaunching "
+                f"(a relaunch would re-pay the world for the same answer)",
+                flush=True,
+            )
+            return rc
+        if attempt == world_retries:
+            print(
+                f"[launcher] world retries exhausted "
+                f"({world_retries + 1} attempts)",
+                flush=True,
+            )
+            return rc
+        delay = delays[attempt]
+        print(
+            f"[launcher] transient world failure — relaunching in "
+            f"{delay:.1f}s (attempt {attempt + 1}/{world_retries + 1})",
+            flush=True,
+        )
+        telemetry.instant(
+            "launch.relaunch", cat="launch", attempt=attempt + 1,
+            error_class=error_class,
+        )
+        time.sleep(delay)
     return rc
 
 
@@ -142,7 +556,41 @@ def main(argv=None) -> None:
     parser.add_argument(
         "--coordinator",
         default=None,
-        help="host:port for jax.distributed (default: free local port)",
+        help="host:port for jax.distributed (default: free local port; "
+        "supervised mode always picks a fresh port per attempt)",
+    )
+    parser.add_argument(
+        "--supervise",
+        action="store_true",
+        help="cross-rank watchdog: file beats + live output streaming, "
+        "coordinated abort on silence/asymmetric death, flight-recorder "
+        "post-mortem, classifier-gated world relaunch",
+    )
+    parser.add_argument(
+        "--silence-timeout",
+        type=float,
+        default=60.0,
+        help="supervised: seconds without any beat/output from a rank "
+        "before the world is aborted (0 disables the silence trigger)",
+    )
+    parser.add_argument(
+        "--world-retries",
+        type=int,
+        default=2,
+        help="supervised: transient world failures relaunched up to this "
+        "many times with backoff",
+    )
+    parser.add_argument(
+        "--relaunch-backoff",
+        type=float,
+        default=1.0,
+        help="supervised: base seconds for the relaunch backoff schedule",
+    )
+    parser.add_argument(
+        "--run-dir",
+        default=None,
+        help="supervised: directory for per-attempt flight/beat files and "
+        "attempts.json (default: a fresh temp dir, printed)",
     )
     parser.add_argument(
         "command",
@@ -155,6 +603,19 @@ def main(argv=None) -> None:
         command = command[1:]
     if not command:
         parser.error("no command given (append: -- python -m ...)")
+    if args.supervise:
+        sys.exit(
+            launch_supervised(
+                command,
+                processes=args.processes,
+                devices_per_process=args.devices_per_process,
+                slices=args.slices,
+                silence_timeout=args.silence_timeout,
+                world_retries=args.world_retries,
+                relaunch_backoff_s=args.relaunch_backoff,
+                run_dir=args.run_dir,
+            )
+        )
     sys.exit(
         launch(
             command,

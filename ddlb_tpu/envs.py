@@ -260,6 +260,53 @@ def get_no_native() -> bool:
     return bool(os.environ.get("DDLB_TPU_NO_NATIVE"))
 
 
+def get_flightrec_dir() -> str:
+    """Collective flight-recorder run directory ("" = recording off).
+
+    When set, every process appends sequenced progress entries
+    (collective entries/exits, mesh builds, worker phase marks, pool
+    rows — ``ddlb_tpu.faults.flightrec``) to a per-rank
+    ``flight-p<rank>.jsonl`` under this shared directory, crash-safely
+    (one flushed line per transition, so even a SIGKILLed rank leaves
+    its completed sequence on disk). ``scripts/flight_report.py`` joins
+    the per-rank files to name the lagging rank and the divergence
+    site after a wedged or killed world. The supervised launcher
+    (``cli/launch.py --supervise``) sets it for every child. Follows
+    the DDLB_TPU_* convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_FLIGHTREC", "").strip()
+
+
+def get_beat_file() -> str:
+    """File-based progress-beat path ("" = file beats off).
+
+    When set, ``faults.heartbeat.beat()`` additionally publishes the
+    process's last-beat ``time.monotonic()`` stamp to this file
+    (atomic tmp+rename, throttled) — the cross-PROCESS form of the
+    shared-memory beat channel, readable by a supervisor that did not
+    fork the process (``cli/launch.py --supervise`` points each rank
+    at ``<run_dir>/beat-p<rank>``). CLOCK_MONOTONIC is system-wide on
+    the hosts the fleet runs, so the supervisor compares the stamp
+    against its own monotonic clock. Follows the DDLB_TPU_*
+    convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_BEAT_FILE", "").strip()
+
+
+def get_world_attempt() -> int:
+    """Which world-level launch attempt this process belongs to
+    (default 0 = the first launch).
+
+    The supervised launcher exports the relaunch attempt number to
+    every child; fault-plan rules treat it as a floor on the retry
+    attempt (``fail_attempts`` gating), so a seeded rank-targeted
+    fault with ``fail_attempts: 1`` fires on the first world launch
+    and clears on the supervised relaunch — the world-level
+    transient-recovery shape.
+    """
+    return get_env(("DDLB_TPU_WORLD_ATTEMPT",), 0, int)
+
+
 def get_sim_slice_count() -> int:
     """Simulated TPU slice count for the DCN topology axis (0 = off).
 

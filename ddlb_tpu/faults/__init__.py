@@ -20,19 +20,31 @@ tiers):
   worth a retry; deterministic failures (ValueError, validation
   mismatch) park immediately instead of burning capture windows.
 - ``heartbeat`` (faults.heartbeat): a cheap shared-memory beat channel
-  from subprocess workers, so a slow-but-alive child extends its
-  deadline at every phase boundary while a truly hung one is killed
-  ``worker_timeout`` seconds after its last sign of life.
+  from subprocess workers — extended with **file beats**
+  (``DDLB_TPU_BEAT_FILE``) so a supervisor that merely SPAWNED a rank
+  (the multi-process launcher) can watch it too — so a slow-but-alive
+  child extends its deadline at every phase boundary while a truly
+  hung one is killed ``worker_timeout`` seconds after its last sign of
+  life.
+- ``flightrec`` (faults.flightrec): the collective flight recorder —
+  per-rank sequenced progress entries (collective enter/exit, phase
+  marks, pool rows) appended crash-safely under ``DDLB_TPU_FLIGHTREC``,
+  joined post-mortem by ``analyze_run`` / ``scripts/flight_report.py``
+  to name the lagging rank and the divergence site of a wedged world.
 
 The consumers are ``benchmark.PrimitiveBenchmarkRunner`` (per-row retry
-with exponential backoff + jitter, per-impl quarantine) and
-``scripts/measure_queue.py`` (classifier-aware parking);
-``scripts/chaos_sweep.py`` is the end-to-end demonstration, and
-``docs/source/robustness.rst`` the operator guide.
+with exponential backoff + jitter, per-impl quarantine),
+``scripts/measure_queue.py`` (classifier-aware parking), and the
+supervised launcher ``cli/launch.py --supervise`` (cross-rank watchdog,
+coordinated abort, classifier-gated world relaunch);
+``scripts/chaos_sweep.py`` and ``scripts/chaos_launch.py`` are the
+end-to-end demonstrations, and ``docs/source/robustness.rst`` the
+operator guide.
 """
 
 from __future__ import annotations
 
+from ddlb_tpu.faults import flightrec, heartbeat
 from ddlb_tpu.faults.classify import (
     DETERMINISTIC,
     TRANSIENT,
@@ -63,6 +75,8 @@ __all__ = [
     "classify_error",
     "corrupt",
     "corrupt_row",
+    "flightrec",
+    "heartbeat",
     "inject",
     "load_plan",
     "reset",

@@ -46,6 +46,19 @@ TRANSIENT_PATTERNS = (
     "BrokenPipeError",
     "EOFError",
     "heartbeat",
+    # distributed-bootstrap flaps: a coordinator that was slow to bind,
+    # a rank that raced the rendezvous window, a backend whose client
+    # init timed out — the environment's fault, and exactly what the
+    # supervised launcher's world-level relaunch exists to absorb
+    # (cli/launch.py); a retried bootstrap on a fresh port succeeds
+    "coordinator",
+    "Unable to initialize backend",
+    "Barrier timed out",
+    "failed to connect",
+    # a collective peer dying mid-op (gloo TCP on the CPU-sim DCN
+    # stand-in): the surviving ranks' rows carry this, and a relaunched
+    # world clears it
+    "Connection closed by peer",
 )
 
 

@@ -1,0 +1,447 @@
+"""Collective flight recorder: sequenced progress entries per rank.
+
+When a multi-process world wedges, the operator's question is never
+"did it hang" (the watchdog answers that) but **"which rank, at which
+collective"** — one rank dying or stalling leaves every peer blocked in
+the next collective with nothing pointing back at the culprit. This
+module answers it with a black-box flight recorder: every process keeps
+a bounded in-memory ring of **sequenced progress entries** — site name,
+mesh axes, payload bytes, monotonic start/end — and, when
+``DDLB_TPU_FLIGHTREC`` names a shared run directory, appends one
+flushed JSON line per transition to a per-rank
+``flight-p<rank>.jsonl``. In an SPMD world every rank executes the same
+sequence of sites, so the per-rank sequence numbers are directly
+comparable: the rank whose last *completed* sequence is lowest is the
+lagging rank, and the site its peers are stuck *inside* is the
+divergence point. ``analyze_run`` (CLI: ``scripts/flight_report.py``)
+computes exactly that join.
+
+Crash-safety contract, each piece load-bearing:
+
+- **Begin lines land before the work**: an entry's ``B`` line is
+  appended and flushed *before* the recorded region runs, so a rank
+  SIGKILLed (or wedged forever) mid-collective still shows the
+  collective it entered — the one fact a post-mortem needs most.
+- **Append-only, one line per transition**: no rewrite step exists
+  that a crash could corrupt; a torn final line is skipped by the
+  reader.
+- **Dump on signal / deadline**: ``configure`` installs SIGTERM/SIGUSR1
+  handlers (main thread only) that append a ``D`` line carrying the
+  dump reason and any in-flight entries, then — for SIGTERM — restore
+  the default disposition and re-raise so the exit status still says
+  "terminated". The supervised launcher's coordinated abort sends
+  SIGTERM first for precisely this reason; its silence deadline is the
+  "dump-on-deadline" trigger.
+- **Zero overhead unset**: the fast path is one cached ``is None``
+  check, same contract as the fault plan and the live stream.
+
+Monotonic clocks only (this module is on the static analyzer's
+wall-clock ban list, DDLB102): entries are compared across ranks on
+one host, where CLOCK_MONOTONIC is system-wide.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ddlb_tpu import envs, telemetry
+
+from contextlib import contextmanager
+
+#: completed entries kept in memory for the dump summary (the file gets
+#: every transition regardless; the ring only bounds process memory)
+RING_SIZE = 512
+
+_UNSET = object()
+
+_lock = threading.Lock()
+#: None = disabled; a dict = active recorder state
+_state: Any = _UNSET
+
+
+def _resolve_state() -> Optional[Dict[str, Any]]:
+    """Build (once) the recorder state from the environment: the
+    per-rank file handle, the sequence counter, the ring, and the
+    signal handlers. Returns None (cached) when the knob is unset."""
+    global _state
+    with _lock:
+        if _state is not _UNSET:
+            return _state
+        run_dir = envs.get_flightrec_dir()
+        if not run_dir:
+            _state = None
+            return None
+        rank = envs.get_process_id()
+        path = os.path.join(run_dir, f"flight-p{rank}.jsonl")
+        try:
+            os.makedirs(run_dir, exist_ok=True)
+            fh = open(path, "a", encoding="utf-8")
+        except OSError as exc:
+            telemetry.warn(
+                f"flight recorder disabled: cannot open {path} ({exc})"
+            )
+            _state = None
+            return None
+        _state = {
+            "fh": fh,
+            "path": path,
+            "rank": rank,
+            "pid": os.getpid(),
+            "seq": 0,
+            "ring": collections.deque(maxlen=RING_SIZE),
+            #: thread ident -> the B-entry dict currently in flight
+            "inflight": {},
+        }
+        _install_handlers()
+        atexit.register(_atexit_dump)
+        return _state
+
+
+def reset() -> None:
+    """Drop the cached recorder state (test helper; the next record
+    re-reads the environment). Does not uninstall signal handlers."""
+    global _state
+    with _lock:
+        state = _state
+        if isinstance(state, dict):
+            try:
+                state["fh"].close()
+            except OSError:
+                pass  # already closed; nothing left to release
+        _state = _UNSET
+
+
+def enabled() -> bool:
+    """True when a run directory is configured (resolving it on first
+    call)."""
+    state = _state
+    if state is _UNSET:
+        state = _resolve_state()
+    return state is not None
+
+
+def _emit(state: Dict[str, Any], line: Dict[str, Any]) -> None:
+    """Append + flush one transition line (crash-safe unit)."""
+    global _state
+    try:
+        state["fh"].write(json.dumps(line, default=str) + "\n")
+        state["fh"].flush()
+    except RuntimeError:
+        # reentrant call into the buffered writer: a signal-handler
+        # dump landed while the main thread was mid-_emit. Drop this
+        # one line — the incremental B/E record already covers it —
+        # and keep the recorder (and the signal handler's control
+        # flow) intact rather than letting CPython's reentrancy
+        # RuntimeError escape into arbitrary main-thread code.
+        return
+    except (OSError, ValueError) as exc:
+        telemetry.warn(f"flight recorder write failed ({exc}); disabling")
+        _state = None
+
+
+@contextmanager
+def record(
+    site: str, axes: str = "", payload_bytes: int = 0, **ctx: Any
+):
+    """One sequenced progress entry around a collective (or any other
+    lock-step region): the ``B`` line is flushed BEFORE the body runs
+    (a rank killed inside still shows where), the ``E`` line after.
+    No-op (one cached check) when recording is off."""
+    state = _state
+    if state is _UNSET:
+        state = _resolve_state()
+    if state is None:
+        yield
+        return
+    with _lock:
+        state["seq"] += 1
+        seq = state["seq"]
+    entry = {
+        "seq": seq,
+        "ph": "B",
+        "site": site,
+        "t": time.monotonic(),
+        "pid": state["pid"],
+        "rank": state["rank"],
+    }
+    if axes:
+        entry["axes"] = axes
+    if payload_bytes:
+        entry["bytes"] = int(payload_bytes)
+    for key, value in ctx.items():
+        if value is not None:
+            entry[key] = value
+    ident = threading.get_ident()
+    state["inflight"][ident] = entry
+    _emit(state, entry)
+    try:
+        yield
+    finally:
+        state["inflight"].pop(ident, None)
+        end = {
+            "seq": seq,
+            "ph": "E",
+            "site": site,
+            "t": time.monotonic(),
+            "pid": state["pid"],
+            "rank": state["rank"],
+        }
+        state["ring"].append({**entry, "t_end": end["t"]})
+        _emit(state, end)
+
+
+def mark(site: str, **ctx: Any) -> None:
+    """One instantaneous sequenced entry (phase marks, pool rows) —
+    counts as completed immediately."""
+    state = _state
+    if state is _UNSET:
+        state = _resolve_state()
+    if state is None:
+        return
+    with _lock:
+        state["seq"] += 1
+        seq = state["seq"]
+    entry = {
+        "seq": seq,
+        "ph": "I",
+        "site": site,
+        "t": time.monotonic(),
+        "pid": state["pid"],
+        "rank": state["rank"],
+    }
+    for key, value in ctx.items():
+        if value is not None:
+            entry[key] = value
+    state["ring"].append(dict(entry))
+    _emit(state, entry)
+
+
+def dump(reason: str) -> None:
+    """Append a dump marker carrying the reason, the last completed
+    sequence, and every in-flight entry — the dump-on-signal /
+    dump-on-deadline record. Safe to call from a signal handler (append
+    + flush only; no locks beyond the emit)."""
+    state = _state
+    if not isinstance(state, dict):
+        return
+    _emit(
+        state,
+        {
+            "ph": "D",
+            "reason": reason,
+            "t": time.monotonic(),
+            "pid": state["pid"],
+            "rank": state["rank"],
+            "last_seq": state["seq"],
+            "inflight": [
+                {"seq": e["seq"], "site": e.get("site")}
+                for e in state["inflight"].values()
+            ],
+        },
+    )
+
+
+def _atexit_dump() -> None:
+    dump("exit")
+
+
+def _install_handlers() -> None:
+    """SIGTERM/SIGUSR1 dump handlers (main thread only — installing
+    from a worker thread raises, in which case the atexit dump and the
+    incremental lines still cover the record)."""
+
+    def _on_usr1(signum, frame):
+        dump("SIGUSR1")
+
+    def _on_term(signum, frame):
+        dump("SIGTERM")
+        # restore default and re-raise so the exit status still says
+        # "terminated by SIGTERM" to whoever is supervising
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    try:
+        signal.signal(signal.SIGUSR1, _on_usr1)
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        telemetry.log(
+            "flight recorder: not on the main thread; signal-dump "
+            "handlers not installed (incremental lines still recorded)"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Post-mortem attribution (the scripts/flight_report.py engine)
+# ---------------------------------------------------------------------------
+
+
+def _read_rank_file(path: str) -> List[Dict[str, Any]]:
+    """Parse one per-rank JSONL file, skipping torn/corrupt lines."""
+    lines: List[Dict[str, Any]] = []
+    try:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            data = f.read()
+    except OSError:
+        return lines
+    for raw in data.splitlines():
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            event = json.loads(raw)
+        except ValueError:
+            continue  # torn final line mid-append
+        if isinstance(event, dict) and "ph" in event:
+            lines.append(event)
+    return lines
+
+
+def _rank_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold one rank's transitions into its progress summary, using the
+    pid stream with the most entries (a rank's main process; pool
+    children share the file but run their own sequence)."""
+    by_pid: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_pid.setdefault(e.get("pid"), []).append(e)
+    if not by_pid:
+        return {
+            "last_completed_seq": 0, "inflight": [], "entries": 0,
+            "dumps": [], "pid": None, "by_seq": {},
+        }
+    pid, stream = max(by_pid.items(), key=lambda kv: len(kv[1]))
+    begun: Dict[int, Dict[str, Any]] = {}
+    by_seq: Dict[int, str] = {}
+    completed = 0
+    dumps: List[str] = []
+    for e in stream:
+        ph = e.get("ph")
+        if ph == "B":
+            begun[int(e.get("seq", 0))] = e
+            by_seq[int(e.get("seq", 0))] = str(e.get("site", ""))
+        elif ph == "E":
+            begun.pop(int(e.get("seq", 0)), None)
+            completed = max(completed, int(e.get("seq", 0)))
+        elif ph == "I":
+            completed = max(completed, int(e.get("seq", 0)))
+            by_seq[int(e.get("seq", 0))] = str(e.get("site", ""))
+        elif ph == "D":
+            dumps.append(str(e.get("reason", "")))
+    inflight = [
+        {"seq": seq, "site": entry.get("site")}
+        for seq, entry in sorted(begun.items())
+    ]
+    # progress orders ranks for the lagging computation: BEGINNING an
+    # entry is progress past everything completed (the rank ARRIVED at
+    # the collective) but not completion of it — so a rank wedged in
+    # seq N outranks a peer that never reached N, and two ranks wedged
+    # in the same collective tie
+    progress = float(completed)
+    if begun:
+        progress = max(progress, max(begun) - 0.5)
+    return {
+        "last_completed_seq": completed,
+        "inflight": inflight,
+        "entries": len(stream),
+        "dumps": dumps,
+        "pid": pid,
+        "by_seq": by_seq,
+        "progress": progress,
+    }
+
+
+def analyze_run(
+    run_dir: str, expected_ranks: Optional[int] = None
+) -> Dict[str, Any]:
+    """Join the per-rank flight files under ``run_dir``: the highest
+    common completed sequence, the lagging rank(s), and the divergence
+    site. Returns a plain-data report (``scripts/flight_report.py``
+    renders it; the supervised launcher prints its headline after a
+    coordinated abort)."""
+    ranks: Dict[int, Dict[str, Any]] = {}
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        names = []
+    for name in names:
+        if not (name.startswith("flight-p") and name.endswith(".jsonl")):
+            continue
+        try:
+            rank = int(name[len("flight-p"):-len(".jsonl")])
+        except ValueError:
+            continue
+        ranks[rank] = _rank_summary(
+            _read_rank_file(os.path.join(run_dir, name))
+        )
+    missing: List[int] = []
+    if expected_ranks:
+        missing = [r for r in range(expected_ranks) if r not in ranks]
+    report: Dict[str, Any] = {
+        "run_dir": run_dir,
+        "ranks": ranks,
+        "missing_ranks": missing,
+    }
+    if not ranks:
+        report["headline"] = f"no flight files under {run_dir}"
+        return report
+    common = min(s["last_completed_seq"] for s in ranks.values())
+    floor = min(s["progress"] for s in ranks.values())
+    ahead = [r for r, s in ranks.items() if s["progress"] > floor]
+    lagging = sorted(
+        r for r, s in ranks.items() if s["progress"] == floor
+    )
+    report["common_seq"] = common
+    # every rank at the same completed seq is not "lagging" — the world
+    # diverged inside one collective (or finished cleanly)
+    report["lagging_ranks"] = lagging if ahead else []
+    divergence = None
+    for pool in (lagging if ahead else []), sorted(ahead), sorted(ranks):
+        for r in pool:
+            if ranks[r]["inflight"]:
+                divergence = ranks[r]["inflight"][-1]["site"]
+                break
+        if divergence:
+            break
+    if divergence is None and ahead:
+        # nobody is stuck (peers may ERROR through a dead-peer
+        # collective rather than wedge in it): the divergence point is
+        # then the first entry an ahead rank ran past the common seq —
+        # the thing the lagging rank never arrived at
+        for r in sorted(ahead):
+            divergence = ranks[r]["by_seq"].get(common + 1)
+            if divergence:
+                break
+    for s in ranks.values():
+        del s["by_seq"]  # per-entry detail: report stays summary-sized
+    report["divergence_site"] = divergence
+    stuck = sorted(r for r, s in ranks.items() if s["inflight"])
+    if missing:
+        report["headline"] = (
+            f"rank(s) {missing} left no flight file (killed before "
+            f"recording anything) — peers stuck"
+            + (f" in '{divergence}'" if divergence else "")
+        )
+    elif ahead and lagging:
+        who = lagging[0] if len(lagging) == 1 else lagging
+        top = max(s["last_completed_seq"] for s in ranks.values())
+        suffix = f" — diverged at '{divergence}'" if divergence else ""
+        report["headline"] = (
+            f"rank {who} lagging at seq {common} while rank(s) "
+            f"{sorted(ahead)} reached {top}{suffix}"
+        )
+    elif stuck:
+        report["headline"] = (
+            f"all ranks at seq {common}, in flight in '{divergence}' — "
+            f"the collective itself wedged"
+        )
+    else:
+        report["headline"] = (
+            f"all ranks completed through seq {common}; no divergence"
+        )
+    return report

@@ -26,14 +26,38 @@ The ``Value`` is created with ``lock=False``: beats are single aligned
 orphan the lock and deadlock the parent's next read — the exact
 unbounded-hang class this channel exists to eliminate. The no-channel
 fast path (every in-process run) is one ``is None`` check.
+
+**File beats** extend the channel beyond shared memory: when
+``DDLB_TPU_BEAT_FILE`` names a path, ``beat()`` additionally publishes
+the stamp to that file (atomic tmp+rename so a reader never sees a torn
+write; throttled to one write per ``FILE_BEAT_INTERVAL_S`` so the
+per-iteration beats of a timing loop cost at most ~10 syscall bursts a
+second). A shared-memory ``Value`` requires the supervisor to have
+FORKED the worker; the file form is what lets the multi-process
+launcher (``cli/launch.py --supervise``) watch ranks it merely
+spawned — same stamp, same monotonic clock domain (same host by
+construction), read with ``read_file_beat``.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Optional
 
+from ddlb_tpu import envs
+
+#: minimum seconds between file-beat writes (shared-memory beats are
+#: never throttled — they are one aligned store)
+FILE_BEAT_INTERVAL_S = 0.1
+
+_UNSET = object()
+
 _channel: Optional[Any] = None
+#: resolved DDLB_TPU_BEAT_FILE path (None = disabled), lazy like the
+#: fault plan so any process that beats self-configures from its env
+_file: Any = _UNSET
+_file_last_write = 0.0
 
 
 def new_channel(ctx: Any) -> Any:
@@ -54,10 +78,59 @@ def set_channel(channel: Any) -> None:
 
 
 def beat() -> None:
-    """Record a liveness beat (no-op without a channel)."""
+    """Record a liveness beat (no-op without a channel or beat file)."""
+    now = time.monotonic()
     channel = _channel
     if channel is not None:
-        channel.value = time.monotonic()
+        channel.value = now
+    path = _file
+    if path is _UNSET:
+        path = _resolve_file()
+    if path is not None:
+        _write_file_beat(path, now)
+
+
+def reset_file() -> None:
+    """Re-read ``DDLB_TPU_BEAT_FILE`` on the next beat (test helper)."""
+    global _file, _file_last_write
+    _file = _UNSET
+    _file_last_write = 0.0
+
+
+def _resolve_file() -> Optional[str]:
+    global _file
+    _file = envs.get_beat_file() or None
+    return _file
+
+
+def _write_file_beat(path: str, now: float) -> None:
+    """Publish ``now`` to the beat file: throttled, atomic (tmp +
+    rename — a supervisor's read never sees a torn stamp), and
+    per-pid tmp names so two processes of one rank (runner + pool
+    child) can share a file, last writer winning."""
+    global _file, _file_last_write
+    if now - _file_last_write < FILE_BEAT_INTERVAL_S and _file_last_write:
+        return
+    _file_last_write = now
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(f"{now:.6f}\n")
+        os.replace(tmp, path)
+    except OSError:
+        # a vanished run dir must never crash a beating worker; the
+        # supervisor sees the stamp go stale, which is the truth
+        _file = None
+
+
+def read_file_beat(path: str) -> float:
+    """The last published file beat as ``time.monotonic()`` seconds
+    (0.0 = never beat / unreadable / torn)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return float(f.read().strip() or 0.0)
+    except (OSError, ValueError):
+        return 0.0
 
 
 def last_beat(channel: Any) -> float:

@@ -26,6 +26,12 @@ Rule fields (all optional except ``site`` and ``kind``):
   ignores it);
 - ``match``: substring filters on the active scope's context, e.g.
   ``{"impl": "overlap"}`` / ``{"primitive": "tp_"}``;
+- ``ranks``: list of process ids the rule applies to (default: every
+  rank). A multi-process chaos plan is shared by the whole world
+  (``DDLB_TPU_FAULT_PLAN`` is inherited), so ``"ranks": [1]`` is what
+  lets one seeded plan kill/hang exactly rank 1 mid-collective while
+  its peers run clean — the rank-targeted battery of
+  ``scripts/chaos_launch.py``;
 - ``probability``: firing probability per eligible call (default 1.0),
   decided by a **deterministic stream** seeded from
   ``(plan seed, site, call index)`` — same seed, same injections, in
@@ -35,7 +41,11 @@ Rule fields (all optional except ``site`` and ``kind``):
 - ``fail_attempts``: fire only while the row's retry attempt (from the
   active ``scope``) is below this (default 1: the first attempt faults,
   the retry runs clean — the transient-recovery shape). Set it high to
-  model a deterministic, never-recovering fault;
+  model a deterministic, never-recovering fault. The supervised
+  launcher's world relaunch counter (``DDLB_TPU_WORLD_ATTEMPT``) acts
+  as a floor on the attempt, so a world-killing fault with the default
+  gate fires on the first launch and clears on the relaunch — the
+  world-level transient-recovery shape;
 - ``duration_s`` / ``exit_code``: kind parameters.
 
 Determinism contract: firing depends only on (plan seed, site name,
@@ -77,6 +87,8 @@ SITES: Dict[str, str] = {
     "worker.result": "result-array corruption before validation",
     "runtime.mesh": "Runtime mesh construction",
     "runtime.barrier": "Runtime cross-process barrier",
+    "runtime.collective": "cross-process result collective (timing MAX-reduce)",
+    "launch.child": "launched-world child bootstrap (Runtime init, pre-connect)",
     "subprocess.entry": "pool child dispatch-loop row entry",
     "subprocess.result": "row dict corruption before posting to parent",
 }
@@ -114,6 +126,9 @@ class FaultRule:
         ):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         self.match = {str(k): str(v) for k, v in spec.get("match", {}).items()}
+        self.ranks = spec.get("ranks")
+        if self.ranks is not None:
+            self.ranks = [int(r) for r in self.ranks]
         self.probability = float(spec.get("probability", 1.0))
         self.at = spec.get("at")
         if self.at is not None:
@@ -124,6 +139,8 @@ class FaultRule:
 
     def matches(self, site: str, context: Dict[str, str]) -> bool:
         if not fnmatch.fnmatchcase(site, self.site):
+            return False
+        if self.ranks is not None and envs.get_process_id() not in self.ranks:
             return False
         for key, needle in self.match.items():
             if needle not in context.get(key, ""):
@@ -313,10 +330,13 @@ def _resolve(site: str, context: Dict[str, Any], kinds: tuple, fire=True):
     for key, value in context.items():
         if value is not None:
             ctx[key] = str(value)
-    rule = plan.pick(
-        site, _next_count(site), ctx, frame.attempt if frame else 0,
-        kinds=kinds,
+    # the world-relaunch counter floors the attempt: a fresh child of a
+    # relaunched world has scope attempt 0, but its fault-plan gating
+    # must see "this world already failed once" (fail_attempts)
+    attempt = max(
+        frame.attempt if frame else 0, envs.get_world_attempt()
     )
+    rule = plan.pick(site, _next_count(site), ctx, attempt, kinds=kinds)
     if rule is not None and fire:
         _fired(site, rule)
     return rule

@@ -61,7 +61,7 @@ import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from ddlb_tpu import envs, faults, telemetry
-from ddlb_tpu.faults import heartbeat
+from ddlb_tpu.faults import flightrec, heartbeat
 from ddlb_tpu.observatory import live
 
 #: env vars that are baked into a worker at spawn time; a change in any
@@ -307,6 +307,13 @@ def run_one_row(
 
     worker = pool.lease(pool_signature())
     reused = worker.rows_run > 0
+    # the flight recorder's pool-row entry: in a launched world the
+    # parent's sequence shows which row was in flight when a rank
+    # wedged, next to the child's own phase marks in the same rank file
+    flightrec.mark(
+        "pool.row", impl=config.get("impl_id"),
+        worker=getattr(worker.proc, "pid", None), reused=reused,
+    )
     outcome = worker.run_row(
         config, prefetch=prefetch, hard_timeout=hard_timeout
     )

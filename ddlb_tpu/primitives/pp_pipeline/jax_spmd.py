@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class JaxSPMDPPPipeline(PPPipeline):
@@ -58,15 +59,24 @@ class JaxSPMDPPPipeline(PPPipeline):
         # finished chunk still needs d - 2 more hops to round the ring
         ticks = max(mb + d - 1, mb + 2 * d - 3)
 
+        # on-chip pipeline state is held in float32: XLA CPU's bf16
+        # float-normalization makes the unrolled drain-ring's
+        # where/dynamic_update_slice chains pathologically slow to
+        # compile (minutes for microbatches >= 2, vs ~1 s here). Wire
+        # payloads still cross every ppermute in the benchmark dtype,
+        # so the measured traffic and the dt precision of each stage
+        # handoff are unchanged.
+        acc = jnp.float32
+
         def step(a, w_loc):
             w = w_loc[0]
             p = jax.lax.axis_index("tp")
             src = d - 1                     # outputs are born at the last stage
             dist = (p - src) % d            # downstream hops from the source
-            buf = jnp.zeros((rows, self.k), dt)   # activation from the left
-            obuf = jnp.zeros((rows, self.n), dt)  # output chunk in transit
-            coll = jnp.zeros((mb, rows, self.n), dt)
-            y = jnp.zeros((rows, self.n), dt)
+            buf = jnp.zeros((rows, self.k), acc)   # activation from the left
+            obuf = jnp.zeros((rows, self.n), acc)  # output chunk in transit
+            coll = jnp.zeros((mb, rows, self.n), acc)
+            y = jnp.zeros((rows, self.n), acc)
             for t in range(ticks):
                 if t < mb + d - 1:
                     if t < mb:
@@ -74,13 +84,13 @@ class JaxSPMDPPPipeline(PPPipeline):
                         # consumes the activation that just hopped in
                         inject = jax.lax.dynamic_slice_in_dim(
                             a, t * rows, rows, axis=0
-                        )
+                        ).astype(acc)
                         x_in = jnp.where(p == 0, inject, buf)
                     else:
                         x_in = buf
                     y = jnp.matmul(
-                        x_in, w, preferred_element_type=jnp.float32
-                    ).astype(dt)
+                        x_in.astype(dt), w, preferred_element_type=jnp.float32
+                    )
                 fin = t - (d - 1)  # microbatch finishing at the last stage
                 if 0 <= fin < mb:
                     upd = jax.lax.dynamic_update_slice(
@@ -95,7 +105,9 @@ class JaxSPMDPPPipeline(PPPipeline):
                     # later microbatch index at the receivers)
                     send_o = jnp.where(p == src, jnp.zeros_like(obuf), obuf)
                 if d > 1:
-                    obuf = jax.lax.ppermute(send_o, "tp", perm=fwd)
+                    obuf = jax.lax.ppermute(
+                        send_o.astype(dt), "tp", perm=fwd
+                    ).astype(acc)
                     # chunk sent by the source at tick T carries microbatch
                     # T - (d-1) and reaches dist h at the end of tick
                     # T + h - 1, hence the arriving index:
@@ -107,11 +119,13 @@ class JaxSPMDPPPipeline(PPPipeline):
                         (p != src) & (idx_a >= 0) & (idx_a < mb), upd, coll
                     )
                     if t + 1 < mb + d - 1:
-                        buf = jax.lax.ppermute(y, "tp", perm=fwd)
-            return coll.reshape(self.m, self.n)
+                        buf = jax.lax.ppermute(
+                            y.astype(dt), "tp", perm=fwd
+                        ).astype(acc)
+            return coll.reshape(self.m, self.n).astype(dt)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, None), P("tp", None, None)),

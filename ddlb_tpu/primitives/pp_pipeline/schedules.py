@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu import telemetry
 from ddlb_tpu.primitives.base import jnp_dtype, validation_atol
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.utils.pipeline_schedule import (
     KIND_BWD,
     KIND_FWD,
@@ -218,7 +219,7 @@ class SchedulePPPipeline(PPPipeline):
             return y_full.reshape(self.m, self.n), dw
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, None), P("tp", None, None)),

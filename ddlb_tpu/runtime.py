@@ -28,6 +28,7 @@ import time
 from typing import Optional, Sequence, Tuple
 
 from ddlb_tpu import envs, faults, telemetry
+from ddlb_tpu.faults import flightrec
 
 _SIM_FLAG = "--xla_force_host_platform_device_count"
 
@@ -88,6 +89,28 @@ def configure_compile_cache() -> Optional[str]:
     return path
 
 
+def distributed_initialized() -> bool:
+    """Whether ``jax.distributed`` is already connected — version
+    bridge: ``jax.distributed.is_initialized`` arrived after the 0.4.x
+    line the relay fleet runs, where the only signal is the private
+    global client (absent/None = not initialized). Without this shim a
+    launched multi-process world crashes at bootstrap on old jax
+    instead of forming the joint mesh."""
+    import jax
+
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    try:
+        from jax._src.distributed import global_state
+
+        return getattr(global_state, "client", None) is not None
+    except ImportError:
+        # no such layout: nothing to ask, assume uninitialized (the
+        # initialize call itself raises if double-connected)
+        return False
+
+
 def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` where available, the pre-0.5 experimental entry
     point otherwise — so the runtime's own collectives (barrier) and the
@@ -142,12 +165,39 @@ class Runtime:
         self.process_id = envs.get_process_id()
         self.num_processes = envs.get_num_processes()
         self._distributed = False
-        if self.num_processes > 1 and not jax.distributed.is_initialized():
-            jax.distributed.initialize(
-                coordinator_address=envs.get_coordinator_address(),
-                num_processes=self.num_processes,
-                process_id=self.process_id,
-            )
+        if self.num_processes > 1:
+            # a multi-process CPU world needs a real cross-process
+            # collectives backend: the CPU client's default ('none')
+            # makes every multiprocess computation fail with
+            # INVALID_ARGUMENT, so the launched CPU-sim worlds (the DCN
+            # stand-in, test_multiprocess, chaos_launch) would form a
+            # mesh they can never compute on. Harmless on TPU (the flag
+            # only configures the CPU client); respected if the
+            # operator already chose an implementation.
+            if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo"
+                    )
+                except (AttributeError, KeyError, ValueError):
+                    pass  # a jax without the flag: nothing to configure
+        # launched-world bootstrap injection site: a fault here models a
+        # rank that died/hung BEFORE the distributed rendezvous — the
+        # flapped-bootstrap class the supervised launcher's world-level
+        # relaunch must absorb (classified transient, faults/classify)
+        faults.inject("launch.child")
+        if self.num_processes > 1 and not distributed_initialized():
+            # flight-recorded as a sequenced entry: a rank wedged in the
+            # rendezvous shows "runtime.init begun, never completed"
+            # while its peers' entries say whether they even got here
+            with flightrec.record(
+                "runtime.init", processes=self.num_processes
+            ):
+                jax.distributed.initialize(
+                    coordinator_address=envs.get_coordinator_address(),
+                    num_processes=self.num_processes,
+                    process_id=self.process_id,
+                )
             self._distributed = True
 
         #: (jitted psum, operand) built lazily by the first barrier();
@@ -249,6 +299,8 @@ class Runtime:
             raise ValueError("shape required for multi-axis meshes")
         with telemetry.span(
             "runtime.mesh_build", cat="runtime", axes=",".join(axis_names)
+        ), flightrec.record(
+            "runtime.mesh_build", axes=",".join(axis_names)
         ):
             return jax.make_mesh(
                 shape, tuple(axis_names), devices=self.devices
@@ -273,6 +325,13 @@ class Runtime:
 
         import jax
 
+        # the DCN stand-in's construction is a flight-recorder entry:
+        # hierarchical/multi-pod scenarios diverge here first when a
+        # rank's topology view disagrees with its peers'
+        flightrec.mark(
+            "runtime.transport_mesh", transport=transport,
+            slices=self.num_slices,
+        )
         if transport not in ("ici", "dcn"):
             raise ValueError(f"transport must be 'ici' or 'dcn', got {transport!r}")
         if transport == "dcn" and self.num_slices == 1:
@@ -357,7 +416,15 @@ class Runtime:
         # wedged transport mid-sweep (e.g. hang = a peer that never
         # arrives; the subprocess parent's heartbeat kill recovers it)
         faults.inject("runtime.barrier")
-        with telemetry.span("runtime.barrier", cat="barrier"):
+        # flight-recorded AFTER the injection site: a rank the plan
+        # hangs/kills here never begins the entry, so the post-mortem
+        # join shows it lagging while its peers sit in-flight in the
+        # barrier — the attribution scripts/chaos_launch.py asserts
+        with telemetry.span("runtime.barrier", cat="barrier"), \
+                flightrec.record(
+                    "runtime.barrier", axes="_barrier",
+                    payload_bytes=4 * self.num_devices,
+                ):
             if self._barrier_call is None:
                 # built once per process: a fresh closure would re-trace
                 # on every barrier, and its jit/compile cost would land

@@ -49,6 +49,8 @@ SPAN_NAMES: Dict[str, str] = {
 #: zero-duration markers (``telemetry.instant``)
 INSTANT_NAMES: Dict[str, str] = {
     "fault.inject": "a fault rule fired at an injection site",
+    "launch.abort": "supervised launcher aborted the world (silence/death)",
+    "launch.relaunch": "supervised launcher relaunching a transient-failed world",
     "log": "rank-tagged log line mirrored into the trace",
     "pool.reuse": "a row dispatched onto an already-warm pool worker",
     "queue.parked": "measure_queue parked a row (deterministic failure)",
@@ -66,6 +68,7 @@ METRIC_NAMES: Dict[str, str] = {
     "compile_ahead.skipped": "prefetch compiles skipped (cache hit)",
     "fault.injected": "fault rules fired",
     "hbm_high_water_bytes": "device memory high-water mark",
+    "launch.world_attempts": "supervised world launch attempts started",
     "loop_overhead_s": "host-side loop overhead estimate",
     "pool.invalidations": "pool leases invalidated (suspect worker killed)",
     "pool.respawns": "pool workers respawned after death",

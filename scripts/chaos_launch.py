@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Chaos battery for the distributed world: rank-targeted faults under
+the supervised launcher.
+
+``scripts/chaos_sweep.py`` proves the SINGLE-host parent<->child surface
+self-heals; this battery proves the MULTI-PROCESS world does. It drives
+a 2-process x 2-device CPU-sim world (a real ``jax.distributed``
+rendezvous, cross-process collectives) through three rank-targeted
+fault scenarios, each seeded at the ``runtime.barrier`` collective on
+**rank 1 only** (the fault plan's ``ranks:`` selector) with
+``fail_attempts: 1`` so the supervised relaunch clears it (the
+``DDLB_TPU_WORLD_ATTEMPT`` floor):
+
+- ``hang``  — rank 1 wedges mid-collective; rank 0 blocks in the psum
+  forever. The watchdog's silence deadline must fire (beats stop
+  world-wide), the coordinated abort must tear the world down, and the
+  flight recorder must name rank 1 — beat ages CANNOT (every rank goes
+  silent together once the world wedges; only the sequence join knows
+  who never arrived).
+- ``exit``  — rank 1 dies abruptly (``os._exit``); asymmetric-death
+  detection, no silence wait.
+- ``kill``  — rank 1 SIGKILLed (the OOM signature); the negative
+  returncode must be mapped and named, never summarized as ``-9``.
+
+Per scenario the battery asserts: detection within the silence
+deadline, ``flight_report`` attribution (lagging rank == 1, divergence
+site == ``runtime.barrier``), a successful world relaunch
+(``attempts.json``: attempt 0 failed transient, attempt 1 ok), and a
+complete CSV — every sweep row measured and valid, zero rows lost.
+Exit code 0 iff every assertion holds; this script is the executable
+acceptance test for ISSUE 8 (log banked at
+``docs/chaos_launch_demo.log``; ``make chaos-launch`` runs it).
+
+Usage: python scripts/chaos_launch.py [--seed 0] [--silence-timeout 25]
+           [--keep DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROCESSES = 2
+DEVICES_PER_PROCESS = 2
+M, N, K = 64, 32, 32  # tiny: the battery tests supervision, not speed
+
+#: slack on top of the silence deadline for detection-latency asserts
+#: (poll slice + SIGTERM grace + beat-file staleness)
+DETECTION_SLACK_S = 15.0
+
+
+def build_plan(kind: str, seed: int) -> dict:
+    """One rank-targeted rule: rank 1 faults at the barrier collective
+    on the first world attempt; the relaunched world runs clean."""
+    rule = {
+        "site": "runtime.barrier",
+        "kind": kind,
+        "ranks": [1],
+        "fail_attempts": 1,
+    }
+    if kind == "hang":
+        rule["duration_s"] = 600.0
+    return {"seed": seed, "rules": [rule]}
+
+
+def child_command(csv: str) -> list:
+    """The world's workload: a 2-row tp_columnwise sweep through the
+    real benchmark CLI (both rows must survive the relaunch for the
+    zero-rows-lost assertion)."""
+    return [
+        sys.executable, "-m", "ddlb_tpu.cli.benchmark",
+        "--primitive", "tp_columnwise",
+        "--impl", "jax_spmd", "--impl", "xla_gspmd",
+        "-m", str(M), "-n", str(N), "-k", str(K),
+        "--dtype", "float32",
+        "--num-iterations", "2", "--num-warmups", "1",
+        "--csv", csv,
+    ]
+
+
+def run_scenario(
+    kind: str, seed: int, silence_timeout: float, base_dir: str,
+    failures: list,
+) -> None:
+    """One fault scenario end to end; appends failed assertions."""
+    from ddlb_tpu.cli.launch import launch_supervised
+    from ddlb_tpu.faults import flightrec
+
+    def check(ok, what):
+        print(f"  {'PASS' if ok else 'FAIL'}  [{kind}] {what}", flush=True)
+        if not ok:
+            failures.append(f"[{kind}] {what}")
+
+    run_dir = os.path.join(base_dir, f"scenario-{kind}")
+    csv = os.path.join(run_dir, "rows.csv")
+    os.makedirs(run_dir, exist_ok=True)
+    os.environ["DDLB_TPU_FAULT_PLAN"] = json.dumps(build_plan(kind, seed))
+
+    print(f"\n==== scenario [{kind}]: rank 1 faults at runtime.barrier "
+          f"====", flush=True)
+    t0 = time.monotonic()
+    rc = launch_supervised(
+        child_command(csv),
+        processes=PROCESSES,
+        devices_per_process=DEVICES_PER_PROCESS,
+        silence_timeout=silence_timeout,
+        world_retries=2,
+        relaunch_backoff_s=0.2,
+        run_dir=run_dir,
+    )
+    elapsed = time.monotonic() - t0
+    print(f"\n== [{kind}] assertions ({elapsed:.1f}s) ==", flush=True)
+
+    check(rc == 0, "supervised launch recovered (exit code 0)")
+
+    with open(os.path.join(run_dir, "attempts.json")) as f:
+        attempts = json.load(f)
+    check(
+        len(attempts) == 2,
+        f"exactly one relaunch: {len(attempts)} attempts recorded",
+    )
+    first, last = attempts[0], attempts[-1]
+    check(
+        first["outcome"] == "failed"
+        and first["error_class"] == "transient",
+        f"attempt 0 failed and classified transient "
+        f"({first['error_class']}: {first['error'][:80]})",
+    )
+    check(
+        first.get("culprit_rank") == 1,
+        f"culprit rank named: {first.get('culprit_rank')} (want 1)",
+    )
+    if kind == "hang":
+        age = float(first.get("silence_age_s") or 0.0)
+        check(
+            silence_timeout <= age <= silence_timeout + DETECTION_SLACK_S,
+            f"hang detected within the silence deadline: "
+            f"silence age {age:.1f}s vs deadline {silence_timeout}s "
+            f"(+{DETECTION_SLACK_S}s slack)",
+        )
+    else:
+        check(
+            "WorkerDied: rank 1" in first["error"],
+            f"asymmetric rank death detected: {first['error'][:80]}",
+        )
+    if kind == "kill":
+        check(
+            "SIGKILL" in first["error"] and "-9" not in first["error"],
+            f"signal death named, not numbered: {first['error'][:80]}",
+        )
+    check(last["outcome"] == "ok", "relaunched world completed cleanly")
+
+    report = flightrec.analyze_run(
+        os.path.join(run_dir, "attempt-0"), expected_ranks=PROCESSES
+    )
+    print(f"  flight verdict: {report.get('headline')}", flush=True)
+    check(
+        report.get("lagging_ranks") == [1],
+        f"flight report names rank 1 as lagging: "
+        f"{report.get('lagging_ranks')}",
+    )
+    check(
+        report.get("divergence_site") == "runtime.barrier",
+        f"divergence site attributed to the barrier collective: "
+        f"{report.get('divergence_site')!r}",
+    )
+
+    import pandas as pd
+
+    # last write wins per config: a failed attempt may have recorded
+    # error rows (a gloo peer ERRORS through a dead-peer collective)
+    # before the abort — the relaunch's appended rows supersede them,
+    # and "zero rows lost" means every sweep config ends with a final
+    # measured, valid row
+    rows = pd.read_csv(csv).groupby("implementation").last().reset_index()
+    check(
+        len(rows) == 2 and set(rows["implementation"]) == {
+            "jax_spmd_0", "xla_gspmd_0"
+        },
+        f"zero rows lost: {len(rows)}/2 sweep configs have a final row",
+    )
+    check(
+        bool(rows["valid"].all()),
+        "every config's final row measured valid after the relaunch",
+    )
+    check(
+        set(rows["num_processes"]) == {PROCESSES}
+        and set(rows["world_size"]) == {
+            PROCESSES * DEVICES_PER_PROCESS
+        },
+        "rows measured on the joint multi-process world (4 devices, "
+        "2 processes)",
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--silence-timeout", type=float, default=25.0,
+        help="watchdog silence budget (must exceed the CPU-sim world's "
+        "longest legitimate beat gap: jax import + distributed init)",
+    )
+    parser.add_argument(
+        "--keep", default=None, metavar="DIR",
+        help="keep run dirs under DIR instead of a deleted temp dir",
+    )
+    args = parser.parse_args(argv)
+
+    base_dir = args.keep or tempfile.mkdtemp(prefix="ddlb_chaos_launch_")
+    os.makedirs(base_dir, exist_ok=True)
+    failures: list = []
+    print(
+        f"chaos_launch: {PROCESSES} ranks x {DEVICES_PER_PROCESS} devices "
+        f"(CPU sim), seed={args.seed}, "
+        f"silence_timeout={args.silence_timeout}s, run dirs {base_dir}",
+        flush=True,
+    )
+    try:
+        for kind in ("hang", "exit", "kill"):
+            run_scenario(
+                kind, args.seed, args.silence_timeout, base_dir, failures
+            )
+    finally:
+        os.environ.pop("DDLB_TPU_FAULT_PLAN", None)
+        if not args.keep:
+            shutil.rmtree(base_dir, ignore_errors=True)
+
+    if failures:
+        print(f"\nchaos_launch: {len(failures)} assertion(s) FAILED",
+              flush=True)
+        return 1
+    print(
+        "\nchaos_launch: every rank-targeted fault detected, attributed, "
+        "and healed by a world relaunch with zero rows lost — OK",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

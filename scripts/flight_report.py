@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Post-mortem attribution over a collective flight-recorder run dir.
+
+When a multi-process world wedges or dies, the supervisor (or the
+operator, after a SIGKILL nothing supervised) is left with one question:
+**which rank diverged, and at which collective**. Every rank recorded
+its sequenced progress entries to ``flight-p<rank>.jsonl`` under the
+shared run dir (``DDLB_TPU_FLIGHTREC``; see ``ddlb_tpu/faults/
+flightrec.py``); this report joins them:
+
+- per rank: the last *completed* sequence number, any entry still in
+  flight (begun, never finished — a wedged collective), and the dump
+  markers the SIGTERM handlers appended;
+- across ranks: the highest common completed sequence, the **lagging
+  rank(s)** (lowest completed sequence while peers advanced — the rank
+  that never arrived at the collective its peers are stuck in), and the
+  **divergence site**.
+
+Usage:
+    python scripts/flight_report.py RUN_DIR [--ranks N] [--json]
+
+``--ranks N`` flags ranks that left no flight file at all (killed
+before recording anything). ``--json`` emits the full report document
+for the chaos battery / CI. Exit code: 0 when the world shows no
+divergence, 1 when it does (or no files were found) — so a supervised
+wrapper can gate on the verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlb_tpu.faults import flightrec  # noqa: E402
+
+
+def render_text(report: dict) -> str:
+    """The human form: per-rank progress table, then the verdict."""
+    lines = [f"flight report: {report['run_dir']}", ""]
+    ranks = report.get("ranks", {})
+    if ranks:
+        lines.append(
+            f"{'rank':>5} {'pid':>8} {'completed':>10} {'entries':>8} "
+            f"{'in flight':<28} dumps"
+        )
+        for rank in sorted(ranks):
+            s = ranks[rank]
+            inflight = (
+                ", ".join(
+                    f"{e['site']}#{e['seq']}" for e in s["inflight"]
+                )
+                or "-"
+            )
+            lines.append(
+                f"{rank:>5} {str(s['pid']):>8} "
+                f"{s['last_completed_seq']:>10} {s['entries']:>8} "
+                f"{inflight:<28} {','.join(s['dumps']) or '-'}"
+            )
+    for rank in report.get("missing_ranks", []):
+        lines.append(f"{rank:>5} {'-':>8} {'no flight file':>10}")
+    lines.append("")
+    if "common_seq" in report:
+        lines.append(f"highest common completed seq: {report['common_seq']}")
+        if report.get("lagging_ranks"):
+            lines.append(f"lagging rank(s): {report['lagging_ranks']}")
+        if report.get("divergence_site"):
+            lines.append(f"divergence site: {report['divergence_site']}")
+    lines.append(f"verdict: {report.get('headline', '')}")
+    return "\n".join(lines)
+
+
+def diverged(report: dict) -> bool:
+    """True when the report shows a problem worth a nonzero exit."""
+    if not report.get("ranks"):
+        return True
+    if report.get("missing_ranks") or report.get("lagging_ranks"):
+        return True
+    return any(s["inflight"] for s in report["ranks"].values())
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("run_dir", help="flight-recorder run directory")
+    parser.add_argument(
+        "--ranks", type=int, default=None,
+        help="expected world size (flags ranks that left no file)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+    report = flightrec.analyze_run(args.run_dir, expected_ranks=args.ranks)
+    if args.as_json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(render_text(report))
+    return 1 if diverged(report) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -177,6 +177,36 @@ def test_fire_listener_announces_fired_rules(monkeypatch):
     assert announced == [("subprocess.entry", "transient_error")]
 
 
+def test_rule_ranks_selector_targets_one_process(monkeypatch):
+    """A world-shared plan with ``ranks: [1]`` fires only in rank 1 —
+    the rank-targeted chaos surface of scripts/chaos_launch.py."""
+    _set_plan(monkeypatch, [
+        {"site": "runtime.barrier", "kind": "transient_error",
+         "ranks": [1], "fail_attempts": 99},
+    ])
+    monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "0")
+    faults.inject("runtime.barrier")  # rank 0: no fire
+    monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "1")
+    with pytest.raises(TimeoutError):
+        faults.inject("runtime.barrier")
+
+
+def test_world_attempt_floors_fail_attempts_gate(monkeypatch):
+    """The supervised relaunch exports DDLB_TPU_WORLD_ATTEMPT; a rule
+    with the default fail_attempts=1 fires on the first world launch
+    and clears on the relaunch, even though the fresh child's scope
+    attempt restarts at 0."""
+    _set_plan(monkeypatch, [
+        {"site": "launch.child", "kind": "transient_error",
+         "fail_attempts": 1},
+    ])
+    with pytest.raises(TimeoutError):
+        faults.inject("launch.child")
+    monkeypatch.setenv("DDLB_TPU_WORLD_ATTEMPT", "1")
+    faults.reset()
+    faults.inject("launch.child")  # relaunched world: cleared
+
+
 def test_malformed_plan_raises(monkeypatch):
     monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", '{"rules": [{"kind": "hang"}]}')
     faults.reset()
@@ -198,6 +228,21 @@ def test_classify_error_split():
     assert classify_error("ValueError: m=96 must be divisible") == DETERMINISTIC
     assert classify_error("validation crashed: TypeError: x") == DETERMINISTIC
     assert classify_error("SomethingNovel: who knows") == DETERMINISTIC
+
+
+def test_classify_distributed_bootstrap_flaps_transient():
+    """Coordinator-unreachable / distributed-init timeouts must be
+    retryable: the supervised launcher's world relaunch (and the
+    queue's parking policy) treats a flapped bootstrap as the
+    environment's fault, not the config's."""
+    for error in (
+        "RuntimeError: Unable to initialize backend 'tpu'",
+        "DEADLINE_EXCEEDED: could not reach coordinator at 10.0.0.2:8476",
+        "XlaRuntimeError: Barrier timed out after 300s",
+        "grpc error: failed to connect to all addresses",
+        "Gloo all-reduce failed: Connection closed by peer",
+    ):
+        assert classify_error(error) == TRANSIENT, error
 
 
 def test_backoff_schedule_exponential_with_jitter():

@@ -1,0 +1,319 @@
+"""Collective flight recorder: crash-safe sequencing + attribution.
+
+What matters: begin lines land on disk BEFORE the recorded body runs (a
+SIGKILLed rank still shows the collective it entered), the per-rank
+sequence join names the lagging rank and the divergence site, dumps
+fire on SIGTERM, and the file-beat extension of the heartbeat channel
+is atomic, throttled, and readable by a non-forking supervisor.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ddlb_tpu.faults import flightrec, heartbeat
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Recorder and beat-file state reset around every test; the
+    SIGTERM/SIGUSR1 dispositions a configure() may have installed are
+    restored so later tests see the defaults."""
+    monkeypatch.delenv("DDLB_TPU_FLIGHTREC", raising=False)
+    monkeypatch.delenv("DDLB_TPU_BEAT_FILE", raising=False)
+    flightrec.reset()
+    heartbeat.reset_file()
+    yield
+    flightrec.reset()
+    heartbeat.reset_file()
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+def _read_lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _write_rank_file(run_dir, rank, lines):
+    os.makedirs(run_dir, exist_ok=True)
+    with open(os.path.join(run_dir, f"flight-p{rank}.jsonl"), "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+def _entry(seq, ph, site, pid=100, **kw):
+    return {"seq": seq, "ph": ph, "site": site, "t": float(seq),
+            "pid": pid, **kw}
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_is_noop(tmp_path):
+    assert not flightrec.enabled()
+    with flightrec.record("runtime.barrier"):
+        flightrec.mark("worker.phase", stage="x")
+    flightrec.dump("nothing")  # no crash, no files anywhere
+
+
+def test_record_emits_begin_before_body_and_end_after(
+    tmp_path, monkeypatch
+):
+    """The crash-safety contract: the B line is flushed before the body
+    runs, so a rank that dies inside still shows where."""
+    monkeypatch.setenv("DDLB_TPU_FLIGHTREC", str(tmp_path))
+    monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "3")
+    flightrec.reset()
+    path = tmp_path / "flight-p3.jsonl"
+    with flightrec.record(
+        "runtime.barrier", axes="_barrier", payload_bytes=32
+    ):
+        mid = _read_lines(path)
+        assert [e["ph"] for e in mid] == ["B"]
+        assert mid[0]["site"] == "runtime.barrier"
+        assert mid[0]["axes"] == "_barrier"
+        assert mid[0]["bytes"] == 32
+        assert mid[0]["rank"] == 3
+    done = _read_lines(path)
+    assert [e["ph"] for e in done] == ["B", "E"]
+    assert done[1]["seq"] == done[0]["seq"]
+    assert done[1]["t"] >= done[0]["t"]
+
+
+def test_end_line_lands_even_when_body_raises(tmp_path, monkeypatch):
+    """A collective that ERRORS (vs wedges) completes its entry — the
+    attribution join must not mistake a crashed-through rank for a
+    stuck one."""
+    monkeypatch.setenv("DDLB_TPU_FLIGHTREC", str(tmp_path))
+    flightrec.reset()
+    with pytest.raises(RuntimeError):
+        with flightrec.record("runtime.collective"):
+            raise RuntimeError("peer closed")
+    lines = _read_lines(tmp_path / "flight-p0.jsonl")
+    assert [e["ph"] for e in lines] == ["B", "E"]
+
+
+def test_marks_and_sequence_are_monotonic(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_FLIGHTREC", str(tmp_path))
+    flightrec.reset()
+    flightrec.mark("worker.phase", stage="setup")
+    with flightrec.record("runtime.mesh_build"):
+        pass
+    flightrec.mark("pool.row", impl="jax_spmd_0")
+    lines = _read_lines(tmp_path / "flight-p0.jsonl")
+    seqs = [e["seq"] for e in lines if e["ph"] in ("B", "I")]
+    assert seqs == [1, 2, 3]
+    assert lines[0]["stage"] == "setup"
+
+
+def test_dump_appends_reason_and_inflight(tmp_path, monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_FLIGHTREC", str(tmp_path))
+    flightrec.reset()
+    with flightrec.record("runtime.barrier"):
+        flightrec.dump("deadline")
+    lines = _read_lines(tmp_path / "flight-p0.jsonl")
+    dump = [e for e in lines if e["ph"] == "D"][0]
+    assert dump["reason"] == "deadline"
+    assert dump["inflight"] == [{"seq": 1, "site": "runtime.barrier"}]
+
+
+def test_sigterm_dumps_then_dies_by_signal(tmp_path):
+    """A real child: SIGTERM triggers the dump handler, then the child
+    still dies BY the signal (exit status preserved for the
+    supervisor's signal-name mapping)."""
+    child = textwrap.dedent(
+        """
+        import time
+        from ddlb_tpu.faults import flightrec
+        with flightrec.record("runtime.barrier"):
+            print("READY", flush=True)
+            time.sleep(60)
+        """
+    )
+    env = dict(
+        os.environ,
+        DDLB_TPU_FLIGHTREC=str(tmp_path),
+        DDLB_TPU_PROCESS_ID="1",
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child], env=env,
+        stdout=subprocess.PIPE, text=True,
+    )
+    assert proc.stdout.readline().strip() == "READY"
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=30)
+    assert proc.returncode == -signal.SIGTERM
+    lines = _read_lines(tmp_path / "flight-p1.jsonl")
+    phases = [e["ph"] for e in lines]
+    assert "D" in phases
+    dump = [e for e in lines if e["ph"] == "D"][0]
+    assert dump["reason"] == "SIGTERM"
+    assert dump["inflight"][0]["site"] == "runtime.barrier"
+    assert "E" not in phases  # it genuinely died inside the entry
+
+
+# ---------------------------------------------------------------------------
+# Attribution (analyze_run / scripts/flight_report.py)
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_names_lagging_rank_and_stuck_site(tmp_path):
+    """Rank 1 never arrived at the barrier its peer is wedged in."""
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [
+        _entry(1, "I", "worker.phase"),
+        _entry(2, "B", "runtime.barrier"),  # begun, never ended
+    ])
+    _write_rank_file(run, 1, [
+        _entry(1, "I", "worker.phase"),
+    ])
+    report = flightrec.analyze_run(run, expected_ranks=2)
+    assert report["common_seq"] == 1
+    assert report["lagging_ranks"] == [1]
+    assert report["divergence_site"] == "runtime.barrier"
+    assert "rank 1 lagging" in report["headline"]
+
+
+def test_analyze_divergence_from_completed_entries(tmp_path):
+    """When nobody is stuck (peers ERROR through a dead-peer
+    collective), the divergence is the first entry the ahead rank ran
+    past the common seq."""
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [
+        _entry(1, "I", "worker.phase"),
+        _entry(2, "B", "runtime.collective"),
+        _entry(2, "E", "runtime.collective"),
+        _entry(3, "I", "worker.phase"),
+    ])
+    _write_rank_file(run, 1, [
+        _entry(1, "I", "worker.phase"),
+    ])
+    report = flightrec.analyze_run(run)
+    assert report["lagging_ranks"] == [1]
+    assert report["divergence_site"] == "runtime.collective"
+
+
+def test_analyze_all_ranks_stuck_in_same_collective(tmp_path):
+    """Equal sequences, everyone in flight: the collective itself
+    wedged — no lagging rank to blame, and the report says so."""
+    run = str(tmp_path)
+    for rank in (0, 1):
+        _write_rank_file(run, rank, [
+            _entry(1, "B", "runtime.barrier", pid=100 + rank),
+        ])
+    report = flightrec.analyze_run(run)
+    assert report["lagging_ranks"] == []
+    assert report["divergence_site"] == "runtime.barrier"
+    assert "collective itself wedged" in report["headline"]
+
+
+def test_analyze_missing_rank_file(tmp_path):
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [_entry(1, "B", "runtime.barrier")])
+    report = flightrec.analyze_run(run, expected_ranks=2)
+    assert report["missing_ranks"] == [1]
+    assert "no flight file" in report["headline"]
+
+
+def test_analyze_clean_world_and_torn_tail(tmp_path):
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [
+        _entry(1, "B", "runtime.barrier"),
+        _entry(1, "E", "runtime.barrier"),
+    ])
+    # a torn final line (killed mid-append) must be skipped, not fatal
+    with open(os.path.join(run, "flight-p0.jsonl"), "a") as f:
+        f.write('{"seq": 2, "ph": "B", "si')
+    report = flightrec.analyze_run(run)
+    assert report["lagging_ranks"] == []
+    assert "no divergence" in report["headline"]
+
+
+def test_analyze_uses_dominant_pid_stream(tmp_path):
+    """A rank file shared by the runner and a pool child: the busier
+    stream (the rank's main process) defines the rank's progress."""
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [
+        _entry(1, "I", "pool.row", pid=50),
+        _entry(1, "I", "worker.phase", pid=60),
+        _entry(2, "I", "worker.phase", pid=60),
+        _entry(3, "B", "runtime.barrier", pid=60),
+    ])
+    report = flightrec.analyze_run(run)
+    assert report["ranks"][0]["pid"] == 60
+    assert report["ranks"][0]["last_completed_seq"] == 2
+
+
+def test_flight_report_cli_json_and_exit_codes(tmp_path):
+    run = str(tmp_path)
+    _write_rank_file(run, 0, [
+        _entry(1, "B", "runtime.barrier"),
+        _entry(1, "E", "runtime.barrier"),
+    ])
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    clean = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "flight_report.py"),
+         run, "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    doc = json.loads(clean.stdout)
+    assert doc["lagging_ranks"] == []
+    diverged = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "flight_report.py"),
+         run, "--ranks", "2"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert diverged.returncode == 1  # rank 1 left no file
+    assert "no flight file" in diverged.stdout
+
+
+# ---------------------------------------------------------------------------
+# File beats (the heartbeat extension the supervisor reads)
+# ---------------------------------------------------------------------------
+
+
+def test_file_beat_written_and_read(tmp_path, monkeypatch):
+    path = str(tmp_path / "beat-p0")
+    monkeypatch.setenv("DDLB_TPU_BEAT_FILE", path)
+    heartbeat.reset_file()
+    before = time.monotonic()
+    heartbeat.beat()
+    stamp = heartbeat.read_file_beat(path)
+    assert before <= stamp <= time.monotonic()
+
+
+def test_file_beat_throttled(tmp_path, monkeypatch):
+    path = str(tmp_path / "beat-p0")
+    monkeypatch.setenv("DDLB_TPU_BEAT_FILE", path)
+    heartbeat.reset_file()
+    heartbeat.beat()
+    first = heartbeat.read_file_beat(path)
+    heartbeat.beat()  # within FILE_BEAT_INTERVAL_S: no second write
+    assert heartbeat.read_file_beat(path) == first
+    time.sleep(heartbeat.FILE_BEAT_INTERVAL_S * 1.5)
+    heartbeat.beat()
+    assert heartbeat.read_file_beat(path) > first
+
+
+def test_file_beat_unreadable_is_zero(tmp_path):
+    assert heartbeat.read_file_beat(str(tmp_path / "missing")) == 0.0
+    torn = tmp_path / "torn"
+    torn.write_text("12.5garbage")
+    assert heartbeat.read_file_beat(str(torn)) == 0.0
+
+
+def test_no_beat_file_env_is_noop(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    heartbeat.reset_file()
+    heartbeat.beat()  # no env: must not create any file
+    assert os.listdir(tmp_path) == []

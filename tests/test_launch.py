@@ -1,0 +1,282 @@
+"""Multi-process launcher: rank env plumbing, exit codes, supervision.
+
+Fast by design: the children are tiny ``python -c`` scripts (no JAX, no
+devices), so the launcher's own contracts — env fan-out, rank-0-last
+output ordering, first-nonzero exit propagation, signal-death mapping,
+the cross-rank watchdog's abort/classify/relaunch loop, and file-beat
+liveness — are tier-1-testable without paying a distributed JAX world
+(the real-world battery is ``scripts/chaos_launch.py``).
+"""
+
+import json
+import os
+import signal
+import sys
+import textwrap
+import time
+
+import pytest
+
+from ddlb_tpu.cli.launch import (
+    _rc_info,
+    launch,
+    launch_supervised,
+)
+
+
+def _lines(capsys):
+    return capsys.readouterr().out.splitlines()
+
+
+# ---------------------------------------------------------------------------
+# Plain mode
+# ---------------------------------------------------------------------------
+
+
+def test_rank_env_plumbing(capsys):
+    """Every child sees its rank identity (DDLB_TPU_NUM_PROCESSES /
+    PROCESS_ID / COORD_ADDR) and, in CPU-sim mode, the forced cpu
+    platform with the requested virtual device count."""
+    code = (
+        "import os; e = os.environ; "
+        "print('ENV', e['DDLB_TPU_PROCESS_ID'], e['DDLB_TPU_NUM_PROCESSES'],"
+        " e['DDLB_TPU_COORD_ADDR'], e['JAX_PLATFORMS'],"
+        " 'host_platform_device_count=4' in e['XLA_FLAGS'].replace('--xla_force_',''))"
+    )
+    rc = launch(
+        [sys.executable, "-c", code], processes=2, devices_per_process=4
+    )
+    assert rc == 0
+    out = _lines(capsys)
+    env_lines = sorted(line for line in out if "ENV" in line)
+    assert len(env_lines) == 2
+    coord0 = env_lines[0].split()[4]
+    assert env_lines[0].startswith("[p0] ENV 0 2")
+    assert env_lines[1].startswith("[p1] ENV 1 2")
+    # one shared coordinator endpoint, cpu platform, 4 sim devices
+    assert env_lines[1].split()[4] == coord0
+    assert coord0.startswith("127.0.0.1:")
+    assert all(line.split()[5] == "cpu" for line in env_lines)
+    assert all(line.split()[6] == "True" for line in env_lines)
+
+
+def test_rank0_output_printed_last(capsys):
+    """Rank 0 owns the result table, so its buffered output must end
+    the launch output regardless of completion order."""
+    code = "import os; print('MARK', os.environ['DDLB_TPU_PROCESS_ID'])"
+    assert launch([sys.executable, "-c", code], processes=3) == 0
+    marks = [line for line in _lines(capsys) if "MARK" in line]
+    assert marks == ["[p1] MARK 1", "[p2] MARK 2", "[p0] MARK 0"]
+
+
+def test_first_nonzero_exit_code_propagated(capsys):
+    code = (
+        "import os, sys; "
+        "sys.exit({'0': 0, '1': 3, '2': 5}[os.environ['DDLB_TPU_PROCESS_ID']])"
+    )
+    assert launch([sys.executable, "-c", code], processes=3) == 3
+    assert "[p1] exit code 3" in _lines(capsys)
+
+
+def test_signal_death_mapped_and_named(capsys):
+    """A signal-killed child has a NEGATIVE returncode; the summary must
+    name the signal and the launcher exit must be 128+signum, never the
+    raw negative number."""
+    code = (
+        "import os, signal; "
+        "os.environ['DDLB_TPU_PROCESS_ID'] == '1' and "
+        "os.kill(os.getpid(), signal.SIGKILL)"
+    )
+    rc = launch([sys.executable, "-c", code], processes=2)
+    assert rc == 128 + signal.SIGKILL
+    out = _lines(capsys)
+    assert "[p1] terminated by SIGKILL (exit code 137)" in out
+
+
+def test_rc_info_mapping():
+    assert _rc_info(0) == (0, "exit code 0")
+    assert _rc_info(7)[0] == 7
+    mapped, text = _rc_info(-signal.SIGTERM)
+    assert mapped == 128 + signal.SIGTERM
+    assert "SIGTERM" in text and "-15" not in text
+
+
+# ---------------------------------------------------------------------------
+# Supervised mode (scripted children, no JAX)
+# ---------------------------------------------------------------------------
+
+
+def _attempts(run_dir):
+    with open(os.path.join(run_dir, "attempts.json")) as f:
+        return json.load(f)
+
+
+def test_supervised_relaunches_transient_world_failure(tmp_path, capsys):
+    """Attempt 0: rank 1 dies with a coordinator-flap signature while
+    rank 0 keeps running -> asymmetric death, classified transient, the
+    WHOLE world relaunches (DDLB_TPU_WORLD_ATTEMPT=1 exported) and
+    completes; attempts.json records both attempts."""
+    code = textwrap.dedent(
+        """
+        import os, sys, time
+        attempt = int(os.environ["DDLB_TPU_WORLD_ATTEMPT"])
+        rank = os.environ["DDLB_TPU_PROCESS_ID"]
+        if attempt == 0 and rank == "1":
+            print("ConnectionError: coordinator unreachable")
+            sys.exit(7)
+        time.sleep(1.0)  # peers in flight when rank 1 dies
+        print("WORK", rank, attempt)
+        """
+    )
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=30.0,
+        world_retries=2,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    assert rc == 0
+    records = _attempts(str(tmp_path))
+    assert [r["outcome"] for r in records] == ["failed", "ok"]
+    assert records[0]["error_class"] == "transient"
+    assert "rank 1" in records[0]["error"]
+    assert records[0]["culprit_rank"] == 1
+    out = "\n".join(_lines(capsys))
+    # the relaunched world saw the incremented attempt counter
+    assert "WORK 0 1" in out and "WORK 1 1" in out
+    # live streaming, not after-exit buffering: child lines carry the
+    # rank prefix as they arrive
+    assert "[p1] ConnectionError: coordinator unreachable" in out
+
+
+def test_supervised_aborts_silent_world_within_deadline(tmp_path):
+    """A rank that produces no beat and no output is detected at the
+    silence deadline and the whole world is torn down together."""
+    code = (
+        "import os, time; "
+        "time.sleep(60 if os.environ['DDLB_TPU_PROCESS_ID'] == '1' else 0.2)"
+    )
+    t0 = time.monotonic()
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=2.0,
+        world_retries=0,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    elapsed = time.monotonic() - t0
+    assert rc != 0
+    assert elapsed < 30.0  # detection at ~2s + grace, never 60s
+    (record,) = _attempts(str(tmp_path))
+    assert record["outcome"] == "failed"
+    assert "TimeoutError" in record["error"]
+    assert record["error_class"] == "transient"
+    assert record["silence_age_s"] >= 2.0
+
+
+def test_supervised_deterministic_failure_not_relaunched(tmp_path):
+    """A symmetric failure whose output tail classifies deterministic
+    (a bad config, not a flaky environment) must not burn relaunches."""
+    code = (
+        "import sys; print('ValueError: bad sweep option'); sys.exit(2)"
+    )
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=10.0,
+        world_retries=3,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    assert rc == 2
+    records = _attempts(str(tmp_path))
+    assert len(records) == 1  # no relaunch
+    assert records[0]["error_class"] == "deterministic"
+
+
+def test_supervised_classifies_final_error_not_incidental_tail(tmp_path):
+    """Transient patterns are matched against the failing ranks' FINAL
+    exception lines only: a benign mid-output mention of 'coordinator'
+    (a recovered warning, an echoed address) must not turn a
+    deterministic failure into a world relaunch."""
+    code = textwrap.dedent(
+        """
+        import os, sys
+        for _ in range(10):
+            print("INFO: connected to coordinator at 127.0.0.1")
+        print("ValueError: bad sweep option")
+        sys.exit(2)
+        """
+    )
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=10.0,
+        world_retries=3,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    assert rc == 2
+    records = _attempts(str(tmp_path))
+    assert len(records) == 1  # no relaunch burned
+    assert records[0]["error_class"] == "deterministic"
+
+
+def test_supervised_file_beats_extend_silence_deadline(tmp_path):
+    """A child that prints NOTHING but beats through its
+    DDLB_TPU_BEAT_FILE outlives a silence deadline shorter than its
+    runtime — the file-beat channel is what the watchdog reads."""
+    code = textwrap.dedent(
+        """
+        import time
+        from ddlb_tpu.faults import heartbeat
+        for _ in range(16):  # ~3.2s of silent-but-beating work
+            heartbeat.beat()
+            time.sleep(0.2)
+        """
+    )
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=1.5,
+        world_retries=0,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    assert rc == 0
+    (record,) = _attempts(str(tmp_path))
+    assert record["outcome"] == "ok"
+    # the beat files were actually written under the attempt dir
+    attempt_dir = os.path.join(str(tmp_path), "attempt-0")
+    assert os.path.exists(os.path.join(attempt_dir, "beat-p0"))
+    assert os.path.exists(os.path.join(attempt_dir, "beat-p1"))
+
+
+def test_supervised_world_retries_exhaust(tmp_path):
+    """A world that keeps dying transiently stops at world_retries and
+    reports the mapped exit code of the last attempt."""
+    code = (
+        "import os, sys, time\n"
+        "if os.environ['DDLB_TPU_PROCESS_ID'] == '1':\n"
+        "    print('RESOURCE_EXHAUSTED: flaky allocator'); sys.exit(9)\n"
+        "time.sleep(0.5)\n"
+    )
+    rc = launch_supervised(
+        [sys.executable, "-c", code],
+        processes=2,
+        silence_timeout=30.0,
+        world_retries=1,
+        relaunch_backoff_s=0.05,
+        run_dir=str(tmp_path),
+    )
+    assert rc == 9
+    records = _attempts(str(tmp_path))
+    assert len(records) == 2
+    assert all(r["outcome"] == "failed" for r in records)
+
+
+def test_supervised_requires_at_least_one_process():
+    with pytest.raises(ValueError, match="processes"):
+        launch_supervised([sys.executable, "-c", "pass"], processes=0)
