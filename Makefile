@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test native bench lint analyze analyze-fast chaos-launch clean
+.PHONY: test native bench lint analyze analyze-fast analyze-changed \
+	hooks ci chaos-launch clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -26,12 +27,29 @@ analyze:
 	fi
 	@$(PYTHON) scripts/analyze.py
 
-# fast pre-commit surface: only files changed vs the merge-base
-analyze-fast:
+# fast pre-commit surface: only files changed vs the merge-base (the
+# committed hook in scripts/hooks/pre-commit runs exactly this target)
+analyze-changed:
 	@$(PYTHON) scripts/analyze.py --changed-only
+
+# historical alias for analyze-changed
+analyze-fast: analyze-changed
 
 # `make lint` is the historical name — it delegates to the analyzer
 lint: analyze
+
+# point git at the committed hooks so the analyzer gates every commit
+hooks:
+	git config core.hooksPath scripts/hooks
+	@echo "git hooks installed (core.hooksPath = scripts/hooks)"
+
+# the CI gate: full analyzer sweep (SARIF artifact for code-scanning
+# upload — see docs/source/static_analysis.rst "CI integration"), then
+# the tier-1 test surface
+ci:
+	$(PYTHON) scripts/analyze.py
+	$(PYTHON) scripts/analyze.py --sarif > analysis.sarif
+	$(PYTHON) -m pytest tests/ -q -m 'not slow'
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

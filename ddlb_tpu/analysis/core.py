@@ -225,9 +225,10 @@ def all_rules() -> List[Rule]:
     """Every registered rule instance, stable-ordered by id. Imported
     lazily so ``core`` has no import cycle with the rule modules."""
     from ddlb_tpu.analysis import rules_domain, rules_project, rules_style
+    from ddlb_tpu.analysis.spmd import rules_spmd
 
     rules: List[Rule] = []
-    for module in (rules_style, rules_domain, rules_project):
+    for module in (rules_style, rules_domain, rules_project, rules_spmd):
         rules.extend(module.RULES)
     return sorted(rules, key=lambda r: r.id)
 
@@ -288,6 +289,7 @@ def analyze(
     rules: Optional[Sequence[Rule]] = None,
     root: Optional[Path] = None,
     project_rules: bool = True,
+    contexts_out: Optional[List[FileContext]] = None,
 ) -> List[Finding]:
     """Run the rule battery over ``paths`` (files, pre-expanded).
 
@@ -295,7 +297,9 @@ def analyze(
     location; callers filter on ``Finding.counts`` / render as needed.
     ``project_rules=False`` skips the repo-level rules (the
     ``--changed-only`` fast path still runs them by default because
-    they are cheap and their state is global).
+    they are cheap and their state is global). ``contexts_out``, when a
+    list, receives every parsed ``FileContext`` so callers (the DDLB101
+    migrated/total inventory) can reuse the one-parse-per-file ASTs.
     """
     rules = list(rules if rules is not None else all_rules())
     per_file = [r for r in rules if not isinstance(r, ProjectRule)]
@@ -305,6 +309,8 @@ def analyze(
     for path in paths:
         ctx = build_context(Path(path), root=root)
         contexts.append(ctx)
+        if contexts_out is not None:
+            contexts_out.append(ctx)
         file_findings: List[Finding] = []
         if ctx.syntax_error is not None:
             exc = ctx.syntax_error

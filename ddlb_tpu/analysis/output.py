@@ -10,6 +10,7 @@ of dropped).
 
 from __future__ import annotations
 
+import ast
 import json
 from collections import Counter
 from typing import Any, Dict, List, Sequence
@@ -165,23 +166,74 @@ def render_sarif(findings: Sequence[Finding]) -> Dict[str, Any]:
     }
 
 
-def shard_map_inventory(findings: Sequence[Finding]) -> List[str]:
+def compat_call_sites(contexts: Sequence[Any]) -> Counter:
+    """Per-family count of ``shard_map_compat(`` call-through sites —
+    the MIGRATED side of the DDLB101 ledger. ``runtime.py`` (the compat
+    shim's own definition and internal uses) is excluded exactly like
+    the DDLB101 rule excludes it from the remaining side."""
+    counts: Counter = Counter()
+    for ctx in contexts:
+        if (
+            ctx.tree is None
+            or not ctx.in_package()
+            or ctx.path.name == "runtime.py"
+        ):
+            continue
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            name = (
+                fn.id
+                if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else ""
+            )
+            if name == "shard_map_compat":
+                counts[family_of(ctx.rel)] += 1
+    return counts
+
+
+def shard_map_inventory(
+    findings: Sequence[Finding], contexts: Sequence[Any] = (),
+) -> List[str]:
     """The DDLB101 per-family migration inventory the ROADMAP item
     needs: counts INCLUDE baselined findings (they are the backlog),
-    sorted largest-first."""
+    sorted largest-first. When ``contexts`` are supplied (the full
+    sweep), each line shows migrated/total progress — the
+    ``shard_map_compat`` call-through sites next to the legacy
+    remainder — instead of just the remaining count."""
     counts: Counter = Counter()
     for f in findings:
         if f.rule == "DDLB101" and not f.suppressed:
             counts[family_of(f.path)] += 1
-    if not counts:
+    migrated = compat_call_sites(contexts) if contexts else Counter()
+    if not counts and not migrated:
         return []
-    total = sum(counts.values())
+    remaining = sum(counts.values())
+    if not migrated:
+        lines = [
+            f"shard_map migration inventory: {remaining} legacy site(s) "
+            f"remaining (DDLB101, incl. baselined):"
+        ]
+        for family, n in sorted(
+            counts.items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            lines.append(f"  {family:32s} {n}")
+        return lines
+    done = sum(migrated.values())
     lines = [
-        f"shard_map migration inventory: {total} legacy site(s) "
-        f"remaining (DDLB101, incl. baselined):"
+        f"shard_map migration inventory: {remaining} legacy site(s) "
+        f"remaining, {done}/{done + remaining} migrated (DDLB101, "
+        f"incl. baselined):"
     ]
-    for family, n in sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])):
-        lines.append(f"  {family:32s} {n}")
+    families = sorted(
+        set(counts) | set(migrated),
+        key=lambda fam: (-counts.get(fam, 0), fam),
+    )
+    for family in families:
+        n = counts.get(family, 0)
+        m = migrated.get(family, 0)
+        lines.append(
+            f"  {family:32s} {n} remaining, {m}/{m + n} migrated"
+        )
     return lines
 
 

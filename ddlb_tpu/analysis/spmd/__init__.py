@@ -1,0 +1,26 @@
+"""Semantic SPMD analysis: collective-trace abstract interpretation.
+
+Where ``ddlb_tpu/analysis/rules_domain.py`` is syntactic (it can see a
+``jax.shard_map`` *call*, not what the mapped body does), this package
+walks every ``shard_map`` / ``runtime.shard_map_compat`` body (and the
+Pallas kernel bodies) with a small abstract interpreter and produces a
+per-function **collective trace**: ordered ``(op, axis_names, payload)``
+entries with branch/loop structure preserved. Four rules read the trace:
+
+- **DDLB120** axis-name validity — every collective's axis must appear
+  in the enclosing mesh axes / partition specs;
+- **DDLB121** static divergence — a collective reachable on one side of
+  a rank-dependent branch but not the other (the static twin of the
+  PR 8 flight recorder);
+- **DDLB122** ppermute permutation totality — ring perms must be a
+  bijection over the axis size (the silent-wrong-answer class);
+- **DDLB123** wire-bytes drift — the trace's per-step payload evaluated
+  under each family's canonical shapes, cross-checked against the
+  ``perfmodel/cost.py`` ``wire_bytes()`` formula every roofline column
+  depends on.
+
+Modules: ``trace`` (value domain + trace model + tracer), ``interp``
+(the AST interpreter + per-file tracing), ``families`` (canonical
+per-family evaluation for DDLB123 and ``--spmd-trace``), ``rules_spmd``
+(the rule battery, registered with the engine via ``core.all_rules``).
+"""

@@ -1,0 +1,270 @@
+"""The DDLB12x semantic SPMD rules — the collective-trace battery.
+
+Where the DDLB10x rules are syntactic (they can see a ``jax.shard_map``
+*call*), these read the collective traces the abstract interpreter
+(``spmd.interp``) extracts from every ``shard_map`` /
+``shard_map_compat`` body and Pallas-adjacent function in
+``ddlb_tpu/primitives``, ``ddlb_tpu/ops`` and ``ddlb_tpu/models``:
+
+- **DDLB120 undeclared-collective-axis**: a collective (or
+  ``axis_index``) naming an axis the enclosing mesh axes / partition
+  specs never declare — at runtime this is a ``NameError`` deep inside
+  jax, at sweep time a family that cannot launch.
+- **DDLB121 rank-divergent-collective**: a collective reachable on one
+  arm of a rank-dependent branch but unmatched on the other — the rank
+  that takes the other arm never arrives, and the world wedges exactly
+  like the PR 8 flight recorder's post-mortems show (findings cite the
+  divergence site the way ``flight_report.py`` names it).
+- **DDLB122 non-bijective-ppermute**: a concrete ``ppermute`` perm with
+  duplicate sources, duplicate destinations, or a source set differing
+  from its destination set — ranks outside the perm silently receive
+  zeros, the wrong-answer-without-an-error class. The symbolic ring
+  comprehension ``[(i, (i ± 1) % d) for i in range(d)]`` is recognized
+  as bijective for every ``d``.
+- **DDLB123 wire-bytes-drift** (project rule): every registered
+  family's members driven under canonical shapes
+  (``spmd.families``); when the traced per-device wire bytes and the
+  family's ``perfmodel``-facing ``wire_bytes()`` formula both resolve
+  and DISAGREE, the formula is wrong — and with it every
+  ``roofline_frac`` column and the bench regression gate. Findings
+  anchor at the defining ``def wire_bytes`` line.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Set, Tuple
+
+from ddlb_tpu.analysis.core import FileContext, Finding, ProjectRule, Rule
+from ddlb_tpu.analysis.spmd.interp import trace_file
+from ddlb_tpu.analysis.spmd.trace import COLLECTIVE_OPS
+
+#: the package subtrees the semantic pass walks (the ISSUE 9 surface:
+#: every shard_map body the benchmark can measure)
+_SPMD_DIRS = ("primitives", "ops", "models")
+
+
+def _in_spmd_scope(ctx: FileContext) -> bool:
+    return ctx.in_package() and any(d in ctx.parts for d in _SPMD_DIRS)
+
+
+class UndeclaredAxisRule(Rule):
+    """Collective axis names must be declared by the enclosing site."""
+
+    id = "DDLB120"
+    name = "undeclared-collective-axis"
+    rationale = (
+        "a psum/ppermute/all_gather naming an axis the mesh never "
+        "declares fails only at trace time on a real world — the "
+        "trace-level check catches it before any launch"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return _in_spmd_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+        for trace in trace_file(ctx):
+            declared = trace.declared_axes()
+            if declared is None:
+                continue
+            for e in trace.entries:
+                if e.op not in COLLECTIVE_OPS + ("axis_index",):
+                    continue
+                for ax in e.axes:
+                    if ax in declared:
+                        continue
+                    key = (e.line, e.col, e.op, ax)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    out.append(
+                        self.finding(
+                            ctx, e.line, e.col,
+                            f"{e.op} over axis '{ax}' which the "
+                            f"enclosing shard_map (line {trace.line}) "
+                            f"never declares — declared axes: "
+                            f"{', '.join(declared) or 'none'}",
+                        )
+                    )
+        return out
+
+
+class StaticDivergenceRule(Rule):
+    """A collective on one arm of a rank-dependent branch only."""
+
+    id = "DDLB121"
+    name = "rank-divergent-collective"
+    rationale = (
+        "a collective reachable on one side of a data-dependent branch "
+        "wedges every peer that takes the other side — the static twin "
+        "of the PR 8 flight recorder's divergence post-mortem"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return _in_spmd_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+        for trace in trace_file(ctx):
+            for div in trace.divergences:
+                e = div.entry
+                key = (e.line, e.op, e.axes, div.branch_line)
+                if key in seen:
+                    continue
+                seen.add(key)
+                axes = ",".join(e.axes) or "?"
+                out.append(
+                    self.finding(
+                        ctx, e.line, e.col,
+                        f"divergence site {e.op}[{axes}]: reachable on "
+                        f"one arm of the rank-dependent {div.branch_kind} "
+                        f"at line {div.branch_line} but unmatched on the "
+                        f"other — the rank taking the other arm never "
+                        f"arrives (runtime twin: flight_report.py "
+                        f"'lagging rank / divergence site')",
+                    )
+                )
+        return out
+
+
+class PpermuteBijectionRule(Rule):
+    """Concrete ppermute perms must be closed permutations."""
+
+    id = "DDLB122"
+    name = "non-bijective-ppermute"
+    rationale = (
+        "jax fills ranks missing from a ppermute perm with ZEROS "
+        "instead of raising — a dropped or duplicated pair is a silent "
+        "wrong answer circulating the ring"
+    )
+
+    def scope(self, ctx: FileContext) -> bool:
+        return _in_spmd_scope(ctx)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+        for trace in trace_file(ctx):
+            for e in trace.entries:
+                if e.op != "ppermute" or e.perm_pattern == "ring":
+                    continue
+                if e.perm is None:
+                    continue  # statically unresolvable: nothing to prove
+                problem = self._perm_problem(e.perm)
+                if problem is None:
+                    continue
+                key = (e.line, e.col, problem)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    self.finding(
+                        ctx, e.line, e.col,
+                        f"ppermute perm {e.perm} is not a bijection: "
+                        f"{problem} — ranks outside the perm receive "
+                        f"zeros silently",
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _perm_problem(perm):
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        if len(set(srcs)) != len(srcs):
+            return "duplicate source rank(s)"
+        if len(set(dsts)) != len(dsts):
+            return "duplicate destination rank(s)"
+        if set(srcs) != set(dsts):
+            missing = sorted(set(srcs) ^ set(dsts))
+            return (
+                f"source and destination sets differ (unbalanced ranks "
+                f"{missing})"
+            )
+        return None
+
+
+class WireDriftRule(ProjectRule):
+    """Traced wire bytes vs the family ``wire_bytes()`` formula."""
+
+    id = "DDLB123"
+    name = "wire-bytes-drift"
+    rationale = (
+        "perfmodel wire_bytes() feeds every roofline_frac column and "
+        "the bench regression gate; a formula that drifts from the "
+        "member's actual collective traffic silently corrupts them all"
+    )
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        if not any(_in_spmd_scope(ctx) for ctx in contexts):
+            return []
+        from ddlb_tpu.analysis.spmd import families
+
+        try:
+            reports = families.verify_families()
+        except Exception as exc:
+            return [
+                Finding(
+                    self.id, "ddlb_tpu/analysis/spmd/families.py", 1, 1,
+                    f"family verification failed to run: "
+                    f"{type(exc).__name__}: {exc}",
+                )
+            ]
+        return self.findings_from(reports)
+
+    def findings_from(self, reports) -> List[Finding]:
+        """Drift reports -> findings (shared with the fixture tests,
+        which drive ``families.verify_families`` over a synthetic
+        tree)."""
+        out: List[Finding] = []
+        for r in reports:
+            if r.status != "drift":
+                continue
+            rel = r.formula_rel or r.rel
+            line = r.formula_line or 1
+            out.append(
+                Finding(
+                    self.id, rel, line, 1,
+                    f"wire-bytes drift for {r.label()}: {r.reason} "
+                    f"(canonical shapes "
+                    f"{families_shapes_label(r.family)}) — the formula "
+                    f"feeds predicted_s/roofline_frac and the bench "
+                    f"gate",
+                    snippet=_line_of(rel, line),
+                )
+            )
+        return out
+
+
+def families_shapes_label(family: str) -> str:
+    from ddlb_tpu.analysis.spmd.families import FAMILY_SHAPES
+
+    s = FAMILY_SHAPES.get(family, {})
+    return (
+        f"m={s.get('m')}, n={s.get('n')}, k={s.get('k')}, d={s.get('d')}"
+    )
+
+
+def _line_of(rel: str, line: int) -> str:
+    """The stripped source line for baseline-stable finding keys."""
+    from ddlb_tpu.analysis.core import repo_root
+
+    try:
+        lines = (repo_root() / rel).read_text(
+            encoding="utf-8"
+        ).splitlines()
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+    except OSError:
+        return ""
+
+
+RULES = [
+    UndeclaredAxisRule(),
+    StaticDivergenceRule(),
+    PpermuteBijectionRule(),
+    WireDriftRule(),
+]

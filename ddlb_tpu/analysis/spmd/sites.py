@@ -1,0 +1,116 @@
+"""Join flight-recorder site names to their static collective traces.
+
+The PR 8 flight recorder names runtime sites (``runtime.barrier``,
+``runtime.collective``, ``pool.row``, …) in its per-rank dumps, and
+``scripts/flight_report.py`` attributes a wedged world to one of them.
+The semantic SPMD pass traces the *code* behind several of those sites
+— the barrier's ``psum``, the cross-process result allgather — so a
+runtime divergence can be linked straight to the static location (and
+collective sequence) the interpreter certified.
+
+``static_site_index()`` builds the join table: every
+``flightrec.record("<site>", …)`` / ``flightrec.mark("<site>", …)``
+call site in the package, keyed by the site literal, with the
+collective trace entries that fall inside the same enclosing function
+(empty for sites that guard host-only regions — worker phases, pool
+row dispatch). ``flight_report.py --json`` attaches the matching rows
+as the report's ``static_trace`` field.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+from ddlb_tpu.analysis.core import build_context, repo_root
+from ddlb_tpu.analysis.spmd.interp import trace_file
+from ddlb_tpu.analysis.spmd.trace import COLLECTIVE_OPS
+
+
+def _site_calls(tree: ast.Module) -> List[Tuple[str, ast.Call]]:
+    """Every ``flightrec.record/mark`` call with a constant site name."""
+    out: List[Tuple[str, ast.Call]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in ("record", "mark")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "flightrec"
+        ):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and (
+            isinstance(node.args[0].value, str)
+        ):
+            out.append((node.args[0].value, node))
+    return out
+
+
+def _enclosing_span(
+    tree: ast.Module, lineno: int
+) -> Tuple[str, int, int]:
+    """(qualname-ish, first line, last line) of the innermost function
+    containing ``lineno``."""
+    best: Tuple[str, int, int] = ("<module>", 1, 10 ** 9)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end and node.lineno >= best[1]:
+                best = (node.name, node.lineno, end)
+    return best
+
+
+def static_site_index(
+    root: Optional[Path] = None,
+) -> Dict[str, Dict[str, Any]]:
+    """Site name -> static location + traced collectives (see module
+    docstring). Files are only parsed when their text mentions the
+    flight recorder; traces are only built for files whose sites sit
+    in functions with SPMD markers."""
+    root = Path(root or repo_root())
+    index: Dict[str, Dict[str, Any]] = {}
+    for path in sorted((root / "ddlb_tpu").rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        try:
+            text = path.read_text(encoding="utf-8")
+        except OSError:
+            continue
+        if "flightrec." not in text:
+            continue
+        ctx = build_context(path, root=root)
+        if ctx.tree is None:
+            continue
+        calls = _site_calls(ctx.tree)
+        if not calls:
+            continue
+        traces = trace_file(ctx)
+        for site, node in calls:
+            fn_name, lo, hi = _enclosing_span(ctx.tree, node.lineno)
+            collectives: List[Dict[str, Any]] = []
+            for trace in traces:
+                for e in trace.entries:
+                    if e.op not in COLLECTIVE_OPS:
+                        continue
+                    if not (lo <= e.line <= hi):
+                        continue
+                    row = {
+                        "op": e.op,
+                        "axes": list(e.axes),
+                        "line": e.line,
+                    }
+                    if row not in collectives:
+                        collectives.append(row)
+            entry = {
+                "rel": ctx.rel,
+                "line": node.lineno,
+                "fn": fn_name,
+                "collectives": collectives,
+            }
+            # first definition wins; re-records of the same site from
+            # helper paths keep the primary anchor
+            index.setdefault(site, entry)
+    return index

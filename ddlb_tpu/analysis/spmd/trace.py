@@ -1,0 +1,503 @@
+"""Value domain and collective-trace model for the SPMD interpreter.
+
+The interpreter (``interp.py``) evaluates ``shard_map`` bodies over a
+small abstract value domain defined here:
+
+- concrete Python scalars / tuples / lists / dicts pass through, so
+  canonical-shape evaluation is mostly *concrete* execution (loops run
+  their real trip counts, reshapes produce real dims);
+- ``Arr`` is a symbolic device array carrying only ``(shape, dtype,
+  tainted)`` — shape dims are ints or ``None`` (unknown); ``tainted``
+  marks values derived from ``lax.axis_index`` (rank-dependent data,
+  the DDLB121 divergence signal);
+- ``Unk`` is the don't-know element (with taint), absorbing everything
+  the interpreter does not model;
+- ``FuncVal`` / ``ShardMapVal`` / ``MeshVal`` / ``SpecVal`` / ``ModVal``
+  model the JAX program-construction layer far enough to find every
+  collective call inside a mapped body.
+
+A ``Tracer`` collects ``TraceEntry`` rows — op, axis names, payload
+size, surrounding branch/loop frames — into ``ShardMapTrace`` objects,
+one per traced ``shard_map`` site (plus "floating" traces for Pallas
+kernel bodies reached outside any ``shard_map``). The DDLB120-123 rules
+and ``scripts/analyze.py --spmd-trace`` consume these traces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: collective ops recorded into traces; the wire-relevant subset powers
+#: DDLB123 and the deadlock-relevant subset powers DDLB121
+COLLECTIVE_OPS = (
+    "psum",
+    "pmean",
+    "ppermute",
+    "all_gather",
+    "psum_scatter",
+    "all_to_all",
+)
+#: rank-asymmetric by protocol (point-to-point DMA), excluded from the
+#: DDLB121 divergence check but still traced for structure/debugging
+P2P_OPS = ("remote_copy",)
+
+#: wire/HBM itemsize per dtype name, mirroring perfmodel.cost._ITEMSIZE
+#: (f64 counts 4: device arrays are f32 unless x64 is enabled). Stated
+#: here too so the analysis tier never imports the perfmodel at module
+#: import time; DDLB123 cross-checks against the real formulas at run
+#: time, which is exactly its job.
+ITEMSIZE = {
+    "float32": 4,
+    "float64": 4,
+    "float16": 2,
+    "bfloat16": 2,
+    "int32": 4,
+    "int64": 8,
+    "int8": 1,
+    "bool": 1,
+}
+
+
+class Unk:
+    """The don't-know element; ``tainted`` marks rank-dependence."""
+
+    __slots__ = ("tainted",)
+
+    def __init__(self, tainted: bool = False) -> None:
+        self.tainted = tainted
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "Unk(tainted)" if self.tainted else "Unk"
+
+
+UNKNOWN = Unk()
+
+
+def is_unknown(v: Any) -> bool:
+    return isinstance(v, Unk)
+
+
+def taint_of(v: Any) -> bool:
+    """Whether a value is (transitively) derived from rank identity."""
+    if isinstance(v, (Unk, Arr)):
+        return v.tainted
+    if isinstance(v, (tuple, list)):
+        return any(taint_of(x) for x in v)
+    return False
+
+
+class Arr:
+    """Symbolic array: shape dims are ints or None (unknown)."""
+
+    __slots__ = ("shape", "dtype", "tainted")
+
+    def __init__(
+        self,
+        shape: Optional[Tuple],
+        dtype: Optional[str] = None,
+        tainted: bool = False,
+    ) -> None:
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.tainted = tainted
+
+    def elems(self) -> Optional[int]:
+        if self.shape is None:
+            return None
+        total = 1
+        for dim in self.shape:
+            if not isinstance(dim, int):
+                return None
+            total *= dim
+        return total
+
+    def nbytes(self) -> Optional[float]:
+        n = self.elems()
+        if n is None:
+            return None
+        isz = ITEMSIZE.get(self.dtype or "", None)
+        if isz is None:
+            return None
+        return float(n * isz)
+
+    def with_shape(self, shape) -> "Arr":
+        return Arr(shape, self.dtype, self.tainted)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        dims = (
+            "?"
+            if self.shape is None
+            else ",".join("?" if d is None else str(d) for d in self.shape)
+        )
+        return f"Arr[{dims}]{self.dtype or '?'}"
+
+
+class FuncVal:
+    """An interpretable function: AST node + defining environment."""
+
+    __slots__ = ("name", "node", "env", "self_val", "path", "owner")
+
+    def __init__(
+        self, name, node, env, self_val=None, path="", owner=None
+    ) -> None:
+        self.name = name
+        self.node = node  # ast.FunctionDef | ast.Lambda
+        self.env = env
+        self.self_val = self_val  # bound receiver for methods
+        self.path = path  # defining file (for cross-module bodies)
+        self.owner = owner  # defining StaticClass (super() dispatch)
+
+
+class ShardMapVal:
+    """The value ``shard_map(fn, mesh=..., in_specs=..., out_specs=...)``
+    evaluates to; calling it shards the args and interprets ``fn``."""
+
+    __slots__ = ("fn", "mesh_axes", "in_specs", "out_specs", "node")
+
+    def __init__(self, fn, mesh_axes, in_specs, out_specs, node) -> None:
+        self.fn = fn
+        self.mesh_axes = mesh_axes  # tuple of names, or None (unknown)
+        self.in_specs = in_specs  # tuple of SpecVal/Unk
+        self.out_specs = out_specs
+        self.node = node  # the shard_map call site
+
+
+class MeshVal:
+    """A mesh whose axis names (and optionally sizes) are known."""
+
+    __slots__ = ("axes", "sizes")
+
+    def __init__(self, axes, sizes=None) -> None:
+        self.axes = tuple(axes) if axes is not None else None
+        self.sizes = dict(sizes or {})
+
+
+class SpecVal:
+    """``PartitionSpec`` literal: entries are str | None | tuple."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries) -> None:
+        self.entries = tuple(entries)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        out = []
+        for e in self.entries:
+            if isinstance(e, str):
+                out.append(e)
+            elif isinstance(e, (tuple, list)):
+                out.extend(x for x in e if isinstance(x, str))
+        return tuple(out)
+
+
+class ModVal:
+    """A dotted module/attribute path ("jax.lax") pending resolution."""
+
+    __slots__ = ("path",)
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+
+class OpaqueReal:
+    """A real host object (e.g. a schedule-table dataclass) whose plain
+    attributes the interpreter may read; never called."""
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj) -> None:
+        self.obj = obj
+
+
+class UnionVal:
+    """A bounded set of alternative values (post-branch merges)."""
+
+    __slots__ = ("options",)
+
+    MAX = 4
+
+    def __init__(self, options) -> None:
+        flat: List[Any] = []
+        for o in options:
+            if isinstance(o, UnionVal):
+                flat.extend(o.options)
+            else:
+                flat.append(o)
+        self.options = flat[: self.MAX]
+
+
+class Frame:
+    """One branch/loop context surrounding a trace entry."""
+
+    __slots__ = ("kind", "label", "tainted", "arm", "line")
+
+    def __init__(self, kind, label, tainted=False, arm=None, line=0) -> None:
+        self.kind = kind  # "if" | "cond" | "switch" | "loop" | "while"
+        self.label = label
+        self.tainted = tainted
+        self.arm = arm
+        self.line = line
+
+    def describe(self) -> str:
+        arm = f"#arm{self.arm}" if self.arm is not None else ""
+        taint = " rank-dependent" if self.tainted else ""
+        return f"{self.kind}({self.label}){arm}{taint}"
+
+
+class TraceEntry:
+    """One collective occurrence inside a traced body."""
+
+    __slots__ = (
+        "op", "axes", "line", "col", "payload", "frames", "perm",
+        "perm_pattern",
+    )
+
+    def __init__(
+        self, op, axes, line, col, payload, frames, perm=None,
+        perm_pattern=None,
+    ) -> None:
+        self.op = op
+        self.axes = tuple(axes)
+        self.line = line
+        self.col = col
+        self.payload = payload  # Arr | None
+        self.frames = list(frames)  # Frame snapshots
+        self.perm = perm  # concrete [(src, dst), ...] when resolvable
+        self.perm_pattern = perm_pattern  # "ring" for the ±1 comprehension
+
+    def payload_bytes(self) -> Optional[float]:
+        if isinstance(self.payload, Arr):
+            return self.payload.nbytes()
+        return None
+
+    def describe(self) -> str:
+        where = "/".join(f.describe() for f in self.frames)
+        pay = repr(self.payload) if self.payload is not None else "?"
+        ax = ",".join(self.axes) or "-"
+        loc = f":{self.line}"
+        return f"{self.op}[{ax}] payload={pay}{loc}" + (
+            f" in {where}" if where else ""
+        )
+
+
+#: per-device ring-algorithm wire bytes each collective contributes,
+#: given its local payload bytes and the axis size d — the same
+#: bandwidth-optimal formulas perfmodel/cost.py states per family
+def wire_contribution(op: str, nbytes: float, d: int) -> float:
+    if d <= 1:
+        return 0.0
+    if op == "all_gather":
+        return nbytes * (d - 1)
+    if op == "psum_scatter":
+        return nbytes * (d - 1) / d
+    if op in ("psum", "pmean"):
+        return 2.0 * nbytes * (d - 1) / d
+    if op == "all_to_all":
+        return nbytes * (d - 1) / d
+    if op == "ppermute":
+        return nbytes
+    return 0.0
+
+
+class Divergence:
+    """A DDLB121 record: a collective present on one arm only."""
+
+    __slots__ = ("entry", "branch_line", "branch_kind")
+
+    def __init__(self, entry, branch_line, branch_kind) -> None:
+        self.entry = entry
+        self.branch_line = branch_line
+        self.branch_kind = branch_kind
+
+
+class ShardMapTrace:
+    """Everything traced from one ``shard_map`` site (or floating body)."""
+
+    __slots__ = (
+        "rel", "line", "col", "fn_name", "mesh_axes", "spec_axes",
+        "entries", "divergences", "phase", "unresolved", "truncated",
+        "site_name",
+    )
+
+    def __init__(
+        self, rel, line, col, fn_name, mesh_axes, spec_axes,
+        phase="measured",
+    ) -> None:
+        self.rel = rel
+        self.line = line
+        self.col = col
+        self.fn_name = fn_name
+        self.mesh_axes = mesh_axes  # tuple | None
+        self.spec_axes = tuple(spec_axes)
+        self.entries: List[TraceEntry] = []
+        self.divergences: List[Divergence] = []
+        self.phase = phase  # "measured" | "init" | "kernel" | "floating"
+        self.unresolved = False
+        self.truncated = False
+        self.site_name = ""  # flightrec site joined by flight_report
+
+    def declared_axes(self) -> Optional[Tuple[str, ...]]:
+        """The axis names a collective may legally use here: the mesh
+        axes (widened by the spec axes), or None — rule skips — when the
+        mesh is not statically known. Spec axes alone are a LOWER bound
+        on the mesh, never the axis universe: ``models/`` maps bodies
+        over ``P("dp", ...)`` specs inside (dp, tp, pp) meshes passed as
+        parameters, and their tp/pp collectives are legal."""
+        if self.mesh_axes is None:
+            return None
+        axes = set(self.spec_axes)
+        axes.update(self.mesh_axes)
+        return tuple(sorted(axes))
+
+    def wire_bytes(self, axis_sizes: Dict[str, int]) -> Optional[float]:
+        """Total per-device wire bytes of the trace's collectives under
+        the given axis sizes; None when any payload is unsizeable."""
+        total = 0.0
+        for e in self.entries:
+            if e.op not in COLLECTIVE_OPS:
+                continue
+            if e.op == "axis_index":  # pragma: no cover - not collective
+                continue
+            nbytes = e.payload_bytes()
+            if nbytes is None:
+                return None
+            d = 1
+            for ax in e.axes:
+                if ax not in axis_sizes:
+                    return None
+                d *= axis_sizes[ax]
+            total += wire_contribution(e.op, nbytes, d)
+        return total
+
+    def describe(self) -> List[str]:
+        head = (
+            f"shard_map @ {self.rel}:{self.line} fn={self.fn_name or '?'} "
+            f"mesh_axes={self.mesh_axes or '?'} specs={self.spec_axes} "
+            f"phase={self.phase}"
+        )
+        lines = [head]
+        if self.unresolved:
+            lines.append("  (body unresolved statically)")
+        # collapse identical (op, line, axes) repeats from concrete loops
+        counts: Dict[Tuple, int] = {}
+        order: List[Tuple] = []
+        by_key: Dict[Tuple, TraceEntry] = {}
+        for e in self.entries:
+            key = (e.op, e.line, e.axes, repr(e.payload))
+            if key not in counts:
+                order.append(key)
+                by_key[key] = e
+            counts[key] = counts.get(key, 0) + 1
+        for key in order:
+            e = by_key[key]
+            n = counts[key]
+            mult = f" x{n}" if n > 1 else ""
+            lines.append(f"  {e.describe()}{mult}")
+        return lines
+
+
+class Tracer:
+    """Collects entries into a stack of open traces.
+
+    ``mode`` selects site behavior: in ``"file"`` mode a ``ShardMapVal``
+    is traced at *creation* (the per-file sweep can rarely see the call);
+    in ``"family"`` mode tracing happens when the value is called (init
+    helpers) or driven explicitly with the member's canonical args.
+    """
+
+    def __init__(self, rel: str, mode: str = "file") -> None:
+        self.rel = rel
+        self.mode = mode
+        self.traces: List[ShardMapTrace] = []
+        self._stack: List[ShardMapTrace] = []
+        self._frames: List[Frame] = []
+
+    # -- trace lifecycle ---------------------------------------------------
+
+    def open_trace(self, trace: ShardMapTrace) -> ShardMapTrace:
+        self.traces.append(trace)
+        self._stack.append(trace)
+        return trace
+
+    def close_trace(self) -> None:
+        self._stack.pop()
+
+    def current(self) -> Optional[ShardMapTrace]:
+        return self._stack[-1] if self._stack else None
+
+    def ensure_floating(self, fn_name: str, line: int) -> ShardMapTrace:
+        """Open a floating (kernel-body) trace when an entry lands with
+        no shard_map context — Pallas kernels reached directly."""
+        if not self._stack:
+            t = ShardMapTrace(
+                self.rel, line, 1, fn_name, None, (), phase="kernel"
+            )
+            self.open_trace(t)
+        return self._stack[-1]
+
+    # -- frames ------------------------------------------------------------
+
+    def push_frame(self, frame: Frame) -> None:
+        self._frames.append(frame)
+
+    def pop_frame(self) -> Frame:
+        return self._frames.pop()
+
+    def frames(self) -> Sequence[Frame]:
+        return tuple(self._frames)
+
+    # -- recording ---------------------------------------------------------
+
+    def record(
+        self, op, axes, node, payload=None, perm=None, perm_pattern=None,
+        fn_name="",
+    ) -> Optional[TraceEntry]:
+        trace = self.current()
+        if trace is None:
+            trace = self.ensure_floating(fn_name, getattr(node, "lineno", 0))
+        entry = TraceEntry(
+            op,
+            axes,
+            getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0) + 1,
+            payload,
+            self.frames(),
+            perm=perm,
+            perm_pattern=perm_pattern,
+        )
+        trace.entries.append(entry)
+        return entry
+
+    def record_divergences(
+        self, arm_entries: List[List[TraceEntry]], frame: Frame
+    ) -> None:
+        """Compare branch arms: a collective (op, axes) multiset present
+        in one arm but unmatched in another, under a rank-dependent
+        condition, is a DDLB121 divergence."""
+        if not frame.tainted:
+            return
+        trace = self.current()
+        if trace is None:
+            return
+
+        def keyset(entries):
+            out: Dict[Tuple, int] = {}
+            for e in entries:
+                if e.op in COLLECTIVE_OPS:
+                    key = (e.op, e.axes)
+                    out[key] = out.get(key, 0) + 1
+            return out
+
+        keysets = [keyset(arm) for arm in arm_entries]
+        for i, entries in enumerate(arm_entries):
+            others = [k for j, k in enumerate(keysets) if j != i]
+            seen: Dict[Tuple, int] = {}
+            for e in entries:
+                if e.op not in COLLECTIVE_OPS:
+                    continue
+                key = (e.op, e.axes)
+                seen[key] = seen.get(key, 0) + 1
+                if any(o.get(key, 0) < seen[key] for o in others):
+                    trace.divergences.append(
+                        Divergence(e, frame.line, frame.kind)
+                    )

@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddlb_tpu.ops.pallas_compat import CompilerParams
+
 
 def _neighbor_barrier(axis_name: str, d: int) -> None:
     """Block until both ring neighbors reached this point
@@ -231,7 +233,7 @@ def ring_ag_matmul(
             pltpu.SemaphoreType.REGULAR,              # buffer-free credits
             pltpu.VMEM((m_loc, bn), jnp.float32),     # GEMM accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interpret,
@@ -421,7 +423,7 @@ def ring_matmul_rs(
             pltpu.SemaphoreType.REGULAR,              # buffer-free credits
             pltpu.VMEM((m_loc, bn), jnp.float32),     # GEMM accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interpret,

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddlb_tpu.ops.pallas_compat import CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -217,7 +219,7 @@ def decode_attention(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -349,7 +351,7 @@ def paged_decode_attention(
         kernel,
         out_shape=jax.ShapeDtypeStruct((b, h, dh), q.dtype),
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

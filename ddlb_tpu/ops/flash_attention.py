@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddlb_tpu.ops.pallas_compat import CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -280,7 +282,7 @@ def flash_attention_chunk(
             jax.ShapeDtypeStruct((h, sq, 1), f32),
         ],
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -484,7 +486,7 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
             ),
             out_shape=out_shape,
             grid_spec=grid_spec,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             cost_estimate=pl.CostEstimate(
@@ -522,7 +524,7 @@ def _flash_forward(q, k, v, row_offset, scale, block_q, block_kv, interpret,
         kernel,
         out_shape=out_shape,
         grid_spec=grid_spec,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -866,7 +868,7 @@ def flash_attention_bwd(
                 out_specs=qspec_t,
                 scratch_shapes=[pltpu.VMEM((bq, dh), f32)],
             ),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             cost_estimate=pl.CostEstimate(
@@ -910,7 +912,7 @@ def flash_attention_bwd(
                     pltpu.VMEM((bkv, dh), f32),
                 ],
             ),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 dimension_semantics=("parallel", "arbitrary"),
             ),
             cost_estimate=pl.CostEstimate(
@@ -946,7 +948,7 @@ def flash_attention_bwd(
             out_specs=qspec,
             scratch_shapes=[pltpu.VMEM((bq, dh), f32)],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -983,7 +985,7 @@ def flash_attention_bwd(
                 pltpu.VMEM((bkv, dh), f32),
             ],
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -1,0 +1,17 @@
+"""jax-version bridge for the Pallas TPU compiler-params class.
+
+jax >= 0.5 spells it ``pltpu.CompilerParams``; the 0.4.x fleet only has
+the old ``pltpu.TPUCompilerParams`` name (same constructor signature).
+Every ops kernel resolves the class through here — the same
+one-version-bridge contract as ``runtime.shard_map_compat`` — so the
+kernels stay written in the modern spelling while the interpret-mode
+tests still run on old jax.
+"""
+
+from __future__ import annotations
+
+import jax.experimental.pallas.tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddlb_tpu.ops.pallas_compat import CompilerParams
+
 #: int8 symmetric range: values quantize to [-127, 127] (-128 unused so the
 #: grid is symmetric and |q*s| <= max|x| exactly)
 _QMAX = 127.0
@@ -213,7 +215,7 @@ def int8_matmul_pallas(
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, s: (i, j)),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ddlb_tpu.ops.pallas_compat import CompilerParams
+
 from ddlb_tpu.ops.collective_matmul import _neighbor_barrier
 
 
@@ -168,7 +170,7 @@ def ring_all_gather(
             pltpu.SemaphoreType.DMA,         # seed + output copies
             pltpu.SemaphoreType.REGULAR,     # buffer-free credits
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interpret,
@@ -322,7 +324,7 @@ def ring_reduce_scatter(
             pltpu.SemaphoreType.DMA,         # output flush
             pltpu.SemaphoreType.REGULAR,     # buffer-free credits
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interpret,

@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.primitives.cp_ring_attention.base import (
     CPRingAttention,
     causal_attention,
@@ -37,7 +38,7 @@ class AllGatherCPRingAttention(CPRingAttention):
             )
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None, None),) * 3,

@@ -16,6 +16,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu.ops.flash_attention import flash_attention
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.primitives.cp_ring_attention.base import CPRingAttention
 
 
@@ -58,7 +59,7 @@ class FlashCPRingAttention(CPRingAttention):
             )
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None, None),) * 3,

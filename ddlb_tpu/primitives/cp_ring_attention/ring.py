@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.primitives.cp_ring_attention.base import (
     NEG_INF as _NEG,
     CPRingAttention,
@@ -113,7 +114,7 @@ class RingCPRingAttention(CPRingAttention):
             return out.transpose(1, 0, 2).astype(q.dtype)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None, None),) * 3,

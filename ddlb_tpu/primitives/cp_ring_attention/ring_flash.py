@@ -26,6 +26,7 @@ from ddlb_tpu.ops.flash_attention import (
     flash_attention_chunk,
     init_flash_carry,
 )
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.primitives.cp_ring_attention.base import CPRingAttention
 
 
@@ -113,7 +114,7 @@ class RingFlashCPRingAttention(CPRingAttention):
 
         spec = P("tp", None, None)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(spec, spec, spec),

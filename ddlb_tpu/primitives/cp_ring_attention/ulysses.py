@@ -22,6 +22,7 @@ from __future__ import annotations
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.primitives.cp_ring_attention.base import (
     CPRingAttention,
     causal_attention,
@@ -110,7 +111,7 @@ class UlyssesCPRingAttention(CPRingAttention):
             return heads_to_seq(out)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None, None),) * 3,

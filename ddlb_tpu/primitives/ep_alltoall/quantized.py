@@ -37,16 +37,17 @@ from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
 class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
     def wire_bytes(self) -> float:
         """Dispatch moves int8 tokens (1 byte/elem — the halved-wire
-        win), combine returns operand-dtype outputs; both keep the
-        diagonal chunk local. Per-row scales are excluded like the
-        tp_columnwise member's."""
+        win) plus their per-token f32 scales on a second all_to_all,
+        combine returns operand-dtype outputs; all three keep the
+        diagonal chunk local. The scales term was missing until DDLB123
+        compared this formula against the traced census."""
         d = self.num_partitions
         if d <= 1:
             return 0.0
         from ddlb_tpu.perfmodel.cost import wire_itemsize
 
         per_dev = (self.m // d) * (
-            self.k * 1 + self.n * wire_itemsize(self.dtype)
+            self.k * 1 + 4 + self.n * wire_itemsize(self.dtype)
         )
         return per_dev * (d - 1) / d
 

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
 from ddlb_tpu.runtime import shard_map_compat
@@ -38,6 +39,26 @@ class JaxSPMDPPPipeline(PPPipeline):
 
     DEFAULT_OPTIONS = {"microbatches": 4}
     ALLOWED_VALUES = {"microbatches": (1, None)}
+
+    def wire_bytes(self) -> float:
+        """The step's actual per-device ppermute census, not the base
+        class's useful-activation floor (``m*n*isz``): XLA traces ONE
+        program, so both rings hop every tick they are wired for —
+        including ticks where a device forwards zeros. The drain ring
+        (``obuf``) moves ``[rows, n]`` on all ``ticks`` ticks and the
+        activation ring (``buf``) moves ``[rows, k]`` on the
+        ``mb + d - 2`` fill ticks. Found by DDLB123: the floor
+        under-counted this member ~3.8x at canonical shapes."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        mb = self.options["microbatches"]
+        rows = self.m // mb
+        isz = wire_itemsize(self.dtype)
+        ticks = max(mb + d - 1, mb + 2 * d - 3)
+        drain = ticks * rows * self.n * isz
+        activations = (mb + d - 2) * rows * self.k * isz
+        return float(drain + activations)
 
     def _check_shapes(self) -> None:
         super()._check_shapes()

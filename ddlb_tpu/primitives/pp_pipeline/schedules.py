@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import telemetry
+from ddlb_tpu.perfmodel.cost import wire_itemsize
 from ddlb_tpu.primitives.base import jnp_dtype, validation_atol
 from ddlb_tpu.primitives.pp_pipeline.base import PPPipeline
 from ddlb_tpu.runtime import shard_map_compat
@@ -64,6 +65,29 @@ class SchedulePPPipeline(PPPipeline):
         "microbatches": (1, None),
         "virtual": (1, 8),
     }
+
+    def wire_bytes(self) -> float:
+        """The training schedule's actual per-device wire: BOTH rings
+        (forward ``[rows, k]`` and backward ``[rows, n]``) hop on EVERY
+        schedule tick — idle arms still feed the unconditional ppermute
+        pair a zero buffer, and XLA moves it — plus the final
+        ``psum`` surfacing the collected ``[mb, rows, n]`` output.
+        The base class's forward-activation floor (``m*n*isz``)
+        under-counted this member ~8.5x at canonical shapes; found by
+        DDLB123, sized by the same host schedule tables the step
+        executes (``tables.ticks``)."""
+        d = self.num_partitions
+        if d <= 1:
+            return 0.0
+        mb = self.options["microbatches"]
+        rows = self.m // mb
+        isz = wire_itemsize(self.dtype)
+        tables = build_schedule(
+            self.options["schedule"], d, mb, self.num_stages // d
+        )
+        hops = tables.ticks * rows * (self.k + self.n) * isz
+        collect = 2.0 * (mb * rows * self.n * isz) * (d - 1) / d
+        return float(hops + collect)
 
     def _check_shapes(self) -> None:
         super()._check_shapes()

@@ -35,12 +35,15 @@ class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
     def wire_bytes(self) -> float:
         """The gathered shard travels as int8 (1 byte/elem), not the
         operand dtype the family base counts — the halved-wire win this
-        member exists for; the per-row f32 scales ride along but are
-        m/d floats against an m/d x k payload, excluded from the floor."""
+        member exists for — PLUS the per-row f32 scale vector that rides
+        the second all_gather (4 B per m/d row; 6% of traffic at k=64,
+        and real wire either way — DDLB123 holds the formula to the
+        traced census, which is how the missing term was found)."""
         d = self.num_partitions
         if d <= 1:
             return 0.0
-        return float((self.m // d) * self.k * (d - 1))  # int8: 1 B/elem
+        # int8 shard (1 B/elem) + f32 per-row scales (4 B/row)
+        return float((self.m // d) * (self.k + 4) * (d - 1))
 
     def _check_shapes(self) -> None:
         super()._check_shapes()

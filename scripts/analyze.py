@@ -82,6 +82,37 @@ def _changed_files(ref: str) -> list:
     )
 
 
+def spmd_trace(family: str) -> int:
+    """Dump one family's (or every family's) member collective traces —
+    the ``--spmd-trace`` debugging surface over the same driver DDLB123
+    verifies with."""
+    from ddlb_tpu.analysis.spmd import families as families_mod
+
+    known = sorted(families_mod.FAMILY_SHAPES)
+    if family != "all" and family not in known:
+        print(
+            f"analyze: unknown family {family!r} — one of: "
+            f"{', '.join(known)} (or 'all')",
+            file=sys.stderr,
+        )
+        return 2
+    wanted = None if family == "all" else [family]
+    reports = families_mod.verify_families(families=wanted)
+    drift = 0
+    for report in reports:
+        for line in report.describe():
+            print(line)
+        drift += report.status == "drift"
+    statuses = {}
+    for report in reports:
+        statuses[report.status] = statuses.get(report.status, 0) + 1
+    summary = ", ".join(
+        f"{n} {status}" for status, n in sorted(statuses.items())
+    )
+    print(f"spmd-trace: {len(reports)} member config(s): {summary}")
+    return 1 if drift else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="analyze.py",
@@ -132,7 +163,16 @@ def main(argv=None) -> int:
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--spmd-trace", metavar="FAMILY", default=None,
+        help="dump the semantic SPMD collective traces for one "
+        "registered family ('all' for every family) and exit — the "
+        "DDLB123 debugging surface",
+    )
     args = parser.parse_args(argv)
+
+    if args.spmd_trace is not None:
+        return spmd_trace(args.spmd_trace)
 
     if args.list_rules:
         for rule in core.all_rules():
@@ -176,7 +216,8 @@ def main(argv=None) -> int:
             )
             return 2
 
-    findings = core.analyze(paths, root=REPO)
+    contexts: list = []
+    findings = core.analyze(paths, root=REPO, contexts_out=contexts)
 
     baseline_path = Path(args.baseline)
     if not args.no_baseline:
@@ -229,7 +270,10 @@ def main(argv=None) -> int:
             findings, show_masked=args.show_masked
         ):
             print(line)
-        for line in output.shard_map_inventory(findings):
+        # migrated/total progress needs the full sweep's ASTs; a
+        # changed-only subset would under-count the migrated side
+        inventory_ctx = contexts if args.changed_only is None else ()
+        for line in output.shard_map_inventory(findings, inventory_ctx):
             print(line)
         masked = sum(
             1 for f in findings if f.suppressed or f.baselined

@@ -73,6 +73,32 @@ def render_text(report: dict) -> str:
     return "\n".join(lines)
 
 
+def static_cross_reference(report: dict) -> dict:
+    """The ``static_trace`` field of ``--json`` reports: every site the
+    dumps name (in-flight entries + the divergence verdict) that the
+    semantic SPMD pass also traced, mapped to its static location and
+    the collective sequence certified there — runtime divergence joined
+    to the exact code the analyzer walked. Best-effort: an environment
+    without the analysis tier just omits the field's entries."""
+    sites = set()
+    div = report.get("divergence_site")
+    if div:
+        sites.add(str(div).split("#")[0])
+    for state in report.get("ranks", {}).values():
+        for e in state.get("inflight", ()):
+            if e.get("site"):
+                sites.add(e["site"])
+    if not sites:
+        return {}
+    try:
+        from ddlb_tpu.analysis.spmd.sites import static_site_index
+
+        index = static_site_index()
+    except Exception:
+        return {}
+    return {s: index[s] for s in sorted(sites) if s in index}
+
+
 def diverged(report: dict) -> bool:
     """True when the report shows a problem worth a nonzero exit."""
     if not report.get("ranks"):
@@ -93,6 +119,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     report = flightrec.analyze_run(args.run_dir, expected_ranks=args.ranks)
     if args.as_json:
+        report["static_trace"] = static_cross_reference(report)
         print(json.dumps(report, indent=1, default=str))
     else:
         print(render_text(report))

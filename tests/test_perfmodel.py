@@ -254,10 +254,30 @@ class TestClosedFormCosts:
         assert est.compute_s == pytest.approx(
             2.0 * 512 * 256 * 256 / 197e12
         )
-        assert est.comm_s == pytest.approx(512 * 256 * 2 / (50.0 * GB))
+        # the step's actual ppermute census (DDLB123): drain ring every
+        # tick + activation ring on the mb+d-2 fill ticks, [rows, n]
+        # bf16 each (k == n)
+        mb = impl.options["microbatches"]
+        rows = 512 // mb
+        ticks = max(mb + d - 1, mb + 2 * d - 3)
+        wire = (ticks + mb + d - 2) * rows * 256 * 2
+        assert est.comm_s == pytest.approx(wire / (50.0 * GB))
         assert est.predicted_s == pytest.approx(
             max(est.compute_s, est.comm_s)
         )
+        assert d == 8
+
+    def test_pp_schedules_wire_counts_both_rings_every_tick(self):
+        from ddlb_tpu.utils.pipeline_schedule import build_schedule
+
+        impl = _stub("pp_pipeline", "schedules", 512, 256, 256)
+        d = impl.num_partitions
+        mb = impl.options["microbatches"]
+        rows = 512 // mb
+        ticks = build_schedule("1f1b", d, mb, 1).ticks
+        hops = ticks * rows * (256 + 256) * 2
+        collect = 2.0 * (mb * rows * 256 * 2) * (d - 1) / d
+        assert impl.wire_bytes() == pytest.approx(hops + collect)
         assert d == 8
 
     def test_collectives_ring_and_copy_roofline(self):
@@ -325,8 +345,13 @@ class TestClosedFormCosts:
         est_bf = estimate(bf, _v5e())
         # int8 MXU runs 2x the bf16 roofline -> half the compute floor
         assert est_q.compute_s == pytest.approx(est_bf.compute_s / 2.0)
-        # the gathered shard travels int8: half the family's bf16 wire
-        assert q.wire_bytes() == pytest.approx(bf.wire_bytes() / 2.0)
+        # the gathered shard travels int8 (half the bf16 wire) plus the
+        # per-row f32 scales' ride-along all_gather (DDLB123)
+        d = q.num_partitions
+        assert q.wire_bytes() == pytest.approx(
+            (512 // d) * (512 + 4) * (d - 1)
+        )
+        assert q.wire_bytes() < bf.wire_bytes()
 
     def test_quantized_reduction_wire_stays_operand_dtype(self):
         # tp_rowwise/dp quantized reduce in full precision: only the MXU
@@ -335,10 +360,11 @@ class TestClosedFormCosts:
         bf = _stub("tp_rowwise", "jax_spmd", 512, 512, 512)
         assert q.wire_bytes() == pytest.approx(bf.wire_bytes())
         assert q.cost_dtype() == "int8"
-        # ep quantized: int8 dispatch + operand-dtype combine
+        # ep quantized: int8 dispatch (+ 4 B/token f32 scales on the
+        # second all_to_all, DDLB123) + operand-dtype combine
         qep = _stub("ep_alltoall", "quantized", 512, 256, 128)
         d = qep.num_partitions
-        expected = (512 // d) * (128 * 1 + 256 * 2) * (d - 1) / d
+        expected = (512 // d) * (128 * 1 + 4 + 256 * 2) * (d - 1) / d
         assert qep.wire_bytes() == pytest.approx(expected)
 
     def test_speculate_hbm_floor_assumes_all_accepted(self):
