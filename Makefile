@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci chaos-launch clean
+	hooks ci chaos-launch overlap-report clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -50,6 +50,14 @@ ci:
 	$(PYTHON) scripts/analyze.py
 	$(PYTHON) scripts/analyze.py --sarif > analysis.sarif
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+# chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
+# unchunked overlap members, schedule-law self-check, banked transcript
+# at docs/overlap_demo.log) — scripts/perf_report.py --overlap runs
+# inside it over the sweep's CSVs (docs/source/performance.rst
+# "Chunked overlap engine")
+overlap-report:
+	$(PYTHON) scripts/overlap_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

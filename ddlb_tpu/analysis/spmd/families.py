@@ -111,6 +111,26 @@ MEMBER_CONFIGS: Dict[Tuple[str, str], List[Dict[str, Any]]] = {
         {"algorithm": "coll_pipeline", "s": 8},
         {"algorithm": "p2p_pipeline", "direction": "unidirectional"},
         {"algorithm": "p2p_pipeline", "direction": "bidirectional"},
+        {"algorithm": "chunked", "chunk_count": 1},
+        {"algorithm": "chunked", "chunk_count": 2},
+    ],
+    # the chunked-fusion engine members: chunking must not change the
+    # total wire, only the schedule (ISSUE 10 zero-drift invariant) —
+    # checked at two pipeline depths per family
+    ("tp_rowwise", "overlap"): [
+        {},
+        {"algorithm": "chunked", "chunk_count": 1},
+        {"algorithm": "chunked", "chunk_count": 2},
+    ],
+    ("dp_allreduce", "overlap"): [
+        {},
+        {"algorithm": "chunked", "chunk_count": 1},
+        {"algorithm": "chunked", "chunk_count": 2},
+    ],
+    ("ep_alltoall", "overlap"): [
+        {},
+        {"algorithm": "chunked", "chunk_count": 1},
+        {"algorithm": "chunked", "chunk_count": 2},
     ],
     # both quantization modes move wire (static: pre-quantized shard
     # gathered; dynamic: quantize-in-step then gather) — check each

@@ -50,6 +50,7 @@ import numpy as np
 from ddlb_tpu import envs, faults, telemetry
 from ddlb_tpu.faults import flightrec, heartbeat
 from ddlb_tpu.observatory import attribution as overlap_attribution
+from ddlb_tpu.perfmodel import cost as perfmodel_cost
 from ddlb_tpu.observatory import live, store
 from ddlb_tpu.faults.classify import TRANSIENT, classify_error
 from ddlb_tpu.native import now_ns, robust_stats
@@ -112,7 +113,10 @@ def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
         "bound": est.bound,
         "chip": est.chip,
         **overlap_attribution.attribute(
-            est, getattr(impl, "COST_SCHEDULE", "sequential"), measured_s
+            est,
+            getattr(impl, "COST_SCHEDULE", "sequential"),
+            measured_s,
+            chunks=perfmodel_cost.overlap_chunks(impl),
         ),
     }
 

@@ -16,14 +16,23 @@ Definitions, from the same closed-form terms the perfmodel computes
 - ``t_serial  = max(compute + comm, hbm)`` — the sequential schedule's
   floor (collective and GEMM back to back);
 - ``t_overlap = max(compute, comm, hbm)`` — the perfect-overlap floor;
+  for a chunked-fusion member (``chunks`` passed, the engine's
+  ``chunk_count``) the floor is the member's OWN schedule,
+  ``max(compute, comm) + min(compute, comm)/chunks`` — perfect overlap
+  minus the pipeline fill/drain (perfmodel.cost's chunk term);
 - ``hideable  = t_serial - t_overlap`` — the communication (or compute)
-  time a perfect pipeline hides entirely;
+  time the member's schedule can hide at best;
 - ``measured_overlap_frac = (t_serial - measured) / hideable`` clamped
   into [0, 1] — 1.0 means the member achieved the analytical overlap
   bound, 0.0 means it ran no better than the sequential schedule.
   Defined only for ``COST_SCHEDULE == "overlap"`` members with a
-  nonzero hideable window (a 1-device collective has nothing to hide);
-  NaN otherwise, so the column is trustworthy on every row;
+  hideable window meaningfully above float noise: a 1-device
+  collective has nothing to hide, and a schedule whose floor already
+  hides everything it ever could (``t_serial == t_overlap`` — e.g. the
+  chunked engine at ``chunk_count=1``, or a member with a zero comm or
+  compute term) has a ~0 denominator that used to escape as inf/junk;
+  both are clamped to NaN (schema: "no hideable window at this
+  schedule's granularity"), so the column is trustworthy on every row;
 - per-phase breakdown: ``phase_compute_s`` / ``phase_comm_s`` are the
   model's phase floors, and ``phase_idle_s = max(0, measured -
   t_overlap)`` is the time no roofline term explains — launch overhead,
@@ -43,6 +52,11 @@ from __future__ import annotations
 from typing import Any, Dict
 
 _NAN = float("nan")
+
+#: relative floor under which a hideable window counts as "nothing to
+#: hide": dividing by a denominator this far below the serial floor
+#: produces junk fractions (inf at exactly 0 pre-clamp), not signal
+_HIDEABLE_RTOL = 1e-9
 
 #: the attribution columns every result row carries (CSV header is fixed
 #: by the first row written, so defaults must exist on measured, crashed
@@ -66,15 +80,21 @@ def _term(est: Any, name: str) -> float:
     return value if value == value and value >= 0.0 else 0.0
 
 
-def attribute(est: Any, schedule: str, measured_s: float) -> Dict[str, Any]:
+def attribute(
+    est: Any, schedule: str, measured_s: float, chunks: Any = None
+) -> Dict[str, Any]:
     """The attribution columns for one row.
 
     ``est`` duck-types the perfmodel estimate (``compute_s`` /
     ``comm_s`` / ``hbm_s`` attributes or dict keys, seconds per call);
     ``schedule`` is the impl's ``COST_SCHEDULE``; ``measured_s`` the
-    measured median. Returns the ``ATTRIBUTION_ROW_DEFAULTS`` key set,
-    with NaN wherever the quantity is undefined (no measurement, no
-    hideable window, non-overlap schedule for the overlap fraction).
+    measured median; ``chunks`` the chunked-fusion pipeline depth when
+    the member declares one (``Primitive.overlap_chunks``) — it tilts
+    ``t_overlap`` to the member's own fill/drain-adjusted floor.
+    Returns the ``ATTRIBUTION_ROW_DEFAULTS`` key set, with NaN wherever
+    the quantity is undefined (no measurement, no hideable window at
+    this schedule's granularity, non-overlap schedule for the overlap
+    fraction).
     """
     compute = _term(est, "compute_s")
     comm = _term(est, "comm_s")
@@ -92,10 +112,14 @@ def attribute(est: Any, schedule: str, measured_s: float) -> Dict[str, Any]:
         return out
     t_serial = max(compute + comm, hbm)
     t_overlap = max(compute, comm, hbm)
+    if isinstance(chunks, (int, float)) and chunks >= 1:
+        t_overlap = max(
+            hbm, max(compute, comm) + min(compute, comm) / float(chunks)
+        )
     if t_overlap > 0.0:
         out["phase_idle_s"] = max(0.0, float(measured_s) - t_overlap)
     hideable = t_serial - t_overlap
-    if schedule == "overlap" and hideable > 0.0:
+    if schedule == "overlap" and hideable > _HIDEABLE_RTOL * t_serial:
         frac = (t_serial - float(measured_s)) / hideable
         out["measured_overlap_frac"] = min(1.0, max(0.0, frac))
     return out

@@ -1,0 +1,403 @@
+"""Shared chunked compute/collective fusion engine (T3-style pipelining).
+
+One ring scheduler behind every family's overlap member (ISSUE 10,
+generalizing the fixed-granularity rings of ``ops/collective_matmul.py``
+and ``ops/ring_collectives.py``): the GEMM is tiled along the sharded
+dimension into a configurable ``chunk_count`` pieces, each chunk's
+collective phase is an explicit ``ppermute`` ring, and chunk ``j+1``'s
+ring hops carry no data dependency on chunk ``j``'s matmul — XLA's
+async collectives + latency-hiding scheduler therefore overlap them,
+which is exactly the T3 (arxiv 2401.16677) / fused
+computation-collective (arxiv 2305.06942) schedule expressed in XLA's
+compilation model instead of CUDA streams.
+
+Double buffering: at steady state exactly two chunk buffers are live —
+the chunk being consumed by the MXU and the chunk in flight on the ring
+(rotating ``ppermute`` buffers in this shard_map path; the Pallas path
+holds the same two slots VMEM/HBM-resident, see ``pallas`` below).
+
+Schedule model (mirrored by ``perfmodel.cost``'s chunk-granularity
+term): with per-call compute floor ``C`` and wire floor ``W`` split
+into ``c`` chunks, the pipeline runs ``max(C, W) + min(C, W)/c`` — the
+fill/drain of one chunk's hidden phase is the part perfect overlap
+cannot remove. ``c=1`` degenerates to the sequential schedule
+``C + W``; ``c → ∞`` approaches the ideal ``max(C, W)``.
+
+Wire invariant (DDLB123): chunking must not change the total wire,
+only the schedule. Every builder here moves exactly the family's
+closed-form ring bytes — AG ``shard*(d-1)``, RS ``(S/d)*(d-1)``, AR
+``2*(S/d)*(d-1)``, A2A ``(shard/d)*(d-1)`` — because each chunk's ring
+moves ``1/c`` of the unchunked payload and there are ``c`` chunks; the
+semantic SPMD analyzer verifies this per member against
+``wire_bytes()``.
+
+Four builders, one per family overlap member:
+
+- ``build_chunked_ag_matmul``     — tp_columnwise: per-chunk ring AG,
+  then the chunk's GEMM (comm leads, compute drains);
+- ``build_chunked_matmul_rs``     — tp_rowwise: per-chunk partial GEMM,
+  then the chunk's ring RS (compute leads, comm drains);
+- ``build_chunked_matmul_ar``     — dp_allreduce: the gradient AR
+  decomposed RS→AG around each chunk's grad GEMM;
+- ``build_chunked_alltoall_expert`` — ep_alltoall: per-expert chunk
+  dispatch/combine exchanges around each chunk's expert GEMM.
+
+Pallas path: the VMEM-resident specialization of this engine is the
+hand-written RDMA kernel pair in ``ops/collective_matmul.py`` — their
+two comm-buffer slots are this module's rotating buffers held on-chip,
+with the ring granularity pinned to ``chunk_count == axis_size`` (one
+chunk per ring step, the only granularity the kernels' semaphore
+protocol encodes). ``build_chunked_ag_matmul`` / ``build_chunked_
+matmul_rs`` route there with ``path="pallas"`` and enforce that pin.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ddlb_tpu import native, telemetry
+from ddlb_tpu.primitives.base import accum_wire_dtypes
+
+
+def fwd_perm(d: int):
+    """The clockwise neighbor ring ``i -> i+1 (mod d)``."""
+    return [(i, (i + 1) % d) for i in range(d)]
+
+
+def plan_report(role: str, *, d: int, chunk_count: int, payload_elems: int) -> None:
+    """Emit the planned chunk/ring-step schedule into the telemetry
+    trace (host-side, at member construction): one ``overlap.chunk``
+    span per chunk, one ``overlap.ring_step`` span per planned hop
+    inside it — the structural record the trace reports join against
+    when diagnosing a chunked member's schedule."""
+    hops = max(0, d - 1)
+    for j in range(chunk_count):
+        with telemetry.span(
+            "overlap.chunk", role=role, chunk=j, chunks=chunk_count,
+            payload_elems=payload_elems,
+        ):
+            for t in range(hops):
+                with telemetry.span("overlap.ring_step", chunk=j, step=t):
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# per-chunk ring collectives (rotating ppermute buffers)
+# ---------------------------------------------------------------------------
+
+
+def ring_ag_chunk(piece, my_sched, *, axis_name: str, d: int):
+    """Ring all-gather of one chunk: ``piece [r, ...]`` -> ``[d, r, ...]``
+    rank-major. ``my_sched[t]`` is the rank whose piece this device
+    holds after ``t`` forward hops (``(my - t) mod d``, the native
+    planner's ``ag_fwd`` table row). The rotating buffer is the double
+    buffer: the copy landing in ``out`` and the copy in flight."""
+    fwd = fwd_perm(d)
+    out = jnp.zeros((d,) + piece.shape, piece.dtype)
+    buf = piece
+    for t in range(d):
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, buf[None], my_sched[t], axis=0
+        )
+        if t + 1 < d:
+            buf = jax.lax.ppermute(buf, axis_name, perm=fwd)
+    return out
+
+
+def ring_rs_chunk(partial, my_sched, *, axis_name: str, d: int,
+                  block_rows: int, acc_t, wire_t):
+    """Ring reduce-scatter of one chunk's partial sums:
+    ``partial [d*block_rows, n]`` (local partials, rank-major blocks) ->
+    ``[block_rows, n]`` — this device's block, summed over the ring.
+    ``my_sched[t]`` is the block folded at step ``t`` (``(my + d - 1 -
+    t) mod d``, the ``rs_fwd`` table row); the travelling accumulator
+    rides the wire in ``wire_t`` and folds in ``acc_t`` (the MXU's
+    native accumulation), same convention as the p2p rings."""
+    fwd = fwd_perm(d)
+    acc = jnp.zeros((block_rows, partial.shape[1]), acc_t)
+    for t in range(d):
+        block = jax.lax.dynamic_slice_in_dim(
+            partial, my_sched[t] * block_rows, block_rows, axis=0
+        )
+        acc = acc + block.astype(acc_t)
+        if t + 1 < d:
+            acc = jax.lax.ppermute(
+                acc.astype(wire_t), axis_name, perm=fwd
+            ).astype(acc_t)
+    return acc
+
+
+def ring_a2a_chunk(x, *, axis_name: str, d: int):
+    """All-to-all of one chunk as ``d-1`` shift-by-``t`` exchanges:
+    ``x [d, g, ...]`` (block ``e`` bound for device ``e``) ->
+    ``[d, g, ...]`` (block ``s`` arrived from device ``s``) — the
+    ``lax.all_to_all(split_axis=0, concat_axis=0)`` contract. The
+    diagonal block stays local, so the per-device wire is exactly
+    ``(d-1)/d`` of the payload, the A2A closed form."""
+    if d == 1:
+        return x
+    my = jax.lax.axis_index(axis_name)
+    out = jnp.zeros_like(x)
+    local = jax.lax.dynamic_slice_in_dim(x, my, 1, axis=0)
+    out = jax.lax.dynamic_update_slice_in_dim(out, local, my, axis=0)
+    for t in range(1, d):
+        # device i sends its block for i+t directly to i+t; the payload
+        # in flight and the block being consumed are the two live slots
+        perm = [(i, (i + t) % d) for i in range(d)]
+        send = jax.lax.dynamic_slice_in_dim(
+            x, jax.lax.rem(my + t, d), 1, axis=0
+        )
+        recv = jax.lax.ppermute(send, axis_name, perm=perm)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, recv, jax.lax.rem(my - t + d, d), axis=0
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# family builders (shard_map bodies)
+# ---------------------------------------------------------------------------
+
+
+def build_chunked_ag_matmul(
+    *,
+    m: int,
+    n: int,
+    k: int,
+    d: int,
+    chunk_count: int,
+    axis_name: str = "tp",
+    path: str = "shard_map",
+    interpret: Any = False,
+):
+    """AG+GEMM (tp_columnwise): ``a_shard [m/d, k]``, ``b [k, n]`` ->
+    ``[m, n]``. Each device's shard is tiled into ``chunk_count``
+    row-chunks; chunk ``j`` is ring-all-gathered and GEMMed while chunk
+    ``j+1``'s ring flies. Requires ``m % (d * chunk_count) == 0``."""
+    if m % (d * chunk_count):
+        raise ValueError(
+            f"m={m} must be divisible by partitions*chunk_count="
+            f"{d * chunk_count} for the chunked engine"
+        )
+    if path == "pallas":
+        return _pallas_ag_matmul(
+            d=d, chunk_count=chunk_count, axis_name=axis_name,
+            interpret=interpret,
+        )
+    rows_c = m // (d * chunk_count)  # rows per rank per chunk
+    sched = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
+    plan_report("ag_matmul", d=d, chunk_count=chunk_count,
+                payload_elems=rows_c * k)
+
+    def step(a_shard, b):
+        my = jax.lax.axis_index(axis_name)
+        my_sched = sched[my]
+        chunks = a_shard.reshape(chunk_count, rows_c, k)
+        tiles = []
+        for j in range(chunk_count):
+            gathered = ring_ag_chunk(
+                chunks[j], my_sched, axis_name=axis_name, d=d
+            )  # [d, rows_c, k] rank-major
+            tiles.append(gathered.reshape(d * rows_c, k) @ b)
+        # tile j rows are rank-major; global order is rank-major then
+        # chunk-major -> transpose (c, d) -> (d, c)
+        out = jnp.stack(tiles)  # [c, d*rows_c, n]
+        out = out.reshape(chunk_count, d, rows_c, n).transpose(1, 0, 2, 3)
+        return out.reshape(m, n)
+
+    return step
+
+
+def build_chunked_matmul_rs(
+    *,
+    m: int,
+    n: int,
+    k: int,
+    d: int,
+    chunk_count: int,
+    axis_name: str = "tp",
+    path: str = "shard_map",
+    interpret: Any = False,
+):
+    """GEMM+RS (tp_rowwise): ``a_shard [m, k/d]``, ``b_shard [k/d, n]``
+    -> ``[m/d, n]`` (this device's fully-reduced rows). Chunk ``j``'s
+    slab gathers the rows that land as every rank's local chunk-``j``
+    block (the coll_pipeline reindex, done once at trace time); its
+    partial GEMM then feeds a ring RS that flies under chunk ``j+1``'s
+    GEMM. Requires ``m % (d * chunk_count) == 0``."""
+    if m % (d * chunk_count):
+        raise ValueError(
+            f"m={m} must be divisible by partitions*chunk_count="
+            f"{d * chunk_count} for the chunked engine"
+        )
+    if path == "pallas":
+        return _pallas_matmul_rs(
+            d=d, chunk_count=chunk_count, axis_name=axis_name,
+            interpret=interpret,
+        )
+    rows_c = m // (d * chunk_count)  # rows per rank per chunk
+    kd = k // d
+    sched = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
+    plan_report("matmul_rs", d=d, chunk_count=chunk_count,
+                payload_elems=rows_c * n)
+
+    def step(a_shard, b_shard):
+        my = jax.lax.axis_index(axis_name)
+        my_sched = sched[my]
+        # accumulate f32, ride the wire in the operand dtype (comm-volume
+        # parity with the reference ring) — the single shared rule
+        acc_t, wire_t = accum_wire_dtypes(a_shard.dtype)
+        a4 = a_shard.reshape(d, chunk_count, rows_c, kd)
+        outs = []
+        for j in range(chunk_count):
+            slab = a4[:, j].reshape(d * rows_c, kd)
+            partial = jnp.matmul(slab, b_shard, preferred_element_type=acc_t)
+            outs.append(
+                ring_rs_chunk(
+                    partial, my_sched, axis_name=axis_name, d=d,
+                    block_rows=rows_c, acc_t=acc_t, wire_t=wire_t,
+                )
+            )  # [rows_c, n] — this rank's chunk-j rows, fully reduced
+        # local row order is chunk-major: [c, rows_c, n] -> [m/d, n]
+        return jnp.stack(outs).reshape(m // d, n).astype(a_shard.dtype)
+
+    return step
+
+
+def build_chunked_matmul_ar(
+    *,
+    m: int,
+    n: int,
+    k: int,
+    d: int,
+    chunk_count: int,
+    axis_name: str = "tp",
+):
+    """GEMM+AR (dp_allreduce): ``a_shard [m, k/d]``, ``b_shard
+    [k/d, n]`` -> ``[m, n]`` replicated. The gradient all-reduce is
+    decomposed RS→AG around each chunk's grad GEMM: chunk ``j`` (a
+    contiguous ``m/chunk_count`` row slab — every row is locally
+    present in the k-sharded layout) GEMMs its partial, ring-reduce-
+    scatters it, and ring-all-gathers the reduced blocks, with chunk
+    ``j+1``'s GEMM overlapping both rings. Requires
+    ``m % (d * chunk_count) == 0``."""
+    if m % (d * chunk_count):
+        raise ValueError(
+            f"m={m} must be divisible by partitions*chunk_count="
+            f"{d * chunk_count} for the chunked engine"
+        )
+    rows_c = m // (d * chunk_count)  # rows per rank-block per chunk
+    kd = k // d
+    sched_rs = jnp.asarray(native.ring_schedule(d, "rs_fwd"))
+    sched_ag = jnp.asarray(native.ring_schedule(d, "ag_fwd"))
+    plan_report("matmul_ar", d=d, chunk_count=chunk_count,
+                payload_elems=rows_c * n)
+
+    def step(a_shard, b_shard):
+        my = jax.lax.axis_index(axis_name)
+        my_rs, my_ag = sched_rs[my], sched_ag[my]
+        # accumulate f32, ride the wire in the operand dtype — the
+        # single shared rule (primitives.base.accum_wire_dtypes)
+        acc_t, wire_t = accum_wire_dtypes(a_shard.dtype)
+        a3 = a_shard.reshape(chunk_count, d * rows_c, kd)
+        outs = []
+        for j in range(chunk_count):
+            partial = jnp.matmul(a3[j], b_shard, preferred_element_type=acc_t)
+            red = ring_rs_chunk(
+                partial, my_rs, axis_name=axis_name, d=d,
+                block_rows=rows_c, acc_t=acc_t, wire_t=wire_t,
+            )  # [rows_c, n] — this rank's block of the slab, reduced
+            gathered = ring_ag_chunk(
+                red.astype(a_shard.dtype), my_ag, axis_name=axis_name, d=d
+            )  # [d, rows_c, n] rank-major == slab row order
+            outs.append(gathered.reshape(d * rows_c, n))
+        return jnp.concatenate(outs, axis=0)  # [m, n]
+
+    return step
+
+
+def build_chunked_alltoall_expert(
+    *,
+    m: int,
+    n: int,
+    k: int,
+    d: int,
+    chunk_count: int,
+    axis_name: str = "tp",
+):
+    """Dispatch/GEMM/combine (ep_alltoall): ``a_loc [m/d, k]``,
+    ``w_loc [1, k, n]`` (resident expert) -> ``[m/d, n]`` in token
+    order. Every routing group is tiled into ``chunk_count`` chunks;
+    chunk ``j``'s dispatch exchange, expert GEMM and combine exchange
+    pipeline against chunks ``j±1``. Requires
+    ``m % (d*d*chunk_count) == 0``."""
+    if m % (d * d * chunk_count):
+        raise ValueError(
+            f"m={m} must be divisible by partitions^2*chunk_count="
+            f"{d * d * chunk_count} for the chunked engine"
+        )
+    gc = m // (d * d * chunk_count)  # tokens per chunk per group
+    plan_report("alltoall_expert", d=d, chunk_count=chunk_count,
+                payload_elems=gc * k)
+
+    def step(a_loc, w_loc):
+        acc_t, _ = accum_wire_dtypes(a_loc.dtype)
+        # [dst group, chunk, token, k]
+        x = a_loc.reshape(d, chunk_count, gc, k)
+        outs = []
+        for j in range(chunk_count):
+            xj = ring_a2a_chunk(x[:, j], axis_name=axis_name, d=d)
+            yj = jnp.matmul(
+                xj.reshape(d * gc, k), w_loc[0], preferred_element_type=acc_t
+            )
+            yj = yj.astype(a_loc.dtype).reshape(d, gc, n)
+            outs.append(ring_a2a_chunk(yj, axis_name=axis_name, d=d))
+        out = jnp.stack(outs, axis=1)  # [group, chunk, gc, n]
+        return out.reshape(d * chunk_count * gc, n)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# pallas path (VMEM-resident double buffers; granularity pinned to the ring)
+# ---------------------------------------------------------------------------
+
+
+def _require_ring_granularity(chunk_count: int, d: int) -> None:
+    if chunk_count != d:
+        raise ValueError(
+            f"the pallas path's semaphore protocol pins chunk_count to "
+            f"the ring size (one chunk per RDMA step): got "
+            f"chunk_count={chunk_count}, axis_size={d}"
+        )
+
+
+def _pallas_ag_matmul(*, d, chunk_count, axis_name, interpret):
+    from ddlb_tpu.ops.collective_matmul import ring_ag_matmul
+
+    _require_ring_granularity(chunk_count, d)
+
+    def step(a_shard, b):
+        return ring_ag_matmul(
+            a_shard, b, axis_name=axis_name, axis_size=d,
+            interpret=interpret,
+        )
+
+    return step
+
+
+def _pallas_matmul_rs(*, d, chunk_count, axis_name, interpret):
+    from ddlb_tpu.ops.collective_matmul import ring_matmul_rs
+
+    _require_ring_granularity(chunk_count, d)
+
+    def step(a_shard, b_shard):
+        return ring_matmul_rs(
+            a_shard, b_shard, axis_name=axis_name, axis_size=d,
+            interpret=interpret,
+        )
+
+    return step

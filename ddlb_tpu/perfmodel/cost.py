@@ -23,7 +23,14 @@ The terms combine per the implementation's ``COST_SCHEDULE``:
 - ``"sequential"`` (default): ``max(compute + comm, hbm)`` — the config
   runs its collective and its GEMM back to back;
 - ``"overlap"`` (overlap / pallas / ring / pipeline members):
-  ``max(compute, comm, hbm)`` — the analytical overlap lower bound;
+  ``max(compute, comm, hbm)`` — the analytical overlap lower bound.
+  Members whose pipeline has a KNOWN finite granularity (the
+  chunked-fusion engine: ``impl.overlap_chunks()`` returns the swept
+  ``chunk_count``) additionally pay the pipeline fill/drain —
+  ``min(compute, comm) / chunks``, i.e. ``1/chunks`` of the serial
+  collective's hideable time — so ``predicted_s`` tracks the schedule
+  the member actually runs: ``chunks=1`` degenerates to the
+  sequential floor, ``chunks → ∞`` to the ideal ``max()``;
 - ``"compute_only"``: the comm term is dropped (the member deliberately
   runs no collective): ``max(compute, hbm)``.
 
@@ -121,6 +128,23 @@ def _comm_term(impl, spec: ChipSpec) -> float:
         return 0.0
     transport = impl.options.get("transport", "ici")
     return float(wire()) / spec.link_bw(transport)
+
+
+def overlap_chunks(impl) -> Optional[int]:
+    """The impl's finite pipeline depth, when it declares one
+    (``Primitive.overlap_chunks`` — the chunked-fusion engine's
+    ``chunk_count``); ``None`` for ideal-overlap members and duck-typed
+    stubs that don't implement the hook."""
+    hook = getattr(impl, "overlap_chunks", None)
+    if not callable(hook):
+        return None
+    try:
+        chunks = hook()
+    except Exception:
+        return None
+    if isinstance(chunks, (int, float)) and chunks >= 1:
+        return int(chunks)
+    return None
 
 
 Terms = Tuple[float, float, float]  # (compute_s, comm_s, hbm_s)
@@ -232,6 +256,13 @@ def estimate(impl, spec: Optional[ChipSpec] = None) -> CostEstimate:
         predicted = max(compute, hbm)
     elif schedule == "overlap":
         predicted = max(compute, comm, hbm)
+        chunks = overlap_chunks(impl)
+        if chunks is not None:
+            # chunk-granularity fill/drain: a c-deep pipeline hides all
+            # but 1/c of the shorter phase (T3's schedule law)
+            predicted = max(
+                hbm, max(compute, comm) + min(compute, comm) / chunks
+            )
     else:
         predicted = max(compute + comm, hbm)
     # the verdict column: which roofline this config sits under

@@ -136,6 +136,20 @@ class Primitive(ABC):
         """The dtype whose MXU peak prices this impl's compute term."""
         return self.COST_DTYPE or self.dtype
 
+    def overlap_chunks(self) -> Optional[int]:
+        """Pipeline depth of an ``"overlap"``-schedule member whose
+        comm/compute interleave has a KNOWN finite granularity (the
+        chunked-fusion engine's ``chunk_count``): the cost model then
+        prices the pipeline fill/drain — ``min(compute, comm)/chunks``
+        on top of the ideal ``max()`` — instead of assuming perfect
+        overlap. The ``algorithm="chunked"`` convention is the engine's
+        contract, shared by every overlap member that adopts it, so the
+        rule lives here once; ``None`` (every other member/algorithm)
+        keeps the ideal-overlap lower bound."""
+        if self.options.get("algorithm") == "chunked":
+            return int(self.options["chunk_count"])
+        return None
+
     #: option schema discovered reflectively by the runner
     #: (reference ddlb/benchmark.py:76-77, 107-110)
     DEFAULT_OPTIONS: Dict[str, Any] = {}

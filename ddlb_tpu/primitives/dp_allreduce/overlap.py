@@ -16,6 +16,12 @@ overlapped collective is a full all-reduce of the gradient:
   ``m % partitions == 0``). ``direction='bidirectional'`` runs both ring
   directions with half-chunks, using both ICI link directions of the torus
   (TPU-first improvement, no reference analogue).
+- ``chunked``: the shared chunked-fusion engine
+  (``ops/chunked_fusion.py``, ISSUE 10): the gradient all-reduce
+  decomposed RS→AG around each of a swept ``chunk_count`` row-chunks'
+  grad GEMMs, the rings double-buffered ``ppermute`` hops that fly
+  under the neighboring chunks' GEMMs; ``overlap_chunks`` prices the
+  fill/drain in the perfmodel.
 """
 
 from __future__ import annotations
@@ -25,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import native
+from ddlb_tpu.ops import chunked_fusion
 from ddlb_tpu.primitives.base import accum_wire_dtypes
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
 from ddlb_tpu.runtime import shard_map_compat
@@ -39,11 +46,13 @@ class OverlapDPAllReduce(DPAllReduce):
         "algorithm": "coll_pipeline",
         "s": 8,
         "direction": "unidirectional",
+        "chunk_count": 2,
     }
     ALLOWED_VALUES = {
-        "algorithm": ["default", "coll_pipeline", "p2p_pipeline"],
+        "algorithm": ["default", "coll_pipeline", "p2p_pipeline", "chunked"],
         "s": (1, None),
         "direction": ["unidirectional", "bidirectional"],
+        "chunk_count": (1, None),
     }
 
     def _check_shapes(self) -> None:
@@ -55,6 +64,13 @@ class OverlapDPAllReduce(DPAllReduce):
                 f"m={self.m} must be divisible by s={self.options['s']} "
                 f"for coll_pipeline"
             )
+        if algo == "chunked":
+            c = self.options["chunk_count"]
+            if self.m % (d * c) != 0:
+                raise ValueError(
+                    f"m={self.m} must be divisible by partitions*"
+                    f"chunk_count={d * c} for the chunked engine"
+                )
         if algo == "p2p_pipeline":
             need = (
                 2 * d if self.options["direction"] == "bidirectional" else d
@@ -72,6 +88,7 @@ class OverlapDPAllReduce(DPAllReduce):
             "default": self._build_default,
             "coll_pipeline": self._build_coll_pipeline,
             "p2p_pipeline": self._build_p2p_pipeline,
+            "chunked": self._build_chunked,
         }[algo]
         # shard_map_compat: jax.shard_map where available, the pre-0.5
         # experimental entry point otherwise (ROADMAP open item — this
@@ -87,6 +104,12 @@ class OverlapDPAllReduce(DPAllReduce):
         )
 
     # -- algorithms ----------------------------------------------------------
+
+    def _build_chunked(self):
+        return chunked_fusion.build_chunked_matmul_ar(
+            m=self.m, n=self.n, k=self.k, d=self.num_partitions,
+            chunk_count=int(self.options["chunk_count"]),
+        )
 
     def _build_default(self):
         def step(a_shard, b_shard):

@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu.ops.collective_matmul import ring_matmul_rs
 from ddlb_tpu.ops.matmul import matmul
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class PallasDPAllReduce(DPAllReduce):
@@ -105,8 +106,11 @@ class PallasDPAllReduce(DPAllReduce):
                 partial = matmul(a_shard, b_shard, **blocks)
                 return jax.lax.psum(partial, "tp")
 
+        # shard_map_compat: jax.shard_map where available, the pre-0.5
+        # experimental entry point otherwise (ROADMAP open item — this
+        # unlocks the xla_collective member on the jax 0.4.x fleet)
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

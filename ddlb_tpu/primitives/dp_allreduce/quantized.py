@@ -24,6 +24,7 @@ from ddlb_tpu.ops.quantized_matmul import (
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.dp_allreduce.base import DPAllReduce
 from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class QuantizedDPAllReduce(QuantizedGEMMMixin, DPAllReduce):
@@ -46,10 +47,13 @@ class QuantizedDPAllReduce(QuantizedGEMMMixin, DPAllReduce):
             bq, sb = quantize_colwise(b_shard)
             return aq, sa, bq, sb
 
+        # shard_map_compat: jax.shard_map where available, the pre-0.5
+        # experimental entry point otherwise (ROADMAP open item — this
+        # unlocks the member on the jax 0.4.x fleet)
         if self.options["quantize"] == "static":
             self.aq, self.sa, self.bq, self.sb = jax.block_until_ready(
                 jax.jit(
-                    jax.shard_map(
+                    shard_map_compat(
                         quant_shards,
                         mesh=self.mesh,
                         in_specs=(P(None, "tp"), P("tp", None)),
@@ -64,7 +68,7 @@ class QuantizedDPAllReduce(QuantizedGEMMMixin, DPAllReduce):
                 )(self.a, self.b)
             )
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     partial_ar,
                     mesh=self.mesh,
                     in_specs=(
@@ -87,7 +91,7 @@ class QuantizedDPAllReduce(QuantizedGEMMMixin, DPAllReduce):
                 return partial_ar(aq, sa, bq, sb)
 
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     step,
                     mesh=self.mesh,
                     in_specs=(P(None, "tp"), P("tp", None)),

@@ -9,6 +9,13 @@ The EP member of the overlap family (reference nvFuser pipeline algorithms,
   i's combine all-to-all and chunk i+1's dispatch all-to-all run while
   chunk i's expert GEMM executes — XLA's async collectives overlap the
   exchanges with the MXU work. Constraint ``m % (d^2 * s) == 0``.
+- ``chunked``: the shared chunked-fusion engine
+  (``ops/chunked_fusion.py``, ISSUE 10): per-expert chunk dispatch —
+  each routing group tiled into a swept ``chunk_count`` chunks whose
+  dispatch/combine exchanges are explicit shift-``ppermute`` steps
+  pipelining against the neighboring chunks' expert GEMMs;
+  ``overlap_chunks`` prices the fill/drain in the perfmodel.
+  Constraint ``m % (d^2 * chunk_count) == 0``.
 """
 
 from __future__ import annotations
@@ -17,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ddlb_tpu.ops import chunked_fusion
 from ddlb_tpu.primitives.base import acc_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 from ddlb_tpu.runtime import shard_map_compat
@@ -27,10 +35,11 @@ class OverlapEPAllToAll(EPAllToAll):
     #: max(compute, comm) — the analytical overlap lower bound
     COST_SCHEDULE = "overlap"
 
-    DEFAULT_OPTIONS = {"algorithm": "coll_pipeline", "s": 4}
+    DEFAULT_OPTIONS = {"algorithm": "coll_pipeline", "s": 4, "chunk_count": 2}
     ALLOWED_VALUES = {
-        "algorithm": ["default", "coll_pipeline"],
+        "algorithm": ["default", "coll_pipeline", "chunked"],
         "s": (1, None),
+        "chunk_count": (1, None),
     }
 
     def _check_shapes(self) -> None:
@@ -44,6 +53,13 @@ class OverlapEPAllToAll(EPAllToAll):
                 f"m={self.m} must be divisible by d^2*s={d * d * s} for "
                 f"coll_pipeline"
             )
+        if self.options["algorithm"] == "chunked":
+            c = self.options["chunk_count"]
+            if self.m % (d * d * c) != 0:
+                raise ValueError(
+                    f"m={self.m} must be divisible by d^2*chunk_count="
+                    f"{d * d * c} for the chunked engine"
+                )
 
     def _input_setup(self) -> None:
         super()._input_setup()
@@ -55,7 +71,13 @@ class OverlapEPAllToAll(EPAllToAll):
                 t, "tp", split_axis=0, concat_axis=0, tiled=True
             )
 
-        if self.options["algorithm"] == "default":
+        if self.options["algorithm"] == "chunked":
+            step = chunked_fusion.build_chunked_alltoall_expert(
+                m=self.m, n=self.n, k=self.k, d=d,
+                chunk_count=int(self.options["chunk_count"]),
+            )
+
+        elif self.options["algorithm"] == "default":
             g = self.group_tokens
 
             def step(a_loc, w_loc):

@@ -26,6 +26,13 @@ Algorithms (option names mirror fuser.py:160-178):
   'bidirectional'`` splits each chunk in half and runs both ring
   directions at once — a TPU-first improvement that uses both ICI link
   directions of the torus; no reference analogue.
+- ``chunked``: the shared chunked-fusion engine
+  (``ops/chunked_fusion.py``, ISSUE 10): the shard tiled into a swept
+  ``chunk_count`` row-chunks, each chunk ring-all-gathered over
+  double-buffered ``ppermute`` hops that fly under the previous
+  chunk's GEMM. The perfmodel prices this member's fill/drain
+  explicitly (``overlap_chunks``), so its ``predicted_s`` tracks the
+  chunk granularity instead of assuming ideal overlap.
 """
 
 from __future__ import annotations
@@ -35,6 +42,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import native
+from ddlb_tpu.ops import chunked_fusion
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
 from ddlb_tpu.runtime import shard_map_compat
 
@@ -48,11 +56,13 @@ class OverlapTPColumnwise(TPColumnwise):
         "algorithm": "coll_pipeline",
         "s": 8,
         "direction": "unidirectional",
+        "chunk_count": 2,
     }
     ALLOWED_VALUES = {
-        "algorithm": ["default", "coll_pipeline", "p2p_pipeline"],
+        "algorithm": ["default", "coll_pipeline", "p2p_pipeline", "chunked"],
         "s": (1, None),
         "direction": ["unidirectional", "bidirectional"],
+        "chunk_count": (1, None),
     }
 
     def _check_shapes(self) -> None:
@@ -66,6 +76,13 @@ class OverlapTPColumnwise(TPColumnwise):
                 f"m={self.m} must be divisible by partitions*s={d * s} "
                 f"for coll_pipeline"
             )
+        if algo == "chunked":
+            c = self.options["chunk_count"]
+            if self.m % (d * c) != 0:
+                raise ValueError(
+                    f"m={self.m} must be divisible by partitions*"
+                    f"chunk_count={d * c} for the chunked engine"
+                )
         if algo == "p2p_pipeline":
             if self.options.get("direction") == "bidirectional" and (
                 self.m % (2 * d) != 0
@@ -82,6 +99,7 @@ class OverlapTPColumnwise(TPColumnwise):
             "default": self._build_default,
             "coll_pipeline": self._build_coll_pipeline,
             "p2p_pipeline": self._build_p2p_pipeline,
+            "chunked": self._build_chunked,
         }[algo]
         self._fn = jax.jit(
             shard_map_compat(
@@ -94,6 +112,12 @@ class OverlapTPColumnwise(TPColumnwise):
         )
 
     # -- algorithms ----------------------------------------------------------
+
+    def _build_chunked(self):
+        return chunked_fusion.build_chunked_ag_matmul(
+            m=self.m, n=self.n, k=self.k, d=self.num_partitions,
+            chunk_count=int(self.options["chunk_count"]),
+        )
 
     def _build_default(self):
         def step(a_shard, b):

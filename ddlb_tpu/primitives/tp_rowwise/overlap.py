@@ -19,6 +19,12 @@ are exactly the reference's long-context mechanism (SURVEY.md section 5,
   (fuser.py:256-258). ``direction='bidirectional'`` runs both ring
   directions with half-chunks (TPU torus improvement, no reference
   analogue).
+- ``chunked``: the shared chunked-fusion engine
+  (``ops/chunked_fusion.py``, ISSUE 10): the output rows tiled into a
+  swept ``chunk_count`` chunks, each chunk's partial GEMM feeding a
+  double-buffered ``ppermute`` ring reduce-scatter that flies under
+  the next chunk's GEMM; ``overlap_chunks`` prices the fill/drain in
+  the perfmodel.
 """
 
 from __future__ import annotations
@@ -28,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ddlb_tpu import native
+from ddlb_tpu.ops import chunked_fusion
 from ddlb_tpu.primitives.base import accum_wire_dtypes as _accum_dtypes
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
 from ddlb_tpu.runtime import shard_map_compat
@@ -42,11 +49,13 @@ class OverlapTPRowwise(TPRowwise):
         "algorithm": "coll_pipeline",
         "s": 8,
         "direction": "unidirectional",
+        "chunk_count": 2,
     }
     ALLOWED_VALUES = {
-        "algorithm": ["default", "coll_pipeline", "p2p_pipeline"],
+        "algorithm": ["default", "coll_pipeline", "p2p_pipeline", "chunked"],
         "s": (1, None),
         "direction": ["unidirectional", "bidirectional"],
+        "chunk_count": (1, None),
     }
 
     def _check_shapes(self) -> None:
@@ -58,6 +67,13 @@ class OverlapTPRowwise(TPRowwise):
                 f"m={self.m} must be divisible by partitions*s="
                 f"{d * self.options['s']} for coll_pipeline"
             )
+        if algo == "chunked":
+            c = self.options["chunk_count"]
+            if self.m % (d * c) != 0:
+                raise ValueError(
+                    f"m={self.m} must be divisible by partitions*"
+                    f"chunk_count={d * c} for the chunked engine"
+                )
         if (
             algo == "p2p_pipeline"
             and self.options["direction"] == "bidirectional"
@@ -75,6 +91,7 @@ class OverlapTPRowwise(TPRowwise):
             "default": self._build_default,
             "coll_pipeline": self._build_coll_pipeline,
             "p2p_pipeline": self._build_p2p_pipeline,
+            "chunked": self._build_chunked,
         }[algo]
         self._fn = jax.jit(
             shard_map_compat(
@@ -87,6 +104,12 @@ class OverlapTPRowwise(TPRowwise):
         )
 
     # -- algorithms ----------------------------------------------------------
+
+    def _build_chunked(self):
+        return chunked_fusion.build_chunked_matmul_rs(
+            m=self.m, n=self.n, k=self.k, d=self.num_partitions,
+            chunk_count=int(self.options["chunk_count"]),
+        )
 
     def _build_default(self):
         def step(a_shard, b_shard):

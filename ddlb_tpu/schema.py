@@ -68,7 +68,9 @@ ROW_COLUMNS: Dict[str, str] = {
     # -- observatory measured-overlap attribution (ISSUE 6) -------------
     "measured_overlap_frac": (
         "achieved overlap fraction: (serial floor - measured) / hideable,"
-        " in [0, 1]; NaN off overlap members"
+        " in [0, 1]; NaN off overlap members AND on rows with no hideable"
+        " window at the schedule's granularity (1-device collective, zero"
+        " comm/compute term, chunked engine at chunk_count=1) — never inf"
     ),
     "phase_compute_s": "model compute-phase floor (MXU term, seconds)",
     "phase_comm_s": "model comm-phase floor (wire term, seconds)",

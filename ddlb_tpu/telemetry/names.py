@@ -25,6 +25,8 @@ SPAN_NAMES: Dict[str, str] = {
     "compile_ahead.prefetch": "background prefetch-compile of config N+1",
     "device_loop.build": "differential device-loop executable build",
     "device_loop.window": "one timed device-loop window",
+    "overlap.chunk": "chunked-fusion engine: one planned pipeline chunk",
+    "overlap.ring_step": "chunked-fusion engine: one planned ring hop",
     "pool.lease": "warm-worker pool lease acquisition",
     "pool.respawn": "pool worker respawn after death/recycle",
     "pool.spawn": "pool worker cold spawn",

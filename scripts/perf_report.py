@@ -11,7 +11,7 @@ cannot give, because a slower impl at a higher fraction of ITS bound
 faster one leaving MXU cycles on the floor.
 
 Usage:
-    python scripts/perf_report.py results/*.csv [--json] [--metric median]
+    python scripts/perf_report.py results/*.csv [--json] [--overlap]
 
 Per (primitive, implementation, option) group the report shows the
 median roofline fraction, the median predicted and measured times, the
@@ -19,6 +19,15 @@ dominating bound, and how many rows measured vs errored. ``--json``
 emits the same structure machine-readably (the driver/CI consumer).
 Rows predating the perfmodel columns (old CSVs) are skipped with a note
 rather than crashing the report.
+
+``--overlap`` switches to the overlap-member ranking (ISSUE 10): only
+rows carrying a ``measured_overlap_frac`` measurement (the observatory
+attribution column stamped on ``COST_SCHEDULE == "overlap"`` members),
+ranked per family by achieved overlap fraction NEXT TO the roofline
+fraction, with the chunked-fusion engine's ``chunk_count`` split out of
+the option string as its own column — the view that answers "which
+schedule granularity actually hides the collective". Composes with
+``--json``.
 """
 
 from __future__ import annotations
@@ -118,6 +127,134 @@ def summarize(rows):
     return families
 
 
+def _chunk_count(option_repr):
+    """The chunked engine's swept granularity, parsed back out of the
+    ``k=v;...`` option string (``_format_options``); None when the row
+    is not a chunked-engine config (legacy overlap algorithms)."""
+    fields = dict(
+        part.split("=", 1)
+        for part in (option_repr or "").split(";")
+        if "=" in part
+    )
+    if fields.get("algorithm") != "chunked":
+        return None
+    try:
+        return int(fields["chunk_count"])
+    except (KeyError, ValueError):
+        return None
+
+
+def summarize_overlap(rows):
+    """Per-family overlap ranking: one entry per (implementation,
+    option) group that measured at least one ``measured_overlap_frac``
+    (NaN rows — non-overlap schedules, no hideable window — drop out by
+    schema), sorted by median achieved overlap fraction descending,
+    ``chunk_count`` carried as its own column."""
+    groups = {}
+    for row in rows:
+        key = (
+            row.get("primitive", ""),
+            row.get("base_implementation") or row.get("implementation", ""),
+            row.get("option", ""),
+        )
+        groups.setdefault(key, []).append(row)
+
+    families = {}
+    for (primitive, impl, option), grp in groups.items():
+        fracs = [_fnum(r.get("measured_overlap_frac")) for r in grp]
+        fracs = [v for v in fracs if v is not None]
+        if not fracs:
+            continue
+        entry = {
+            "implementation": impl,
+            "option": option,
+            "chunk_count": _chunk_count(option),
+            "rows": len(grp),
+            "overlap_frac": _median(fracs),
+            "roofline_frac": _median(
+                [_fnum(r.get("roofline_frac")) for r in grp]
+            ),
+            "predicted_ms": _median(
+                [
+                    None if v is None else v * 1e3
+                    for v in (_fnum(r.get("predicted_s")) for r in grp)
+                ]
+            ),
+            "measured_ms": _median(
+                [_fnum(r.get("median time (ms)")) for r in grp]
+            ),
+            "idle_ms": _median(
+                [
+                    None if v is None else v * 1e3
+                    for v in (_fnum(r.get("phase_idle_s")) for r in grp)
+                ]
+            ),
+        }
+        families.setdefault(primitive, []).append(entry)
+
+    for primitive in families:
+        families[primitive].sort(
+            key=lambda e: (
+                e["overlap_frac"] is None,
+                -(e["overlap_frac"] or 0.0),
+            )
+        )
+    return families
+
+
+def render_overlap_text(families, skipped):
+    lines = []
+    for primitive in sorted(families):
+        entries = families[primitive]
+        lines.append(f"== {primitive} (overlap members) ==")
+        lines.append(
+            f"{'rank':>4}  {'impl':<14} {'overlap':>8} {'roofline':>9} "
+            f"{'chunks':>6} {'pred ms':>10} {'meas ms':>10} {'idle ms':>9}"
+            f"  option"
+        )
+        for rank, e in enumerate(entries, 1):
+            ov = (
+                f"{e['overlap_frac']:.4g}"
+                if e["overlap_frac"] is not None
+                else "-"
+            )
+            rf = (
+                f"{e['roofline_frac']:.4g}"
+                if e["roofline_frac"] is not None
+                else "-"
+            )
+            ck = str(e["chunk_count"]) if e["chunk_count"] else "-"
+            pred = (
+                f"{e['predicted_ms']:.4f}"
+                if e["predicted_ms"] is not None
+                else "-"
+            )
+            meas = (
+                f"{e['measured_ms']:.4f}"
+                if e["measured_ms"] is not None
+                else "-"
+            )
+            idle = (
+                f"{e['idle_ms']:.4f}" if e["idle_ms"] is not None else "-"
+            )
+            lines.append(
+                f"{rank:>4}  {e['implementation']:<14} {ov:>8} {rf:>9} "
+                f"{ck:>6} {pred:>10} {meas:>10} {idle:>9}  {e['option']}"
+            )
+        lines.append("")
+    if not families:
+        lines.append(
+            "no rows carry a measured_overlap_frac — run a sweep that "
+            "includes overlap members (or see docs/overlap_demo.log)"
+        )
+    for path in skipped:
+        lines.append(
+            f"note: {path} predates the perfmodel columns — skipped "
+            f"(re-run the sweep to get roofline_frac)"
+        )
+    return "\n".join(lines)
+
+
 def render_text(families, skipped):
     lines = []
     for primitive in sorted(families):
@@ -167,6 +304,11 @@ def main(argv=None) -> int:
         "--json", action="store_true",
         help="emit the ranking as JSON instead of the text table",
     )
+    parser.add_argument(
+        "--overlap", action="store_true",
+        help="rank overlap members by measured_overlap_frac (next to "
+             "roofline_frac), per family and chunk_count",
+    )
     args = parser.parse_args(argv)
 
     missing = [p for p in args.csvs if not os.path.exists(p)]
@@ -181,7 +323,9 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    families = summarize(rows)
+    families = (
+        summarize_overlap(rows) if args.overlap else summarize(rows)
+    )
     if args.json:
         print(
             json.dumps(
@@ -190,7 +334,8 @@ def main(argv=None) -> int:
             )
         )
     else:
-        print(render_text(families, skipped))
+        render = render_overlap_text if args.overlap else render_text
+        print(render(families, skipped))
     return 0
 
 
