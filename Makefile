@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci chaos-launch overlap-report clean
+	hooks ci chaos-launch overlap-report serving-load-report clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -44,12 +44,14 @@ hooks:
 	@echo "git hooks installed (core.hooksPath = scripts/hooks)"
 
 # the CI gate: full analyzer sweep (SARIF artifact for code-scanning
-# upload — see docs/source/static_analysis.rst "CI integration"), then
-# the tier-1 test surface
+# upload — see docs/source/static_analysis.rst "CI integration"), the
+# tier-1 test surface, then the serving-load acceptance sweep (knee +
+# SLO gate on CPU sim — docs/source/observability.rst)
 ci:
 	$(PYTHON) scripts/analyze.py
 	$(PYTHON) scripts/analyze.py --sarif > analysis.sarif
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
+	$(PYTHON) scripts/serving_load_demo.py
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -58,6 +60,15 @@ ci:
 # "Chunked overlap engine")
 overlap-report:
 	$(PYTHON) scripts/overlap_demo.py
+
+# serving observability acceptance: the CPU-sim load sweep to
+# saturation (workload generator -> serving engine -> SLO rows), the
+# latency-vs-offered-load report with the detected knee, and the
+# observatory SLO gate catching a seeded 2x decode slowdown — banked
+# transcript at docs/serving_load_demo.log (docs/source/observability.rst
+# "Serving SLO observability")
+serving-load-report:
+	$(PYTHON) scripts/serving_load_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

@@ -75,11 +75,16 @@ FAMILY_SHAPES: Dict[str, Dict[str, int]] = {
     "collectives": {"m": 256, "n": 1, "k": 64, "d": 4},
     "transformer_step": {"m": 64, "n": 64, "k": 64, "d": 4},
     "transformer_decode": {"m": 64, "n": 64, "k": 64, "d": 4},
+    "serving_load": {"m": 16, "n": 32, "k": 64, "d": 4},
 }
 
 #: families whose registered cost model prices no wire term at all —
 #: their wire_bytes (when any) is not a claim DDLB123 can hold them to
-NO_WIRE_TERM_FAMILIES = ("transformer_step", "transformer_decode")
+NO_WIRE_TERM_FAMILIES = (
+    "transformer_step",
+    "transformer_decode",
+    "serving_load",
+)
 
 #: per-(family, member) option matrices where the defaults don't cover
 #: the wire-relevant behavior; one MemberReport per entry

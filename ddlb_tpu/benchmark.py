@@ -914,6 +914,13 @@ class PrimitiveBenchmarkRunner:
                     quarantined=bool(row.get("quarantined")),
                     retries=row.get("retries"),
                     worker_reused=row.get("worker_reused"),
+                    # serving SLO summary (absent on non-serving rows;
+                    # the dashboard's serving panel keys on these)
+                    slo_ttft_p50_ms=row.get("slo_ttft_p50_ms"),
+                    slo_ttft_p95_ms=row.get("slo_ttft_p95_ms"),
+                    slo_ttft_p99_ms=row.get("slo_ttft_p99_ms"),
+                    slo_goodput_rps=row.get("slo_goodput_rps"),
+                    slo_attainment=row.get("slo_attainment"),
                 )
                 # mirror=False: the row is already in the CSV and the
                 # worker.row span — echoing the table into the trace

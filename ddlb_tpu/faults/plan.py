@@ -91,6 +91,11 @@ SITES: Dict[str, str] = {
     "launch.child": "launched-world child bootstrap (Runtime init, pre-connect)",
     "subprocess.entry": "pool child dispatch-loop row entry",
     "subprocess.result": "row dict corruption before posting to parent",
+    "serve.admit": "serving engine request admission (prefill + slot copy)",
+    "serve.decode_tick": (
+        "serving engine ragged decode tick (kind=hang + duration_s = "
+        "the per-token latency-injection shape the SLO gate catches)"
+    ),
 }
 
 

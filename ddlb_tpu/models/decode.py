@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ddlb_tpu.runtime import reshard_compat, shard_map_compat
 from ddlb_tpu.models.transformer import (
     TransformerConfig,
     _moe_ffn,
@@ -626,7 +627,7 @@ def make_decode_fn(mesh, cfg: TransformerConfig, ragged: bool = False):
     pos_spec = P("dp") if ragged else P()
 
     def step(params, cache, tokens, pos):
-        return jax.shard_map(
+        return shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(specs, cspecs, P("dp"), pos_spec),
@@ -705,7 +706,7 @@ def make_chunk_decode_fn(mesh, cfg: TransformerConfig):
     cspecs = cache_specs(cfg)
 
     def chunk(params, cache, tokens, start):
-        return jax.shard_map(
+        return shard_map_compat(
             body,
             mesh=mesh,
             in_specs=(specs, cspecs, P("dp", None), P()),
@@ -834,7 +835,7 @@ def make_prefill_fn(mesh, cfg: TransformerConfig, dynamic_last: bool = False):
     if dynamic_last:
 
         def prefill(params, cache, tokens, last):
-            return jax.shard_map(
+            return shard_map_compat(
                 body,
                 mesh=mesh,
                 in_specs=(specs, cspecs, P("dp", None), P()),
@@ -845,7 +846,7 @@ def make_prefill_fn(mesh, cfg: TransformerConfig, dynamic_last: bool = False):
     else:
 
         def prefill(params, cache, tokens):
-            return jax.shard_map(
+            return shard_map_compat(
                 functools.partial(body, last=None),
                 mesh=mesh,
                 in_specs=(specs, cspecs, P("dp", None)),
@@ -1024,9 +1025,9 @@ def make_generate_fn(
         # sampled column: dynamic_update_slice requires operand and
         # update shardings to agree (reshard: the serving meshes carry
         # Explicit axis types, where with_sharding_constraint is a no-op)
-        prompt = jax.sharding.reshard(prompt, dp_rows)
+        prompt = reshard_compat(prompt, dp_rows)
         logits, cache = prefill(params, cache, prompt)
-        tokens = jax.sharding.reshard(
+        tokens = reshard_compat(
             jnp.zeros((B, S0 + n_new), jnp.int32), dp_rows
         )
         tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
@@ -1136,13 +1137,13 @@ def make_speculate_fn(
                     f"+ n_new {n_new} + spec_k {k}"
                 )
         dp_rows = NamedSharding(mesh, P("dp", None))
-        prompt = jax.sharding.reshard(prompt, dp_rows)
+        prompt = reshard_compat(prompt, dp_rows)
         logits, cache = prefill_t(params, cache, prompt)
         _, cache_draft = prefill_d(params_draft, cache_draft, prompt)
         # token buffer wide enough for a full provisional block written
         # at the last in-range position; final slice trims it
         width = S0 + n_new + k + 1
-        tokens = jax.sharding.reshard(
+        tokens = reshard_compat(
             jnp.zeros((B, width), jnp.int32), dp_rows
         )
         tokens = jax.lax.dynamic_update_slice(tokens, prompt, (0, 0))
@@ -1175,7 +1176,7 @@ def make_speculate_fn(
                 )
                 return cache_draft, nxt, props
 
-            props = jax.sharding.reshard(jnp.zeros((B, k), jnp.int32), dp_rows)
+            props = reshard_compat(jnp.zeros((B, k), jnp.int32), dp_rows)
             cache_draft, last_prop, props = jax.lax.fori_loop(
                 0, k, dstep, (cache_draft, last, props)
             )

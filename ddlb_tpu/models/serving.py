@@ -58,7 +58,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ddlb_tpu import telemetry
+from ddlb_tpu import faults, telemetry
+from ddlb_tpu.runtime import shard_map_compat
 from ddlb_tpu.models.decode import (
     init_cache,
     init_paged_cache,
@@ -113,6 +114,12 @@ class EngineStats:
     lane_ticks_total: int = 0
     prefix_hits: int = 0        # admissions served from the shared prefix
     prefill_tokens_saved: int = 0
+    #: load-shedding counters (ddlb_tpu/workload drives them): requests
+    #: preempted mid-generation (requeued, prefix-of-work preserved) and
+    #: the K/V cache rows those preemptions abandoned — the engine's
+    #: eviction cost, re-paid as prefill on re-admission
+    preemptions: int = 0
+    kv_evicted_tokens: int = 0
     # paged layout only: page-pool pressure
     pages_capacity: int = 0
     pages_in_use: int = 0       # current gauge (incl. shared prefix pages)
@@ -253,7 +260,7 @@ class ContinuousBatchingEngine:
                 return out
 
             self._copy_slot_paged = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     copy_paged_body,
                     mesh=mesh,
                     in_specs=(big_cs, cs, P(), P(), P()),
@@ -280,7 +287,7 @@ class ContinuousBatchingEngine:
                 return out
 
             self._copy_slot = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     copy_body,
                     mesh=mesh,
                     in_specs=(cs, cs, P(), P()),
@@ -302,7 +309,7 @@ class ContinuousBatchingEngine:
             }
 
         self._seed_prefix = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 seed_body,
                 mesh=mesh,
                 in_specs=(cs, cs),
@@ -606,10 +613,42 @@ class ContinuousBatchingEngine:
             b *= 2
         return b
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests queued and not yet admitted — the gauge the load
+        driver samples per tick (saturation shows here first)."""
+        return len(self._queue)
+
+    def active_slots(self) -> List[int]:
+        """Slots currently running a request (a scheduling-policy view;
+        the load driver's preemption policy picks among these)."""
+        return [s for s in range(self.B) if self._slot_req[s] is not None]
+
+    def slot_request(self, slot: int) -> Optional[int]:
+        """The request index slot ``slot`` is running, or None (idle)."""
+        return self._slot_req[slot]
+
+    def queue_head(self) -> Optional[int]:
+        """Request index waiting at the head of the admission queue, or
+        None when the queue is empty."""
+        return self._queue[0] if self._queue else None
+
+    def remaining_budget(self, slot: int) -> int:
+        """Tokens slot ``slot``'s request may still generate (its
+        ``max_new`` minus what it has produced) — the preemption
+        policy's work-remaining signal. 0 for an idle slot."""
+        req_idx = self._slot_req[slot]
+        if req_idx is None:
+            return 0
+        return self._requests[req_idx].max_new - len(self._slot_new[slot])
+
     def _admit(self, slot: int, req_idx: int) -> None:
         with telemetry.span(
             "serve.admit", cat="serve", slot=slot, request=req_idx
         ):
+            # chaos surface: a plan can wedge/kill/delay the admission
+            # path of a live serving world (faults/plan.SITES)
+            faults.inject("serve.admit")
             self._admit_inner(slot, req_idx)
 
     def _admit_inner(self, slot: int, req_idx: int) -> None:
@@ -774,6 +813,61 @@ class ContinuousBatchingEngine:
             self._prefix_slots.discard(slot)
             self._drain_retired_prefix(slot)
 
+    def preempt(self, slot: int, requeue: str = "back") -> int:
+        """Preempt slot ``slot`` mid-generation: requeue the request
+        with the tokens generated so far folded into its prompt and its
+        budget reduced accordingly, park the lane, and (paged) return
+        its pages to the pool. Returns the requeued request's index.
+
+        ``requeue`` places the remnant at the ``"back"`` of the queue
+        (the head-of-line-relief shape: the freed slot goes to whoever
+        was waiting — the default) or at the ``"front"`` (strict
+        seniority: the preempted request reclaims the next slot, e.g.
+        when preempting only to defragment the page pool).
+
+        No token is ever re-GENERATED — the resumed request greedy-
+        continues from exactly where it stopped — but its K/V rows are
+        evicted and re-paid as prefill at re-admission: that recompute
+        is preemption's honest cost, counted in
+        ``stats.kv_evicted_tokens``. The scheduling layer (the
+        ``serving_load`` driver's head-of-line policy, or a future
+        admission controller) decides WHEN to preempt; the engine only
+        provides the mechanism."""
+        if requeue not in ("back", "front"):
+            raise ValueError(f"requeue must be 'back' or 'front', got {requeue!r}")
+        req_idx = self._slot_req[slot]
+        if req_idx is None:
+            raise ValueError(f"slot {slot} is idle; nothing to preempt")
+        req = self._requests[req_idx]
+        new = self._slot_new[slot]
+        remaining = req.max_new - len(new)
+        assert remaining >= 1  # else _maybe_finish would have retired it
+        prompt = np.concatenate([req.prompt, np.asarray(new, np.int32)])
+        self.stats.preemptions += 1
+        self.stats.kv_evicted_tokens += int(self.pos[slot])
+        telemetry.instant(
+            "serve.preempt", cat="serve", slot=slot, request=req_idx,
+            generated=len(new), remaining=remaining,
+        )
+        self._slot_req[slot] = None
+        self._slot_new[slot] = []
+        self.pos[slot] = self.S_max   # park: writes drop, lane idles
+        self.cur_tok[slot] = 0
+        if self.paged:
+            self._table_np[slot] = self.num_pages
+            self._push_table()
+            self._release_pages(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self._prefix_slots.discard(slot)
+            self._drain_retired_prefix(slot)
+        new_idx = len(self._requests)
+        self._requests.append(Request(prompt, max_new=remaining))
+        if requeue == "front":
+            self._queue.appendleft(new_idx)
+        else:
+            self._queue.append(new_idx)
+        return new_idx
+
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> int:
@@ -781,6 +875,10 @@ class ContinuousBatchingEngine:
         active = [s for s in range(self.B) if self._slot_req[s] is not None]
         if not active:
             return 0
+        # chaos surface: a plan can stall (kind=hang + duration_s — the
+        # decode-slowdown shape the SLO gate must catch), error, or kill
+        # the tick path of a live serving world (faults/plan.SITES)
+        faults.inject("serve.decode_tick")
         # no per-tick span: a locked trace write per decoded token would
         # perturb the measured loop this engine runs inside — ticks are
         # counted into the metrics registry and summarized as one

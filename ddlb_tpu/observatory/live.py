@@ -24,7 +24,16 @@ Event kinds currently posted:
   ``worker_dead`` — the pool's lease lifecycle and the parent-observed
   heartbeat age, so the dashboard shows per-worker liveness exactly as
   the kill policy sees it;
-- ``queue_parked`` — the hardware queue's park decisions.
+- ``queue_parked`` — the hardware queue's park decisions;
+- ``serving_tick`` — the serving_load drive loop's throttled
+  queue-depth/progress gauge (the dashboard's serving panel feed,
+  ISSUE 11).
+
+Events whose ``kind`` the fold does not recognize are COUNTED, not
+dropped silently (``state["unknown"]``): the stream is shared by
+processes that may be newer than the dashboard tailing it, and a frame
+that quietly renders less is how a forward-compat gap hides (the
+pre-ISSUE-11 ``--html`` blank-table bug). Renderers surface the count.
 
 ``fold`` turns an event list into the dashboard's render state; it
 lives here (not in the script) so tests pin the folding semantics and
@@ -118,21 +127,35 @@ def fold(
     - ``recent``: the last N completed rows with their
       predicted-vs-measured fields;
     - ``fracs``: every finite ``roofline_frac`` / ``overlap`` pair seen,
-      for the rolling predicted-vs-measured summary.
+      for the rolling predicted-vs-measured summary;
+    - ``serving``: the serving panel's state — the rolling queue-depth
+      gauge ring (``serving_tick`` events), in-drain progress, and the
+      latest serving row's SLO summary (TTFT percentiles, goodput,
+      attainment);
+    - ``unknown``: per-kind counts of events this build did not
+      recognize (surfaced by the renderers, never silently dropped).
     """
     if state is None:
-        state = {
-            "totals": {
-                "total": 0, "done": 0, "errors": 0, "quarantined": 0,
-                "parked": 0, "retries": 0,
-            },
-            "workers": {},
-            "current": {},
-            "recent": [],
-            "fracs": [],
-            "sweep_done": False,
-            "last_ts": 0.0,
-        }
+        state = {}
+    state.setdefault(
+        "totals",
+        {
+            "total": 0, "done": 0, "errors": 0, "quarantined": 0,
+            "parked": 0, "retries": 0,
+        },
+    )
+    state.setdefault("workers", {})
+    state.setdefault("current", {})
+    state.setdefault("recent", [])
+    state.setdefault("fracs", [])
+    state.setdefault("sweep_done", False)
+    state.setdefault("last_ts", 0.0)
+    # serving panel state (ISSUE 11): rolling queue-depth gauge ring +
+    # the latest completed serving row's SLO summary. setdefault (not
+    # the None-branch literal) so a state folded by an OLDER dashboard
+    # build gains the keys instead of KeyError-ing the renderer.
+    state.setdefault("serving", {"depths": [], "progress": None, "latest": None})
+    state.setdefault("unknown", {})
     totals = state["totals"]
     for e in events:
         kind = e.get("kind")
@@ -176,8 +199,30 @@ def fold(
             overlap = _finite(e.get("measured_overlap_frac"))
             if frac is not None or overlap is not None:
                 state["fracs"].append({"roofline": frac, "overlap": overlap})
+            if _finite(e.get("slo_ttft_p95_ms")) is not None:
+                # a serving_load completion: its SLO summary becomes the
+                # panel's headline tiles
+                state["serving"]["latest"] = {
+                    "impl": e.get("impl"),
+                    "ttft_p50_ms": _finite(e.get("slo_ttft_p50_ms")),
+                    "ttft_p95_ms": _finite(e.get("slo_ttft_p95_ms")),
+                    "ttft_p99_ms": _finite(e.get("slo_ttft_p99_ms")),
+                    "goodput_rps": _finite(e.get("slo_goodput_rps")),
+                    "attainment": _finite(e.get("slo_attainment")),
+                }
             state["recent"].append(e)
             del state["recent"][:-recent]
+        elif kind == "serving_tick":
+            serving = state["serving"]
+            depth = _finite(e.get("queue_depth"))
+            if depth is not None:
+                serving["depths"].append(int(depth))
+                del serving["depths"][:-120]
+            serving["progress"] = {
+                "active": e.get("active"),
+                "done": e.get("done"),
+                "total": e.get("total"),
+            }
         elif kind == "worker_spawn":
             state["workers"][e.get("worker")] = {
                 "state": "spawning",
@@ -206,4 +251,11 @@ def fold(
             worker["error"] = str(e.get("error") or "")[:120]
         elif kind == "queue_parked":
             totals["parked"] += 1
+        else:
+            # forward compat: a kind this build doesn't know is counted
+            # and surfaced, never silently dropped (a newer runner may
+            # share the stream with an older dashboard)
+            state["unknown"][str(kind)] = state["unknown"].get(
+                str(kind), 0
+            ) + 1
     return state

@@ -22,9 +22,18 @@ absolute deviation), never mean/std:
 - findings are ranked by robust z (history-backed) then by
   measured/predicted ratio (prior-only), worst first.
 
-Consumed by ``scripts/observatory_report.py`` (the CLI) and by
-``bench.py``'s roofline gate (the headline's history layer). Stdlib
-only, like the rest of the package.
+Serving rows carry latency DISTRIBUTIONS next to their median time
+(ISSUE 11), and a serving regression can hide entirely in a tail
+percentile — p99 TTFT triples while the median barely moves — so the
+same median+MAD machinery additionally gates every ``SLO_METRICS``
+column per key (``detect_slo``), with per-metric direction (goodput
+regresses DOWN). ``detect_all`` merges both gates into one ranked
+report.
+
+Consumed by ``scripts/observatory_report.py`` and
+``scripts/serving_load_report.py`` (the CLIs) and by ``bench.py``'s
+roofline gate (the headline's history layer). Stdlib only, like the
+rest of the package.
 """
 
 from __future__ import annotations
@@ -41,6 +50,19 @@ REL_FLOOR = 0.05     # MAD floor, as a fraction of the median
 PRIOR_FACTOR = 5.0   # prior-only: measured > 5x the analytical bound
 
 MEASURE_COLUMN = "median time (ms)"
+
+#: serving SLO metrics gated per key NEXT TO the default time metric
+#: (ISSUE 11): direction "high" = bigger is worse (latency
+#: percentiles), "low" = smaller is worse (goodput). Rows that don't
+#: carry a metric (every non-serving family) contribute nothing —
+#: the gate extends the detector, it never re-scopes it.
+SLO_METRICS = (
+    ("slo_ttft_p50_ms", "high"),
+    ("slo_ttft_p95_ms", "high"),
+    ("slo_ttft_p99_ms", "high"),
+    ("slo_tpot_p95_ms", "high"),
+    ("slo_goodput_rps", "low"),
+)
 
 
 def median(values: List[float]) -> float:
@@ -136,39 +158,14 @@ def detect(
         if measured is None:
             continue  # error rows have no measurement to regress
         key = row_key(row)
-        ident = {
-            "implementation": row.get("implementation"),
-            "base_implementation": row.get("base_implementation"),
-            "primitive": row.get("primitive"),
-            "option": row.get("option"),
-            "m": row.get("m"),
-            "n": row.get("n"),
-            "k": row.get("k"),
-            "chip": row.get("chip"),
-        }
         stats = base.get(key)
         if stats is not None:
-            baseline = stats["median"]
-            if baseline <= 0.0:
-                continue
-            scale = max(stats["mad"], rel_floor * baseline)
-            z = (measured - baseline) / scale if scale > 0 else float("inf")
-            ratio = measured / baseline
-            if z > z_tol and ratio > 1.0 + min_excess:
-                findings.append(
-                    {
-                        **ident,
-                        "key": key,
-                        "source": "history",
-                        "measured_ms": measured,
-                        "baseline_ms": baseline,
-                        "mad_ms": stats["mad"],
-                        "history_n": stats["n"],
-                        "history_runs": stats["runs"],
-                        "ratio": ratio,
-                        "z": z,
-                    }
-                )
+            finding = _history_finding(
+                row, key, metric, measured, stats, "high",
+                z_tol, min_excess, rel_floor,
+            )
+            if finding is not None:
+                findings.append(finding)
             continue
         # perfmodel prior: no history for this key — the analytical
         # lower bound is the only baseline available
@@ -180,8 +177,9 @@ def detect(
         if ratio > prior_factor:
             findings.append(
                 {
-                    **ident,
+                    **_ident(row),
                     "key": key,
+                    "metric": metric,
                     "source": "perfmodel_prior",
                     "measured_ms": measured,
                     "baseline_ms": predicted_ms,
@@ -189,8 +187,148 @@ def detect(
                     "z": float("nan"),
                 }
             )
+    return _rank(findings)
+
+
+def _ident(row: Dict[str, Any]) -> Dict[str, Any]:
+    """The identity columns every finding carries — ONE definition, so
+    the time gate and the SLO gate cannot drift apart on field shape."""
+    return {
+        "implementation": row.get("implementation"),
+        "base_implementation": row.get("base_implementation"),
+        "primitive": row.get("primitive"),
+        "option": row.get("option"),
+        "m": row.get("m"),
+        "n": row.get("n"),
+        "k": row.get("k"),
+        "chip": row.get("chip"),
+    }
+
+
+def _history_finding(
+    row: Dict[str, Any],
+    key: str,
+    metric: str,
+    measured: float,
+    stats: Dict[str, Any],
+    direction: str,
+    z_tol: float,
+    min_excess: float,
+    rel_floor: float,
+) -> Optional[Dict[str, Any]]:
+    """The history-backed gate core shared by ``detect`` and
+    ``detect_slo``: median+MAD z against the key's baseline, with
+    ``direction`` deciding which way is worse ("high" = bigger is
+    worse; "low" = smaller is worse, ``ratio`` oriented so >1 always
+    reads "this much worse"). None when the row is within tolerance."""
+    baseline = stats["median"]
+    if baseline <= 0.0:
+        return None
+    scale = max(stats["mad"], rel_floor * baseline)
+    if direction == "low":
+        z = (baseline - measured) / scale if scale > 0 else float("inf")
+        ratio = baseline / measured if measured > 0 else float("inf")
+    else:
+        z = (measured - baseline) / scale if scale > 0 else float("inf")
+        ratio = measured / baseline
+    if not (z > z_tol and ratio > 1.0 + min_excess):
+        return None
+    return {
+        **_ident(row),
+        "key": key,
+        "metric": metric,
+        "source": "history",
+        "measured_ms": measured,
+        "baseline_ms": baseline,
+        "mad_ms": stats["mad"],
+        "history_n": stats["n"],
+        "history_runs": stats["runs"],
+        "ratio": ratio,
+        "z": z,
+    }
+
+
+def _rank(findings: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """History-backed findings by robust z (worst first), then
+    prior-only advisories by measured/predicted ratio — the one ranking
+    rule shared by the time gate, the SLO gate and their union."""
     history_backed = [f for f in findings if f["source"] == "history"]
     prior_only = [f for f in findings if f["source"] != "history"]
     history_backed.sort(key=lambda f: -f["z"])
     prior_only.sort(key=lambda f: -f["ratio"])
     return history_backed + prior_only
+
+
+def detect_slo(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    metrics=SLO_METRICS,
+    exclude_run: Optional[str] = None,
+    z_tol: float = Z_TOL,
+    min_excess: float = MIN_EXCESS,
+    rel_floor: float = REL_FLOOR,
+) -> List[Dict[str, Any]]:
+    """SLO-metric regression findings (ISSUE 11): every metric in
+    ``metrics`` gated per key against its own per-key history baseline,
+    with per-metric direction — a TTFT percentile regresses UP, goodput
+    regresses DOWN. History-backed only (the perfmodel predicts a
+    drain's time, not its percentile distribution, so there is no prior
+    to fall back to); rows that don't carry a metric — every
+    non-serving family — simply contribute nothing.
+
+    Finding shape matches ``detect`` (``metric`` names the column;
+    ``ratio`` is always worse/better oriented so >1 reads "this much
+    worse" for both directions).
+    """
+    findings: List[Dict[str, Any]] = []
+    for metric, direction in metrics:
+        base = baselines(history, metric=metric, exclude_run=exclude_run)
+        for row in current_rows:
+            measured = finite(row.get(metric))
+            if measured is None:
+                continue
+            key = row_key(row)
+            stats = base.get(key)
+            if stats is None:
+                continue
+            finding = _history_finding(
+                row, key, metric, measured, stats, direction,
+                z_tol, min_excess, rel_floor,
+            )
+            if finding is not None:
+                findings.append(finding)
+    return _rank(findings)
+
+
+def detect_all(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    exclude_run: Optional[str] = None,
+    z_tol: float = Z_TOL,
+    min_excess: float = MIN_EXCESS,
+    rel_floor: float = REL_FLOOR,
+    prior_factor: float = PRIOR_FACTOR,
+) -> List[Dict[str, Any]]:
+    """The full gate: the default time metric (``detect``, perfmodel
+    prior included) PLUS every SLO metric (``detect_slo``), re-ranked
+    as one list so a serving SLO blow-up competes with — and can
+    outrank — a kernel-time regression in the same report."""
+    return _rank(
+        detect(
+            current_rows,
+            history,
+            exclude_run=exclude_run,
+            z_tol=z_tol,
+            min_excess=min_excess,
+            rel_floor=rel_floor,
+            prior_factor=prior_factor,
+        )
+        + detect_slo(
+            current_rows,
+            history,
+            exclude_run=exclude_run,
+            z_tol=z_tol,
+            min_excess=min_excess,
+            rel_floor=rel_floor,
+        )
+    )

@@ -224,6 +224,10 @@ FAMILY_COST_MODELS: Dict[str, Callable[[object, ChipSpec], Terms]] = {
     "pp_pipeline": _pipeline_cost,
     "transformer_step": _model_step_cost,
     "transformer_decode": _decode_cost,
+    # serving_load shares the decode census (weights+KV re-read floor vs
+    # compute); the family's cost_model() additionally floors the
+    # prediction at the open-loop trace's arrival horizon
+    "serving_load": _decode_cost,
     "collectives": _collective_cost,
 }
 

@@ -32,6 +32,7 @@ ALLOWED_PRIMITIVES = (
     "transformer_step",
     "transformer_decode",
     "collectives",
+    "serving_load",
 )
 
 _REGISTRY = {
@@ -230,6 +231,20 @@ _REGISTRY = {
         "compute_only": (
             "ddlb_tpu.primitives.collectives.compute_only",
             "ComputeOnlyCollectives",
+        ),
+    },
+    # the serving engine under open-loop traffic: SLO distributions
+    # (TTFT/TPOT percentiles, goodput at an SLO bound) instead of
+    # fixed-shape kernel time — the "millions of users" measurement
+    # surface (no reference analogue: the reference has no serving path)
+    "serving_load": {
+        "engine": (
+            "ddlb_tpu.primitives.serving_load.engine",
+            "EngineServingLoad",
+        ),
+        "static": (
+            "ddlb_tpu.primitives.serving_load.static",
+            "StaticServingLoad",
         ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue

@@ -1,0 +1,27 @@
+"""serving_load: the serving engine measured under open-loop traffic.
+
+Lazy re-exports, matching the package-wide pattern (importing the
+family must not trigger backend imports)."""
+
+from __future__ import annotations
+
+_LAZY = {
+    "ServingLoad": ("ddlb_tpu.primitives.serving_load.base", "ServingLoad"),
+    "EngineServingLoad": (
+        "ddlb_tpu.primitives.serving_load.engine",
+        "EngineServingLoad",
+    ),
+    "StaticServingLoad": (
+        "ddlb_tpu.primitives.serving_load.static",
+        "StaticServingLoad",
+    ),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

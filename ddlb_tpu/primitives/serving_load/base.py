@@ -1,0 +1,599 @@
+"""ServingLoad: the serving engine measured under open-loop traffic.
+
+Every other family measures a fixed-shape program; this one measures
+the FRAMEWORK AS A SERVICE: a seeded open-loop workload
+(``ddlb_tpu/workload``: Poisson or bursty arrivals, mixed
+prompt/output-length mix, Zipf shared-prefix population) is replayed
+against the continuous-batching engine (``models/serving.py``), and the
+row reports the latency DISTRIBUTION the traffic experienced — TTFT and
+TPOT percentiles, goodput under the configured SLO bound, attainment,
+queue-depth gauges, preemption/eviction counters — as schema-registered
+``slo_*`` / ``serve_*`` columns next to the usual timing statistics.
+Swept over the ``rate`` axis these rows ARE the latency-vs-offered-load
+curve; ``scripts/serving_load_report.py`` finds the saturation knee and
+the observatory gates the percentiles per key like any other metric.
+
+Shape mapping onto the ``(m, n, k)`` contract (the serving regime's
+axes, matching ``transformer_decode``):
+
+- ``m``: mean prompt length (the workload's ``prompt_mean``; actual
+  prompts are lognormal around it, ``prompt_min=m/4`` .. ``prompt_max``
+  = ``4*m``)
+- ``n``: d_model
+- ``k``: d_ff
+
+Measurement protocol: one measured call = one full drain of the trace
+(open loop — arrivals release on the wall clock regardless of engine
+progress, so queueing delay is real). ``host_clock`` only: the drain is
+host-scheduled by construction. Iterations re-drain the same trace
+against compile-cached programs, and the SLO distributions POOL across
+every drain after the first (the first carries XLA compiles and is a
+throwaway; a single drain's p95 over a small trace is max-dominated
+noise — pooled order statistics are what give the observatory's
+per-key baselines a stable footing).
+
+Members:
+
+- ``engine``: continuous batching — admissions fill any free slot every
+  tick (plus the optional head-of-line preemption policy,
+  ``preempt_hol_ticks``);
+- ``static``: batch-synchronous strawman — admissions only when EVERY
+  slot is idle, so a batch runs to full completion before the next
+  wave. The TTFT gap between the two members is the number continuous
+  batching exists to close.
+
+Validation checks the drain's ACCOUNTING (every request completed
+exactly once, generated budgets honored, prompts round-tripped, ledger
+consistent); token-level greedy-chain exactness is the engine's own
+contract, pinned in tests/test_serving_engine.py / test_paged.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ddlb_tpu import telemetry
+from ddlb_tpu.observatory import live
+from ddlb_tpu.primitives.base import Primitive
+from ddlb_tpu.workload import SLOTracker, WorkloadSpec, generate_trace
+
+#: live-stream serving_tick throttle (seconds between posts; the stream
+#: is env-gated off by default, so this costs one env read per tick)
+_TICK_POST_INTERVAL_S = 0.5
+
+
+class ServingLoad(Primitive):
+    """ABC for load-driven serving members (the drive loop lives here;
+    members choose the admission policy)."""
+
+    primitive_name = "serving_load"
+
+    BASE_OPTIONS = {
+        #: engine slots sharing the KV cache (the continuous batch)
+        "batch": 8,
+        "vocab": 512,
+        "n_heads": 8,
+        "n_kv_heads": 0,
+        "layers": 1,
+        "kv_cache": "bf16",
+        "mlp_kernel": "bf16",
+        "attn_kernel": "einsum",
+        "decode_kernel": "einsum",
+        "cache_layout": "contiguous",
+        "page_size": 128,
+        "page_pool_frac": 1.0,
+        # -- workload (ddlb_tpu/workload/generator.py) ------------------
+        #: offered load, requests/second (the load-sweep axis)
+        "rate": 4.0,
+        "process": "poisson",
+        "burst_factor": 4.0,
+        "burst_duty": 0.2,
+        "burst_len_s": 1.0,
+        #: requests in the trace (0 = 3 * batch)
+        "n_requests": 0,
+        #: mean generated-token budget (exponential mix, clipped to
+        #: [1, out_max])
+        "out_mean": 8,
+        "out_max": 32,
+        "prompt_sigma": 0.4,
+        #: Zipf shared-prefix population (0 = off); the rank-0 prefix is
+        #: installed as the engine's shared-prefix cache
+        "prefix_pop": 0,
+        "prefix_len": 0,
+        "prefix_alpha": 1.1,
+        # -- SLO bound (the goodput/attainment predicate) ---------------
+        "slo_ttft_ms": 2000.0,
+        "slo_tpot_ms": 500.0,
+        # -- scheduling policy ------------------------------------------
+        #: head-of-line preemption: when the queue head has waited this
+        #: many ticks with no admission, preempt the active slot with
+        #: the most remaining budget (0 = never preempt)
+        "preempt_hol_ticks": 0,
+    }
+    BASE_ALLOWED = {
+        "batch": (1, None),
+        "vocab": (2, None),
+        "n_heads": (1, None),
+        "n_kv_heads": (0, None),
+        "layers": (1, None),
+        "kv_cache": ["bf16", "int8"],
+        "mlp_kernel": ["bf16", "int8", "int8_weights"],
+        "attn_kernel": ["flash", "einsum"],
+        "decode_kernel": ["einsum", "pallas"],
+        "cache_layout": ["contiguous", "paged"],
+        "page_size": (1, None),
+        "page_pool_frac": (0.01, 1.0),
+        "rate": (0.01, None),
+        "process": ["poisson", "bursty"],
+        "burst_factor": (1.0, None),
+        "burst_duty": (0.01, 0.99),
+        "burst_len_s": (0.01, None),
+        "n_requests": (0, None),
+        "out_mean": (1, None),
+        "out_max": (1, None),
+        "prompt_sigma": (0.0, 2.0),
+        "prefix_pop": (0, None),
+        "prefix_len": (0, None),
+        "prefix_alpha": (0.1, None),
+        "slo_ttft_ms": (1.0, None),
+        "slo_tpot_ms": (1.0, None),
+        "preempt_hol_ticks": (0, None),
+    }
+
+    # -- schema/shape plumbing ----------------------------------------------
+
+    def _mesh_factors(self) -> Tuple[int, int]:
+        """(1, num_devices): the engine's batch axis IS the slot axis;
+        dp>1 composes as one engine per dp shard (models/serving.py)."""
+        return 1, self.runtime.num_devices
+
+    def _check_shapes(self) -> None:
+        o = self.options
+        _, tp = self._mesh_factors()
+        if self.n % o["n_heads"] != 0:
+            raise ValueError(
+                f"n={self.n} (d_model) not divisible by "
+                f"n_heads={o['n_heads']}"
+            )
+        if o["n_heads"] % tp != 0:
+            raise ValueError(
+                f"n_heads={o['n_heads']} not divisible by tp={tp}"
+            )
+        if o["n_kv_heads"]:
+            if o["n_heads"] % o["n_kv_heads"] or o["n_kv_heads"] % tp:
+                raise ValueError(
+                    f"n_kv_heads={o['n_kv_heads']} must divide "
+                    f"n_heads={o['n_heads']} and be divisible by tp={tp}"
+                )
+        if o["batch"] % tp != 0:
+            raise ValueError(
+                f"batch={o['batch']} not divisible by tp={tp} "
+                f"(the MoE block router)"
+            )
+        if self.dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError("serving_load requires a floating dtype")
+        if o["prefix_pop"] and not o["prefix_len"]:
+            raise ValueError("prefix_pop > 0 needs prefix_len >= 1")
+
+    # -- the workload --------------------------------------------------------
+
+    def workload_spec(self) -> WorkloadSpec:
+        """The trace's identity: everything from the options + shape +
+        seed, so equal rows replay equal traffic."""
+        o = self.options
+        return WorkloadSpec(
+            n_requests=o["n_requests"] or 3 * o["batch"],
+            rate_rps=float(o["rate"]),
+            process=o["process"],
+            burst_factor=float(o["burst_factor"]),
+            burst_duty=float(o["burst_duty"]),
+            burst_len_s=float(o["burst_len_s"]),
+            prompt_mean=self.m,
+            prompt_sigma=float(o["prompt_sigma"]),
+            prompt_min=max(1, self.m // 4),
+            prompt_max=4 * self.m,
+            out_mean=o["out_mean"],
+            out_min=1,
+            out_max=o["out_max"],
+            vocab=o["vocab"],
+            prefix_pop=o["prefix_pop"],
+            prefix_alpha=float(o["prefix_alpha"]),
+            prefix_len=o["prefix_len"],
+            seed=self.seed,
+        )
+
+    def _trace_horizon_s(self) -> float:
+        """The last arrival offset — an open-loop drain cannot finish
+        earlier, so it floors the prediction below."""
+        return self._trace[-1].arrival_s if self._trace else 0.0
+
+    # -- perfmodel -----------------------------------------------------------
+
+    def flops(self) -> float:
+        """Useful-work census of the whole drained trace: per request,
+        one prompt prefill + its generated tokens' decode forwards —
+        the same convention as ``transformer_decode`` phase=serve
+        (idle-lane ride-alongs, preemption re-prefills and deferred
+        waits are overhead, not model work)."""
+        o = self.options
+        D, F = self.n, self.k
+        L, V = o["layers"], o["vocab"]
+        kv_frac = (o["n_kv_heads"] or o["n_heads"]) / o["n_heads"]
+        proj = (4.0 + 4.0 * kv_frac) * D * D
+        total = 0.0
+        for r in self._trace:
+            S0 = r.prompt.size
+            total += S0 * (L * (proj + 2.0 * S0 * D + 4.0 * D * F))
+            total += 2.0 * D * V
+            steps = r.max_new - 1
+            ctx_sum = steps * S0 + steps * (steps - 1) / 2.0
+            total += (
+                steps * (L * (proj + 4.0 * D * F) + 2.0 * D * V)
+                + L * 4.0 * D * ctx_sum
+            )
+        return total
+
+    def hbm_bytes(self) -> float:
+        """HBM floor: every generated token re-reads weights + KV cache
+        (the ``transformer_decode`` serve census, shared via
+        ``utils/hbm_budget`` so the two cannot drift)."""
+        from ddlb_tpu.utils.hbm_budget import decode_budget
+
+        o = self.options
+        rep = decode_budget(
+            ctx=self.m,
+            d_model=self.n,
+            d_ff=self.k,
+            vocab=o["vocab"],
+            n_heads=o["n_heads"],
+            batch=o["batch"],
+            n_kv_heads=o["n_kv_heads"],
+            layers=o["layers"],
+            kv_cache=o["kv_cache"],
+            mlp_kernel=o["mlp_kernel"],
+            attn_kernel=o["attn_kernel"],
+            phase="decode",
+            validate=False,
+        )
+        per_pass = rep.components["weights"] + rep.components["kv_cache"]
+        total_tokens = sum(r.max_new for r in self._trace)
+        return total_tokens * per_pass
+
+    def cost_model(self):
+        """The decode census floor, additionally floored by the trace's
+        arrival horizon: an OPEN-LOOP drain cannot complete before its
+        last request has even arrived, so ``predicted_s`` is
+        ``max(census floor, horizon)`` — without the horizon term every
+        low-load row would read as a huge (false) inefficiency."""
+        est = super().cost_model()
+        horizon = self._trace_horizon_s()
+        if horizon > est.predicted_s:
+            est = dataclasses.replace(est, predicted_s=horizon)
+        return est
+
+    # -- engine construction -------------------------------------------------
+
+    def _model_config(self):
+        from ddlb_tpu.models.transformer import TransformerConfig
+        from ddlb_tpu.primitives.base import jnp_dtype
+
+        o = self.options
+        return TransformerConfig(
+            vocab=o["vocab"],
+            d_model=self.n,
+            n_heads=o["n_heads"],
+            n_kv_heads=o["n_kv_heads"],
+            d_ff=self.k,
+            layers_per_stage=o["layers"],
+            mlp_kernel=o["mlp_kernel"],
+            kv_cache=o["kv_cache"],
+            attn_kernel=o["attn_kernel"],
+            decode_kernel=o["decode_kernel"],
+            cache_layout=o["cache_layout"],
+            page_size=o["page_size"],
+            dtype=jnp_dtype(self.dtype),
+        )
+
+    def _input_setup(self) -> None:
+        import jax
+
+        from ddlb_tpu.models.decode import make_decode_fn
+        from ddlb_tpu.models.serving import ContinuousBatchingEngine
+        from ddlb_tpu.models.transformer import init_params
+        from ddlb_tpu.workload import prefix_tokens
+
+        cfg = self._model_config()
+        dp, tp = self._mesh_factors()
+        self.mesh = self.runtime.mesh(("dp", "tp"), shape=(dp, tp))
+        self.num_partitions = dp * tp
+        o = self.options
+        spec = self.workload_spec()
+        self._trace = generate_trace(spec)
+
+        params = init_params(cfg, pp=1, n_experts=tp, seed=self.seed)
+        _, shardings = make_decode_fn(self.mesh, cfg)
+        params = {
+            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+        }
+        max_need = max(r.prompt.size + r.max_new for r in self._trace)
+        num_pages = None
+        if cfg.cache_layout == "paged":
+            ps = cfg.page_size
+            max_need = -(-max_need // ps) * ps
+            per_slot = max_need // ps
+            num_pages = max(
+                1, round(o["page_pool_frac"] * o["batch"] * per_slot)
+            )
+        self._engine = ContinuousBatchingEngine(
+            self.mesh, cfg, params,
+            max_batch=o["batch"], max_len=max_need, num_pages=num_pages,
+        )
+        if spec.prefix_pop:
+            # the rank-0 (hot) population member goes into the engine's
+            # shared-prefix cache; other ranks are cache misses by design
+            self._engine.set_shared_prefix(prefix_tokens(spec, 0))
+        self._last: Optional[Dict[str, Any]] = None
+        #: drain bookkeeping: drain 1 (the warmup/compile drain) gets a
+        #: throwaway tracker; later drains POOL into one tracker so the
+        #: row's percentiles ride (drains-1) x n_requests samples
+        self._drains = 0
+        self._pooled: Optional[SLOTracker] = None
+        self._makespan_total = 0.0
+
+        def run_trace(tok0):
+            import jax.core as _core
+
+            if isinstance(tok0, _core.Tracer):
+                raise ValueError(
+                    "serving_load requires "
+                    "time_measurement_backend='host_clock' (the drain "
+                    "is host-scheduled open-loop replay)"
+                )
+            self._drain()
+            # fence on the cache so timing includes the last step
+            return self._engine.cache["k"]
+
+        self._fn = run_trace
+        self._args = (np.int32(0),)
+        jax.block_until_ready(params)
+
+    @property
+    def _call_args(self):
+        return self._args
+
+    def get_inputs(self):
+        return self._args
+
+    def timed_call(self):
+        return self._fn, self._args
+
+    # -- the drive loop ------------------------------------------------------
+
+    def _admission_open(self, engine) -> bool:
+        """Member policy hook: may queued requests be admitted NOW?"""
+        raise NotImplementedError
+
+    def _drain(self) -> None:
+        """One full open-loop replay of the trace against a freshly
+        reset engine. Arrivals release on the wall clock (open loop);
+        per-request timelines, queue gauges and engine counters fold
+        into ``self._last`` for ``extra_row_fields``/``validate``."""
+        from ddlb_tpu.models.serving import Request
+
+        o = self.options
+        eng = self._engine
+        eng.reset()
+        trace = self._trace
+        n = len(trace)
+        self._drains += 1
+        if self._drains == 1:
+            # the compile drain: its latencies include XLA compiles and
+            # must never pollute the pooled distributions (kept as the
+            # fallback for a warmup-less single-drain run)
+            tracker = SLOTracker(o["slo_ttft_ms"], o["slo_tpot_ms"])
+        elif self._pooled is None:
+            tracker = self._pooled = SLOTracker(
+                o["slo_ttft_ms"], o["slo_tpot_ms"]
+            )
+        else:
+            tracker = self._pooled
+            tracker.new_drain()
+        alias: Dict[int, int] = {}        # engine req idx -> trace index
+        orig_prompt = {r.index: r.prompt.size for r in trace}
+        hol_ticks = 0
+        last_head: Optional[int] = None
+        submitted = 0
+        done_seen = 0
+        last_post = -_TICK_POST_INTERVAL_S
+        with telemetry.span("serve.drain", cat="serve", requests=n):
+            t0 = time.perf_counter()
+            while done_seen < n:
+                now = time.perf_counter() - t0
+                while submitted < n and trace[submitted].arrival_s <= now:
+                    r = trace[submitted]
+                    idx = eng.submit(Request(r.prompt, max_new=r.max_new))
+                    alias[idx] = r.index
+                    tracker.arrived(r.index, r.arrival_s)
+                    submitted += 1
+                admitted = 0
+                if self._admission_open(eng):
+                    admitted = eng.admit_ready()
+                if admitted:
+                    # admission computes the first generated token
+                    # synchronously; idempotent, so re-stamping active
+                    # slots is safe and preemption re-admissions no-op
+                    t_now = time.perf_counter() - t0
+                    for s in eng.active_slots():
+                        tracker.first_token(alias[eng.slot_request(s)], t_now)
+                    hol_ticks = 0
+                head_req = eng.queue_head()
+                head = alias[head_req] if head_req is not None else None
+                if head is not None and head == last_head and not admitted:
+                    hol_ticks += 1
+                    if (
+                        o["preempt_hol_ticks"]
+                        and hol_ticks > o["preempt_hol_ticks"]
+                        and eng.active_slots()
+                    ):
+                        self._preempt_for_head(eng, alias)
+                        hol_ticks = 0
+                else:
+                    last_head = head
+                tracker.observe_queue(eng.queue_depth)
+                active = eng.step()
+                t_now = time.perf_counter() - t0
+                for c in eng.completions[done_seen:]:
+                    orig = alias[c.request_index]
+                    tracker.first_token(orig, t_now)  # 1-token finishers
+                    tracker.finished(
+                        orig, t_now, c.tokens.size - orig_prompt[orig]
+                    )
+                done_seen = len(eng.completions)
+                if t_now - last_post >= _TICK_POST_INTERVAL_S:
+                    # env-gated no-op unless DDLB_TPU_LIVE is set — the
+                    # dashboard's queue-depth sparkline feed
+                    live.post_event(
+                        "serving_tick",
+                        queue_depth=eng.queue_depth,
+                        active=active,
+                        done=done_seen,
+                        total=n,
+                    )
+                    last_post = t_now
+                if active == 0 and not eng.queue_depth and submitted < n:
+                    # idle gap: the next event is the next arrival, whose
+                    # time is KNOWN — sleep exactly to it (a capped nap
+                    # here would tax every low-load TTFT by the cap)
+                    wait = trace[submitted].arrival_s - (
+                        time.perf_counter() - t0
+                    )
+                    if wait > 0:
+                        time.sleep(wait)
+            makespan = time.perf_counter() - t0
+        horizon = max(self._trace_horizon_s(), 1e-9)
+        if tracker is self._pooled:
+            self._makespan_total += makespan
+            goodput_window = self._makespan_total
+        else:
+            goodput_window = makespan
+        fields = tracker.row_fields(goodput_window, offered_rps=n / horizon)
+        telemetry.record_max("serve.queue_depth", tracker.queue_peak)
+        telemetry.instant(
+            "serve.slo", cat="serve",
+            completed=tracker.completed,
+            ttft_p95_ms=fields["slo_ttft_p95_ms"],
+            goodput_rps=fields["slo_goodput_rps"],
+            queue_peak=tracker.queue_peak,
+        )
+        self._last = {
+            "tracker": tracker,
+            "fields": fields,
+            "makespan_s": makespan,
+            "completions": [
+                (alias[c.request_index], c.tokens) for c in eng.completions
+            ],
+        }
+
+    def _preempt_for_head(self, eng, alias: Dict[int, int]) -> None:
+        """The head-of-line policy's action: preempt the active slot
+        with the most remaining budget (the one whose eviction frees a
+        lane soonest per token of work lost), keeping the timeline
+        alias pointing at the original trace request."""
+        slot = max(eng.active_slots(), key=eng.remaining_budget)
+        orig = alias[eng.slot_request(slot)]
+        new_idx = eng.preempt(slot)
+        alias[new_idx] = orig
+
+    # -- row columns ---------------------------------------------------------
+
+    def extra_row_fields(self) -> dict:
+        """The SLO distribution columns — pooled over the row's
+        post-warmup drains — plus the engine's own scheduling/pressure
+        counters (schema.py documents each; every column appears on
+        every serving_load row so CSVs keep one header)."""
+        if self._last is None:
+            return {}
+        s = self._engine.stats
+        out = dict(self._last["fields"])
+        out.update(
+            {
+                "serve_occupancy": round(s.occupancy, 4),
+                "serve_prefix_hits": s.prefix_hits,
+                "serve_admissions_deferred": s.admissions_deferred,
+                "serve_preemptions": s.preemptions,
+                "serve_kv_evicted_tokens": s.kv_evicted_tokens,
+                # always present (0 capacity = contiguous layout), so a
+                # mixed contiguous/paged sweep keeps ONE CSV header —
+                # the appender aligns to the first row written
+                "serve_peak_pages": s.peak_pages_in_use,
+                "serve_pages_capacity": s.pages_capacity,
+            }
+        )
+        return out
+
+    # -- validation ----------------------------------------------------------
+
+    def validate(self, result) -> bool:
+        """Accounting validation of the last drain: every trace request
+        completed exactly once, its generated budget was honored (the
+        engine runs eos-free, so completion length is exact), its
+        prompt round-tripped at the front of its token stream, all
+        tokens in vocab range, and the SLO ledger agrees with the
+        completion count. Token-level chain exactness is the engine's
+        own pinned contract (tests/test_serving_engine.py)."""
+        if self._last is None:
+            telemetry.log("serving_load validation FAILED: no drain ran")
+            return False
+        o = self.options
+        trace = {r.index: r for r in self._trace}
+        seen: Dict[int, int] = {}
+        ok = True
+        for orig, tokens in self._last["completions"]:
+            seen[orig] = seen.get(orig, 0) + 1
+            r = trace[orig]
+            S0 = r.prompt.size
+            if tokens.size != S0 + r.max_new:
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"length {tokens.size} != {S0 + r.max_new}"
+                )
+                ok = False
+                continue
+            if not np.array_equal(tokens[:S0], r.prompt):
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"prompt mangled"
+                )
+                ok = False
+            if ((tokens < 0) | (tokens >= o["vocab"])).any():
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"token out of vocab range"
+                )
+                ok = False
+        if sorted(seen) != sorted(trace) or any(
+            v != 1 for v in seen.values()
+        ):
+            telemetry.log(
+                f"serving_load validation FAILED: {len(seen)} distinct "
+                f"completions for {len(trace)} requests"
+            )
+            ok = False
+        tracker = self._last["tracker"]
+        expected = (
+            (self._drains - 1) * len(trace)
+            if tracker is self._pooled
+            else len(trace)
+        )
+        if tracker.completed != expected:
+            telemetry.log(
+                "serving_load validation FAILED: SLO ledger count "
+                f"{tracker.completed} != {expected} "
+                f"({self._drains} drains of {len(trace)} requests)"
+            )
+            ok = False
+        return ok

@@ -1,0 +1,18 @@
+"""The continuous-batching member: admit into any free slot, any tick.
+
+The production policy (``models/serving.py``'s reason to exist): a
+request is admitted the moment a slot frees, so lanes never idle while
+traffic waits. With ``preempt_hol_ticks`` set, the base drive loop
+additionally relieves head-of-line blocking by preempting the
+longest-remaining active request — the engine's eviction mechanism
+under a real policy.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.serving_load.base import ServingLoad
+
+
+class EngineServingLoad(ServingLoad):
+    def _admission_open(self, engine) -> bool:
+        return True

@@ -1,0 +1,18 @@
+"""The batch-synchronous strawman: admit only when EVERY slot is idle.
+
+Pre-continuous-batching serving: a wave of requests is admitted
+together and the next wave waits until the whole batch drains, so one
+long generation holds ``batch - 1`` finished lanes hostage. Measured
+under the same traffic as the ``engine`` member, the TTFT-percentile
+and goodput gap between the two IS continuous batching's win — the
+baseline the serving observability layer exists to make visible.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.serving_load.base import ServingLoad
+
+
+class StaticServingLoad(ServingLoad):
+    def _admission_open(self, engine) -> bool:
+        return not engine.active_slots()

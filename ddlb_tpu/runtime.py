@@ -131,6 +131,20 @@ def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=None):
     )
 
 
+def reshard_compat(x, sharding):
+    """``jax.sharding.reshard`` where available, falling back to
+    ``with_sharding_constraint`` on pre-0.5 JAX. The two agree for the
+    serving paths' use: pinning one explicit layout on a traced value
+    under jit. (reshard exists because with_sharding_constraint is a
+    no-op under Explicit axis types; 0.4.x has no Explicit axis types,
+    so the constraint is the real thing there.)"""
+    import jax
+
+    if hasattr(jax.sharding, "reshard"):
+        return jax.sharding.reshard(x, sharding)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
 class Runtime:
     """Process-wide singleton (reference Communicator.__new__, communicator.py:36-43)."""
 

@@ -98,4 +98,23 @@ ROW_COLUMNS: Dict[str, str] = {
     "serve_peak_pages": "serving paged-KV peak pages in use",
     "serve_pages_capacity": "serving paged-KV pool capacity",
     "serve_prefix_hits": "serving shared-prefix cache hits",
+    # -- serving_load SLO telemetry (ISSUE 11: open-loop traffic drains;
+    #    percentiles are streaming estimates within 0.4% relative —
+    #    workload/slo.py; NaN marks "no sample", e.g. TPOT with every
+    #    request generating one token) ----------------------------------
+    "slo_offered_rps": "realized offered load: requests / arrival horizon",
+    "slo_completed": "completions pooled over the row's post-warmup drains",
+    "slo_ttft_p50_ms": "median time-to-first-token incl. queueing wait",
+    "slo_ttft_p95_ms": "p95 time-to-first-token incl. queueing wait",
+    "slo_ttft_p99_ms": "p99 time-to-first-token incl. queueing wait",
+    "slo_tpot_p50_ms": "median per-output-token latency (steady decode)",
+    "slo_tpot_p95_ms": "p95 per-output-token latency",
+    "slo_tpot_p99_ms": "p99 per-output-token latency",
+    "slo_e2e_p95_ms": "p95 end-to-end request latency (arrival to done)",
+    "slo_goodput_rps": "completions meeting BOTH SLO bounds per second",
+    "slo_attainment": "fraction of completions meeting both SLO bounds",
+    "serve_queue_peak": "peak admission-queue depth over the drain",
+    "serve_queue_mean": "mean admission-queue depth over the drain",
+    "serve_preemptions": "requests preempted (requeued, KV evicted)",
+    "serve_kv_evicted_tokens": "KV cache rows abandoned by preemptions",
 }

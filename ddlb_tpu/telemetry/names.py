@@ -38,6 +38,7 @@ SPAN_NAMES: Dict[str, str] = {
     "runtime.barrier": "cross-process barrier collective",
     "runtime.mesh_build": "device mesh construction",
     "serve.admit": "serving engine admission of one request batch",
+    "serve.drain": "serving_load one open-loop trace drain (measured call)",
     "serve.run": "serving engine full run loop",
     "worker.profile": "benchmark_worker optional profiling phase",
     "worker.row": "benchmark_worker one full row (the report join key)",
@@ -57,6 +58,8 @@ INSTANT_NAMES: Dict[str, str] = {
     "pool.reuse": "a row dispatched onto an already-warm pool worker",
     "queue.parked": "measure_queue parked a row (deterministic failure)",
     "runner.quarantine": "an impl crossed the consecutive-failure gate",
+    "serve.preempt": "serving engine preempted a slot (requeued, KV evicted)",
+    "serve.slo": "serving_load end-of-drain SLO summary (TTFT/goodput)",
     "serve.ticks": "serving engine decode-tick marker",
 }
 
@@ -80,6 +83,7 @@ METRIC_NAMES: Dict[str, str] = {
     "runner.quarantined_impls": "impls quarantined this run",
     "runner.retries": "row retry attempts dispatched",
     "serve.decode_s": "seconds in serving decode ticks",
+    "serve.queue_depth": "serving load driver's peak observed queue depth",
     "serve.ticks": "serving decode ticks executed",
 }
 

@@ -103,7 +103,9 @@ def build_report(history_dir, args):
     if args.get("current"):
         records = _drop_self_banked(records, current)
     self_excluded = banked_total - len(records)
-    findings = regress.detect(
+    # the full gate: median time + every serving SLO percentile/goodput
+    # column, one ranked list (regress.detect_all, ISSUE 11)
+    findings = regress.detect_all(
         current,
         records,
         exclude_run=exclude,
@@ -150,17 +152,20 @@ def print_report(report, top_n):
         return
     print(f"\n{len(findings)} regression(s), worst first:")
     print(
-        f"  {'#':>2} {'impl':<22} {'shape':<17} {'measured':>10} "
+        f"  {'#':>2} {'impl':<18} {'shape':<13} "
+        f"{'metric':<16} {'measured':>10} "
         f"{'baseline':>10} {'ratio':>6} {'z':>7}  source"
     )
     for i, f in enumerate(findings[:top_n], 1):
         shape = f"{f.get('m')}x{f.get('n')}x{f.get('k')}"
         z = f.get("z")
         z_txt = f"{z:7.1f}" if isinstance(z, float) and z == z else "      -"
+        metric = str(f.get("metric") or regress.MEASURE_COLUMN)
+        metric = metric.replace("median time (ms)", "median_ms")
         print(
-            f"  {i:>2} {str(f.get('implementation'))[:22]:<22} "
-            f"{shape:<17} {f['measured_ms']:>9.3f}ms "
-            f"{f['baseline_ms']:>9.3f}ms {f['ratio']:>5.2f}x "
+            f"  {i:>2} {str(f.get('implementation'))[:18]:<18} "
+            f"{shape:<13} {metric[:16]:<16} {f['measured_ms']:>9.3f}  "
+            f"{f['baseline_ms']:>9.3f} {f['ratio']:>5.2f}x "
             f"{z_txt}  {f['source']}"
         )
     if len(findings) > top_n:

@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Serving load-sweep report: latency-vs-offered-load, knee, SLO gate.
+
+The serving observability layer's CLI (ISSUE 11). Input is a sweep CSV
+of ``serving_load`` rows (the ``rate`` option is the load axis; every
+other option equal rows form one curve). For each curve the report:
+
+- prints the **latency-vs-offered-load table** — offered rate, TTFT
+  p50/p95/p99, TPOT p95, goodput, attainment, queue peak — plus an
+  ASCII p95-TTFT bar per point, so the saturation shape is visible in a
+  terminal transcript;
+- finds the **saturation knee**: the first swept rate whose knee
+  metric (default: MEDIAN TTFT — saturation moves every request's
+  queueing wait, and the median resists the scheduler-stall tail noise
+  shared hosts add; ``--knee-metric slo_ttft_p95_ms`` for quiet
+  dedicated hardware) exceeds ``--knee-factor`` (default 2.5) times
+  the lowest-rate baseline — the last point BEFORE it is the highest
+  offered load the configuration sustains with bounded queueing. "No
+  knee within the swept range" is itself a finding (the sweep never
+  reached saturation);
+- runs the **observatory SLO gate** when a history bank is available
+  (``--history DIR`` or ``DDLB_TPU_HISTORY``): every row's median time
+  AND SLO percentile/goodput columns against their per-key banked
+  history (``observatory.regress.detect_all``), with the current CSV's
+  own banked copies excluded so a run never baselines against itself.
+
+Exit code: 0 clean, 1 when the SLO gate found regressions, 2 usage —
+the same gating contract as ``observatory_report.py``, so CI wraps it
+directly (``make serving-load-report``).
+
+Usage: python scripts/serving_load_report.py --current CSV
+           [--history DIR] [--json] [--json-out FILE] [--knee-factor F]
+           [--knee-metric COL] [--top N] [--z-tol F] [--min-excess F]
+
+(``--json`` replaces stdout with the document; ``--json-out FILE``
+keeps the human view on stdout and writes the same document to FILE
+from the one parse/gate pass.)
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlb_tpu.observatory import regress, store  # noqa: E402
+
+#: the per-point columns a curve carries (CSV -> float via
+#: regress.finite; missing/NaN stays None and renders as "-")
+_POINT_COLUMNS = (
+    "slo_offered_rps",
+    "slo_ttft_p50_ms",
+    "slo_ttft_p95_ms",
+    "slo_ttft_p99_ms",
+    "slo_tpot_p95_ms",
+    "slo_goodput_rps",
+    "slo_attainment",
+    "serve_queue_peak",
+    "serve_preemptions",
+    "median time (ms)",
+)
+
+_INT_COLUMNS = ("m", "n", "k", "world_size")
+
+
+def _coerce(row):
+    """Normalize one CSV row so its history key matches banked rows."""
+    out = dict(row)
+    for col in _INT_COLUMNS:
+        try:
+            out[col] = int(float(out[col]))
+        except (KeyError, TypeError, ValueError):
+            pass
+    return out
+
+
+def _split_rate(option: str):
+    """(rate, option-without-rate): the load axis is stripped from the
+    curve's group identity so rows differing only in ``rate`` line up."""
+    rate = None
+    kept = []
+    for part in str(option or "").split(";"):
+        if part.startswith("rate="):
+            try:
+                rate = float(part[5:])
+            except ValueError:
+                rate = None
+        else:
+            kept.append(part)
+    return rate, ";".join(kept)
+
+
+def load_rows(path):
+    with open(path, newline="", encoding="utf-8") as f:
+        return [_coerce(r) for r in csv.DictReader(f)]
+
+
+def build_curves(rows):
+    """serving_load rows -> [{group, points}] with points sorted by the
+    swept rate. Non-serving rows (no slo columns) are ignored."""
+    curves = {}
+    for row in rows:
+        if row.get("primitive") != "serving_load":
+            continue
+        if regress.finite(row.get("slo_ttft_p95_ms")) is None:
+            continue  # error row: nothing to curve
+        rate, rest = _split_rate(row.get("option"))
+        if rate is None:
+            continue
+        key = (
+            str(row.get("base_implementation")),
+            rest,
+            row.get("m"),
+            row.get("n"),
+            row.get("k"),
+            str(row.get("dtype")),
+        )
+        point = {"rate": rate}
+        for col in _POINT_COLUMNS:
+            point[col] = regress.finite(row.get(col))
+        curves.setdefault(key, []).append(point)
+    out = []
+    for key, points in sorted(curves.items(), key=lambda kv: str(kv[0])):
+        points.sort(key=lambda p: p["rate"])
+        out.append(
+            {
+                "impl": key[0],
+                "option": key[1],
+                "shape": f"{key[2]}x{key[3]}x{key[4]}",
+                "dtype": key[5],
+                "points": points,
+            }
+        )
+    return out
+
+
+#: default knee metric: the MEDIAN TTFT. Saturation moves every
+#: request's queueing wait, so the median blows up exactly at the knee;
+#: tail percentiles saturate earlier but also carry scheduler-stall
+#: noise on shared hosts — they stay in the table, the knee decision
+#: defaults to the robust statistic (``--knee-metric`` overrides, e.g.
+#: slo_ttft_p95_ms on quiet dedicated hardware).
+KNEE_METRIC = "slo_ttft_p50_ms"
+
+
+def find_knee(points, knee_factor, metric=KNEE_METRIC):
+    """The saturation knee of one curve: the first swept rate whose
+    knee metric exceeds ``knee_factor`` x the lowest-rate baseline.
+    Returns a dict with ``detected``, the knee point, and the last
+    sustainable point before it."""
+    usable = [p for p in points if p.get(metric) is not None]
+    if len(usable) < 2:
+        return {"detected": False, "reason": "fewer than 2 measured points"}
+    base = usable[0][metric]
+    if base <= 0.0:
+        return {"detected": False, "reason": f"degenerate baseline {metric}"}
+    for i, p in enumerate(usable[1:], 1):
+        ratio = p[metric] / base
+        if ratio > knee_factor:
+            return {
+                "detected": True,
+                "metric": metric,
+                "knee_rate": p["rate"],
+                "sustained_rate": usable[i - 1]["rate"],
+                "ratio": ratio,
+                "baseline_ms": base,
+            }
+    return {
+        "detected": False,
+        "reason": (
+            f"{metric} stayed within {knee_factor}x of baseline across "
+            f"the swept range (no saturation reached)"
+        ),
+    }
+
+
+def _fmt(value, spec="{:.1f}", missing="-"):
+    return missing if value is None else spec.format(value)
+
+
+def _bar(value, peak, width=28):
+    if value is None or peak is None or peak <= 0:
+        return ""
+    return "#" * max(1, int(round(value / peak * width)))
+
+
+def print_curves(curves, knee_factor):
+    for curve in curves:
+        print(
+            f"\n{curve['impl']} [{curve['shape']} {curve['dtype']}] "
+            f"{curve['option']}"
+        )
+        print(
+            f"  {'rate':>7} {'offered':>8} {'ttft p50':>9} {'ttft p95':>9} "
+            f"{'ttft p99':>9} {'tpot p95':>9} {'goodput':>8} {'attain':>7} "
+            f"{'queue':>6}  p95 latency"
+        )
+        peak = max(
+            (p["slo_ttft_p95_ms"] for p in curve["points"]
+             if p.get("slo_ttft_p95_ms") is not None),
+            default=None,
+        )  # the bar scale: the curve's own worst p95
+        for p in curve["points"]:
+            print(
+                f"  {p['rate']:>7.1f} "
+                f"{_fmt(p.get('slo_offered_rps')):>8} "
+                f"{_fmt(p.get('slo_ttft_p50_ms')):>9} "
+                f"{_fmt(p.get('slo_ttft_p95_ms')):>9} "
+                f"{_fmt(p.get('slo_ttft_p99_ms')):>9} "
+                f"{_fmt(p.get('slo_tpot_p95_ms'), '{:.2f}'):>9} "
+                f"{_fmt(p.get('slo_goodput_rps'), '{:.2f}'):>8} "
+                f"{_fmt(p.get('slo_attainment'), '{:.0%}'):>7} "
+                f"{_fmt(p.get('serve_queue_peak'), '{:.0f}'):>6}  "
+                f"{_bar(p.get('slo_ttft_p95_ms'), peak)}"
+            )
+        knee = curve["knee"]
+        if knee["detected"]:
+            print(
+                f"  saturation knee: {knee['metric']} blows past "
+                f"{knee_factor:.1f}x baseline at {knee['knee_rate']:.1f} "
+                f"req/s offered ({knee['ratio']:.1f}x); last "
+                f"sustained load {knee['sustained_rate']:.1f} req/s"
+            )
+        else:
+            print(f"  no saturation knee: {knee['reason']}")
+
+
+def run_gate(
+    rows,
+    history_dir,
+    top_n,
+    quiet=False,
+    z_tol=regress.Z_TOL,
+    min_excess=regress.MIN_EXCESS,
+):
+    """The observatory SLO gate against the banked history; returns the
+    findings list (empty = clean)."""
+    records = store.load_history(history_dir)
+    # drop the current CSV's own banked copies (exact key+median match
+    # — the observatory_report self-baseline rule)
+    own = set()
+    for row in rows:
+        value = regress.finite(row.get(regress.MEASURE_COLUMN))
+        if value is not None:
+            own.add((regress.row_key(row), round(value, 9)))
+    kept = []
+    for record in records:
+        r = record.get("row") or {}
+        value = regress.finite(r.get(regress.MEASURE_COLUMN))
+        key = record.get("key") or regress.row_key(r)
+        if value is not None and (key, round(value, 9)) in own:
+            continue
+        kept.append(record)
+    findings = regress.detect_all(
+        rows, kept, z_tol=z_tol, min_excess=min_excess
+    )
+    if quiet:
+        return findings
+    if not findings:
+        print(
+            f"\nSLO gate: clean against {len(kept)} banked baseline "
+            f"row(s)"
+        )
+        return findings
+    print(f"\nSLO gate: {len(findings)} regression(s), worst first:")
+    for i, f in enumerate(findings[:top_n], 1):
+        metric = str(f.get("metric") or regress.MEASURE_COLUMN)
+        z = f.get("z")
+        z_txt = f"z={z:.1f}" if isinstance(z, float) and z == z else "prior"
+        print(
+            f"  {i:>2} {str(f.get('implementation'))[:20]:<20} "
+            f"{metric:<18} {f['measured_ms']:>10.3f} vs "
+            f"{f['baseline_ms']:>10.3f}  {f['ratio']:.2f}x  {z_txt}"
+        )
+    if len(findings) > top_n:
+        print(f"  ... and {len(findings) - top_n} more (--top)")
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+
+    def _opt(flag, default=None):
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                raise SystemExit(f"serving_load_report: {flag} needs a value")
+            value = argv[i + 1]
+            del argv[i: i + 2]
+            return value
+        return default
+
+    current = _opt("--current")
+    history_dir = _opt("--history") or os.environ.get(
+        "DDLB_TPU_HISTORY", ""
+    ).strip()
+    knee_factor = float(_opt("--knee-factor", "2.5"))
+    knee_metric = _opt("--knee-metric", KNEE_METRIC)
+    top_n = int(_opt("--top", "20"))
+    z_tol = float(_opt("--z-tol", regress.Z_TOL))
+    min_excess = float(_opt("--min-excess", regress.MIN_EXCESS))
+    json_out = _opt("--json-out")
+    if argv and current is None:
+        current = argv.pop(0)
+    if argv:
+        print(f"serving_load_report: unknown argument(s): {argv}")
+        return 2
+    if not current:
+        print(
+            "usage: serving_load_report.py --current CSV [--history DIR] "
+            "[--json] [--knee-factor F] [--top N]"
+        )
+        return 2
+    rows = load_rows(current)
+    curves = build_curves(rows)
+    if not curves:
+        print(
+            f"serving_load_report: no measured serving_load rows in "
+            f"{current}"
+        )
+        return 2
+    for curve in curves:
+        curve["knee"] = find_knee(
+            curve["points"], knee_factor, metric=knee_metric
+        )
+    findings = []
+    if as_json:
+        # JSON mode is machine-consumed: the document is the only output
+        if history_dir:
+            findings = run_gate(
+                rows, history_dir, top_n, quiet=True,
+                z_tol=z_tol, min_excess=min_excess,
+            )
+        print(
+            json.dumps(
+                {
+                    "current": os.path.abspath(current),
+                    "knee_factor": knee_factor,
+                    "curves": curves,
+                    "findings": findings,
+                },
+                indent=1,
+                default=str,
+            )
+        )
+        return 1 if findings else 0
+    print(
+        f"serving load report — {current}: {len(curves)} curve(s), "
+        f"knee factor {knee_factor}"
+    )
+    print_curves(curves, knee_factor)
+    if history_dir:
+        findings = run_gate(
+            rows, history_dir, top_n, z_tol=z_tol, min_excess=min_excess
+        )
+    else:
+        print("\nSLO gate: skipped (no history bank — pass --history DIR)")
+    if json_out:
+        # the machine-readable document NEXT TO the human view, from the
+        # one parse/gate pass (the demo and CI consume both)
+        with open(json_out, "w", encoding="utf-8") as f:
+            json.dump(
+                {
+                    "current": os.path.abspath(current),
+                    "knee_factor": knee_factor,
+                    "curves": curves,
+                    "findings": findings,
+                },
+                f,
+                indent=1,
+                default=str,
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

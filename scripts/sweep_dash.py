@@ -20,7 +20,15 @@ Shown, from the folded event state (``ddlb_tpu/observatory/live.py``):
 - recent rows and the rolling predicted-vs-measured view: median
   roofline fraction and median measured overlap fraction, so an overlap
   regression is visible WHILE the sweep runs instead of in tomorrow's
-  CSV diff.
+  CSV diff;
+- the serving panel (ISSUE 11), when the stream carries serving_load
+  traffic: latest TTFT p50/p95/p99 + goodput + SLO-attainment tiles
+  and the drive loop's queue-depth sparkline (``serving_tick``
+  events) — saturation visible as it builds, not post-hoc.
+
+Forward compatibility: event kinds this build does not recognize are
+counted and surfaced as a note (text and HTML both) — a stream written
+by a NEWER runner degrades loudly instead of rendering a blank frame.
 
 Renderers:
 
@@ -73,6 +81,70 @@ def _rolling(state):
         median(ov) if ov else None,
         len(state["fracs"]),
     )
+
+
+#: unicode eighth-block ramp for the text sparkline
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width=40):
+    """Queue-depth gauge ring as a block-character sparkline (text
+    modes; the HTML snapshot draws the same series as SVG)."""
+    if not values:
+        return ""
+    values = values[-width:]
+    hi = max(values)
+    if hi <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    return "".join(
+        _SPARK_BLOCKS[
+            min(len(_SPARK_BLOCKS) - 1, int(v / hi * (len(_SPARK_BLOCKS) - 1)))
+        ]
+        for v in values
+    )
+
+
+def _serving_lines(state):
+    """The serving panel (empty list when no serving events were seen):
+    latest SLO summary + the queue-depth sparkline."""
+    serving = state.get("serving") or {}
+    latest = serving.get("latest")
+    depths = serving.get("depths") or []
+    progress = serving.get("progress")
+    if not latest and not depths:
+        return []
+    lines = ["", "serving:"]
+    if latest:
+        lines.append(
+            f"  TTFT p50/p95/p99: {_fmt(latest.get('ttft_p50_ms'), '{:.1f}')}"
+            f"/{_fmt(latest.get('ttft_p95_ms'), '{:.1f}')}"
+            f"/{_fmt(latest.get('ttft_p99_ms'), '{:.1f}')} ms   "
+            f"goodput {_fmt(latest.get('goodput_rps'), '{:.2f}')} req/s   "
+            f"SLO attainment {_fmt(latest.get('attainment'), '{:.0%}')}"
+            f"   [{latest.get('impl')}]"
+        )
+    if depths:
+        head = f"  queue depth (peak {max(depths)}): "
+        lines.append(head + _sparkline(depths))
+    if progress and progress.get("total"):
+        lines.append(
+            f"  drain: {progress.get('done')}/{progress.get('total')} done, "
+            f"{progress.get('active')} lanes active"
+        )
+    return lines
+
+
+def _unknown_note(state):
+    """One line naming event kinds this dashboard build doesn't know —
+    the forward-compat guard (a newer runner sharing the stream must
+    degrade loudly, not as a blank frame)."""
+    unknown = state.get("unknown") or {}
+    if not unknown:
+        return ""
+    kinds = ", ".join(
+        f"{kind} x{count}" for kind, count in sorted(unknown.items())
+    )
+    return f"note: {sum(unknown.values())} event(s) of unrecognized kind(s): {kinds}"
 
 
 def render_text(state, width=96):
@@ -141,6 +213,10 @@ def render_text(state, width=96):
             f"{_fmt(e.get('measured_overlap_frac')):>8}  "
             f"{' '.join(flags)}"
         )
+    lines.extend(_serving_lines(state))
+    note = _unknown_note(state)
+    if note:
+        lines.extend(["", note])
     return "\n".join(line[:width] for line in lines)
 
 
@@ -159,6 +235,7 @@ _HTML_HEAD = """<!DOCTYPE html>
   --border: #d9d8d4;
   --status-good: #0ca30c; --status-critical: #d03b3b;
   --status-warning: #fab219;
+  --series-1: #2a78d6;
   background: var(--surface-1); color: var(--text-primary);
   font: 14px/1.5 system-ui, sans-serif; padding: 24px; margin: 0;
 }
@@ -168,9 +245,11 @@ _HTML_HEAD = """<!DOCTYPE html>
     --surface-1: #1a1a19; --surface-2: #242422;
     --text-primary: #ffffff; --text-secondary: #c3c2b7;
     --border: #3a3a37;
+    --series-1: #3987e5;
   }
 }
 .viz-root h1 { font-size: 18px; margin: 0 0 4px; }
+.viz-root h2 { font-size: 15px; margin: 0 0 8px; }
 .viz-root .sub { color: var(--text-secondary); margin: 0 0 20px; }
 .tiles { display: flex; gap: 12px; flex-wrap: wrap; margin: 0 0 24px; }
 .tile { background: var(--surface-2); border: 1px solid var(--border);
@@ -186,8 +265,39 @@ td.num, th.num { text-align: right; }
 .status.good { color: var(--status-good); }
 .status.bad { color: var(--status-critical); }
 .status.warn { color: var(--status-warning); }
+.spark { display: block; }
+.spark polyline { fill: none; stroke: var(--series-1); stroke-width: 2;
+  stroke-linejoin: round; stroke-linecap: round; }
+.note { color: var(--text-secondary); margin: 0 0 24px; }
 </style></head><body class="viz-root">
 """
+
+
+def _spark_svg(depths, width=360, height=48, pad=4):
+    """The queue-depth gauge ring as one inline SVG polyline (single
+    series: the caption names it, the stroke wears the categorical
+    slot-1 token, values stay in ink via the caption text)."""
+    values = depths[-120:]
+    hi = max(max(values), 1)
+    n = len(values)
+    points = []
+    for i, v in enumerate(values):
+        x = pad + (width - 2 * pad) * (i / max(n - 1, 1))
+        y = height - pad - (height - 2 * pad) * (v / hi)
+        points.append(f"{x:.1f},{y:.1f}")
+    caption = (
+        f"queue depth over the last {n} gauge samples "
+        f"(peak {max(values)})"
+    )
+    return (
+        f'<figure class="spark" style="margin:0 0 24px">'
+        f'<svg width="{width}" height="{height}" role="img" '
+        f'aria-label="{html_mod.escape(caption)}">'
+        f'<polyline points="{" ".join(points)}"><title>'
+        f"{html_mod.escape(caption)}</title></polyline></svg>"
+        f'<figcaption style="color:var(--text-secondary);font-size:12px">'
+        f"{html_mod.escape(caption)}</figcaption></figure>"
+    )
 
 
 def render_html(state, source=""):
@@ -221,6 +331,35 @@ def render_html(state, source=""):
             f'<div class="l">{esc(label)}</div></div>'
         )
     out.append("</div>")
+
+    serving = state.get("serving") or {}
+    latest = serving.get("latest")
+    depths = serving.get("depths") or []
+    if latest or depths:
+        out.append("<h2>Serving</h2>")
+        if latest:
+            s_tiles = [
+                (_fmt(latest.get("ttft_p50_ms"), "{:.1f}"), "TTFT p50 (ms)"),
+                (_fmt(latest.get("ttft_p95_ms"), "{:.1f}"), "TTFT p95 (ms)"),
+                (_fmt(latest.get("ttft_p99_ms"), "{:.1f}"), "TTFT p99 (ms)"),
+                (
+                    _fmt(latest.get("goodput_rps"), "{:.2f}"),
+                    "goodput (req/s in SLO)",
+                ),
+                (_fmt(latest.get("attainment"), "{:.0%}"), "SLO attainment"),
+            ]
+            out.append('<div class="tiles">')
+            for value, label in s_tiles:
+                out.append(
+                    f'<div class="tile"><div class="v">{esc(value)}</div>'
+                    f'<div class="l">{esc(label)}</div></div>'
+                )
+            out.append("</div>")
+        if depths:
+            out.append(_spark_svg(depths))
+    note = _unknown_note(state)
+    if note:
+        out.append(f'<p class="note">{esc(note)}</p>')
 
     out.append('<table><caption>Workers</caption>')
     out.append(

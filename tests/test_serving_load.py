@@ -1,0 +1,761 @@
+"""Traffic-scale serving observability (ISSUE 11).
+
+Four layers, cheapest first: the workload generator's determinism
+contract, the streaming-percentile accuracy bound, the SLO regression
+gate's fire/stay-silent semantics on synthetic history, and the
+engine-backed ``serving_load`` family end to end (SLO columns, the
+preemption policy under overload, the serve fault sites).
+"""
+
+import csv
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+# ---------------------------------------------------------------------------
+# workload generator
+# ---------------------------------------------------------------------------
+
+
+class TestGenerator:
+    def _spec(self, **kw):
+        from ddlb_tpu.workload import WorkloadSpec
+
+        base = dict(n_requests=64, rate_rps=20.0, seed=7)
+        base.update(kw)
+        return WorkloadSpec(**base)
+
+    @pytest.mark.parametrize("process", ["poisson", "bursty"])
+    def test_seeded_determinism(self, process):
+        """Two runs, identical traces — arrivals, prompts, budgets,
+        prefix picks, byte for byte (the satellite's pinned contract)."""
+        from ddlb_tpu.workload import generate_trace
+
+        spec = self._spec(
+            process=process, prefix_pop=4, prefix_len=8, seed=13
+        )
+        t1 = generate_trace(spec)
+        t2 = generate_trace(spec)
+        assert len(t1) == len(t2) == 64
+        for a, b in zip(t1, t2):
+            assert a.arrival_s == b.arrival_s
+            assert a.max_new == b.max_new
+            assert a.prefix_id == b.prefix_id
+            np.testing.assert_array_equal(a.prompt, b.prompt)
+
+    def test_seed_changes_trace(self):
+        from ddlb_tpu.workload import generate_trace
+
+        t1 = generate_trace(self._spec(seed=1))
+        t2 = generate_trace(self._spec(seed=2))
+        assert any(
+            a.arrival_s != b.arrival_s or not np.array_equal(a.prompt, b.prompt)
+            for a, b in zip(t1, t2)
+        )
+
+    def test_arrivals_monotone_and_rate_shaped(self):
+        from ddlb_tpu.workload import generate_trace
+
+        trace = generate_trace(self._spec(n_requests=400, rate_rps=50.0))
+        arr = np.array([r.arrival_s for r in trace])
+        assert (np.diff(arr) >= 0).all()
+        realized = len(arr) / arr[-1]
+        assert 35.0 < realized < 70.0  # Poisson noise around 50 rps
+
+    def test_bursty_mean_rate_preserved(self):
+        """The MMPP's burst/quiet rates must average back to the
+        offered rate — the process axis varies burstiness, not load."""
+        from ddlb_tpu.workload import generate_trace
+
+        trace = generate_trace(
+            self._spec(
+                n_requests=600, rate_rps=50.0, process="bursty",
+                burst_factor=4.0, burst_duty=0.2, burst_len_s=0.5,
+            )
+        )
+        arr = np.array([r.arrival_s for r in trace])
+        realized = len(arr) / arr[-1]
+        assert 35.0 < realized < 70.0
+
+    def test_zipf_prefix_population(self):
+        """Rank 0 is the hot prefix; prompts carry their prefix tokens
+        inline."""
+        from ddlb_tpu.workload import generate_trace, prefix_tokens
+
+        spec = self._spec(
+            n_requests=300, prefix_pop=6, prefix_len=12, prefix_alpha=1.2
+        )
+        trace = generate_trace(spec)
+        counts = np.bincount(
+            [r.prefix_id for r in trace], minlength=spec.prefix_pop
+        )
+        assert counts[0] == counts.max() and counts[0] > 0
+        hot = prefix_tokens(spec, 0)
+        for r in trace:
+            want = prefix_tokens(spec, r.prefix_id)
+            np.testing.assert_array_equal(r.prompt[: want.size], want)
+        assert hot.size == 12
+
+    def test_spec_validation(self):
+        from ddlb_tpu.workload import WorkloadSpec
+
+        with pytest.raises(ValueError, match="rate_rps"):
+            self._spec(rate_rps=0.0)
+        with pytest.raises(ValueError, match="process"):
+            self._spec(process="steady")
+        with pytest.raises(ValueError, match="quiet"):
+            self._spec(process="bursty", burst_factor=6.0, burst_duty=0.2)
+        with pytest.raises(ValueError, match="prefix_len"):
+            self._spec(prefix_pop=2, prefix_len=0)
+        assert WorkloadSpec(n_requests=1, rate_rps=1.0).max_total_tokens > 0
+
+
+# ---------------------------------------------------------------------------
+# streaming percentiles + SLO ledger
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingQuantile:
+    def test_within_one_percent_of_numpy(self):
+        """The satellite's accuracy bar: 10k-sample reference, every
+        reported percentile within 1% of exact numpy.quantile."""
+        from ddlb_tpu.workload import StreamingQuantile
+
+        rng = np.random.default_rng(3)
+        samples = rng.lognormal(mean=2.5, sigma=1.1, size=10_000)
+        sq = StreamingQuantile()
+        for s in samples:
+            sq.add(float(s))
+        for q in (0.5, 0.9, 0.95, 0.99):
+            exact = float(np.quantile(samples, q))
+            est = sq.quantile(q)
+            assert abs(est - exact) / exact < 0.01, (q, est, exact)
+
+    def test_empty_and_clamped(self):
+        from ddlb_tpu.workload import StreamingQuantile
+
+        sq = StreamingQuantile()
+        assert sq.quantile(0.5) != sq.quantile(0.5)  # NaN
+        sq.add(5.0)
+        assert sq.quantile(0.0) == sq.quantile(1.0) == 5.0
+        with pytest.raises(ValueError):
+            sq.quantile(1.5)
+
+
+class TestSLOTracker:
+    def test_ledger_and_goodput(self):
+        from ddlb_tpu.workload import SLOTracker
+
+        tr = SLOTracker(ttft_slo_ms=100.0, tpot_slo_ms=50.0)
+        # request 0: meets both bounds (ttft 50ms, tpot 10ms over 3 tok)
+        tr.arrived(0, 0.0)
+        tr.first_token(0, 0.05)
+        tr.finished(0, 0.07, new_tokens=3)
+        # request 1: misses the TTFT bound
+        tr.arrived(1, 0.0)
+        tr.first_token(1, 0.5)
+        tr.finished(1, 0.52, new_tokens=3)
+        tr.observe_queue(2)
+        tr.observe_queue(4)
+        fields = tr.row_fields(makespan_s=1.0, offered_rps=2.0)
+        assert fields["slo_completed"] == 2
+        assert fields["slo_goodput_rps"] == pytest.approx(1.0)
+        assert fields["slo_attainment"] == pytest.approx(0.5)
+        assert fields["serve_queue_peak"] == 4
+        assert fields["serve_queue_mean"] == pytest.approx(3.0)
+        assert fields["slo_ttft_p50_ms"] == pytest.approx(50.0, rel=0.02)
+
+    def test_pooling_across_drains(self):
+        """new_drain keeps the distributions and counters, resets the
+        per-request timelines — indices reuse cleanly."""
+        from ddlb_tpu.workload import SLOTracker
+
+        tr = SLOTracker(ttft_slo_ms=1000.0, tpot_slo_ms=1000.0)
+        for _ in range(3):
+            tr.arrived(0, 0.0)
+            tr.first_token(0, 0.01)
+            tr.finished(0, 0.02, new_tokens=2)
+            tr.new_drain()
+        assert tr.completed == 3
+
+    def test_first_token_idempotent(self):
+        """A preempted request's re-admission must not move its TTFT."""
+        from ddlb_tpu.workload import SLOTracker
+
+        tr = SLOTracker(ttft_slo_ms=1000.0, tpot_slo_ms=1000.0)
+        tr.arrived(0, 0.0)
+        tr.first_token(0, 0.02)
+        tr.first_token(0, 0.9)  # re-admission after preemption: no-op
+        tr.finished(0, 1.0, new_tokens=2)
+        assert tr.row_fields(1.0, 1.0)["slo_ttft_p50_ms"] == pytest.approx(
+            20.0, rel=0.02
+        )
+
+
+# ---------------------------------------------------------------------------
+# the SLO regression gate (synthetic history — detector semantics)
+# ---------------------------------------------------------------------------
+
+
+def _serving_record(run, ttft95=20.0, goodput=5.0, med=10.0, rate="8.0"):
+    from ddlb_tpu.observatory import regress
+
+    row = {
+        "implementation": "engine_0", "base_implementation": "engine",
+        "primitive": "serving_load", "option": f"out_mean=4;rate={rate}",
+        "m": 8, "n": 32, "k": 64, "dtype": "float32", "world_size": 4,
+        "chip": "cpu-sim", "time_measurement_backend": "host_clock",
+        "median time (ms)": med,
+        "slo_ttft_p50_ms": ttft95 * 0.6,
+        "slo_ttft_p95_ms": ttft95,
+        "slo_ttft_p99_ms": ttft95 * 1.2,
+        "slo_tpot_p95_ms": 3.0,
+        "slo_goodput_rps": goodput,
+    }
+    return {
+        "kind": "row", "run_id": run, "key": regress.row_key(row),
+        "row": row,
+    }
+
+
+class TestSLOGate:
+    def _history(self, n=4):
+        return [
+            _serving_record(f"r{i}", ttft95=20.0 + 0.3 * i) for i in range(n)
+        ]
+
+    def test_silent_on_clean(self):
+        from ddlb_tpu.observatory import regress
+
+        clean = [_serving_record("cur", ttft95=20.5)["row"]]
+        assert (
+            regress.detect_all(clean, self._history(), exclude_run="cur")
+            == []
+        )
+
+    def test_fires_on_2x_slowdown_ranked_first(self):
+        """A seeded 2x decode slowdown doubles the TTFT percentiles and
+        halves goodput; the gate must fire with SLO-metric findings and
+        rank by robust z."""
+        from ddlb_tpu.observatory import regress
+
+        slowed = [
+            _serving_record("cur", ttft95=41.0, goodput=2.4, med=10.2)["row"]
+        ]
+        findings = regress.detect_all(
+            slowed, self._history(), exclude_run="cur"
+        )
+        assert findings
+        assert all(str(f["metric"]).startswith("slo_") for f in findings)
+        assert findings[0]["ratio"] == pytest.approx(2.0, rel=0.1)
+        zs = [f["z"] for f in findings]
+        assert zs == sorted(zs, reverse=True)
+
+    def test_goodput_direction_is_inverted(self):
+        from ddlb_tpu.observatory import regress
+
+        dropped = [_serving_record("cur", goodput=2.0)["row"]]
+        findings = regress.detect_slo(
+            dropped, self._history(), exclude_run="cur"
+        )
+        assert [f["metric"] for f in findings] == ["slo_goodput_rps"]
+        assert findings[0]["ratio"] == pytest.approx(2.5)
+        # goodput IMPROVING must never flag
+        improved = [_serving_record("cur", goodput=50.0)["row"]]
+        assert (
+            regress.detect_slo(improved, self._history(), exclude_run="cur")
+            == []
+        )
+
+    def test_non_serving_rows_contribute_nothing(self):
+        from ddlb_tpu.observatory import regress
+
+        row = {
+            "implementation": "jax_spmd_0", "primitive": "tp_columnwise",
+            "option": "-", "m": 64, "n": 64, "k": 64,
+            "median time (ms)": 5.0,
+        }
+        assert regress.detect_slo([row], self._history()) == []
+
+    def test_slo_metrics_are_registered_columns(self):
+        """Every gated metric must be a schema-documented column — the
+        gate cannot reference a column the rows will never carry."""
+        from ddlb_tpu.observatory import regress
+        from ddlb_tpu.schema import ROW_COLUMNS
+
+        for metric, direction in regress.SLO_METRICS:
+            assert metric in ROW_COLUMNS
+            assert direction in ("high", "low")
+
+
+# ---------------------------------------------------------------------------
+# the report CLI: curves, knee, gate exit codes
+# ---------------------------------------------------------------------------
+
+
+def _curve_row(rate, ttft50, ttft95, goodput, impl="engine"):
+    return {
+        "primitive": "serving_load",
+        "implementation": f"{impl}_0",
+        "base_implementation": impl,
+        "option": f"out_mean=4;rate={rate}",
+        "m": 8, "n": 32, "k": 64, "dtype": "float32", "world_size": 4,
+        "chip": "cpu-sim", "time_measurement_backend": "host_clock",
+        "median time (ms)": 100.0,
+        "slo_offered_rps": rate * 0.9,
+        "slo_ttft_p50_ms": ttft50,
+        "slo_ttft_p95_ms": ttft95,
+        "slo_ttft_p99_ms": ttft95 * 1.2,
+        "slo_tpot_p95_ms": 4.0,
+        "slo_goodput_rps": goodput,
+        "slo_attainment": 1.0,
+        "serve_queue_peak": 0,
+        "serve_preemptions": 0,
+    }
+
+
+class TestServingLoadReport:
+    def _write_csv(self, path, rows):
+        with open(path, "w", newline="", encoding="utf-8") as f:
+            writer = csv.DictWriter(f, fieldnames=sorted(rows[0]))
+            writer.writeheader()
+            writer.writerows(rows)
+
+    def test_curves_and_knee(self, tmp_path):
+        import serving_load_report as rep
+
+        rows = [
+            _curve_row(4.0, 5.0, 9.0, 3.9),
+            _curve_row(16.0, 6.0, 11.0, 15.0),
+            _curve_row(64.0, 40.0, 120.0, 20.0),   # past the knee
+            _curve_row(256.0, 160.0, 400.0, 21.0),
+        ]
+        curves = rep.build_curves(rows)
+        assert len(curves) == 1
+        knee = rep.find_knee(curves[0]["points"], 2.5)
+        assert knee["detected"]
+        assert knee["knee_rate"] == 64.0
+        assert knee["sustained_rate"] == 16.0
+
+    def test_no_knee_when_flat(self):
+        import serving_load_report as rep
+
+        points = rep.build_curves(
+            [_curve_row(4.0, 5.0, 9.0, 3.9), _curve_row(8.0, 5.5, 9.5, 7.8)]
+        )[0]["points"]
+        assert not rep.find_knee(points, 2.5)["detected"]
+
+    def test_cli_exit_codes(self, tmp_path, monkeypatch):
+        """0 on clean vs history, 1 on a seeded regression, 2 usage —
+        the observatory gating contract."""
+        import serving_load_report as rep
+        from ddlb_tpu.observatory import store
+
+        monkeypatch.delenv("DDLB_TPU_HISTORY", raising=False)
+        hist = tmp_path / "hist"
+        for i, run in enumerate(("base-1", "base-2", "base-3")):
+            for rate in (4.0, 64.0):
+                banked = _curve_row(rate, 5.0 + 0.1 * i, 9.0 + 0.1 * i, 3.9)
+                # distinct medians per run: identical (key, median)
+                # pairs would trip the gate's self-copy exclusion
+                banked["median time (ms)"] = 100.0 + i
+                store.bank_row(banked, run=run, directory=str(hist))
+        clean_csv = tmp_path / "clean.csv"
+        self._write_csv(
+            clean_csv,
+            [_curve_row(4.0, 5.1, 9.2, 3.85), _curve_row(64.0, 5.0, 9.1, 3.9)],
+        )
+        assert rep.main(
+            ["--current", str(clean_csv), "--history", str(hist)]
+        ) == 0
+        slow_csv = tmp_path / "slow.csv"
+        self._write_csv(
+            slow_csv,
+            [
+                _curve_row(4.0, 10.4, 18.6, 1.9),   # 2x ttft, goodput halved
+                _curve_row(64.0, 5.0, 9.1, 3.9),
+            ],
+        )
+        assert rep.main(
+            ["--current", str(slow_csv), "--history", str(hist)]
+        ) == 1
+        assert rep.main([]) == 2
+
+    def test_json_document(self, tmp_path, capsys):
+        import serving_load_report as rep
+
+        path = tmp_path / "c.csv"
+        self._write_csv(
+            path, [_curve_row(4.0, 5.0, 9.0, 3.9), _curve_row(16.0, 30.0, 90.0, 4.0)]
+        )
+        rc = rep.main(["--current", str(path), "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["curves"][0]["knee"]["detected"]
+
+
+# ---------------------------------------------------------------------------
+# dashboard: serving panel + forward-compat guard
+# ---------------------------------------------------------------------------
+
+
+class TestDashboardServingPanel:
+    def _events(self):
+        return [
+            {"kind": "sweep_start", "total": 2, "pid": 1, "ts": 1.0},
+            {"kind": "serving_tick", "pid": 1, "ts": 1.1, "queue_depth": 2,
+             "active": 4, "done": 3, "total": 12},
+            {"kind": "serving_tick", "pid": 1, "ts": 1.2, "queue_depth": 7,
+             "active": 4, "done": 6, "total": 12},
+            {"kind": "row_done", "pid": 1, "ts": 2.0, "impl": "engine_0",
+             "median_ms": 900.0, "slo_ttft_p50_ms": 12.0,
+             "slo_ttft_p95_ms": 31.0, "slo_ttft_p99_ms": 44.0,
+             "slo_goodput_rps": 7.5, "slo_attainment": 0.96},
+        ]
+
+    def test_fold_serving_state(self):
+        from ddlb_tpu.observatory import live
+
+        state = live.fold(self._events())
+        assert state["serving"]["depths"] == [2, 7]
+        assert state["serving"]["latest"]["ttft_p95_ms"] == 31.0
+        assert state["serving"]["progress"]["done"] == 6
+
+    def test_unknown_kinds_counted_not_dropped(self):
+        from ddlb_tpu.observatory import live
+
+        events = self._events() + [
+            {"kind": "from_the_future", "pid": 2, "ts": 3.0},
+            {"kind": "from_the_future", "pid": 2, "ts": 3.1},
+        ]
+        state = live.fold(events)
+        assert state["unknown"] == {"from_the_future": 2}
+
+    def test_fold_tolerates_pre_serving_state_dict(self):
+        """Forward compat the other way: an incremental fold onto a
+        state dict built before the serving keys existed."""
+        from ddlb_tpu.observatory import live
+
+        old = live.fold([])
+        old.pop("serving")
+        old.pop("unknown")
+        state = live.fold(self._events(), old)
+        assert state["serving"]["latest"] is not None
+
+    def test_text_frame_has_panel_and_note(self):
+        import sweep_dash
+        from ddlb_tpu.observatory import live
+
+        state = live.fold(
+            self._events() + [{"kind": "new_kind", "pid": 9, "ts": 4.0}]
+        )
+        text = sweep_dash.render_text(state)
+        assert "serving:" in text
+        assert "TTFT p50/p95/p99" in text
+        assert "queue depth" in text
+        assert "unrecognized" in text and "new_kind" in text
+
+    def test_html_renders_unknown_kinds_not_blank(self):
+        """The satellite: an --html snapshot over a stream full of
+        unrecognized row kinds must render its tables + a loud note,
+        never a blank frame."""
+        import sweep_dash
+        from ddlb_tpu.observatory import live
+
+        foreign = [
+            {"kind": f"kind_{i}", "pid": 1, "ts": float(i)} for i in range(5)
+        ]
+        state = live.fold(foreign)
+        html = sweep_dash.render_html(state, source="test")
+        assert "<table>" in html and "Workers" in html
+        assert "unrecognized" in html and "kind_0" in html
+
+    def test_html_serving_panel_sparkline(self):
+        import sweep_dash
+        from ddlb_tpu.observatory import live
+
+        html = sweep_dash.render_html(live.fold(self._events()))
+        assert "Serving" in html
+        assert "polyline" in html and "queue depth" in html
+        assert "TTFT p95" in html
+
+
+# ---------------------------------------------------------------------------
+# the engine under traffic (the expensive tier: two real drains)
+# ---------------------------------------------------------------------------
+
+
+def _worker_config(**options):
+    base = {
+        "batch": 8, "vocab": 64, "n_heads": 8, "layers": 1,
+        "rate": 200.0, "n_requests": 10, "out_mean": 3, "out_max": 5,
+        "slo_ttft_ms": 4000.0, "slo_tpot_ms": 2000.0,
+    }
+    base.update(options)
+    return {
+        "primitive": "serving_load",
+        "impl_id": "engine_0",
+        "base_implementation": "engine",
+        "options": base,
+        "m": 8, "n": 32, "k": 64, "dtype": "float32",
+        "num_iterations": 1, "num_warmups": 1, "validate": True,
+        "time_measurement_backend": "host_clock",
+        "barrier_at_each_iteration": False,
+    }
+
+
+class TestServingLoadFamily:
+    def test_row_carries_slo_columns_and_validates(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+        from ddlb_tpu.schema import ROW_COLUMNS
+
+        row = benchmark_worker(_worker_config())
+        assert row["error"] == "" and bool(row["valid"])
+        for col in (
+            "slo_ttft_p50_ms", "slo_ttft_p95_ms", "slo_ttft_p99_ms",
+            "slo_goodput_rps", "slo_attainment", "slo_offered_rps",
+            "serve_queue_peak", "serve_queue_mean", "serve_preemptions",
+            "serve_kv_evicted_tokens", "serve_occupancy",
+        ):
+            assert col in row, col
+            assert col in ROW_COLUMNS, col
+        assert row["slo_completed"] == 2 * 10  # timing + validation drains
+        assert np.isfinite(float(row["slo_ttft_p95_ms"]))
+        # the horizon floor: an open-loop drain can't beat its arrivals
+        assert float(row["predicted_s"]) > 0.0
+
+    def test_hol_preemption_fires_under_overload_and_accounts(self):
+        """Head-of-line preemption under a burst of LONG generations:
+        with every slot pinned by a long-running request (short ones
+        free slots almost every tick — continuous batching alone
+        relieves the head), the head would wait tens of ticks; the
+        policy preempts instead, KV rows are evicted, and the
+        accounting validation STILL holds (every request completes
+        exactly once at full budget)."""
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            _worker_config(
+                rate=2000.0, n_requests=12, out_mean=30, out_max=40,
+                preempt_hol_ticks=3,
+            )
+        )
+        assert row["error"] == "" and bool(row["valid"])
+        assert int(row["serve_preemptions"]) > 0
+        assert int(row["serve_kv_evicted_tokens"]) > 0
+
+    def test_trace_identity_is_seed_stable(self):
+        """Two impl constructions, identical workload (the bankable-row
+        precondition)."""
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("serving_load", "engine")
+        a = cls(8, 32, 64, dtype="float32", rate=50.0, n_requests=6,
+                batch=8, vocab=64, n_heads=8)
+        b = cls(8, 32, 64, dtype="float32", rate=50.0, n_requests=6,
+                batch=8, vocab=64, n_heads=8)
+        for ra, rb in zip(a._trace, b._trace):
+            assert ra.arrival_s == rb.arrival_s
+            np.testing.assert_array_equal(ra.prompt, rb.prompt)
+
+
+class TestServeFaultSites:
+    def test_decode_tick_site_fires(self, monkeypatch):
+        """The chaos battery can target the serving path: a
+        serve.decode_tick rule fires on every tick (the latency-
+        injection shape the demo uses for its seeded slowdown)."""
+        from ddlb_tpu import faults
+        from ddlb_tpu.faults import plan as fault_plan
+
+        plan = {
+            "seed": 1,
+            "rules": [{"site": "serve.decode_tick", "kind": "hang",
+                       "duration_s": 0.0}],
+        }
+        monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps(plan))
+        fault_plan.reset()
+        try:
+            from ddlb_tpu.benchmark import benchmark_worker
+
+            row = benchmark_worker(_worker_config(n_requests=4))
+            assert "serve.decode_tick" in str(row["fault_injected"])
+            assert row["error"] == ""
+        finally:
+            monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+            fault_plan.reset()
+
+    def test_sites_registered(self):
+        from ddlb_tpu.faults.plan import SITES
+
+        assert "serve.admit" in SITES
+        assert "serve.decode_tick" in SITES
+
+
+# ---------------------------------------------------------------------------
+# engine preemption mechanism (direct, no worker)
+# ---------------------------------------------------------------------------
+
+
+class TestEnginePreemption:
+    def _engine(self, **kw):
+        import jax
+
+        from ddlb_tpu.models.decode import make_decode_fn
+        from ddlb_tpu.models.serving import ContinuousBatchingEngine
+        from ddlb_tpu.models.transformer import TransformerConfig, init_params
+        from ddlb_tpu.runtime import Runtime
+
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_heads=4, d_ff=64,
+            layers_per_stage=1, microbatches=1, attn_kernel="einsum",
+            **kw.pop("cfg_kw", {}),
+        )
+        mesh = Runtime().mesh(("dp", "tp"), shape=(1, 2))
+        params = init_params(cfg, pp=1, n_experts=2, seed=0)
+        _, sh = make_decode_fn(mesh, cfg)
+        params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+        eng = ContinuousBatchingEngine(
+            mesh, cfg, params, max_batch=2, max_len=48, **kw
+        )
+        return eng, cfg, mesh, params
+
+    def test_preempt_resumes_same_greedy_chain(self):
+        from ddlb_tpu.models.serving import Request
+
+        eng, *_ = self._engine()
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, 64, 8).astype(np.int32)
+        eng.submit(Request(prompt, max_new=6))
+        eng.admit_ready()
+        eng.step()
+        eng.step()
+        baseline, *_ = self._engine()
+        baseline.submit(Request(prompt, max_new=6))
+        done_base = baseline.run()
+        new_idx = eng.preempt(0)
+        done = eng.run()
+        assert eng.stats.preemptions == 1
+        assert eng.stats.kv_evicted_tokens > 0
+        resumed = [c for c in done if c.request_index == new_idx]
+        assert len(resumed) == 1
+        np.testing.assert_array_equal(
+            resumed[0].tokens, done_base[0].tokens
+        )
+
+    def test_requeue_back_vs_front(self):
+        from ddlb_tpu.models.serving import Request
+
+        eng, *_ = self._engine()
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(1, 64, 6).astype(np.int32) for _ in range(4)]
+        for p in prompts:
+            eng.submit(Request(p, max_new=4))
+        eng.admit_ready()  # fills both slots; 2 queued
+        eng.step()
+        head_before = eng.queue_head()
+        back_idx = eng.preempt(0)  # default: back of the queue
+        assert eng.queue_head() == head_before
+        front_idx = eng.preempt(1, requeue="front")
+        assert eng.queue_head() == front_idx
+        assert back_idx != front_idx
+        with pytest.raises(ValueError, match="idle"):
+            eng.preempt(0)
+        with pytest.raises(ValueError, match="requeue"):
+            # both slots idle now, but the arg check comes first
+            eng.preempt(0, requeue="sideways")
+        done = eng.run()
+        # 2 untouched originals + 2 remnants complete (the preempted
+        # originals live on only through their remnants)
+        assert len(done) == 4
+        assert {c.request_index for c in done} == {2, 3, back_idx, front_idx}
+        assert eng.stats.preemptions == 2
+
+    def test_preempt_paged_releases_pages(self):
+        from ddlb_tpu.models.serving import Request
+
+        eng, *_ = self._engine(
+            cfg_kw={"cache_layout": "paged", "page_size": 8},
+            num_pages=12,
+        )
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, 64, 8).astype(np.int32)
+        eng.submit(Request(prompt, max_new=4))
+        eng.admit_ready()
+        eng.step()
+        in_use = eng.stats.pages_in_use
+        assert in_use > 0
+        eng.preempt(0)
+        assert eng.stats.pages_in_use < in_use
+        done = eng.run()
+        assert len(done) == 1
+        assert eng.stats.pages_in_use == 0
+
+
+@pytest.mark.slow
+class TestServingLoadSweepHeavy:
+    """The heavy shapes (satellite: marked slow, outside tier-1): a
+    full multi-rate sweep through the runner to an actual saturation
+    knee, paged + bursty + shared-prefix member included."""
+
+    def test_load_sweep_to_saturation_knee(self, tmp_path):
+        import serving_load_report as rep
+        from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+        common = {
+            "implementation": "engine", "batch": 8, "vocab": 128,
+            "n_heads": 8, "n_requests": 32, "out_mean": 4, "out_max": 8,
+            "slo_ttft_ms": 100.0, "slo_tpot_ms": 40.0,
+        }
+        impls = {
+            f"engine_{i}": {**common, "rate": rate}
+            for i, rate in enumerate((10.0, 40.0, 1200.0))
+        }
+        impls["engine_paged"] = {
+            **common, "rate": 40.0, "cache_layout": "paged",
+            "page_size": 16, "page_pool_frac": 0.5,
+            "prefix_pop": 4, "prefix_len": 16,
+        }
+        csv_path = tmp_path / "sweep.csv"
+        df = PrimitiveBenchmarkRunner(
+            "serving_load", m=16, n=64, k=128,
+            implementations=impls, dtype="float32",
+            num_iterations=2, num_warmups=1, validate=True,
+            barrier_at_each_iteration=False, progress=False,
+            output_csv=str(csv_path),
+        ).run()
+        assert (df["error"].astype(str) == "").all()
+        assert df["valid"].astype(bool).all()
+        paged = df[df["implementation"] == "engine_paged"].iloc[0]
+        assert int(paged["serve_prefix_hits"]) > 0
+        assert int(paged["serve_peak_pages"]) > 0
+        curves = rep.build_curves(
+            [r for r in rep.load_rows(str(csv_path))]
+        )
+        multi = [c for c in curves if len(c["points"]) >= 3]
+        assert multi, "rate sweep did not form a curve"
+        knee = rep.find_knee(multi[0]["points"], 2.5)
+        assert knee["detected"], knee
+
+
+# ---------------------------------------------------------------------------
+# make lint / schema coverage rides the analyzer suite; here we pin the
+# one schema property the lint can't see: every slo_* column the driver
+# emits is registered
+# ---------------------------------------------------------------------------
+
+
+def test_every_emitted_slo_column_is_registered():
+    from ddlb_tpu.schema import ROW_COLUMNS
+    from ddlb_tpu.workload import SLOTracker
+
+    tracker = SLOTracker(1.0, 1.0)
+    for col in tracker.row_fields(1.0, 1.0):
+        assert col in ROW_COLUMNS and ROW_COLUMNS[col], col
