@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci chaos-launch overlap-report serving-load-report clean
+	hooks ci chaos-launch overlap-report serving-load-report sim-report \
+	clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -52,6 +53,7 @@ ci:
 	$(PYTHON) scripts/analyze.py --sarif > analysis.sarif
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
 	$(PYTHON) scripts/serving_load_demo.py
+	$(PYTHON) scripts/sim_demo.py
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -69,6 +71,14 @@ overlap-report:
 # "Serving SLO observability")
 serving-load-report:
 	$(PYTHON) scripts/serving_load_demo.py
+
+# static-simulator acceptance: closed-form agreement for every family,
+# a banked cpu-sim sweep replayed through the tolerance-gated history
+# join (with a seeded faster-than-roofline row proving the gate fires),
+# and the 1024-chip flat vs hierarchical vs striped ranking — banked
+# transcript at docs/sim_demo.log (docs/source/simulator.rst)
+sim-report:
+	$(PYTHON) scripts/sim_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

@@ -431,6 +431,12 @@ class MemberReport:
         #: anchor for DDLB123 findings: the defining wire_bytes() line
         self.formula_rel = ""
         self.formula_line = 0
+        #: schedule-export metadata (the simulator front-end's inputs):
+        #: the statically evaluated ``flops()`` census, the member's
+        #: ``COST_SCHEDULE``, and the chunked-engine pipeline depth
+        self.flops_formula: Optional[float] = None
+        self.cost_schedule = "sequential"
+        self.chunk_count: Optional[int] = None
 
     def label(self) -> str:
         opts = ",".join(f"{k}={v}" for k, v in sorted(self.options.items()))
@@ -661,6 +667,13 @@ def trace_member(
 
     options = _static_options(klass, interp, overrides)
     schedule = klass.class_attr("COST_SCHEDULE", interp)
+    if isinstance(schedule, str):
+        report.cost_schedule = schedule
+    if options.get("algorithm") == "chunked":
+        # the chunked-fusion engine's contract (Primitive.overlap_chunks)
+        chunks = options.get("chunk_count")
+        if isinstance(chunks, int) and chunks >= 1:
+            report.chunk_count = chunks
     if schedule == "compute_only":
         report.status = "skipped"
         report.reason = "compute_only member (no wire by contract)"
@@ -708,6 +721,26 @@ def trace_member(
             value = None
         if isinstance(value, (int, float)):
             report.wire_formula = float(value)
+
+    # the FLOP census over the same static instance — the compute side
+    # of the simulator's schedule export (wire alone cannot place the
+    # GEMM stream the collective overlaps with)
+    flops_owner = klass.find_method("flops")
+    if flops_owner is not None:
+        owner, fdef = flops_owner
+        try:
+            value = interp.call_function(
+                FuncVal(
+                    "flops", fdef, owner.env, self_val=selfval,
+                    path=owner.rel, owner=owner,
+                ),
+                [],
+                {},
+            )
+        except Exception:
+            value = None
+        if isinstance(value, (int, float)):
+            report.flops_formula = float(value)
 
     setup = klass.find_method("_input_setup")
     if setup is None:
@@ -782,6 +815,55 @@ def trace_member(
             f"{formula:.0f} B"
         )
     return report
+
+
+def member_schedule(
+    family: str,
+    member: str,
+    overrides: Optional[Dict[str, Any]] = None,
+    registry: Optional[ClassRegistry] = None,
+    shapes: Optional[Dict[str, int]] = None,
+) -> Dict[str, Any]:
+    """The schedule-export API: one member's traced collective schedule
+    as a plain dict the static performance simulator replays
+    (``ddlb_tpu.simulator.frontends.program_from_schedule``).
+
+    Runs ``trace_member`` under the canonical (or supplied) shapes and
+    flattens the result: ordered per-entry collective dicts
+    (``ShardMapTrace.export_entries``), the statically evaluated
+    ``flops()``/``wire_bytes()`` censuses, the member's cost schedule
+    and chunk depth, and the axis sizes everything was resolved under.
+    Purely static — no JAX import, so 4096-chip replays stay bookable
+    from the analysis tier.
+    """
+    if registry is None:
+        from ddlb_tpu.analysis.core import repo_root
+
+        registry = ClassRegistry(repo_root())
+    shapes = shapes or FAMILY_SHAPES[family]
+    report = trace_member(
+        family, member, dict(overrides or {}), registry, shapes=shapes
+    )
+    axis_sizes = _axis_sizes_for(family, shapes["d"])
+    entries: List[Dict[str, Any]] = []
+    for t in report.traces:
+        entries.extend(t.export_entries(axis_sizes))
+    return {
+        "family": family,
+        "member": member,
+        "options": dict(report.options),
+        "status": report.status,
+        "reason": report.reason,
+        "shapes": dict(shapes),
+        "partitions": shapes["d"],
+        "axis_sizes": axis_sizes,
+        "entries": entries,
+        "flops": report.flops_formula,
+        "wire_traced": report.wire_traced,
+        "wire_formula": report.wire_formula,
+        "schedule": report.cost_schedule,
+        "chunks": report.chunk_count,
+    }
 
 
 def member_matrix(family: str) -> List[Tuple[str, List[Dict[str, Any]]]]:

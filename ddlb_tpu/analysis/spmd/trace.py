@@ -369,6 +369,41 @@ class ShardMapTrace:
             total += wire_contribution(e.op, nbytes, d)
         return total
 
+    def export_entries(
+        self, axis_sizes: Dict[str, int]
+    ) -> List[Dict[str, Any]]:
+        """The trace's wire-relevant collectives as plain dicts, in
+        traced order — the simulator's schedule-export surface
+        (``ddlb_tpu.simulator.frontends`` replays these step-by-step).
+
+        Each dict carries ``op``, the ``axes`` tuple, the resolved axis
+        product ``axis`` (None when a name is missing from
+        ``axis_sizes``), the LOCAL payload ``nbytes`` (None when the
+        payload would not size), and the source ``line``. Entries stay
+        un-collapsed: a chunked ring's ``c*(d-1)`` ppermutes export as
+        ``c*(d-1)`` dicts, which is exactly what step-by-step replay
+        needs."""
+        out: List[Dict[str, Any]] = []
+        for e in self.entries:
+            if e.op not in COLLECTIVE_OPS + P2P_OPS:
+                continue
+            d: Optional[int] = 1
+            for ax in e.axes:
+                if ax not in axis_sizes:
+                    d = None
+                    break
+                d *= axis_sizes[ax]
+            out.append(
+                {
+                    "op": e.op,
+                    "axes": tuple(e.axes),
+                    "axis": d,
+                    "nbytes": e.payload_bytes(),
+                    "line": e.line,
+                }
+            )
+        return out
+
     def describe(self) -> List[str]:
         head = (
             f"shard_map @ {self.rel}:{self.line} fn={self.fn_name or '?'} "

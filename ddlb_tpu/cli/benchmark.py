@@ -291,6 +291,14 @@ def main(argv=None) -> None:
         help="run on an N-device CPU simulation",
     )
     parser.add_argument(
+        "--topology", default=None, metavar="SPEC",
+        help="synthetic topology for the static performance simulator "
+        "(spec '<chip>:<pods>x<dims>' or a preset name, e.g. "
+        "'v5p:4x16x16'); exported as DDLB_TPU_TOPOLOGY so "
+        "scripts/sim_report.py and the perfmodel consumers of this run "
+        "see one world (envs.get_topology_override)",
+    )
+    parser.add_argument(
         "--worker-timeout", type=float, default=None, metavar="SECONDS",
         help="kill a hung worker after this many seconds and record an "
         "error row (requires --isolation subprocess)",
@@ -333,6 +341,19 @@ def main(argv=None) -> None:
         "runner clear caches once per signature, not per row)",
     )
     args = parser.parse_args(argv)
+
+    if args.topology:
+        # validate before exporting: a typo'd world must fail the launch,
+        # not silently skew every downstream simulator read
+        import os
+
+        from ddlb_tpu.perfmodel.topology import resolve_topology
+
+        try:
+            resolve_topology(args.topology)
+        except (KeyError, ValueError) as exc:
+            parser.error(f"bad --topology {args.topology!r}: {exc}")
+        os.environ["DDLB_TPU_TOPOLOGY"] = args.topology
 
     impl_specs = args.impl or ["jax_spmd"]
     implementations: Dict[str, List[Dict[str, Any]]] = {}

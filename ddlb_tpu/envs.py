@@ -222,6 +222,20 @@ def get_chip_override() -> str:
     return os.environ.get("DDLB_TPU_CHIP", "").strip()
 
 
+def get_topology_override() -> str:
+    """Simulator topology selection ("" = the consumer's default).
+
+    The one sanctioned read of ``DDLB_TPU_TOPOLOGY``: a spec string
+    (``<chip>:<pods>x<ici_dim>[x...]``, e.g. ``v5p:4x16x16``) or a
+    preset name resolved by ``perfmodel.topology.resolve_topology``.
+    ``scripts/sim_report.py`` and the demo read their default world from
+    here; the benchmark CLI's ``--topology`` flag exports it so one
+    launcher invocation pins the world for every downstream consumer.
+    Follows the DDLB_TPU_* convention: empty/unset defers.
+    """
+    return os.environ.get("DDLB_TPU_TOPOLOGY", "").strip()
+
+
 def get_autotune_cache_path() -> str:
     """Autotune-cache JSON path override ("" = the repo-root default).
 

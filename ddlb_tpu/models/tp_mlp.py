@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ddlb_tpu.runtime import shard_map_compat
+
 
 def init_params(
     d_model: int, d_ff: int, dtype=jnp.bfloat16, seed: int = 0
@@ -80,7 +82,9 @@ def mlp_block(mesh, axis_name: str = "tp"):
         )
         return y.astype(x_local.dtype)
 
-    return jax.shard_map(
+    # shard_map_compat: jax.shard_map where it exists, the pre-0.5
+    # experimental entry point otherwise (jax 0.4.x fleet)
+    return shard_map_compat(
         block,
         mesh=mesh,
         in_specs=(P(axis_name, None), P(None, axis_name), P(axis_name, None)),

@@ -14,7 +14,12 @@ JAX-free process tiers like ``bench.py``'s parent and ``scripts/lint.py``):
 - ``cost`` — closed-form per-primitive-family cost models (GEMM time
   from ``flops()``/peak, collective time from ``wire_bytes()`` over the
   bandwidth-optimal ring formula, decode time from the HBM byte census)
-  combined per implementation schedule into a predicted lower bound.
+  combined per implementation schedule into a predicted lower bound,
+  plus the ring-step decomposition and HiCCL-style hierarchical
+  composition formulas the static simulator replays;
+- ``topology`` — synthetic multi-pod worlds (``pods`` x ``ici_mesh``
+  compositions of one ChipSpec) for the static performance simulator
+  (``ddlb_tpu.simulator``), selectable via ``DDLB_TPU_TOPOLOGY``.
 
 Every benchmark row gains ``predicted_s`` / ``roofline_frac`` / ``bound``
 columns from this model (``benchmark.make_result_row``), ranked per
@@ -34,13 +39,23 @@ from ddlb_tpu.perfmodel.specs import (
     detect_spec,
     get_spec,
 )
+from ddlb_tpu.perfmodel.topology import (
+    Topology,
+    flat_topology,
+    parse_topology,
+    resolve_topology,
+)
 
 __all__ = [
     "CHIP_SPECS",
     "ChipSpec",
     "CostEstimate",
     "FAMILY_COST_MODELS",
+    "Topology",
     "detect_spec",
     "estimate",
+    "flat_topology",
     "get_spec",
+    "parse_topology",
+    "resolve_topology",
 ]

@@ -81,6 +81,136 @@ def wire_itemsize(dtype: str) -> int:
         ) from None
 
 
+# ---------------------------------------------------------------------------
+# ring decomposition + hierarchical composition formulas
+# ---------------------------------------------------------------------------
+
+#: canonical collective-op vocabulary (trace.py mirrors it); aliases map
+#: the family/option spellings onto it
+_OP_ALIASES = {
+    "all_reduce": "psum",
+    "pmean": "psum",
+    "reduce_scatter": "psum_scatter",
+}
+
+
+def canonical_op(op: str) -> str:
+    """``all_reduce``/``reduce_scatter`` spellings -> trace vocabulary."""
+    return _OP_ALIASES.get(op, op)
+
+
+def ring_step_count(op: str, d: int) -> int:
+    """Synchronous ring steps the bandwidth-optimal algorithm runs over
+    a ``d``-member axis: ``d-1`` hops (AG/RS/A2A), ``2(d-1)`` for the
+    RS+AG all-reduce, one for a ppermute. The step granularity the
+    simulator replays a closed-form collective at."""
+    if d <= 1:
+        return 0
+    op = canonical_op(op)
+    if op == "psum":
+        return 2 * (d - 1)
+    if op == "ppermute":
+        return 1
+    if op in ("all_gather", "psum_scatter", "all_to_all"):
+        return d - 1
+    raise ValueError(f"Unknown collective op {op!r}")
+
+
+def ring_wire_bytes(op: str, nbytes: float, d: int) -> float:
+    """Per-device wire bytes of the flat ring algorithm, given the
+    device's LOCAL payload ``nbytes`` and axis size ``d`` — the same
+    closed forms the family bases state (AG ``S*(d-1)``, RS
+    ``(S/d)*(d-1)``, AR ``2*(S/d)*(d-1)``, A2A ``(S/d)*(d-1)``,
+    ppermute ``S``); mirrored by ``analysis.spmd.trace
+    .wire_contribution``."""
+    if d <= 1:
+        return 0.0
+    op = canonical_op(op)
+    if op == "all_gather":
+        return nbytes * (d - 1)
+    if op == "psum_scatter":
+        return nbytes * (d - 1) / d
+    if op == "psum":
+        return 2.0 * nbytes * (d - 1) / d
+    if op == "all_to_all":
+        return nbytes * (d - 1) / d
+    if op == "ppermute":
+        return float(nbytes)
+    raise ValueError(f"Unknown collective op {op!r}")
+
+
+def hierarchical_phases(
+    op: str, nbytes: float, intra: int, inter: int
+) -> Tuple[Dict[str, object], ...]:
+    """The HiCCL-style two-level decomposition of one collective over
+    ``intra`` chips per slice and ``inter`` slices, as an ordered tuple
+    of phases ``{tag, op, scope, axis, nbytes}`` (``scope``:
+    ``"intra"`` rides ICI, ``"inter"`` rides DCN; ``nbytes`` is the
+    phase's LOCAL payload, so ``ring_wire_bytes(op, nbytes, axis)``
+    prices it):
+
+    - ``all_reduce``: RS-intra -> AR-inter (on the 1/intra shard) ->
+      AG-intra — the composition the collectives family's
+      ``hierarchical`` member runs (HiCCL, arxiv 2408.05962);
+    - ``all_gather``: AG-inter (local shard) -> AG-intra (the
+      inter-gathered block);
+    - ``reduce_scatter``: RS-intra -> RS-inter (on the 1/intra shard);
+    - ``all_to_all``: inter exchange of the cross-slice fraction, then
+      the intra redistribution.
+
+    Degenerate axes (size 1) drop their phases, so a 1-pod world prices
+    exactly the flat intra formula.
+    """
+    op = canonical_op(op)
+    phases = []
+
+    def phase(tag, phase_op, scope, axis, payload):
+        if axis > 1:
+            phases.append(
+                {
+                    "tag": tag,
+                    "op": phase_op,
+                    "scope": scope,
+                    "axis": int(axis),
+                    "nbytes": float(payload),
+                }
+            )
+
+    if op == "psum":
+        phase("rs-intra", "psum_scatter", "intra", intra, nbytes)
+        phase("ar-inter", "psum", "inter", inter, nbytes / intra)
+        phase("ag-intra", "all_gather", "intra", intra, nbytes / intra)
+    elif op == "all_gather":
+        phase("ag-inter", "all_gather", "inter", inter, nbytes)
+        phase("ag-intra", "all_gather", "intra", intra, nbytes * inter)
+    elif op == "psum_scatter":
+        phase("rs-intra", "psum_scatter", "intra", intra, nbytes)
+        phase("rs-inter", "psum_scatter", "inter", inter, nbytes / intra)
+    elif op == "all_to_all":
+        phase("a2a-inter", "all_to_all", "inter", inter, nbytes)
+        phase("a2a-intra", "all_to_all", "intra", intra, nbytes)
+    else:
+        raise ValueError(
+            f"No hierarchical composition for collective op {op!r}"
+        )
+    return tuple(phases)
+
+
+def hierarchical_wire_bytes(
+    op: str, nbytes: float, intra: int, inter: int
+) -> Dict[str, float]:
+    """Per-device wire bytes of the hierarchical composition, split by
+    link class (``{"ici": ..., "dcn": ...}``) — the formula that lets
+    ``perf_report``/``sim_report`` rank flat vs hierarchical per
+    topology: the DCN share carries ``1/intra`` of the payload (AR),
+    which is the whole multi-pod case for the composition."""
+    out = {"ici": 0.0, "dcn": 0.0}
+    for ph in hierarchical_phases(op, nbytes, intra, inter):
+        cls = "ici" if ph["scope"] == "intra" else "dcn"
+        out[cls] += ring_wire_bytes(ph["op"], ph["nbytes"], ph["axis"])
+    return out
+
+
 @dataclass(frozen=True)
 class CostEstimate:
     """The model's verdict for one configured implementation."""

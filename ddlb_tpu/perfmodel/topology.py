@@ -1,0 +1,216 @@
+"""Synthetic multi-pod topology layer: ChipSpecs composed into worlds.
+
+The spec registry (``specs.py``) knows one chip's link rates; this
+module composes chips into the worlds the static performance simulator
+(``ddlb_tpu.simulator``) replays schedules on — ``pods`` slices of an
+``ici_mesh`` each, joined by per-chip DCN shares — at 256–4096-chip
+scales no test environment can rent. Stdlib-only at import, like the
+rest of the perfmodel: the simulator's ranking tier must run with no
+accelerator and no JAX.
+
+The model is deliberately the one the framework's collectives already
+assume (see ``specs.py`` conventions):
+
+- inside a slice, a 1-D ring neighbor hop moves at ``ChipSpec.link_bw
+  ("ici")`` per direction; an N-D ``ici_mesh`` has one independent ring
+  family per mesh dimension (the torus axes), which is what multi-path
+  striping exploits;
+- across slices, each chip owns a ``link_bw("dcn")`` share of the host
+  NIC;
+- a *flat* ring laid out over a multi-pod world advances in synchronous
+  steps gated by the slowest link in the ring (the DCN hop), the
+  reason hierarchical compositions exist.
+
+Resource names (``mxu``, ``hbm``, ``ici0..iciN-1``, ``dcn``, ``flat``)
+are the contract between ``Topology`` and the simulator's event engine:
+every schedule step declares the one resource it occupies, and
+``Topology.resource_rate`` prices its duration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from ddlb_tpu.perfmodel.specs import ChipSpec, get_spec
+
+#: the env override (read via ``envs.get_topology_override`` — the one
+#: accessor surface) and the CLI ``--topology`` flag share this format
+TOPOLOGY_ENV = "DDLB_TPU_TOPOLOGY"
+
+#: spec format: ``<chip>:<pods>x<dim0>[x<dim1>...]`` — first factor is
+#: the DCN (pod) axis, the rest the per-slice ICI mesh
+SPEC_FORMAT = "<chip>:<pods>x<ici_dim>[x<ici_dim>...]"
+
+
+@dataclass(frozen=True)
+class Topology:
+    """A synthetic multi-pod world: ``pods`` slices of one ``ici_mesh``.
+
+    ``chip`` supplies every rate (``perfmodel.specs.ChipSpec.link_bw``
+    for ICI/DCN, ``peak_flops``/``hbm_bw`` for the compute and memory
+    resources); the composition supplies the counts. A 1-pod world is
+    the *degenerate flat* topology the simulator's closed-form
+    validation runs on — every hop is ICI, exactly the geometry the
+    ``perfmodel.cost`` ring formulas price.
+    """
+
+    chip: ChipSpec
+    pods: int = 1
+    ici_mesh: Tuple[int, ...] = (8,)
+    name: str = ""
+
+    def __post_init__(self) -> None:
+        if self.pods < 1:
+            raise ValueError(f"pods must be >= 1, got {self.pods}")
+        if not self.ici_mesh or any(d < 1 for d in self.ici_mesh):
+            raise ValueError(
+                f"ici_mesh needs positive dims, got {self.ici_mesh!r}"
+            )
+        if not self.name:
+            object.__setattr__(self, "name", self.spec_string())
+
+    # -- composition ---------------------------------------------------------
+
+    @property
+    def chips_per_pod(self) -> int:
+        total = 1
+        for dim in self.ici_mesh:
+            total *= dim
+        return total
+
+    @property
+    def num_chips(self) -> int:
+        return self.pods * self.chips_per_pod
+
+    def spec_string(self) -> str:
+        dims = "x".join(str(d) for d in self.ici_mesh)
+        return f"{self.chip.name}:{self.pods}x{dims}"
+
+    # -- link rates (bytes/s per chip, per direction) ------------------------
+
+    @property
+    def ici_bw(self) -> float:
+        return self.chip.link_bw("ici")
+
+    @property
+    def dcn_bw(self) -> float:
+        return self.chip.link_bw("dcn")
+
+    @property
+    def flat_bw(self) -> float:
+        """The rate one synchronous flat-ring step advances at: the
+        slowest link class the world-spanning ring must cross (ICI on a
+        single pod, the DCN share otherwise)."""
+        if self.pods > 1:
+            return min(self.ici_bw, self.dcn_bw)
+        return self.ici_bw
+
+    def resource_rate(self, resource: str, dtype: str = "bfloat16") -> float:
+        """Price of one schedule resource, in units/second: FLOP/s for
+        ``mxu`` (at the chip's ``dtype`` peak), bytes/s otherwise.
+        Unknown resources raise — a schedule step billed against a
+        resource the topology cannot price would otherwise simulate at
+        infinite speed."""
+        if resource == "mxu":
+            return self.chip.peak_flops(dtype)
+        if resource == "hbm":
+            return self.chip.hbm_bw
+        if resource == "dcn":
+            return self.dcn_bw
+        if resource == "flat":
+            return self.flat_bw
+        if resource.startswith("ici"):
+            idx = resource[3:] or "0"
+            if idx.isdigit() and int(idx) < len(self.ici_mesh):
+                return self.ici_bw
+        raise ValueError(
+            f"Topology {self.name} cannot price resource {resource!r} "
+            f"(ici_mesh has {len(self.ici_mesh)} dims)"
+        )
+
+    def comm_resources(self) -> Tuple[str, ...]:
+        """Every link-class resource this world exposes, the per-link
+        utilization breakdown's row set."""
+        out = [f"ici{i}" for i in range(len(self.ici_mesh))]
+        if self.pods > 1:
+            out += ["dcn", "flat"]
+        return tuple(out)
+
+    # -- flat-ring accounting -------------------------------------------------
+
+    def flat_hop_fractions(self) -> Dict[str, float]:
+        """How a world-spanning flat ring's hops split across link
+        classes: a ring visiting all ``n`` chips crosses the pod
+        boundary ``pods`` times (once per slice exit), every other hop
+        is an intra-slice ICI neighbor hop. Used to attribute a
+        ``flat``-scoped step's bytes to physical link classes in the
+        utilization breakdown."""
+        n = self.num_chips
+        if self.pods <= 1 or n <= 1:
+            return {"ici0": 1.0}
+        dcn_hops = self.pods
+        return {"ici0": (n - dcn_hops) / n, "dcn": dcn_hops / n}
+
+    def describe(self) -> str:
+        dims = "x".join(str(d) for d in self.ici_mesh)
+        return (
+            f"{self.name}: {self.num_chips} x {self.chip.name} chips "
+            f"({self.pods} pod(s) of {dims}), "
+            f"ici {self.ici_bw / 1e9:.0f} GB/s/dir, "
+            f"dcn {self.dcn_bw / 1e9:.2f} GB/s/chip"
+        )
+
+
+def parse_topology(spec: str) -> Topology:
+    """``'v5p:4x8x8'`` -> 4 pods of an 8x8 ICI mesh of v5p chips.
+
+    Format: ``chip:podsxdim0[xdim1...]`` (chip names/aliases resolve
+    through the spec registry). A bare ``chip:N`` is the degenerate flat
+    world — one pod, a 1-D ring of N chips. Malformed specs raise with
+    the expected format in the message (the CLI/env surface)."""
+    text = str(spec).strip()
+    chip_name, sep, rest = text.partition(":")
+    if not sep or not chip_name.strip() or not rest.strip():
+        raise ValueError(
+            f"Bad topology spec {spec!r}: expected {SPEC_FORMAT}"
+        )
+    chip = get_spec(chip_name)  # unknown chips raise KeyError here
+    try:
+        factors = [int(p) for p in rest.strip().lower().split("x")]
+    except ValueError:
+        raise ValueError(
+            f"Bad topology spec {spec!r}: dims must be integers "
+            f"({SPEC_FORMAT})"
+        ) from None
+    if any(f < 1 for f in factors):
+        raise ValueError(
+            f"Bad topology spec {spec!r}: dims must be positive"
+        )
+    if len(factors) == 1:
+        return Topology(chip=chip, pods=1, ici_mesh=(factors[0],))
+    return Topology(chip=chip, pods=factors[0], ici_mesh=tuple(factors[1:]))
+
+
+def flat_topology(num_chips: int, chip: str = "cpu-sim") -> Topology:
+    """The degenerate validation world: one pod, a 1-D ICI ring — the
+    geometry under which the simulator must agree with the
+    ``perfmodel.cost`` closed forms to float precision."""
+    return Topology(chip=get_spec(chip), pods=1, ici_mesh=(int(num_chips),))
+
+
+#: named presets for the report/demo surfaces (the 256–4096-chip worlds
+#: the ROADMAP's simulator item calls for); ``parse_topology`` accepts
+#: these names as well as raw specs
+PRESETS: Dict[str, str] = {
+    "pod256": "v5p:1x16x16",
+    "2pod512": "v5p:2x16x16",
+    "4pod1024": "v5p:4x16x16",
+    "8pod2048": "v5e:8x16x16",
+    "16pod4096": "v6e:16x16x16",
+}
+
+
+def resolve_topology(spec: str) -> Topology:
+    """Preset name or raw spec string -> ``Topology``."""
+    return parse_topology(PRESETS.get(str(spec).strip(), spec))

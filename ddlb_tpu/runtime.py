@@ -484,8 +484,14 @@ def as_auto_mesh(mesh):
     """Rebuild a mesh with all axes in ``Auto`` mode for GSPMD implicit
     propagation (JAX 0.9 defaults to Explicit sharding-in-types, which
     rejects mid-function ``with_sharding_constraint``); operands and jit
-    shardings must then use this mesh consistently."""
-    from jax.sharding import AxisType, Mesh
+    shardings must then use this mesh consistently. Pre-0.5 JAX has no
+    axis types at all — every mesh already propagates implicitly — so
+    the mesh passes through untouched there (the version bridge the
+    model-layer shard_map_compat migration rides)."""
+    try:
+        from jax.sharding import AxisType, Mesh
+    except ImportError:
+        return mesh
 
     return Mesh(
         mesh.devices,

@@ -1,0 +1,42 @@
+"""Static multi-pod performance simulator (discrete-event replay).
+
+Replays a *schedule program* — an ordered, dependency-structured list of
+compute / wire / HBM steps — on a synthetic ``perfmodel.topology
+.Topology``, producing a predicted timeline, overlap fraction, and
+per-link utilization breakdown without booting a single chip. Three
+front-ends build programs (``frontends``):
+
+- the semantic SPMD interpreter's per-member ordered collective trace
+  (``analysis/spmd``), so chunked double-buffered rings and pipeline
+  schedule tables replay step-by-step;
+- the perfmodel closed forms over a duck-typed impl (the validation
+  front-end — on a degenerate flat topology the replay must agree with
+  ``perfmodel.cost`` to float precision);
+- synthetic compositions written directly against the schedule IR
+  (flat ring, HiCCL-style hierarchical phases, multi-path striped), so
+  hierarchical collectives are ranked *before* they exist as impl
+  members.
+
+``scripts/sim_report.py`` is the ranking/validation CLI;
+``scripts/sim_demo.py`` (= ``make sim-report``) is the banked
+acceptance transcript.
+"""
+
+from ddlb_tpu.simulator.engine import ReplayResult, replay
+from ddlb_tpu.simulator.program import (
+    ComputeStep,
+    HbmStep,
+    ScheduleProgram,
+    Stage,
+    WireStep,
+)
+
+__all__ = [
+    "ComputeStep",
+    "HbmStep",
+    "ReplayResult",
+    "ScheduleProgram",
+    "Stage",
+    "WireStep",
+    "replay",
+]

@@ -1,0 +1,454 @@
+"""Program builders: traced members, closed-form impls, synthetic algos.
+
+Three ways a ``ScheduleProgram`` comes to exist, each with a different
+fidelity/availability trade:
+
+1. **Traced** (``program_from_schedule``): the semantic SPMD
+   interpreter's ordered collective trace for a real registered member
+   (``analysis.spmd.families.member_schedule``) replays step-by-step —
+   a chunked double-buffered ring arrives as its literal ``c*(d-1)``
+   ppermutes and a pipeline schedule table as its per-tick hop
+   sequence, so the engine's arbitration (not a closed form) decides
+   what overlaps.
+2. **Closed-form** (``program_from_impl``): a duck-typed impl's
+   ``perfmodel.cost`` terms lowered into ring-granularity steps — the
+   validation front-end: on a degenerate flat topology the replayed
+   makespan must equal ``cost.estimate().predicted_s`` to float
+   precision, because the engine's arbitration of the sequential /
+   ideal-overlap / chunked shapes IS the cost model's combination rule.
+3. **Synthetic** (``flat_ring_program`` / ``hierarchical_program`` /
+   ``striped_program``): algorithms written directly against the IR —
+   flat world-spanning ring, HiCCL-style RS-intra → AR-inter →
+   AG-intra phases, and multi-path striping across the ICI mesh
+   dimensions — so compositions are ranked per topology *before*
+   anyone builds them as impl members.
+
+Placement conventions the lowering uses (stated once here): the
+per-chunk GEMM leads the wire for the reduce-side families
+(``compute_first``), trails it for the gather-side ones
+(``comm_first``), and splits around the dispatch/combine pair for
+ep_alltoall (``sandwich`` — traced path only; the closed-form path
+groups the pair so the replay lands exactly on the cost model's
+two-phase fill/drain law).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ddlb_tpu.perfmodel.cost import (
+    FAMILY_COST_MODELS,
+    canonical_op,
+    hierarchical_phases,
+    overlap_chunks,
+    ring_step_count,
+    ring_wire_bytes,
+)
+from ddlb_tpu.perfmodel.topology import Topology
+from ddlb_tpu.simulator.program import (
+    ComputeStep,
+    HbmStep,
+    ScheduleProgram,
+    Stage,
+    WireStep,
+    pipelined,
+    sequential,
+)
+
+#: the collective shape each family's wire rides, for closed-form
+#: lowering and for the per-family synthetic ranking
+FAMILY_COLLECTIVES: Dict[str, str] = {
+    "tp_columnwise": "all_gather",
+    "tp_rowwise": "reduce_scatter",
+    "dp_allreduce": "all_reduce",
+    "ep_alltoall": "all_to_all",
+    "cp_ring_attention": "ppermute",
+    "pp_pipeline": "ppermute",
+    "collectives": "all_reduce",
+}
+
+#: where the per-chunk GEMM sits relative to its wire (module docstring)
+FAMILY_PLACEMENT: Dict[str, str] = {
+    "tp_columnwise": "comm_first",
+    "tp_rowwise": "compute_first",
+    "dp_allreduce": "compute_first",
+    "ep_alltoall": "sandwich",
+}
+
+
+class ProgramBuildError(ValueError):
+    """A front-end could not lower its input into the schedule IR
+    (unsizeable traced payload, unknown family, empty schedule)."""
+
+
+# ---------------------------------------------------------------------------
+# synthetic compositions (written directly against the IR)
+# ---------------------------------------------------------------------------
+
+
+def _ring_steps(
+    op: str, nbytes: float, d: int, scope: str, tag: str
+) -> List[WireStep]:
+    """One collective lowered to its synchronous ring steps: the
+    bandwidth-optimal step count with the closed-form total spread
+    evenly (totals are exact; the granularity is what replay needs)."""
+    total = ring_wire_bytes(op, nbytes, d)
+    count = ring_step_count(op, d)
+    if count <= 0 or total <= 0.0:
+        return []
+    return [
+        WireStep(total / count, scope=scope, op=canonical_op(op), tag=tag)
+        for _ in range(count)
+    ]
+
+
+def flat_ring_program(
+    op: str, nbytes: float, topology: Topology, name: str = ""
+) -> ScheduleProgram:
+    """The baseline: one ring over all chips. On a multi-pod world every
+    synchronous step is gated by the slowest link in the ring (the
+    ``flat`` channel), which is precisely why this loses to the
+    hierarchical composition on DCN-bound topologies."""
+    n = topology.num_chips
+    scope = "flat" if topology.pods > 1 else "ici0"
+    steps = _ring_steps(op, nbytes, n, scope, "flat-ring")
+    return sequential(
+        name or f"flat/{canonical_op(op)}",
+        steps,
+        algo="flat",
+        op=canonical_op(op),
+        payload_bytes=nbytes,
+    )
+
+
+def hierarchical_program(
+    op: str, nbytes: float, topology: Topology, name: str = ""
+) -> ScheduleProgram:
+    """HiCCL-style two-level composition (``perfmodel.cost
+    .hierarchical_phases``): intra phases ride the first ICI ring
+    family, the inter phase rides each chip's DCN share. Phases chain —
+    they are data-dependent by construction."""
+    steps: List[WireStep] = []
+    for ph in hierarchical_phases(
+        op, nbytes, topology.chips_per_pod, topology.pods
+    ):
+        scope = "ici0" if ph["scope"] == "intra" else "dcn"
+        steps.extend(
+            _ring_steps(ph["op"], ph["nbytes"], ph["axis"], scope, ph["tag"])
+        )
+    return sequential(
+        name or f"hier/{canonical_op(op)}",
+        steps,
+        algo="hierarchical",
+        op=canonical_op(op),
+        payload_bytes=nbytes,
+    )
+
+
+def striped_program(
+    op: str, nbytes: float, topology: Topology, name: str = ""
+) -> ScheduleProgram:
+    """FlexLink-style multi-path striping: the payload splits across one
+    stripe per ICI mesh dimension (each torus axis is an independent
+    ring family), every stripe running the hierarchical composition on
+    its own ICI channel; the stripes contend for the shared DCN share,
+    which the engine arbitrates. One ICI dimension degenerates to
+    ``hierarchical_program`` exactly."""
+    stripes = max(1, len(topology.ici_mesh))
+    stages: List[Stage] = []
+    for s in range(stripes):
+        steps: List[WireStep] = []
+        for ph in hierarchical_phases(
+            op, nbytes / stripes, topology.chips_per_pod, topology.pods
+        ):
+            scope = f"ici{s}" if ph["scope"] == "intra" else "dcn"
+            steps.extend(
+                _ring_steps(
+                    ph["op"], ph["nbytes"], ph["axis"], scope,
+                    f"{ph['tag']}#s{s}",
+                )
+            )
+        stages.append(Stage(steps, label=f"stripe{s}"))
+    prog = pipelined(
+        name or f"striped/{canonical_op(op)}",
+        stages,
+        algo="striped",
+        op=canonical_op(op),
+        payload_bytes=nbytes,
+        stripes=stripes,
+    )
+    return prog
+
+
+SYNTHETIC_ALGOS = ("flat", "hierarchical", "striped")
+
+
+def synthetic_program(
+    algo: str, op: str, nbytes: float, topology: Topology
+) -> ScheduleProgram:
+    """Dispatch one of the ranked compositions by name."""
+    if algo == "flat":
+        return flat_ring_program(op, nbytes, topology)
+    if algo == "hierarchical":
+        return hierarchical_program(op, nbytes, topology)
+    if algo == "striped":
+        return striped_program(op, nbytes, topology)
+    raise ProgramBuildError(
+        f"Unknown synthetic algorithm {algo!r}; known: {SYNTHETIC_ALGOS}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# closed-form front-end (the validation path)
+# ---------------------------------------------------------------------------
+
+
+def _impl_cost_dtype(impl) -> str:
+    hook = getattr(impl, "cost_dtype", None)
+    if callable(hook):
+        try:
+            return hook()
+        except Exception:
+            return impl.dtype
+    return impl.dtype
+
+
+def program_from_impl(
+    impl, topology: Topology, transport: Optional[str] = None
+) -> ScheduleProgram:
+    """Lower one duck-typed implementation's cost terms into a program.
+
+    The censuses (``flops()`` / ``wire_bytes()`` / ``hbm_bytes()``) come
+    through the family's registered cost model — one source of truth
+    with the perfmodel — and the SCHEDULE comes from the IR: sequential
+    members chain, ideal-overlap members race, chunked members pipeline
+    ``overlap_chunks()`` two-phase stages. On a degenerate flat
+    topology the replayed makespan therefore equals
+    ``cost.estimate(impl).predicted_s`` to float precision — the
+    validation contract ``simulator.validate.closed_form_check``
+    asserts per family.
+    """
+    family = getattr(impl, "primitive_name", None)
+    if family not in FAMILY_COST_MODELS:
+        raise ProgramBuildError(
+            f"No cost model for primitive family {family!r}"
+        )
+    spec = topology.chip
+    compute_s, comm_s, hbm_s = FAMILY_COST_MODELS[family](impl, spec)
+    schedule = getattr(impl, "COST_SCHEDULE", "sequential")
+    if schedule == "compute_only":
+        comm_s = 0.0
+    if transport is None:
+        transport = impl.options.get("transport", "ici")
+    scope = "dcn" if transport == "dcn" else "ici0"
+    dtype = _impl_cost_dtype(impl)
+    # invert the terms back into engine quantities priced by the SAME
+    # spec, so rates cancel exactly
+    flops = compute_s * spec.peak_flops(dtype)
+    wire = comm_s * spec.link_bw(transport)
+    hbm = hbm_s * spec.hbm_bw
+    d = max(1, int(impl.num_partitions))
+    op = FAMILY_COLLECTIVES.get(family, "ppermute")
+    if family == "collectives":
+        op = impl.options.get("op", "all_reduce")
+    count = max(1, ring_step_count(op, d)) if wire > 0.0 else 0
+
+    label = f"{family}/{getattr(impl, 'implementation_name', type(impl).__name__)}"
+    meta = {
+        "family": family,
+        "schedule": schedule,
+        "transport": transport,
+        "frontend": "closed-form",
+    }
+
+    def wire_steps(total: float, tag: str) -> List[WireStep]:
+        if total <= 0.0 or count == 0:
+            return []
+        return [
+            WireStep(total / count, scope=scope, op=canonical_op(op), tag=tag)
+            for _ in range(count)
+        ]
+
+    compute = (
+        [ComputeStep(flops, dtype=dtype, tag="gemm")] if flops > 0.0 else []
+    )
+    hbm_steps = [HbmStep(hbm, tag="hbm")] if hbm > 0.0 else []
+
+    chunks = overlap_chunks(impl) if schedule == "overlap" else None
+    if schedule == "overlap" and chunks is None:
+        # ideal overlap: independent tracks, the engine takes the max
+        stages = [Stage(wire_steps(wire, "ring"), label="comm")]
+        if compute:
+            stages.insert(0, Stage(compute, label="compute"))
+        if hbm_steps:
+            stages.append(Stage(hbm_steps, label="hbm"))
+        return pipelined(label, [s for s in stages if s.steps], **meta)
+    if schedule == "overlap" and chunks is not None:
+        # the chunked-fusion engine's two-phase pipeline: per chunk,
+        # 1/chunks of each census, GEMM placed per the family table
+        # (the sandwich family is grouped here so the fill/drain lands
+        # exactly on the cost model's law — module docstring)
+        placement = FAMILY_PLACEMENT.get(family, "comm_first")
+        stages = []
+        for j in range(chunks):
+            csteps = (
+                [ComputeStep(flops / chunks, dtype=dtype, tag=f"gemm#{j}")]
+                if flops > 0.0
+                else []
+            )
+            wsteps = wire_steps(wire / chunks, f"ring#{j}")
+            if placement == "compute_first":
+                stages.append(Stage(csteps + wsteps, label=f"chunk{j}"))
+            else:
+                stages.append(Stage(wsteps + csteps, label=f"chunk{j}"))
+        if hbm_steps:
+            stages.append(Stage(hbm_steps, label="hbm"))
+        return pipelined(label, stages, chunks=chunks, **meta)
+    # sequential (and compute_only, whose comm is zeroed): one chain,
+    # HBM racing it on its own track (Stage.hbm_parallel)
+    placement = FAMILY_PLACEMENT.get(family, "comm_first")
+    wsteps = wire_steps(wire, "ring")
+    if placement == "compute_first":
+        chain: List[Any] = compute + wsteps
+    else:
+        chain = wsteps + compute
+    return sequential(label, chain + hbm_steps, **meta)
+
+
+# ---------------------------------------------------------------------------
+# traced front-end (the semantic SPMD interpreter's schedule export)
+# ---------------------------------------------------------------------------
+
+
+def _entry_steps(
+    entry: Dict[str, Any], scope_default: str, tag: str
+) -> List[WireStep]:
+    """One exported trace entry -> its ring steps. ppermute entries ARE
+    single hops already (the chunked rings' literal schedule); closed-
+    form collectives (a jax_spmd member's one psum) decompose into
+    their ring step count."""
+    op = entry["op"]
+    d = entry["axis"]
+    nbytes = entry["nbytes"]
+    if d is None or nbytes is None:
+        raise ProgramBuildError(
+            f"trace entry {op} at line {entry.get('line')} did not "
+            f"resolve (axis={d}, nbytes={nbytes})"
+        )
+    scope = "dcn" if "dcn" in entry["axes"] else scope_default
+    if op in ("ppermute", "remote_copy"):
+        return [
+            WireStep(float(nbytes), scope=scope, op="ppermute", tag=tag)
+        ]
+    return _ring_steps(op, float(nbytes), int(d), scope, tag)
+
+
+def program_from_schedule(
+    export: Dict[str, Any],
+    topology: Topology,
+    transport: Optional[str] = None,
+) -> ScheduleProgram:
+    """Replay input from ``analysis.spmd.families.member_schedule``.
+
+    The exported entries replay in traced order. Chunked members
+    (``export['chunks']``) partition their entries into ``chunks``
+    equal groups — the trace of the double-buffered engine is exactly
+    ``chunks`` repetitions of one chunk's ring — and each group becomes
+    one pipeline stage with its share of the GEMM placed per the family
+    table (including the true ``sandwich`` split for ep_alltoall, which
+    is the fidelity the closed-form front-end deliberately gives up).
+    """
+    entries: Sequence[Dict[str, Any]] = export["entries"]
+    family = export["family"]
+    if transport is None:
+        transport = export.get("options", {}).get("transport", "ici")
+    scope_default = "dcn" if transport == "dcn" else "ici0"
+    d = max(1, int(export["partitions"]))
+    flops_total = export.get("flops") or 0.0
+    flops = flops_total / d
+    dtype = export.get("options", {}).get("dtype", "bfloat16")
+    label = f"{family}/{export['member']}"
+    meta = {
+        "family": family,
+        "member": export["member"],
+        "schedule": export.get("schedule", "sequential"),
+        "frontend": "traced",
+    }
+
+    chunks = export.get("chunks")
+    if chunks and chunks > 1 and entries:
+        # the double-buffered engine's trace is `chunks` repetitions of
+        # one chunk's ring, so the split is normally exact; a member
+        # with ride-along collectives (an odd trailing psum) still
+        # pipelines — near-even contiguous groups — but says so, since
+        # the grouping is then a guess rather than the traced structure
+        if len(entries) % chunks:
+            from ddlb_tpu import telemetry
+
+            telemetry.warn(
+                f"{label}: {len(entries)} traced collectives do not "
+                f"split evenly into chunk_count={chunks} pipeline "
+                f"stages; grouping near-evenly (meta.chunk_fallback)"
+            )
+            meta["chunk_fallback"] = True
+        base, extra = divmod(len(entries), chunks)
+        placement = FAMILY_PLACEMENT.get(family, "comm_first")
+        stages: List[Stage] = []
+        cursor = 0
+        for j in range(chunks):
+            size = base + (1 if j < extra else 0)
+            group = entries[cursor:cursor + size]
+            cursor += size
+            wsteps: List[WireStep] = []
+            for e in group:
+                wsteps.extend(_entry_steps(e, scope_default, f"chunk{j}"))
+            csteps = (
+                [ComputeStep(flops / chunks, dtype=dtype, tag=f"gemm#{j}")]
+                if flops > 0.0
+                else []
+            )
+            if placement == "compute_first":
+                steps = csteps + wsteps
+            elif placement == "sandwich" and len(wsteps) >= 2:
+                half = len(wsteps) // 2
+                steps = wsteps[:half] + csteps + wsteps[half:]
+            else:
+                steps = wsteps + csteps
+            stages.append(Stage(steps, label=f"chunk{j}"))
+        return pipelined(label, stages, chunks=chunks, **meta)
+
+    wsteps = []
+    for e in entries:
+        wsteps.extend(_entry_steps(e, scope_default, "trace"))
+    csteps = (
+        [ComputeStep(flops, dtype=dtype, tag="gemm")] if flops > 0.0 else []
+    )
+    if export.get("schedule") == "overlap":
+        stages = [Stage(csteps, label="compute"), Stage(wsteps, label="comm")]
+        return pipelined(label, [s for s in stages if s.steps], **meta)
+    placement = FAMILY_PLACEMENT.get(family, "comm_first")
+    if placement == "compute_first":
+        chain: List[Any] = csteps + wsteps
+    else:
+        chain = wsteps + csteps
+    if not chain:
+        raise ProgramBuildError(
+            f"{label}: traced schedule is empty "
+            f"(status={export.get('status')!r}: {export.get('reason')})"
+        )
+    return sequential(label, chain, **meta)
+
+
+def program_from_member(
+    family: str,
+    member: str,
+    topology: Topology,
+    overrides: Optional[Dict[str, Any]] = None,
+    shapes: Optional[Dict[str, int]] = None,
+) -> ScheduleProgram:
+    """Convenience: trace a registered member (``member_schedule``) and
+    lower it — the one-call form the report script uses."""
+    from ddlb_tpu.analysis.spmd.families import member_schedule
+
+    export = member_schedule(family, member, overrides, shapes=shapes)
+    return program_from_schedule(export, topology)

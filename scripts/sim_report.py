@@ -1,0 +1,317 @@
+#!/usr/bin/env python
+"""Static performance simulator report: rank algorithms per topology.
+
+The Big Send-off evaluation loop as a framework feature: for each
+collective-bearing family, the flat ring, the HiCCL-style hierarchical
+composition, and the multi-path striped composition are replayed on a
+synthetic multi-pod topology (``ddlb_tpu.perfmodel.topology``) and
+ranked by predicted makespan — before a single chip is booked. A
+second section replays the *traced* schedules of real registered
+members (the semantic SPMD interpreter's export) at their canonical
+world size, with per-member predicted time, overlap fraction, and the
+per-link utilization breakdown.
+
+Usage:
+    python scripts/sim_report.py [--topology SPEC] [--payload-mib N]
+                                 [--families F1,F2] [--json]
+    python scripts/sim_report.py --validate [--history DIR]
+
+``--topology`` defaults to ``DDLB_TPU_TOPOLOGY``
+(``envs.get_topology_override``; the benchmark CLI's ``--topology``
+exports it) and then to the 1024-chip ``4pod1024`` preset. ``--json``
+emits the same structure machine-readably.
+
+``--validate`` runs the two simulator gates instead of the ranking:
+float-precision agreement with the ``perfmodel.cost`` closed forms on
+degenerate flat topologies for every registered family, and — when a
+history bank is given via ``--history`` or ``DDLB_TPU_HISTORY`` — the
+tolerance-gated join against banked observatory medians.
+
+Exit codes: 0 success; 1 validation failure (or empty ranking); 2
+usage errors (argparse).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_TOPOLOGY = "4pod1024"
+
+#: family -> synthetic-ranking payload op (frontends.FAMILY_COLLECTIVES
+#: restated: the ranking set is the explicit-collective families)
+RANKED_FAMILIES = (
+    "tp_columnwise",
+    "tp_rowwise",
+    "dp_allreduce",
+    "ep_alltoall",
+    "collectives",
+)
+
+#: traced members replayed in the per-member section: the baseline
+#: explicit member and the chunked engine at two pipeline depths
+TRACED_MEMBERS = (
+    ("tp_columnwise", "jax_spmd", {}),
+    ("tp_columnwise", "overlap", {"algorithm": "chunked", "chunk_count": 4}),
+    ("tp_rowwise", "jax_spmd", {}),
+    ("tp_rowwise", "overlap", {"algorithm": "chunked", "chunk_count": 4}),
+    ("dp_allreduce", "jax_spmd", {}),
+    ("dp_allreduce", "overlap", {"algorithm": "chunked", "chunk_count": 4}),
+    ("ep_alltoall", "jax_spmd", {}),
+    ("ep_alltoall", "overlap", {"algorithm": "chunked", "chunk_count": 4}),
+    ("pp_pipeline", "schedules", {}),
+)
+
+
+def _fmt_s(seconds):
+    if seconds is None or not math.isfinite(seconds):
+        return "?"
+    if seconds >= 1e-3:
+        return f"{seconds * 1e3:.3f}ms"
+    return f"{seconds * 1e6:.3f}us"
+
+
+def build_ranking(topology, payload_bytes, families):
+    from ddlb_tpu.simulator.engine import replay, summarize
+    from ddlb_tpu.simulator.frontends import (
+        FAMILY_COLLECTIVES,
+        SYNTHETIC_ALGOS,
+        synthetic_program,
+    )
+
+    ranking = []
+    for family in families:
+        op = FAMILY_COLLECTIVES[family]
+        rows = []
+        for algo in SYNTHETIC_ALGOS:
+            program = synthetic_program(algo, op, payload_bytes, topology)
+            result = replay(program, topology)
+            row = summarize(result, topology)
+            row["algo"] = algo
+            rows.append(row)
+        flat_s = next(
+            r["makespan_s"] for r in rows if r["algo"] == "flat"
+        )
+        for row in rows:
+            row["speedup_vs_flat"] = (
+                flat_s / row["makespan_s"] if row["makespan_s"] > 0 else None
+            )
+        rows.sort(key=lambda r: r["makespan_s"])
+        ranking.append({"family": family, "op": op, "rows": rows})
+    return ranking
+
+
+def build_member_section(members):
+    from ddlb_tpu.analysis.core import repo_root
+    from ddlb_tpu.analysis.spmd.families import ClassRegistry, member_schedule
+    from ddlb_tpu.perfmodel.topology import flat_topology
+    from ddlb_tpu.simulator.engine import replay, summarize
+    from ddlb_tpu.simulator.frontends import (
+        ProgramBuildError,
+        program_from_schedule,
+    )
+
+    # one registry for the whole section: the members share most of
+    # their statically-parsed module/base-class graph
+    registry = ClassRegistry(repo_root())
+    out = []
+    for family, member, overrides in members:
+        export = member_schedule(family, member, overrides, registry=registry)
+        label = f"{family}/{member}" + (
+            "[" + ",".join(f"{k}={v}" for k, v in sorted(overrides.items()))
+            + "]"
+            if overrides
+            else ""
+        )
+        record = {
+            "member": label,
+            "trace_status": export["status"],
+            "entries": len(export["entries"]),
+        }
+        topo = flat_topology(export["partitions"], "v5e")
+        try:
+            result = replay(program_from_schedule(export, topo), topo)
+        except ProgramBuildError as exc:
+            record["error"] = str(exc)
+            out.append(record)
+            continue
+        record.update(summarize(result, topo))
+        out.append(record)
+    return out
+
+
+def run_validation(history_dir):
+    from ddlb_tpu.simulator.validate import closed_form_check, history_check
+
+    closed = closed_form_check()
+    summary = {
+        "closed_form": {
+            "checked": len(closed),
+            "failures": [r for r in closed if not r["ok"]],
+            "max_rel_err": max((r["rel_err"] for r in closed), default=0.0),
+        }
+    }
+    if history_dir:
+        summary["history"] = history_check(history_dir)
+    return summary
+
+
+def print_ranking(topology, payload_bytes, ranking):
+    print(f"== simulated algorithm ranking on {topology.describe()} ==")
+    print(f"   payload {payload_bytes / (1 << 20):.0f} MiB/device\n")
+    for block in ranking:
+        print(f"-- {block['family']} ({block['op']}) --")
+        print(f"{'algo':<14} {'predicted':>12} {'vs flat':>8}  link busy fractions")
+        for row in block["rows"]:
+            links = " ".join(
+                f"{name}={info['busy_frac']:.2f}"
+                for name, info in sorted(row["links"].items())
+                if info["busy_frac"] > 0
+            )
+            speed = row["speedup_vs_flat"]
+            print(
+                f"{row['algo']:<14} {_fmt_s(row['makespan_s']):>12} "
+                f"{(f'{speed:.2f}x' if speed else '?'):>8}  {links}"
+            )
+        print()
+
+
+def print_members(members):
+    print("== traced member replays (canonical shapes, flat v5e world) ==")
+    print(
+        f"{'member':<52} {'trace':>9} {'steps':>6} {'predicted':>12} "
+        f"{'ovl':>6}"
+    )
+    for rec in members:
+        if "error" in rec:
+            print(f"{rec['member']:<52} {rec['trace_status']:>9} "
+                  f"-- {rec['error']}")
+            continue
+        ovl = rec.get("overlap_frac")
+        print(
+            f"{rec['member']:<52} {rec['trace_status']:>9} "
+            f"{rec['events']:>6} {_fmt_s(rec['makespan_s']):>12} "
+            f"{(f'{ovl:.2f}' if ovl is not None else 'nan'):>6}"
+        )
+    print()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--topology", default=None,
+        help=f"topology spec or preset (default: DDLB_TPU_TOPOLOGY, then "
+        f"{DEFAULT_TOPOLOGY})",
+    )
+    parser.add_argument(
+        "--payload-mib", type=float, default=64.0,
+        help="per-device collective payload for the ranking (MiB)",
+    )
+    parser.add_argument(
+        "--families", default=None,
+        help="comma-separated subset of the ranked families",
+    )
+    parser.add_argument(
+        "--no-members", action="store_true",
+        help="skip the traced per-member section (ranking only)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    parser.add_argument(
+        "--validate", action="store_true",
+        help="run the closed-form + history validation gates instead",
+    )
+    parser.add_argument(
+        "--history", default=None,
+        help="observatory history directory for the validation join "
+        "(default: DDLB_TPU_HISTORY)",
+    )
+    args = parser.parse_args(argv)
+
+    from ddlb_tpu import envs
+    from ddlb_tpu.perfmodel.topology import resolve_topology
+
+    spec = args.topology or envs.get_topology_override() or DEFAULT_TOPOLOGY
+    try:
+        topology = resolve_topology(spec)
+    except (KeyError, ValueError) as exc:
+        parser.error(f"bad --topology {spec!r}: {exc}")
+
+    if args.validate:
+        history_dir = args.history or envs.get_history_dir() or None
+        summary = run_validation(history_dir)
+        ok = not summary["closed_form"]["failures"] and (
+            "history" not in summary or summary["history"]["ok"]
+        )
+        if args.as_json:
+            print(json.dumps({"validation": summary, "ok": ok}, indent=2))
+        else:
+            cf = summary["closed_form"]
+            print(
+                f"closed-form agreement: {cf['checked']} configs, "
+                f"{len(cf['failures'])} failures, max rel err "
+                f"{cf['max_rel_err']:.2e}"
+            )
+            for failure in cf["failures"]:
+                print(f"  FAIL {failure}")
+            if "history" in summary:
+                h = summary["history"]
+                print(
+                    f"history join: {h['checked']} keys checked, "
+                    f"{h['skipped']} skipped, {len(h['violations'])} "
+                    f"violations (rtol={h['rtol']}, "
+                    f"lb_slack={h['lower_bound_slack']})"
+                )
+                for violation in h["violations"]:
+                    print(f"  FAIL {violation}")
+            print("VALIDATION " + ("PASSED" if ok else "FAILED"))
+        return 0 if ok else 1
+
+    families = RANKED_FAMILIES
+    if args.families:
+        wanted = [f.strip() for f in args.families.split(",") if f.strip()]
+        unknown = [f for f in wanted if f not in RANKED_FAMILIES]
+        if unknown:
+            parser.error(
+                f"unknown families {unknown}; ranked: {RANKED_FAMILIES}"
+            )
+        families = tuple(wanted)
+
+    payload = args.payload_mib * (1 << 20)
+    ranking = build_ranking(topology, payload, families)
+    members = [] if args.no_members else build_member_section(TRACED_MEMBERS)
+    if not ranking:
+        print("nothing to rank", file=sys.stderr)
+        return 1
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "topology": {
+                        "spec": topology.name,
+                        "chip": topology.chip.name,
+                        "pods": topology.pods,
+                        "ici_mesh": list(topology.ici_mesh),
+                        "chips": topology.num_chips,
+                    },
+                    "payload_bytes": payload,
+                    "ranking": ranking,
+                    "members": members,
+                },
+                indent=2,
+            )
+        )
+        return 0
+    print_ranking(topology, payload, ranking)
+    if members:
+        print_members(members)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,491 @@
+"""Static performance simulator: engine, front-ends, validation, CLI.
+
+The acceptance surface of the simulator subsystem:
+
+- topology parsing/presets and the resource-rate contract;
+- event-engine determinism (bit-identical replays);
+- closed-form agreement with ``perfmodel.cost`` on degenerate flat
+  topologies for every registered family;
+- the chunked pipeline law ``max(C, W) + min(C, W)/chunks`` reproduced
+  from the REPLAYED double-buffered ring (traced front-end), not from a
+  closed form;
+- hierarchical-beats-flat on a 2-pod DCN-bound topology;
+- the tolerance-gated history join against a seeded cpu-sim capture
+  (clean passes, a faster-than-roofline row fails);
+- ``scripts/sim_report.py`` exit codes and ``--json`` shape;
+- the ``DDLB_TPU_TOPOLOGY`` accessor and the CLI ``--topology`` export.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ddlb_tpu.perfmodel.cost import (
+    hierarchical_wire_bytes,
+    ring_step_count,
+    ring_wire_bytes,
+)
+from ddlb_tpu.perfmodel.topology import (
+    PRESETS,
+    Topology,
+    flat_topology,
+    parse_topology,
+    resolve_topology,
+)
+from ddlb_tpu.simulator.engine import replay, summarize
+from ddlb_tpu.simulator.frontends import (
+    flat_ring_program,
+    hierarchical_program,
+    striped_program,
+    synthetic_program,
+)
+from ddlb_tpu.simulator.program import (
+    ComputeStep,
+    Stage,
+    WireStep,
+    pipelined,
+    sequential,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIM_REPORT = os.path.join(REPO, "scripts", "sim_report.py")
+
+GB = 1e9
+MiB = float(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# topology layer
+# ---------------------------------------------------------------------------
+
+
+class TestTopology:
+    def test_parse_spec(self):
+        topo = parse_topology("v5p:4x16x16")
+        assert topo.chip.name == "v5p"
+        assert topo.pods == 4
+        assert topo.ici_mesh == (16, 16)
+        assert topo.chips_per_pod == 256
+        assert topo.num_chips == 1024
+
+    def test_parse_degenerate_flat(self):
+        topo = parse_topology("v5e:8")
+        assert topo.pods == 1
+        assert topo.num_chips == 8
+        assert topo.flat_bw == topo.ici_bw
+
+    def test_parse_rejects_malformed(self):
+        for bad in ("v5e", "v5e:", ":4x4", "v5e:axb", "v5e:0x4"):
+            with pytest.raises(ValueError):
+                parse_topology(bad)
+        with pytest.raises(KeyError):
+            parse_topology("v99:4x4")
+
+    def test_presets_resolve(self):
+        for name in PRESETS:
+            topo = resolve_topology(name)
+            assert 256 <= topo.num_chips <= 4096
+
+    def test_flat_bw_gated_by_dcn_on_multipod(self):
+        topo = parse_topology("v5p:2x16")
+        assert topo.flat_bw == topo.dcn_bw  # dcn is the slow class
+        assert topo.resource_rate("ici0") == topo.ici_bw
+        assert topo.resource_rate("mxu", "bfloat16") == 459e12
+
+    def test_unknown_resource_raises(self):
+        topo = parse_topology("v5e:8")
+        with pytest.raises(ValueError):
+            topo.resource_rate("ici5")  # only one ici mesh dim
+
+    def test_flat_hop_fractions_sum_to_one(self):
+        topo = parse_topology("v5e:4x8x8")
+        fractions = topo.flat_hop_fractions()
+        assert sum(fractions.values()) == pytest.approx(1.0)
+        assert fractions["dcn"] == pytest.approx(4 / 256)
+
+
+# ---------------------------------------------------------------------------
+# engine determinism + schedule semantics
+# ---------------------------------------------------------------------------
+
+
+def _demo_program():
+    stages = [
+        Stage(
+            [
+                WireStep(8 * MiB, scope="ici0", tag=f"ring#{j}"),
+                ComputeStep(1e9, tag=f"gemm#{j}"),
+            ],
+            label=f"chunk{j}",
+        )
+        for j in range(4)
+    ]
+    return pipelined("demo", stages)
+
+
+class TestEngine:
+    def test_deterministic_replay(self):
+        topo = flat_topology(8, "v5e")
+        first = replay(_demo_program(), topo)
+        second = replay(_demo_program(), topo)
+        assert first.makespan_s == second.makespan_s
+        assert [
+            (e.index, e.resource, e.start_s, e.finish_s)
+            for e in first.timeline
+        ] == [
+            (e.index, e.resource, e.start_s, e.finish_s)
+            for e in second.timeline
+        ]
+        assert first.events == second.events == 8
+
+    def test_sequential_sums_overlap_races(self):
+        topo = flat_topology(8, "v5e")
+        comm = WireStep(50 * GB / 1e3, scope="ici0")  # exactly 1 ms
+        comp = ComputeStep(197e12 / 1e3)  # exactly 1 ms at bf16 peak
+        seq = replay(sequential("seq", [comm, comp]), topo)
+        assert seq.makespan_s == pytest.approx(2e-3)
+        ovl = replay(
+            pipelined("ovl", [Stage([comp]), Stage([comm])]), topo
+        )
+        assert ovl.makespan_s == pytest.approx(1e-3)
+        assert ovl.overlap_frac == pytest.approx(1.0)
+
+    def test_overlap_frac_nan_without_hideable_window(self):
+        topo = flat_topology(8, "v5e")
+        result = replay(sequential("comm-only", [WireStep(MiB)]), topo)
+        assert math.isnan(result.overlap_frac)
+
+    def test_summarize_shape(self):
+        topo = parse_topology("v5e:2x4")
+        doc = summarize(replay(_demo_program(), topo), topo)
+        assert doc["chips"] == 8
+        assert set(doc["links"]) == {"ici0", "dcn", "flat"}
+        for info in doc["links"].values():
+            assert 0.0 <= info["busy_frac"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# synthetic compositions
+# ---------------------------------------------------------------------------
+
+
+class TestSynthetics:
+    def test_flat_ring_wire_census(self):
+        topo = flat_topology(16, "v5e")
+        payload = 4 * MiB
+        program = flat_ring_program("all_reduce", payload, topo)
+        assert program.total(WireStep) == pytest.approx(
+            ring_wire_bytes("all_reduce", payload, 16)
+        )
+        assert program.num_steps() == ring_step_count("all_reduce", 16)
+
+    def test_hierarchical_wire_split_matches_formula(self):
+        topo = parse_topology("v5e:4x8")
+        payload = 8 * MiB
+        program = hierarchical_program("all_reduce", payload, topo)
+        result = replay(program, topo)
+        want = hierarchical_wire_bytes("all_reduce", payload, 8, 4)
+        assert result.payload.get("ici0", 0.0) == pytest.approx(want["ici"])
+        assert result.payload.get("dcn", 0.0) == pytest.approx(want["dcn"])
+
+    def test_hierarchical_beats_flat_on_dcn_bound_2pod(self):
+        # the acceptance topology: 2 pods, thin DCN — every flat-ring
+        # step is gated by the cross-pod hop
+        topo = parse_topology("v5p:2x16")
+        payload = 64 * MiB
+        for op in ("all_reduce", "all_gather", "reduce_scatter"):
+            flat = replay(flat_ring_program(op, payload, topo), topo)
+            hier = replay(hierarchical_program(op, payload, topo), topo)
+            assert hier.makespan_s < flat.makespan_s, op
+        # and the advantage is the DCN relief, not an accounting trick:
+        # flat moves its whole census at the DCN rate
+        flat = replay(flat_ring_program("all_reduce", payload, topo), topo)
+        assert flat.makespan_s == pytest.approx(
+            ring_wire_bytes("all_reduce", payload, 32) / topo.dcn_bw
+        )
+
+    def test_striped_degenerates_to_hierarchical_on_1d_mesh(self):
+        topo = parse_topology("v5e:2x8")  # one ici dim -> one stripe
+        payload = 8 * MiB
+        hier = replay(hierarchical_program("all_reduce", payload, topo), topo)
+        striped = replay(striped_program("all_reduce", payload, topo), topo)
+        assert striped.makespan_s == pytest.approx(hier.makespan_s)
+
+    def test_striped_beats_hierarchical_on_2d_mesh(self):
+        topo = parse_topology("v5p:2x8x8")
+        payload = 64 * MiB
+        hier = replay(hierarchical_program("all_reduce", payload, topo), topo)
+        striped = replay(striped_program("all_reduce", payload, topo), topo)
+        assert striped.makespan_s < hier.makespan_s
+
+    def test_unknown_algo_raises(self):
+        from ddlb_tpu.simulator.frontends import ProgramBuildError
+
+        with pytest.raises(ProgramBuildError):
+            synthetic_program("magic", "all_reduce", MiB, flat_topology(8))
+
+
+# ---------------------------------------------------------------------------
+# closed-form agreement (every registered family)
+# ---------------------------------------------------------------------------
+
+
+class TestClosedFormAgreement:
+    def test_every_family_agrees_to_float_precision(self):
+        from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+        from ddlb_tpu.simulator.validate import closed_form_check
+
+        results = closed_form_check()
+        covered = {r["family"] for r in results}
+        assert covered == set(ALLOWED_PRIMITIVES)
+        for r in results:
+            assert r["ok"], (
+                f"{r['family']}/{r['member']} {r['options']}: "
+                f"sim {r['predicted_sim_s']} vs cost "
+                f"{r['predicted_cost_s']} (rel {r['rel_err']:.2e})"
+            )
+
+    def test_chunked_depths_checked(self):
+        from ddlb_tpu.simulator.validate import closed_form_check
+
+        results = closed_form_check(families=("dp_allreduce",))
+        chunked = [
+            r for r in results if r["options"].get("algorithm") == "chunked"
+        ]
+        assert {r["options"]["chunk_count"] for r in chunked} == {1, 2, 4}
+
+
+# ---------------------------------------------------------------------------
+# traced front-end: the replayed double-buffered ring
+# ---------------------------------------------------------------------------
+
+
+class TestTracedReplay:
+    @pytest.mark.parametrize("chunks", [2, 4])
+    @pytest.mark.parametrize("family", ["tp_columnwise", "tp_rowwise"])
+    def test_chunk_law_emerges_from_replay(self, family, chunks):
+        """The pipeline law is NOT coded into the traced path: the
+        engine's FIFO arbitration of the literal c*(d-1) traced
+        ppermutes must land on ``max(C, W) + min(C, W)/c``."""
+        from ddlb_tpu.analysis.spmd.families import member_schedule
+        from ddlb_tpu.simulator.frontends import program_from_schedule
+
+        export = member_schedule(
+            family, "overlap",
+            {"algorithm": "chunked", "chunk_count": chunks},
+        )
+        assert export["status"] == "verified"
+        d = export["partitions"]
+        assert len(export["entries"]) > 0
+        assert len(export["entries"]) % chunks == 0
+        topo = flat_topology(d, "v5e")
+        result = replay(program_from_schedule(export, topo), topo)
+        compute, wire = result.compute_busy_s, result.comm_busy_s
+        law = max(compute, wire) + min(compute, wire) / chunks
+        assert result.makespan_s == pytest.approx(law, rel=1e-12)
+
+    def test_sequential_member_replays_serial_floor(self):
+        from ddlb_tpu.analysis.spmd.families import member_schedule
+        from ddlb_tpu.simulator.frontends import program_from_schedule
+
+        export = member_schedule("dp_allreduce", "jax_spmd", {})
+        assert export["status"] == "verified"
+        topo = flat_topology(export["partitions"], "v5e")
+        result = replay(program_from_schedule(export, topo), topo)
+        assert result.makespan_s == pytest.approx(
+            result.compute_busy_s + result.comm_busy_s, rel=1e-12
+        )
+        # the traced wire census survives the lowering intact
+        assert sum(
+            v for r, v in result.payload.items() if r.startswith("ici")
+        ) == pytest.approx(export["wire_traced"])
+
+    def test_pipeline_schedule_table_replays_step_by_step(self):
+        from ddlb_tpu.analysis.spmd.families import member_schedule
+        from ddlb_tpu.simulator.frontends import program_from_schedule
+
+        export = member_schedule("pp_pipeline", "schedules", {})
+        assert export["status"] == "verified"
+        # the dense tick table arrives as per-tick hops, not one blob
+        assert len(export["entries"]) > 10
+        topo = flat_topology(export["partitions"], "v5e")
+        result = replay(program_from_schedule(export, topo), topo)
+        assert result.makespan_s > 0.0
+
+
+# ---------------------------------------------------------------------------
+# history join (seeded cpu-sim capture)
+# ---------------------------------------------------------------------------
+
+
+def _seed_capture(tmp_path, slack: float = 3.0):
+    """Bank a synthetic-but-honest cpu-sim capture: rows whose measured
+    medians sit ``slack``x above their own closed-form predictions (the
+    roofline contract every real capture satisfies)."""
+    from ddlb_tpu.observatory.store import bank_row
+    from ddlb_tpu.perfmodel.cost import estimate
+    from ddlb_tpu.perfmodel.specs import get_spec
+    from ddlb_tpu.simulator.validate import build_stub
+
+    directory = str(tmp_path)
+    spec = get_spec("cpu-sim")
+    configs = [
+        ("tp_columnwise", "jax_spmd", {}, "", (256, 64, 64)),
+        ("dp_allreduce", "jax_spmd", {}, "", (256, 64, 64)),
+        (
+            "dp_allreduce",
+            "overlap",
+            {"algorithm": "chunked", "chunk_count": 2},
+            "algorithm=chunked;chunk_count=2",
+            (256, 64, 64),
+        ),
+        # outside REPRODUCIBLE_FAMILIES: must still face the
+        # lower-bound gate (2b), never be skipped
+        ("transformer_decode", "spmd", {}, "", (64, 64, 64)),
+    ]
+    for family, member, options, option_str, (m, n, k) in configs:
+        impl = build_stub(
+            family, member, m, n, k, 8, dtype="float32", **options
+        )
+        predicted = estimate(impl, spec).predicted_s
+        row = {
+            "primitive": family,
+            "base_implementation": member,
+            "option": option_str,
+            "m": m, "n": n, "k": k,
+            "dtype": "float32",
+            "world_size": 8,
+            "chip": "cpu-sim",
+            "time_measurement_backend": "host_clock",
+            "median time (ms)": predicted * slack * 1e3,
+            "predicted_s": predicted,
+            "error": "",
+        }
+        assert bank_row(row, kind="row", directory=directory)
+    return directory
+
+
+class TestHistoryJoin:
+    def test_clean_capture_validates(self, tmp_path):
+        from ddlb_tpu.simulator.validate import history_check
+
+        directory = _seed_capture(tmp_path)
+        verdict = history_check(directory)
+        # all four keys face the lower-bound gate, including the
+        # transformer_decode row outside REPRODUCIBLE_FAMILIES
+        assert verdict["checked"] == 4
+        assert verdict["violations"] == []
+        assert verdict["ok"]
+
+    def test_faster_than_roofline_row_fails(self, tmp_path):
+        from ddlb_tpu.observatory.store import bank_row, load_history
+        from ddlb_tpu.simulator.validate import history_check
+
+        directory = _seed_capture(tmp_path)
+        row = dict(load_history(directory)[0]["row"])
+        row["m"] = 512  # fresh key: the clean medians cannot absorb it
+        row["median time (ms)"] = float(row["predicted_s"]) * 1e3 / 4.0
+        assert bank_row(row, kind="row", directory=directory)
+        verdict = history_check(directory)
+        assert not verdict["ok"]
+        assert any(
+            v["kind"] == "lower-bound" for v in verdict["violations"]
+        )
+
+    def test_empty_history_is_not_a_pass(self, tmp_path):
+        from ddlb_tpu.simulator.validate import history_check
+
+        assert not history_check(str(tmp_path))["ok"]
+
+
+# ---------------------------------------------------------------------------
+# the report CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_report(*args):
+    return subprocess.run(
+        [sys.executable, SIM_REPORT, *args],
+        capture_output=True, text=True, timeout=300,
+    )
+
+
+class TestSimReportCLI:
+    def test_json_shape_and_exit_zero(self):
+        out = _run_report(
+            "--topology", "v5e:2x4", "--no-members", "--json",
+            "--payload-mib", "4",
+        )
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert doc["topology"]["chips"] == 8
+        assert {b["family"] for b in doc["ranking"]} == {
+            "tp_columnwise", "tp_rowwise", "dp_allreduce", "ep_alltoall",
+            "collectives",
+        }
+        for block in doc["ranking"]:
+            algos = [r["algo"] for r in block["rows"]]
+            assert sorted(algos) == ["flat", "hierarchical", "striped"]
+            # rows arrive ranked fastest-first
+            spans = [r["makespan_s"] for r in block["rows"]]
+            assert spans == sorted(spans)
+
+    def test_bad_topology_exits_two(self):
+        out = _run_report("--topology", "nonsense")
+        assert out.returncode == 2
+        assert "topology" in out.stderr
+
+    def test_bad_family_exits_two(self):
+        out = _run_report("--families", "warp_drive")
+        assert out.returncode == 2
+
+    def test_validation_failure_exits_one(self, tmp_path):
+        directory = _seed_capture(tmp_path)
+        from ddlb_tpu.observatory.store import bank_row, load_history
+
+        row = dict(load_history(directory)[0]["row"])
+        row["m"] = 512
+        row["median time (ms)"] = float(row["predicted_s"]) * 1e3 / 4.0
+        assert bank_row(row, kind="row", directory=directory)
+        out = _run_report("--validate", "--history", directory)
+        assert out.returncode == 1, out.stdout
+        assert "FAILED" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# env accessor + CLI threading
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyOverride:
+    def test_env_accessor(self, monkeypatch):
+        from ddlb_tpu import envs
+
+        monkeypatch.delenv("DDLB_TPU_TOPOLOGY", raising=False)
+        assert envs.get_topology_override() == ""
+        monkeypatch.setenv("DDLB_TPU_TOPOLOGY", " v5p:2x16 ")
+        assert envs.get_topology_override() == "v5p:2x16"
+
+    def test_cli_exports_topology(self, monkeypatch):
+        from ddlb_tpu.cli import benchmark as cli
+
+        monkeypatch.delenv("DDLB_TPU_TOPOLOGY", raising=False)
+        captured = {}
+        monkeypatch.setattr(
+            cli, "run_benchmark", lambda config: captured.update(config)
+        )
+        cli.main(["--topology", "v5e:2x4", "--sim", "8"])
+        assert os.environ.get("DDLB_TPU_TOPOLOGY") == "v5e:2x4"
+        assert captured["primitive"] == "tp_columnwise"
+
+    def test_cli_rejects_bad_topology(self, monkeypatch):
+        from ddlb_tpu.cli import benchmark as cli
+
+        with pytest.raises(SystemExit):
+            cli.main(["--topology", "not-a-world"])
