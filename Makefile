@@ -46,11 +46,14 @@ hooks:
 
 # the CI gate: full analyzer sweep (SARIF artifact for code-scanning
 # upload — see docs/source/static_analysis.rst "CI integration"), the
-# tier-1 test surface, then the serving-load acceptance sweep (knee +
-# SLO gate on CPU sim — docs/source/observability.rst)
+# Pallas kernel census (VMEM/tile/DMA budget per chip spec, fails on
+# any non-baselined DDLB130-133 finding — "Pallas kernel rules" in the
+# same doc), the tier-1 test surface, then the serving-load acceptance
+# sweep (knee + SLO gate on CPU sim — docs/source/observability.rst)
 ci:
 	$(PYTHON) scripts/analyze.py
 	$(PYTHON) scripts/analyze.py --sarif > analysis.sarif
+	$(PYTHON) scripts/analyze.py --pallas-census
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
 	$(PYTHON) scripts/serving_load_demo.py
 	$(PYTHON) scripts/sim_demo.py

@@ -96,24 +96,43 @@ class Finding:
 
 
 class FileContext:
-    """Everything the rules need about one file, computed exactly once."""
+    """Everything the rules need about one file, computed exactly once.
 
-    def __init__(self, path: Path, rel: str, source: str) -> None:
+    ``parsed`` (when supplied by the ``build_context`` mtime cache)
+    short-circuits the expensive immutable work — the AST parse and the
+    suppression-comment tokenization — while the mutable per-run state
+    (``used_suppressions``, rule caches hung off the instance) always
+    starts fresh.
+    """
+
+    def __init__(
+        self,
+        path: Path,
+        rel: str,
+        source: str,
+        parsed: Optional[Tuple] = None,
+    ) -> None:
         self.path = path
         self.rel = rel  # repo-relative posix path (or the input as given)
         self.source = source
         self.lines = source.splitlines()
         self.tree: Optional[ast.Module] = None
         self.syntax_error: Optional[SyntaxError] = None
-        try:
-            self.tree = ast.parse(source, filename=str(path))
-        except SyntaxError as exc:
-            self.syntax_error = exc
         #: line -> rule ids a ``# ddlb: ignore[...]`` comment names there
         self.suppressions: Dict[int, Set[str]] = {}
         #: (line, rule) pairs that actually suppressed a finding
         self.used_suppressions: Set[Tuple[int, str]] = set()
         self._index: Optional[Dict[type, List[ast.AST]]] = None
+        if parsed is not None:
+            self.tree, self.syntax_error, cached_supp = parsed
+            self.suppressions = {
+                line: set(ids) for line, ids in cached_supp.items()
+            }
+            return
+        try:
+            self.tree = ast.parse(source, filename=str(path))
+        except SyntaxError as exc:
+            self.syntax_error = exc
         self._collect_suppressions()
 
     @property
@@ -225,10 +244,13 @@ def all_rules() -> List[Rule]:
     """Every registered rule instance, stable-ordered by id. Imported
     lazily so ``core`` has no import cycle with the rule modules."""
     from ddlb_tpu.analysis import rules_domain, rules_project, rules_style
+    from ddlb_tpu.analysis.pallas import rules_pallas
     from ddlb_tpu.analysis.spmd import rules_spmd
 
     rules: List[Rule] = []
-    for module in (rules_style, rules_domain, rules_project, rules_spmd):
+    for module in (
+        rules_style, rules_domain, rules_project, rules_spmd, rules_pallas
+    ):
         rules.extend(module.RULES)
     return sorted(rules, key=lambda r: r.id)
 
@@ -249,11 +271,47 @@ def relativize(path: Path, root: Optional[Path] = None) -> str:
         return path.as_posix()
 
 
+#: (resolved path) -> (mtime_ns, size, tree, syntax_error, suppressions)
+#: — the in-process parse cache. One ``analyze`` invocation builds the
+#: same FileContext several times (the project rules re-anchor findings,
+#: DDLB123/DDLB130 drive the ClassRegistry over the same files, the
+#: test suite runs dozens of sweeps per process); keying on
+#: (mtime_ns, size) keeps a stale AST impossible while making every
+#: re-parse after the first a dict hit. Mutable per-run state is NOT
+#: cached — ``FileContext`` rebuilds it fresh (see its docstring).
+_PARSE_CACHE: Dict[str, Tuple[int, int, object, object, Dict]] = {}
+
+_PARSE_CACHE_MAX = 2048
+
+
 def build_context(path: Path, root: Optional[Path] = None) -> FileContext:
-    """Parse ``path`` once into a ``FileContext``."""
+    """Parse ``path`` once into a ``FileContext`` (mtime-keyed cache)."""
     path = Path(path)
+    rel = relativize(path, root)
+    try:
+        stat = path.stat()
+        key = str(path.resolve())
+    except OSError:
+        key = None
+    if key is not None:
+        hit = _PARSE_CACHE.get(key)
+        if hit is not None and hit[0] == stat.st_mtime_ns and (
+            hit[1] == stat.st_size
+        ):
+            source = path.read_text(encoding="utf-8")
+            return FileContext(
+                path, rel, source, parsed=(hit[2], hit[3], hit[4])
+            )
     source = path.read_text(encoding="utf-8")
-    return FileContext(path, relativize(path, root), source)
+    ctx = FileContext(path, rel, source)
+    if key is not None:
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[key] = (
+            stat.st_mtime_ns, stat.st_size, ctx.tree, ctx.syntax_error,
+            {line: set(ids) for line, ids in ctx.suppressions.items()},
+        )
+    return ctx
 
 
 def _apply_suppressions(ctx: FileContext, findings: List[Finding]) -> None:

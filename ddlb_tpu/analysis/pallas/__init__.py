@@ -1,0 +1,20 @@
+"""Static Pallas kernel analysis: model, canonical census, DDLB13x rules.
+
+``model`` extends the semantic SPMD interpreter into ``pallas_call``
+kernel bodies (Refs, BlockSpecs, DMA semaphores, remote-copy wire);
+``census`` drives every registered ops kernel at canonical sweep shapes;
+``rules_pallas`` turns the censuses into findings DDLB130-134. See
+``docs/source/static_analysis.rst`` ("Pallas kernel rules").
+"""
+
+from ddlb_tpu.analysis.pallas.census import (  # noqa: F401
+    KERNEL_SPECS,
+    KernelSpec,
+    pallas_call_sites,
+    run_census,
+    shared_run,
+)
+from ddlb_tpu.analysis.pallas.model import (  # noqa: F401
+    KernelCensus,
+    PallasModel,
+)

@@ -1,0 +1,968 @@
+"""Static Pallas kernel model: the value domain behind the DDLB13x rules.
+
+The PR 9 abstract interpreter stops at every ``pallas_call`` and returns
+its ``out_shape`` — which is why DDLB123 lists the pallas members as
+*opaque* and why nothing checks a kernel's VMEM working set, tile
+alignment, or DMA-semaphore protocol before XLA does (as a compile
+error) or the hardware does (as a perf cliff). This module extends the
+interpreter INTO the kernel body: when an ``Interpreter`` carries a
+``PallasModel``, the ``pl``/``pltpu`` program-construction surface
+(``pallas_call``, ``BlockSpec``, ``PrefetchScalarGridSpec``, VMEM/SMEM
+scratch, DMA/REGULAR/BARRIER semaphores, ``make_async_copy`` /
+``make_async_remote_copy``, ``emit_pipeline``, ``run_scoped``,
+``pl.when``, ``program_id``/``num_programs``, ``pl.ds``) evaluates to
+model values, the kernel function is interpreted over symbolic ``Ref``s,
+and one ``KernelCensus`` per ``pallas_call`` invocation records:
+
+- the **VMEM working set**: every VMEM-resident block (pipelined blocks
+  count their double-buffer multiplicity x2 — Pallas's implicit grid
+  pipeline keeps the in-flight and the in-use copy resident), scratch
+  allocations, and the peak over inner ``emit_pipeline`` tile sets
+  (inner pipelines are scoped, so they max rather than sum);
+- every **block record** (block shape, operand shape, dtype, memory
+  space) — the DDLB131 tile-alignment and DDLB133 divisibility inputs;
+- per-semaphore **DMA start/wait balance** under the SPMD-symmetric
+  model (a remote copy's send increments locally AND its recv
+  increments locally, because the left neighbor runs the same program)
+  — the DDLB132 input. Concrete ``fori_loop`` bounds and concrete
+  ``pl.when`` predicates make the counts path-exact for the ring
+  kernels;
+- **remote-DMA wire**: every ``make_async_remote_copy(...).start()``
+  records a ``remote_copy`` trace entry sized from its source Ref, so a
+  kernel ring exports the same per-hop schedule a ``shard_map`` ring
+  does — the DDLB123 de-opaquing and the simulator's pallas frontend;
+- **MXU tiles**: every dot over Ref-backed tiles, for the census dump.
+
+Everything here is source-level: no JAX import, same contract as the
+rest of the analysis tier.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ddlb_tpu.analysis.spmd import interp as interp_mod
+from ddlb_tpu.analysis.spmd.interp import _MISSING, Frame, FuncVal, PartialVal
+from ddlb_tpu.analysis.spmd.trace import (
+    ITEMSIZE,
+    UNKNOWN,
+    Arr,
+    ModVal,
+    UnionVal,
+    taint_of,
+)
+
+#: sublane granule of the second-to-last dim per dtype; the last dim's
+#: lane granule is always 128 (pallas_guide.md "Tiling Constraints")
+SUBLANE = {
+    "float32": 8,
+    "float64": 8,
+    "int32": 8,
+    "int64": 8,
+    "bfloat16": 16,
+    "float16": 16,
+    "int8": 32,
+    "bool": 32,
+}
+LANE = 128
+
+
+def _prod(dims) -> Optional[int]:
+    total = 1
+    for d in dims:
+        if not isinstance(d, int):
+            return None
+        total *= d
+    return total
+
+
+def _nbytes(shape, dtype) -> Optional[float]:
+    n = _prod(shape) if shape is not None else None
+    isz = ITEMSIZE.get(dtype or "", None)
+    if n is None or isz is None:
+        return None
+    return float(n * isz)
+
+
+class VmemItem:
+    """One VMEM-resident allocation in a kernel's working set."""
+
+    __slots__ = ("label", "shape", "dtype", "mult", "origin")
+
+    def __init__(self, label, shape, dtype, mult, origin) -> None:
+        self.label = label
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.mult = mult  # 1 resident, 2 double-buffered pipeline block
+        self.origin = origin  # "block" | "scratch" | "pipeline"
+
+    def nbytes(self) -> Optional[float]:
+        base = _nbytes(self.shape, self.dtype)
+        return None if base is None else base * self.mult
+
+    def describe(self) -> str:
+        dims = (
+            "?" if self.shape is None
+            else "x".join(str(d) for d in self.shape)
+        )
+        size = self.nbytes()
+        size_s = "?" if size is None else f"{size / (1 << 20):.2f} MiB"
+        return (
+            f"{self.label:24s} [{dims}] {self.dtype or '?'} "
+            f"x{self.mult} ({self.origin}) = {size_s}"
+        )
+
+
+class BlockRecord:
+    """One BlockSpec binding: block shape vs the operand it tiles."""
+
+    __slots__ = (
+        "label", "block_shape", "operand_shape", "dtype", "space", "line",
+    )
+
+    def __init__(
+        self, label, block_shape, operand_shape, dtype, space, line
+    ) -> None:
+        self.label = label
+        self.block_shape = (
+            tuple(block_shape) if block_shape is not None else None
+        )
+        self.operand_shape = (
+            tuple(operand_shape) if operand_shape is not None else None
+        )
+        self.dtype = dtype
+        self.space = space
+        self.line = line
+
+
+class KernelCensus:
+    """Everything the DDLB130-133 rules need about ONE ``pallas_call``."""
+
+    def __init__(self, name: str, rel: str, line: int) -> None:
+        self.name = name
+        self.rel = rel
+        self.line = line
+        self.grid: Optional[Tuple] = None
+        self.grid_steps: Optional[int] = 1
+        self.vmem_items: List[VmemItem] = []
+        #: peak over inner emit_pipeline invocations (scoped: max not sum)
+        self.pipeline_bytes = 0.0
+        self.blocks: List[BlockRecord] = []
+        #: sem name -> {"kind", "starts", "waits", "unknown"}
+        self.sems: Dict[str, Dict[str, Any]] = {}
+        self.remote_hops = 0
+        self.remote_bytes = 0.0
+        self.local_dma_bytes = 0.0
+        self.mxu_tiles: List[Tuple] = []
+        self.notes: List[str] = []
+        #: set when the kernel body did not interpret to completion —
+        #: the census may UNDERCOUNT (missed run_scoped allocations,
+        #: missed DMA events), so the budget rule must fail it rather
+        #: than pass a partially-modeled kernel
+        self.incomplete: Optional[str] = None
+
+    def sem(self, name: str, kind: str) -> Dict[str, Any]:
+        return self.sems.setdefault(
+            name, {"kind": kind, "starts": 0, "waits": 0, "unknown": False}
+        )
+
+    def vmem_bytes(self) -> Optional[float]:
+        """Total resident working set; None when any item is unsizeable
+        (the budget rule reports the unresolved census instead of a
+        silently-low number)."""
+        total = self.pipeline_bytes
+        for item in self.vmem_items:
+            size = item.nbytes()
+            if size is None:
+                return None
+            total += size
+        return total
+
+    def unbalanced_sems(self) -> List[Tuple[str, Dict[str, Any]]]:
+        out = []
+        for name, rec in sorted(self.sems.items()):
+            if rec["unknown"]:
+                continue
+            if rec["starts"] != rec["waits"]:
+                out.append((name, rec))
+        return out
+
+    def describe(self) -> List[str]:
+        grid = self.grid if self.grid is not None else "-"
+        total = self.vmem_bytes()
+        total_s = "?" if total is None else f"{total / (1 << 20):.2f} MiB"
+        lines = [
+            f"{self.rel}:{self.line} kernel={self.name} grid={grid} "
+            f"vmem={total_s} remote_hops={self.remote_hops} "
+            f"remote_bytes={self.remote_bytes:.0f}"
+        ]
+        for item in self.vmem_items:
+            lines.append("  vmem  " + item.describe())
+        if self.pipeline_bytes:
+            lines.append(
+                f"  vmem  inner-pipeline peak = "
+                f"{self.pipeline_bytes / (1 << 20):.2f} MiB"
+            )
+        for name, rec in sorted(self.sems.items()):
+            bal = rec["starts"] - rec["waits"]
+            flag = "?" if rec["unknown"] else (
+                "ok" if bal == 0 else f"UNBALANCED {bal:+d}"
+            )
+            lines.append(
+                f"  sem   {name:24s} {rec['kind']:8s} "
+                f"starts={rec['starts']} waits={rec['waits']} {flag}"
+            )
+        for tile in sorted(set(self.mxu_tiles)):
+            m, k, n, dt = tile
+            lines.append(f"  mxu   {m}x{k} @ {k}x{n} {dt}")
+        for note in self.notes:
+            lines.append(f"  note  {note}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# model values (the ddlb_attr / ddlb_subscript protocol of spmd.interp)
+# ---------------------------------------------------------------------------
+
+
+class DSVal:
+    """``pl.ds(start, size)`` — a dynamic slice of known length."""
+
+    __slots__ = ("start", "size")
+
+    def __init__(self, start, size) -> None:
+        self.start = start
+        self.size = size
+
+
+def _translate_idx(idx) -> Any:
+    """Map DSVal items to plain slices so ``Interpreter.index_arr`` can
+    size the result; everything else passes through."""
+
+    def one(it):
+        if isinstance(it, DSVal):
+            if isinstance(it.size, int):
+                return slice(0, it.size)
+            return slice(None)
+        return it
+
+    if isinstance(idx, tuple):
+        return tuple(one(i) for i in idx)
+    return one(idx)
+
+
+class RefVal:
+    """A kernel Ref: shape/dtype plus the memory space it lives in."""
+
+    __slots__ = ("shape", "dtype", "space", "name", "kind")
+
+    def __init__(self, shape, dtype, space, name="", kind="in") -> None:
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.space = space  # "vmem" | "any" | "smem"
+        self.name = name
+        self.kind = kind  # "in" | "out" | "scratch" | "prefetch"
+
+    def arr(self) -> Arr:
+        return Arr(self.shape, self.dtype)
+
+    def ddlb_attr(self, attr, interp, node):
+        if attr == "shape":
+            return self.shape if self.shape is not None else UNKNOWN
+        if attr == "dtype":
+            return self.dtype or UNKNOWN
+        if attr == "ndim":
+            return len(self.shape) if self.shape is not None else UNKNOWN
+        if attr == "at":
+            return _RefAt(self)
+        return UNKNOWN
+
+    def ddlb_subscript(self, idx, interp, node):
+        # a Ref READ produces a symbolic array of the indexed shape
+        return interp.index_arr(self.arr(), _translate_idx(idx))
+
+    def sliced(self, idx, interp) -> "RefVal":
+        out = interp.index_arr(self.arr(), _translate_idx(idx))
+        return RefVal(out.shape, self.dtype, self.space, self.name,
+                      self.kind)
+
+
+class _RefAt:
+    """``ref.at[...]`` — a sub-Ref view (still a Ref, still DMA-able)."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref: RefVal) -> None:
+        self.ref = ref
+
+    def ddlb_subscript(self, idx, interp, node):
+        return self.ref.sliced(idx, interp)
+
+
+class SemVal:
+    """One kernel semaphore (or semaphore array — slots collapse to one
+    identity for balance accounting, which keeps counts exact even when
+    the slot index is symbolic)."""
+
+    __slots__ = ("name", "kind", "census")
+
+    def __init__(self, name, kind, census) -> None:
+        self.name = name
+        self.kind = kind  # "dma" | "regular" | "barrier"
+        self.census = census
+
+    def ddlb_attr(self, attr, interp, node):
+        if attr == "at":
+            return _SemAt(self)
+        return UNKNOWN
+
+
+class _SemAt:
+    __slots__ = ("sem",)
+
+    def __init__(self, sem: SemVal) -> None:
+        self.sem = sem
+
+    def ddlb_subscript(self, idx, interp, node):
+        return self.sem
+
+
+class DmaVal:
+    """A ``make_async_copy`` / ``make_async_remote_copy`` handle.
+
+    ``start()`` records the transfer (a sized ``remote_copy`` trace
+    entry for RDMA — the wire the rings move) and increments the DMA
+    semaphores; ``wait()`` decrements them. The wait-only idiom
+    (``make_async_copy(x, x, sem).wait()``) therefore decrements without
+    a matching local start, exactly as the hardware semantics pair a
+    wait against SOME earlier start on that semaphore.
+    """
+
+    __slots__ = ("model", "src", "dst", "sems", "remote", "node")
+
+    def __init__(self, model, src, dst, sems, remote, node) -> None:
+        self.model = model
+        self.src = src
+        self.dst = dst
+        self.sems = [s for s in sems if isinstance(s, SemVal)]
+        self.remote = remote
+        self.node = node
+
+    def ddlb_attr(self, attr, interp, node):
+        if attr == "start":
+            return self._start
+        if attr == "wait":
+            return self._wait
+        return UNKNOWN
+
+    def _payload(self) -> Optional[Arr]:
+        if isinstance(self.src, RefVal):
+            return self.src.arr()
+        if isinstance(self.src, Arr):
+            return self.src
+        return None
+
+    def _start(self, args, kwargs, node, interp):
+        census = self.model.current()
+        payload = self._payload()
+        nbytes = payload.nbytes() if payload is not None else None
+        if self.remote:
+            interp.tracer.record(
+                "remote_copy", (), self.node, payload=payload
+            )
+            if census is not None:
+                census.remote_hops += 1
+                if nbytes is not None:
+                    census.remote_bytes += nbytes
+                else:
+                    census.notes.append(
+                        "remote copy payload would not size"
+                    )
+        elif census is not None:
+            if nbytes is not None:
+                census.local_dma_bytes += nbytes
+        for sem in self.sems:
+            self.model.sem_event(sem, +1)
+        return None
+
+    def _wait(self, args, kwargs, node, interp):
+        for sem in self.sems:
+            self.model.sem_event(sem, -1)
+        return None
+
+
+class WhenVal:
+    """``pl.when(cond)`` — execute-or-skip at interpretation time: a
+    concrete False predicate skips the body (path-exact ring protocol
+    counting), anything else interprets it once under an ``if`` frame."""
+
+    __slots__ = ("model", "cond", "line")
+
+    def __init__(self, model, cond, line) -> None:
+        self.model = model
+        self.cond = cond
+        self.line = line
+
+    def __call__(self, args, kwargs, node, interp):
+        fn = args[0] if args else None
+        if fn is None:
+            return None
+        cond = self.cond
+        if isinstance(cond, (bool, int, float)) and not cond:
+            return None
+        concrete = isinstance(cond, (bool, int, float))
+        if concrete:
+            interp.call_value(fn, [], {}, node)
+            return None
+        frame = Frame(
+            "if", "pl.when", tainted=taint_of(cond), line=self.line
+        )
+        interp.tracer.push_frame(frame)
+        try:
+            interp.call_value(fn, [], {}, node)
+        finally:
+            interp.tracer.pop_frame()
+        return None
+
+
+class BlockSpecVal:
+    """``pl.BlockSpec`` literal: block shape, index map, memory space."""
+
+    __slots__ = ("block_shape", "index_map", "space")
+
+    def __init__(self, block_shape, index_map, space) -> None:
+        self.block_shape = block_shape
+        self.index_map = index_map
+        self.space = space  # "vmem" | "any" | "smem" | None (default)
+
+
+class ScratchVal:
+    """``pltpu.VMEM(shape, dtype)`` / ``pltpu.SMEM(...)`` allocation."""
+
+    __slots__ = ("shape", "dtype", "space")
+
+    def __init__(self, shape, dtype, space) -> None:
+        self.shape = shape
+        self.dtype = dtype
+        self.space = space
+
+
+class SemSpecVal:
+    """``pltpu.SemaphoreType.DMA((2,))`` etc. (bare names arrive as
+    ``ModVal`` and are resolved by ``_scratch_to_ref``)."""
+
+    __slots__ = ("kind", "slots")
+
+    def __init__(self, kind, slots=None) -> None:
+        self.kind = kind
+        self.slots = slots
+
+
+class GridSpecVal:
+    """``pltpu.PrefetchScalarGridSpec`` / ``pl.GridSpec`` literal."""
+
+    __slots__ = (
+        "num_scalar_prefetch", "grid", "in_specs", "out_specs", "scratch",
+    )
+
+    def __init__(
+        self, num_scalar_prefetch, grid, in_specs, out_specs, scratch
+    ) -> None:
+        self.num_scalar_prefetch = num_scalar_prefetch
+        self.grid = grid
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.scratch = scratch
+
+
+class EmitPipelineVal:
+    """``pltpu.emit_pipeline(body, grid=..., in_specs=..., out_specs=...)``
+    — on call, charges the tile set (x2: the inner pipeline double
+    buffers its blocks) against the enclosing census's pipeline peak and
+    interprets the body once over tile Refs."""
+
+    __slots__ = ("model", "body", "grid", "in_specs", "out_specs")
+
+    def __init__(self, model, body, grid, in_specs, out_specs) -> None:
+        self.model = model
+        self.body = body
+        self.grid = grid
+        self.in_specs = list(in_specs or [])
+        self.out_specs = list(out_specs or [])
+
+    def __call__(self, args, kwargs, node, interp):
+        census = self.model.current()
+        specs = self.in_specs + self.out_specs
+        operands = list(args)
+        tiles: List[RefVal] = []
+        total = 0.0
+        sizeable = True
+        for i, spec in enumerate(specs):
+            operand = operands[i] if i < len(operands) else UNKNOWN
+            dtype = None
+            oshape = None
+            if isinstance(operand, RefVal):
+                dtype, oshape = operand.dtype, operand.shape
+            elif isinstance(operand, Arr):
+                dtype, oshape = operand.dtype, operand.shape
+            block = (
+                spec.block_shape if isinstance(spec, BlockSpecVal) else None
+            )
+            tiles.append(RefVal(block, dtype, "vmem", kind="in"))
+            if census is not None:
+                census.blocks.append(
+                    BlockRecord(
+                        f"{self._label(node)}#{i}", block, oshape, dtype,
+                        "vmem", getattr(node, "lineno", 0),
+                    )
+                )
+            size = _nbytes(block, dtype)
+            if size is None:
+                sizeable = False
+            else:
+                total += 2.0 * size
+        if census is not None:
+            if sizeable:
+                census.pipeline_bytes = max(census.pipeline_bytes, total)
+            else:
+                census.notes.append(
+                    "emit_pipeline tile set would not size"
+                )
+        frame = Frame("loop", "emit_pipeline",
+                      line=getattr(node, "lineno", 0))
+        interp.tracer.push_frame(frame)
+        try:
+            interp.call_value(self.body, tiles, {}, node)
+        finally:
+            interp.tracer.pop_frame()
+        return None
+
+    @staticmethod
+    def _label(node) -> str:
+        return f"emit_pipeline@{getattr(node, 'lineno', 0)}"
+
+
+# ---------------------------------------------------------------------------
+# pallas_call modeling
+# ---------------------------------------------------------------------------
+
+
+def _space_name(value, default="vmem") -> str:
+    """Resolve a memory_space operand: ``pltpu.VMEM``/``ANY``/``SMEM``
+    ModVals, or a UnionVal from ``vmem if interpret else any`` — the
+    hardware (ANY) branch wins, because the census models the real-chip
+    path, not the interpreter's park-everything-in-VMEM emulation."""
+    if isinstance(value, UnionVal):
+        names = [_space_name(o, default="") for o in value.options]
+        if "any" in names:
+            return "any"
+        for n in names:
+            if n:
+                return n
+        return default
+    if isinstance(value, ModVal):
+        tail = value.path.rsplit(".", 1)[-1].lower()
+        if tail in ("vmem", "any", "smem", "hbm"):
+            return "any" if tail == "hbm" else tail
+    return default
+
+
+def _as_seq(value) -> List[Any]:
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    if value is None:
+        return []
+    return [value]
+
+
+class PallasCallVal:
+    """The value ``pl.pallas_call(kernel, ...)`` evaluates to: calling
+    it with operands builds a ``KernelCensus``, interprets the kernel
+    body over Refs, and returns the declared ``out_shape`` arrays."""
+
+    __slots__ = (
+        "model", "kernel", "out_shape", "grid", "in_specs", "out_specs",
+        "scratch", "num_prefetch", "node",
+    )
+
+    def __init__(
+        self, model, kernel, out_shape, grid, in_specs, out_specs,
+        scratch, num_prefetch, node,
+    ) -> None:
+        self.model = model
+        self.kernel = kernel
+        self.out_shape = out_shape
+        self.grid = grid
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.scratch = scratch
+        self.num_prefetch = num_prefetch
+        self.node = node
+
+    # -- kernel identity ----------------------------------------------------
+
+    def _kernel_fn(self) -> Optional[FuncVal]:
+        fn = self.kernel
+        while isinstance(fn, PartialVal):
+            fn = fn.fn
+        return fn if isinstance(fn, FuncVal) else None
+
+    def _site(self, interp) -> Tuple[str, int]:
+        line = getattr(self.node, "lineno", 0)
+        for fv in reversed(interp._fn_stack):
+            if fv.path:
+                return fv.path, line
+        return interp.tracer.rel, line
+
+    # -- ref construction ---------------------------------------------------
+
+    def _block_ref(
+        self, census, operand, spec, kind, label, pipelined
+    ) -> RefVal:
+        dtype = operand.dtype if isinstance(operand, Arr) else None
+        oshape = operand.shape if isinstance(operand, Arr) else None
+        block = None
+        space = "vmem"
+        if isinstance(spec, BlockSpecVal):
+            block = spec.block_shape
+            space = spec.space or "vmem"
+        if block is None:
+            shape = oshape
+            mult = 1
+        else:
+            shape = tuple(block)
+            mult = 2 if pipelined else 1
+        census.blocks.append(
+            BlockRecord(label, block, oshape, dtype, space, census.line)
+        )
+        if space == "vmem":
+            if shape is None or dtype is None:
+                census.notes.append(
+                    f"{label}: operand/block would not size"
+                )
+            census.vmem_items.append(
+                VmemItem(label, shape, dtype, mult, "block")
+            )
+        return RefVal(shape, dtype, space, kind=kind)
+
+    def _scratch_to_ref(self, census, alloc, index) -> Any:
+        label = f"scratch[{index}]"
+        if isinstance(alloc, ScratchVal):
+            if alloc.space == "vmem":
+                census.vmem_items.append(
+                    VmemItem(label, alloc.shape, alloc.dtype, 1, "scratch")
+                )
+            return RefVal(
+                alloc.shape, alloc.dtype, alloc.space, name=label,
+                kind="scratch",
+            )
+        if isinstance(alloc, SemSpecVal):
+            return SemVal(label, alloc.kind, census)
+        if isinstance(alloc, ModVal) and "SemaphoreType" in alloc.path:
+            kind = alloc.path.rsplit(".", 1)[-1].lower()
+            return SemVal(label, kind, census)
+        census.notes.append(f"{label}: unmodeled scratch allocation")
+        return UNKNOWN
+
+    # -- the call -----------------------------------------------------------
+
+    def __call__(self, args, kwargs, node, interp):
+        rel, line = self._site(interp)
+        kfn = self._kernel_fn()
+        census = KernelCensus(
+            kfn.name if kfn is not None else "<kernel>", rel, line
+        )
+        self.model.censuses.append(census)
+        census.grid = (
+            tuple(self.grid) if isinstance(self.grid, (tuple, list))
+            else None
+        )
+        census.grid_steps = (
+            _prod(census.grid) if census.grid is not None else 1
+        )
+        pipelined = census.grid is not None
+
+        operands = list(args)
+        refs: List[Any] = []
+        n_pre = self.num_prefetch or 0
+        for i in range(min(n_pre, len(operands))):
+            op = operands[i]
+            shape = op.shape if isinstance(op, Arr) else None
+            dtype = op.dtype if isinstance(op, Arr) else "int32"
+            refs.append(
+                RefVal(shape, dtype, "smem", kind="prefetch")
+            )
+        ins = operands[n_pre:]
+        in_specs = _as_seq(self.in_specs)
+        for i, op in enumerate(ins):
+            spec = in_specs[i] if i < len(in_specs) else None
+            refs.append(
+                self._block_ref(
+                    census, op, spec, "in", f"in[{i}]", pipelined
+                )
+            )
+        outs = _as_seq(self.out_shape)
+        out_specs = _as_seq(self.out_specs)
+        for i, out in enumerate(outs):
+            spec = out_specs[i] if i < len(out_specs) else None
+            refs.append(
+                self._block_ref(
+                    census, out, spec, "out", f"out[{i}]", pipelined
+                )
+            )
+        for i, alloc in enumerate(_as_seq(self.scratch)):
+            refs.append(self._scratch_to_ref(census, alloc, i))
+
+        # name refs after the kernel's own parameters (readable censuses
+        # and sem findings: "send_sem", not "scratch[0]")
+        if kfn is not None:
+            params = kfn.node.args
+            names = [a.arg for a in params.posonlyargs + params.args]
+            for name, ref in zip(names, refs):
+                if isinstance(ref, (RefVal, SemVal)):
+                    ref.name = name
+
+        self.model.stack.append(census)
+        try:
+            if self.kernel is None or (
+                kfn is None and not callable(self.kernel)
+            ):
+                census.incomplete = "kernel did not resolve statically"
+            else:
+                interp.call_value(self.kernel, refs, {}, self.node)
+        except interp_mod._Abort:
+            census.incomplete = "interpretation budget exhausted"
+        except Exception as exc:  # pragma: no cover - defensive
+            census.incomplete = (
+                f"kernel body failed: {type(exc).__name__}"
+            )
+        finally:
+            self.model.stack.pop()
+        if census.incomplete is not None:
+            census.notes.append(census.incomplete)
+
+        if isinstance(self.out_shape, (tuple, list)):
+            return tuple(
+                o if isinstance(o, Arr) else UNKNOWN
+                for o in self.out_shape
+            )
+        return (
+            self.out_shape
+            if isinstance(self.out_shape, Arr)
+            else UNKNOWN
+        )
+
+
+# ---------------------------------------------------------------------------
+# the model: dispatch + accounting
+# ---------------------------------------------------------------------------
+
+
+class PallasModel:
+    """Per-run pallas state: the census list and the pl/pltpu handlers
+    the interpreter consults (``Interpreter(pallas_model=...)``)."""
+
+    def __init__(self) -> None:
+        self.censuses: List[KernelCensus] = []
+        self.stack: List[KernelCensus] = []
+
+    def current(self) -> Optional[KernelCensus]:
+        return self.stack[-1] if self.stack else None
+
+    def sem_event(self, sem: SemVal, delta) -> None:
+        census = sem.census or self.current()
+        if census is None:
+            return
+        rec = census.sem(sem.name or "<sem>", sem.kind)
+        if not isinstance(delta, int):
+            rec["unknown"] = True
+            return
+        if delta > 0:
+            rec["starts"] += delta
+        else:
+            rec["waits"] += -delta
+
+    def note_dot(self, a, b) -> None:
+        census = self.current()
+        if census is None:
+            return
+        sa = a.shape if isinstance(a, Arr) else None
+        sb = b.shape if isinstance(b, Arr) else None
+        if (
+            sa is not None and sb is not None
+            and len(sa) >= 2 and len(sb) >= 2
+        ):
+            census.mxu_tiles.append(
+                (sa[-2], sa[-1], sb[-1],
+                 a.dtype if isinstance(a, Arr) else None)
+            )
+
+    # -- the pl/pltpu call surface ------------------------------------------
+
+    def dispatch(self, path, tail, args, kwargs, node, interp):
+        """Handle one dotted call; ``_MISSING`` means "not mine"."""
+        if "pallas" not in path:
+            return _MISSING
+        if tail == "pallas_call":
+            return self._pallas_call(args, kwargs, node)
+        if tail == "BlockSpec":
+            block = args[0] if args else kwargs.get("block_shape")
+            index_map = (
+                args[1] if len(args) > 1 else kwargs.get("index_map")
+            )
+            block = tuple(block) if isinstance(block, (tuple, list)) else None
+            space = kwargs.get("memory_space")
+            return BlockSpecVal(
+                block, index_map,
+                None if space is None else _space_name(space),
+            )
+        if tail in ("PrefetchScalarGridSpec", "GridSpec"):
+            grid = kwargs.get("grid", args[0] if args else None)
+            return GridSpecVal(
+                kwargs.get("num_scalar_prefetch", 0) or 0,
+                tuple(grid) if isinstance(grid, (tuple, list)) else None,
+                _as_seq(kwargs.get("in_specs")),
+                _as_seq(kwargs.get("out_specs")),
+                _as_seq(kwargs.get("scratch_shapes")),
+            )
+        if tail in ("VMEM", "SMEM"):
+            shape = args[0] if args else kwargs.get("shape")
+            dtype = interp_mod._as_dtype(
+                args[1] if len(args) > 1 else kwargs.get("dtype")
+            )
+            shape = (
+                tuple(shape) if isinstance(shape, (tuple, list)) else None
+            )
+            return ScratchVal(shape, dtype, tail.lower())
+        if tail in ("DMA", "REGULAR", "BARRIER") and "SemaphoreType" in path:
+            return SemSpecVal(tail.lower(), args[0] if args else None)
+        if tail == "make_async_copy":
+            src = args[0] if args else kwargs.get("src_ref")
+            dst = args[1] if len(args) > 1 else kwargs.get("dst_ref")
+            sem = args[2] if len(args) > 2 else kwargs.get("sem")
+            return DmaVal(self, src, dst, [sem], remote=False, node=node)
+        if tail == "make_async_remote_copy":
+            src = args[0] if args else kwargs.get("src_ref")
+            send = kwargs.get("send_sem")
+            recv = kwargs.get("recv_sem")
+            # symmetric SPMD model: our send increments our send_sem,
+            # and our recv_sem is incremented by the neighbor running
+            # the same program — both count as this device's starts
+            return DmaVal(
+                self, src, kwargs.get("dst_ref"), [send, recv],
+                remote=True, node=node,
+            )
+        if tail == "get_barrier_semaphore":
+            census = self.current()
+            return SemVal("<barrier>", "barrier", census)
+        if tail == "semaphore_signal":
+            sem = args[0] if args else kwargs.get("sem")
+            inc = kwargs.get("inc", args[1] if len(args) > 1 else 1)
+            if isinstance(sem, SemVal):
+                self.sem_event(sem, inc)
+            return None
+        if tail == "semaphore_wait":
+            sem = args[0] if args else kwargs.get("sem")
+            dec = args[1] if len(args) > 1 else kwargs.get(
+                "decrement", 1
+            )
+            if isinstance(sem, SemVal):
+                self.sem_event(sem, -dec if isinstance(dec, int) else dec)
+            return None
+        if tail == "emit_pipeline":
+            return EmitPipelineVal(
+                self,
+                args[0] if args else None,
+                kwargs.get("grid"),
+                _as_seq(kwargs.get("in_specs")),
+                _as_seq(kwargs.get("out_specs")),
+            )
+        if tail == "run_scoped":
+            return self._run_scoped(args, kwargs, node, interp)
+        if tail == "when":
+            return WhenVal(
+                self, args[0] if args else UNKNOWN,
+                getattr(node, "lineno", 0),
+            )
+        if tail == "program_id":
+            return Arr((), "int32")
+        if tail == "num_programs":
+            census = self.current()
+            axis = args[0] if args else None
+            if (
+                census is not None
+                and census.grid is not None
+                and isinstance(axis, int)
+                and axis < len(census.grid)
+                and isinstance(census.grid[axis], int)
+            ):
+                return census.grid[axis]
+            return UNKNOWN
+        if tail in ("ds", "dslice"):
+            start = args[0] if args else None
+            size = args[1] if len(args) > 1 else kwargs.get("size")
+            return DSVal(start, size)
+        if tail == "with_memory_space_constraint":
+            return args[0] if args else UNKNOWN
+        if tail in (
+            "CompilerParams", "TPUCompilerParams", "CostEstimate",
+            "InterpretParams",
+        ):
+            return UNKNOWN
+        # pl.cdiv falls through to the interpreter's generic
+        # concrete-int rem/cdiv handler (one ceiling-division source)
+        return _MISSING
+
+    def _pallas_call(self, args, kwargs, node) -> PallasCallVal:
+        kernel = args[0] if args else kwargs.get("kernel")
+        grid_spec = kwargs.get("grid_spec")
+        grid = kwargs.get("grid")
+        in_specs = kwargs.get("in_specs")
+        out_specs = kwargs.get("out_specs")
+        scratch = kwargs.get("scratch_shapes")
+        num_prefetch = 0
+        if isinstance(grid_spec, GridSpecVal):
+            grid = grid_spec.grid
+            in_specs = grid_spec.in_specs
+            out_specs = grid_spec.out_specs
+            scratch = grid_spec.scratch
+            num_prefetch = grid_spec.num_scalar_prefetch
+        return PallasCallVal(
+            self, kernel, kwargs.get("out_shape"), grid, in_specs,
+            out_specs, scratch, num_prefetch, node,
+        )
+
+    def _run_scoped(self, args, kwargs, node, interp):
+        """``pltpu.run_scoped(body, *allocs)``: allocate, run, free —
+        the allocations join the census working set (they are live for
+        the body's whole extent) and the body interprets over them."""
+        census = self.current()
+        body = args[0] if args else None
+        refs: List[Any] = []
+        for i, alloc in enumerate(list(args[1:]) + sorted(
+            kwargs.items()
+        )):
+            name = f"run_scoped[{i}]"
+            if isinstance(alloc, tuple) and len(alloc) == 2:
+                name, alloc = f"run_scoped[{alloc[0]}]", alloc[1]
+            if isinstance(alloc, ScratchVal):
+                if census is not None and alloc.space == "vmem":
+                    census.vmem_items.append(
+                        VmemItem(name, alloc.shape, alloc.dtype, 1,
+                                 "scratch")
+                    )
+                refs.append(
+                    RefVal(alloc.shape, alloc.dtype, alloc.space,
+                           name=name, kind="scratch")
+                )
+            elif isinstance(alloc, (SemSpecVal, ModVal)):
+                kind = (
+                    alloc.kind if isinstance(alloc, SemSpecVal)
+                    else alloc.path.rsplit(".", 1)[-1].lower()
+                )
+                refs.append(SemVal(name, kind, census))
+            else:
+                refs.append(UNKNOWN)
+        if body is None:
+            return UNKNOWN
+        return interp.call_value(body, refs, {}, node)

@@ -1,0 +1,424 @@
+"""The DDLB13x Pallas kernel rules — the kernel-resource battery.
+
+Where DDLB120-123 read the collective traces of ``shard_map`` bodies,
+these read the per-``pallas_call`` resource censuses the kernel model
+extracts (``analysis.pallas.model`` driven by ``analysis.pallas.census``
+at canonical sweep shapes):
+
+- **DDLB130 vmem-over-budget**: a kernel's resident VMEM working set
+  (pipelined blocks x2, scratch, inner-pipeline peak) exceeds a
+  registered chip's ``vmem_bytes`` (``perfmodel/specs.py``) — on
+  hardware this is a Mosaic allocation failure at compile time, found
+  today only by booking the chip. The rule also closes coverage: a
+  ``pallas_call`` site no census reaches, a kernel spec that failed to
+  drive, and a census that would not size are all findings, so a new
+  kernel cannot land unmodeled.
+- **DDLB131 tile-misalignment**: a VMEM block whose last dim exceeds
+  the 128 lane and is not a multiple of it, or whose second-to-last dim
+  exceeds the dtype sublane granule ((8,128)/f32, (16,128)/bf16,
+  (32,128)/int8) without dividing it — Mosaic inserts relayouts and the
+  MXU runs partially masked, the silent perf-cliff class. Dims at or
+  under the granule pad (legal, deliberate: ``[bq, 1]`` flash
+  accumulators), so only true misalignment fires.
+- **DDLB132 dma-semaphore-leak**: per-semaphore DMA start/wait balance
+  over the interpreted kernel (concrete ring trip counts, concrete
+  ``pl.when`` predicates): a start that never meets a wait wedges the
+  NEXT kernel invocation on a dirty semaphore — the cross-invocation
+  cousin of the flight recorder's in-flight hang.
+- **DDLB133 grid-block-mismatch**: a block shape that does not divide
+  the operand it tiles under canonical shapes — Pallas pads the tail
+  block and the kernel reads unmasked garbage, the
+  wrong-answer-without-an-error class.
+- **DDLB134 direct-compiler-params** (style, per file): a direct
+  ``pltpu.CompilerParams`` / ``TPUCompilerParams`` reference outside
+  ``ops/pallas_compat.py`` — the jax-0.4.x rename bridge PR 9
+  installed; one un-bridged reference breaks every interpret-mode test
+  on the 0.4.x fleet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable, List, Sequence, Set, Tuple
+
+from ddlb_tpu.analysis.core import FileContext, Finding, ProjectRule, Rule
+from ddlb_tpu.analysis.pallas.model import LANE, SUBLANE, KernelCensus
+
+#: the subtrees whose presence in a sweep turns the project rules on
+#: (same contract as the DDLB12x semantic scope)
+_KERNEL_DIRS = ("ops", "primitives")
+
+_CENSUS_REL = "ddlb_tpu/analysis/pallas/census.py"
+
+
+def _in_kernel_scope(ctx: FileContext) -> bool:
+    return ctx.in_package() and any(d in ctx.parts for d in _KERNEL_DIRS)
+
+
+def _line_of(rel: str, line: int) -> str:
+    from ddlb_tpu.analysis.core import repo_root
+
+    try:
+        lines = (repo_root() / rel).read_text(
+            encoding="utf-8"
+        ).splitlines()
+        return lines[line - 1].strip() if 1 <= line <= len(lines) else ""
+    except OSError:
+        return ""
+
+
+class _CensusRule(ProjectRule):
+    """Shared plumbing: run (or receive) the census sweep, emit
+    findings via ``findings_from`` (fixture tests drive that directly,
+    mirroring the DDLB123 pattern)."""
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        if not any(_in_kernel_scope(ctx) for ctx in contexts):
+            return []
+        from ddlb_tpu.analysis.pallas import census as census_mod
+
+        try:
+            run = census_mod.shared_run()
+        except Exception as exc:
+            return [
+                Finding(
+                    self.id, _CENSUS_REL, 1, 1,
+                    f"pallas census failed to run: "
+                    f"{type(exc).__name__}: {exc}",
+                )
+            ]
+        return self.findings_from(run, contexts)
+
+    def findings_from(
+        self, run: Any, contexts: Sequence[FileContext] = ()
+    ) -> List[Finding]:
+        raise NotImplementedError
+
+    def census_finding(
+        self, census: KernelCensus, message: str
+    ) -> Finding:
+        return Finding(
+            self.id, census.rel, census.line or 1, 1, message,
+            severity=self.severity,
+            snippet=_line_of(census.rel, census.line or 1),
+        )
+
+
+class VmemBudgetRule(_CensusRule):
+    """Kernel working set vs every registered chip's VMEM capacity."""
+
+    id = "DDLB130"
+    name = "vmem-over-budget"
+    rationale = (
+        "a kernel whose resident blocks + scratch exceed a chip's VMEM "
+        "fails Mosaic allocation only on real hardware; the census "
+        "catches it at analyze time, and its coverage check keeps every "
+        "pallas_call site modeled"
+    )
+
+    def findings_from(
+        self, run: Any, contexts: Sequence[FileContext] = ()
+    ) -> List[Finding]:
+        from ddlb_tpu.analysis.pallas.census import pallas_call_sites
+        from ddlb_tpu.perfmodel.specs import CHIP_SPECS
+
+        out: List[Finding] = []
+        for label, reason in run.errors:
+            out.append(
+                Finding(
+                    self.id, _CENSUS_REL, 1, 1,
+                    f"kernel spec {label!r} failed to drive: {reason} — "
+                    f"its pallas_call sites are unmodeled",
+                )
+            )
+        covered: Set[Tuple[str, int]] = set()
+        for census in run.censuses:
+            covered.add((census.rel, census.line))
+            if census.incomplete is not None:
+                # a partially-interpreted body may have missed
+                # run_scoped allocations and DMA events entirely — a
+                # green gate over an undercounted census would be a lie
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name}: body did not interpret "
+                        f"to completion ({census.incomplete}) — the "
+                        f"census may undercount; simplify the kernel "
+                        f"or extend the model before relying on "
+                        f"DDLB130-133 here",
+                    )
+                )
+                continue
+            total = census.vmem_bytes()
+            if total is None:
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name}: VMEM working set would "
+                        f"not size statically "
+                        f"({'; '.join(census.notes) or 'unknown'}) — "
+                        f"the budget check cannot run",
+                    )
+                )
+                continue
+            over = [
+                (spec.name, spec.vmem_bytes)
+                for spec in CHIP_SPECS.values()
+                if total > spec.vmem_bytes
+            ]
+            if over:
+                chips = ", ".join(
+                    f"{name} ({cap / (1 << 20):.0f} MiB)"
+                    for name, cap in sorted(over)
+                )
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name}: VMEM working set "
+                        f"{total / (1 << 20):.2f} MiB exceeds {chips} "
+                        f"at canonical sweep shapes — shrink the blocks "
+                        f"or gate the config per chip",
+                    )
+                )
+        for rel, line in pallas_call_sites(contexts):
+            if (rel, line) not in covered:
+                out.append(
+                    Finding(
+                        self.id, rel, line, 1,
+                        "pallas_call site reached by no kernel census — "
+                        "register a KernelSpec in "
+                        "analysis/pallas/census.py so DDLB130-133 can "
+                        "model it",
+                        snippet=_line_of(rel, line),
+                    )
+                )
+        return out
+
+
+class TileAlignmentRule(_CensusRule):
+    """VMEM block last-two-dims vs the dtype tiling granules."""
+
+    id = "DDLB131"
+    name = "tile-misalignment"
+    rationale = (
+        "a VMEM block whose trailing dims exceed but do not divide the "
+        "(sublane, 128) granule for its dtype forces Mosaic relayouts "
+        "and masked MXU lanes — a silent perf cliff the compiler "
+        "accepts without a diagnostic"
+    )
+
+    def findings_from(
+        self, run: Any, contexts: Sequence[FileContext] = ()
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+        for census in run.censuses:
+            for rec in census.blocks:
+                if rec.space != "vmem" or rec.block_shape is None:
+                    continue
+                dims = [
+                    d for d in rec.block_shape if isinstance(d, int)
+                ]
+                if len(dims) < 2 or len(dims) != len(rec.block_shape):
+                    continue
+                sub = SUBLANE.get(rec.dtype or "", None)
+                if sub is None:
+                    continue
+                problems = []
+                last, second = dims[-1], dims[-2]
+                if last > LANE and last % LANE:
+                    problems.append(
+                        f"last dim {last} > {LANE} lanes but not a "
+                        f"multiple of {LANE}"
+                    )
+                if second > sub and second % sub:
+                    problems.append(
+                        f"second-to-last dim {second} > sublane {sub} "
+                        f"({rec.dtype}) but not a multiple of {sub}"
+                    )
+                if not problems:
+                    continue
+                key = (census.rel, census.line, rec.label,
+                       rec.block_shape)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name} block {rec.label} "
+                        f"{list(rec.block_shape)} ({rec.dtype}): "
+                        f"{'; '.join(problems)} — pad or resize to the "
+                        f"({sub}, {LANE}) granule",
+                    )
+                )
+        return out
+
+
+class DmaSemaphoreRule(_CensusRule):
+    """Per-semaphore start/wait balance across the interpreted kernel."""
+
+    id = "DDLB132"
+    name = "dma-semaphore-leak"
+    rationale = (
+        "a DMA start whose semaphore is never awaited leaves the next "
+        "kernel invocation waiting on a dirty semaphore (or racing a "
+        "live copy) — the ring protocols drain every credit for exactly "
+        "this reason, and the interpreter's concrete trip counts make "
+        "the balance checkable per path"
+    )
+
+    def findings_from(
+        self, run: Any, contexts: Sequence[FileContext] = ()
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        for census in run.censuses:
+            for name, rec in census.unbalanced_sems():
+                delta = rec["starts"] - rec["waits"]
+                kind = (
+                    "unwaited start(s)" if delta > 0
+                    else "wait(s) with no matching start"
+                )
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name} semaphore {name} "
+                        f"({rec['kind']}): {rec['starts']} start(s) / "
+                        f"{rec['waits']} wait(s) — {abs(delta)} "
+                        f"{kind} on the interpreted paths; the kernel "
+                        f"exits with a dirty semaphore",
+                    )
+                )
+        return out
+
+
+class GridBlockRule(_CensusRule):
+    """Block shapes must divide their operands at canonical shapes."""
+
+    id = "DDLB133"
+    name = "grid-block-mismatch"
+    rationale = (
+        "a block that does not divide its operand makes Pallas pad the "
+        "tail tile; kernels that reduce over it read unmasked garbage "
+        "— wrong answers with no error, caught here under the canonical "
+        "sweep shapes every kernel must serve"
+    )
+
+    def findings_from(
+        self, run: Any, contexts: Sequence[FileContext] = ()
+    ) -> List[Finding]:
+        out: List[Finding] = []
+        seen: Set[Tuple] = set()
+        for census in run.censuses:
+            for rec in census.blocks:
+                if rec.block_shape is None or rec.operand_shape is None:
+                    continue
+                if len(rec.block_shape) != len(rec.operand_shape):
+                    continue
+                bad = [
+                    (i, o, b)
+                    for i, (o, b) in enumerate(
+                        zip(rec.operand_shape, rec.block_shape)
+                    )
+                    if isinstance(o, int) and isinstance(b, int)
+                    and b > 0 and o % b
+                ]
+                if not bad:
+                    continue
+                key = (census.rel, census.line, rec.label,
+                       rec.block_shape, rec.operand_shape)
+                if key in seen:
+                    continue
+                seen.add(key)
+                dims = ", ".join(
+                    f"dim {i}: {o} % {b} != 0" for i, o, b in bad
+                )
+                out.append(
+                    self.census_finding(
+                        census,
+                        f"kernel {census.name} block {rec.label} "
+                        f"{list(rec.block_shape)} does not divide "
+                        f"operand {list(rec.operand_shape)} ({dims}) "
+                        f"at canonical shapes — the padded tail tile "
+                        f"is read unmasked",
+                    )
+                )
+        return out
+
+
+class DirectCompilerParamsRule(Rule):
+    """Direct pltpu compiler-params references outside the bridge."""
+
+    id = "DDLB134"
+    name = "direct-compiler-params"
+    rationale = (
+        "jax >= 0.5 spells it pltpu.CompilerParams, the 0.4.x fleet "
+        "only has TPUCompilerParams; ops/pallas_compat.py is the one "
+        "version bridge — a direct reference breaks one side of the "
+        "fleet (the rename class PR 9 fixed once)"
+    )
+
+    _BANNED = ("CompilerParams", "TPUCompilerParams")
+
+    def scope(self, ctx: FileContext) -> bool:
+        return ctx.in_package() and ctx.path.name != "pallas_compat.py"
+
+    @staticmethod
+    def _is_jax_pallas(module: str) -> bool:
+        """The jax pallas namespace itself — NOT the repo's own bridge
+        (``ddlb_tpu.ops.pallas_compat`` is the sanctioned import)."""
+        return module.startswith("jax") and "pallas" in module
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        out: List[Finding] = []
+        pallas_aliases: Set[str] = set()
+        for node in ctx.nodes(ast.Import):
+            for alias in node.names:
+                if self._is_jax_pallas(alias.name):
+                    pallas_aliases.add(
+                        alias.asname or alias.name.split(".")[0]
+                    )
+        for node in ctx.nodes(ast.ImportFrom):
+            if not node.module:
+                continue
+            if not self._is_jax_pallas(node.module):
+                continue
+            for alias in node.names:
+                if alias.name in ("tpu", "pallas"):
+                    pallas_aliases.add(alias.asname or alias.name)
+                if alias.name in self._BANNED:
+                    out.append(
+                        self.finding(
+                            ctx, node.lineno, node.col_offset + 1,
+                            f"direct import of {alias.name} from "
+                            f"{node.module} — resolve it through "
+                            f"ddlb_tpu.ops.pallas_compat (the jax-0.4.x "
+                            f"rename bridge)",
+                        )
+                    )
+        for node in ctx.nodes(ast.Attribute):
+            if (
+                node.attr in self._BANNED
+                and isinstance(node.value, ast.Name)
+                and node.value.id in pallas_aliases
+            ):
+                out.append(
+                    self.finding(
+                        ctx, node.lineno, node.col_offset + 1,
+                        f"direct {node.value.id}.{node.attr} reference "
+                        f"— use ddlb_tpu.ops.pallas_compat."
+                        f"CompilerParams (the jax-0.4.x rename bridge)",
+                    )
+                )
+        return out
+
+
+RULES = [
+    VmemBudgetRule(),
+    TileAlignmentRule(),
+    DmaSemaphoreRule(),
+    GridBlockRule(),
+    DirectCompilerParamsRule(),
+]

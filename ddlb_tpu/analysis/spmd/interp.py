@@ -150,6 +150,21 @@ class SelfVal:
         self.klass = klass
 
 
+class PartialVal:
+    """``functools.partial`` over an interpretable callee: the bound
+    positional args lead, bound keywords merge under call-site keywords
+    — what lets the Pallas kernel model see the concrete ``d``/``bn``/
+    ``bk`` every ops kernel binds via ``functools.partial(kernel, ...)``
+    before handing it to ``pallas_call``."""
+
+    __slots__ = ("fn", "args", "kwargs")
+
+    def __init__(self, fn, args=(), kwargs=None) -> None:
+        self.fn = fn
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+
+
 class HostNS:
     """A host-side namespace (e.g. the family driver's ``self.runtime``
     stand-in): attribute reads return the named member — plain abstract
@@ -292,6 +307,7 @@ class Interpreter:
         self_summaries: Optional[Dict[str, Callable]] = None,
         module_resolver: Optional[Callable] = None,
         axis_sizes: Optional[Dict[str, int]] = None,
+        pallas_model: Optional[Any] = None,
     ) -> None:
         self.tracer = tracer
         self.budget = budget or Budget()
@@ -302,6 +318,11 @@ class Interpreter:
         #: optional cross-module FuncVal resolver(path) for ddlb_tpu.*
         self.module_resolver = module_resolver
         self.axis_sizes = dict(axis_sizes or {})
+        #: optional ``analysis.pallas.model.PallasModel``: when set, the
+        #: pl/pltpu surface (pallas_call, BlockSpec, DMA semaphores,
+        #: emit_pipeline, ...) dispatches to it and kernel BODIES are
+        #: interpreted instead of stopping at ``out_shape``
+        self.pallas = pallas_model
         self.depth = 0
         #: family-driver phase control: when set, shard_map bodies traced
         #: from direct calls record under this phase instead of the
@@ -371,6 +392,12 @@ class Interpreter:
         """Dispatch a call on any callee value."""
         if isinstance(fn, FuncVal):
             return self.call_function(fn, args, kwargs)
+        if isinstance(fn, PartialVal):
+            merged_kw = dict(fn.kwargs)
+            merged_kw.update(kwargs)
+            return self.call_value(
+                fn.fn, list(fn.args) + list(args), merged_kw, node
+            )
         if isinstance(fn, ShardMapVal):
             return self.apply_shard_map(fn, args)
         if isinstance(fn, UnionVal):
@@ -581,6 +608,21 @@ class Interpreter:
                 d *= self.axis_sizes.get(ax, 0) or 0
             return d
 
+        if self.pallas is not None:
+            handled = self.pallas.dispatch(path, tail, args, kwargs,
+                                           node, self)
+            if handled is not _MISSING:
+                return handled
+        if tail == "partial":
+            # functools.partial over any interpretable callee
+            if args:
+                return PartialVal(args[0], args[1:], kwargs)
+            return UNKNOWN
+        if tail in ("rem", "cdiv") and len(args) >= 2 and all(
+            isinstance(a, int) for a in args[:2]
+        ) and args[1] != 0:
+            a, b = args[0], args[1]
+            return a % b if tail == "rem" else -(-a // b)
         if tail in ("shard_map", "shard_map_compat"):
             return self.make_shard_map(args, kwargs, node)
         if tail == "PartitionSpec":
@@ -915,6 +957,8 @@ class Interpreter:
             )
         if tail == "dot_general":
             b = args[1] if len(args) > 1 else UNKNOWN
+            if self.pallas is not None:
+                self.pallas.note_dot(arr0, b)
             dn = args[2] if len(args) > 2 else kwargs.get(
                 "dimension_numbers"
             )
@@ -1102,6 +1146,8 @@ class Interpreter:
     # -- shape helpers ------------------------------------------------------
 
     def matmul_shape(self, a, b) -> Any:
+        if self.pallas is not None:
+            self.pallas.note_dot(a, b)
         sa, sb = _shape_of(a), _shape_of(b)
         dt = _dtype_of(a) or _dtype_of(b)
         tainted = taint_of(a) or taint_of(b)
@@ -1366,6 +1412,19 @@ class Interpreter:
     def _e_Compare(self, node, env):
         left = self.eval(node.left, env)
         vals = [self.eval(c, env) for c in node.comparators]
+
+        def norm(v):
+            # dtype-name ModVals compare like their names, so guards of
+            # the form ``cache.dtype == jnp.int8`` stay concrete (the
+            # decode kernels' precision dispatch)
+            if isinstance(v, ModVal):
+                dt = _as_dtype(v)
+                if dt is not None:
+                    return dt
+            return v
+
+        left = norm(left)
+        vals = [norm(v) for v in vals]
         concrete = (int, float, bool, str)
         if isinstance(left, concrete) and all(
             isinstance(v, concrete) or v is None for v in vals
@@ -1598,6 +1657,11 @@ class Interpreter:
             )
         if isinstance(base, FuncVal):
             return UNKNOWN
+        hook = getattr(base, "ddlb_attr", None)
+        if hook is not None:
+            # the kernel-model value protocol (analysis.pallas.model):
+            # Refs, semaphores and DMA handles resolve their own attrs
+            return hook(attr, self, node)
         return Unk(tainted=taint_of(base))
 
     def self_attr(self, selfval: SelfVal, attr: str, node) -> Any:
@@ -1692,6 +1756,9 @@ class Interpreter:
             return UnionVal(
                 [self.subscript(o, idx, node) for o in base.options]
             )
+        hook = getattr(base, "ddlb_subscript", None)
+        if hook is not None:
+            return hook(idx, self, node)
         return Unk(tainted=taint_of(base) or taint_of(idx))
 
     def index_arr(self, arr: Arr, idx) -> Any:
@@ -1784,7 +1851,25 @@ class Interpreter:
         raise _Continue()
 
     def _s_FunctionDef(self, node, env):
-        env.set(node.name, FuncVal(node.name, node, env))
+        value: Any = FuncVal(node.name, node, env)
+        # apply decorators conservatively (innermost first): Pallas
+        # kernels predicate code with ``@pl.when(cond)`` on NESTED defs,
+        # which must execute-or-skip at interpretation time exactly like
+        # trace time. A decorator the domain cannot model (``Unk``
+        # result) keeps the undecorated FuncVal — @jax.custom_vjp et al
+        # stay callable.
+        for dec in reversed(node.decorator_list):
+            try:
+                dec_val = self.eval(dec, env)
+                applied = self.call_value(dec_val, [value], {}, node)
+            except _Abort:
+                raise
+            except Exception:
+                break
+            if is_unknown(applied):
+                break
+            value = applied
+        env.set(node.name, value)
 
     def _s_AsyncFunctionDef(self, node, env):
         env.set(node.name, UNKNOWN)
@@ -2196,17 +2281,23 @@ def _contains_spmd_marker(fn_node) -> bool:
     return False
 
 
-def build_module_env(tree: ast.Module, interp: "Interpreter") -> Env:
+def build_module_env(
+    tree: ast.Module, interp: "Interpreter", rel: str = ""
+) -> Env:
     """A module's interpretation env: imports as ``ModVal`` paths plus
     module-level simple constants and function defs (shared by the
-    per-file tracer and the cross-module resolver)."""
+    per-file tracer and the cross-module resolver). ``rel`` stamps each
+    ``FuncVal`` with its defining file so cross-module findings (the
+    Pallas kernel census above all) anchor at the right path."""
     env = module_alias_env(tree)
     for stmt in tree.body:
         try:
             if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
                 interp.exec_stmt(stmt, env)
             elif isinstance(stmt, ast.FunctionDef):
-                env.set(stmt.name, FuncVal(stmt.name, stmt, env))
+                env.set(
+                    stmt.name, FuncVal(stmt.name, stmt, env, path=rel)
+                )
         except (_Abort, _Return, _Break, _Continue):
             break
     return env
@@ -2225,7 +2316,7 @@ def trace_file(ctx) -> List[ShardMapTrace]:
         tracer = Tracer(ctx.rel, mode="file")
         budget = Budget()
         interp = Interpreter(tracer, budget=budget)
-        module_env = build_module_env(ctx.tree, interp)
+        module_env = build_module_env(ctx.tree, interp, rel=ctx.rel)
         candidates: List[Tuple[ast.FunctionDef, Optional[str]]] = []
         for stmt in ctx.tree.body:
             if isinstance(stmt, ast.FunctionDef) and _contains_spmd_marker(

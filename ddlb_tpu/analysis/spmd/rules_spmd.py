@@ -187,14 +187,20 @@ class PpermuteBijectionRule(Rule):
 
 
 class WireDriftRule(ProjectRule):
-    """Traced wire bytes vs the family ``wire_bytes()`` formula."""
+    """Traced wire bytes vs the family ``wire_bytes()`` formula — and
+    the registered-opaque discipline: a member whose wire the tracer
+    cannot see must carry an ``OPAQUE_JUSTIFIED`` entry
+    (``spmd.families``), and a stale entry (member no longer opaque)
+    must be removed, so the opaque set can only shrink deliberately."""
 
     id = "DDLB123"
     name = "wire-bytes-drift"
     rationale = (
         "perfmodel wire_bytes() feeds every roofline_frac column and "
         "the bench regression gate; a formula that drifts from the "
-        "member's actual collective traffic silently corrupts them all"
+        "member's actual collective traffic silently corrupts them all "
+        "— and a member that silently lands opaque escapes the check "
+        "entirely, so opacity itself must be registered"
     )
 
     def check_project(
@@ -216,12 +222,38 @@ class WireDriftRule(ProjectRule):
             ]
         return self.findings_from(reports)
 
-    def findings_from(self, reports) -> List[Finding]:
-        """Drift reports -> findings (shared with the fixture tests,
-        which drive ``families.verify_families`` over a synthetic
-        tree)."""
+    def findings_from(self, reports, justified=None) -> List[Finding]:
+        """Drift + unregistered/stale-opaque reports -> findings
+        (shared with the fixture tests, which drive
+        ``families.verify_families`` over a synthetic tree and inject
+        their own ``justified`` registry)."""
+        from ddlb_tpu.analysis.spmd import families
+
+        if justified is None:
+            justified = families.OPAQUE_JUSTIFIED
         out: List[Finding] = []
+        opaque_seen = set()
         for r in reports:
+            if r.status == "opaque":
+                opaque_seen.add((r.family, r.member))
+            if r.status == "opaque" and (
+                (r.family, r.member) not in justified
+            ):
+                rel = r.formula_rel or r.rel
+                line = r.formula_line or 1
+                out.append(
+                    Finding(
+                        self.id, rel, line, 1,
+                        f"{r.label()} is opaque to the tracer with no "
+                        f"registered justification — model its wire "
+                        f"(analysis/pallas traces kernel DMA rings) or "
+                        f"register ({r.family!r}, {r.member!r}) in "
+                        f"families.OPAQUE_JUSTIFIED with why it cannot "
+                        f"be checked",
+                        snippet=_line_of(rel, line),
+                    )
+                )
+                continue
             if r.status != "drift":
                 continue
             rel = r.formula_rel or r.rel
@@ -237,7 +269,48 @@ class WireDriftRule(ProjectRule):
                     snippet=_line_of(rel, line),
                 )
             )
+        covered = {(r.family, r.member) for r in reports}
+        families_seen = {r.family for r in reports}
+        for key in sorted(justified):
+            if key[0] not in families_seen:
+                # the whole family is outside this sweep (fixture runs,
+                # --spmd-trace subsets): its entries are not judgeable
+                continue
+            if key not in opaque_seen:
+                why = (
+                    "the member now traces"
+                    if key in covered
+                    else "the member is no longer registered"
+                )
+                rel, line = _justified_anchor()
+                out.append(
+                    Finding(
+                        self.id, rel, line, 1,
+                        f"stale OPAQUE_JUSTIFIED entry {key}: {why} — "
+                        f"remove the entry so the opaque set only "
+                        f"shrinks deliberately",
+                        snippet=_line_of(rel, line),
+                    )
+                )
         return out
+
+
+def _justified_anchor() -> Tuple[str, int]:
+    """The ``OPAQUE_JUSTIFIED = {`` definition line in families.py —
+    where a stale-entry finding sends the reader."""
+    rel = "ddlb_tpu/analysis/spmd/families.py"
+    from ddlb_tpu.analysis.core import repo_root
+
+    try:
+        lines = (repo_root() / rel).read_text(
+            encoding="utf-8"
+        ).splitlines()
+    except OSError:
+        return rel, 1
+    for i, line in enumerate(lines, 1):
+        if line.startswith("OPAQUE_JUSTIFIED"):
+            return rel, i
+    return rel, 1
 
 
 def families_shapes_label(family: str) -> str:

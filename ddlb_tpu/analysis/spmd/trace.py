@@ -38,7 +38,11 @@ COLLECTIVE_OPS = (
     "all_to_all",
 )
 #: rank-asymmetric by protocol (point-to-point DMA), excluded from the
-#: DDLB121 divergence check but still traced for structure/debugging
+#: DDLB121 divergence check but SIZED like a ppermute hop: under the
+#: SPMD-symmetric model every device sends one payload per recorded
+#: remote copy, which is exactly what the Pallas kernel rings move —
+#: the de-opaquing contract that lets DDLB123 hold ``ring_all_gather``
+#: et al to their ``wire_bytes()`` formulas
 P2P_OPS = ("remote_copy",)
 
 #: wire/HBM itemsize per dtype name, mirroring perfmodel.cost._ITEMSIZE
@@ -284,6 +288,12 @@ class TraceEntry:
 #: given its local payload bytes and the axis size d — the same
 #: bandwidth-optimal formulas perfmodel/cost.py states per family
 def wire_contribution(op: str, nbytes: float, d: int) -> float:
+    if op == "remote_copy":
+        # one kernel-level RDMA hop: every device sends the payload
+        # once (the symmetric ring/all-pairs protocols of ops/); the
+        # axis product does not divide it — the kernel already sliced
+        # the payload, and the entry often carries no axis names at all
+        return nbytes
     if d <= 1:
         return 0.0
     if op == "all_gather":
@@ -350,11 +360,12 @@ class ShardMapTrace:
         return tuple(sorted(axes))
 
     def wire_bytes(self, axis_sizes: Dict[str, int]) -> Optional[float]:
-        """Total per-device wire bytes of the trace's collectives under
-        the given axis sizes; None when any payload is unsizeable."""
+        """Total per-device wire bytes of the trace's collectives — and
+        kernel-level remote-DMA hops — under the given axis sizes; None
+        when any payload is unsizeable."""
         total = 0.0
         for e in self.entries:
-            if e.op not in COLLECTIVE_OPS:
+            if e.op not in COLLECTIVE_OPS + P2P_OPS:
                 continue
             if e.op == "axis_index":  # pragma: no cover - not collective
                 continue

@@ -38,6 +38,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ddlb_tpu.ops.collective_matmul import _gemm_pipeline
+from ddlb_tpu.ops.pallas_compat import CompilerParams
 
 
 def _global_barrier(axis_name: str, d: int) -> None:
@@ -233,7 +234,7 @@ def alltoall_expert_matmul(
             pltpu.SemaphoreType.DMA,                  # local copies
             pltpu.VMEM((g, bn), jnp.float32),         # GEMM accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             has_side_effects=True, collective_id=collective_id
         ),
         interpret=interpret,

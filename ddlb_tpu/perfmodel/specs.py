@@ -22,6 +22,13 @@ Conventions (documented here once, relied on everywhere):
 - ``dcn_bw_gbs`` is the per-chip share of the host NIC for cross-slice
   traffic (the ``transport='dcn'`` mesh layout).
 - ``hbm_bw_gbs`` / ``hbm_gib`` are the published per-chip HBM numbers.
+- ``vmem_mib`` is the per-core VMEM capacity a Pallas kernel's resident
+  working set (pipelined blocks x2, scratch, accumulators) must fit in
+  — ~16 MiB/core on v4/v5e/v5p, doubled on Trillium (pallas_guide.md
+  "Memory Hierarchy"). The static kernel census (DDLB130,
+  ``ddlb_tpu.analysis.pallas``) holds every ``pallas_call`` to this
+  budget; ``cpu-sim`` is deliberately generous because the Pallas
+  interpreter parks whole operands in VMEM and enforces no cap.
 - ``cpu-sim`` is calibrated *optimistic* (a host CPU cannot reach 1
   TFLOP/s dense or 100 GB/s effective copy at benchmark shapes), so the
   ``roofline_frac`` invariant ``(0, 1]`` holds on the simulated topology
@@ -56,6 +63,7 @@ class ChipSpec:
     hbm_bw_gbs: float
     ici_bw_gbs: float  # per-direction ring-neighbor link, GB/s
     dcn_bw_gbs: float
+    vmem_mib: float = 16.0  # per-core VMEM capacity (see conventions)
     aliases: tuple = field(default=())
 
     # -- derived, in SI units the cost model consumes ------------------------
@@ -80,6 +88,11 @@ class ChipSpec:
     def hbm_bw(self) -> float:
         return self.hbm_bw_gbs * GB
 
+    @property
+    def vmem_bytes(self) -> float:
+        """Per-core VMEM capacity in bytes — the DDLB130 budget."""
+        return self.vmem_mib * float(1 << 20)
+
     def link_bw(self, transport: str = "ici") -> float:
         """Ring-neighbor bandwidth in bytes/s for a transport layer."""
         if transport == "dcn":
@@ -101,6 +114,7 @@ CHIP_SPECS: Dict[str, ChipSpec] = {
             hbm_bw_gbs=1228.0,
             ici_bw_gbs=50.0,
             dcn_bw_gbs=6.25,
+            vmem_mib=16.0,
             aliases=("tpu v4", "tpu_v4"),
         ),
         ChipSpec(
@@ -114,6 +128,7 @@ CHIP_SPECS: Dict[str, ChipSpec] = {
             hbm_bw_gbs=819.0,
             ici_bw_gbs=50.0,
             dcn_bw_gbs=6.25,
+            vmem_mib=16.0,
             aliases=("v5 lite", "v5litepod", "tpu v5 lite", "tpu v5e"),
         ),
         ChipSpec(
@@ -127,6 +142,7 @@ CHIP_SPECS: Dict[str, ChipSpec] = {
             hbm_bw_gbs=2765.0,
             ici_bw_gbs=100.0,
             dcn_bw_gbs=12.5,
+            vmem_mib=16.0,
             aliases=("tpu v5p", "tpu v5"),
         ),
         ChipSpec(
@@ -140,6 +156,7 @@ CHIP_SPECS: Dict[str, ChipSpec] = {
             hbm_bw_gbs=1640.0,
             ici_bw_gbs=112.0,
             dcn_bw_gbs=12.5,
+            vmem_mib=32.0,
             aliases=("v6 lite", "trillium", "tpu v6 lite", "tpu v6e"),
         ),
         # Calibrated virtual-device entry (see module conventions): all
@@ -158,6 +175,7 @@ CHIP_SPECS: Dict[str, ChipSpec] = {
             hbm_bw_gbs=100.0,
             ici_bw_gbs=100.0,
             dcn_bw_gbs=10.0,
+            vmem_mib=1024.0,
             aliases=("cpu", "sim", "host"),
         ),
     )

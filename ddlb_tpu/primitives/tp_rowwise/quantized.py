@@ -24,6 +24,7 @@ from ddlb_tpu.ops.quantized_matmul import (
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class QuantizedTPRowwise(QuantizedGEMMMixin, TPRowwise):
@@ -43,10 +44,12 @@ class QuantizedTPRowwise(QuantizedGEMMMixin, TPRowwise):
                 partial, "tp", scatter_dimension=0, tiled=True
             )  # [m/d, n]
 
-        # B plays the weight role: per-shard-column int8 + scales at init.
+        # B plays the weight role: per-shard-column int8 + scales at init
+        # (shard_map_compat: jax.shard_map where available, the pre-0.5
+        # experimental entry point otherwise — the jax 0.4.x fleet).
         self.bq, self.sb = jax.block_until_ready(
             jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     quantize_colwise,
                     mesh=self.mesh,
                     in_specs=(P("tp", None),),
@@ -59,7 +62,7 @@ class QuantizedTPRowwise(QuantizedGEMMMixin, TPRowwise):
         if self.options["quantize"] == "static":
             self.aq, self.sa = jax.block_until_ready(
                 jax.jit(
-                    jax.shard_map(
+                    shard_map_compat(
                         quantize_rowwise,
                         mesh=self.mesh,
                         in_specs=(P(None, "tp"),),
@@ -69,7 +72,7 @@ class QuantizedTPRowwise(QuantizedGEMMMixin, TPRowwise):
                 )(self.a)
             )
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     partial_rs,
                     mesh=self.mesh,
                     in_specs=(
@@ -90,7 +93,7 @@ class QuantizedTPRowwise(QuantizedGEMMMixin, TPRowwise):
                 return partial_rs(aq, sa, bq, sb)
 
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     step,
                     mesh=self.mesh,
                     in_specs=(P(None, "tp"), P("tp", None), P("tp", None)),

@@ -7,9 +7,13 @@ fidelity/availability trade:
    interpreter's ordered collective trace for a real registered member
    (``analysis.spmd.families.member_schedule``) replays step-by-step —
    a chunked double-buffered ring arrives as its literal ``c*(d-1)``
-   ppermutes and a pipeline schedule table as its per-tick hop
-   sequence, so the engine's arbitration (not a closed form) decides
-   what overlaps.
+   ppermutes, a pipeline schedule table as its per-tick hop sequence,
+   and a fused Pallas RDMA kernel (the ``analysis.pallas`` kernel
+   model's de-opaqued members) as its literal in-kernel
+   ``make_async_remote_copy`` hops — ``remote_copy`` entries lower to
+   single hops like ppermutes, the export's ``chunks`` carries the
+   kernel's hop count as the pipeline depth, and the engine's
+   arbitration (not a closed form) decides what overlaps.
 2. **Closed-form** (``program_from_impl``): a duck-typed impl's
    ``perfmodel.cost`` terms lowered into ring-granularity steps — the
    validation front-end: on a degenerate flat topology the replayed

@@ -113,6 +113,83 @@ def spmd_trace(family: str) -> int:
     return 1 if drift else 0
 
 
+def pallas_census() -> int:
+    """Dump every kernel census (the ``--pallas-census`` mode): the
+    per-``pallas_call`` VMEM/tile/DMA/wire breakdown, the budget table
+    against every registered chip spec, and the DDLB130-133 findings —
+    exit 1 on any finding, so ``make ci`` fails on an unmodeled or
+    over-budget kernel."""
+    from ddlb_tpu.analysis.pallas import census as census_mod
+    from ddlb_tpu.analysis.pallas import rules_pallas
+    from ddlb_tpu.perfmodel.specs import CHIP_SPECS
+
+    contexts = [
+        core.build_context(p, root=REPO)
+        for p in core.expand_targets([str(REPO / "ddlb_tpu")])
+    ]
+    run = census_mod.shared_run()
+    for census in run.censuses:
+        for line in census.describe():
+            print(line)
+        print()
+    chips = sorted(CHIP_SPECS.values(), key=lambda s: s.name)
+    print(
+        "VMEM budget table (census total vs per-chip capacity, "
+        "canonical sweep shapes):"
+    )
+    header = f"  {'kernel':44s}" + "".join(
+        f"{s.name:>10s}" for s in chips
+    )
+    print(header)
+    seen = set()
+    for census in run.censuses:
+        key = (census.rel, census.line)
+        if key in seen:
+            continue
+        seen.add(key)
+        total = census.vmem_bytes()
+        label = f"{census.name} ({census.rel.rsplit('/', 1)[-1]})"
+        if total is None:
+            print(f"  {label:44s}" + "  unsizeable")
+            continue
+        cells = "".join(
+            f"{'OVER' if total > s.vmem_bytes else 'ok':>7s}"
+            f"{total / (1 << 20):>3.0f}M"
+            if total > s.vmem_bytes
+            else f"{total / (1 << 20):>9.1f}M"
+            for s in chips
+        )
+        print(f"  {label:44s}{cells}")
+    findings = []
+    for rule in rules_pallas.RULES:
+        if hasattr(rule, "findings_from"):
+            findings.extend(rule.findings_from(run, contexts))
+    # same masking contract as the main sweep: inline suppressions on
+    # the finding's line, then the committed baseline — the gate fails
+    # only on NON-masked findings (the Makefile's stated behavior)
+    by_rel = {ctx.rel: ctx for ctx in contexts}
+    for f in findings:
+        ctx = by_rel.get(f.path)
+        if ctx is not None:
+            core._apply_suppressions(ctx, [f])
+    baseline_mod.apply(
+        findings,
+        baseline_mod.load(REPO / baseline_mod.BASELINE_NAME),
+        REPO / baseline_mod.BASELINE_NAME,
+    )
+    for f in findings:
+        print(output.text_line(f))
+    counting = sum(1 for f in findings if f.counts)
+    n_sites = len(census_mod.pallas_call_sites(contexts))
+    print(
+        f"pallas-census: {len(seen)} distinct pallas_call site(s) "
+        f"censused of {n_sites} in ddlb_tpu/, "
+        f"{counting} finding(s) ({len(findings) - counting} masked), "
+        f"{len(run.errors)} drive error(s)"
+    )
+    return 1 if counting else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="analyze.py",
@@ -169,10 +246,19 @@ def main(argv=None) -> int:
         "registered family ('all' for every family) and exit — the "
         "DDLB123 debugging surface",
     )
+    parser.add_argument(
+        "--pallas-census", action="store_true",
+        help="dump every Pallas kernel's VMEM/tile/DMA census and the "
+        "per-chip budget table, exit 1 on any DDLB130-133 finding — "
+        "the kernel-model debugging surface (and the make ci gate)",
+    )
     args = parser.parse_args(argv)
 
     if args.spmd_trace is not None:
         return spmd_trace(args.spmd_trace)
+
+    if args.pallas_census:
+        return pallas_census()
 
     if args.list_rules:
         for rule in core.all_rules():

@@ -372,12 +372,22 @@ class TestRepoSurface:
         # every registered family is exercised
         covered = {r.family for r in reports}
         assert covered == set(families.FAMILY_SHAPES)
-        # the statically-checkable members all verify; opacity is only
-        # the compiler-scheduled class (xla_gspmd) and kernel-internal
-        # DMA (pallas collectives)
-        for label in by_status.get("opaque", []):
-            assert ("xla_gspmd" in label) or ("pallas" in label), label
-        assert len(by_status.get("verified", [])) >= 30
+        # the statically-checkable members all verify; since the Pallas
+        # kernel model (ISSUE 13) traces kernel-internal DMA rings,
+        # opacity is ONLY the compiler-scheduled class (xla_gspmd) —
+        # down from 15 configs to 10 — and every remaining opaque
+        # member carries a registered justification
+        opaque = by_status.get("opaque", [])
+        assert len(opaque) == 10, opaque
+        for label in opaque:
+            assert "xla_gspmd" in label, label
+        opaque_keys = {
+            (r.family, r.member)
+            for r in reports
+            if r.status == "opaque"
+        }
+        assert opaque_keys <= set(families.OPAQUE_JUSTIFIED)
+        assert len(by_status.get("verified", [])) >= 50
 
     def test_spmd_trace_cli(self):
         proc = subprocess.run(
