@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
 	hooks ci chaos-launch overlap-report serving-load-report sim-report \
-	clean
+	skew-report clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -57,6 +57,7 @@ ci:
 	$(PYTHON) -m pytest tests/ -q -m 'not slow'
 	$(PYTHON) scripts/serving_load_demo.py
 	$(PYTHON) scripts/sim_demo.py
+	$(PYTHON) scripts/skew_demo.py
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -82,6 +83,15 @@ serving-load-report:
 # transcript at docs/sim_demo.log (docs/source/simulator.rst)
 sim-report:
 	$(PYTHON) scripts/sim_demo.py
+
+# cross-rank skew acceptance: two clean launched 2-rank CPU-sim worlds
+# bank skew baselines, then a seeded single-rank slowdown at the
+# runtime.collective site must be detected, attributed to the injected
+# rank and ranked first by scripts/skew_report.py, with zero findings
+# on the clean runs — banked transcript at docs/skew_demo.log
+# (docs/source/observability.rst "Cross-rank timeline")
+skew-report:
+	$(PYTHON) scripts/skew_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

@@ -126,6 +126,11 @@ _DEADLINE_FILES = (
     "ddlb_tpu/cli/launch.py",
     "ddlb_tpu/benchmark.py",
     "ddlb_tpu/utils/timing.py",
+    # the clock-alignment layer (ISSUE 14) compares monotonic stamps
+    # across processes — a wall-clock stamp there would fold NTP steps
+    # straight into the offset fit it exists to make trustworthy
+    "ddlb_tpu/telemetry/clocksync.py",
+    "ddlb_tpu/observatory/timeline.py",
 )
 
 

@@ -82,6 +82,7 @@ ROW_WRITER_FILES = (
     "ddlb_tpu/benchmark.py",
     "ddlb_tpu/pool.py",
     "ddlb_tpu/telemetry/metrics.py",
+    "ddlb_tpu/telemetry/clocksync.py",
     "ddlb_tpu/observatory/attribution.py",
     "scripts/hw_common.py",
 )

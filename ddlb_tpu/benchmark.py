@@ -49,6 +49,7 @@ import numpy as np
 
 from ddlb_tpu import envs, faults, telemetry
 from ddlb_tpu.faults import flightrec, heartbeat
+from ddlb_tpu.telemetry import clocksync
 from ddlb_tpu.observatory import attribution as overlap_attribution
 from ddlb_tpu.perfmodel import cost as perfmodel_cost
 from ddlb_tpu.observatory import live, store
@@ -209,6 +210,10 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
             telemetry.span(
                 "worker.row", cat="row", impl=impl_id, primitive=primitive
             ):
+        # the cross-rank skew fold reads exactly this row's collective
+        # spans: drop whatever a previous row (or bootstrap) recorded
+        clocksync.reset_row()
+        skew_fields: Optional[Dict[str, Any]] = None
         try:
             faults.inject("worker.setup")
             impl_class = load_impl_class(primitive, base_impl)
@@ -275,6 +280,12 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
                     * 1e-3,
                 )
                 times_ms = _max_reduce_across_processes(times_ms, runtime)
+            # cross-rank skew fold (ISSUE 14): while the world is still
+            # in lock-step, allgather every rank's collective entry/exit
+            # stamps, align clocks on the row's own barrier exchanges,
+            # and fold the arrival skew into the row's skew columns. A
+            # no-op (defaults) on single-process worlds.
+            skew_fields = clocksync.fold_row_skew(runtime)
             _mark("measured; validation begin" if do_validate else "measured")
 
             valid = True
@@ -349,6 +360,11 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         # timing/validation crash still gets predicted_s and bound; only
         # roofline_frac needs the measurement and degrades to NaN)
         perf=_perfmodel_fields(impl, times_ms),
+        # the cross-rank skew columns (ISSUE 14): arrival-skew seconds,
+        # exit spread, the straggler rank and its waited-on share, with
+        # the clock-alignment uncertainty bound alongside; defaults on
+        # single-process rows and rows whose worker died pre-fold
+        skew=skew_fields,
     )
     if impl is not None and np.isfinite(times_ms).any():
         # family-specific measured quantities (speculate acceptance
@@ -401,6 +417,7 @@ def make_result_row(
     compile_cache_hit: bool = False,
     metrics: Optional[Dict[str, Any]] = None,
     perf: Optional[Dict[str, Any]] = None,
+    skew: Optional[Dict[str, Any]] = None,
     retries: int = 0,
     fault_injected: str = "",
     error_class: str = "",
@@ -427,6 +444,11 @@ def make_result_row(
     perf_fields = dict(PERF_ROW_DEFAULTS)
     if perf:
         perf_fields.update({k: perf[k] for k in perf_fields if k in perf})
+    skew_fields = dict(clocksync.SKEW_ROW_DEFAULTS)
+    if skew:
+        skew_fields.update(
+            {k: skew[k] for k in skew_fields if k in skew}
+        )
     tflops = flop_count / 1e9 / times_ms
     stats = robust_stats(times_ms)
     return {
@@ -477,6 +499,10 @@ def make_result_row(
         # lower bound for this config, the fraction of it achieved, and
         # the roofline term that dominates (compute/comm/hbm)
         **perf_fields,
+        # the cross-rank skew columns (ISSUE 14): how long this row's
+        # collectives waited on their last arrival, which rank it was,
+        # and the clock-alignment uncertainty the attribution carries
+        **skew_fields,
         # the robustness columns (ISSUE 4), identical on every path so
         # the CSV header cannot drift: how many retries this row took,
         # which fault-plan sites fired, the error's transient-vs-
@@ -562,11 +588,17 @@ def _max_reduce_across_processes(times_ms: np.ndarray, runtime) -> np.ndarray:
     # and flight-recorded (a rank that never arrives leaves its peers
     # in-flight here — named by scripts/flight_report.py)
     faults.inject("runtime.collective")
+    # clock-sync stamps AFTER the injection site (a fault-delayed rank
+    # must arrive late on its own stamp) — this collective is the
+    # preferred slowdown-injection point, so it feeds the skew fold but
+    # never the offset fit (clocksync.FIT_SITES excludes it)
+    t_enter = time.monotonic()
     with flightrec.record(
         "runtime.collective",
         payload_bytes=int(times_ms.size * 8 * runtime.num_processes),
     ):
         gathered = multihost_utils.process_allgather(times_ms)
+    clocksync.record_span("runtime.collective", t_enter, time.monotonic())
     return np.max(gathered, axis=0)
 
 
@@ -914,6 +946,12 @@ class PrimitiveBenchmarkRunner:
                     quarantined=bool(row.get("quarantined")),
                     retries=row.get("retries"),
                     worker_reused=row.get("worker_reused"),
+                    # cross-rank skew summary (the dashboard's per-rank
+                    # lane panel keys on these; defaults off multi-
+                    # process worlds fold to nothing)
+                    skew_enter_s=row.get("skew_enter_s"),
+                    straggler_rank=row.get("straggler_rank"),
+                    straggler_frac=row.get("straggler_frac"),
                     # serving SLO summary (absent on non-serving rows;
                     # the dashboard's serving panel keys on these)
                     slo_ttft_p50_ms=row.get("slo_ttft_p50_ms"),

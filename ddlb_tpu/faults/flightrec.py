@@ -303,19 +303,61 @@ def _read_rank_file(path: str) -> List[Dict[str, Any]]:
     return lines
 
 
+#: public alias: the timeline observatory (observatory/timeline.py)
+#: reads per-rank files with the same torn-line tolerance the sequence
+#: join uses, so the time join and the sequence join cannot diverge on
+#: what counts as a readable entry
+read_rank_file = _read_rank_file
+
+
+def rank_files(run_dir: str) -> Dict[int, str]:
+    """``{rank: path}`` for every ``flight-p<rank>.jsonl`` under
+    ``run_dir`` — the one discovery rule the sequence join
+    (``analyze_run``) and the time join (``observatory/timeline.py``)
+    share, so a filename-format change cannot desynchronize them."""
+    out: Dict[int, str] = {}
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith("flight-p") and name.endswith(".jsonl")):
+            continue
+        try:
+            rank = int(name[len("flight-p"):-len(".jsonl")])
+        except ValueError:
+            continue
+        out[rank] = os.path.join(run_dir, name)
+    return out
+
+
+def dominant_stream(
+    events: List[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """One rank's events reduced to the pid stream with the most
+    entries — the rank's main process (pool children share the file
+    but run their own sequence). Shared by both joins for the same
+    cannot-diverge reason as ``rank_files``."""
+    by_pid: Dict[Any, List[Dict[str, Any]]] = {}
+    for event in events:
+        by_pid.setdefault(event.get("pid"), []).append(event)
+    if not by_pid:
+        return []
+    return max(by_pid.values(), key=len)
+
+
 def _rank_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold one rank's transitions into its progress summary, using the
-    pid stream with the most entries (a rank's main process; pool
-    children share the file but run their own sequence)."""
-    by_pid: Dict[Any, List[Dict[str, Any]]] = {}
-    for e in events:
-        by_pid.setdefault(e.get("pid"), []).append(e)
-    if not by_pid:
+    pid stream with the most entries (``dominant_stream`` — a rank's
+    main process; pool children share the file but run their own
+    sequence)."""
+    stream = dominant_stream(events)
+    if not stream:
         return {
             "last_completed_seq": 0, "inflight": [], "entries": 0,
             "dumps": [], "pid": None, "by_seq": {},
         }
-    pid, stream = max(by_pid.items(), key=lambda kv: len(kv[1]))
+    pid = stream[0].get("pid")
     begun: Dict[int, Dict[str, Any]] = {}
     by_seq: Dict[int, str] = {}
     completed = 0
@@ -365,20 +407,8 @@ def analyze_run(
     renders it; the supervised launcher prints its headline after a
     coordinated abort)."""
     ranks: Dict[int, Dict[str, Any]] = {}
-    try:
-        names = sorted(os.listdir(run_dir))
-    except OSError:
-        names = []
-    for name in names:
-        if not (name.startswith("flight-p") and name.endswith(".jsonl")):
-            continue
-        try:
-            rank = int(name[len("flight-p"):-len(".jsonl")])
-        except ValueError:
-            continue
-        ranks[rank] = _rank_summary(
-            _read_rank_file(os.path.join(run_dir, name))
-        )
+    for rank, path in rank_files(run_dir).items():
+        ranks[rank] = _rank_summary(_read_rank_file(path))
     missing: List[int] = []
     if expected_ranks:
         missing = [r for r in range(expected_ranks) if r not in ranks]

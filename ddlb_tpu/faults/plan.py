@@ -89,6 +89,11 @@ SITES: Dict[str, str] = {
     "runtime.barrier": "Runtime cross-process barrier",
     "runtime.collective": "cross-process result collective (timing MAX-reduce)",
     "launch.child": "launched-world child bootstrap (Runtime init, pre-connect)",
+    "skew.fold": (
+        "cross-rank skew fold's stamp allgather (telemetry/clocksync) — "
+        "a rank-targeted fault here models a rank dying/wedging inside "
+        "the observability collective itself"
+    ),
     "subprocess.entry": "pool child dispatch-loop row entry",
     "subprocess.result": "row dict corruption before posting to parent",
     "serve.admit": "serving engine request admission (prefill + slot copy)",

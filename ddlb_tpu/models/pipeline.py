@@ -49,6 +49,7 @@ from ddlb_tpu.models.transformer import (
     make_stage_fn,
     param_specs,
 )
+from ddlb_tpu.runtime import set_mesh_compat, shard_map_compat
 from ddlb_tpu.utils.pipeline_schedule import build_schedule
 
 
@@ -365,7 +366,9 @@ def make_loss_and_grads_1f1b(
             out_grads[name] = g.astype(params[name].dtype)
         return loss, out_grads
 
-    fn = jax.shard_map(
+    # runtime.shard_map_compat (DDLB101 migration): jax 0.4.x has no
+    # jax.shard_map, and this schedule must run on the old-jax fleet
+    fn = shard_map_compat(
         body,
         mesh=mesh,
         in_specs=(specs, P("dp", None), P("dp", None)),
@@ -410,7 +413,7 @@ def make_train_step_1f1b(
     )
 
     def init_opt_state(params):
-        with jax.set_mesh(mesh):
+        with set_mesh_compat(mesh):
             return jax.jit(optimizer.init)(params)
 
     return train_step, init_opt_state, shardings

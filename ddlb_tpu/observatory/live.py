@@ -132,6 +132,10 @@ def fold(
       gauge ring (``serving_tick`` events), in-drain progress, and the
       latest serving row's SLO summary (TTFT percentiles, goodput,
       attainment);
+    - ``lanes``: the per-rank skew panel (ISSUE 14) — per process id,
+      how many completed rows named it the straggler, its accumulated
+      arrival-skew seconds, and the latest row's ``straggler_frac``
+      (``row_done`` events carry the skew fold's summary);
     - ``unknown``: per-kind counts of events this build did not
       recognize (surfaced by the renderers, never silently dropped).
     """
@@ -155,6 +159,9 @@ def fold(
     # the None-branch literal) so a state folded by an OLDER dashboard
     # build gains the keys instead of KeyError-ing the renderer.
     state.setdefault("serving", {"depths": [], "progress": None, "latest": None})
+    # per-rank skew lanes (ISSUE 14); setdefault for the same
+    # older-dashboard-folded-state reason as the serving panel above
+    state.setdefault("lanes", {})
     state.setdefault("unknown", {})
     totals = state["totals"]
     for e in events:
@@ -210,6 +217,18 @@ def fold(
                     "goodput_rps": _finite(e.get("slo_goodput_rps")),
                     "attainment": _finite(e.get("slo_attainment")),
                 }
+            strag = _finite(e.get("straggler_rank"))
+            if strag is not None and strag >= 0:
+                # per-rank lane bookkeeping: lanes key by the straggler
+                # process id (JSON round-trips dict keys as strings, so
+                # pin the str form)
+                lane = state["lanes"].setdefault(
+                    str(int(strag)),
+                    {"straggler_rows": 0, "skew_s": 0.0, "last_frac": None},
+                )
+                lane["straggler_rows"] += 1
+                lane["skew_s"] += _finite(e.get("skew_enter_s")) or 0.0
+                lane["last_frac"] = _finite(e.get("straggler_frac"))
             state["recent"].append(e)
             del state["recent"][:-recent]
         elif kind == "serving_tick":

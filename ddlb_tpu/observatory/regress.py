@@ -27,7 +27,10 @@ Serving rows carry latency DISTRIBUTIONS next to their median time
 percentile — p99 TTFT triples while the median barely moves — so the
 same median+MAD machinery additionally gates every ``SLO_METRICS``
 column per key (``detect_slo``), with per-metric direction (goodput
-regresses DOWN). ``detect_all`` merges both gates into one ranked
+regresses DOWN), and every ``SKEW_METRICS`` column (``detect_skew``,
+ISSUE 14 — a straggler rank that the timing MAX-reduce hides, gated
+with absolute noise floors because the skew columns live near zero on
+clean runs). ``detect_all`` merges all three gates into one ranked
 report.
 
 Consumed by ``scripts/observatory_report.py`` and
@@ -62,6 +65,19 @@ SLO_METRICS = (
     ("slo_ttft_p99_ms", "high"),
     ("slo_tpot_p95_ms", "high"),
     ("slo_goodput_rps", "low"),
+)
+
+#: cross-rank skew metrics gated per key (ISSUE 14): ``(metric,
+#: direction, abs_floor, abs_excess)``. The skew columns live near
+#: zero on clean runs (scheduler jitter), so the relative machinery
+#: alone would flag 3x-of-nothing noise — each metric therefore
+#: carries an ABSOLUTE noise floor on the MAD scale and an absolute
+#: minimum excess a finding must clear:
+#: ``straggler_frac`` must grow by 0.20 of the row's collective time,
+#: ``skew_enter_s`` by 100 ms of real waiting, before either counts.
+SKEW_METRICS = (
+    ("straggler_frac", "high", 0.02, 0.20),
+    ("skew_enter_s", "high", 0.005, 0.10),
 )
 
 
@@ -215,23 +231,35 @@ def _history_finding(
     z_tol: float,
     min_excess: float,
     rel_floor: float,
+    abs_floor: float = 0.0,
+    abs_excess: float = 0.0,
 ) -> Optional[Dict[str, Any]]:
-    """The history-backed gate core shared by ``detect`` and
-    ``detect_slo``: median+MAD z against the key's baseline, with
-    ``direction`` deciding which way is worse ("high" = bigger is
-    worse; "low" = smaller is worse, ``ratio`` oriented so >1 always
-    reads "this much worse"). None when the row is within tolerance."""
+    """The history-backed gate core shared by ``detect``,
+    ``detect_slo`` and ``detect_skew``: median+MAD z against the key's
+    baseline, with ``direction`` deciding which way is worse ("high" =
+    bigger is worse; "low" = smaller is worse, ``ratio`` oriented so >1
+    always reads "this much worse"). ``abs_floor`` floors the noise
+    scale and ``abs_excess`` demands an absolute worsening — both 0 for
+    the time/SLO gates, nonzero for near-zero-baseline metrics (the
+    skew columns) where relative machinery alone flags 3x-of-nothing.
+    None when the row is within tolerance."""
     baseline = stats["median"]
-    if baseline <= 0.0:
+    if baseline <= 0.0 and abs_floor <= 0.0:
         return None
-    scale = max(stats["mad"], rel_floor * baseline)
+    scale = max(stats["mad"], rel_floor * baseline, abs_floor)
+    # ratio degrades to the robust scale as denominator when the true
+    # denominator is 0 (a zero-skew clean baseline, a zeroed goodput):
+    # still "this much worse", but FINITE — these findings land in
+    # ``--json`` documents, and bare Infinity is not valid JSON
     if direction == "low":
         z = (baseline - measured) / scale if scale > 0 else float("inf")
-        ratio = baseline / measured if measured > 0 else float("inf")
+        ratio = baseline / (measured if measured > 0 else scale)
+        excess = baseline - measured
     else:
         z = (measured - baseline) / scale if scale > 0 else float("inf")
-        ratio = measured / baseline
-    if not (z > z_tol and ratio > 1.0 + min_excess):
+        ratio = measured / (baseline if baseline > 0.0 else scale)
+        excess = measured - baseline
+    if not (z > z_tol and ratio > 1.0 + min_excess and excess >= abs_excess):
         return None
     return {
         **_ident(row),
@@ -259,6 +287,45 @@ def _rank(findings: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return history_backed + prior_only
 
 
+def _detect_metrics(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    specs,
+    exclude_run: Optional[str],
+    z_tol: float,
+    min_excess: float,
+    rel_floor: float,
+    decorate=None,
+) -> List[Dict[str, Any]]:
+    """The one per-metric history gate the SLO and skew detectors
+    share: every ``(metric, direction, abs_floor, abs_excess)`` spec
+    gated per key against its own baseline (rows that don't carry a
+    metric contribute nothing), ``decorate(finding, row)`` adding any
+    metric-family extras. Factored so the three gates ``detect_all``
+    merges can never drift apart on the gating loop itself."""
+    findings: List[Dict[str, Any]] = []
+    for metric, direction, abs_floor, abs_excess in specs:
+        base = baselines(history, metric=metric, exclude_run=exclude_run)
+        for row in current_rows:
+            measured = finite(row.get(metric))
+            if measured is None:
+                continue
+            key = row_key(row)
+            stats = base.get(key)
+            if stats is None:
+                continue
+            finding = _history_finding(
+                row, key, metric, measured, stats, direction,
+                z_tol, min_excess, rel_floor,
+                abs_floor=abs_floor, abs_excess=abs_excess,
+            )
+            if finding is not None:
+                if decorate is not None:
+                    decorate(finding, row)
+                findings.append(finding)
+    return _rank(findings)
+
+
 def detect_slo(
     current_rows: List[Dict[str, Any]],
     history: List[Dict[str, Any]],
@@ -280,24 +347,73 @@ def detect_slo(
     ``ratio`` is always worse/better oriented so >1 reads "this much
     worse" for both directions).
     """
-    findings: List[Dict[str, Any]] = []
-    for metric, direction in metrics:
-        base = baselines(history, metric=metric, exclude_run=exclude_run)
-        for row in current_rows:
-            measured = finite(row.get(metric))
-            if measured is None:
-                continue
-            key = row_key(row)
-            stats = base.get(key)
-            if stats is None:
-                continue
-            finding = _history_finding(
-                row, key, metric, measured, stats, direction,
-                z_tol, min_excess, rel_floor,
-            )
-            if finding is not None:
-                findings.append(finding)
-    return _rank(findings)
+    return _detect_metrics(
+        current_rows,
+        history,
+        [(metric, direction, 0.0, 0.0) for metric, direction in metrics],
+        exclude_run,
+        z_tol,
+        min_excess,
+        rel_floor,
+    )
+
+
+def detect_skew(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    metrics=SKEW_METRICS,
+    exclude_run: Optional[str] = None,
+    z_tol: float = Z_TOL,
+    min_excess: float = MIN_EXCESS,
+    rel_floor: float = REL_FLOOR,
+) -> List[Dict[str, Any]]:
+    """Cross-rank skew regression findings (ISSUE 14): every metric in
+    ``metrics`` gated per key against its own history baseline — a row
+    whose collectives suddenly wait much longer on a last arrival is a
+    straggler regression even when its measured time barely moves (the
+    timing MAX-reduce hides exactly this). History-backed only, with
+    the per-metric absolute floors described at ``SKEW_METRICS`` so
+    clean-run scheduler jitter can never alarm.
+
+    Finding shape matches ``detect``; each finding additionally carries
+    the row's ``straggler_rank`` and ``clock_unc_s`` so a report can
+    name the culprit without re-reading the row. A ``skew_enter_s``
+    excess inside the row's own clock-alignment uncertainty bound is
+    dropped — differences below the bound are noise by definition (the
+    fold carries it for exactly this) — and a row whose fold made NO
+    alignment claim at all (``clock_unc_s`` NaN: too few exchanges to
+    fit, raw possibly-cross-host stamps) never alarms on that metric.
+    ``straggler_frac`` is unitless and keeps only its absolute floor.
+    """
+
+    def _name_straggler(finding, row):
+        finding["straggler_rank"] = row.get("straggler_rank")
+        if "clock_unc_s" in row:
+            # None = the fold declined to align (NaN sentinel); rows
+            # without the column at all (older schema) carry no key
+            # and are not unc-gated
+            finding["clock_unc_s"] = finite(row.get("clock_unc_s"))
+
+    findings = _detect_metrics(
+        current_rows,
+        history,
+        metrics,
+        exclude_run,
+        z_tol,
+        min_excess,
+        rel_floor,
+        decorate=_name_straggler,
+    )
+    kept = []
+    for finding in findings:
+        if finding["metric"] == "skew_enter_s" and "clock_unc_s" in finding:
+            unc = finding["clock_unc_s"]
+            if unc is None:
+                continue  # no alignment claim -> no skew-seconds claim
+            if finding["measured_ms"] - finding["baseline_ms"] <= unc:
+                continue  # inside the bound: noise by definition
+        kept.append(finding)
+    return kept
 
 
 def detect_all(
@@ -310,9 +426,10 @@ def detect_all(
     prior_factor: float = PRIOR_FACTOR,
 ) -> List[Dict[str, Any]]:
     """The full gate: the default time metric (``detect``, perfmodel
-    prior included) PLUS every SLO metric (``detect_slo``), re-ranked
-    as one list so a serving SLO blow-up competes with — and can
-    outrank — a kernel-time regression in the same report."""
+    prior included) PLUS every SLO metric (``detect_slo``) PLUS the
+    cross-rank skew metrics (``detect_skew``), re-ranked as one list so
+    a serving SLO blow-up or a straggler regression competes with — and
+    can outrank — a kernel-time regression in the same report."""
     return _rank(
         detect(
             current_rows,
@@ -324,6 +441,14 @@ def detect_all(
             prior_factor=prior_factor,
         )
         + detect_slo(
+            current_rows,
+            history,
+            exclude_run=exclude_run,
+            z_tol=z_tol,
+            min_excess=min_excess,
+            rel_floor=rel_floor,
+        )
+        + detect_skew(
             current_rows,
             history,
             exclude_run=exclude_run,

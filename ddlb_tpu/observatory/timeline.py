@@ -1,0 +1,383 @@
+"""Cross-rank timeline observatory: clock-aligned world traces.
+
+The flight recorder (``ddlb_tpu/faults/flightrec.py``) answers "which
+rank, at which collective" by SEQUENCE number; this module adds the
+temporal join: per-rank entries aligned onto one world clock via the
+collective rendezvous exchanges the run already executed
+(``telemetry.clocksync`` — midpoint estimator over ``runtime.barrier``
+/ ``runtime.init`` spans, drift-fitted, uncertainty bound carried on
+every aligned event). From the merged timeline it derives:
+
+- a **per-collective skew table**: for every sequence-joined two-sided
+  collective, the aligned per-rank entry/exit stamps, the arrival
+  spread (time the collective waited on its last arrival), the
+  straggler rank, and the waited share of the collective's total time;
+- a **worst-rank ranking**: per rank, the skew-wait seconds it caused
+  as the last arrival and how often it was the straggler;
+- a **critical-path attribution** per rank: wall time split into
+  ``compute`` (between-collective work inside a timed measurement
+  window), ``host`` (between-collective time outside one — setup,
+  validation, bootstrap), ``skew_wait`` (inside a collective, before
+  its last arrival) and ``wire`` (inside a collective, after the last
+  arrival — the transfer itself).
+
+``scripts/skew_report.py`` renders the document; ``flight_report.py
+--json`` embeds the aligned event list so the sequence join and the
+time join ship in one document. Stdlib-only, like the rest of the
+observatory: the analysis runs post-hoc over JSONL files.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ddlb_tpu import telemetry
+from ddlb_tpu.faults import flightrec
+from ddlb_tpu.telemetry import clocksync
+
+#: sites with all-arrive-then-all-release semantics: their spans are
+#: comparable across ranks as ONE world collective per sequence number
+#: (runtime.mesh_build is deliberately absent — mesh construction is
+#: rank-local work that merely happens everywhere, not a rendezvous)
+TWO_SIDED_SITES = (
+    "runtime.init",
+    "runtime.barrier",
+    "runtime.collective",
+)
+
+#: worker.phase stage prefixes that bracket the timed measurement
+#: window — between-collective gaps inside it attribute to compute,
+#: outside it to host (setup / validation / bootstrap orchestration)
+_TIMING_BEGIN_PREFIX = "warmup done"
+_TIMING_END_PREFIX = "measured"
+
+
+def json_safe(obj: Any) -> Any:
+    """``obj`` with every non-finite float replaced by None — the
+    timeline documents carry honest inf/NaN sentinels (an unalignable
+    rank's uncertainty, a defaulted skew column), and ``json.dumps``
+    would otherwise emit bare ``Infinity``/``NaN``, which strict JSON
+    parsers (jq, JSON.parse) reject wholesale. Applied by every
+    ``--json`` renderer right before dumping."""
+    import math
+
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    return obj
+
+
+def read_rank_events(run_dir: str) -> Dict[int, List[Dict[str, Any]]]:
+    """Per-rank flight-recorder events under ``run_dir``, reduced to
+    each rank's dominant pid stream — discovery, parsing, and pid
+    selection all shared with the sequence join (``flightrec.rank_files``
+    / ``read_rank_file`` / ``dominant_stream``), so the two joins
+    cannot diverge on what counts as a rank's record."""
+    ranks: Dict[int, List[Dict[str, Any]]] = {}
+    for rank, path in flightrec.rank_files(run_dir).items():
+        stream = flightrec.dominant_stream(flightrec.read_rank_file(path))
+        if stream:
+            ranks[rank] = stream
+    return ranks
+
+
+def pair_spans(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Join one rank's B/E transitions by sequence number into spans;
+    instants (``I``) become zero-width entries, un-ended ``B`` entries
+    (a wedged or killed collective) keep ``t1: None``."""
+    spans: Dict[int, Dict[str, Any]] = {}
+    order: List[int] = []
+    for event in events:
+        ph = event.get("ph")
+        if ph not in ("B", "E", "I"):
+            continue
+        try:
+            seq = int(event.get("seq", 0))
+        except (TypeError, ValueError):
+            continue
+        if ph == "B":
+            spans[seq] = {
+                "seq": seq,
+                "site": str(event.get("site", "")),
+                "t0": float(event.get("t", 0.0)),
+                "t1": None,
+                "ph": "span",
+                "stage": event.get("stage"),
+                "impl": event.get("impl"),
+            }
+            order.append(seq)
+        elif ph == "E" and seq in spans:
+            spans[seq]["t1"] = float(event.get("t", 0.0))
+        elif ph == "I":
+            t = float(event.get("t", 0.0))
+            spans[seq] = {
+                "seq": seq,
+                "site": str(event.get("site", "")),
+                "t0": t,
+                "t1": t,
+                "ph": "instant",
+                "stage": event.get("stage"),
+                "impl": event.get("impl"),
+            }
+            order.append(seq)
+    return [spans[seq] for seq in order]
+
+
+def _exchange_spans(
+    spans_by_rank: Dict[int, List[Dict[str, Any]]],
+    sites: Sequence[str],
+) -> Dict[int, Dict[int, Dict[str, Any]]]:
+    """``{seq: {rank: span}}`` for sequence numbers where EVERY rank
+    completed a span at the same site in ``sites`` — the world
+    collectives the sequence join certifies as one event."""
+    ranks = sorted(spans_by_rank)
+    per_rank = {
+        rank: {
+            s["seq"]: s
+            for s in spans_by_rank[rank]
+            if s["ph"] == "span" and s["t1"] is not None
+            and s["site"] in sites
+        }
+        for rank in ranks
+    }
+    if not ranks:
+        return {}
+    shared = set.intersection(*(set(m) for m in per_rank.values()))
+    out: Dict[int, Dict[int, Dict[str, Any]]] = {}
+    for seq in sorted(shared):
+        site = per_rank[ranks[0]][seq]["site"]
+        if all(per_rank[r][seq]["site"] == site for r in ranks):
+            out[seq] = {r: per_rank[r][seq] for r in ranks}
+    return out
+
+
+def _timing_windows(
+    spans: Sequence[Dict[str, Any]], align
+) -> List[List[float]]:
+    """Aligned [begin, end] measurement windows from a rank's
+    ``worker.phase`` marks (open windows close at +inf)."""
+    windows: List[List[float]] = []
+    for span in spans:
+        if span["site"] != "worker.phase" or span.get("stage") is None:
+            continue
+        stage = str(span["stage"])
+        t = align(span["t0"])
+        if stage.startswith(_TIMING_BEGIN_PREFIX):
+            windows.append([t, float("inf")])
+        elif stage.startswith(_TIMING_END_PREFIX) and windows and (
+            windows[-1][1] == float("inf")
+        ):
+            windows[-1][1] = t
+    return windows
+
+
+def _in_windows(t: float, windows: Sequence[Sequence[float]]) -> bool:
+    return any(w[0] <= t <= w[1] for w in windows)
+
+
+def build_world_timeline(
+    run_dir: str, expected_ranks: Optional[int] = None
+) -> Dict[str, Any]:
+    """The merged, clock-aligned world timeline of one flight-recorder
+    run dir — see the module docstring for the document's sections."""
+    with telemetry.span("timeline.merge", cat="timeline"):
+        return _build(run_dir, expected_ranks)
+
+
+def _build(run_dir: str, expected_ranks: Optional[int]) -> Dict[str, Any]:
+    rank_events = read_rank_events(run_dir)
+    spans_by_rank = {
+        rank: pair_spans(events) for rank, events in rank_events.items()
+    }
+    ranks = sorted(spans_by_rank)
+    missing = (
+        [r for r in range(expected_ranks) if r not in spans_by_rank]
+        if expected_ranks
+        else []
+    )
+    doc: Dict[str, Any] = {
+        "run_dir": run_dir,
+        "ranks": ranks,
+        "missing_ranks": missing,
+    }
+    if not ranks:
+        doc.update(
+            alignment="none", offsets={}, events=[], collectives=[],
+            attribution={}, worst_ranks=[], total_skew_s=0.0,
+            headline=f"no flight files under {run_dir}",
+        )
+        return doc
+
+    # -- offset fit over the certified exchange collectives ------------
+    fit_exchanges = _exchange_spans(spans_by_rank, clocksync.FIT_SITES)
+    fits = clocksync.fit_offsets(
+        {
+            rank: [
+                (fit_exchanges[seq][rank]["t0"], fit_exchanges[seq][rank]["t1"])
+                for seq in sorted(fit_exchanges)
+            ]
+            for rank in ranks
+        }
+    )
+    # same minimum-exchange guard as the in-row fold: one or two
+    # exchanges are not a clock model — a genuinely late rank at the
+    # only barrier would become its "offset", halving the real skew
+    # and shifting blame onto the innocent peer (raw stamps are exact
+    # on one host; a multi-host dir without enough exchanges honestly
+    # reports alignment "none")
+    aligned = (
+        len(ranks) > 1
+        and len(fit_exchanges) >= clocksync.MIN_FIT_EXCHANGES
+    )
+    doc["alignment"] = "barrier" if aligned else "none"
+    doc["offsets"] = {rank: fits[rank].as_dict() for rank in ranks}
+
+    def align(rank: int, t: Optional[float]) -> Optional[float]:
+        if t is None:
+            return None
+        return fits[rank].align(t) if aligned else t
+
+    # -- the merged event list (every entry, aligned + uncertainty) ----
+    origin = min(
+        (
+            align(rank, s["t0"])
+            for rank in ranks
+            for s in spans_by_rank[rank]
+        ),
+        default=0.0,
+    )
+    events: List[Dict[str, Any]] = []
+    for rank in ranks:
+        unc = fits[rank].uncertainty_s if aligned else 0.0
+        for span in spans_by_rank[rank]:
+            t0 = align(rank, span["t0"])
+            t1 = align(rank, span["t1"])
+            events.append(
+                {
+                    "rank": rank,
+                    "seq": span["seq"],
+                    "site": span["site"],
+                    "ph": span["ph"],
+                    "ts": span["t0"],
+                    "aligned_ts": t0,
+                    "rel_s": t0 - origin,
+                    "dur_s": (t1 - t0) if t1 is not None else None,
+                    "unc_s": unc,
+                    **(
+                        {"stage": span["stage"]}
+                        if span.get("stage") is not None
+                        else {}
+                    ),
+                }
+            )
+    events.sort(key=lambda e: (e["aligned_ts"], e["rank"], e["seq"]))
+    doc["events"] = events
+
+    # -- per-collective skew table --------------------------------------
+    world = _exchange_spans(spans_by_rank, TWO_SIDED_SITES)
+    collectives: List[Dict[str, Any]] = []
+    caused = {rank: 0.0 for rank in ranks}
+    strag_counts = {rank: 0 for rank in ranks}
+    total_skew = 0.0
+    unc_total = max(
+        (fits[r].uncertainty_s for r in ranks if r != fits[r].ref_rank),
+        default=0.0,
+    ) if aligned else 0.0
+    releases: Dict[int, float] = {}  # per-seq release, reused below
+    for seq in sorted(world):
+        per_rank = world[seq]
+        enters = {r: align(r, per_rank[r]["t0"]) for r in ranks}
+        exits = {r: align(r, per_rank[r]["t1"]) for r in ranks}
+        first = min(enters.values())
+        release = max(enters.values())
+        releases[seq] = release
+        end = max(exits.values())
+        skew = release - first
+        straggler = max(ranks, key=lambda r: enters[r])
+        total = max(end - first, 0.0)
+        collectives.append(
+            {
+                "seq": seq,
+                "site": per_rank[ranks[0]]["site"],
+                "rel_s": first - origin,
+                "skew_enter_s": skew,
+                "skew_exit_s": max(exits.values()) - min(exits.values()),
+                "total_s": total,
+                "straggler_rank": straggler if skew > 0.0 else -1,
+                "straggler_frac": skew / total if total > 0.0 else 0.0,
+                "unc_s": unc_total,
+                "ranks": {
+                    r: {
+                        "enter_s": enters[r] - origin,
+                        "exit_s": exits[r] - origin,
+                        "late_s": enters[r] - first,
+                    }
+                    for r in ranks
+                },
+            }
+        )
+        total_skew += skew
+        caused[straggler] += skew
+        if skew > 0.0:
+            strag_counts[straggler] += 1
+    doc["collectives"] = collectives
+    doc["total_skew_s"] = total_skew
+
+    # -- worst-rank ranking ---------------------------------------------
+    doc["worst_ranks"] = [
+        {
+            "rank": rank,
+            "caused_skew_s": caused[rank],
+            "straggler_count": strag_counts[rank],
+        }
+        for rank in sorted(ranks, key=lambda r: -caused[r])
+    ]
+
+    # -- critical-path attribution per rank ------------------------------
+    attribution: Dict[int, Dict[str, float]] = {}
+    for rank in ranks:
+        windows = _timing_windows(
+            spans_by_rank[rank], lambda t, _r=rank: align(_r, t)
+        )
+        acc = {"compute_s": 0.0, "wire_s": 0.0, "skew_wait_s": 0.0,
+               "host_s": 0.0}
+        prev_exit: Optional[float] = None
+        for seq in sorted(world):
+            per_rank = world[seq]
+            enter = align(rank, per_rank[rank]["t0"])
+            exit_ = align(rank, per_rank[rank]["t1"])
+            release = releases[seq]
+            if prev_exit is not None and enter > prev_exit:
+                gap = enter - prev_exit
+                mid = (prev_exit + enter) / 2.0
+                key = "compute_s" if _in_windows(mid, windows) else "host_s"
+                acc[key] += gap
+            acc["skew_wait_s"] += max(0.0, min(release, exit_) - enter)
+            acc["wire_s"] += max(0.0, exit_ - max(release, enter))
+            prev_exit = exit_
+        attribution[rank] = acc
+    doc["attribution"] = attribution
+
+    # -- headline --------------------------------------------------------
+    if not collectives:
+        doc["headline"] = (
+            f"{len(ranks)} rank(s), no sequence-joined two-sided "
+            f"collectives — nothing to attribute"
+        )
+    elif total_skew <= 0.0:
+        doc["headline"] = (
+            f"{len(collectives)} collective(s) across {len(ranks)} "
+            f"rank(s); zero arrival skew"
+        )
+    else:
+        worst = doc["worst_ranks"][0]
+        doc["headline"] = (
+            f"rank {worst['rank']} caused "
+            f"{worst['caused_skew_s']:.3f}s of {total_skew:.3f}s total "
+            f"arrival skew across {len(collectives)} collective(s) "
+            f"(last arrival {worst['straggler_count']}x)"
+        )
+    return doc

@@ -29,6 +29,7 @@ from typing import Optional, Sequence, Tuple
 
 from ddlb_tpu import envs, faults, telemetry
 from ddlb_tpu.faults import flightrec
+from ddlb_tpu.telemetry import clocksync
 
 _SIM_FLAG = "--xla_force_host_platform_device_count"
 
@@ -129,6 +130,19 @@ def shard_map_compat(fn, mesh, in_specs, out_specs, check_vma=None):
     return shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
     )
+
+
+def set_mesh_compat(mesh):
+    """``jax.set_mesh(mesh)`` where available; on pre-0.5 JAX the mesh
+    itself (``Mesh`` is a context manager there, and the legacy global
+    mesh context is the analogous "make this the ambient mesh" form).
+    The model layer's ``with set_mesh_compat(mesh):`` blocks work on
+    both — the version bridge the shard_map_compat migration rides."""
+    import jax
+
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return mesh
 
 
 def reshard_compat(x, sharding):
@@ -430,6 +444,12 @@ class Runtime:
         # wedged transport mid-sweep (e.g. hang = a peer that never
         # arrives; the subprocess parent's heartbeat kill recovers it)
         faults.inject("runtime.barrier")
+        # the clock-sync exchange stamps bracket everything AFTER the
+        # injection site: a fault-delayed rank arrives late on its own
+        # stamp, exactly what the skew fold must attribute. Monotonic
+        # stamps (system-wide on one host; the offset fit is what makes
+        # them comparable across hosts).
+        t_enter = time.monotonic()
         # flight-recorded AFTER the injection site: a rank the plan
         # hangs/kills here never begins the entry, so the post-mortem
         # join shows it lagging while its peers sit in-flight in the
@@ -472,6 +492,18 @@ class Runtime:
             out.block_until_ready()
             # summed per row into the ``barrier_wait_s`` CSV column
             telemetry.record("barrier_wait_s", time.perf_counter() - t0)
+        # two-sided exchange record: the barrier span is a clock-sync
+        # exchange point (no rank exits before the last one enters), so
+        # its enter/exit stamps feed BOTH the row skew fold and the
+        # post-hoc world-timeline offset fit. The instant additionally
+        # anchors this process's monotonic clock to the trace shard's
+        # epoch timestamps (a no-op unless DDLB_TPU_TRACE is set).
+        t_exit = time.monotonic()
+        clocksync.record_span("runtime.barrier", t_enter, t_exit)
+        telemetry.instant(
+            "clocksync.exchange", cat="clocksync", mono_t=t_exit,
+            site="runtime.barrier",
+        )
 
     def __repr__(self) -> str:
         return (

@@ -75,6 +75,27 @@ ROW_COLUMNS: Dict[str, str] = {
     "phase_compute_s": "model compute-phase floor (MXU term, seconds)",
     "phase_comm_s": "model comm-phase floor (wire term, seconds)",
     "phase_idle_s": "measured time no roofline term explains (overhead)",
+    # -- cross-rank skew attribution (ISSUE 14: telemetry/clocksync.py
+    #    fold over the row's collective entry/exit stamps, clocks
+    #    aligned on the row's own barrier exchanges; defaults on
+    #    single-process rows) ---------------------------------------------
+    "skew_enter_s": (
+        "summed arrival skew: per collective, how long it waited on its"
+        " last-arriving rank (aligned max enter - min enter), seconds"
+    ),
+    "skew_exit_s": "summed collective exit spread (aligned), seconds",
+    "straggler_rank": (
+        "process id that caused the most arrival-skew seconds as the"
+        " last arrival; -1 when no skew / single-process"
+    ),
+    "straggler_frac": (
+        "skew_enter_s / total collective time: the share of the row's"
+        " collective wall time spent waiting on last arrivals, in [0,1]"
+    ),
+    "clock_unc_s": (
+        "worst-rank clock-alignment uncertainty bound (midpoint"
+        " estimator, telemetry/clocksync.py) the skew columns carry"
+    ),
     # -- robustness / self-healing (PR 4) -------------------------------
     "retries": "retry attempts this row consumed before its final state",
     "fault_injected": "fault-plan sites that fired under this row (csv)",

@@ -42,6 +42,8 @@ SPAN_NAMES: Dict[str, str] = {
     "serve.run": "serving engine full run loop",
     "sim.replay": "simulator discrete-event replay of one schedule program",
     "sim.validate": "simulator validation pass (closed-form or history join)",
+    "skew.fold": "cross-rank skew fold: stamp allgather + clock-aligned fold",
+    "timeline.merge": "world-timeline build over a flight-recorder run dir",
     "worker.profile": "benchmark_worker optional profiling phase",
     "worker.row": "benchmark_worker one full row (the report join key)",
     "worker.setup": "benchmark_worker input/mesh setup phase",
@@ -53,6 +55,11 @@ SPAN_NAMES: Dict[str, str] = {
 
 #: zero-duration markers (``telemetry.instant``)
 INSTANT_NAMES: Dict[str, str] = {
+    "clocksync.exchange": (
+        "clock-sync anchor: a barrier exit's monotonic stamp next to "
+        "the trace event's epoch ts (maps trace shards onto the "
+        "aligned world timeline)"
+    ),
     "fault.inject": "a fault rule fired at an injection site",
     "launch.abort": "supervised launcher aborted the world (silence/death)",
     "launch.relaunch": "supervised launcher relaunching a transient-failed world",

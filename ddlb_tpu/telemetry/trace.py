@@ -63,6 +63,10 @@ class Tracer:
             f"trace-{self.host}-p{self.rank}-{self.pid}.jsonl",
         )
         self._lock = threading.Lock()
+        #: per-tracer emission counter, stamped onto every event as
+        #: ``seq`` — the within-(pid, tid) tie-breaker that makes the
+        #: shard merge deterministic for equal-microsecond timestamps
+        self._seq = 0
         self._file = open(self.path, "a", encoding="utf-8")
         # Chrome metadata event: name this pid's track by rank@host so a
         # merged multi-process trace stays attributable
@@ -77,8 +81,10 @@ class Tracer:
         )
 
     def emit(self, event: Dict[str, Any]) -> None:
-        line = json.dumps(event, default=str)
         with self._lock:
+            self._seq += 1
+            event.setdefault("seq", self._seq)
+            line = json.dumps(event, default=str)
             try:
                 self._file.write(line + "\n")
                 self._file.flush()
@@ -246,6 +252,29 @@ def read_events(directory: str) -> List[Dict[str, Any]]:
     return events
 
 
+def _merge_sort_key(event: Dict[str, Any]) -> tuple:
+    """Deterministic merge order: metadata events first (they name the
+    tracks and carry no ``ts``), then timestamp — tie-broken by
+    ``(pid, tid, seq)`` so equal-microsecond spans from different
+    processes cannot reorder across merges (ts alone left the order at
+    the mercy of shard filenames, which embed pids that change every
+    run)."""
+
+    def _num(value, default=0.0):
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return default
+
+    return (
+        0 if event.get("ph") == "M" else 1,
+        _num(event.get("ts"), float("-inf")),
+        int(_num(event.get("pid"))),
+        int(_num(event.get("tid"))),
+        int(_num(event.get("seq"))),
+    )
+
+
 def merge_trace(directory: Optional[str] = None) -> Optional[str]:
     """Merge every per-process shard under ``directory`` (default: the
     configured trace dir) into ``trace.json`` — the Chrome trace_event
@@ -258,6 +287,7 @@ def merge_trace(directory: Optional[str] = None) -> Optional[str]:
     events = read_events(directory)
     if not events:
         return None
+    events.sort(key=_merge_sort_key)
     out = os.path.join(directory, "trace.json")
     tmp = out + ".tmp"
     with open(tmp, "w", encoding="utf-8") as f:

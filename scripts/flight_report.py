@@ -21,9 +21,13 @@ Usage:
 
 ``--ranks N`` flags ranks that left no flight file at all (killed
 before recording anything). ``--json`` emits the full report document
-for the chaos battery / CI. Exit code: 0 when the world shows no
-divergence, 1 when it does (or no files were found) — so a supervised
-wrapper can gate on the verdict.
+for the chaos battery / CI — including the TIME join (ISSUE 14): every
+entry with an ``aligned_ts`` (clock-sync offsets from the run's own
+barrier exchanges applied when available, raw monotonic otherwise,
+``alignment: none|barrier`` flagged) plus the per-rank offset fits, so
+the sequence join and the time join render from one document. Exit
+code: 0 when the world shows no divergence, 1 when it does (or no
+files were found) — so a supervised wrapper can gate on the verdict.
 """
 
 from __future__ import annotations
@@ -120,6 +124,20 @@ def main(argv=None) -> int:
     report = flightrec.analyze_run(args.run_dir, expected_ranks=args.ranks)
     if args.as_json:
         report["static_trace"] = static_cross_reference(report)
+        # the time join rides the same document (ISSUE 14): every entry
+        # with its clock-aligned timestamp + uncertainty, the per-rank
+        # offset fits, and the alignment mode flag
+        from ddlb_tpu.observatory import timeline as timeline_mod
+
+        world = timeline_mod.build_world_timeline(
+            args.run_dir, expected_ranks=args.ranks
+        )
+        report["alignment"] = world["alignment"]
+        report["clock_offsets"] = world["offsets"]
+        report["entries"] = world["events"]
+        # non-finite sentinels (an unalignable rank's inf uncertainty)
+        # must not become bare Infinity — strict parsers reject it
+        report = timeline_mod.json_safe(report)
         print(json.dumps(report, indent=1, default=str))
     else:
         print(render_text(report))

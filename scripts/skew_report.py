@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Cross-rank skew report: the world timeline, stragglers, and the gate.
+
+The temporal post-mortem of a multi-process run (ISSUE 14): where
+``flight_report.py`` joins ranks by SEQUENCE number, this report joins
+them by TIME — per-rank flight-recorder stamps aligned onto one world
+clock via the run's own barrier exchanges (midpoint estimator + drift
+fit, ``ddlb_tpu/telemetry/clocksync.py``), then folded into:
+
+- the per-rank clock-offset table (offset, drift, uncertainty bound);
+- the per-collective skew table: which collective waited how long on
+  its last arrival, who arrived last, and the waited share of the
+  collective's wall time;
+- the worst-rank ranking (skew-seconds each rank caused as the last
+  arrival) and the per-rank critical-path attribution — wall time
+  split into compute / wire / skew-wait / host;
+- with ``--history``, the observatory skew GATE: the named run's
+  banked rows (``straggler_frac`` / ``skew_enter_s`` columns) against
+  the per-key history baseline (``regress.detect_skew`` — median+MAD
+  with absolute noise floors), findings ranked worst first.
+
+Usage:
+    python scripts/skew_report.py RUN_DIR [--ranks N] [--json]
+        [--history DIR] [--run RUN_ID] [--top N]
+
+Exit code: 1 when the gate flags a regression (or RUN_DIR has no
+flight files), 0 otherwise — so CI and the demo can gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlb_tpu.observatory import regress, store, timeline  # noqa: E402
+
+
+def _fmt_s(value, width=9):
+    try:
+        return f"{float(value):{width}.4f}"
+    except (TypeError, ValueError):
+        return " " * (width - 1) + "-"
+
+
+def render_text(doc: dict, top: int = 12) -> str:
+    """The human form: alignment, skew table, ranking, attribution."""
+    lines = [f"skew report: {doc['run_dir']}", ""]
+    ranks = doc.get("ranks", [])
+    offsets = doc.get("offsets", {})
+    n_ex = max(
+        (o.get("n_exchanges", 0) for o in offsets.values()), default=0
+    )
+    lines.append(
+        f"clock alignment: {doc.get('alignment')} "
+        f"({len(ranks)} rank(s), {n_ex} exchange(s))"
+    )
+    for rank in ranks:
+        fit = offsets.get(rank, {})
+        if fit.get("rank") == fit.get("ref_rank"):
+            continue
+        lines.append(
+            f"  rank {rank}: offset {fit.get('offset_s', 0.0):+.6f}s "
+            f"± {fit.get('uncertainty_s', 0.0):.6f}s  "
+            f"(drift {fit.get('drift_per_s', 0.0):+.2e}/s over "
+            f"{fit.get('n_exchanges', 0)} exchange(s))"
+        )
+    for rank in doc.get("missing_ranks", []):
+        lines.append(f"  rank {rank}: no flight file")
+
+    collectives = doc.get("collectives", [])
+    worst = sorted(
+        collectives, key=lambda c: -c.get("skew_enter_s", 0.0)
+    )[:top]
+    lines.append("")
+    lines.append(
+        f"collectives ({len(collectives)} joined; worst arrival skew "
+        f"first, top {len(worst)}):"
+    )
+    lines.append(
+        f"  {'seq':>5} {'site':<22} {'t+s':>9} {'skew_enter':>10} "
+        f"{'skew_exit':>9} {'total':>9} {'frac':>6}  straggler"
+    )
+    for c in worst:
+        strag = c.get("straggler_rank", -1)
+        lines.append(
+            f"  {c['seq']:>5} {c['site']:<22} {_fmt_s(c.get('rel_s'))} "
+            f"{_fmt_s(c.get('skew_enter_s'), 10)} "
+            f"{_fmt_s(c.get('skew_exit_s'))} {_fmt_s(c.get('total_s'))} "
+            f"{c.get('straggler_frac', 0.0):>6.2f}  "
+            f"{'rank ' + str(strag) if strag >= 0 else '-'}"
+        )
+
+    lines.append("")
+    lines.append("per-rank attribution (compute / wire / skew-wait / host):")
+    for rank in ranks:
+        acc = doc.get("attribution", {}).get(rank, {})
+        lines.append(
+            f"  rank {rank}: compute {_fmt_s(acc.get('compute_s'))}s  "
+            f"wire {_fmt_s(acc.get('wire_s'))}s  "
+            f"skew-wait {_fmt_s(acc.get('skew_wait_s'))}s  "
+            f"host {_fmt_s(acc.get('host_s'))}s"
+        )
+
+    lines.append("")
+    lines.append("worst ranks (skew-seconds caused as the last arrival):")
+    for entry in doc.get("worst_ranks", []):
+        lines.append(
+            f"  rank {entry['rank']}: {entry['caused_skew_s']:.4f}s "
+            f"across {entry['straggler_count']} collective(s)"
+        )
+    lines.append("")
+    lines.append(f"verdict: {doc.get('headline', '')}")
+    return "\n".join(lines)
+
+
+def render_findings(findings: list) -> str:
+    if not findings:
+        return "gate: clean — no skew regression against history"
+    lines = [f"gate: {len(findings)} skew regression finding(s), worst first:"]
+    for f in findings:
+        lines.append(
+            f"  {f.get('metric')}: {f.get('measured_ms'):.4f} vs baseline "
+            f"{f.get('baseline_ms'):.4f} (z={f.get('z'):.1f}, "
+            f"x{f.get('ratio'):.2f}) straggler rank "
+            f"{f.get('straggler_rank')} — {f.get('implementation')} "
+            f"[{f.get('primitive')} {f.get('m')}x{f.get('n')}x{f.get('k')}]"
+        )
+    return "\n".join(lines)
+
+
+def gate(history_dir: str, run_id):
+    """(current_rows, findings): the named run's banked rows gated by
+    ``regress.detect_skew`` against the rest of the history. Default
+    run: the latest ``run_id`` in the bank."""
+    records = store.load_history(history_dir)
+    if run_id is None:
+        row_records = [r for r in records if r.get("kind", "row") == "row"]
+        run_id = row_records[-1].get("run_id") if row_records else None
+    current = [
+        r["row"]
+        for r in records
+        if r.get("run_id") == run_id and r.get("kind", "row") == "row"
+    ]
+    findings = regress.detect_skew(current, records, exclude_run=run_id)
+    return run_id, current, findings
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("run_dir", help="flight-recorder run directory")
+    parser.add_argument(
+        "--ranks", type=int, default=None,
+        help="expected world size (flags ranks that left no file)",
+    )
+    parser.add_argument(
+        "--history", default=None,
+        help="observatory history dir: run the skew gate against it",
+    )
+    parser.add_argument(
+        "--run", default=None,
+        help="run_id to gate (default: the latest banked run)",
+    )
+    parser.add_argument(
+        "--top", type=int, default=12,
+        help="collectives shown in the skew table (worst first)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    doc = timeline.build_world_timeline(
+        args.run_dir, expected_ranks=args.ranks
+    )
+    findings = []
+    run_id = None
+    if args.history:
+        run_id, _, findings = gate(args.history, args.run)
+
+    if args.as_json:
+        out = {"timeline": doc, "gated_run": run_id, "findings": findings}
+        print(json.dumps(timeline.json_safe(out), indent=1, default=str))
+    else:
+        print(render_text(doc, top=args.top))
+        if args.history:
+            print()
+            print(f"gated run: {run_id}")
+            print(render_findings(findings))
+    if not doc.get("ranks"):
+        return 1
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

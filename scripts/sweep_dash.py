@@ -134,6 +134,26 @@ def _serving_lines(state):
     return lines
 
 
+def _lane_lines(state):
+    """The per-rank skew lane panel (ISSUE 14; empty when no completed
+    row ever named a straggler): per process id, how many rows blamed
+    it, its accumulated arrival-skew seconds, the latest frac."""
+    lanes = state.get("lanes") or {}
+    if not lanes:
+        return []
+    worst = max(lanes, key=lambda r: lanes[r].get("skew_s") or 0.0)
+    lines = ["", "rank lanes (straggler attribution):"]
+    for rank in sorted(lanes, key=lambda r: int(r)):
+        lane = lanes[rank]
+        mark = "  <- worst" if rank == worst and len(lanes) > 1 else ""
+        lines.append(
+            f"  p{rank}: straggler in {lane.get('straggler_rows', 0)} "
+            f"row(s), skew {_fmt(lane.get('skew_s'), '{:.3f}')}s, "
+            f"last frac {_fmt(lane.get('last_frac'), '{:.2f}')}{mark}"
+        )
+    return lines
+
+
 def _unknown_note(state):
     """One line naming event kinds this dashboard build doesn't know —
     the forward-compat guard (a newer runner sharing the stream must
@@ -213,6 +233,7 @@ def render_text(state, width=96):
             f"{_fmt(e.get('measured_overlap_frac')):>8}  "
             f"{' '.join(flags)}"
         )
+    lines.extend(_lane_lines(state))
     lines.extend(_serving_lines(state))
     note = _unknown_note(state)
     if note:
@@ -331,6 +352,25 @@ def render_html(state, source=""):
             f'<div class="l">{esc(label)}</div></div>'
         )
     out.append("</div>")
+
+    lanes = state.get("lanes") or {}
+    if lanes:
+        out.append('<table><caption>Rank lanes (straggler attribution)'
+                   "</caption>")
+        out.append(
+            "<tr><th>rank</th><th class=num>straggler rows</th>"
+            "<th class=num>skew (s)</th><th class=num>last frac</th></tr>"
+        )
+        for rank in sorted(lanes, key=lambda r: int(r)):
+            lane = lanes[rank]
+            out.append(
+                f"<tr><td>p{esc(str(rank))}</td>"
+                f"<td class=num>{lane.get('straggler_rows', 0)}</td>"
+                f"<td class=num>{_fmt(lane.get('skew_s'), '{:.3f}')}</td>"
+                f"<td class=num>{_fmt(lane.get('last_frac'), '{:.2f}')}"
+                f"</td></tr>"
+            )
+        out.append("</table>")
 
     serving = state.get("serving") or {}
     latest = serving.get("latest")

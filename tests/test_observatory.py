@@ -423,6 +423,73 @@ def test_fold_matches_phase_marks_across_pids(monkeypatch, tmp_path):
     assert state["current"][100]["stage"] == "warmup done; measuring"
 
 
+def test_fold_interleaved_multi_rank_writers(monkeypatch, tmp_path):
+    """The dashboard's multi-process blind spot (ISSUE 14 satellite):
+    two writer pids interleave their row lifecycles on one stream, one
+    of them tears its tail mid-append, and a third batch arrives with
+    out-of-order timestamps. The fold must keep the two in-flight rows
+    separate, count every completion, fold the skew lanes, and produce
+    the SAME state incrementally as in one pass."""
+    path = str(tmp_path / "live.jsonl")
+    monkeypatch.setenv("DDLB_TPU_LIVE", path)
+    a, b = 111, 222  # two runner pids sharing the stream
+    interleaved = [
+        {"ts": 1.0, "pid": a, "kind": "sweep_start", "total": 2},
+        {"ts": 1.1, "pid": b, "kind": "sweep_start", "total": 1},
+        {"ts": 2.0, "pid": a, "kind": "row_start", "impl": "x_0",
+         "primitive": "tp_columnwise", "m": 1, "n": 1, "k": 1},
+        {"ts": 2.1, "pid": b, "kind": "row_start", "impl": "y_0",
+         "primitive": "dp_allreduce", "m": 2, "n": 2, "k": 2},
+        {"ts": 2.5, "pid": a, "kind": "row_phase", "impl": "x_0",
+         "stage": "warmup done; measuring"},
+        {"ts": 2.6, "pid": b, "kind": "row_phase", "impl": "y_0",
+         "stage": "setup begin"},
+        {"ts": 3.0, "pid": a, "kind": "row_done", "impl": "x_0",
+         "median_ms": 1.5, "straggler_rank": 1, "skew_enter_s": 0.4,
+         "straggler_frac": 0.8},
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        for event in interleaved:
+            f.write(json.dumps(event) + "\n")
+        # writer b dies mid-append: a torn, newline-less tail
+        f.write('{"ts": 3.1, "pid": 222, "kind": "row_do')
+    events, offset = live.read_events(path)
+    state = live.fold(events)
+    # the torn line is deferred, so b's row is still in flight with its
+    # OWN phase — never cross-attached to a's row
+    assert state["totals"]["total"] == 3
+    assert state["totals"]["done"] == 1
+    assert set(state["current"]) == {b}
+    assert state["current"][b]["stage"] == "setup begin"
+    assert state["lanes"]["1"]["straggler_rows"] == 1
+    assert state["lanes"]["1"]["skew_s"] == pytest.approx(0.4)
+    assert state["lanes"]["1"]["last_frac"] == pytest.approx(0.8)
+
+    # writer b recovers and completes; events land with out-of-order
+    # timestamps (cross-process appends interleave arbitrarily)
+    tail = [
+        {"ts": 4.0, "pid": b, "kind": "row_done", "impl": "y_0",
+         "median_ms": 9.9, "straggler_rank": 0, "skew_enter_s": 0.1,
+         "straggler_frac": 0.3},
+        {"ts": 3.5, "pid": a, "kind": "sweep_done", "rows": 2},
+    ]
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("\n")  # the torn line stays torn (skipped as corrupt)
+        for event in tail:
+            f.write(json.dumps(event) + "\n")
+    more, _ = live.read_events(path, offset)
+    state = live.fold(more, state)
+    assert state["totals"]["done"] == 2
+    assert state["current"] == {}
+    assert state["sweep_done"] is True
+    assert state["last_ts"] == 4.0  # out-of-order ts never regresses it
+    assert set(state["lanes"]) == {"0", "1"}
+
+    # one-pass fold over the full file equals the incremental fold
+    all_events, _ = live.read_events(path)
+    assert live.fold(all_events) == state
+
+
 def test_live_tolerates_torn_multibyte_tail(monkeypatch, tmp_path):
     path = _seed_live(monkeypatch, tmp_path)
     with open(path, "ab") as f:

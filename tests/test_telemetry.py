@@ -133,6 +133,73 @@ def test_subprocess_shard_merge(trace_dir):
     assert len(pids) == 2  # genuinely two processes on one timeline
 
 
+def test_merge_is_deterministic_for_equal_timestamps(tmp_path):
+    """ISSUE 14 satellite: equal-microsecond spans from different pids
+    must not reorder across merges — the merge sorts by ts with a
+    ``(pid, tid, seq)`` tie-break, so the output is a pure function of
+    the shard CONTENTS (shard filenames embed pids that change every
+    run and must not decide the order)."""
+    from ddlb_tpu.telemetry import trace as trace_mod
+
+    def shard(name, events):
+        with open(tmp_path / name, "w", encoding="utf-8") as f:
+            for event in events:
+                f.write(json.dumps(event) + "\n")
+
+    # two pids, every span at the SAME ts; within pid 7, two tids and
+    # within one tid two emissions (the seq tie-break)
+    shard(
+        "trace-host-p0-9.jsonl",
+        [
+            {"ph": "M", "name": "process_name", "pid": 9, "tid": 0,
+             "args": {"name": "p1@host"}},
+            {"ph": "X", "name": "b2", "ts": 100.0, "dur": 1.0, "pid": 9,
+             "tid": 1, "seq": 2},
+            {"ph": "X", "name": "b1", "ts": 100.0, "dur": 1.0, "pid": 9,
+             "tid": 1, "seq": 1},
+        ],
+    )
+    shard(
+        "trace-host-p0-7.jsonl",
+        [
+            {"ph": "X", "name": "a2", "ts": 100.0, "dur": 1.0, "pid": 7,
+             "tid": 5, "seq": 1},
+            {"ph": "X", "name": "a1", "ts": 100.0, "dur": 1.0, "pid": 7,
+             "tid": 3, "seq": 1},
+        ],
+    )
+    merged = telemetry.merge_trace(str(tmp_path))
+    with open(merged) as f:
+        first = [e["name"] for e in json.load(f)["traceEvents"]]
+    # metadata first, then (pid, tid, seq) inside the equal-ts group
+    assert first == ["process_name", "a1", "a2", "b1", "b2"]
+    # merging again (and after renaming a shard, i.e. a different read
+    # order) yields byte-identical output
+    with open(merged, "rb") as f:
+        doc1 = f.read()
+    os.rename(
+        tmp_path / "trace-host-p0-7.jsonl",
+        tmp_path / "trace-host-p0-zz.jsonl",
+    )
+    telemetry.merge_trace(str(tmp_path))
+    with open(merged, "rb") as f:
+        assert f.read() == doc1
+    assert trace_mod._merge_sort_key({"ph": "M"})[0] == 0
+
+
+def test_tracer_stamps_monotonic_seq(trace_dir):
+    for _ in range(3):
+        with telemetry.span("good", cat="x"):
+            pass
+    events = [
+        e for e in telemetry.read_events(str(trace_dir))
+        if e.get("name") == "good"
+    ]
+    seqs = [e.get("seq") for e in events]
+    assert all(isinstance(s, int) for s in seqs)
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
 def test_unwritable_trace_dir_disables_tracing(tmp_path, monkeypatch, capsys):
     """Telemetry must never abort the sweep it observes: an unwritable
     DDLB_TPU_TRACE degrades to one warning + tracing off, not an OSError

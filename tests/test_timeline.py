@@ -1,0 +1,468 @@
+"""Cross-rank timeline observatory (ISSUE 14): the clock-offset
+estimator, the row skew fold, the world-timeline builder, and the skew
+regression gate. Everything here is synthetic-clock math — no JAX, no
+launched worlds (test_multiprocess covers the live path; the demo
+``scripts/skew_demo.py`` is the end-to-end acceptance)."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from ddlb_tpu.observatory import regress, timeline
+from ddlb_tpu.telemetry import clocksync
+
+
+def _synthetic_spans(n, delta, rng, width_s=0.004, start=100.0, gap=0.15):
+    """(ref_spans, shifted_spans): n rendezvous exchanges observed by a
+    reference clock and by a clock offset by ``delta`` seconds."""
+    ref, shifted = [], []
+    t = start
+    for _ in range(n):
+        t += gap + rng.uniform(0.0, gap)
+        w0, e0 = rng.uniform(0, width_s), rng.uniform(0, width_s)
+        w1, e1 = rng.uniform(0, width_s), rng.uniform(0, width_s)
+        ref.append((t - w0, t + e0))
+        shifted.append((t - w1 + delta, t + e1 + delta))
+    return ref, shifted
+
+
+class TestOffsetEstimator:
+    def test_recovers_synthetic_offset_within_uncertainty(self):
+        rng = random.Random(7)
+        for delta in (-2.25, 0.0, 3.7, 120.0):
+            ref, shifted = _synthetic_spans(10, delta, rng)
+            fit = clocksync.fit_offsets({0: ref, 1: shifted})[1]
+            assert fit.n_exchanges == 10
+            assert abs(fit.offset_s - delta) <= fit.uncertainty_s
+            # the bound is conservative but must stay usefully tight
+            # against millisecond-scale exchange widths
+            assert fit.uncertainty_s < 0.1
+            # aligned midpoints coincide within the bound
+            mid = sum(shifted[3]) / 2.0
+            ref_mid = sum(ref[3]) / 2.0
+            assert abs(fit.align(mid) - ref_mid) <= fit.uncertainty_s
+
+    def test_reference_rank_is_identity(self):
+        rng = random.Random(1)
+        ref, shifted = _synthetic_spans(4, 5.0, rng)
+        fits = clocksync.fit_offsets({0: ref, 1: shifted})
+        assert fits[0].offset_s == 0.0
+        assert fits[0].uncertainty_s == 0.0
+        assert fits[0].align(123.0) == 123.0
+
+    def test_drift_fit_recovers_slope(self):
+        rng = random.Random(3)
+        drift = 2e-4  # 200 us/s — visible over a 20 s window
+        ref, shifted = [], []
+        t = 50.0
+        for _ in range(24):
+            t += 1.0
+            w = rng.uniform(0, 0.002)
+            off = 1.5 + drift * (t - 50.0)
+            ref.append((t - w, t + w))
+            shifted.append((t - w + off, t + w + off))
+        fit = clocksync.fit_offsets({0: ref, 1: shifted})[1]
+        assert fit.drift_per_s == pytest.approx(drift, rel=0.2)
+        # a late stamp aligns within the bound despite the drift
+        local = shifted[-1][1]
+        assert abs(fit.align(local) - ref[-1][1]) <= fit.uncertainty_s
+
+    def test_robust_to_one_skewed_exchange(self):
+        """One exchange where a rank genuinely arrived late (a real
+        straggler) must not drag the offset: the median absorbs it."""
+        rng = random.Random(5)
+        ref, shifted = _synthetic_spans(9, 2.0, rng)
+        # exchange 4: the shifted rank arrives 0.5s late — its span
+        # starts late, the ref rank's span starts early and waits
+        b, e = shifted[4]
+        shifted[4] = (b + 0.5, e + 0.5)
+        rb, re_ = ref[4]
+        ref[4] = (rb - 0.0, re_ + 0.5)
+        fit = clocksync.fit_offsets({0: ref, 1: shifted})[1]
+        assert abs(fit.offset_s - 2.0) < 0.05
+
+    def test_empty_and_missing_rank_spans(self):
+        fits = clocksync.fit_offsets({0: [], 1: []})
+        assert fits[1].uncertainty_s == float("inf")
+        assert clocksync.fit_offsets({}) == {}
+
+
+class TestRowSkewFold:
+    def test_pure_fold_attributes_injected_straggler(self):
+        """Rank 1's clock is offset by 5s AND it arrives 0.4s late at
+        one collective: the fold must align the clocks away and blame
+        exactly the injected lateness."""
+        delta = 5.0
+        sites, enters, exits = [], [[], []], [[], []]
+        t = 10.0
+        for j in range(8):
+            t += 0.1
+            late = 0.4 if j == 5 else 0.0
+            sites.append(
+                "runtime.collective" if j == 5 else "runtime.barrier"
+            )
+            enters[0].append(t)
+            exits[0].append(t + late + 0.005)
+            enters[1].append(t + late + delta)
+            exits[1].append(t + late + 0.005 + delta)
+        out = clocksync.skew_from_spans(sites, enters, exits)
+        assert out["straggler_rank"] == 1
+        assert out["skew_enter_s"] == pytest.approx(0.4, abs=0.02)
+        assert out["straggler_frac"] > 0.5
+        assert out["clock_unc_s"] < 0.05
+
+    def test_fold_without_fit_sites_never_fits_from_skewed_spans(self):
+        """No barrier exchange in the row: the fold must NOT fit
+        offsets from the skew-bearing collectives themselves (that
+        would absorb half an injected slowdown into the clock model) —
+        raw stamps are used and clock_unc_s honestly claims nothing."""
+        import math
+
+        sites = ["runtime.collective"]
+        out = clocksync.skew_from_spans(
+            sites, [[10.0], [10.4]], [[10.41], [10.41]]
+        )
+        assert out["skew_enter_s"] == pytest.approx(0.4)
+        assert out["straggler_rank"] == 1
+        assert math.isnan(out["clock_unc_s"])
+
+    def test_fold_declines_single_exchange_fit(self):
+        """One barrier exchange is not a clock model: a rank 0.4 s late
+        at the ONLY barrier would otherwise become a +0.2 s 'offset'
+        that halves the real skew and shifts blame onto the innocent
+        peer at the next collective. Below MIN_FIT_EXCHANGES the fold
+        must keep raw stamps and attribute the full skew."""
+        import math
+
+        sites = ["runtime.barrier", "runtime.collective"]
+        enters = [[10.0, 11.0], [10.4, 11.0]]
+        exits = [[10.41, 11.1], [10.41, 11.1]]
+        out = clocksync.skew_from_spans(sites, enters, exits)
+        assert out["skew_enter_s"] == pytest.approx(0.4)
+        assert out["straggler_rank"] == 1
+        assert math.isnan(out["clock_unc_s"])
+
+    def test_fold_zero_skew_names_no_straggler(self):
+        sites = ["runtime.barrier"] * 3
+        enters = [[1.0, 2.0, 3.0], [1.0, 2.0, 3.0]]
+        exits = [[1.1, 2.1, 3.1], [1.1, 2.1, 3.1]]
+        out = clocksync.skew_from_spans(sites, enters, exits)
+        assert out["straggler_rank"] == -1
+        assert out["skew_enter_s"] == 0.0
+        assert out["straggler_frac"] == 0.0
+
+    def test_fold_single_rank_is_defaults(self):
+        out = clocksync.skew_from_spans(
+            ["runtime.barrier"], [[1.0]], [[1.5]]
+        )
+        assert out == clocksync.SKEW_ROW_DEFAULTS
+
+    def test_fold_row_skew_single_process_defaults(self):
+        class _Rt:
+            num_processes = 1
+
+        clocksync.record_span("runtime.barrier", 1.0, 2.0)
+        try:
+            assert clocksync.fold_row_skew(_Rt()) == (
+                clocksync.SKEW_ROW_DEFAULTS
+            )
+        finally:
+            clocksync.reset_row()
+
+    def test_span_log_reset_and_bound(self):
+        clocksync.reset_row()
+        clocksync.record_span("runtime.barrier", 1.0, 2.0)
+        clocksync.record_span("runtime.collective", 3.0, 4.0)
+        assert [s[0] for s in clocksync.row_spans()] == [
+            "runtime.barrier", "runtime.collective",
+        ]
+        clocksync.reset_row()
+        assert clocksync.row_spans() == []
+
+
+def _write_flight(run_dir, rank, events):
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, f"flight-p{rank}.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        for event in events:
+            f.write(json.dumps(event) + "\n")
+
+
+def _world_events(rank, offset, barriers, late_at=None, late_s=0.0,
+                  i_am_late=False, pid=1000):
+    """One rank's flight stream: init + phase marks + barriers + one
+    runtime.collective, stamps on a clock shifted by ``offset``.
+
+    Rendezvous semantics when ``late_at`` names a barrier index: the
+    late rank (``i_am_late``) ENTERS ``late_s`` after everyone else;
+    every rank EXITS at the release (late arrival + 0.01), and the rest
+    of the world's timeline shifts by ``late_s`` — exactly what a real
+    single-rank stall does to a lock-step world.
+    """
+    seq = 0
+    events = []
+
+    def emit(ph, site, t, **extra):
+        events.append(
+            {"seq": seq, "ph": ph, "site": site, "t": t + offset,
+             "pid": pid + rank, "rank": rank, **extra}
+        )
+
+    t = 100.0
+    seq += 1
+    emit("B", "runtime.init", t)
+    emit("E", "runtime.init", t + 0.3)
+    t += 0.4
+    seq += 1
+    emit("I", "worker.phase", t, stage="setup begin (x)")
+    t += 0.05
+    seq += 1
+    emit("I", "worker.phase", t, stage="warmup done; measuring")
+    for j in range(barriers):
+        t += 0.1
+        late = late_s if late_at == j else 0.0
+        enter = t + (late if i_am_late else 0.0)
+        release = t + late
+        seq += 1
+        emit("B", "runtime.barrier", enter)
+        emit("E", "runtime.barrier", release + 0.01)
+        t = release + 0.01
+    t += 0.05
+    seq += 1
+    emit("B", "runtime.collective", t)
+    emit("E", "runtime.collective", t + 0.02)
+    t += 0.1
+    seq += 1
+    emit("I", "worker.phase", t, stage="measured")
+    return events
+
+
+def _paired_world(tmp_path, late_rank=None, late_s=0.0, offset1=50.0):
+    """A 2-rank flight dir: sequence-aligned collectives, rank 1's
+    clock shifted by ``offset1``, optionally one rank 0.?s late at
+    barrier index 3 (the other rank waits there)."""
+    run_dir = str(tmp_path / "flight")
+    late_at = 3 if late_rank is not None else None
+    for rank in range(2):
+        _write_flight(
+            run_dir,
+            rank,
+            _world_events(
+                rank,
+                offset1 if rank == 1 else 0.0,
+                6,
+                late_at=late_at,
+                late_s=late_s,
+                i_am_late=rank == late_rank,
+            ),
+        )
+    return run_dir
+
+
+class TestWorldTimeline:
+    def test_aligns_known_offset_and_flags_mode(self, tmp_path):
+        run_dir = _paired_world(tmp_path, offset1=50.0)
+        doc = timeline.build_world_timeline(run_dir, expected_ranks=2)
+        assert doc["alignment"] == "barrier"
+        fit = doc["offsets"][1]
+        assert abs(fit["offset_s"] - 50.0) <= fit["uncertainty_s"]
+        assert fit["uncertainty_s"] < 0.5
+        # aligned events: the two ranks' barrier entries coincide
+        barriers = [
+            e for e in doc["events"] if e["site"] == "runtime.barrier"
+        ]
+        by_seq = {}
+        for e in barriers:
+            by_seq.setdefault(e["seq"], []).append(e)
+        for seq, pair in by_seq.items():
+            if len(pair) == 2:
+                assert abs(
+                    pair[0]["aligned_ts"] - pair[1]["aligned_ts"]
+                ) <= max(p["unc_s"] for p in pair) + 0.02
+
+    def test_attributes_seeded_straggler_to_rank(self, tmp_path):
+        run_dir = _paired_world(tmp_path, late_rank=1, late_s=0.5)
+        doc = timeline.build_world_timeline(run_dir, expected_ranks=2)
+        assert doc["total_skew_s"] == pytest.approx(0.5, abs=0.1)
+        assert doc["worst_ranks"][0]["rank"] == 1
+        worst = max(
+            doc["collectives"], key=lambda c: c["skew_enter_s"]
+        )
+        assert worst["straggler_rank"] == 1
+        assert worst["site"] == "runtime.barrier"
+        assert worst["skew_enter_s"] == pytest.approx(0.5, abs=0.05)
+        # the WAITING rank (0) accrues the skew-wait seconds
+        assert doc["attribution"][0]["skew_wait_s"] == pytest.approx(
+            0.5, abs=0.1
+        )
+        assert "rank 1" in doc["headline"]
+
+    def test_attribution_splits_compute_and_host(self, tmp_path):
+        run_dir = _paired_world(tmp_path)
+        doc = timeline.build_world_timeline(run_dir, expected_ranks=2)
+        acc = doc["attribution"][0]
+        # gaps between the measuring-window barriers are compute; the
+        # init->first-barrier gap (setup) is host
+        assert acc["compute_s"] > 0.0
+        assert acc["host_s"] > 0.0
+
+    def test_empty_dir_and_missing_rank(self, tmp_path):
+        doc = timeline.build_world_timeline(str(tmp_path / "nope"))
+        assert doc["alignment"] == "none"
+        assert "no flight files" in doc["headline"]
+        run_dir = str(tmp_path / "half")
+        _write_flight(run_dir, 0, _world_events(0, 0.0, 2))
+        doc = timeline.build_world_timeline(run_dir, expected_ranks=2)
+        assert doc["missing_ranks"] == [1]
+        assert doc["alignment"] == "none"  # nothing to exchange against
+
+    def test_flight_report_json_carries_aligned_entries(
+        self, tmp_path, capsys
+    ):
+        from scripts.flight_report import main as flight_main
+
+        run_dir = _paired_world(tmp_path)
+        rc = flight_main([run_dir, "--ranks", "2", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["alignment"] == "barrier"
+        assert doc["entries"], "every flight entry must be in the doc"
+        for entry in doc["entries"]:
+            assert "aligned_ts" in entry and "unc_s" in entry
+
+    def test_json_documents_stay_strictly_valid(self, tmp_path, capsys):
+        """An unalignable world carries inf/NaN sentinels internally;
+        the --json renderers must never leak them as bare Infinity
+        (invalid under RFC 8259 — jq/JSON.parse reject the document)."""
+        from scripts.flight_report import main as flight_main
+
+        run_dir = str(tmp_path / "half")
+        _write_flight(run_dir, 0, _world_events(0, 0.0, 2))
+        flight_main([run_dir, "--ranks", "2", "--json"])
+        out = capsys.readouterr().out
+        assert "Infinity" not in out and "NaN" not in out
+        json.loads(out)
+        assert timeline.json_safe(
+            {"x": float("inf"), "y": [float("nan"), 1.0]}
+        ) == {"x": None, "y": [None, 1.0]}
+
+
+def _skew_row(run, frac, skew_s, rank=1, impl="jax_spmd_0"):
+    return {
+        "implementation": impl,
+        "base_implementation": "jax_spmd",
+        "primitive": "tp_columnwise",
+        "option": "-",
+        "m": 64, "n": 32, "k": 32,
+        "dtype": "float32",
+        "chip": "cpu-sim",
+        "world_size": 2,
+        "time_measurement_backend": "host_clock",
+        "median time (ms)": 1.0,
+        "straggler_frac": frac,
+        "skew_enter_s": skew_s,
+        "straggler_rank": rank,
+        "_run": run,
+    }
+
+
+def _bank(rows):
+    return [
+        {"key": regress.row_key(row), "run_id": row["_run"], "kind": "row",
+         "row": row}
+        for row in rows
+    ]
+
+
+class TestSkewGate:
+    def test_seeded_straggler_detected_and_ranked_first(self):
+        history = _bank(
+            [
+                _skew_row("clean-0", 0.15, 0.008, rank=0),
+                _skew_row("clean-1", 0.22, 0.012, rank=1),
+            ]
+        )
+        current = [_skew_row("seeded", 0.88, 0.41, rank=1)]
+        findings = regress.detect_skew(
+            current, history, exclude_run="seeded"
+        )
+        assert findings, "the seeded straggler must be flagged"
+        assert findings[0]["metric"] in ("straggler_frac", "skew_enter_s")
+        assert findings[0]["straggler_rank"] == 1
+        # detect_all merges the skew gate into the one ranked report
+        merged = regress.detect_all(current, history, exclude_run="seeded")
+        assert any(
+            f["metric"] in ("straggler_frac", "skew_enter_s")
+            for f in merged
+        )
+
+    def test_clean_jitter_never_alarms(self):
+        """Clean-run scheduler jitter — small absolute values moving by
+        large RATIOS — must stay below the absolute floors."""
+        history = _bank(
+            [
+                _skew_row("clean-0", 0.10, 0.004),
+                _skew_row("clean-1", 0.18, 0.009),
+            ]
+        )
+        current = [_skew_row("clean-2", 0.27, 0.02)]
+        assert regress.detect_skew(
+            current, history, exclude_run="clean-2"
+        ) == []
+
+    def test_zero_baseline_yields_finite_ratio(self):
+        """A perfectly clean baseline (median 0.0 skew) against a real
+        regression: the finding must fire with a FINITE ratio (these
+        documents ship through --json; bare Infinity is invalid)."""
+        import math
+
+        history = _bank(
+            [
+                _skew_row("clean-0", 0.0, 0.0),
+                _skew_row("clean-1", 0.0, 0.0),
+            ]
+        )
+        current = [_skew_row("seeded", 0.9, 0.45, rank=1)]
+        findings = regress.detect_skew(
+            current, history, exclude_run="seeded"
+        )
+        assert findings
+        assert all(math.isfinite(f["ratio"]) for f in findings)
+
+    def test_unalignable_row_never_alarms_on_skew_seconds(self):
+        """clock_unc_s NaN = the fold made no alignment claim (raw
+        possibly-cross-host stamps): skew_enter_s findings drop; a
+        finite bound drops only excesses inside it."""
+        history = _bank(
+            [
+                _skew_row("clean-0", 0.02, 0.005),
+                _skew_row("clean-1", 0.03, 0.008),
+            ]
+        )
+        seeded = _skew_row("seeded", 0.03, 5.0, rank=1)
+        seeded["clock_unc_s"] = float("nan")
+        findings = regress.detect_skew(
+            [seeded], history, exclude_run="seeded"
+        )
+        assert all(f["metric"] != "skew_enter_s" for f in findings)
+        # finite bound larger than the excess: also dropped
+        seeded["clock_unc_s"] = 10.0
+        findings = regress.detect_skew(
+            [seeded], history, exclude_run="seeded"
+        )
+        assert all(f["metric"] != "skew_enter_s" for f in findings)
+        # tight bound: the finding stands and carries the bound
+        seeded["clock_unc_s"] = 0.001
+        findings = regress.detect_skew(
+            [seeded], history, exclude_run="seeded"
+        )
+        assert any(f["metric"] == "skew_enter_s" for f in findings)
+
+    def test_rows_without_skew_columns_contribute_nothing(self):
+        row = _skew_row("clean-0", float("nan"), float("nan"))
+        history = _bank([_skew_row("clean-1", 0.1, 0.01)])
+        assert regress.detect_skew([row], history) == []
