@@ -4,8 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci chaos-launch overlap-report serving-load-report sim-report \
-	skew-report clean
+	hooks ci chaos-launch chaos-degrade overlap-report \
+	serving-load-report sim-report sim-report-degrade skew-report clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -58,6 +58,8 @@ ci:
 	$(PYTHON) scripts/serving_load_demo.py
 	$(PYTHON) scripts/sim_demo.py
 	$(PYTHON) scripts/skew_demo.py
+	$(MAKE) sim-report-degrade
+	$(MAKE) chaos-degrade
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -99,6 +101,27 @@ skew-report:
 # layer (docs/source/robustness.rst)
 chaos-launch:
 	$(PYTHON) scripts/chaos_launch.py
+
+# degraded-world chaos battery: a seeded persistent 4x link_slow must be
+# detected by the observatory skew gate, indicted to the right rank/link
+# by the health verdict (zero indictments on the clean baselines),
+# mitigated by a DEGRADED relaunch (world shrunk around the indicted
+# slot, zero rows lost, world_degraded stamped), and bracketed by the
+# simulator's degraded-topology prediction — the executable acceptance
+# test for the detect -> attribute -> mitigate loop (ISSUE 15; banked
+# transcript at docs/chaos_degrade_demo.log)
+chaos-degrade:
+	$(PYTHON) scripts/chaos_degrade.py
+
+# degraded-topology ranking: flat vs hierarchical vs striped AR under a
+# failing DCN trunk link (dcn=0.25) and a downed torus axis (ici1=0) on
+# a 4-pod world — striped must degrade gracefully, with the per-link
+# utilization table showing the reroute (docs/source/robustness.rst
+# "Degraded worlds")
+sim-report-degrade:
+	$(PYTHON) scripts/sim_report.py --topology 4pod1024 \
+		--families dp_allreduce,collectives \
+		--degrade dcn=0.25 --degrade ici1=0
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

@@ -511,6 +511,11 @@ def make_result_row(
         "fault_injected": fault_injected,
         "error_class": error_class,
         "quarantined": bool(quarantined),
+        # the degraded-world stamp (ISSUE 15): True on rows measured by
+        # a world the supervised launcher relaunched shrunk/remapped
+        # around an indicted rank — identical on every path so the CSV
+        # header cannot drift
+        "world_degraded": envs.get_world_degraded(),
         # the warm-worker-pool columns (ISSUE 5), defaults here so the
         # schema is identical on every path (in-process rows, pooled
         # rows, error rows); the subprocess dispatcher overwrites them
@@ -584,10 +589,14 @@ def _max_reduce_across_processes(times_ms: np.ndarray, runtime) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     # the one cross-process collective OUTSIDE the jitted impl programs:
-    # injectable (a plan can wedge/kill a specific rank mid-allgather)
-    # and flight-recorded (a rank that never arrives leaves its peers
+    # injectable (a plan can wedge/kill a specific rank mid-allgather,
+    # or charge a degraded link's payload-proportional delay) and
+    # flight-recorded (a rank that never arrives leaves its peers
     # in-flight here — named by scripts/flight_report.py)
-    faults.inject("runtime.collective")
+    faults.inject(
+        "runtime.collective",
+        payload_bytes=int(times_ms.size * 8 * runtime.num_processes),
+    )
     # clock-sync stamps AFTER the injection site (a fault-delayed rank
     # must arrive late on its own stamp) — this collective is the
     # preferred slowdown-injection point, so it feeds the skew fold but

@@ -73,6 +73,13 @@ TERM_GRACE_S = 5.0
 #: (symmetric: classify, don't relaunch blindly), while peers wedged in
 #: a collective the dead rank never joins stay alive past it forever
 DEATH_GRACE_S = 2.0
+#: asymmetric-silence split: when the silence deadline fires on one
+#: rank while some peer showed life within this fraction of the
+#: deadline, the world is NOT lock-step-wedged (a wedge stops every
+#: rank's beats together) — the silent rank is a slow/degraded PEER,
+#: and the error classifies DEGRADED (barrier-timeout-with-surviving-
+#: peers), the mitigating relaunch's trigger, instead of transient
+PEER_FRESH_FRAC = 0.5
 
 
 def _free_port() -> int:
@@ -106,10 +113,14 @@ def _child_env(
     env: Optional[dict],
     attempt_dir: Optional[str] = None,
     attempt: int = 0,
+    phys_rank: Optional[int] = None,
+    phys_world: Optional[int] = None,
+    degraded: bool = False,
 ) -> dict:
     """One rank's environment: the bootstrap vars every mode sets, the
     CPU-sim world when requested, and — under supervision — the beat
-    file, flight-recorder dir and world-attempt counter."""
+    file, flight-recorder dir, world-attempt counter, and (on a
+    degraded relaunch) the PHYSICAL slot id + world_degraded stamp."""
     child_env = dict(os.environ if env is None else env)
     child_env.update(
         {
@@ -118,6 +129,25 @@ def _child_env(
             "DDLB_TPU_COORD_ADDR": coordinator,
         }
     )
+    if phys_rank is not None:
+        # the rank's PHYSICAL world slot: jax.distributed needs dense
+        # process ids 0..N-1, but fault-plan topo/rank selectors key on
+        # the slot (envs.get_physical_rank) so a shrunken world's
+        # survivors keep dodging the hardware that indicted the
+        # excluded slot instead of re-rolling its faults onto whoever
+        # inherited its process id
+        child_env["DDLB_TPU_PHYS_RANK"] = str(phys_rank)
+        # ...and ring-neighbor math (an rx-direction link fault's
+        # receiver) must wrap the FULL physical ring, not the shrunken
+        # process count (envs.get_physical_world)
+        child_env["DDLB_TPU_PHYS_WORLD"] = str(phys_world or processes)
+    if degraded:
+        # stamped onto every result row (the world_degraded schema
+        # column): banked history must tell limp-mode measurements
+        # from full-world ones
+        child_env["DDLB_TPU_WORLD_DEGRADED"] = "1"
+    else:
+        child_env.pop("DDLB_TPU_WORLD_DEGRADED", None)
     if devices_per_process:
         # CPU-sim world: force the cpu platform in every child (the
         # reference parent also never touches the accelerator,
@@ -315,6 +345,22 @@ def _watch_world(
             ages = [(now - s.last_sign(), s) for s in running]
             age, state = max(ages, key=lambda pair: pair[0])
             if age > silence_timeout:
+                freshest = min(pair[0] for pair in ages)
+                if (
+                    len(ages) > 1
+                    and freshest < PEER_FRESH_FRAC * silence_timeout
+                ):
+                    # peers kept beating while this rank went dark: a
+                    # slow/wedged PEER, not a world wedge — the
+                    # degraded-component signature (classify: DEGRADED)
+                    return (
+                        f"SlowPeer: rank {state.rank} silent for "
+                        f"{age:.1f}s while {len(ages) - 1} peer(s) kept "
+                        f"beating (freshest {freshest:.1f}s ago) — "
+                        f"aborting the degraded world",
+                        state.rank,
+                        age,
+                    )
                 return (
                     f"TimeoutError: rank {state.rank} silent for "
                     f"{age:.1f}s (no beat, no output) — aborting the "
@@ -378,19 +424,42 @@ def launch_supervised(
     world_retries: int = 2,
     relaunch_backoff_s: float = 1.0,
     run_dir: Optional[str] = None,
+    exclude_ranks: Any = (),
+    health_gate: bool = False,
 ) -> int:
     """Supervised mode: launch, watch, abort, attribute, relaunch.
     Returns 0 when an attempt completes cleanly, else the mapped exit
     code of the final failed attempt. Every attempt gets its own
     ``<run_dir>/attempt-N`` flight/beat directory and a line in
-    ``<run_dir>/attempts.json``."""
+    ``<run_dir>/attempts.json``.
+
+    **Degraded worlds** (ISSUE 15): ``processes`` is the FULL world;
+    ``exclude_ranks`` names physical slots to launch without (the
+    operator's pre-indictment), and the launcher itself excludes more
+    when a failure classifies DEGRADED (a ``link_down`` transport
+    error, a slow peer whose silence aborted a still-beating world) or
+    — with ``health_gate=True`` — when the attempt's clock-aligned
+    timeline produces a persistent-straggler indictment
+    (``observatory.health``). A degraded relaunch shrinks the world
+    around the indicted slot (survivors keep their physical slot id
+    via ``DDLB_TPU_PHYS_RANK``; rows are stamped ``world_degraded``),
+    but only while ``health.relaunch_policy`` says shrinking still
+    leaves a real multi-rank world — a 2-rank world's link failure is
+    fatal-not-degraded."""
     from ddlb_tpu import telemetry
     from ddlb_tpu.faults import flightrec
-    from ddlb_tpu.faults.classify import TRANSIENT
+    from ddlb_tpu.faults.classify import DEGRADED, TRANSIENT
     from ddlb_tpu.faults.plan import backoff_delays
+    from ddlb_tpu.observatory import health
 
     if processes < 1:
         raise ValueError(f"processes must be >= 1, got {processes}")
+    excluded = set(int(r) for r in exclude_ranks or ())
+    bad = [r for r in excluded if not (0 <= r < processes)]
+    if bad:
+        raise ValueError(
+            f"exclude_ranks {sorted(bad)} outside the world 0..{processes - 1}"
+        )
     run_dir = run_dir or tempfile.mkdtemp(prefix="ddlb_launch_")
     os.makedirs(run_dir, exist_ok=True)
     delays = backoff_delays(
@@ -399,22 +468,38 @@ def launch_supervised(
     records: List[Dict[str, Any]] = []
     rc = 1
     for attempt in range(world_retries + 1):
+        #: surviving physical slots; process id i runs slot slots[i]
+        slots = [r for r in range(processes) if r not in excluded]
+        n = len(slots)
+        if n < 1:
+            print("[launcher] every rank excluded — nothing to launch",
+                  flush=True)
+            return rc
+        degraded = bool(excluded)
         attempt_dir = os.path.join(run_dir, f"attempt-{attempt}")
         os.makedirs(attempt_dir, exist_ok=True)
         coordinator = f"127.0.0.1:{_free_port()}"
         print(
-            f"[launcher] attempt {attempt}: {processes} rank(s), "
-            f"coordinator {coordinator}, run dir {attempt_dir}",
+            f"[launcher] attempt {attempt}: {n} rank(s)"
+            + (
+                f" (DEGRADED world: slots {slots}, excluded "
+                f"{sorted(excluded)})"
+                if degraded
+                else ""
+            )
+            + f", coordinator {coordinator}, run dir {attempt_dir}",
             flush=True,
         )
         started = time.monotonic()
         ranks: List[_Rank] = []
-        for rank in range(processes):
+        for rank in range(n):
             proc = subprocess.Popen(
                 command,
                 env=_child_env(
-                    rank, processes, coordinator, devices_per_process,
+                    rank, n, coordinator, devices_per_process,
                     slices, env, attempt_dir=attempt_dir, attempt=attempt,
+                    phys_rank=slots[rank], phys_world=processes,
+                    degraded=degraded,
                 ),
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
@@ -449,7 +534,7 @@ def launch_supervised(
                 if s.proc.returncode not in (0, None)
             ]
             culprit = failed[0] if failed else None
-        report = flightrec.analyze_run(attempt_dir, expected_ranks=processes)
+        report = flightrec.analyze_run(attempt_dir, expected_ranks=n)
         if error and report.get("lagging_ranks"):
             # the flight recorder's sequence join beats the watchdog's
             # beat-age guess at naming the diverging rank (every rank's
@@ -477,10 +562,34 @@ def launch_supervised(
                     break
         if error and not rc:
             rc = 1  # an aborted world must never report success
+
+        # -- health gate: a clean-but-limping attempt can still indict a
+        # persistently-straggling rank from its own clock-aligned
+        # timeline (the detect -> indict -> mitigate loop's trigger when
+        # nothing crashed — a slow link doesn't kill anyone)
+        verdict = None
+        if health_gate and not error:
+            from ddlb_tpu.observatory import timeline as timeline_mod
+
+            doc = timeline_mod.build_world_timeline(
+                attempt_dir, expected_ranks=n
+            )
+            verdict = health.verdict_from_observations(
+                health.observations_from_timeline(doc), world=n
+            )
+        indicted = (
+            verdict["rank"]
+            if verdict is not None
+            and verdict["status"] == health.PERSISTENT
+            else (culprit if error and error_class == DEGRADED else None)
+        )
+        outcome = "ok" if not error else "failed"
+        if indicted is not None:
+            outcome = "degraded"
         records.append(
             {
                 "attempt": attempt,
-                "outcome": "ok" if not error else "failed",
+                "outcome": outcome,
                 "error": error,
                 "error_class": error_class,
                 "culprit_rank": culprit,
@@ -489,11 +598,68 @@ def launch_supervised(
                 "duration_s": round(time.monotonic() - started, 2),
                 "coordinator": coordinator,
                 "ranks": rank_rcs,
+                "world_slots": slots,
+                "excluded_ranks": sorted(excluded),
+                "world_degraded": degraded,
+                "health": verdict,
                 "flight_headline": report.get("headline"),
                 "divergence_site": report.get("divergence_site"),
             }
         )
         _persist_attempts(run_dir, records)
+
+        if indicted is not None:
+            # indicted is a PROCESS id of this attempt; the hardware to
+            # exclude is its physical slot
+            phys = slots[indicted] if 0 <= indicted < n else indicted
+            policy = health.relaunch_policy(n)
+            reason = (
+                verdict["reason"]
+                if verdict is not None
+                else f"{error_class}: {error[:120]}"
+            )
+            print(
+                f"[launcher] rank {indicted} (physical slot {phys}) "
+                f"indicted: {reason}",
+                flush=True,
+            )
+            if policy != "exclude":
+                print(
+                    f"[launcher] {n}-rank world cannot shrink around the "
+                    f"indicted rank (a degraded relaunch needs >= 2 "
+                    f"survivors) — fatal, not degraded",
+                    flush=True,
+                )
+                records[-1]["mitigation"] = "fatal"
+                _persist_attempts(run_dir, records)
+                # a completed-but-indicted attempt keeps its result; a
+                # failed one keeps its truthful exit code
+                return 0 if not error else rc
+            if attempt == world_retries:
+                print(
+                    f"[launcher] world retries exhausted before the "
+                    f"degraded relaunch ({world_retries + 1} attempts)",
+                    flush=True,
+                )
+                records[-1]["mitigation"] = "exhausted"
+                _persist_attempts(run_dir, records)
+                return 0 if not error else rc
+            excluded.add(phys)
+            records[-1]["mitigation"] = f"exclude slot {phys}"
+            _persist_attempts(run_dir, records)
+            print(
+                f"[launcher] relaunching DEGRADED without slot {phys} "
+                f"({n - 1} rank(s); attempt "
+                f"{attempt + 1}/{world_retries + 1})",
+                flush=True,
+            )
+            telemetry.instant(
+                "launch.degraded", cat="launch", slot=phys,
+                attempt=attempt + 1,
+            )
+            time.sleep(min(delays[attempt], 2.0))
+            continue
+
         if not error:
             print(
                 f"[launcher] attempt {attempt} completed cleanly "
@@ -593,6 +759,25 @@ def main(argv=None) -> None:
         "attempts.json (default: a fresh temp dir, printed)",
     )
     parser.add_argument(
+        "--exclude-rank",
+        type=int,
+        action="append",
+        default=None,
+        metavar="SLOT",
+        help="supervised: launch the world WITHOUT this physical slot "
+        "(repeatable) — the operator's pre-indictment; rows are stamped "
+        "world_degraded and survivors keep their slot id in "
+        "DDLB_TPU_PHYS_RANK",
+    )
+    parser.add_argument(
+        "--health-gate",
+        action="store_true",
+        help="supervised: run the persistent-straggler health verdict "
+        "(observatory.health) over each attempt's clock-aligned "
+        "timeline; a persistent indictment triggers a degraded relaunch "
+        "with the indicted slot excluded",
+    )
+    parser.add_argument(
         "command",
         nargs=argparse.REMAINDER,
         help="command to run in every process (prefix with --)",
@@ -614,6 +799,8 @@ def main(argv=None) -> None:
                 world_retries=args.world_retries,
                 relaunch_backoff_s=args.relaunch_backoff,
                 run_dir=args.run_dir,
+                exclude_ranks=args.exclude_rank or (),
+                health_gate=args.health_gate,
             )
         )
     sys.exit(

@@ -307,6 +307,44 @@ def get_beat_file() -> str:
     return os.environ.get("DDLB_TPU_BEAT_FILE", "").strip()
 
 
+def get_physical_rank() -> int:
+    """This process's PHYSICAL world slot (default: the process id).
+
+    The supervised launcher's degraded relaunch (``cli/launch.py``)
+    shrinks the world around an indicted slot: the surviving ranks get
+    fresh contiguous process ids (jax.distributed needs 0..N-1) but
+    keep their original slot number here, so topology-scoped fault
+    rules (``faults.plan`` ``topo``/``ranks`` selectors) keep targeting
+    the same *hardware* — a relaunch that excluded the bad slot
+    genuinely dodges the fault instead of re-rolling it onto whoever
+    inherited process id 1.
+    """
+    return get_env(("DDLB_TPU_PHYS_RANK",), get_process_id(), int)
+
+
+def get_physical_world() -> int:
+    """The FULL physical world size (default: the process count).
+
+    Topology fault rules compute ring neighbors modulo the physical
+    ring (``faults.plan.FaultRule.affected_rank``); on a degraded
+    relaunch the process count SHRINKS while physical slot ids keep
+    full-world numbering, so the launcher exports the original size
+    here — otherwise an ``rx``-direction link fault would wrap around
+    the shrunken count and re-target a surviving healthy slot.
+    """
+    return get_env(("DDLB_TPU_PHYS_WORLD",), get_num_processes(), int)
+
+
+def get_world_degraded() -> bool:
+    """Whether this world is a DEGRADED relaunch (shrunk/remapped
+    around an indicted rank) — stamped onto every result row as the
+    ``world_degraded`` column so banked history can tell a full-world
+    measurement from a limp-mode one. Set by the supervised launcher;
+    empty/unset = a full healthy world.
+    """
+    return bool(os.environ.get("DDLB_TPU_WORLD_DEGRADED", "").strip())
+
+
 def get_world_attempt() -> int:
     """Which world-level launch attempt this process belongs to
     (default 0 = the first launch).

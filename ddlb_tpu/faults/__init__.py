@@ -14,11 +14,17 @@ tiers):
   fault plan** from ``DDLB_TPU_FAULT_PLAN`` (inline JSON or a file
   path). Zero overhead when the knob is unset: the fast path is one
   global ``is None`` check.
-- ``classify_error`` (faults.classify): the transient-vs-deterministic
-  split the self-healing runner and the hardware row queue share — only
-  transients (TimeoutError, WorkerDied, RESOURCE_EXHAUSTED, ...) are
-  worth a retry; deterministic failures (ValueError, validation
-  mismatch) park immediately instead of burning capture windows.
+- ``classify_error`` (faults.classify): the transient / degraded /
+  deterministic split the self-healing runner, the hardware row queue
+  and the supervised launcher share — only transients (TimeoutError,
+  WorkerDied, RESOURCE_EXHAUSTED, ...) are worth a retry; degraded
+  failures (a downed/slow link, a slow peer — ISSUE 15) park in the
+  queue and trigger the launcher's shrunken relaunch; deterministic
+  failures (ValueError, validation mismatch) park immediately instead
+  of burning capture windows. The plan's topology-scoped kinds
+  (``link_slow`` / ``link_down`` / ``chip_slow``, selected by axis /
+  index / direction / factor) realize a degraded component as
+  deterministic payload-proportional delays at the collective sites.
 - ``heartbeat`` (faults.heartbeat): a cheap shared-memory beat channel
   from subprocess workers — extended with **file beats**
   (``DDLB_TPU_BEAT_FILE``) so a supervisor that merely SPAWNED a rank

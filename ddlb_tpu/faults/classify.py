@@ -1,9 +1,11 @@
-"""Error classification: transient (retry) vs deterministic (park).
+"""Error classification: transient (retry) / degraded (mitigate) /
+deterministic (park).
 
 One shared split for every failure-policy consumer — the self-healing
-sweep runner retries only transients, and the hardware row queue parks
-deterministic failures immediately instead of burning its MAX_ATTEMPTS
-passes on a config that can never succeed. The classes:
+sweep runner retries only transients, the hardware row queue parks
+everything else immediately instead of burning its MAX_ATTEMPTS passes,
+and the supervised launcher picks its relaunch mode from the class. The
+classes:
 
 - **transient**: the failure came from the environment, not the config —
   a hung/killed worker (``TimeoutError``, ``WorkerDied``), allocator
@@ -11,6 +13,15 @@ passes on a config that can never succeed. The classes:
   (``RESOURCE_EXHAUSTED``), transport/runtime flaps (``UNAVAILABLE``,
   ``DEADLINE_EXCEEDED``, broken pipes, spawn failures). Worth a retry
   with backoff.
+- **degraded** (ISSUE 15): the failure names a *persistently bad
+  component* — a downed/slow link (``link_down``), a peer that went
+  silent while its world kept beating (``SlowPeer``: the
+  barrier-timeout-with-surviving-peers shape), a persistent-straggler
+  indictment. An identical retry hits the same hardware and fails the
+  same way; the remedy is the supervised launcher's DEGRADED relaunch
+  (world shrunk/remapped around the indicted rank), and the row queue
+  parks it like a deterministic failure — re-burning capture windows
+  on bad hardware helps nobody.
 - **deterministic**: the config itself is wrong or produces wrong
   numbers — ``ValueError``/``TypeError`` from option or shape checks, a
   validation mismatch, corrupted-result numerics. A retry re-pays the
@@ -18,16 +29,37 @@ passes on a config that can never succeed. The classes:
 
 Classification is substring-based over the recorded error string (the
 rows and the queue state both carry stringified errors, not exception
-objects), with the transient patterns checked first; an unrecognized
-error is deterministic — the conservative default for wall-clock, since
-a wrongly-parked row costs one manual retry while a wrongly-retried one
-burns a capture window. JAX-free, importable from every process tier.
+objects), with the degraded patterns checked first (a ``link_down``
+raises ``ConnectionError``, which would otherwise read transient — and
+relaunching the same world onto the same dead link just fails again),
+then the transient ones; an unrecognized error is deterministic — the
+conservative default for wall-clock, since a wrongly-parked row costs
+one manual retry while a wrongly-retried one burns a capture window.
+JAX-free, importable from every process tier.
 """
 
 from __future__ import annotations
 
 TRANSIENT = "transient"
 DETERMINISTIC = "deterministic"
+DEGRADED = "degraded"
+
+#: substrings marking an error as caused by a persistently degraded
+#: component (checked BEFORE the transient patterns — see module
+#: docstring): the link_down realization's actual raise site
+#: (faults.plan.inject — anchored on the full injected phrase, because
+#: a bare "link_down"/"link_slow" would also match the plan VALIDATION
+#: ValueErrors, which are deterministic config errors that must park,
+#: never trigger a world shrink), the launcher's slow-peer abort, and
+#: the health verdict's indictment vocabulary
+DEGRADED_PATTERNS = (
+    "injected link_down",
+    "link is down",
+    "SlowPeer",
+    "slow peer",
+    "persistent straggler",
+    "DegradedWorld",
+)
 
 #: substrings marking an error as environment-caused and retryable;
 #: checked against the stringified error (exception class names prefix
@@ -63,7 +95,8 @@ TRANSIENT_PATTERNS = (
 
 
 def classify_error(error: str, valid: bool = True) -> str:
-    """``TRANSIENT``, ``DETERMINISTIC``, or ``""`` for a clean row.
+    """``TRANSIENT``, ``DEGRADED``, ``DETERMINISTIC``, or ``""`` for a
+    clean row.
 
     ``valid=False`` with an empty error string is the runner's soft
     validation failure — deterministic (same inputs, same mismatch).
@@ -71,6 +104,9 @@ def classify_error(error: str, valid: bool = True) -> str:
     error = str(error or "").strip()
     if not error:
         return "" if valid else DETERMINISTIC
+    for pattern in DEGRADED_PATTERNS:
+        if pattern in error:
+            return DEGRADED
     for pattern in TRANSIENT_PATTERNS:
         if pattern in error:
             return TRANSIENT

@@ -23,7 +23,7 @@ Rule fields (all optional except ``site`` and ``kind``):
   ``TimeoutError`` — the retryable class), ``deterministic_error``
   (raises ``ValueError`` — parks immediately), ``corrupt`` (consumed by
   ``corrupt``/``corrupt_row`` at result-carrying sites; ``inject``
-  ignores it);
+  ignores it), or one of the **topology fault kinds** below;
 - ``match``: substring filters on the active scope's context, e.g.
   ``{"impl": "overlap"}`` / ``{"primitive": "tp_"}``;
 - ``ranks``: list of process ids the rule applies to (default: every
@@ -31,7 +31,50 @@ Rule fields (all optional except ``site`` and ``kind``):
   (``DDLB_TPU_FAULT_PLAN`` is inherited), so ``"ranks": [1]`` is what
   lets one seeded plan kill/hang exactly rank 1 mid-collective while
   its peers run clean — the rank-targeted battery of
-  ``scripts/chaos_launch.py``;
+  ``scripts/chaos_launch.py``. Matching uses the PHYSICAL rank
+  (``DDLB_TPU_PHYS_RANK``, exported by the supervised launcher's
+  degraded relaunch; falls back to the process id) so a world
+  relaunched WITHOUT an indicted slot genuinely dodges the rule that
+  targeted it;
+
+**Topology fault kinds** (ISSUE 15): at multi-pod scale the dominant
+failure is not a crash but a *degraded* component — one slow ICI link
+or throttled chip dragging every collective. The kinds ``link_slow``,
+``link_down`` and ``chip_slow`` model exactly that, selected by a
+``topo`` dict instead of rank globs::
+
+    {"site": "runtime.*", "kind": "link_slow",
+     "topo": {"axis": "ici", "index": 1, "direction": "tx",
+              "factor": 0.25},
+     "sim_link_gbs": 1e-6}
+
+- ``topo.axis``: the link class (``ici`` / ``dcn`` — CPU-sim realizes
+  both on the process ring);
+- ``topo.index``: which link (``index`` connects rank ``index`` to
+  rank ``index+1`` on the ring) or, for ``chip_slow``, which chip;
+- ``topo.direction``: ``tx`` (the sender, rank ``index``, is delayed)
+  or ``rx`` (the receiver, rank ``index+1 mod world``) — realized
+  identically in CPU-sim, carried so the health verdict can name the
+  directed link;
+- ``topo.factor``: the surviving bandwidth fraction in ``(0, 1]`` —
+  ``0.25`` is "this link runs at quarter rate";
+- ``sim_link_gbs``: the *simulated* healthy link rate in GB/s the
+  CPU-sim realization prices the delay against (default: the cpu-sim
+  chip spec's class rate, which makes the delay negligible — a chaos
+  plan that wants a measurable CPU-sim skew declares a small rate,
+  since the host never actually moves bytes at ICI speeds).
+
+Realization at the registered collective sites (``runtime.barrier``,
+``runtime.collective``, the ``overlap.ring_step`` schedule walk):
+``link_slow`` / ``chip_slow`` sleep the deterministic
+payload-proportional extra time a factor-degraded link costs —
+``perfmodel.cost.link_slow_extra_s(payload, bw, factor)``, the SAME
+closed form the simulator's ``Degradation`` overlay prices, so a
+seeded "ICI link at 0.25x" produces the skew signature the clock-sync
+fold (ISSUE 14) measures AND the degraded-world replay predicts.
+``link_down`` raises a ``link_down`` transport error on the affected
+rank, which ``faults.classify`` classes DEGRADED (the mitigating
+relaunch's trigger), never transient.
 - ``probability``: firing probability per eligible call (default 1.0),
   decided by a **deterministic stream** seeded from
   ``(plan seed, site, call index)`` — same seed, same injections, in
@@ -94,6 +137,13 @@ SITES: Dict[str, str] = {
         "a rank-targeted fault here models a rank dying/wedging inside "
         "the observability collective itself"
     ),
+    "overlap.ring_step": (
+        "chunked-fusion ring-schedule walk (ops/chunked_fusion"
+        ".plan_report) — the host-side per-hop planning step where a "
+        "topology fault (link_slow/chip_slow) charges its payload-"
+        "proportional delay on the affected rank, surfacing as that "
+        "rank's late arrival at the next collective"
+    ),
     "subprocess.entry": "pool child dispatch-loop row entry",
     "subprocess.result": "row dict corruption before posting to parent",
     "serve.admit": "serving engine request admission (prefill + slot copy)",
@@ -122,6 +172,10 @@ def set_fire_listener(fn) -> None:
     _fire_listener = fn
 
 
+#: the topology-scoped fault kinds (degraded-component model, ISSUE 15)
+TOPO_KINDS = ("link_slow", "link_down", "chip_slow")
+
+
 class FaultRule:
     """One plan rule; see the module docstring for field semantics."""
 
@@ -132,7 +186,7 @@ class FaultRule:
         self.kind = str(spec["kind"])
         if self.kind not in (
             "hang", "exit", "kill", "transient_error",
-            "deterministic_error", "corrupt",
+            "deterministic_error", "corrupt", *TOPO_KINDS,
         ):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         self.match = {str(k): str(v) for k, v in spec.get("match", {}).items()}
@@ -146,11 +200,94 @@ class FaultRule:
         self.fail_attempts = int(spec.get("fail_attempts", 1))
         self.duration_s = float(spec.get("duration_s", 3600.0))
         self.exit_code = int(spec.get("exit_code", 1))
+        self.topo: Optional[Dict[str, Any]] = None
+        self.sim_link_gbs = spec.get("sim_link_gbs")
+        if self.kind in TOPO_KINDS:
+            topo = spec.get("topo")
+            if not isinstance(topo, dict) or "index" not in topo:
+                raise ValueError(
+                    f"topology fault kind {self.kind!r} needs a 'topo' "
+                    f"dict with at least 'index': {spec!r}"
+                )
+            factor = float(topo.get("factor", 1.0))
+            if self.kind != "link_down" and not (0.0 < factor <= 1.0):
+                raise ValueError(
+                    f"{self.kind} topo.factor must be in (0, 1], got "
+                    f"{factor}"
+                )
+            direction = str(topo.get("direction", "tx"))
+            if direction not in ("tx", "rx"):
+                raise ValueError(
+                    f"topo.direction must be 'tx' or 'rx', got "
+                    f"{direction!r}"
+                )
+            self.topo = {
+                "axis": str(topo.get("axis", "ici")),
+                "index": int(topo["index"]),
+                "direction": direction,
+                "factor": factor,
+            }
+
+    def affected_rank(self) -> Optional[int]:
+        """The PHYSICAL rank a topology-scoped rule degrades: the chip
+        itself for ``chip_slow``; for link kinds, link ``index``
+        connects rank ``index`` -> rank ``index+1`` on the CPU-sim
+        process ring, so ``tx`` degrades rank ``index`` and ``rx`` the
+        receiver ``index+1 mod world``. The modulo rides the FULL
+        physical ring (``envs.get_physical_world`` — exported by the
+        supervised launcher), never the possibly-shrunken process
+        count: a degraded relaunch keeps full-world slot numbering,
+        and wrapping around the shrunk count would re-target a
+        surviving healthy slot. None for non-topo rules."""
+        if self.topo is None:
+            return None
+        index = self.topo["index"]
+        if self.kind == "chip_slow" or self.topo["direction"] == "tx":
+            return index
+        world = max(1, envs.get_physical_world())
+        return (index + 1) % world
+
+    def link_label(self) -> str:
+        """Human name of the degraded component (the health verdict's
+        link vocabulary): ``ici[1->2]`` / ``chip[1]``."""
+        if self.topo is None:
+            return ""
+        if self.kind == "chip_slow":
+            return f"chip[{self.topo['index']}]"
+        world = max(1, envs.get_physical_world())
+        i = self.topo["index"]
+        return f"{self.topo['axis']}[{i}->{(i + 1) % world}]"
+
+    def delay_s(self, payload_bytes: float) -> float:
+        """The payload-proportional extra seconds this rule's degraded
+        link charges one crossing — ``perfmodel.cost.link_slow_extra_s``
+        with the rule's simulated link rate (see module docstring), the
+        same closed form the simulator's ``Degradation`` overlay
+        prices."""
+        from ddlb_tpu.perfmodel.cost import link_slow_extra_s
+        from ddlb_tpu.perfmodel.specs import get_spec
+
+        if self.topo is None or payload_bytes <= 0.0:
+            return 0.0
+        if self.sim_link_gbs is not None:
+            bw = float(self.sim_link_gbs) * 1e9
+        else:
+            spec = get_spec("cpu-sim")
+            transport = "dcn" if self.topo["axis"] == "dcn" else "ici"
+            bw = spec.link_bw(transport)
+        return link_slow_extra_s(
+            float(payload_bytes), bw, self.topo["factor"]
+        )
 
     def matches(self, site: str, context: Dict[str, str]) -> bool:
         if not fnmatch.fnmatchcase(site, self.site):
             return False
-        if self.ranks is not None and envs.get_process_id() not in self.ranks:
+        if self.ranks is not None and (
+            envs.get_physical_rank() not in self.ranks
+        ):
+            return False
+        affected = self.affected_rank()
+        if affected is not None and envs.get_physical_rank() != affected:
             return False
         for key, needle in self.match.items():
             if needle not in context.get(key, ""):
@@ -352,21 +489,42 @@ def _resolve(site: str, context: Dict[str, Any], kinds: tuple, fire=True):
     return rule
 
 
-def inject(site: str, **context: Any) -> None:
+def inject(
+    site: str, payload_bytes: float = 0.0, **context: Any
+) -> None:
     """Injection site: no-op unless a loaded plan has a firing rule here,
     in which case the configured fault happens (raise / hang / abrupt
-    process death). The no-plan fast path is one ``is None`` check."""
+    process death / degraded-link delay). The no-plan fast path is one
+    ``is None`` check. ``payload_bytes`` is what the site would move
+    over the wire — the quantity the topology fault kinds price their
+    payload-proportional delay against (collective sites pass their
+    real payload; sites that pass nothing see zero topo delay)."""
     if _plan is None:
         return
     rule = _resolve(
         site, context,
-        ("hang", "exit", "kill", "transient_error", "deterministic_error"),
+        ("hang", "exit", "kill", "transient_error", "deterministic_error",
+         *TOPO_KINDS),
     )
     if rule is None:
         return
     if rule.kind == "hang":
         time.sleep(rule.duration_s)
         return
+    if rule.kind in ("link_slow", "chip_slow"):
+        # the degraded-component realization: the deterministic extra
+        # time a factor-degraded link costs this payload, charged as a
+        # sleep on the affected rank — its peers then measure exactly
+        # the arrival-skew signature the clock-sync fold attributes
+        extra = rule.delay_s(payload_bytes)
+        if extra > 0.0:
+            telemetry.record("fault.delay_s", extra)
+            time.sleep(extra)
+        return
+    if rule.kind == "link_down":
+        raise ConnectionError(
+            f"injected link_down at {site}: {rule.link_label()} is down"
+        )
     if rule.kind == "exit":
         os._exit(rule.exit_code)
     if rule.kind == "kill":

@@ -39,6 +39,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ddlb_tpu.runtime import set_mesh_compat, shard_map_compat
+
 LN_EPS = 1e-6
 
 
@@ -960,7 +962,9 @@ def make_loss_fn(mesh, cfg: TransformerConfig):
         loss = jax.lax.psum(loss, "tp") / tp
         return loss
 
-    loss_fn = jax.shard_map(
+    # runtime.shard_map_compat (DDLB101 migration): jax 0.4.x has no
+    # jax.shard_map — the compat shim maps check_vma onto check_rep
+    loss_fn = shard_map_compat(
         loss_body,
         mesh=mesh,
         in_specs=(specs, P("dp", None), P("dp", None)),
@@ -1017,7 +1021,7 @@ def make_train_step(
         # sharding — an uncommitted single-device skeleton would pin
         # checkpoint restores to one device (models/checkpoint.py places
         # onto the target's sharding)
-        with jax.set_mesh(mesh):
+        with set_mesh_compat(mesh):
             return jax.jit(optimizer.init)(params)
 
     return train_step, init_opt_state, shardings

@@ -28,7 +28,12 @@ process tiers, same contract as telemetry and perfmodel):
   stream (``DDLB_TPU_LIVE``) fed by the pool's heartbeat and the
   runner's row completions, consumed by the ``scripts/sweep_dash.py``
   TUI — per-worker state, rows done/parked/quarantined, the current
-  row's phase, rolling predicted-vs-measured.
+  row's phase, rolling predicted-vs-measured;
+- **persistent-straggler indictment** (``observatory.health``, ISSUE
+  15): banked straggler/skew columns folded across rows and runs into
+  a per-rank/per-link transient-vs-persistent verdict — the trigger
+  for the supervised launcher's degraded relaunch, rendered by
+  ``scripts/health_report.py`` and gated in ``regress.detect_all``.
 
 Everything is env-gated with the package's "" = disabled convention and
 best-effort by contract: observability must never abort or perturb the

@@ -1,0 +1,262 @@
+"""Persistent-straggler indictment: transient hiccup vs bad hardware.
+
+The skew fold (ISSUE 14) says which rank a single row's collectives
+waited on; the skew GATE says a row waits more than its history. What
+neither says is whether the straggler is a one-off — a scheduler
+stall, a compaction pause — or a *persistently degraded component* (a
+slow ICI link, a thermally-throttled chip: the dominant failure shape
+of The Big Send-off's reliability-at-scale regime) that every future
+run will hit again. This module is that verdict: it folds straggler
+observations across rows and runs into a per-rank/per-link health
+verdict the mitigating relaunch (``cli/launch.py --supervise``) can
+act on.
+
+An **observation** is one corroborating piece of evidence: a banked
+result row's ``straggler_rank`` / ``skew_enter_s`` / ``clock_unc_s``
+columns (``observations_from_history``), or one clock-aligned world
+collective from a flight-recorder timeline
+(``observations_from_timeline``). An observation *qualifies* only when
+
+- it names a rank (``straggler_rank >= 0``),
+- its skew clears the absolute noise floor ``MIN_SKEW_S`` (clean-run
+  scheduler jitter must never accumulate into an indictment), and
+- its skew exceeds the observation's own clock-alignment uncertainty
+  bound — a skew claim inside ``clock_unc_s`` is noise by definition
+  (the same guard ``regress.detect_skew`` applies). A row whose fold
+  made NO alignment claim (NaN ``clock_unc_s`` on a multi-process row)
+  contributes nothing.
+
+The **verdict** (``verdict_from_observations``) refuses to indict on
+thin evidence: a persistent indictment needs at least
+``MIN_OBSERVATIONS`` qualifying observations AND one rank causing at
+least ``DOMINANCE`` of them — a single skewed row is refused outright,
+and alternating stragglers (ranks trading places run to run: host
+noise, not hardware) classify *transient*. A persistent verdict names
+the rank, the candidate hardware (the chip and its ring-neighbor
+links, the fault model's ``link_label`` vocabulary), and the evidence
+counts.
+
+``scripts/health_report.py`` renders the verdict;
+``regress.detect_all`` gates it next to the time/SLO/skew detectors;
+the supervised launcher consults ``relaunch_policy`` before shrinking
+a world around an indicted rank. Stdlib-only, like the rest of the
+observatory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ddlb_tpu.observatory.regress import finite
+
+#: a persistent indictment needs at least this many qualifying
+#: observations — a single skewed row (or one skewed collective) is
+#: refused outright, whatever its magnitude
+MIN_OBSERVATIONS = 3
+
+#: ...and one rank must cause at least this share of them: stragglers
+#: alternating between ranks are host noise (transient), not hardware
+DOMINANCE = 0.6
+
+#: absolute per-observation noise floor, seconds of arrival skew —
+#: below it an observation never qualifies (clean-run scheduler jitter
+#: lives here; the same philosophy as regress.SKEW_METRICS' floors)
+MIN_SKEW_S = 0.05
+
+HEALTHY = "healthy"
+TRANSIENT = "transient"
+PERSISTENT = "persistent"
+
+
+def qualifying_rank(
+    rank: Any, skew_s: Any, unc_s: Any, min_skew_s: float
+) -> Optional[int]:
+    """The qualifying rank of one observation, or None. ``unc_s``
+    semantics: a finite bound gates the skew (within the bound = no
+    claim); NaN/None means the source made no alignment claim at all —
+    refused, matching ``detect_skew``'s NaN-uncertainty rule; 0.0 is an
+    exact-clock claim (raw single-host stamps) and gates nothing."""
+    try:
+        r = int(rank)
+    except (TypeError, ValueError):
+        return None
+    if r < 0:
+        return None
+    skew = finite(skew_s)
+    if skew is None or skew <= min_skew_s:
+        return None
+    unc = finite(unc_s)
+    if unc is None:
+        return None
+    if skew <= unc:
+        return None
+    return r
+
+
+def observations_from_history(
+    records: Sequence[Dict[str, Any]],
+    run_id: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Observations from banked history records (``store.load_history``
+    shape): one per row that carries the skew columns. ``run_id``
+    restricts to one run's rows (the launcher's per-attempt check);
+    None folds the whole bank (the longitudinal report)."""
+    out: List[Dict[str, Any]] = []
+    for record in records:
+        if record.get("kind", "row") != "row":
+            continue
+        if run_id is not None and record.get("run_id") != run_id:
+            continue
+        row = record.get("row") or {}
+        if "straggler_rank" not in row:
+            continue
+        out.append(
+            {
+                "rank": row.get("straggler_rank"),
+                "skew_s": row.get("skew_enter_s"),
+                "unc_s": row.get("clock_unc_s"),
+                "source": "row",
+                "run_id": record.get("run_id"),
+                "label": str(row.get("implementation") or ""),
+            }
+        )
+    return out
+
+
+def observations_from_rows(
+    rows: Sequence[Dict[str, Any]], run_id: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Observations from bare result rows (a current, not-yet-banked
+    run — the ``detect_all`` surface)."""
+    return observations_from_history(
+        [{"kind": "row", "row": row, "run_id": run_id} for row in rows],
+    )
+
+
+def observations_from_timeline(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Observations from a world-timeline document
+    (``observatory.timeline.build_world_timeline``): one per
+    sequence-joined two-sided collective. Multi-rank timelines that
+    could not align (``alignment: none`` — too few exchange points)
+    contribute nothing: their raw cross-rank stamps carry no claim the
+    verdict could trust (single-host dirs included, conservatively —
+    the launcher's worlds fit plenty of barrier exchanges)."""
+    if doc.get("alignment") != "barrier":
+        return []
+    out: List[Dict[str, Any]] = []
+    for coll in doc.get("collectives", ()):
+        out.append(
+            {
+                "rank": coll.get("straggler_rank"),
+                "skew_s": coll.get("skew_enter_s"),
+                "unc_s": coll.get("unc_s"),
+                "source": "collective",
+                "run_id": doc.get("run_dir"),
+                "label": f"seq {coll.get('seq')} {coll.get('site')}",
+            }
+        )
+    return out
+
+
+def link_candidates(rank: int, world: Optional[int]) -> List[str]:
+    """The hardware a persistently-straggling rank indicts: its chip
+    and the ring-neighbor links it receives/sends on — the fault
+    model's ``link_label`` vocabulary (``faults.plan``), so a chaos
+    battery can assert the seeded link is among the candidates. A
+    straggler observation cannot distinguish a slow chip from a slow
+    inbound link; the verdict honestly names all three."""
+    out = [f"chip[{rank}]"]
+    if world and world > 1:
+        prev = (rank - 1) % world
+        out.append(f"ici[{prev}->{rank}]")
+        out.append(f"ici[{rank}->{(rank + 1) % world}]")
+    return out
+
+
+def verdict_from_observations(
+    observations: Sequence[Dict[str, Any]],
+    world: Optional[int] = None,
+    min_observations: int = MIN_OBSERVATIONS,
+    dominance: float = DOMINANCE,
+    min_skew_s: float = MIN_SKEW_S,
+) -> Dict[str, Any]:
+    """Fold observations into the health verdict (module docstring)."""
+    counts: Dict[int, int] = {}
+    caused: Dict[int, float] = {}
+    runs: Dict[int, set] = {}
+    qualifying = 0
+    for obs in observations:
+        rank = qualifying_rank(
+            obs.get("rank"), obs.get("skew_s"), obs.get("unc_s"), min_skew_s
+        )
+        if rank is None:
+            continue
+        qualifying += 1
+        counts[rank] = counts.get(rank, 0) + 1
+        caused[rank] = caused.get(rank, 0.0) + float(obs["skew_s"])
+        runs.setdefault(rank, set()).add(obs.get("run_id"))
+    doc: Dict[str, Any] = {
+        "observations": len(observations),
+        "qualifying": qualifying,
+        "per_rank": {
+            r: {
+                "count": counts[r],
+                "caused_s": caused[r],
+                "runs": len(runs[r]),
+            }
+            for r in sorted(counts)
+        },
+    }
+    if qualifying == 0:
+        doc.update(
+            status=HEALTHY, rank=-1, share=0.0, links=[],
+            reason="no qualifying straggler observations",
+        )
+        return doc
+    top = max(counts, key=lambda r: (counts[r], caused[r]))
+    share = counts[top] / qualifying
+    doc.update(rank=top, share=share)
+    if counts[top] < min_observations:
+        doc.update(
+            status=TRANSIENT, links=[],
+            reason=(
+                f"rank {top} straggled {counts[top]}x — below the "
+                f"{min_observations}-observation corroboration floor "
+                f"(a single skewed row never indicts)"
+            ),
+        )
+        return doc
+    if share < dominance:
+        doc.update(
+            status=TRANSIENT, rank=-1, links=[],
+            reason=(
+                f"stragglers alternate across ranks (top rank {top} "
+                f"caused only {share:.0%} of {qualifying} qualifying "
+                f"observations, dominance floor {dominance:.0%}) — host "
+                f"noise, not hardware"
+            ),
+        )
+        return doc
+    doc.update(
+        status=PERSISTENT,
+        links=link_candidates(top, world),
+        reason=(
+            f"rank {top} was the straggler in {counts[top]} of "
+            f"{qualifying} qualifying observations ({share:.0%}) across "
+            f"{len(runs[top])} run(s), causing {caused[top]:.3f}s of "
+            f"arrival skew"
+        ),
+    )
+    return doc
+
+
+def relaunch_policy(n_ranks: int, n_excluded: int = 0) -> str:
+    """What a persistent indictment permits: ``"exclude"`` when
+    shrinking the world around the indicted rank still leaves a
+    genuinely distributed world (>= 2 survivors), ``"fatal"``
+    otherwise — a ``link_down`` on a 2-rank world has no degraded mode
+    to limp along in (excluding either endpoint leaves a single-rank
+    non-world), so the failure is fatal-not-degraded and must park,
+    never relaunch."""
+    survivors = int(n_ranks) - int(n_excluded) - 1
+    return "exclude" if survivors >= 2 else "fatal"

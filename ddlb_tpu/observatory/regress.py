@@ -277,14 +277,21 @@ def _history_finding(
 
 
 def _rank(findings: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """History-backed findings by robust z (worst first), then
-    prior-only advisories by measured/predicted ratio — the one ranking
-    rule shared by the time gate, the SLO gate and their union."""
+    """Health indictments first (a persistent-hardware verdict outranks
+    any single-key regression — it predicts EVERY future run), then
+    history-backed findings by robust z (worst first), then prior-only
+    advisories by measured/predicted ratio — the one ranking rule
+    shared by the time gate, the SLO gate, the health gate and their
+    union."""
+    health = [f for f in findings if f["source"] == "health"]
     history_backed = [f for f in findings if f["source"] == "history"]
-    prior_only = [f for f in findings if f["source"] != "history"]
+    prior_only = [
+        f for f in findings if f["source"] not in ("history", "health")
+    ]
+    health.sort(key=lambda f: -f.get("caused_s", 0.0))
     history_backed.sort(key=lambda f: -f["z"])
     prior_only.sort(key=lambda f: -f["ratio"])
-    return history_backed + prior_only
+    return health + history_backed + prior_only
 
 
 def _detect_metrics(
@@ -416,6 +423,81 @@ def detect_skew(
     return kept
 
 
+def detect_health(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    exclude_run: Optional[str] = None,
+) -> List[Dict[str, Any]]:
+    """Persistent-straggler indictment finding (ISSUE 15): the health
+    verdict (``observatory.health``) folded over the banked
+    observations PLUS the current run's rows. At most one finding —
+    ``metric="persistent_straggler"``, ``source="health"`` — and only
+    when the CURRENT run contributes at least one qualifying
+    observation naming the indicted rank: a bank whose old rows already
+    indicted a since-replaced component must not re-flag every clean
+    run after it forever."""
+    from ddlb_tpu.observatory import health
+
+    hist_obs = health.observations_from_history(
+        [
+            r for r in history
+            if not (exclude_run and r.get("run_id") == exclude_run)
+        ]
+    )
+    cur_obs = health.observations_from_rows(current_rows)
+    # the world size names the indicted rank's neighbor-link candidates
+    # (link_candidates): the rows themselves carry it
+    world = max(
+        (
+            int(w)
+            for w in (
+                finite(row.get("num_processes")) for row in current_rows
+            )
+            if w is not None and w > 1
+        ),
+        default=None,
+    )
+    verdict = health.verdict_from_observations(
+        hist_obs + cur_obs, world=world
+    )
+    if verdict["status"] != health.PERSISTENT:
+        return []
+    rank = verdict["rank"]
+    corroborating = [
+        row
+        for row, obs in zip(current_rows, cur_obs)
+        if health.qualifying_rank(
+            obs.get("rank"), obs.get("skew_s"), obs.get("unc_s"),
+            health.MIN_SKEW_S,
+        ) == rank
+    ]
+    if not corroborating:
+        return []
+    stats = verdict["per_rank"][rank]
+    return [
+        {
+            **_ident(corroborating[0]),
+            "key": "world",
+            "metric": "persistent_straggler",
+            "source": "health",
+            "straggler_rank": rank,
+            # report-compatible numeric fields: the caused skew is the
+            # measured quantity, the healthy baseline is zero, and the
+            # corroboration count stands in for the ratio column
+            "measured_ms": stats["caused_s"] * 1e3,
+            "baseline_ms": 0.0,
+            "ratio": float(stats["count"]),
+            "z": float("nan"),
+            "caused_s": stats["caused_s"],
+            "share": verdict["share"],
+            "observations": stats["count"],
+            "runs": stats["runs"],
+            "links": verdict["links"],
+            "reason": verdict["reason"],
+        }
+    ]
+
+
 def detect_all(
     current_rows: List[Dict[str, Any]],
     history: List[Dict[str, Any]],
@@ -427,11 +509,18 @@ def detect_all(
 ) -> List[Dict[str, Any]]:
     """The full gate: the default time metric (``detect``, perfmodel
     prior included) PLUS every SLO metric (``detect_slo``) PLUS the
-    cross-rank skew metrics (``detect_skew``), re-ranked as one list so
-    a serving SLO blow-up or a straggler regression competes with — and
-    can outrank — a kernel-time regression in the same report."""
+    cross-rank skew metrics (``detect_skew``) PLUS the
+    persistent-straggler health verdict (``detect_health``), re-ranked
+    as one list so a serving SLO blow-up, a straggler regression or a
+    hardware indictment competes with — and can outrank — a kernel-time
+    regression in the same report."""
     return _rank(
-        detect(
+        detect_health(
+            current_rows,
+            history,
+            exclude_run=exclude_run,
+        )
+        + detect(
             current_rows,
             history,
             exclude_run=exclude_run,

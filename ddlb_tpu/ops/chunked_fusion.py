@@ -58,7 +58,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from ddlb_tpu import native, telemetry
+from ddlb_tpu import faults, native, telemetry
 from ddlb_tpu.primitives.base import accum_wire_dtypes
 
 
@@ -67,12 +67,21 @@ def fwd_perm(d: int):
     return [(i, (i + 1) % d) for i in range(d)]
 
 
-def plan_report(role: str, *, d: int, chunk_count: int, payload_elems: int) -> None:
+def plan_report(
+    role: str, *, d: int, chunk_count: int, payload_elems: int,
+    itemsize: int = 2,
+) -> None:
     """Emit the planned chunk/ring-step schedule into the telemetry
     trace (host-side, at member construction): one ``overlap.chunk``
     span per chunk, one ``overlap.ring_step`` span per planned hop
     inside it — the structural record the trace reports join against
-    when diagnosing a chunked member's schedule."""
+    when diagnosing a chunked member's schedule. Each planned hop is
+    also a topology-fault injection site: a seeded degraded link
+    charges its payload-proportional delay here on the affected rank
+    (host-side — the traced ring itself cannot host a sleep), so the
+    slow rank arrives late at the next collective exactly as a dragged
+    ring schedule would. ``itemsize`` prices the hop payload (the sweep
+    members ride bf16 wires by default)."""
     hops = max(0, d - 1)
     for j in range(chunk_count):
         with telemetry.span(
@@ -81,7 +90,11 @@ def plan_report(role: str, *, d: int, chunk_count: int, payload_elems: int) -> N
         ):
             for t in range(hops):
                 with telemetry.span("overlap.ring_step", chunk=j, step=t):
-                    pass
+                    faults.inject(
+                        "overlap.ring_step",
+                        payload_bytes=payload_elems * itemsize,
+                        role=role,
+                    )
 
 
 # ---------------------------------------------------------------------------

@@ -139,6 +139,50 @@ def ring_wire_bytes(op: str, nbytes: float, d: int) -> float:
     raise ValueError(f"Unknown collective op {op!r}")
 
 
+def degraded_bw(bw: float, factor: float) -> float:
+    """Surviving bandwidth of a degraded link: ``bw * factor``, with
+    ``factor`` in ``[0, 1]`` (0 = link down). The one place the
+    multiplier semantics live — the fault realization
+    (``faults.plan``), the ``Degradation`` topology overlay and the
+    degraded replay all price through it."""
+    if not (0.0 <= factor <= 1.0):
+        raise ValueError(f"degradation factor must be in [0, 1], got {factor}")
+    return bw * factor
+
+
+def link_slow_extra_s(nbytes: float, bw: float, factor: float) -> float:
+    """Extra seconds one ``nbytes`` crossing of a ``factor``-degraded
+    link costs over the healthy transfer: ``nbytes/(bw*factor) -
+    nbytes/bw``. This is the degraded wire formula BOTH sides of the
+    detect->mitigate loop share: the CPU-sim fault realization sleeps
+    exactly this (``faults.plan.FaultRule.delay_s``), and the
+    simulator's degraded-world replay predicts it — which is what lets
+    ``scripts/chaos_degrade.py`` assert the prediction brackets the
+    measured skew. ``factor=0`` (link down) is not a delay but an
+    outage; it raises."""
+    slow = degraded_bw(bw, factor)
+    if slow <= 0.0:
+        raise ValueError(
+            "link_slow_extra_s models a SLOW link; factor=0 is link_down"
+        )
+    if nbytes <= 0.0 or bw <= 0.0:
+        return 0.0
+    return nbytes / slow - nbytes / bw
+
+
+def degraded_ring_time_s(
+    op: str, nbytes: float, d: int, bw: float, factor: float = 1.0
+) -> float:
+    """Closed-form flat-ring collective time on a ``factor``-degraded
+    link class: the bandwidth-optimal wire bytes over the surviving
+    rate. The degenerate check the degraded replay must land on (the
+    degraded analogue of the healthy closed-form gate)."""
+    slow = degraded_bw(bw, factor)
+    if slow <= 0.0:
+        return float("inf")
+    return ring_wire_bytes(op, nbytes, d) / slow
+
+
 def hierarchical_phases(
     op: str, nbytes: float, intra: int, inter: int
 ) -> Tuple[Dict[str, object], ...]:

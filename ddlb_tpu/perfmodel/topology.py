@@ -29,9 +29,10 @@ every schedule step declares the one resource it occupies, and
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Tuple
+from dataclasses import dataclass, field, replace
+from typing import Dict, Mapping, Optional, Tuple
 
+from ddlb_tpu.perfmodel.cost import degraded_bw
 from ddlb_tpu.perfmodel.specs import ChipSpec, get_spec
 
 #: the env override (read via ``envs.get_topology_override`` — the one
@@ -41,6 +42,80 @@ TOPOLOGY_ENV = "DDLB_TPU_TOPOLOGY"
 #: spec format: ``<chip>:<pods>x<dim0>[x<dim1>...]`` — first factor is
 #: the DCN (pod) axis, the rest the per-slice ICI mesh
 SPEC_FORMAT = "<chip>:<pods>x<ici_dim>[x<ici_dim>...]"
+
+
+@dataclass(frozen=True)
+class Degradation:
+    """Per-link-class degradation overlay (ISSUE 15): the degraded-world
+    twin of a healthy ``Topology``.
+
+    ``factors`` maps link-class resource names (``ici0``..``iciN-1``,
+    ``dcn``) to the surviving bandwidth fraction in ``(0, 1]`` —
+    ``{"dcn": 0.75}`` is "one of the four bonded DCN trunk links down".
+    ``down`` names classes that failed outright (``link_down``):
+    schedule steps billed against them price at zero rate (infinite
+    duration), so an unroutable composition honestly replays to an
+    infinite makespan while reroute-capable compositions (striping over
+    the surviving torus axes) route around it at build time.
+
+    Spec string: comma-joined ``class=factor`` pairs, factor 0 meaning
+    down — ``"dcn=0.25"`` / ``"ici1=0"`` — the ``sim_report --degrade``
+    surface.
+    """
+
+    factors: Mapping[str, float] = field(default_factory=dict)
+    down: Tuple[str, ...] = ()
+    name: str = ""
+
+    def __post_init__(self) -> None:
+        for cls, f in self.factors.items():
+            if not (0.0 < float(f) <= 1.0):
+                raise ValueError(
+                    f"degradation factor for {cls!r} must be in (0, 1] "
+                    f"(use down= for failed links), got {f}"
+                )
+        if not self.name:
+            parts = [f"{c}={self.factors[c]:g}" for c in sorted(self.factors)]
+            parts += [f"{c}=0" for c in sorted(self.down)]
+            object.__setattr__(self, "name", ",".join(parts) or "healthy")
+
+    def factor(self, resource: str) -> float:
+        """Surviving-bandwidth multiplier for one link class: 0.0 when
+        the class is down, 1.0 when untouched."""
+        if resource in self.down:
+            return 0.0
+        return float(self.factors.get(resource, 1.0))
+
+
+def parse_degradation(spec: str) -> Degradation:
+    """``'dcn=0.25,ici1=0'`` -> a ``Degradation`` (factor 0 = down)."""
+    factors: Dict[str, float] = {}
+    down = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        cls, sep, value = part.partition("=")
+        cls = cls.strip()
+        if not sep or not cls:
+            raise ValueError(
+                f"Bad degradation spec {spec!r}: expected "
+                f"class=factor[,class=factor...] (factor 0 = link down)"
+            )
+        try:
+            f = float(value)
+        except ValueError:
+            raise ValueError(
+                f"Bad degradation spec {spec!r}: factor {value!r} is not "
+                f"a number"
+            ) from None
+        if f == 0.0:
+            down.append(cls)
+        else:
+            factors[cls] = f
+    if not factors and not down:
+        raise ValueError(f"Bad degradation spec {spec!r}: empty")
+    return Degradation(factors=factors, down=tuple(down))
 
 
 @dataclass(frozen=True)
@@ -59,6 +134,8 @@ class Topology:
     pods: int = 1
     ici_mesh: Tuple[int, ...] = (8,)
     name: str = ""
+    #: the degraded-world overlay; None = every link healthy
+    degradation: Optional[Degradation] = None
 
     def __post_init__(self) -> None:
         if self.pods < 1:
@@ -68,7 +145,31 @@ class Topology:
                 f"ici_mesh needs positive dims, got {self.ici_mesh!r}"
             )
         if not self.name:
-            object.__setattr__(self, "name", self.spec_string())
+            name = self.spec_string()
+            if self.degradation is not None:
+                name = f"{name}!{self.degradation.name}"
+            object.__setattr__(self, "name", name)
+
+    def degraded(self, degradation: Degradation) -> "Topology":
+        """This world with ``degradation`` overlaid (fresh name so a
+        report can show healthy and degraded side by side)."""
+        return replace(self, degradation=degradation, name="")
+
+    def link_factor(self, resource: str) -> float:
+        """The overlay's surviving-bandwidth multiplier for one link
+        class (1.0 on a healthy world)."""
+        if self.degradation is None:
+            return 1.0
+        return self.degradation.factor(resource)
+
+    def alive_ici_axes(self) -> Tuple[int, ...]:
+        """ICI mesh dimensions whose ring family still carries traffic —
+        the axes multi-path striping can reroute over."""
+        return tuple(
+            i
+            for i in range(len(self.ici_mesh))
+            if self.link_factor(f"ici{i}") > 0.0
+        )
 
     # -- composition ---------------------------------------------------------
 
@@ -101,14 +202,26 @@ class Topology:
     def flat_bw(self) -> float:
         """The rate one synchronous flat-ring step advances at: the
         slowest link class the world-spanning ring must cross (ICI on a
-        single pod, the DCN share otherwise)."""
+        single pod, the DCN share otherwise). A world-spanning snake
+        crosses EVERY ici ring family, so under a degradation the rate
+        is gated by the worst surviving multiplier — and goes to zero
+        (unroutable) when any crossed class is down."""
+        ici = min(
+            (
+                degraded_bw(self.ici_bw, self.link_factor(f"ici{i}"))
+                for i in range(len(self.ici_mesh))
+            ),
+            default=self.ici_bw,
+        )
         if self.pods > 1:
-            return min(self.ici_bw, self.dcn_bw)
-        return self.ici_bw
+            return min(ici, degraded_bw(self.dcn_bw, self.link_factor("dcn")))
+        return ici
 
     def resource_rate(self, resource: str, dtype: str = "bfloat16") -> float:
         """Price of one schedule resource, in units/second: FLOP/s for
-        ``mxu`` (at the chip's ``dtype`` peak), bytes/s otherwise.
+        ``mxu`` (at the chip's ``dtype`` peak), bytes/s otherwise — link
+        classes scaled by the degradation overlay (0.0 = down; the
+        engine prices a step on a downed link at infinite duration).
         Unknown resources raise — a schedule step billed against a
         resource the topology cannot price would otherwise simulate at
         infinite speed."""
@@ -117,13 +230,13 @@ class Topology:
         if resource == "hbm":
             return self.chip.hbm_bw
         if resource == "dcn":
-            return self.dcn_bw
+            return degraded_bw(self.dcn_bw, self.link_factor("dcn"))
         if resource == "flat":
             return self.flat_bw
         if resource.startswith("ici"):
             idx = resource[3:] or "0"
             if idx.isdigit() and int(idx) < len(self.ici_mesh):
-                return self.ici_bw
+                return degraded_bw(self.ici_bw, self.link_factor(resource))
         raise ValueError(
             f"Topology {self.name} cannot price resource {resource!r} "
             f"(ici_mesh has {len(self.ici_mesh)} dims)"
@@ -154,12 +267,15 @@ class Topology:
 
     def describe(self) -> str:
         dims = "x".join(str(d) for d in self.ici_mesh)
-        return (
+        text = (
             f"{self.name}: {self.num_chips} x {self.chip.name} chips "
             f"({self.pods} pod(s) of {dims}), "
             f"ici {self.ici_bw / 1e9:.0f} GB/s/dir, "
             f"dcn {self.dcn_bw / 1e9:.2f} GB/s/chip"
         )
+        if self.degradation is not None:
+            text += f", DEGRADED {self.degradation.name}"
+        return text
 
 
 def parse_topology(spec: str) -> Topology:

@@ -442,8 +442,12 @@ class Runtime:
         # collective-entry injection site: the barrier is the one
         # collective EVERY timing path crosses, so a fault here models a
         # wedged transport mid-sweep (e.g. hang = a peer that never
-        # arrives; the subprocess parent's heartbeat kill recovers it)
-        faults.inject("runtime.barrier")
+        # arrives; the subprocess parent's heartbeat kill recovers it).
+        # payload_bytes feeds the topology fault kinds' payload-
+        # proportional delay (the degraded-link realization)
+        faults.inject(
+            "runtime.barrier", payload_bytes=4 * self.num_devices
+        )
         # the clock-sync exchange stamps bracket everything AFTER the
         # injection site: a fault-delayed rank arrives late on its own
         # stamp, exactly what the skew fold must attribute. Monotonic

@@ -99,8 +99,17 @@ ROW_COLUMNS: Dict[str, str] = {
     # -- robustness / self-healing (PR 4) -------------------------------
     "retries": "retry attempts this row consumed before its final state",
     "fault_injected": "fault-plan sites that fired under this row (csv)",
-    "error_class": "transient / deterministic / quarantined / '' (clean)",
+    "error_class": (
+        "transient / degraded / deterministic / quarantined / '' (clean)"
+    ),
     "quarantined": "row skipped because its impl was quarantined",
+    # -- degraded worlds (ISSUE 15) --------------------------------------
+    "world_degraded": (
+        "row measured on a DEGRADED world: the supervised launcher"
+        " relaunched shrunk/remapped around an indicted rank"
+        " (DDLB_TPU_WORLD_DEGRADED) — banked history must tell limp-mode"
+        " measurements from full-world ones"
+    ),
     # -- warm-worker pool (PR 5) ----------------------------------------
     "worker_reused": "row ran on an already-warm pool worker",
     "worker_setup_s": "child init cost when this row paid the spawn",

@@ -111,7 +111,13 @@ def _duration(step, topology: Topology) -> float:
         return step.flops / topology.resource_rate("mxu", step.dtype)
     if isinstance(step, HbmStep):
         return step.nbytes / topology.resource_rate("hbm")
-    return step.nbytes / topology.resource_rate(step.resource)
+    rate = topology.resource_rate(step.resource)
+    if rate <= 0.0:
+        # a downed link (Degradation overlay): the step never completes —
+        # an unroutable program honestly replays to an infinite makespan
+        # instead of crashing, so degraded rankings can SHOW the outage
+        return math.inf if step.nbytes > 0.0 else 0.0
+    return step.nbytes / rate
 
 
 def replay(program: ScheduleProgram, topology: Topology) -> ReplayResult:

@@ -129,14 +129,20 @@ def hierarchical_program(
     op: str, nbytes: float, topology: Topology, name: str = ""
 ) -> ScheduleProgram:
     """HiCCL-style two-level composition (``perfmodel.cost
-    .hierarchical_phases``): intra phases ride the first ICI ring
-    family, the inter phase rides each chip's DCN share. Phases chain —
-    they are data-dependent by construction."""
+    .hierarchical_phases``): intra phases ride one ICI ring family, the
+    inter phase rides each chip's DCN share. Phases chain — they are
+    data-dependent by construction. Under a ``Degradation`` with a
+    downed ICI axis the intra phases reroute onto the first SURVIVING
+    axis (the composition needs one healthy ring family, not a
+    particular one); a downed DCN has no alternative and the program
+    honestly replays unroutable."""
+    alive = topology.alive_ici_axes()
+    intra_scope = f"ici{alive[0]}" if alive else "ici0"
     steps: List[WireStep] = []
     for ph in hierarchical_phases(
         op, nbytes, topology.chips_per_pod, topology.pods
     ):
-        scope = "ici0" if ph["scope"] == "intra" else "dcn"
+        scope = intra_scope if ph["scope"] == "intra" else "dcn"
         steps.extend(
             _ring_steps(ph["op"], ph["nbytes"], ph["axis"], scope, ph["tag"])
         )
@@ -146,6 +152,7 @@ def hierarchical_program(
         algo="hierarchical",
         op=canonical_op(op),
         payload_bytes=nbytes,
+        intra_scope=intra_scope,
     )
 
 
@@ -157,15 +164,23 @@ def striped_program(
     ring family), every stripe running the hierarchical composition on
     its own ICI channel; the stripes contend for the shared DCN share,
     which the engine arbitrates. One ICI dimension degenerates to
-    ``hierarchical_program`` exactly."""
-    stripes = max(1, len(topology.ici_mesh))
+    ``hierarchical_program`` exactly.
+
+    This is the composition whose redundancy pays off under link
+    failure (the FlexLink case the degraded ranking quantifies): under
+    a ``Degradation`` the stripes are laid over the SURVIVING axes only
+    — a downed torus axis's share reroutes onto its peers at build
+    time, visible in the per-link utilization table as the dead class
+    carrying zero bytes while the survivors carry its payload."""
+    alive = list(topology.alive_ici_axes()) or [0]
+    stripes = len(alive)
     stages: List[Stage] = []
-    for s in range(stripes):
+    for s, axis in enumerate(alive):
         steps: List[WireStep] = []
         for ph in hierarchical_phases(
             op, nbytes / stripes, topology.chips_per_pod, topology.pods
         ):
-            scope = f"ici{s}" if ph["scope"] == "intra" else "dcn"
+            scope = f"ici{axis}" if ph["scope"] == "intra" else "dcn"
             steps.extend(
                 _ring_steps(
                     ph["op"], ph["nbytes"], ph["axis"], scope,
@@ -180,6 +195,7 @@ def striped_program(
         op=canonical_op(op),
         payload_bytes=nbytes,
         stripes=stripes,
+        stripe_axes=alive,
     )
     return prog
 

@@ -62,6 +62,11 @@ INSTANT_NAMES: Dict[str, str] = {
     ),
     "fault.inject": "a fault rule fired at an injection site",
     "launch.abort": "supervised launcher aborted the world (silence/death)",
+    "launch.degraded": (
+        "supervised launcher relaunching DEGRADED: world shrunk around "
+        "an indicted physical slot (persistent-straggler verdict or "
+        "degraded-classified failure)"
+    ),
     "launch.relaunch": "supervised launcher relaunching a transient-failed world",
     "log": "rank-tagged log line mirrored into the trace",
     "pool.reuse": "a row dispatched onto an already-warm pool worker",
@@ -80,6 +85,10 @@ METRIC_NAMES: Dict[str, str] = {
     "compile_ahead.prefetch_s": "seconds spent prefetch-compiling",
     "compile_ahead.prefetched": "prefetch compiles completed",
     "compile_ahead.skipped": "prefetch compiles skipped (cache hit)",
+    "fault.delay_s": (
+        "seconds of injected degraded-link delay (link_slow/chip_slow "
+        "payload-proportional sleeps, summed per process)"
+    ),
     "fault.injected": "fault rules fired",
     "hbm_high_water_bytes": "device memory high-water mark",
     "launch.world_attempts": "supervised world launch attempts started",

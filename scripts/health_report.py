@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Persistent-straggler health report: indict bad hardware, or refuse.
+
+Renders the health verdict (``ddlb_tpu.observatory.health``, ISSUE 15)
+over one or both evidence sources:
+
+- ``--history DIR``: the observatory bank's rows — every multi-process
+  row's ``straggler_rank`` / ``skew_enter_s`` / ``clock_unc_s`` columns
+  become observations, folded ACROSS runs (``--run`` restricts to one
+  run's rows);
+- ``RUN_DIR`` (positional, optional): a flight-recorder run dir — its
+  clock-aligned world timeline contributes one observation per
+  sequence-joined collective.
+
+The verdict distinguishes a transient hiccup from a persistently
+degraded component: an indictment needs >= 3 corroborating qualifying
+observations, a dominant rank (alternating stragglers classify
+transient), and every observation's skew must clear both the absolute
+noise floor and its own clock-alignment uncertainty bound. A
+persistent verdict names the rank and the candidate hardware (chip +
+ring-neighbor links).
+
+Usage:
+    python scripts/health_report.py [RUN_DIR] [--history DIR]
+        [--run RUN_ID] [--ranks N] [--json]
+
+Exit codes: 0 healthy/transient, 1 persistent indictment (the gate the
+chaos battery and CI consume), 2 usage errors / no evidence source.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddlb_tpu.observatory import health, store, timeline  # noqa: E402
+
+
+def build_report(
+    history_dir=None, run_id=None, run_dir=None, ranks=None
+):
+    """Gather observations from the given sources and fold the verdict."""
+    observations = []
+    sources = {}
+    world = ranks
+    if history_dir:
+        records = store.load_history(history_dir)
+        obs = health.observations_from_history(records, run_id=run_id)
+        observations.extend(obs)
+        sources["history"] = {
+            "dir": history_dir,
+            "run_id": run_id,
+            "observations": len(obs),
+        }
+    if run_dir:
+        doc = timeline.build_world_timeline(run_dir, expected_ranks=ranks)
+        obs = health.observations_from_timeline(doc)
+        observations.extend(obs)
+        sources["timeline"] = {
+            "dir": run_dir,
+            "alignment": doc.get("alignment"),
+            "observations": len(obs),
+        }
+        if world is None and doc.get("ranks"):
+            world = len(doc["ranks"])
+    verdict = health.verdict_from_observations(observations, world=world)
+    return {"sources": sources, "world": world, "verdict": verdict}
+
+
+def render_text(report) -> str:
+    lines = ["health report: persistent-straggler indictment", ""]
+    for name, src in report["sources"].items():
+        detail = ", ".join(
+            f"{k}={v}" for k, v in src.items() if k != "observations"
+        )
+        lines.append(
+            f"  source {name}: {src['observations']} observation(s) "
+            f"({detail})"
+        )
+    verdict = report["verdict"]
+    lines.append("")
+    lines.append(
+        f"  qualifying observations: {verdict['qualifying']} / "
+        f"{verdict['observations']} (floor {health.MIN_SKEW_S * 1e3:.0f}ms "
+        f"skew, each above its own clock-uncertainty bound)"
+    )
+    for rank, stats in sorted(verdict.get("per_rank", {}).items()):
+        lines.append(
+            f"    rank {rank}: straggled {stats['count']}x across "
+            f"{stats['runs']} run(s), {stats['caused_s']:.3f}s caused"
+        )
+    lines.append("")
+    lines.append(f"verdict: {verdict['status'].upper()} — {verdict['reason']}")
+    if verdict["status"] == health.PERSISTENT:
+        lines.append(
+            f"  indicted: rank {verdict['rank']} "
+            f"(candidate hardware: {', '.join(verdict['links'])})"
+        )
+        lines.append(
+            f"  mitigation: relaunch with the rank excluded "
+            f"(cli.launch --supervise --health-gate, or --exclude-rank "
+            f"{verdict['rank']})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "run_dir", nargs="?", default=None,
+        help="flight-recorder run dir (timeline observations)",
+    )
+    parser.add_argument(
+        "--history", default=None,
+        help="observatory history dir (banked-row observations)",
+    )
+    parser.add_argument(
+        "--run", default=None,
+        help="restrict history observations to one run_id",
+    )
+    parser.add_argument(
+        "--ranks", type=int, default=None,
+        help="world size (names the indicted rank's neighbor links)",
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json")
+    args = parser.parse_args(argv)
+
+    if not args.run_dir and not args.history:
+        parser.error("need RUN_DIR and/or --history (no evidence source)")
+
+    report = build_report(
+        history_dir=args.history,
+        run_id=args.run,
+        run_dir=args.run_dir,
+        ranks=args.ranks,
+    )
+    if args.as_json:
+        print(json.dumps(timeline.json_safe(report), indent=1, default=str))
+    else:
+        print(render_text(report))
+    return 1 if report["verdict"]["status"] == health.PERSISTENT else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

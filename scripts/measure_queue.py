@@ -42,7 +42,11 @@ from ddlb_tpu import telemetry  # noqa: E402
 # the transient-vs-deterministic split shared with the sweep runner
 # (also JAX-free): deterministic failures park IMMEDIATELY instead of
 # burning a second capture-window pass on a config that cannot succeed
-from ddlb_tpu.faults.classify import DETERMINISTIC, classify_error  # noqa: E402
+from ddlb_tpu.faults.classify import (  # noqa: E402
+    DEGRADED,
+    DETERMINISTIC,
+    classify_error,
+)
 # the live sweep stream (also JAX-free, env-gated): park decisions feed
 # the scripts/sweep_dash.py dashboard next to the pool's worker events
 from ddlb_tpu.observatory import live  # noqa: E402
@@ -697,8 +701,8 @@ def _run_row(entry, base_proto, run_fn):
 
 def _print_parked_summary(queue, state) -> None:
     """End-of-run table of parked entries with their persisted reasons
-    (last error + transient/deterministic class), so a parked row is
-    diagnosable from the run log alone."""
+    (last error + transient/degraded/deterministic class), so a parked
+    row is diagnosable from the run log alone."""
     parked = []
     for entry in queue:
         rec = state.get(entry_key(entry), {})
@@ -863,15 +867,19 @@ def main(argv=None, run_fn=None) -> int:
             }
             if not ok:
                 failed += 1
-                if cls == DETERMINISTIC and attempt < MAX_ATTEMPTS:
+                if cls in (DETERMINISTIC, DEGRADED) and attempt < MAX_ATTEMPTS:
                     # a deterministic failure (bad option, validation
-                    # mismatch) returns the same answer on every pass:
-                    # park now instead of re-burning MAX_ATTEMPTS
-                    # relay windows on it (attempts stays truthful —
-                    # the parked flag is what later passes honor)
+                    # mismatch) returns the same answer on every pass,
+                    # and a degraded one (downed/slow link, indicted
+                    # peer) hits the same bad hardware: park now
+                    # instead of re-burning MAX_ATTEMPTS relay windows
+                    # (attempts stays truthful — the parked flag is
+                    # what later passes honor; the degraded remedy is
+                    # the supervised launcher's shrunken relaunch, not
+                    # a queue retry)
                     rec["parked"] = True
                     print(
-                        f"[queue] parking immediately (deterministic "
+                        f"[queue] parking immediately ({cls} "
                         f"failure): {entry['label']} — {err[:120]}",
                         flush=True,
                     )

@@ -14,7 +14,22 @@ per-link utilization breakdown.
 Usage:
     python scripts/sim_report.py [--topology SPEC] [--payload-mib N]
                                  [--families F1,F2] [--json]
+    python scripts/sim_report.py --degrade CLASS=FACTOR[,...] [...]
     python scripts/sim_report.py --validate [--history DIR]
+
+``--degrade`` (repeatable) replays the ranking on DEGRADED twins of the
+topology (``perfmodel.topology.Degradation``): each spec is a
+comma-joined ``class=factor`` list over the link-class resources
+(``ici0``..``iciN-1``, ``dcn``), factor 0 meaning the link is down —
+``--degrade dcn=0.25 --degrade ici1=0``. Per scenario the report shows
+every algorithm's healthy vs degraded makespan, the slowdown ratio, and
+the degraded replay's per-link utilization — the table where striping's
+reroute around a dead torus axis (dead class at zero bytes, survivors
+carrying its share) and its graceful degradation under a failing DCN
+link are visible, quantifying FlexLink-style redundancy (arxiv
+2510.15882) before any hardware fails for real. Unroutable
+compositions (a flat ring through a downed link) report ``unroutable``
+and rank last.
 
 ``--topology`` defaults to ``DDLB_TPU_TOPOLOGY``
 (``envs.get_topology_override``; the benchmark CLI's ``--topology``
@@ -74,6 +89,114 @@ def _fmt_s(seconds):
     if seconds >= 1e-3:
         return f"{seconds * 1e3:.3f}ms"
     return f"{seconds * 1e6:.3f}us"
+
+
+def build_degraded_ranking(topology, payload_bytes, families, degradations):
+    """The degraded-world ranking: per scenario, per family, every
+    synthetic algorithm replayed on the healthy topology AND its
+    degraded twin (the scenario-independent healthy replays are cached
+    per family/algo). Non-finite degraded makespans (a composition
+    routed through a downed link) become ``routable: False`` with a
+    None makespan; ``--json`` additionally passes the document through
+    ``timeline.json_safe`` so the inf/NaN the infinite replay leaves in
+    the busy/utilization fields never reach a strict parser."""
+    from ddlb_tpu.simulator.engine import replay, summarize
+    from ddlb_tpu.simulator.frontends import (
+        FAMILY_COLLECTIVES,
+        SYNTHETIC_ALGOS,
+        synthetic_program,
+    )
+
+    healthy_cache = {}
+
+    def healthy_makespan(family, algo, op):
+        if (family, algo) not in healthy_cache:
+            healthy_cache[(family, algo)] = replay(
+                synthetic_program(algo, op, payload_bytes, topology),
+                topology,
+            ).makespan_s
+        return healthy_cache[(family, algo)]
+
+    scenarios = []
+    for degradation in degradations:
+        degraded_topo = topology.degraded(degradation)
+        blocks = []
+        for family in families:
+            op = FAMILY_COLLECTIVES[family]
+            rows = []
+            for algo in SYNTHETIC_ALGOS:
+                healthy_s = healthy_makespan(family, algo, op)
+                # built against the DEGRADED topology so reroute-capable
+                # compositions lay their stripes over surviving axes
+                degraded = replay(
+                    synthetic_program(
+                        algo, op, payload_bytes, degraded_topo
+                    ),
+                    degraded_topo,
+                )
+                row = summarize(degraded, degraded_topo)
+                routable = math.isfinite(degraded.makespan_s)
+                row.update(
+                    algo=algo,
+                    healthy_s=healthy_s,
+                    degraded_s=degraded.makespan_s if routable else None,
+                    routable=routable,
+                    slowdown=(
+                        degraded.makespan_s / healthy_s
+                        if routable and healthy_s > 0
+                        else None
+                    ),
+                )
+                if not routable:
+                    row["makespan_s"] = None
+                rows.append(row)
+            rows.sort(
+                key=lambda r: (
+                    not r["routable"],
+                    r["degraded_s"] if r["degraded_s"] is not None else 0.0,
+                )
+            )
+            blocks.append({"family": family, "op": op, "rows": rows})
+        scenarios.append(
+            {"degradation": degradation.name, "families": blocks}
+        )
+    return scenarios
+
+
+def print_degraded(topology, payload_bytes, scenarios):
+    for scenario in scenarios:
+        print(
+            f"== degraded ranking under [{scenario['degradation']}] on "
+            f"{topology.describe()} =="
+        )
+        print(f"   payload {payload_bytes / (1 << 20):.0f} MiB/device\n")
+        for block in scenario["families"]:
+            print(f"-- {block['family']} ({block['op']}) --")
+            print(
+                f"{'algo':<14} {'healthy':>12} {'degraded':>12} "
+                f"{'slowdown':>9}  degraded link utilization"
+            )
+            for row in block["rows"]:
+                if not row["routable"]:
+                    print(
+                        f"{row['algo']:<14} "
+                        f"{_fmt_s(row['healthy_s']):>12} "
+                        f"{'unroutable':>12} {'-':>9}  (routed through a "
+                        f"downed link)"
+                    )
+                    continue
+                links = " ".join(
+                    f"{name}={info['bytes'] / (1 << 20):.1f}MiB"
+                    for name, info in sorted(row["links"].items())
+                    if name != "flat" and info["bytes"] > 0
+                )
+                links = links or "(no surviving-link traffic)"
+                print(
+                    f"{row['algo']:<14} {_fmt_s(row['healthy_s']):>12} "
+                    f"{_fmt_s(row['degraded_s']):>12} "
+                    f"{row['slowdown']:>8.2f}x  {links}"
+                )
+            print()
 
 
 def build_ranking(topology, payload_bytes, families):
@@ -220,6 +343,12 @@ def main(argv=None) -> int:
         "--no-members", action="store_true",
         help="skip the traced per-member section (ranking only)",
     )
+    parser.add_argument(
+        "--degrade", action="append", default=None, metavar="SPEC",
+        help="degradation scenario 'class=factor[,...]' (factor 0 = link "
+        "down), repeatable — replays the ranking on the degraded twin "
+        "of the topology next to the healthy one",
+    )
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument(
         "--validate", action="store_true",
@@ -281,7 +410,51 @@ def main(argv=None) -> int:
             )
         families = tuple(wanted)
 
+    from ddlb_tpu.perfmodel.topology import parse_degradation
+
+    degradations = []
+    for spec_text in args.degrade or ():
+        try:
+            degradations.append(parse_degradation(spec_text))
+        except ValueError as exc:
+            parser.error(str(exc))
+
     payload = args.payload_mib * (1 << 20)
+    if degradations:
+        # degraded mode: the failure-scenario ranking replaces the
+        # healthy ranking + member sections (healthy numbers ride along
+        # per row as the slowdown baseline)
+        scenarios = build_degraded_ranking(
+            topology, payload, families, degradations
+        )
+        if not scenarios:
+            print("nothing to rank", file=sys.stderr)
+            return 1
+        if args.as_json:
+            from ddlb_tpu.observatory.timeline import json_safe
+
+            print(
+                json.dumps(
+                    json_safe(
+                        {
+                            "topology": {
+                                "spec": topology.name,
+                                "chip": topology.chip.name,
+                                "pods": topology.pods,
+                                "ici_mesh": list(topology.ici_mesh),
+                                "chips": topology.num_chips,
+                            },
+                            "payload_bytes": payload,
+                            "degraded": scenarios,
+                        }
+                    ),
+                    indent=2,
+                )
+            )
+            return 0
+        print_degraded(topology, payload, scenarios)
+        return 0
+
     ranking = build_ranking(topology, payload, families)
     members = [] if args.no_members else build_member_section(TRACED_MEMBERS)
     if not ranking:

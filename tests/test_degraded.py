@@ -1,0 +1,545 @@
+"""Degraded-world resilience (ISSUE 15): topology fault model,
+persistent-straggler indictment, degraded simulator, mitigation policy.
+
+CPU-only and JAX-free except where noted — the fault plan, the health
+verdict, the Degradation overlay and the degraded replay are all
+stdlib tiers. The end-to-end loop (seeded link_slow -> skew gate ->
+indictment -> degraded relaunch -> simulator bracket) is proven by
+``scripts/chaos_degrade.py`` (``make chaos-degrade``); these tests pin
+the edge cases the ISSUE names.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+
+import pytest
+
+from ddlb_tpu.faults import plan
+from ddlb_tpu.faults.classify import (
+    DEGRADED,
+    DETERMINISTIC,
+    TRANSIENT,
+    classify_error,
+)
+from ddlb_tpu.observatory import health, regress
+from ddlb_tpu.perfmodel.cost import (
+    degraded_bw,
+    degraded_ring_time_s,
+    link_slow_extra_s,
+    ring_wire_bytes,
+)
+from ddlb_tpu.perfmodel.specs import get_spec
+from ddlb_tpu.perfmodel.topology import (
+    Degradation,
+    Topology,
+    parse_degradation,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_plan(monkeypatch):
+    plan.reset()
+    yield
+    plan.reset()
+
+
+def _load(rules, seed=0):
+    return plan.load_plan(json.dumps({"seed": seed, "rules": rules}))
+
+
+# ---------------------------------------------------------------------------
+# topology fault kinds (faults.plan)
+# ---------------------------------------------------------------------------
+
+
+class TestTopoFaultRules:
+    def test_topo_kinds_need_topo_dict(self):
+        with pytest.raises(ValueError, match="topo"):
+            plan.FaultRule({"site": "x", "kind": "link_slow"})
+
+    def test_factor_must_be_fraction(self):
+        with pytest.raises(ValueError, match="factor"):
+            plan.FaultRule(
+                {"site": "x", "kind": "chip_slow",
+                 "topo": {"index": 0, "factor": 4.0}}
+            )
+
+    def test_direction_validated(self):
+        with pytest.raises(ValueError, match="direction"):
+            plan.FaultRule(
+                {"site": "x", "kind": "link_slow",
+                 "topo": {"index": 0, "direction": "up", "factor": 0.5}}
+            )
+
+    def test_affected_rank_tx_rx_and_chip(self, monkeypatch):
+        monkeypatch.setenv("DDLB_TPU_NUM_PROCESSES", "3")
+        tx = plan.FaultRule(
+            {"site": "x", "kind": "link_slow",
+             "topo": {"index": 1, "direction": "tx", "factor": 0.5}}
+        )
+        rx = plan.FaultRule(
+            {"site": "x", "kind": "link_slow",
+             "topo": {"index": 2, "direction": "rx", "factor": 0.5}}
+        )
+        chip = plan.FaultRule(
+            {"site": "x", "kind": "chip_slow",
+             "topo": {"index": 2, "factor": 0.5}}
+        )
+        assert tx.affected_rank() == 1
+        assert rx.affected_rank() == 0  # (2+1) % 3 wraps the ring
+        assert chip.affected_rank() == 2
+        assert tx.link_label() == "ici[1->2]"
+        assert chip.link_label() == "chip[2]"
+
+    def test_delay_is_the_shared_closed_form(self):
+        rule = plan.FaultRule(
+            {"site": "x", "kind": "link_slow", "sim_link_gbs": 1e-6,
+             "topo": {"index": 0, "factor": 0.25}}
+        )
+        # 1000 B at 1000 B/s healthy: 1s healthy, 4s at quarter rate
+        assert rule.delay_s(1000) == pytest.approx(
+            link_slow_extra_s(1000, 1000.0, 0.25)
+        )
+        assert rule.delay_s(1000) == pytest.approx(3.0)
+        assert rule.delay_s(0) == 0.0
+
+    def test_default_rate_is_the_chip_spec(self):
+        rule = plan.FaultRule(
+            {"site": "x", "kind": "link_slow",
+             "topo": {"index": 0, "factor": 0.5}}
+        )
+        spec = get_spec("cpu-sim")
+        assert rule.delay_s(1 << 20) == pytest.approx(
+            link_slow_extra_s(1 << 20, spec.link_bw("ici"), 0.5)
+        )
+
+    def test_inject_sleeps_only_on_the_affected_rank(self, monkeypatch):
+        # delay = 64 B * (1/0.25 - 1) / 3200 B/s = 0.06 s on rank 1 only
+        _load([
+            {"site": "runtime.collective", "kind": "link_slow",
+             "topo": {"index": 1, "direction": "tx", "factor": 0.25},
+             "sim_link_gbs": 3.2e-6, "fail_attempts": 99},
+        ])
+        monkeypatch.setenv("DDLB_TPU_PHYS_RANK", "0")
+        t0 = time.monotonic()
+        plan.inject("runtime.collective", payload_bytes=64)
+        assert time.monotonic() - t0 < 0.05
+        monkeypatch.setenv("DDLB_TPU_PHYS_RANK", "1")
+        t0 = time.monotonic()
+        plan.inject("runtime.collective", payload_bytes=64)
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_rx_neighbor_wraps_the_physical_ring(self, monkeypatch):
+        """After a degraded relaunch the process count shrinks but slot
+        ids keep full-world numbering: the rx receiver must wrap the
+        FULL physical ring (DDLB_TPU_PHYS_WORLD), else the fault would
+        re-target a surviving healthy slot."""
+        monkeypatch.setenv("DDLB_TPU_NUM_PROCESSES", "2")  # shrunk
+        monkeypatch.setenv("DDLB_TPU_PHYS_WORLD", "3")     # full ring
+        rx = plan.FaultRule(
+            {"site": "x", "kind": "link_slow",
+             "topo": {"index": 2, "direction": "rx", "factor": 0.5}}
+        )
+        assert rx.affected_rank() == 0  # (2+1) % 3, never % 2
+        assert rx.link_label() == "ici[2->0]"
+
+    def test_physical_rank_dodges_after_exclusion(self, monkeypatch):
+        """A degraded relaunch keys fault targeting on the PHYSICAL
+        slot: the surviving rank that inherited process id 1 must not
+        inherit slot 1's fault."""
+        _load([
+            {"site": "runtime.collective", "kind": "link_slow",
+             "topo": {"index": 1, "direction": "tx", "factor": 0.25},
+             "sim_link_gbs": 3.2e-6, "fail_attempts": 99},
+        ])
+        # the shrunken world's process 1 runs physical slot 2
+        monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "1")
+        monkeypatch.setenv("DDLB_TPU_PHYS_RANK", "2")
+        t0 = time.monotonic()
+        plan.inject("runtime.collective", payload_bytes=64)
+        assert time.monotonic() - t0 < 0.05
+
+    def test_link_down_raises_degraded_classified_error(self, monkeypatch):
+        monkeypatch.setenv("DDLB_TPU_NUM_PROCESSES", "2")
+        monkeypatch.setenv("DDLB_TPU_PHYS_RANK", "0")
+        _load([
+            {"site": "runtime.barrier", "kind": "link_down",
+             "topo": {"index": 0, "direction": "tx"}, "fail_attempts": 99},
+        ])
+        with pytest.raises(ConnectionError, match="link_down.*ici\\[0->1\\]"):
+            plan.inject("runtime.barrier", payload_bytes=8)
+
+    def test_new_sites_registered(self):
+        assert "overlap.ring_step" in plan.SITES
+
+
+# ---------------------------------------------------------------------------
+# three-way classification
+# ---------------------------------------------------------------------------
+
+
+class TestClassification:
+    def test_link_down_degraded_not_transient(self):
+        # ConnectionError alone is transient; the link_down shape must
+        # win (degraded patterns checked first) — relaunching the same
+        # world onto the same dead link just fails again
+        err = "ConnectionError: injected link_down at x: ici[0->1] is down"
+        assert classify_error(err) == DEGRADED
+        assert classify_error("ConnectionError: reset by peer") == TRANSIENT
+
+    def test_plan_validation_errors_stay_deterministic(self):
+        # a malformed topo rule raises a ValueError MENTIONING the kind
+        # — a config error, not degraded hardware: classifying it
+        # degraded would shrink a healthy world per relaunch attempt
+        for err in (
+            "ValueError: link_slow topo.factor must be in (0, 1], got 1.5",
+            "ValueError: topology fault kind 'link_down' needs a 'topo' "
+            "dict with at least 'index'",
+        ):
+            assert classify_error(err) == DETERMINISTIC
+
+    def test_slow_peer_degraded(self):
+        assert classify_error(
+            "SlowPeer: rank 1 silent for 30.0s while 2 peer(s) kept "
+            "beating (freshest 0.4s ago)"
+        ) == DEGRADED
+
+    def test_existing_classes_unchanged(self):
+        assert classify_error("TimeoutError: hung") == TRANSIENT
+        assert classify_error("ValueError: bad shape") == DETERMINISTIC
+        assert classify_error("", valid=True) == ""
+
+    def test_link_down_on_two_rank_world_is_fatal_not_degraded(self):
+        """ISSUE 15 edge case: the class says DEGRADED but the
+        mitigation policy refuses — excluding either endpoint of a
+        2-rank world leaves a single-rank non-world."""
+        err = "injected link_down at runtime.barrier: ici[0->1] is down"
+        assert classify_error(err) == DEGRADED
+        assert health.relaunch_policy(2) == "fatal"
+        assert health.relaunch_policy(3) == "exclude"
+        assert health.relaunch_policy(3, n_excluded=1) == "fatal"
+
+
+# ---------------------------------------------------------------------------
+# persistent-straggler indictment (observatory.health)
+# ---------------------------------------------------------------------------
+
+
+def _obs(rank=1, skew=0.4, unc=0.01, run="r0"):
+    return {"rank": rank, "skew_s": skew, "unc_s": unc, "run_id": run}
+
+
+class TestHealthVerdict:
+    def test_single_observation_refused(self):
+        v = health.verdict_from_observations([_obs()])
+        assert v["status"] == health.TRANSIENT
+        assert "never indicts" in v["reason"]
+
+    def test_two_observations_still_refused(self):
+        v = health.verdict_from_observations([_obs(), _obs(run="r1")])
+        assert v["status"] == health.TRANSIENT
+
+    def test_three_corroborating_rows_indict(self):
+        v = health.verdict_from_observations(
+            [_obs(run=f"r{i}") for i in range(3)], world=3
+        )
+        assert v["status"] == health.PERSISTENT
+        assert v["rank"] == 1
+        assert v["links"] == ["chip[1]", "ici[0->1]", "ici[1->2]"]
+        assert v["per_rank"][1]["runs"] == 3
+
+    def test_skew_within_clock_uncertainty_never_indicts(self):
+        v = health.verdict_from_observations(
+            [_obs(skew=0.3, unc=0.5) for _ in range(6)]
+        )
+        assert v["status"] == health.HEALTHY
+        assert v["qualifying"] == 0
+
+    def test_no_alignment_claim_never_indicts(self):
+        v = health.verdict_from_observations(
+            [_obs(unc=float("nan")) for _ in range(6)]
+        )
+        assert v["status"] == health.HEALTHY
+
+    def test_below_noise_floor_never_indicts(self):
+        v = health.verdict_from_observations(
+            [_obs(skew=0.01, unc=0.0) for _ in range(6)]
+        )
+        assert v["status"] == health.HEALTHY
+
+    def test_alternating_ranks_classify_transient(self):
+        obs = [_obs(rank=i % 2, run=f"r{i}") for i in range(6)]
+        v = health.verdict_from_observations(obs)
+        assert v["status"] == health.TRANSIENT
+        assert v["rank"] == -1
+        assert "alternate" in v["reason"]
+
+    def test_dominant_rank_survives_minority_noise(self):
+        obs = [_obs(rank=1, run=f"r{i}") for i in range(5)]
+        obs.append(_obs(rank=0, run="r9"))
+        v = health.verdict_from_observations(obs, world=2)
+        assert v["status"] == health.PERSISTENT
+        assert v["rank"] == 1
+
+    def test_observations_from_history_and_rows(self):
+        row = {
+            "straggler_rank": 2, "skew_enter_s": 0.2, "clock_unc_s": 0.01,
+            "implementation": "jax_spmd_0",
+        }
+        records = [
+            {"kind": "row", "run_id": "a", "row": row},
+            {"kind": "bench", "run_id": "a", "row": row},  # not a row
+            {"kind": "row", "run_id": "b", "row": {"valid": True}},  # no skew
+        ]
+        obs = health.observations_from_history(records)
+        assert len(obs) == 1 and obs[0]["rank"] == 2
+        assert health.observations_from_history(records, run_id="zzz") == []
+        assert len(health.observations_from_rows([row])) == 1
+
+    def test_observations_from_timeline_require_alignment(self):
+        coll = {
+            "seq": 5, "site": "runtime.collective", "straggler_rank": 1,
+            "skew_enter_s": 0.3, "unc_s": 0.005,
+        }
+        aligned = {"alignment": "barrier", "collectives": [coll],
+                   "run_dir": "/x"}
+        unaligned = {"alignment": "none", "collectives": [coll]}
+        assert len(health.observations_from_timeline(aligned)) == 1
+        assert health.observations_from_timeline(unaligned) == []
+
+
+class TestHealthGate:
+    def _rows(self, n=4, rank=1):
+        return [
+            {
+                "straggler_rank": rank, "skew_enter_s": 0.4,
+                "clock_unc_s": 0.01, "implementation": "jax_spmd_0",
+                "base_implementation": "jax_spmd", "primitive": "tp",
+                "option": "-", "m": 1, "n": 1, "k": 1, "chip": "cpu-sim",
+                "num_processes": 3,
+            }
+            for _ in range(n)
+        ]
+
+    def test_detect_health_fires_and_ranks_first(self):
+        rows = self._rows()
+        findings = regress.detect_all(rows, [])
+        assert findings and findings[0]["metric"] == "persistent_straggler"
+        assert findings[0]["straggler_rank"] == 1
+        assert findings[0]["source"] == "health"
+        # world derived from the rows' num_processes column: the
+        # finding names the neighbor-link candidates, not just the chip
+        assert findings[0]["links"] == [
+            "chip[1]", "ici[0->1]", "ici[1->2]"
+        ]
+
+    def test_detect_health_needs_current_corroboration(self):
+        """Old banked indictments must not re-flag clean runs forever."""
+        history = [
+            {"kind": "row", "run_id": "old", "row": row}
+            for row in self._rows()
+        ]
+        clean = [
+            {**row, "straggler_rank": -1, "skew_enter_s": 0.001}
+            for row in self._rows()
+        ]
+        assert regress.detect_health(clean, history) == []
+
+    def test_detect_health_excludes_own_banked_copies(self):
+        rows = self._rows(n=2)  # 2 current + 2 banked copies != 3 distinct
+        history = [
+            {"kind": "row", "run_id": "me", "row": row} for row in rows
+        ]
+        # with the self-copies excluded only 2 observations remain
+        assert regress.detect_health(rows, history, exclude_run="me") == []
+
+
+# ---------------------------------------------------------------------------
+# Degradation overlay + degraded replay
+# ---------------------------------------------------------------------------
+
+
+class TestDegradation:
+    def test_parse(self):
+        deg = parse_degradation("dcn=0.25,ici1=0")
+        assert deg.factors == {"dcn": 0.25}
+        assert deg.down == ("ici1",)
+        assert deg.factor("dcn") == 0.25
+        assert deg.factor("ici1") == 0.0
+        assert deg.factor("ici0") == 1.0
+
+    def test_parse_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_degradation("")
+        with pytest.raises(ValueError):
+            parse_degradation("dcn")
+        with pytest.raises(ValueError):
+            parse_degradation("dcn=fast")
+
+    def test_factor_range_validated(self):
+        with pytest.raises(ValueError, match="down"):
+            Degradation(factors={"dcn": 0.0})
+        with pytest.raises(ValueError):
+            Degradation(factors={"dcn": 2.0})
+
+    def test_resource_rates_scale(self):
+        topo = Topology(
+            chip=get_spec("v5p"), pods=2, ici_mesh=(4, 4)
+        ).degraded(parse_degradation("dcn=0.5,ici1=0"))
+        healthy = Topology(chip=get_spec("v5p"), pods=2, ici_mesh=(4, 4))
+        assert topo.resource_rate("dcn") == pytest.approx(
+            degraded_bw(healthy.resource_rate("dcn"), 0.5)
+        )
+        assert topo.resource_rate("ici1") == 0.0
+        assert topo.resource_rate("ici0") == healthy.resource_rate("ici0")
+        assert topo.alive_ici_axes() == (0,)
+        # the world-spanning flat snake crosses the dead axis: rate 0
+        assert topo.flat_bw == 0.0
+        assert "!" in topo.name and topo.degradation is not None
+
+    def test_degraded_replay_matches_closed_form(self):
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import flat_ring_program
+
+        topo = Topology(chip=get_spec("v5e"), pods=1, ici_mesh=(8,))
+        deg = topo.degraded(Degradation(factors={"ici0": 0.25}))
+        payload = float(1 << 20)
+        got = replay(
+            flat_ring_program("psum", payload, deg), deg
+        ).makespan_s
+        want = degraded_ring_time_s(
+            "psum", payload, 8, topo.ici_bw, 0.25
+        )
+        assert got == pytest.approx(want, rel=1e-12)
+        # and the degraded-minus-healthy delta is the per-crossing
+        # extra the fault realization sleeps, summed over ring steps
+        healthy = replay(
+            flat_ring_program("psum", payload, topo), topo
+        ).makespan_s
+        assert got - healthy == pytest.approx(
+            link_slow_extra_s(
+                ring_wire_bytes("psum", payload, 8), topo.ici_bw, 0.25
+            ),
+            rel=1e-9,
+        )
+
+    def test_striped_reroutes_around_downed_axis(self):
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import striped_program
+
+        topo = Topology(chip=get_spec("v5p"), pods=2, ici_mesh=(8, 8))
+        deg = topo.degraded(Degradation(down=("ici1",)))
+        payload = float(1 << 24)
+        result = replay(striped_program("psum", payload, deg), deg)
+        assert math.isfinite(result.makespan_s)
+        links = result.link_utilization(deg)
+        assert links["ici1"]["bytes"] == 0.0  # the reroute, visible
+        assert links["ici0"]["bytes"] > 0.0
+        assert result.meta["stripe_axes"] == [0]
+        # the healthy twin spreads the same payload across both axes
+        healthy = replay(striped_program("psum", payload, topo), topo)
+        assert healthy.meta["stripe_axes"] == [0, 1]
+        assert links["ici0"]["bytes"] == pytest.approx(
+            healthy.link_utilization(topo)["ici0"]["bytes"] * 2, rel=1e-9
+        )
+
+    def test_hierarchical_reroutes_intra_axis(self):
+        from ddlb_tpu.simulator.frontends import hierarchical_program
+
+        topo = Topology(
+            chip=get_spec("v5p"), pods=2, ici_mesh=(8, 8)
+        ).degraded(Degradation(down=("ici0",)))
+        prog = hierarchical_program("psum", float(1 << 20), topo)
+        assert prog.meta["intra_scope"] == "ici1"
+
+    def test_flat_unroutable_replays_infinite(self):
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import flat_ring_program
+
+        topo = Topology(
+            chip=get_spec("v5p"), pods=2, ici_mesh=(8,)
+        ).degraded(Degradation(down=("dcn",)))
+        result = replay(
+            flat_ring_program("psum", float(1 << 20), topo), topo
+        )
+        assert math.isinf(result.makespan_s)
+
+
+# ---------------------------------------------------------------------------
+# sim_report --degrade CLI
+# ---------------------------------------------------------------------------
+
+
+class TestSimReportDegrade:
+    def test_json_shape_and_graceful_striped(self, capsys):
+        from scripts.sim_report import main
+
+        rc = main([
+            "--topology", "v5p:4x8x8", "--families", "dp_allreduce",
+            "--payload-mib", "16", "--degrade", "ici1=0", "--json",
+        ])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out)
+        rows = doc["degraded"][0]["families"][0]["rows"]
+        by_algo = {r["algo"]: r for r in rows}
+        assert not by_algo["flat"]["routable"]
+        assert by_algo["striped"]["routable"]
+        assert by_algo["striped"]["links"]["ici1"]["bytes"] == 0.0
+        # ranked: routable compositions first
+        assert rows[-1]["algo"] == "flat"
+
+    def test_bad_spec_exits_2(self):
+        from scripts.sim_report import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["--degrade", "nonsense"])
+        assert exc.value.code == 2
+
+
+# ---------------------------------------------------------------------------
+# env accessors + row schema
+# ---------------------------------------------------------------------------
+
+
+class TestEnvAndSchema:
+    def test_physical_rank_falls_back_to_process_id(self, monkeypatch):
+        from ddlb_tpu import envs
+
+        monkeypatch.delenv("DDLB_TPU_PHYS_RANK", raising=False)
+        monkeypatch.setenv("DDLB_TPU_PROCESS_ID", "2")
+        assert envs.get_physical_rank() == 2
+        monkeypatch.setenv("DDLB_TPU_PHYS_RANK", "5")
+        assert envs.get_physical_rank() == 5
+
+    def test_world_degraded_flag(self, monkeypatch):
+        from ddlb_tpu import envs
+
+        monkeypatch.delenv("DDLB_TPU_WORLD_DEGRADED", raising=False)
+        assert envs.get_world_degraded() is False
+        monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "1")
+        assert envs.get_world_degraded() is True
+
+    def test_row_carries_world_degraded(self, monkeypatch):
+        import numpy as np
+
+        from ddlb_tpu.benchmark import make_result_row
+        from ddlb_tpu.schema import ROW_COLUMNS
+
+        assert "world_degraded" in ROW_COLUMNS
+        monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "1")
+        row = make_result_row(
+            config={"impl_id": "x", "primitive": "tp_columnwise",
+                    "m": 1, "n": 1, "k": 1},
+            times_ms=np.array([1.0]),
+            flop_count=1.0,
+            option_repr="-",
+            valid=True,
+            error="",
+            world_size=1,
+            num_processes=1,
+            platform="cpu",
+        )
+        assert row["world_degraded"] is True

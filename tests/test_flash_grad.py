@@ -154,6 +154,10 @@ def test_flash_bf16_forward_close():
     assert float(jnp.max(jnp.abs(o.astype(jnp.float32) - o_ref))) < 2e-2
 
 
+@pytest.mark.slow  # full flagship forward under BOTH attention kernels
+# (the flash one INTERPRETED on the CPU sim): ~60 s of compile for one
+# equivalence check — unlocked by the transformer shard_map_compat
+# migration but outside the tier-1 870 s budget
 def test_model_flash_vs_einsum_losses_match():
     """The flagship model computes the same loss (and the same gradient
     step) with flash kernels as with the einsum formulation — both

@@ -71,6 +71,11 @@ def test_graft_entry_single_chip():
     assert np.isfinite(float(out))
 
 
+@pytest.mark.slow  # full MoE train-step compile (flash kernels run
+# INTERPRETED on the CPU sim) x two model variants on an 8-device mesh:
+# several minutes of XLA CPU compile — unlocked by the transformer
+# shard_map_compat migration (ISSUE 15), but far too heavy for the
+# tier-1 870 s budget
 def test_graft_dryrun_multichip():
     import importlib.util
 

@@ -182,9 +182,15 @@ class TestModel1F1B:
         from ddlb_tpu.runtime import Runtime
 
         mesh = Runtime().mesh(("dp", "tp", "pp"), shape=(2, 2, 2))
+        # einsum attention: this class validates the SCHEDULE math
+        # (1F1B manual-vjp vs autodiff GPipe); the default flash kernel
+        # runs INTERPRETED on the CPU sim and would multiply the
+        # value_and_grad compile severalfold for coverage that
+        # test_flash_grad's flash-vs-einsum model test already owns
+        # (the tier-1 870 s budget note in ROADMAP)
         cfg = TransformerConfig(
             vocab=64, d_model=32, n_heads=4, d_ff=64,
-            layers_per_stage=1, microbatches=mb,
+            layers_per_stage=1, microbatches=mb, attn_kernel="einsum",
         )
         params = init_params(cfg, pp=2, n_experts=2)
         tokens, targets = example_tokens(batch=8, seq=16, vocab=cfg.vocab)
@@ -194,6 +200,11 @@ class TestModel1F1B:
         targets = jax.device_put(targets, sh["data"])
         return mesh, cfg, loss_fn, params, tokens, targets
 
+    @pytest.mark.slow  # two full-model autodiff compiles (value_and_grad
+    # through the 8-device shard_mapped flagship, plus the manual-vjp
+    # 1F1B build) — minutes of XLA CPU compile; unlocked by the
+    # transformer shard_map_compat migration but outside the tier-1
+    # 870 s budget (the train-step smoke below keeps tier-1 coverage)
     def test_1f1b_loss_and_grads_match_autodiff_gpipe(self):
         import jax
 
@@ -269,6 +280,8 @@ class TestModelInterleaved:
     is global stage c*pp + p; the tick body dynamically indexes the
     chunk's param slice and grads accumulate per chunk."""
 
+    @pytest.mark.slow  # same budget reasoning as the 1F1B grads-match
+    # test: two full-model pipeline compiles for one equivalence check
     def test_matches_gpipe_on_same_model(self):
         """The same 4-layer model partitioned two ways — GPipe pp=2
         stages of 2 layers vs interleaved v=2 chunks of 1 layer on the
@@ -288,13 +301,15 @@ class TestModelInterleaved:
         from ddlb_tpu.runtime import Runtime
 
         mesh = Runtime().mesh(("dp", "tp", "pp"), shape=(2, 2, 2))
+        # einsum attention for the same budget reason as TestModel1F1B:
+        # the partitioning equivalence under test is kernel-agnostic
         cfg_g = TransformerConfig(
             vocab=64, d_model=32, n_heads=4, d_ff=64,
-            layers_per_stage=2, microbatches=4,
+            layers_per_stage=2, microbatches=4, attn_kernel="einsum",
         )
         cfg_i = TransformerConfig(
             vocab=64, d_model=32, n_heads=4, d_ff=64,
-            layers_per_stage=1, microbatches=4,
+            layers_per_stage=1, microbatches=4, attn_kernel="einsum",
         )
         params4 = init_params(cfg_i, pp=4, n_experts=2)
         tokens, targets = example_tokens(8, 16, 64)
