@@ -59,6 +59,7 @@ ci:
 	$(PYTHON) scripts/sim_demo.py
 	$(PYTHON) scripts/skew_demo.py
 	$(MAKE) sim-report-degrade
+	$(MAKE) sim-report-compare
 	$(MAKE) chaos-degrade
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
@@ -122,6 +123,14 @@ sim-report-degrade:
 	$(PYTHON) scripts/sim_report.py --topology 4pod1024 \
 		--families dp_allreduce,collectives \
 		--degrade dcn=0.25 --degrade ici1=0
+
+# member-twin gate: the REAL topology-adaptive members (jax_spmd_hier /
+# jax_spmd_striped) traced at the 4-pod world's own axis sizes and
+# replayed next to the synthetic flat/hier/striped builders — makespans
+# within tolerance, rankings agreeing (docs/source/performance.rst
+# "Topology-adaptive collectives")
+sim-report-compare:
+	$(PYTHON) scripts/sim_report.py --compare-members
 
 clean:
 	rm -f ddlb_tpu/native/_host_runtime.so

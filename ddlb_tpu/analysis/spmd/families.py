@@ -195,6 +195,27 @@ MEMBER_CONFIGS: Dict[Tuple[str, str], List[Dict[str, Any]]] = {
         {"quantize": "static"},
         {"quantize": "dynamic"},
     ],
+    # the topology-adaptive members (ISSUE 16): every decomposition the
+    # member can resolve to, pinned — ``auto`` consults live-world
+    # signals (fault plan, health bank) the static tier must not read
+    ("collectives", "jax_spmd_hier"): [
+        {"op": "all_gather", "composition": "hierarchical"},
+        {"op": "all_reduce", "composition": "hierarchical"},
+        {"op": "reduce_scatter", "composition": "hierarchical"},
+        {"op": "all_to_all", "composition": "hierarchical"},
+        {"op": "all_reduce", "composition": "flat"},
+    ],
+    ("collectives", "jax_spmd_striped"): [{}],
+    ("dp_allreduce", "jax_spmd_hier"): [
+        {"composition": "hierarchical"},
+        {"composition": "flat"},
+    ],
+    ("dp_allreduce", "jax_spmd_striped"): [{}],
+    ("ep_alltoall", "jax_spmd_hier"): [
+        {"composition": "hierarchical"},
+        {"composition": "flat"},
+    ],
+    ("ep_alltoall", "jax_spmd_striped"): [{}],
 }
 
 
@@ -440,6 +461,22 @@ class ModuleResolver:
             return None
         tree, env = self.registry.module(module)
         if tree is None:
+            # ``pkg.module.ClassName.method`` — the explicit
+            # parent-class call idiom (``JaxSPMDCollectives.
+            # _input_setup(self)``, the composed members' flat
+            # delegation): resolve the class statically and return the
+            # unbound method; the call site passes self positionally
+            mod2, _, cls_name = module.rpartition(".")
+            if mod2:
+                klass = self.registry.resolve(mod2, cls_name)
+                if klass is not None:
+                    found = klass.find_method(symbol)
+                    if found is not None:
+                        owner, fdef = found
+                        return FuncVal(
+                            fdef.name, fdef, owner.env, path=owner.rel,
+                            owner=owner,
+                        )
             return None
         bound = env.get(symbol)
         if bound is _MISSING:
@@ -516,12 +553,35 @@ def _registry_table() -> Dict[str, Dict[str, Tuple[str, str]]]:
     return _REGISTRY
 
 
-def _axis_sizes_for(family: str, d: int) -> Dict[str, int]:
+def _axis_sizes_for(
+    family: str, d: int, explicit: Optional[Dict[str, int]] = None
+) -> Dict[str, int]:
+    """Canonical hybrid/torus axis sizes for a ``d``-device trace.
+
+    ``explicit`` (extra ``dcn``/``ici``/``sx``/``sy`` keys riding on a
+    shapes dict) pins the split instead of the near-square default —
+    the simulator's twin check traces members at the axis sizes of the
+    topology it replays them on (``pods``/``ici_mesh``), not at the
+    canonical census split."""
     sizes = {"tp": d, "_barrier": d}
-    # the hierarchical collectives member builds a 2-D (dcn, ici) mesh
-    half = max(1, int(round(d ** 0.5)))
-    sizes["ici"] = half
-    sizes["dcn"] = max(1, d // half)
+    explicit = explicit or {}
+    # the hierarchical members build a 2-D (dcn, ici) mesh
+    ici = explicit.get("ici")
+    dcn = explicit.get("dcn")
+    if ici is None and dcn:
+        ici = max(1, d // int(dcn))
+    if ici is None:
+        ici = max(1, int(round(d ** 0.5)))
+    sizes["ici"] = int(ici)
+    sizes["dcn"] = int(dcn) if dcn else max(1, d // sizes["ici"])
+    # the striped members additionally split the slice into its torus
+    # factorization (runtime.torus_mesh / cost.torus_factors)
+    from ddlb_tpu.perfmodel.cost import torus_factors
+
+    sx, sy = explicit.get("sx"), explicit.get("sy")
+    if sx is None or sy is None:
+        sx, sy = torus_factors(sizes["ici"])
+    sizes["sx"], sizes["sy"] = int(sx), int(sy)
     return sizes
 
 
@@ -587,12 +647,53 @@ def _self_summaries(shapes: Dict[str, int]) -> Dict[str, Any]:
             Arr((stages, k, n) if stages is not None else None, "float32"),
         )
 
+    # the ComposedMember (primitives/topo_compose.py) topology helpers,
+    # summarized from the SAME canonical axis sizes the trace resolves
+    # under: the live policy reads env state (fault plan, health bank,
+    # degraded stamp) the static tier must not consult, so the summary
+    # is the healthy-world restriction of select_composition — pinned
+    # compositions pass through, ``auto`` follows the topology alone
+
+    def _resolved_composition(selfval, args, kwargs, node, interp):
+        options = selfval.attrs.get("options")
+        requested = "auto"
+        if isinstance(options, dict):
+            requested = options.get("composition", "auto")
+        if requested != "auto":
+            return requested
+        return (
+            "hierarchical"
+            if interp.axis_sizes.get("dcn", 1) > 1
+            else "flat"
+        )
+
+    def _two_level(selfval, args, kwargs, node, interp):
+        d = selfval.attrs.get("num_partitions")
+        inter = interp.axis_sizes.get("dcn", 1)
+        if not isinstance(d, int) or inter > d or d % inter:
+            return (d, 1)
+        return (d // inter, inter)
+
+    def _torus(selfval, args, kwargs, node, interp):
+        return (
+            interp.axis_sizes.get("sx", 1),
+            interp.axis_sizes.get("sy", 1),
+        )
+
+    def _stripe_count(selfval, args, kwargs, node, interp):
+        sizes = _torus(selfval, args, kwargs, node, interp)
+        return max(1, sum(1 for a in sizes if a > 1))
+
     return {
         "_host_operands": _host_operands,
         "_host_qkv": _host_qkv,
         "_device_put": _device_put,
         "_host_chain_operands": _host_chain_operands,
         "_host_tokens_experts": _host_tokens_experts,
+        "_resolved_composition": _resolved_composition,
+        "_two_level": _two_level,
+        "_torus": _torus,
+        "_stripe_count": _stripe_count,
     }
 
 
@@ -637,12 +738,26 @@ def _runtime_ns(shapes: Dict[str, int], axis_sizes: Dict[str, int]) -> HostNS:
             {"dcn": axis_sizes["dcn"], "ici": axis_sizes["ici"]},
         )
 
+    def _torus_mesh(args, kwargs, node, interp):
+        return MeshVal(
+            ("dcn", "sx", "sy"),
+            {
+                "dcn": axis_sizes["dcn"],
+                "sx": axis_sizes.get("sx", 1),
+                "sy": axis_sizes.get("sy", 1),
+            },
+        )
+
     return HostNS(
         {
             "mesh": _mesh,
             "transport_mesh": _mesh,
             "hybrid_mesh": _hybrid_mesh,
-            "num_slices": 1,
+            "torus_mesh": _torus_mesh,
+            # the static world has as many slices as the dcn axis the
+            # hybrid/torus members factor over — one number, both sides
+            # (formula and trace) of the DDLB123 comparison
+            "num_slices": axis_sizes.get("dcn", 1),
             "num_devices": d,
             "local_devices": (interp_mod.UNKNOWN,),
             "process_id": 0,
@@ -655,15 +770,27 @@ def _runtime_ns(shapes: Dict[str, int], axis_sizes: Dict[str, int]) -> HostNS:
 def _static_options(
     klass: StaticClass, interp: Interpreter, overrides: Dict[str, Any]
 ) -> Dict[str, Any]:
-    """``option_schema`` semantics statically: the mro-first
-    ``BASE_OPTIONS`` under the mro-first ``DEFAULT_OPTIONS``."""
+    """``option_schema`` semantics statically: ``BASE_OPTIONS`` under
+    ``DEFAULT_OPTIONS``, each merged base-first across the mro — the
+    subclass idiom ``{**Parent.DEFAULT_OPTIONS, ...}`` spreads a
+    cross-module attribute the static evaluator cannot expand, so the
+    reverse-mro walk recovers those inherited defaults from the
+    parents' own literals (a subclass that deliberately DROPS a parent
+    key is approximated as keeping it; options are additive here)."""
     merged: Dict[str, Any] = {}
     for name in ("BASE_OPTIONS", "DEFAULT_OPTIONS"):
-        table = klass.class_attr(name, interp)
-        if isinstance(table, dict):
-            merged.update(
-                {k: v for k, v in table.items() if isinstance(k, str)}
-            )
+        for cls in reversed(klass.mro()):
+            value = cls._class_assign_in(cls, name)
+            if value is None:
+                continue
+            try:
+                table = interp.eval(value, cls.env)
+            except Exception:
+                continue
+            if isinstance(table, dict):
+                merged.update(
+                    {k: v for k, v in table.items() if isinstance(k, str)}
+                )
     merged.update(overrides)
     return merged
 
@@ -716,7 +843,7 @@ def trace_member(
         report.reason = f"class {class_name} did not resolve statically"
         return report
 
-    axis_sizes = _axis_sizes_for(family, shapes["d"])
+    axis_sizes = _axis_sizes_for(family, shapes["d"], shapes)
     tracer = Tracer(report.rel, mode="family")
     # the kernel model rides along so pallas members trace their
     # in-kernel DMA rings instead of stopping opaque at pallas_call
@@ -930,7 +1057,7 @@ def member_schedule(
     report = trace_member(
         family, member, dict(overrides or {}), registry, shapes=shapes
     )
-    axis_sizes = _axis_sizes_for(family, shapes["d"])
+    axis_sizes = _axis_sizes_for(family, shapes["d"], shapes)
     entries: List[Dict[str, Any]] = []
     for t in report.traces:
         entries.extend(t.export_entries(axis_sizes))
@@ -949,6 +1076,17 @@ def member_schedule(
         "wire_formula": report.wire_formula,
         "schedule": report.cost_schedule,
         "chunks": report.chunk_count,
+        # striped members: concurrent ring families per slice (the
+        # count of non-degenerate torus axes) — the simulator front-end
+        # splits the ici stream across them
+        "stripes": max(
+            1,
+            sum(
+                1
+                for a in ("sx", "sy")
+                if axis_sizes.get(a, 1) > 1
+            ),
+        ),
     }
 
 

@@ -255,6 +255,73 @@ def hierarchical_wire_bytes(
     return out
 
 
+def torus_factors(n: int) -> Tuple[int, int]:
+    """The squarest 2D factorization ``(sx, sy)`` of an ``n``-chip slice:
+    ``sx`` is the largest divisor of ``n`` at most ``sqrt(n)``, ``sy =
+    n // sx``. The one place the intra-slice torus shape is derived
+    from a flat device count, shared by the striped formulas, the
+    runtime's ``torus_mesh`` and the analysis tier's canonical axis
+    sizes — so the static census and the traced mesh always agree on
+    which axes the stripes ride."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"slice size must be >= 1, got {n}")
+    sx = 1
+    f = 1
+    while f * f <= n:
+        if n % f == 0:
+            sx = f
+        f += 1
+    return sx, n // sx
+
+
+def striped_wire_bytes(
+    op: str, nbytes: float, inter: int, ici_axes: Tuple[int, ...]
+) -> Dict[str, float]:
+    """Per-device wire bytes of the FlexLink-style striped composition
+    (arxiv 2510.15882): the payload splits into one stripe per
+    non-degenerate ICI torus axis, each stripe running the two-level
+    hierarchical composition concurrently over a DISTINCT axis family.
+
+    Striping re-partitions the payload across link families without
+    changing the per-class totals for the reduction/gather shapes —
+    every stripe's intra phases still touch every chip of the slice —
+    so ``ici``/``dcn`` delegate to ``hierarchical_wire_bytes`` over the
+    full slice (``intra = prod(ici_axes)``). ``all_to_all`` is the
+    exception: the intra redistribution runs per torus axis (size
+    ``a``) instead of over the flat slice, paying ``sum((a-1)/a)``
+    instead of ``(intra-1)/intra`` — strictly more wire, spread over
+    more link families.
+
+    Returns ``{"ici", "dcn", "stripes", "ici_per_stripe"}``: the class
+    totals plus the concurrency facts the ranking needs — ``stripes``
+    concurrent ring families, each carrying ``ici_per_stripe`` bytes,
+    which is what makes the composition survive a degraded or downed
+    axis (the stripe share, not the whole payload, rides the slow
+    links).
+    """
+    op = canonical_op(op)
+    axes = [int(a) for a in ici_axes if int(a) > 1]
+    if len(axes) == 0:
+        axes = [1]
+    intra = 1
+    for a in axes:
+        intra *= a
+    if op == "all_to_all":
+        ici = sum([nbytes * (a - 1) / a for a in axes])
+        dcn = ring_wire_bytes("all_to_all", nbytes, inter)
+    else:
+        cls = hierarchical_wire_bytes(op, nbytes, intra, inter)
+        ici, dcn = cls["ici"], cls["dcn"]
+    stripes = max(1, len(axes))
+    return {
+        "ici": ici,
+        "dcn": dcn,
+        "stripes": float(stripes),
+        "ici_per_stripe": ici / stripes,
+    }
+
+
 @dataclass(frozen=True)
 class CostEstimate:
     """The model's verdict for one configured implementation."""

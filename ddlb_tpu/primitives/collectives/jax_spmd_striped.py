@@ -1,0 +1,22 @@
+"""Pure-collectives member with the striped composition pinned.
+
+The FlexLink-style multi-path member (arxiv 2510.15882) as its own
+sweep identity: same implementation as ``jax_spmd_hier`` (which owns
+all compositions), with ``composition='striped'`` as the default so
+sweeps rank the per-torus-axis concurrent rings alongside flat and
+hierarchical. Stripes ``all_reduce`` (see the hier module docstring).
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.collectives.jax_spmd_hier import (
+    JaxSPMDHierCollectives,
+)
+
+
+class JaxSPMDStripedCollectives(JaxSPMDHierCollectives):
+    DEFAULT_OPTIONS = {
+        **JaxSPMDHierCollectives.DEFAULT_OPTIONS,
+        "op": "all_reduce",
+        "composition": "striped",
+    }

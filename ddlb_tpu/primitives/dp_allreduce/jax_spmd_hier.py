@@ -1,0 +1,154 @@
+"""Topology-adaptive DP gradient all-reduce: hierarchical & striped.
+
+The family's GEMM+AR with the all-reduce decomposed per the live
+topology instead of one flat ring (the simulator's multi-pod winner
+made real — ISSUE 16):
+
+- ``hierarchical``: RS over ICI, AR of the 1/ici shard over DCN, AG
+  over ICI on the 2-D ``(dcn, ici)`` hybrid mesh (HiCCL, arxiv
+  2408.05962) — the narrow cross-slice links carry ``1/intra`` of the
+  gradient;
+- ``striped``: the gradient's rows split into one stripe per
+  intra-slice torus axis on the 3-D ``(dcn, sx, sy)`` mesh, each
+  stripe's scatter/gather sandwich leading with a DISTINCT axis
+  (FlexLink, arxiv 2510.15882) — concurrent rings over independent
+  link families, which is also what survives a degraded or indicted
+  axis;
+- ``flat``: the parent's single ring; ``auto``: resolved by
+  ``primitives.topo_compose.select_composition`` and stamped on the
+  row via the ``composition`` column.
+
+``wire_bytes()`` prices the resolved composition with
+``cost.hierarchical_wire_bytes`` / ``cost.striped_wire_bytes`` over the
+full ``[m, n]`` gradient — DDLB123 verifies the traced bytes against it
+at zero drift.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.perfmodel.cost import wire_itemsize
+from ddlb_tpu.primitives.dp_allreduce.jax_spmd import JaxSPMDDPAllReduce
+from ddlb_tpu.primitives.topo_compose import COMPOSITIONS, ComposedMember
+from ddlb_tpu.runtime import shard_map_compat
+
+
+class JaxSPMDHierDPAllReduce(ComposedMember, JaxSPMDDPAllReduce):
+    DEFAULT_OPTIONS = {
+        **JaxSPMDDPAllReduce.DEFAULT_OPTIONS,
+        "composition": "hierarchical",
+    }
+    ALLOWED_VALUES = {
+        **JaxSPMDDPAllReduce.ALLOWED_VALUES,
+        "composition": list(COMPOSITIONS) + ["auto"],
+    }
+
+    def _collective_payloads(self):
+        # every replica all-reduces the full [m, n] partial gradient
+        return [
+            ("all_reduce", float(self.m * self.n * wire_itemsize(self.dtype)))
+        ]
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        comp = self._resolved_composition()
+        if comp == "flat":
+            return
+        if "transport" in self._options_manager.overridden:
+            raise ValueError(
+                "hierarchical/striped compositions build their own "
+                "hybrid/torus meshes; the transport axis does not apply"
+            )
+        intra, _inter = self._two_level()
+        rows = intra
+        if comp == "striped":
+            rows = self._stripe_count() * intra
+        if self.m % rows:
+            raise ValueError(
+                f"m={self.m} must divide into the composition's scatter "
+                f"pieces ({rows}) for composition={comp!r}"
+            )
+
+    def _input_setup(self) -> None:
+        comp = self._resolved_composition()
+        if comp == "flat":
+            JaxSPMDDPAllReduce._input_setup(self)
+            return
+        if comp == "striped":
+            self._setup_striped()
+            return
+        self._setup_hierarchical()
+
+    def _setup_hierarchical(self) -> None:
+        self.mesh = self.runtime.hybrid_mesh(("dcn", "ici"))
+        a_host, b_host = self._host_operands()
+        self.a = self._device_put(a_host, P(None, ("dcn", "ici")))
+        self.b = self._device_put(b_host, P(("dcn", "ici"), None))
+
+        def step(a_shard, b_shard):
+            partial = a_shard @ b_shard  # [m, n] partial gradient
+            part = jax.lax.psum_scatter(
+                partial, "ici", scatter_dimension=0, tiled=True
+            )
+            part = jax.lax.psum(part, "dcn")
+            return jax.lax.all_gather(part, "ici", axis=0, tiled=True)
+
+        self._fn = jax.jit(
+            shard_map_compat(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )
+
+    def _setup_striped(self) -> None:
+        self.mesh = self.runtime.torus_mesh(("dcn", "sx", "sy"))
+        a_host, b_host = self._host_operands()
+        spec = ("dcn", "sx", "sy")
+        self.a = self._device_put(a_host, P(None, spec))
+        self.b = self._device_put(b_host, P(spec, None))
+        sx, sy = self._torus()
+        _intra, inter = self._two_level()
+        axes = []
+        if sx > 1:
+            axes.append("sx")
+        if sy > 1:
+            axes.append("sy")
+        if len(axes) == 0:
+            axes = ["sx"]
+        stripes = len(axes)
+        piece = self.m // stripes
+
+        def step(a_shard, b_shard):
+            partial = a_shard @ b_shard  # [m, n] partial gradient
+            outs = []
+            for w in range(stripes):
+                x = partial[w * piece:(w + 1) * piece]
+                order = axes[w:] + axes[:w]
+                for ax in order:
+                    x = jax.lax.psum_scatter(
+                        x, ax, scatter_dimension=0, tiled=True
+                    )
+                if inter > 1:
+                    x = jax.lax.psum(x, "dcn")
+                for ax in reversed(order):
+                    x = jax.lax.all_gather(x, ax, axis=0, tiled=True)
+                outs.append(x)
+            if stripes == 1:
+                return outs[0]
+            return jnp.concatenate(outs, axis=0)
+
+        self._fn = jax.jit(
+            shard_map_compat(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(None, spec), P(spec, None)),
+                out_specs=P(None, None),
+                check_vma=False,
+            )
+        )

@@ -1,0 +1,21 @@
+"""DP gradient all-reduce with the striped composition pinned.
+
+The FlexLink-style multi-path member (arxiv 2510.15882) as its own
+sweep identity: same implementation as ``jax_spmd_hier`` (which owns
+all compositions), with ``composition='striped'`` as the default so
+autotune/perfmodel rank the striped rings alongside flat and
+hierarchical — the composition axis swept the way ``chunk_count`` is.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.dp_allreduce.jax_spmd_hier import (
+    JaxSPMDHierDPAllReduce,
+)
+
+
+class JaxSPMDStripedDPAllReduce(JaxSPMDHierDPAllReduce):
+    DEFAULT_OPTIONS = {
+        **JaxSPMDHierDPAllReduce.DEFAULT_OPTIONS,
+        "composition": "striped",
+    }

@@ -1,0 +1,209 @@
+"""Topology-adaptive MoE dispatch/combine: hierarchical & striped A2A.
+
+The family's all-to-all exchanges decomposed per the live topology
+(ISSUE 16) instead of one flat exchange:
+
+- ``hierarchical``: each A2A becomes A2A-dcn then A2A-ici on the 2-D
+  ``(dcn, ici)`` hybrid mesh — route every token group to its
+  destination SLICE first, then to the destination chip, with a
+  transpose between to bring the next level's index leading and one
+  after to restore source-rank order (the same routing identity the
+  collectives family's hier member states);
+- ``striped``: the exchange deepens to three levels — dcn, then each
+  intra-slice torus axis separately on the ``(dcn, sx, sy)`` mesh — so
+  the redistribution rides BOTH torus axes' link families; the token
+  groups additionally split into one stripe per alive axis, each
+  stripe running its dispatch -> expert GEMM -> combine end to end
+  (the GEMM is per-token, so stripes are independent), which is what
+  lets the stripes' rings overlap in flight (FlexLink, arxiv
+  2510.15882). Per-axis A2A pays ``sum((a-1)/a)`` of the payload —
+  ``cost.striped_wire_bytes``'s all_to_all exception;
+- ``flat``: the parent's single exchanges; ``auto``: resolved by
+  ``primitives.topo_compose.select_composition``, stamped on the row
+  via the ``composition`` column.
+
+``wire_bytes()`` prices dispatch (``[m/d, k]``) and combine
+(``[m/d, n]``) payloads through the composition's closed form;
+DDLB123's traced census must agree at zero drift.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ddlb_tpu.perfmodel.cost import wire_itemsize
+from ddlb_tpu.primitives.base import acc_dtype
+from ddlb_tpu.primitives.ep_alltoall.jax_spmd import JaxSPMDEPAllToAll
+from ddlb_tpu.primitives.topo_compose import COMPOSITIONS, ComposedMember
+from ddlb_tpu.runtime import shard_map_compat
+
+
+class JaxSPMDHierEPAllToAll(ComposedMember, JaxSPMDEPAllToAll):
+    DEFAULT_OPTIONS = {
+        **JaxSPMDEPAllToAll.DEFAULT_OPTIONS,
+        "composition": "hierarchical",
+    }
+    ALLOWED_VALUES = {
+        **JaxSPMDEPAllToAll.ALLOWED_VALUES,
+        "composition": list(COMPOSITIONS) + ["auto"],
+    }
+
+    def _collective_payloads(self):
+        d = self.num_partitions
+        isz = wire_itemsize(self.dtype)
+        shard = self.m // d
+        return [
+            ("all_to_all", float(shard * self.k * isz)),  # dispatch
+            ("all_to_all", float(shard * self.n * isz)),  # combine
+        ]
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        comp = self._resolved_composition()
+        if comp == "flat":
+            return
+        if "transport" in self._options_manager.overridden:
+            raise ValueError(
+                "hierarchical/striped compositions build their own "
+                "hybrid/torus meshes; the transport axis does not apply"
+            )
+        if comp == "striped":
+            stripes = self._stripe_count()
+            if self.group_tokens % stripes:
+                raise ValueError(
+                    f"m={self.m}: {self.group_tokens} tokens per routing "
+                    f"group must divide into {stripes} stripes"
+                )
+
+    def _input_setup(self) -> None:
+        comp = self._resolved_composition()
+        if comp == "flat":
+            JaxSPMDEPAllToAll._input_setup(self)
+            return
+        if comp == "striped":
+            self._setup_striped()
+            return
+        self._setup_hierarchical()
+
+    # -- two-level exchange --------------------------------------------------
+
+    def _setup_hierarchical(self) -> None:
+        """Token groups are destination-rank ordered, and rank =
+        ``slice * ici + chip`` on the hybrid mesh — so the ``[d, g]``
+        group axis reshapes to ``[inter, intra, g]`` exactly, each A2A
+        routes one level, and the final transpose restores source-rank
+        order (dispatch) / expert-rank order (combine)."""
+        self.mesh = self.runtime.hybrid_mesh(("dcn", "ici"))
+        a_host, w_host = self._host_tokens_experts()
+        self.a = self._device_put(a_host, P(("dcn", "ici"), None))
+        self.w = self._device_put(w_host, P(("dcn", "ici"), None, None))
+        d, g = self.num_partitions, self.group_tokens
+        intra, inter = self._two_level()
+        acc = acc_dtype(self.dtype)
+
+        def exchange(x):
+            # x: [inter, intra, g, f] destination-ordered; returns the
+            # same shape source-ordered
+            x = jax.lax.all_to_all(
+                x, "dcn", split_axis=0, concat_axis=0, tiled=True
+            )
+            x = x.transpose(1, 0, 2, 3)
+            x = jax.lax.all_to_all(
+                x, "ici", split_axis=0, concat_axis=0, tiled=True
+            )
+            return x.transpose(1, 0, 2, 3)
+
+        def step(a_loc, w_loc):
+            x = exchange(a_loc.reshape(inter, intra, g, self.k))
+            y = jnp.matmul(
+                x.reshape(d * g, self.k), w_loc[0],
+                preferred_element_type=acc,
+            )
+            y = y.astype(a_loc.dtype).reshape(inter, intra, g, self.n)
+            return exchange(y).reshape(d * g, self.n)
+
+        self._fn = jax.jit(
+            shard_map_compat(
+                step,
+                mesh=self.mesh,
+                in_specs=(
+                    P(("dcn", "ici"), None),
+                    P(("dcn", "ici"), None, None),
+                ),
+                out_specs=P(("dcn", "ici"), None),
+                check_vma=False,
+            )
+        )
+
+    # -- three-level striped exchange ---------------------------------------
+
+    def _setup_striped(self) -> None:
+        """Rank = ``slice*sx*sy + u*sy + v`` on the torus mesh, so the
+        group axis reshapes to ``[inter, sx, sy, g]``; the exchange
+        routes one level per A2A (slice, then each torus axis), bringing
+        each level's destination index leading first and finishing with
+        the reorder back to rank order. Stripes split ``g``: each
+        stripe's dispatch/GEMM/combine is independent end to end, so
+        they issue as separate in-flight exchanges."""
+        self.mesh = self.runtime.torus_mesh(("dcn", "sx", "sy"))
+        a_host, w_host = self._host_tokens_experts()
+        spec = ("dcn", "sx", "sy")
+        self.a = self._device_put(a_host, P(spec, None))
+        self.w = self._device_put(w_host, P(spec, None, None))
+        d, g = self.num_partitions, self.group_tokens
+        sx, sy = self._torus()
+        _intra, inter = self._two_level()
+        stripes = 0
+        if sx > 1:
+            stripes += 1
+        if sy > 1:
+            stripes += 1
+        stripes = max(1, stripes)
+        gs = g // stripes
+        acc = acc_dtype(self.dtype)
+
+        def exchange(x):
+            # x: [inter, sx, sy, gs, f] destination-ordered; returns the
+            # same shape source-ordered
+            x = jax.lax.all_to_all(
+                x, "dcn", split_axis=0, concat_axis=0, tiled=True
+            )
+            # bring the sx destination index leading
+            x = x.transpose(1, 0, 2, 3, 4)
+            x = jax.lax.all_to_all(
+                x, "sx", split_axis=0, concat_axis=0, tiled=True
+            )
+            # bring the sy destination index leading
+            x = x.transpose(2, 1, 0, 3, 4)
+            x = jax.lax.all_to_all(
+                x, "sy", split_axis=0, concat_axis=0, tiled=True
+            )
+            # [sy(src), dcn(src), sx(src)] -> rank order [dcn, sx, sy]
+            return x.transpose(1, 2, 0, 3, 4)
+
+        def step(a_loc, w_loc):
+            tok = a_loc.reshape(d, g, self.k)
+            outs = []
+            for w in range(stripes):
+                sub = tok[:, w * gs:(w + 1) * gs]
+                x = exchange(sub.reshape(inter, sx, sy, gs, self.k))
+                y = jnp.matmul(
+                    x.reshape(d * gs, self.k), w_loc[0],
+                    preferred_element_type=acc,
+                )
+                y = y.astype(a_loc.dtype).reshape(inter, sx, sy, gs, self.n)
+                outs.append(exchange(y).reshape(d, gs, self.n))
+            full = outs[0] if stripes == 1 else jnp.concatenate(outs, axis=1)
+            return full.reshape(d * g, self.n)
+
+        self._fn = jax.jit(
+            shard_map_compat(
+                step,
+                mesh=self.mesh,
+                in_specs=(P(spec, None), P(spec, None, None)),
+                out_specs=P(spec, None),
+                check_vma=False,
+            )
+        )

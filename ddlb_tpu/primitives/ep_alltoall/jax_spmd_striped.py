@@ -1,0 +1,21 @@
+"""MoE dispatch/combine with the striped composition pinned.
+
+The FlexLink-style multi-path member (arxiv 2510.15882) as its own
+sweep identity: same implementation as ``jax_spmd_hier`` (which owns
+all compositions), with ``composition='striped'`` as the default so
+sweeps rank the three-level per-torus-axis exchange alongside flat and
+hierarchical.
+"""
+
+from __future__ import annotations
+
+from ddlb_tpu.primitives.ep_alltoall.jax_spmd_hier import (
+    JaxSPMDHierEPAllToAll,
+)
+
+
+class JaxSPMDStripedEPAllToAll(JaxSPMDHierEPAllToAll):
+    DEFAULT_OPTIONS = {
+        **JaxSPMDHierEPAllToAll.DEFAULT_OPTIONS,
+        "composition": "striped",
+    }

@@ -32,6 +32,7 @@ from ddlb_tpu.ops.quantized_matmul import (
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
 from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
@@ -69,7 +70,7 @@ class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
         # quantize_weight_stack treats the leading expert axis as a stack
         self.wq, self.ws = jax.block_until_ready(
             jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     quantize_weight_stack,
                     mesh=self.mesh,
                     in_specs=(P("tp", None, None),),
@@ -102,7 +103,7 @@ class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
         if opts["quantize"] == "static":
             self.aq, self.sa = jax.block_until_ready(
                 jax.jit(
-                    jax.shard_map(
+                    shard_map_compat(
                         quantize_rowwise,
                         mesh=self.mesh,
                         in_specs=(P("tp", None),),
@@ -112,7 +113,7 @@ class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
                 )(self.a)
             )
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     dispatch_gemm_combine,
                     mesh=self.mesh,
                     in_specs=(
@@ -133,7 +134,7 @@ class QuantizedEPAllToAll(QuantizedGEMMMixin, EPAllToAll):
                 return dispatch_gemm_combine(aq, sa, wq_loc, ws_loc)
 
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     step,
                     mesh=self.mesh,
                     in_specs=(

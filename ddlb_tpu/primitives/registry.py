@@ -116,6 +116,16 @@ _REGISTRY = {
             "ddlb_tpu.primitives.dp_allreduce.quantized",
             "QuantizedDPAllReduce",
         ),
+        # topology-adaptive compositions (ISSUE 16): real hierarchical /
+        # striped all-reduce, selectable at runtime (composition=auto)
+        "jax_spmd_hier": (
+            "ddlb_tpu.primitives.dp_allreduce.jax_spmd_hier",
+            "JaxSPMDHierDPAllReduce",
+        ),
+        "jax_spmd_striped": (
+            "ddlb_tpu.primitives.dp_allreduce.jax_spmd_striped",
+            "JaxSPMDStripedDPAllReduce",
+        ),
     },
     # context-parallel attention: no reference analogue (SURVEY.md section
     # 2.5 — the reference has no attention op); the natural extension of
@@ -176,6 +186,16 @@ _REGISTRY = {
             "ddlb_tpu.primitives.ep_alltoall.pallas_impl",
             "PallasEPAllToAll",
         ),
+        # topology-adaptive compositions (ISSUE 16): two-level and
+        # three-level striped token exchanges
+        "jax_spmd_hier": (
+            "ddlb_tpu.primitives.ep_alltoall.jax_spmd_hier",
+            "JaxSPMDHierEPAllToAll",
+        ),
+        "jax_spmd_striped": (
+            "ddlb_tpu.primitives.ep_alltoall.jax_spmd_striped",
+            "JaxSPMDStripedEPAllToAll",
+        ),
     },
     # the flagship model's full train/forward step through the same
     # runner — the composition the GEMM primitives exist to accelerate
@@ -231,6 +251,16 @@ _REGISTRY = {
         "compute_only": (
             "ddlb_tpu.primitives.collectives.compute_only",
             "ComputeOnlyCollectives",
+        ),
+        # topology-adaptive compositions (ISSUE 16): per-phase rings on
+        # the hybrid mesh / striped rings on the torus mesh
+        "jax_spmd_hier": (
+            "ddlb_tpu.primitives.collectives.jax_spmd_hier",
+            "JaxSPMDHierCollectives",
+        ),
+        "jax_spmd_striped": (
+            "ddlb_tpu.primitives.collectives.jax_spmd_striped",
+            "JaxSPMDStripedCollectives",
         ),
     },
     # the serving engine under open-loop traffic: SLO distributions

@@ -1,0 +1,250 @@
+"""Runtime composition selection for topology-adaptive collectives.
+
+The ``jax_spmd_hier`` / ``jax_spmd_striped`` members of the collective
+families (collectives, dp_allreduce, ep_alltoall) accept a
+``composition`` option: ``flat`` (one ring over every chip),
+``hierarchical`` (HiCCL-style two-level intra/inter decomposition,
+arxiv 2408.05962), ``striped`` (FlexLink-style per-torus-axis
+concurrent rings, arxiv 2510.15882), or ``auto``. This module is the
+one place ``auto`` resolves: the policy consults the live topology
+(``Runtime.num_slices`` + the slice's torus factorization), the seeded
+fault plan (``DDLB_TPU_FAULT_PLAN`` topology rules), the degraded-world
+relaunch stamp (``DDLB_TPU_WORLD_DEGRADED``) and the observatory health
+verdict (banked history under ``DDLB_TPU_HISTORY``), and picks the
+composition the simulator's rankings say survives that world:
+
+- an indicted ICI link (persistent health verdict) or a seeded
+  ``link_slow``/``link_down`` topology fault -> ``striped``: the
+  stripe that rides the hurt axis carries only ``1/stripes`` of the
+  payload, and a DOWNED axis's share reroutes onto its peers — flat is
+  unroutable there (``simulator.frontends.striped_program`` is the
+  ranking twin);
+- a degraded-world relaunch (limp mode) -> ``striped`` for the same
+  reason: the relaunch shrank the world around hurt hardware and the
+  survivors' links are not to be trusted with whole payloads;
+- multi-slice healthy world -> ``hierarchical``: the DCN phase carries
+  ``1/intra`` of the payload (the 7.8x multi-pod win);
+- single-slice healthy world -> ``flat``: both compositions degenerate
+  to it, so say so (the resolved choice is stamped on every row via
+  the ``composition`` schema column).
+
+JAX-free and cheap by construction (env + stdlib; history is read
+lazily and only when present) so ``wire_bytes()`` on duck-typed stubs
+— the perfmodel tests, ``simulator.validate.build_stub`` — resolves
+identically to the live runtime.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ddlb_tpu import envs
+
+#: the composition vocabulary, ``auto`` excluded (it resolves to one of
+#: these); members validate their option against ``auto`` + this
+COMPOSITIONS = ("flat", "hierarchical", "striped")
+
+#: fault kinds that indict a link class for the reroute policy
+_LINK_FAULT_KINDS = ("link_slow", "link_down")
+
+
+def two_level_factors(
+    num_partitions: int, num_slices: int
+) -> Tuple[int, int]:
+    """The (intra, inter) mesh factorization of a ``num_partitions``
+    world with ``num_slices`` DCN slices — inter falls back to 1 when
+    the slice count does not divide the world (a duck-typed stub with
+    no real topology), so the degenerate axes drop phases exactly as
+    ``cost.hierarchical_phases`` documents."""
+    d = max(1, int(num_partitions))
+    inter = max(1, int(num_slices or 1))
+    if inter > d or d % inter:
+        inter = 1
+    return d // inter, inter
+
+
+def fault_plan_link_faults() -> List[Dict[str, Any]]:
+    """Topology link-fault rules (``link_slow``/``link_down``) from the
+    seeded fault plan env, as plain dicts ``{kind, axis, index,
+    factor}``. Parsed directly from ``DDLB_TPU_FAULT_PLAN`` JSON rather
+    than through ``faults.plan.load_plan`` so a malformed plan (which
+    the fault layer treats as fatal at realization time) degrades to
+    "no signal" here — selection must never crash a healthy run."""
+    raw = envs.get_fault_plan()
+    if not raw:
+        return []
+    try:
+        if not raw.lstrip().startswith("{"):
+            # the knob also accepts a path (faults.plan.load_plan)
+            with open(raw, encoding="utf-8") as f:
+                raw = f.read()
+        spec = json.loads(raw)
+        rules = spec.get("rules", []) if isinstance(spec, dict) else []
+    except (OSError, ValueError, AttributeError):
+        return []
+    out: List[Dict[str, Any]] = []
+    for rule in rules:
+        if not isinstance(rule, dict):
+            continue
+        kind = str(rule.get("kind", ""))
+        topo = rule.get("topo")
+        if kind in _LINK_FAULT_KINDS and isinstance(topo, dict):
+            out.append(
+                {
+                    "kind": kind,
+                    "axis": str(topo.get("axis", "ici")),
+                    "index": topo.get("index"),
+                    "factor": topo.get("factor", 1.0),
+                }
+            )
+    return out
+
+
+def health_link_verdict(world: Optional[int] = None) -> Dict[str, Any]:
+    """The banked observatory health verdict, or the healthy default
+    when no history directory is configured / readable. Lazy imports
+    keep this module stdlib-only until a history is actually set."""
+    directory = envs.get_history_dir()
+    if not directory:
+        return {"status": "healthy", "links": []}
+    try:
+        from ddlb_tpu.observatory.health import (
+            observations_from_history,
+            verdict_from_observations,
+        )
+        from ddlb_tpu.observatory.store import load_history
+
+        records = load_history(directory)
+        return verdict_from_observations(
+            observations_from_history(records), world=world
+        )
+    except Exception:
+        return {"status": "healthy", "links": []}
+
+
+def select_composition(
+    requested: str,
+    num_partitions: int,
+    num_slices: int,
+) -> Tuple[str, str]:
+    """Resolve a member's ``composition`` option to one of
+    ``COMPOSITIONS`` plus a human-readable reason (telemetry + the
+    chaos battery's assertion surface). Non-``auto`` requests pass
+    through — a pinned composition is the sweep-matrix case and must
+    never be second-guessed."""
+    if requested != "auto":
+        if requested not in COMPOSITIONS:
+            raise ValueError(
+                f"composition must be one of {COMPOSITIONS + ('auto',)}, "
+                f"got {requested!r}"
+            )
+        return requested, "pinned"
+
+    if envs.get_world_degraded():
+        return "striped", (
+            "degraded-world relaunch (DDLB_TPU_WORLD_DEGRADED): the "
+            "survivors' links carry stripe shares, not whole payloads"
+        )
+    faults = fault_plan_link_faults()
+    if faults:
+        worst = faults[0]
+        return "striped", (
+            f"seeded {worst['kind']} on {worst['axis']}[{worst['index']}] "
+            "(fault plan): striped reroutes the hurt axis's share onto "
+            "its peer stripes"
+        )
+    verdict = health_link_verdict(world=num_partitions)
+    links = [
+        str(link)
+        for link in (verdict.get("links") or [])
+        if str(link).startswith("ici[")
+    ]
+    if verdict.get("status") == "persistent" and links:
+        return "striped", (
+            f"health verdict indicts {links[0]} (persistent straggler): "
+            "striped carries 1/stripes of the payload per link family"
+        )
+    _intra, inter = two_level_factors(num_partitions, num_slices)
+    if inter > 1:
+        return "hierarchical", (
+            f"healthy {inter}-slice world: the DCN phase carries "
+            "1/intra of the payload"
+        )
+    return "flat", "healthy single-slice world: the compositions degenerate"
+
+
+class ComposedMember:
+    """Mixin for the ``jax_spmd_hier`` / ``jax_spmd_striped`` members:
+    composition resolution, the closed-form wire census routed per
+    composition, and the ``composition`` row stamp. JAX-free — the
+    mixin's methods work on duck-typed stubs (``validate.build_stub``,
+    the perfmodel tests) exactly as on live instances, which is what
+    lets the DDLB123 census and the simulator twins share one formula.
+
+    Families list their collective payloads via ``_collective_payloads()``
+    -> ``[(op, local_nbytes), ...]`` (dp: one AR of the gradient; ep:
+    dispatch + combine A2As; collectives: the configured op); the mixin
+    prices them with ``cost.hierarchical_wire_bytes`` /
+    ``cost.striped_wire_bytes`` and defers to the family base (flat
+    ring) when the composition resolves flat.
+    """
+
+    def _resolved_composition(self) -> str:
+        cached = getattr(self, "_composition", None)
+        if cached is None:
+            runtime = getattr(self, "runtime", None)
+            num_slices = int(getattr(runtime, "num_slices", 1) or 1)
+            cached, reason = select_composition(
+                self.options.get("composition", "auto"),
+                self.num_partitions,
+                num_slices,
+            )
+            self._composition = cached
+            self._composition_reason = reason
+        return cached
+
+    def _two_level(self) -> Tuple[int, int]:
+        """(intra, inter) for this instance's world."""
+        runtime = getattr(self, "runtime", None)
+        return two_level_factors(
+            self.num_partitions, int(getattr(runtime, "num_slices", 1) or 1)
+        )
+
+    def _torus(self) -> Tuple[int, int]:
+        """The slice's (sx, sy) torus factorization — stripe axes."""
+        from ddlb_tpu.perfmodel.cost import torus_factors
+
+        intra, _inter = self._two_level()
+        return torus_factors(intra)
+
+    def _stripe_count(self) -> int:
+        sx, sy = self._torus()
+        return max(1, sum(1 for a in (sx, sy) if a > 1))
+
+    def wire_bytes(self) -> float:
+        from ddlb_tpu.perfmodel.cost import (
+            hierarchical_wire_bytes,
+            striped_wire_bytes,
+        )
+
+        comp = self._resolved_composition()
+        if comp == "flat":
+            return super().wire_bytes()
+        intra, inter = self._two_level()
+        total = 0.0
+        if comp == "hierarchical":
+            for op, nbytes in self._collective_payloads():
+                cls = hierarchical_wire_bytes(op, nbytes, intra, inter)
+                total += cls["ici"] + cls["dcn"]
+        else:
+            sx, sy = self._torus()
+            for op, nbytes in self._collective_payloads():
+                cls = striped_wire_bytes(op, nbytes, inter, (sx, sy))
+                total += cls["ici"] + cls["dcn"]
+        return total
+
+    def extra_row_fields(self) -> dict:
+        fields = dict(super().extra_row_fields())
+        fields["composition"] = self._resolved_composition()
+        return fields

@@ -424,6 +424,30 @@ class Runtime:
         )
         return jax.sharding.Mesh(arr, tuple(axis_names))
 
+    def torus_mesh(self, axis_names=("dcn", "sx", "sy")):
+        """3-D ``(num_slices, sx, sy)`` mesh splitting each slice into
+        its squarest 2-D torus factorization (``perfmodel.cost
+        .torus_factors``) — the striped composition's world view: one
+        independent ring family per intra-slice axis, the DCN axis
+        kept separate. Device order matches ``hybrid_mesh`` (slices
+        are contiguous blocks), so the two views agree on which chips
+        share a slice."""
+        import numpy as np
+
+        import jax
+
+        from ddlb_tpu.perfmodel.cost import torus_factors
+
+        per = self.num_devices // self.num_slices
+        sx, sy = torus_factors(per)
+        order = sorted(
+            range(self.num_devices), key=lambda i: (self.slice_ids[i], i)
+        )
+        arr = np.array([self.devices[i] for i in order]).reshape(
+            self.num_slices, sx, sy
+        )
+        return jax.sharding.Mesh(arr, tuple(axis_names))
+
     # -- synchronization -----------------------------------------------------
 
     def barrier(self) -> None:

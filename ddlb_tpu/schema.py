@@ -120,6 +120,12 @@ ROW_COLUMNS: Dict[str, str] = {
     "bank_key": "caller-config identity JSON for hwlogs/rows.jsonl dedup",
     # -- family extras (impl.extra_row_fields; only on measured rows of
     #    the family, never part of the fixed CSV header contract) -------
+    "composition": (
+        "resolved collective composition (flat / hierarchical / striped)"
+        " stamped by the topology-adaptive members; 'auto' resolves via"
+        " primitives.topo_compose against the live topology, fault plan,"
+        " degraded-world stamp and health verdict"
+    ),
     "spec_accept_rate": "speculative decoding measured acceptance rate",
     "spec_rounds": "speculative decoding verify rounds measured",
     "spec_proposals": "speculative decoding proposed-token count",

@@ -341,7 +341,10 @@ def program_from_impl(
 
 
 def _entry_steps(
-    entry: Dict[str, Any], scope_default: str, tag: str
+    entry: Dict[str, Any],
+    scope_default: str,
+    tag: str,
+    topology: Optional[Topology] = None,
 ) -> List[WireStep]:
     """One exported trace entry -> its ring steps. ppermute entries ARE
     single hops already (the chunked rings' literal schedule); closed-
@@ -355,7 +358,29 @@ def _entry_steps(
             f"trace entry {op} at line {entry.get('line')} did not "
             f"resolve (axis={d}, nbytes={nbytes})"
         )
-    scope = "dcn" if "dcn" in entry["axes"] else scope_default
+    axes = entry["axes"]
+    if "dcn" in axes:
+        scope = "dcn"
+    elif "sy" in axes:
+        # the striped members' torus mesh (runtime.torus_mesh): each
+        # intra-slice torus axis is its own ring family / link class
+        scope = "ici1"
+    elif "sx" in axes:
+        scope = "ici0"
+    else:
+        scope = scope_default
+    if (
+        topology is not None
+        and topology.pods > 1
+        and scope.startswith("ici")
+        and int(d) >= topology.num_chips
+    ):
+        # a ring spanning the whole multi-pod world (a flat member's one
+        # collective over the full device axis) crosses the pod boundary:
+        # bill it to the slowest-link-gated flat channel, exactly like
+        # the synthetic flat_ring_program — otherwise the traced flat
+        # baseline would replay at ICI speed and the comparison lies
+        scope = "flat"
     if op in ("ppermute", "remote_copy"):
         return [
             WireStep(float(nbytes), scope=scope, op="ppermute", tag=tag)
@@ -421,7 +446,9 @@ def program_from_schedule(
             cursor += size
             wsteps: List[WireStep] = []
             for e in group:
-                wsteps.extend(_entry_steps(e, scope_default, f"chunk{j}"))
+                wsteps.extend(
+                    _entry_steps(e, scope_default, f"chunk{j}", topology)
+                )
             csteps = (
                 [ComputeStep(flops / chunks, dtype=dtype, tag=f"gemm#{j}")]
                 if flops > 0.0
@@ -437,9 +464,48 @@ def program_from_schedule(
             stages.append(Stage(steps, label=f"chunk{j}"))
         return pipelined(label, stages, chunks=chunks, **meta)
 
+    stripes = int(export.get("stripes") or 1)
+    rides_torus = any(
+        "sx" in e.get("axes", ()) or "sy" in e.get("axes", ())
+        for e in entries
+    )
+    if stripes > 1 and rides_torus and len(entries) % stripes == 0:
+        # the striped members' trace is stripe-major (stripe w's whole
+        # sandwich/exchange, then stripe w+1's): one contiguous group
+        # per stripe, replayed as concurrent stages — distinct ring
+        # families contend only where they genuinely share a link
+        # class (the DCN psum), which is the engine's arbitration to
+        # decide, not a closed form's
+        per = len(entries) // stripes
+        placement = FAMILY_PLACEMENT.get(family, "comm_first")
+        stages = []
+        for s in range(stripes):
+            group = entries[s * per:(s + 1) * per]
+            wsteps = []
+            for e in group:
+                wsteps.extend(
+                    _entry_steps(e, scope_default, f"stripe{s}", topology)
+                )
+            csteps = (
+                [ComputeStep(
+                    flops / stripes, dtype=dtype, tag=f"gemm#s{s}"
+                )]
+                if flops > 0.0
+                else []
+            )
+            if placement == "compute_first":
+                steps: List[Any] = csteps + wsteps
+            elif placement == "sandwich" and len(wsteps) >= 2:
+                half = len(wsteps) // 2
+                steps = wsteps[:half] + csteps + wsteps[half:]
+            else:
+                steps = wsteps + csteps
+            stages.append(Stage(steps, label=f"stripe{s}"))
+        return pipelined(label, stages, stripes=stripes, **meta)
+
     wsteps = []
     for e in entries:
-        wsteps.extend(_entry_steps(e, scope_default, "trace"))
+        wsteps.extend(_entry_steps(e, scope_default, "trace", topology))
     csteps = (
         [ComputeStep(flops, dtype=dtype, tag="gemm")] if flops > 0.0 else []
     )

@@ -38,6 +38,15 @@ scale. Everything runs in REAL launched 3-process CPU-sim worlds (a
    and the measured per-row arrival skew must fall within tolerance of
    it — the injection, the perfmodel and the simulator priced one
    closed form, and the measurement confirms it.
+7. **Topology-adaptive re-run** (ISSUE 16): a second seeded world runs
+   the REAL composed dp_allreduce member with ``composition=auto``.
+   ``primitives.topo_compose.select_composition`` must pick
+   ``striped`` on BOTH attempts — from the seeded fault plan on the
+   full world, then from the ``DDLB_TPU_WORLD_DEGRADED`` stamp on the
+   degraded relaunch — with zero rows lost and the resolved choice
+   stamped on every row via the ``composition`` schema column (the
+   same healthy parent process resolves ``auto`` -> ``flat``, the
+   zero-false-positive side).
 
 Usage: python scripts/chaos_degrade.py [--seed 0] [--keep DIR]
            [--log FILE]
@@ -66,6 +75,18 @@ DEVICES_PER_PROCESS = 2
 M, N, K = 96, 32, 48
 ITERATIONS = 4         # barriered iterations = clock-sync exchanges
 IMPLS = ("jax_spmd", "xla_gspmd", "compute_only")  # 3 rows = 3 observations
+
+#: step 7's workload: the composed dp_allreduce member with the runtime
+#: composition policy under test, a pinned-striped control, and the
+#: family's flat baseline — still 3 rows, so the launcher's health gate
+#: clears its MIN_OBSERVATIONS floor. M=96 divides the striped scatter
+#: pieces on the full world (stripes 2 x intra 6 = 12) AND the shrunken
+#: one (2 x 4 = 8)
+AUTO_IMPLS = (
+    "jax_spmd_hier;composition=auto",
+    "jax_spmd_hier;composition=striped",
+    "jax_spmd",
+)
 
 #: the seeded degradation: link ici[1->2] surviving at quarter rate.
 #: SIM_LINK_GBS is the simulated healthy link rate the CPU-sim
@@ -113,15 +134,15 @@ class _Tee:
         self._file.close()
 
 
-def child_command(csv: str) -> list:
-    """The world's workload: a 3-impl tp_columnwise sweep through the
-    real benchmark CLI — every row crosses ``runtime.collective`` once
-    (the timing MAX-reduce), so each row is one straggler observation."""
+def child_command(csv: str, primitive="tp_columnwise", impls=IMPLS) -> list:
+    """The world's workload: a 3-impl sweep through the real benchmark
+    CLI — every row crosses ``runtime.collective`` once (the timing
+    MAX-reduce), so each row is one straggler observation."""
     cmd = [
         sys.executable, "-m", "ddlb_tpu.cli.benchmark",
-        "--primitive", "tp_columnwise",
+        "--primitive", primitive,
     ]
-    for impl in IMPLS:
+    for impl in impls:
         cmd += ["--impl", impl]
     cmd += [
         "-m", str(M), "-n", str(N), "-k", str(K),
@@ -156,7 +177,8 @@ def build_plan(seed: int) -> dict:
 
 
 def run_world(
-    name, base, history, plan=None, health_gate=True, world_retries=2
+    name, base, history, plan=None, health_gate=True, world_retries=2,
+    primitive="tp_columnwise", impls=IMPLS,
 ):
     """Launch one supervised 3-rank world; returns (rc, run_dir)."""
     from ddlb_tpu.cli.launch import launch_supervised
@@ -179,7 +201,10 @@ def run_world(
           f"{'on' if health_gate else 'off'})", flush=True)
     try:
         rc = launch_supervised(
-            child_command(os.path.join(run_dir, "rows.csv")),
+            child_command(
+                os.path.join(run_dir, "rows.csv"),
+                primitive=primitive, impls=impls,
+            ),
             processes=PROCESSES,
             devices_per_process=DEVICES_PER_PROCESS,
             silence_timeout=120.0,
@@ -417,6 +442,69 @@ def main(argv=None) -> int:
                 f"{hi:.3f}])",
             )
 
+        # -- 7: composition=auto re-run picks striped under the fault ----
+        from ddlb_tpu.primitives.topo_compose import select_composition
+
+        print("\n==== topology-adaptive re-run: dp_allreduce "
+              "composition=auto under the same seeded fault ====")
+        comp, reason = select_composition(
+            "auto", PROCESSES * DEVICES_PER_PROCESS, 1
+        )
+        check(
+            comp == "flat",
+            f"healthy parent resolves auto -> {comp} ({reason})",
+        )
+        rc, run_dir = run_world(
+            "seeded-auto", base, history, plan=build_plan(args.seed),
+            primitive="dp_allreduce", impls=AUTO_IMPLS,
+        )
+        check(rc == 0, f"auto world recovered degraded (rc={rc})")
+        with open(os.path.join(run_dir, "attempts.json")) as f:
+            attempts = json.load(f)
+        last = attempts[-1]
+        check(
+            len(attempts) == 2
+            and last["outcome"] == "ok"
+            and last.get("world_degraded") is True,
+            f"auto world relaunched DEGRADED once "
+            f"({len(attempts)} attempts, final {last['outcome']})",
+        )
+        auto_df = pd.read_csv(os.path.join(run_dir, "rows.csv"))
+        final = (
+            auto_df.groupby("implementation").last().reset_index()
+        )
+        check(
+            len(final) == len(AUTO_IMPLS)
+            and bool(final["valid"].all())
+            and bool(final["world_degraded"].all()),
+            f"zero rows lost: {len(final)}/{len(AUTO_IMPLS)} configs "
+            f"measured valid on the degraded world",
+        )
+        auto_rows = auto_df[
+            auto_df["option"].str.contains("composition=auto", na=False)
+        ]
+        check(
+            len(auto_rows) == 2
+            and set(auto_rows["composition"]) == {"striped"},
+            f"composition=auto resolved striped on BOTH attempts — the "
+            f"fault plan on the full world, the degraded stamp on the "
+            f"relaunch ({sorted(set(auto_rows['composition']))} over "
+            f"{len(auto_rows)} rows)",
+        )
+        pinned = auto_df[
+            auto_df["option"].str.contains("composition=striped", na=False)
+        ]
+        check(
+            len(pinned) > 0 and set(pinned["composition"]) == {"striped"},
+            "pinned composition=striped control passes through unchanged",
+        )
+        flat_rows = auto_df[auto_df["implementation"] == "jax_spmd_0"]
+        check(
+            bool(flat_rows["composition"].isna().all()),
+            "non-composed jax_spmd rows leave the composition column "
+            "empty",
+        )
+
         print()
     finally:
         os.environ.pop("DDLB_TPU_FAULT_PLAN", None)
@@ -431,8 +519,10 @@ def main(argv=None) -> int:
             f.write(
                 "\nchaos_degrade: seeded degraded link detected by the "
                 "skew gate, indicted by the health verdict, mitigated by "
-                "a degraded relaunch with zero rows lost, and bracketed "
-                "by the simulator's degraded-world prediction — OK\n"
+                "a degraded relaunch with zero rows lost, bracketed "
+                "by the simulator's degraded-world prediction, and "
+                "rerouted by composition=auto resolving striped on every "
+                "attempt — OK\n"
             )
     if failures:
         print(f"\nchaos_degrade: {len(failures)} assertion(s) FAILED",
@@ -442,7 +532,8 @@ def main(argv=None) -> int:
         return 1
     print(
         "\nchaos_degrade: seeded degraded link detected, indicted, "
-        "mitigated, and model-bracketed with zero rows lost — OK",
+        "mitigated, model-bracketed, and rerouted (composition=auto -> "
+        "striped) with zero rows lost — OK",
         flush=True,
     )
     return 0

@@ -42,6 +42,15 @@ degenerate flat topologies for every registered family, and — when a
 history bank is given via ``--history`` or ``DDLB_TPU_HISTORY`` — the
 tolerance-gated join against banked observatory medians.
 
+``--compare-members`` runs the member-twin gate
+(``simulator.validate.member_twin_check``): the REAL topology-adaptive
+members (``jax_spmd_hier``/``jax_spmd_striped``, ISSUE 16) trace at the
+topology's own axis sizes and their replayed schedules land next to the
+synthetic flat/hierarchical/striped builders — makespans within
+tolerance (flat/hier are step-for-step identical; striped has its own
+documented bar) and rankings agreeing (hier and striped both beat flat
+on the multi-pod world). ``make ci`` runs this gate.
+
 Exit codes: 0 success; 1 validation failure (or empty ranking); 2
 usage errors (argparse).
 """
@@ -268,6 +277,29 @@ def build_member_section(members):
     return out
 
 
+def print_compare_members(summary):
+    print(
+        f"== real members vs synthetic twins on {summary['topology']} =="
+    )
+    print(
+        f"{'family':<14} {'member':<18} {'composition':<13} "
+        f"{'traced':>12} {'synthetic':>12} {'rel':>7} {'bar':>5}"
+    )
+    for rec in summary["records"]:
+        print(
+            f"{rec['family']:<14} {rec['member']:<18} "
+            f"{rec['composition']:<13} {_fmt_s(rec['traced_s']):>12} "
+            f"{_fmt_s(rec['synthetic_s']):>12} {rec['rel_err']:>7.3f} "
+            f"{rec['rtol']:>5.2f}"
+            + ("" if rec["ok"] else "  FAIL")
+        )
+    for failure in summary["failures"]:
+        print(f"  FAIL {failure}")
+    print(
+        "MEMBER-TWIN " + ("PASSED" if summary["ok"] else "FAILED")
+    )
+
+
 def run_validation(history_dir):
     from ddlb_tpu.simulator.validate import closed_form_check, history_check
 
@@ -355,6 +387,12 @@ def main(argv=None) -> int:
         help="run the closed-form + history validation gates instead",
     )
     parser.add_argument(
+        "--compare-members", action="store_true",
+        help="replay the real topology-adaptive members' traced "
+        "schedules next to the synthetic flat/hier/striped builders and "
+        "gate on tolerance + ranking agreement (member_twin_check)",
+    )
+    parser.add_argument(
         "--history", default=None,
         help="observatory history directory for the validation join "
         "(default: DDLB_TPU_HISTORY)",
@@ -369,6 +407,16 @@ def main(argv=None) -> int:
         topology = resolve_topology(spec)
     except (KeyError, ValueError) as exc:
         parser.error(f"bad --topology {spec!r}: {exc}")
+
+    if args.compare_members:
+        from ddlb_tpu.simulator.validate import member_twin_check
+
+        summary = member_twin_check(topology=spec)
+        if args.as_json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print_compare_members(summary)
+        return 0 if summary["ok"] else 1
 
     if args.validate:
         history_dir = args.history or envs.get_history_dir() or None

@@ -23,6 +23,10 @@ import pytest
 from ddlb_tpu.perfmodel.cost import (
     FAMILY_COST_MODELS,
     estimate,
+    hierarchical_wire_bytes,
+    ring_wire_bytes,
+    striped_wire_bytes,
+    torus_factors,
     wire_itemsize,
 )
 from ddlb_tpu.perfmodel.specs import (
@@ -141,6 +145,90 @@ def _stub(primitive, name, m, n, k, dtype="bfloat16", d=8, **options):
     defaults, _ = cls.option_schema()
     impl.options = {**defaults, **options}
     return impl
+
+
+class TestStripedFormulas:
+    """``torus_factors`` + ``striped_wire_bytes`` hand-computed, plus
+    the conservation anchors that tie the striped composition to the
+    hierarchical and flat formulas (ISSUE 16 satellite)."""
+
+    def test_torus_factors_squarest_split(self):
+        assert torus_factors(1) == (1, 1)
+        assert torus_factors(4) == (2, 2)
+        assert torus_factors(8) == (2, 4)
+        assert torus_factors(12) == (3, 4)
+        assert torus_factors(16) == (4, 4)
+        assert torus_factors(256) == (16, 16)
+        assert torus_factors(7) == (1, 7)  # primes stay 1 x n
+        with pytest.raises(ValueError):
+            torus_factors(0)
+
+    def test_striped_all_reduce_hand_computed(self):
+        # 4 slices x (4x4) torus, S bytes local: RS-intra S*15/16,
+        # AR-inter 2*(S/16)*(3/4), AG-intra (S/16)*15 — two stripes
+        # splitting the ICI share evenly
+        s = 1024.0
+        got = striped_wire_bytes("all_reduce", s, 4, (4, 4))
+        assert got["ici"] == pytest.approx(s * 15.0 / 16.0 + s * 15.0 / 16.0)
+        assert got["dcn"] == pytest.approx(2.0 * (s / 16.0) * 3.0 / 4.0)
+        assert got["stripes"] == 2
+        assert got["ici_per_stripe"] == pytest.approx(got["ici"] / 2.0)
+
+    def test_striped_all_to_all_pays_per_axis(self):
+        # the intra redistribution runs per torus axis: sum((a-1)/a)
+        # instead of the flat slice's (15/16) — strictly more wire,
+        # spread over two independent link families
+        s = 1024.0
+        got = striped_wire_bytes("all_to_all", s, 4, (4, 4))
+        assert got["ici"] == pytest.approx(s * (3.0 / 4.0 + 3.0 / 4.0))
+        assert got["dcn"] == pytest.approx(s * 3.0 / 4.0)
+        hier = hierarchical_wire_bytes("all_to_all", s, 16, 4)
+        assert got["ici"] > hier["ici"]
+        assert got["dcn"] == pytest.approx(hier["dcn"])
+
+    @pytest.mark.parametrize(
+        "op", ["all_reduce", "all_gather", "reduce_scatter"]
+    )
+    def test_striped_class_totals_match_hierarchical(self, op):
+        # striping re-partitions, it does not add wire: for the
+        # reduction/gather shapes the class totals equal the two-level
+        # composition over the full slice
+        s = 4096.0
+        got = striped_wire_bytes(op, s, 2, (2, 4))
+        hier = hierarchical_wire_bytes(op, s, 8, 2)
+        assert got["ici"] == pytest.approx(hier["ici"])
+        assert got["dcn"] == pytest.approx(hier["dcn"])
+        assert got["stripes"] == 2
+
+    def test_hierarchical_all_reduce_total_matches_flat(self):
+        # the sanity anchor the compositions hang off: AR's two-level
+        # total equals the flat ring for any factorization
+        s = 4096.0
+        hier = hierarchical_wire_bytes("all_reduce", s, 8, 2)
+        assert hier["ici"] + hier["dcn"] == pytest.approx(
+            ring_wire_bytes("all_reduce", s, 16)
+        )
+
+    def test_striped_degenerate_axes_drop_stripes(self):
+        # a 1-extent axis contributes no stripe; a 1xN torus is exactly
+        # the hierarchical composition
+        s = 512.0
+        got = striped_wire_bytes("all_reduce", s, 2, (1, 8))
+        hier = hierarchical_wire_bytes("all_reduce", s, 8, 2)
+        assert got["stripes"] == 1
+        assert got["ici"] == pytest.approx(hier["ici"])
+        assert got["dcn"] == pytest.approx(hier["dcn"])
+
+    def test_striped_single_slice_has_no_dcn(self):
+        got = striped_wire_bytes("all_reduce", 512.0, 1, (2, 2))
+        assert got["dcn"] == 0.0
+        assert got["ici"] > 0.0
+
+    def test_striped_single_chip_slice_is_dcn_only(self):
+        got = striped_wire_bytes("all_gather", 512.0, 4, (1, 1))
+        assert got["ici"] == 0.0
+        assert got["stripes"] == 1
+        assert got["dcn"] == pytest.approx(512.0 * 3.0)
 
 
 class TestClosedFormCosts:

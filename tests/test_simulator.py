@@ -489,3 +489,108 @@ class TestTopologyOverride:
 
         with pytest.raises(SystemExit):
             cli.main(["--topology", "not-a-world"])
+
+
+# ---------------------------------------------------------------------------
+# member twins: real traced members vs synthetic compositions (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+class TestMemberTwins:
+    """The topology-adaptive members' traced schedules replayed next to
+    the synthetic builders that predicted them (validate.member_twin_
+    check), plus the traced-front-end lowering rules the replay relies
+    on: sx/sy entries land on distinct ICI link classes, stripe-major
+    traces split into concurrent stages, and a world-spanning flat
+    member's ring bills the flat channel on a multi-pod world."""
+
+    #: d=16 as 4 pods of a 2x2 torus — both torus axes alive, so the
+    #: striped trace carries two true stripes
+    SHAPES_16 = {
+        "m": 256, "n": 1, "k": 64, "d": 16,
+        "dcn": 4, "ici": 4, "sx": 2, "sy": 2,
+    }
+
+    def _schedule(self, overrides):
+        from ddlb_tpu.analysis.spmd.families import member_schedule
+
+        return member_schedule(
+            "collectives", "jax_spmd_hier",
+            {"op": "all_reduce", **overrides},
+            shapes=self.SHAPES_16,
+        )
+
+    def test_twin_gate_passes(self):
+        from ddlb_tpu.simulator.validate import member_twin_check
+
+        out = member_twin_check()
+        assert out["ok"], out["failures"]
+        by_key = {
+            (r["family"], r["composition"]): r for r in out["records"]
+        }
+        # all three families x three compositions replayed
+        assert len(by_key) == 9
+        for family in ("collectives", "dp_allreduce", "ep_alltoall"):
+            # flat/hier traces lower to step-for-step identical programs
+            assert by_key[(family, "flat")]["rel_err"] < 1e-9
+            assert by_key[(family, "hierarchical")]["rel_err"] < 1e-9
+            # the acceptance ranking: both adaptive compositions beat
+            # flat on the 4-pod world, in the REAL members' replays
+            flat_s = by_key[(family, "flat")]["traced_s"]
+            assert by_key[(family, "hierarchical")]["traced_s"] < flat_s
+            assert by_key[(family, "striped")]["traced_s"] < flat_s
+
+    def test_striped_trace_splits_into_concurrent_stages(self):
+        from ddlb_tpu.simulator.frontends import program_from_schedule
+
+        export = self._schedule({"composition": "striped"})
+        assert export["status"] == "verified", export["reason"]
+        assert export["stripes"] == 2
+        topo = Topology(
+            chip=parse_topology("v5p:4x2x2").chip, pods=4, ici_mesh=(2, 2)
+        )
+        prog = program_from_schedule(dict(export, flops=0.0), topo)
+        assert prog.overlap  # stripes are concurrent, not chained
+        assert len(prog.stages) == 2
+        scopes = {
+            s.scope for stage in prog.stages for s in stage.steps
+            if isinstance(s, WireStep)
+        }
+        # the two ring families ride DISTINCT link classes + shared DCN
+        assert scopes == {"ici0", "ici1", "dcn"}
+        # each stripe's big ring leads on its own axis
+        lead0 = next(
+            s for s in prog.stages[0].steps if isinstance(s, WireStep)
+        )
+        lead1 = next(
+            s for s in prog.stages[1].steps if isinstance(s, WireStep)
+        )
+        assert {lead0.scope, lead1.scope} == {"ici0", "ici1"}
+
+    def test_flat_member_bills_flat_channel_on_multipod(self):
+        from ddlb_tpu.simulator.frontends import program_from_schedule
+
+        export = self._schedule({"composition": "flat"})
+        assert export["status"] == "verified", export["reason"]
+        multipod = parse_topology("v5p:4x2x2")
+        prog = program_from_schedule(dict(export, flops=0.0), multipod)
+        scopes = {
+            s.scope for stage in prog.stages for s in stage.steps
+            if isinstance(s, WireStep)
+        }
+        assert scopes == {"flat"}
+        # the same export on a single-pod world stays on ICI
+        flat_world = flat_topology(16, "v5p")
+        prog = program_from_schedule(dict(export, flops=0.0), flat_world)
+        scopes = {
+            s.scope for stage in prog.stages for s in stage.steps
+            if isinstance(s, WireStep)
+        }
+        assert scopes == {"ici0"}
+
+    def test_compare_members_cli(self):
+        out = _run_report("--compare-members", "--json")
+        assert out.returncode == 0, out.stderr or out.stdout
+        doc = json.loads(out.stdout)
+        assert doc["ok"]
+        assert len(doc["records"]) == 9

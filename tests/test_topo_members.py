@@ -1,0 +1,253 @@
+"""Topology-adaptive members (ISSUE 16): hier/striped compositions.
+
+Every member x composition validates numerically on the 8-device CPU
+mesh in both the 1-slice and 2-simulated-slice worlds, the selection
+policy resolves ``auto`` from live topology / fault plan / degraded
+overlay, and ``wire_bytes()`` tracks the resolved composition's closed
+form (``cost.hierarchical_wire_bytes`` / ``cost.striped_wire_bytes``) —
+the same identities DDLB123's traced census verifies at zero drift.
+"""
+
+import json
+
+import pytest
+
+from ddlb_tpu.perfmodel.cost import (
+    hierarchical_wire_bytes,
+    striped_wire_bytes,
+    torus_factors,
+)
+from ddlb_tpu.primitives.registry import load_impl_class
+from ddlb_tpu.primitives.topo_compose import (
+    select_composition,
+    two_level_factors,
+)
+from ddlb_tpu.runtime import Runtime
+
+M, N, K = 256, 64, 64  # m % d^2 at d=8; all stripe/scatter splits exact
+
+
+@pytest.fixture
+def two_slices(monkeypatch):
+    """8 CPU devices as 2 simulated slices x 4 (test_collectives.py
+    idiom); restores the clean singleton afterwards."""
+    monkeypatch.setenv("DDLB_TPU_SIM_SLICES", "2")
+    Runtime.reset()
+    yield
+    monkeypatch.delenv("DDLB_TPU_SIM_SLICES")
+    Runtime.reset()
+    Runtime()
+
+
+# -- selection policy ---------------------------------------------------------
+
+
+def test_two_level_factors():
+    assert two_level_factors(8, 1) == (8, 1)
+    assert two_level_factors(8, 2) == (4, 2)
+    assert two_level_factors(8, 4) == (2, 4)
+    # a slice count that does not divide the world degenerates to flat
+    assert two_level_factors(8, 3) == (8, 1)
+
+
+def test_select_composition_pinned_passthrough():
+    for comp in ("flat", "hierarchical", "striped"):
+        assert select_composition(comp, 8, 2)[0] == comp
+    with pytest.raises(ValueError):
+        select_composition("bogus", 8, 2)
+
+
+def test_select_composition_auto_healthy():
+    # healthy 1-slice world -> flat; multi-slice -> hierarchical
+    comp, reason = select_composition("auto", 8, 1)
+    assert comp == "flat"
+    comp, reason = select_composition("auto", 8, 2)
+    assert comp == "hierarchical"
+    assert "slice" in reason or "inter" in reason
+
+
+def test_select_composition_auto_degraded_world(monkeypatch):
+    monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "1")
+    comp, reason = select_composition("auto", 8, 2)
+    assert comp == "striped"
+    assert "degraded" in reason
+
+
+def test_select_composition_auto_fault_plan(monkeypatch, tmp_path):
+    plan = {
+        "seed": 7,
+        "rules": [
+            {
+                "site": "runtime.collective",
+                "kind": "link_slow",
+                "topo": {"axis": "ici", "index": 1, "direction": "tx",
+                         "factor": 0.25},
+            }
+        ],
+    }
+    path = tmp_path / "plan.json"
+    path.write_text(json.dumps(plan))
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", str(path))
+    comp, reason = select_composition("auto", 8, 1)
+    assert comp == "striped"
+    assert "link" in reason
+
+
+# -- torus mesh ---------------------------------------------------------------
+
+
+def test_torus_mesh_shape():
+    mesh = Runtime().torus_mesh()
+    sx, sy = torus_factors(Runtime().num_devices)
+    assert mesh.axis_names == ("dcn", "sx", "sy")
+    assert mesh.devices.shape == (1, sx, sy)
+
+
+def test_torus_mesh_two_slices(two_slices):
+    mesh = Runtime().torus_mesh()
+    assert mesh.devices.shape == (2, 2, 2)
+    # slice-major device order, hybrid_mesh-compatible
+    hybrid = Runtime().hybrid_mesh(("dcn", "ici"))
+    assert (mesh.devices.reshape(2, 4) == hybrid.devices).all()
+
+
+# -- members: numerical correctness ------------------------------------------
+
+
+COLLECTIVE_OPS = ("all_gather", "all_reduce", "reduce_scatter",
+                  "all_to_all")
+
+
+@pytest.mark.parametrize("op", COLLECTIVE_OPS)
+def test_collectives_hier_two_slices(two_slices, op):
+    cls = load_impl_class("collectives", "jax_spmd_hier")
+    impl = cls(M, 1, K, dtype="float32", op=op)
+    assert impl._resolved_composition() == "hierarchical"
+    assert impl.mesh.axis_names == ("dcn", "ici")
+    assert impl.validate(impl.run())
+
+
+def test_collectives_striped_both_worlds(two_slices):
+    cls = load_impl_class("collectives", "jax_spmd_striped")
+    impl = cls(M, 1, K, dtype="float32")
+    assert impl.options["op"] == "all_reduce"
+    assert impl.mesh.axis_names == ("dcn", "sx", "sy")
+    assert impl.validate(impl.run())
+
+
+def test_collectives_striped_single_slice():
+    cls = load_impl_class("collectives", "jax_spmd_striped")
+    impl = cls(M, 1, K, dtype="float32")
+    assert impl.validate(impl.run())
+
+
+@pytest.mark.parametrize("comp", ["hierarchical", "striped"])
+def test_dp_allreduce_members_two_slices(two_slices, comp):
+    cls = load_impl_class("dp_allreduce", "jax_spmd_hier")
+    impl = cls(M, N, K, dtype="float32", composition=comp)
+    assert impl.validate(impl.run())
+
+
+@pytest.mark.parametrize("comp", ["hierarchical", "striped"])
+def test_ep_alltoall_members_two_slices(two_slices, comp):
+    cls = load_impl_class("ep_alltoall", "jax_spmd_hier")
+    impl = cls(M, N, K, dtype="float32", composition=comp)
+    assert impl.validate(impl.run())
+
+
+def test_ep_striped_single_slice():
+    cls = load_impl_class("ep_alltoall", "jax_spmd_striped")
+    impl = cls(M, N, K, dtype="float32")
+    assert impl.validate(impl.run())
+
+
+def test_auto_resolves_per_world(two_slices):
+    cls = load_impl_class("dp_allreduce", "jax_spmd_hier")
+    impl = cls(M, N, K, dtype="float32", composition="auto")
+    assert impl._resolved_composition() == "hierarchical"
+    assert impl.validate(impl.run())
+
+
+# -- guards -------------------------------------------------------------------
+
+
+def test_member_guards():
+    hier = load_impl_class("collectives", "jax_spmd_hier")
+    with pytest.raises(ValueError, match="single hop"):
+        hier(M, 1, K, dtype="float32", op="ppermute")
+    with pytest.raises(ValueError, match="transport axis"):
+        hier(M, 1, K, dtype="float32", op="all_reduce", transport="dcn")
+    striped = load_impl_class("collectives", "jax_spmd_striped")
+    with pytest.raises(ValueError, match="all_reduce only"):
+        striped(M, 1, K, dtype="float32", op="all_gather")
+    dp = load_impl_class("dp_allreduce", "jax_spmd_hier")
+    with pytest.raises(ValueError, match="scatter"):
+        dp(12, N, K, dtype="float32", composition="striped")
+
+
+# -- row stamp + closed-form wire bytes ---------------------------------------
+
+
+def test_composition_stamped_on_rows():
+    cls = load_impl_class("dp_allreduce", "jax_spmd_hier")
+    impl = cls(M, N, K, dtype="float32", composition="hierarchical")
+    assert impl.extra_row_fields()["composition"] == "hierarchical"
+    flat = cls(M, N, K, dtype="float32", composition="flat")
+    assert flat.extra_row_fields()["composition"] == "flat"
+
+
+def test_composition_column_registered():
+    # the row stamp is a schema-registered column (DDLB108 discipline):
+    # an undocumented CSV contract change must not ship
+    from ddlb_tpu.schema import ROW_COLUMNS
+
+    assert "composition" in ROW_COLUMNS
+    assert ROW_COLUMNS["composition"].strip()
+
+
+def test_ddlb123_census_two_true_stripes():
+    # d=16 factors to (dcn=4, sx=2, sy=2): BOTH torus axes alive, so the
+    # striped members trace two genuinely concurrent ring families —
+    # the canonical d=4 census only exercises the degenerate (1, 2)
+    # slice. Zero drift against the striped closed form, and the
+    # schedule export carries the stripe count the simulator splits on.
+    from ddlb_tpu.analysis.core import repo_root
+    from ddlb_tpu.analysis.spmd import families
+
+    registry = families.ClassRegistry(repo_root())
+    sizes = families._axis_sizes_for("collectives", 16)
+    assert (sizes["sx"], sizes["sy"]) == (2, 2)
+    for fam, member, shapes in [
+        ("collectives", "jax_spmd_striped",
+         {"m": 256, "n": 1, "k": 64, "d": 16}),
+        ("dp_allreduce", "jax_spmd_striped",
+         {"m": 256, "n": 64, "k": 64, "d": 16}),
+        ("ep_alltoall", "jax_spmd_striped",
+         {"m": 512, "n": 64, "k": 64, "d": 16}),
+        ("collectives", "jax_spmd_hier",
+         {"m": 256, "n": 1, "k": 64, "d": 16}),
+    ]:
+        report = families.trace_member(fam, member, {}, registry,
+                                       shapes=shapes)
+        assert report.status == "verified", (report.label(), report.reason)
+        sched = families.member_schedule(fam, member, registry=registry,
+                                         shapes=shapes)
+        assert sched["stripes"] == 2
+
+
+def test_wire_bytes_track_composition(two_slices):
+    d = 8
+    nbytes = M * N * 4  # full fp32 gradient
+    intra, inter = two_level_factors(d, 2)
+    cls = load_impl_class("dp_allreduce", "jax_spmd_hier")
+
+    hier = cls(M, N, K, dtype="float32", composition="hierarchical")
+    expect = hierarchical_wire_bytes("all_reduce", nbytes, intra, inter)
+    assert hier.wire_bytes() == pytest.approx(expect["ici"] + expect["dcn"])
+
+    striped = cls(M, N, K, dtype="float32", composition="striped")
+    sx, sy = torus_factors(intra)
+    expect = striped_wire_bytes("all_reduce", nbytes, inter, (sx, sy))
+    assert striped.wire_bytes() == pytest.approx(
+        expect["ici"] + expect["dcn"]
+    )
