@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci chaos-launch chaos-degrade overlap-report \
+	hooks ci calib-report chaos-launch chaos-degrade overlap-report \
 	serving-load-report sim-report sim-report-degrade skew-report clean
 
 test:
@@ -61,6 +61,7 @@ ci:
 	$(MAKE) sim-report-degrade
 	$(MAKE) sim-report-compare
 	$(MAKE) chaos-degrade
+	$(MAKE) calib-report
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -95,6 +96,15 @@ sim-report:
 # (docs/source/observability.rst "Cross-rank timeline")
 skew-report:
 	$(PYTHON) scripts/skew_demo.py
+
+# calibration-observatory acceptance: bank uncalibrated cpu-sim rounds,
+# fit the latency/overhead constants (IRLS-LAD), pass the calibrated
+# validation gate, stamp three calibrated rounds (drift gate silent),
+# then a seeded 2x-slower round must fire regress.detect_calibration
+# and exit calib_report.py nonzero — banked transcript at
+# docs/calib_demo.log (docs/source/simulator.rst "Calibration")
+calib-report:
+	$(PYTHON) scripts/calib_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

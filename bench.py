@@ -526,17 +526,23 @@ def _bank_headline(row: dict) -> None:
         print(f"[bench] history bank failed: {exc}", file=sys.stderr)
 
 
-def _history_baseline(row: dict):
-    """(median, mad, n) of ``roofline_frac`` over the observatory
-    history's previous bench captures of this metric/world — the robust
-    baseline layer of the regression gate. None when the history is
-    disabled, unreadable, or has fewer than 3 comparable captures (a
-    2-sample median is no steadier than the last-capture rule)."""
+def _history_baseline(
+    row: dict, column: str = "roofline_frac", cal_version=None
+):
+    """(median, mad, n) of ``column`` over the observatory history's
+    previous bench captures of this metric/world — the robust baseline
+    layer of the regression gate. For the calibrated column the
+    baseline is additionally fenced to captures priced against the SAME
+    calibration table (``cal_version``): residual fractions under
+    different fitted constants are not comparable. None when the
+    history is disabled, unreadable, or has fewer than 3 comparable
+    captures (a 2-sample median is no steadier than the last-capture
+    rule)."""
     try:
         from ddlb_tpu.observatory import regress, store
 
         fracs = [
-            float(r["row"]["roofline_frac"])
+            float(r["row"][column])
             for r in store.load_history()
             if r.get("kind") == "bench"
             and r["row"].get("metric") == row.get("metric")
@@ -546,8 +552,12 @@ def _history_baseline(row: dict):
             # _bank_headline recorded must never shape the baseline
             and bool(r["row"].get("valid"))
             and r["row"].get("platform", "tpu") == "tpu"
-            and isinstance(r["row"].get("roofline_frac"), (int, float))
-            and math.isfinite(r["row"]["roofline_frac"])
+            and isinstance(r["row"].get(column), (int, float))
+            and math.isfinite(r["row"][column])
+            and (
+                cal_version is None
+                or r["row"].get("cal_version", "") == cal_version
+            )
         ]
     except Exception:  # pragma: no cover - corrupt bank must not gate
         return None
@@ -568,11 +578,22 @@ def _check_roofline_regression(row: dict) -> None:
     captures — robust to one lucky/unlucky window — and the most recent
     cached capture otherwise. Soft by contract (annotate, warn, exit 0).
     """
-    frac = row.get("roofline_frac")
+    # a headline priced against a calibration table gates on the
+    # calibrated fraction — an absolute yardstick (≈1.0 when healthy)
+    # instead of an achieved share of a lower bound — with its baseline
+    # fenced to the same cal_version; uncalibrated captures keep the
+    # raw roofline_frac gate unchanged
+    column, cal_version = "roofline_frac", None
+    frac = row.get("roofline_frac_cal")
+    if isinstance(frac, (int, float)) and math.isfinite(frac):
+        column = "roofline_frac_cal"
+        cal_version = row.get("cal_version", "")
+    else:
+        frac = row.get("roofline_frac")
     if not isinstance(frac, (int, float)) or not math.isfinite(frac):
         return
     tol = _env_float("DDLB_TPU_BENCH_ROOFLINE_TOL", ROOFLINE_REGRESSION_TOL)
-    hist = _history_baseline(row)
+    hist = _history_baseline(row, column, cal_version)
     if hist is not None:
         baseline, mad, n = hist
         source = f"history median of {n} captures (MAD {mad:.4f})"
@@ -582,18 +603,22 @@ def _check_roofline_regression(row: dict) -> None:
             for e in _load_tpu_cache()
             if e.get("metric") == row.get("metric")
             and e.get("world_size") == row.get("world_size")
-            and isinstance(e.get("roofline_frac"), (int, float))
-            and math.isfinite(e["roofline_frac"])
+            and isinstance(e.get(column), (int, float))
+            and math.isfinite(e[column])
+            and (
+                cal_version is None
+                or e.get("cal_version", "") == cal_version
+            )
         ]
         if not prev:
             return
-        baseline = float(prev[-1]["roofline_frac"])
+        baseline = float(prev[-1][column])
         source = f"previous capture ({prev[-1].get('captured_at')})"
     if frac < baseline * (1.0 - tol):
         row["roofline_regression"] = True
-        row["roofline_frac_prev"] = baseline
+        row[f"{column}_prev"] = baseline
         print(
-            f"[bench] ROOFLINE REGRESSION: roofline_frac {frac:.4f} is "
+            f"[bench] ROOFLINE REGRESSION: {column} {frac:.4f} is "
             f">{tol:.0%} below the {source}'s {baseline:.4f}",
             file=sys.stderr,
         )
@@ -885,6 +910,24 @@ def _headline_result(emit=None) -> dict:
         headline["roofline_frac"] = round(frac, 4)
         headline["bound"] = row.get("bound", "")
         headline["chip"] = row.get("chip", "")
+    # the calibrated analogue (ISSUE 17): predicted_cal_s / measured —
+    # near 1.0 on a healthy fitted model, dropping when the hardware
+    # slows against it. Only present when the row was priced against a
+    # calibration table (DDLB_TPU_CALIB), so uncalibrated headlines are
+    # byte-identical; cal_version rides along so baselines never mix
+    # across refits
+    pcal = row.get("predicted_cal_s")
+    med_ms = row.get("median time (ms)")
+    if (
+        isinstance(pcal, float)
+        and math.isfinite(pcal)
+        and pcal > 0.0
+        and isinstance(med_ms, (int, float))
+        and math.isfinite(med_ms)
+        and med_ms > 0.0
+    ):
+        headline["roofline_frac_cal"] = round(pcal / (med_ms * 1e-3), 4)
+        headline["cal_version"] = row.get("cal_version", "")
     # The validated primary stage goes out FIRST — the caller banks it
     # (printed line / pool partial), so if the sidecar below dies
     # non-pythonically (device halt, OOM kill) the already-measured

@@ -83,11 +83,19 @@ PERF_ROW_DEFAULTS: Dict[str, Any] = {
     "roofline_frac": float("nan"),
     "bound": "",
     "chip": "",
+    # the calibrated-prediction trio (ISSUE 17): stamped only when a
+    # calibration table covers the chip; these defaults otherwise, so
+    # an uncalibrated sweep's rows are byte-identical to pre-calib ones
+    "predicted_cal_s": float("nan"),
+    "cal_residual_frac": float("nan"),
+    "cal_version": "",
     **overlap_attribution.ATTRIBUTION_ROW_DEFAULTS,
 }
 
 
-def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
+def _perfmodel_fields(
+    impl, times_ms: np.ndarray, backend: str = "host_clock"
+) -> Dict[str, Any]:
     """The perfmodel columns for one row: the impl's ``cost_model()``
     verdict plus ``roofline_frac`` against the measured MEDIAN (the
     jitter-robust statistic the headline bench also pins), and the
@@ -108,7 +116,7 @@ def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
         return {}
     finite = times_ms[np.isfinite(times_ms)]
     measured_s = float(np.median(finite)) * 1e-3 if finite.size else float("nan")
-    return {
+    fields = {
         "predicted_s": est.predicted_s,
         "roofline_frac": est.roofline_frac(measured_s),
         "bound": est.bound,
@@ -120,6 +128,21 @@ def _perfmodel_fields(impl, times_ms: np.ndarray) -> Dict[str, Any]:
             chunks=perfmodel_cost.overlap_chunks(impl),
         ),
     }
+    # the calibrated trio (ISSUE 17): priced per (chip, timing backend)
+    # from the DDLB_TPU_CALIB table; absent table/group leaves the
+    # PERF_ROW_DEFAULTS in place — the uncalibrated row is untouched
+    try:
+        cal = perfmodel_cost.calibrated_estimate(impl, backend=backend)
+    except Exception as exc:
+        telemetry.warn(
+            f"calibrated estimate failed: {type(exc).__name__}: {exc}"
+        )
+        cal = None
+    if cal is not None:
+        fields["predicted_cal_s"] = cal.predicted_cal_s
+        fields["cal_residual_frac"] = cal.residual_frac(measured_s)
+        fields["cal_version"] = cal.version
+    return fields
 
 
 # ---------------------------------------------------------------------------
@@ -359,7 +382,7 @@ def benchmark_worker(config: Dict[str, Any]) -> Dict[str, Any]:
         # impl — including error rows (the prediction is shape-only, so a
         # timing/validation crash still gets predicted_s and bound; only
         # roofline_frac needs the measurement and degrades to NaN)
-        perf=_perfmodel_fields(impl, times_ms),
+        perf=_perfmodel_fields(impl, times_ms, backend=timing_backend),
         # the cross-rank skew columns (ISSUE 14): arrival-skew seconds,
         # exit spread, the straggler rank and its waited-on share, with
         # the clock-alignment uncertainty bound alongside; defaults on

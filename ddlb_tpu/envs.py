@@ -197,6 +197,23 @@ def get_history_dir() -> str:
     return os.environ.get("DDLB_TPU_HISTORY", "").strip()
 
 
+def get_calib_path() -> str:
+    """Calibration-table JSON path ("" = uncalibrated).
+
+    When set, the prediction stack loads the versioned calibration table
+    (``ddlb_tpu.perfmodel.calib``) fitted from banked observatory
+    history: ``cost.calibrated_estimate`` prices per-hop latency /
+    per-step software overhead / per-row dispatch constants on top of
+    the bandwidth lower bound, the simulator's replay adds the same
+    terms per step, and every runner row is stamped with
+    ``predicted_cal_s`` / ``cal_residual_frac`` / ``cal_version``.
+    Unset keeps every prediction the raw analytical bound and the three
+    columns at their defaults — byte-identical rows. Follows the
+    DDLB_TPU_* convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_CALIB", "").strip()
+
+
 def get_live_path() -> str:
     """Live sweep-stream file ("" = stream disabled).
 

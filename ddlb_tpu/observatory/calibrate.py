@@ -1,0 +1,93 @@
+"""Fit the calibration table from the banked observatory history.
+
+The bank (``store.py``) holds thousands of keyed rows with both the
+analytical lower bound (``predicted_s``) and the measured median —
+a calibration dataset, not just a regression baseline. This driver
+streams it per ``(chip, time_measurement_backend)`` group (limp-mode
+``world_degraded`` rows and arrival-horizon families are filtered by
+``calib.row_features``), runs the robust fitter, and persists the
+versioned table the whole prediction stack prices from
+(``DDLB_TPU_CALIB``).
+
+Split from ``perfmodel.calib`` on the same line the store draws:
+``calib`` is the pure model (features, fitter, table), this module is
+the observatory glue (bank streaming, git_rev/banked_at stamping,
+persistence).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ddlb_tpu.observatory import store
+from ddlb_tpu.perfmodel import calib
+
+
+def collect_samples(
+    directory: Optional[str] = None,
+    records: Optional[Iterable[Dict[str, Any]]] = None,
+    *,
+    chip: Optional[str] = None,
+    family: Optional[str] = None,
+) -> Dict[Tuple[str, str], List[Dict[str, Any]]]:
+    """Fit samples grouped per (chip, backend), streamed from the bank.
+
+    ``records`` overrides the bank read (tests hand synthetic
+    histories straight in); otherwise ``store.iter_history`` streams
+    ``kind="row"`` records under the optional chip/family predicates.
+    Rows ``calib.row_features`` rejects (errors, degraded worlds,
+    serving families, unmeasured) are dropped here.
+    """
+    if records is None:
+        records = store.iter_history(directory, kind="row", chip=chip, family=family)
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for record in records:
+        row = record.get("row") if isinstance(record, dict) else None
+        if not isinstance(row, dict):
+            continue
+        features = calib.row_features(row)
+        if features is None:
+            continue
+        row_chip = str(row.get("chip") or "")
+        if not row_chip:
+            continue
+        backend = str(row.get("time_measurement_backend") or "")
+        groups.setdefault((row_chip, backend), []).append(features)
+    return groups
+
+
+def calibrate_history(
+    directory: Optional[str] = None,
+    records: Optional[Iterable[Dict[str, Any]]] = None,
+    *,
+    chip: Optional[str] = None,
+    family: Optional[str] = None,
+    min_rows: int = calib.MIN_ROWS,
+) -> Optional[calib.CalibrationTable]:
+    """Fit every (chip, backend) group the bank can support.
+
+    Groups too thin for a trustworthy fit are skipped (the fitter
+    returns None below ``min_rows``); the table carries only groups
+    that fit. None when nothing fit — an empty table must not be
+    mistaken for a calibrated world.
+    """
+    groups = collect_samples(directory, records, chip=chip, family=family)
+    fitted: Dict[Tuple[str, str], calib.GroupCalibration] = {}
+    for (group_chip, backend), samples in sorted(groups.items()):
+        fit = calib.fit_group(
+            samples, chip=group_chip, backend=backend, min_rows=min_rows
+        )
+        if fit is not None:
+            fitted[(group_chip, backend)] = fit
+    if not fitted:
+        return None
+    return calib.make_table(
+        fitted, git_rev=store.git_rev(), banked_at=time.time()
+    )
+
+
+def write_table(table: calib.CalibrationTable, path: str) -> str:
+    """Persist a fitted table where ``DDLB_TPU_CALIB`` can point."""
+    calib.save_table(table, path)
+    return path

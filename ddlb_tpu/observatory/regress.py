@@ -30,8 +30,10 @@ column per key (``detect_slo``), with per-metric direction (goodput
 regresses DOWN), and every ``SKEW_METRICS`` column (``detect_skew``,
 ISSUE 14 — a straggler rank that the timing MAX-reduce hides, gated
 with absolute noise floors because the skew columns live near zero on
-clean runs). ``detect_all`` merges all three gates into one ranked
-report.
+clean runs), and every ``CAL_METRICS`` column (``detect_calibration``,
+ISSUE 17 — residual drift off the fitted calibration model, baselines
+fenced per ``cal_version``). ``detect_all`` merges every gate into one
+ranked report.
 
 Consumed by ``scripts/observatory_report.py`` and
 ``scripts/serving_load_report.py`` (the CLIs) and by ``bench.py``'s
@@ -78,6 +80,18 @@ SLO_METRICS = (
 SKEW_METRICS = (
     ("straggler_frac", "high", 0.02, 0.20),
     ("skew_enter_s", "high", 0.005, 0.10),
+)
+
+#: calibration-drift metric gated per key (ISSUE 17): same
+#: ``(metric, direction, abs_floor, abs_excess)`` shape as the skew
+#: set. ``cal_residual_frac`` sits near zero on a freshly-fitted model,
+#: so the MAD scale is floored at 0.02 and a finding must clear an
+#: absolute +0.10 residual excess — a run 10% slower than the fitted
+#: model beyond baseline noise. Direction-aware: only drift toward
+#: SLOWER gates ("high"); a run faster than the model is a refit hint,
+#: not an alarm (the report shows it, the gate stays quiet).
+CAL_METRICS = (
+    ("cal_residual_frac", "high", 0.02, 0.10),
 )
 
 
@@ -183,9 +197,17 @@ def detect(
             if finding is not None:
                 findings.append(finding)
             continue
-        # perfmodel prior: no history for this key — the analytical
-        # lower bound is the only baseline available
-        predicted_s = finite(row.get("predicted_s"))
+        # perfmodel prior: no history for this key. The calibrated
+        # prediction (ISSUE 17) is the preferred baseline when the row
+        # was priced against a table — it tracks absolute makespans, so
+        # PRIOR_FACTOR over it is a far tighter net than over the raw
+        # bound; rows stamped uncalibrated (NaN) fall back to the
+        # analytical lower bound, behavior unchanged.
+        prior = "calibrated"
+        predicted_s = finite(row.get("predicted_cal_s"))
+        if predicted_s is None or predicted_s <= 0.0:
+            prior = "analytical"
+            predicted_s = finite(row.get("predicted_s"))
         if predicted_s is None or predicted_s <= 0.0:
             continue
         predicted_ms = predicted_s * 1e3
@@ -197,6 +219,7 @@ def detect(
                     "key": key,
                     "metric": metric,
                     "source": "perfmodel_prior",
+                    "prior": prior,
                     "measured_ms": measured,
                     "baseline_ms": predicted_ms,
                     "ratio": ratio,
@@ -423,6 +446,60 @@ def detect_skew(
     return kept
 
 
+def detect_calibration(
+    current_rows: List[Dict[str, Any]],
+    history: List[Dict[str, Any]],
+    metrics=CAL_METRICS,
+    exclude_run: Optional[str] = None,
+    z_tol: float = Z_TOL,
+    min_excess: float = MIN_EXCESS,
+    rel_floor: float = REL_FLOOR,
+) -> List[Dict[str, Any]]:
+    """Calibration-drift findings (ISSUE 17): ``cal_residual_frac``
+    gated per key against its own history baseline — a run whose
+    measured medians drift off the fitted latency/overhead model is a
+    model-validity alarm even when no single key regresses against raw
+    history (a uniform +overhead shift moves EVERY residual but may
+    stay inside each key's time-metric noise).
+
+    Residual baselines are only comparable under the SAME fitted
+    constants, so history is fenced to records stamped with one of the
+    current rows' ``cal_version`` values — after a refit the gate
+    starts a fresh baseline instead of alarming against residuals of a
+    model that no longer exists. Rows without a finite residual (every
+    uncalibrated row) contribute nothing; with no calibrated rows at
+    all this is a no-op, keeping ``detect_all`` unchanged for
+    uncalibrated worlds. Each finding carries ``cal_version``.
+    """
+    versions = {
+        str(row.get("cal_version") or "")
+        for row in current_rows
+        if finite(row.get("cal_residual_frac")) is not None
+    }
+    versions.discard("")
+    if not versions:
+        return []
+    fenced = [
+        rec
+        for rec in history
+        if str((rec.get("row") or {}).get("cal_version") or "") in versions
+    ]
+
+    def _stamp_version(finding, row):
+        finding["cal_version"] = row.get("cal_version")
+
+    return _detect_metrics(
+        current_rows,
+        fenced,
+        metrics,
+        exclude_run,
+        z_tol,
+        min_excess,
+        rel_floor,
+        decorate=_stamp_version,
+    )
+
+
 def detect_health(
     current_rows: List[Dict[str, Any]],
     history: List[Dict[str, Any]],
@@ -509,11 +586,12 @@ def detect_all(
 ) -> List[Dict[str, Any]]:
     """The full gate: the default time metric (``detect``, perfmodel
     prior included) PLUS every SLO metric (``detect_slo``) PLUS the
-    cross-rank skew metrics (``detect_skew``) PLUS the
-    persistent-straggler health verdict (``detect_health``), re-ranked
-    as one list so a serving SLO blow-up, a straggler regression or a
-    hardware indictment competes with — and can outrank — a kernel-time
-    regression in the same report."""
+    cross-rank skew metrics (``detect_skew``) PLUS the calibration
+    drift gate (``detect_calibration``) PLUS the persistent-straggler
+    health verdict (``detect_health``), re-ranked as one list so a
+    serving SLO blow-up, a straggler regression, a model-drift alarm or
+    a hardware indictment competes with — and can outrank — a
+    kernel-time regression in the same report."""
     return _rank(
         detect_health(
             current_rows,
@@ -538,6 +616,14 @@ def detect_all(
             rel_floor=rel_floor,
         )
         + detect_skew(
+            current_rows,
+            history,
+            exclude_run=exclude_run,
+            z_tol=z_tol,
+            min_excess=min_excess,
+            rel_floor=rel_floor,
+        )
+        + detect_calibration(
             current_rows,
             history,
             exclude_run=exclude_run,

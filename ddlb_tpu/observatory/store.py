@@ -36,7 +36,16 @@ import json
 import os
 import subprocess
 import time
-from typing import Any, Dict, List, Optional
+from typing import (
+    Any,
+    Callable,
+    Collection,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Union,
+)
 
 from ddlb_tpu import envs, telemetry
 
@@ -179,3 +188,65 @@ def load_history(directory: Optional[str] = None) -> List[Dict[str, Any]]:
             ):
                 records.append(record)
     return records
+
+
+def _matches(value: Any, want: Union[None, str, Collection[str]]) -> bool:
+    if want is None:
+        return True
+    if isinstance(want, str):
+        return value == want
+    return value in want
+
+
+def iter_history(
+    directory: Optional[str] = None,
+    *,
+    kind: Optional[str] = "row",
+    chip: Union[None, str, Collection[str]] = None,
+    family: Union[None, str, Collection[str]] = None,
+    impl: Union[None, str, Collection[str]] = None,
+    predicate: Optional[Callable[[Dict[str, Any]], bool]] = None,
+) -> Iterator[Dict[str, Any]]:
+    """Stream banked records oldest-first under key-column predicates.
+
+    The calibration fitter reads the whole bank but fits one
+    ``(chip, backend)`` group at a time; this is the streaming form of
+    ``load_history`` that never materializes the full bank. Filters:
+    ``kind`` (None = every kind), and ``chip`` / ``family`` / ``impl``
+    each accepting one string or any collection of strings, matched
+    against the row's ``chip`` / ``primitive`` / ``base_implementation``
+    columns; ``predicate(record)`` for anything else. Same tolerance
+    contract as ``load_history``: a torn tail (a process killed
+    mid-append leaves a truncated last line) or any other corrupt line
+    is skipped, and rows from older or newer schemas pass through —
+    filters only read the columns they name, unknown columns ride
+    along untouched.
+    """
+    path = history_path(directory)
+    if path is None or not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if not (
+                isinstance(record, dict) and isinstance(record.get("row"), dict)
+            ):
+                continue
+            if kind is not None and record.get("kind", "row") != kind:
+                continue
+            row = record["row"]
+            if not _matches(row.get("chip"), chip):
+                continue
+            if not _matches(row.get("primitive"), family):
+                continue
+            if not _matches(row.get("base_implementation"), impl):
+                continue
+            if predicate is not None and not predicate(record):
+                continue
+            yield record

@@ -1,0 +1,634 @@
+"""Calibration constants fitted from banked observatory history.
+
+The analytical model (``cost.py``) and the static simulator replay pure
+bandwidth/FLOP lower bounds — validation gate 2 only proves they *lower
+bound* measured medians. Collective-performance practice (The Big
+Send-off, arxiv 2504.18658; HiCCL, arxiv 2408.05962) models a collective
+as bandwidth + per-hop latency + software overhead; those two extra
+terms are exactly what the banked history's (predicted, measured) pairs
+can fit. Per ``(chip, time_measurement_backend)`` group this module
+fits three constants by iteratively-reweighted least-absolute-deviation
+(robust to the outlier rows every shared host banks):
+
+- ``dispatch_s``  — fixed per-row overhead (dispatch, sync, timer);
+- ``step_s``      — software overhead per schedule step (every
+  ComputeStep AND every WireStep the engine replays);
+- ``hop_s[link]`` — per-hop latency per link class (``ici`` / ``dcn``).
+
+The residual model per banked row is linear in the constants::
+
+    measured_s - predicted_s = dispatch_s + step_s * steps
+                               + sum_c hop_s[c] * hops[c]
+
+where the step/hop census mirrors ``frontends.program_from_impl``
+exactly (one shared ``schedule_census``), so the fitted constants price
+engine replays and the closed-form ``cost.calibrated_estimate`` to the
+same numbers by construction. Everything here is stdlib-only and
+deterministic — no randomness, fixed iteration cap, tiny ridge so even
+collinear designs (wire-only groups where steps == hops) solve to one
+answer; predictions only ever use ``step_s + hop_s`` summed, so that
+split is never load-bearing.
+
+Tables persist as versioned JSON (``DDLB_TPU_CALIB`` via ``envs.py``)
+with fit metadata: row/key counts, residual MAD, git_rev, banked_at.
+With no table every consumer returns None / adds zero — the
+uncalibrated path is byte-identical.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+from .cost import canonical_op, ring_step_count
+
+#: link classes the fitter distinguishes; engine WireStep scopes map
+#: onto them via scope_link_class (dcn -> dcn, everything else -- ici0,
+#: ici1, flat -- is an intra-slice ici hop).
+LINK_CLASSES: Tuple[str, ...] = ("ici", "dcn")
+
+#: table format version (the file layout, not the fit identity — that
+#: is the sha-fingerprinted ``version`` string).
+TABLE_FORMAT = 1
+
+#: minimum rows per (chip, backend) group before a fit is trusted.
+MIN_ROWS = 8
+
+#: families whose measured time is not a schedule replay (arrival
+#: horizons, open-loop drains) — their residuals would poison the fit.
+FIT_FAMILY_EXCLUDE: Tuple[str, ...] = ("serving_load",)
+
+
+def scope_link_class(scope: str) -> str:
+    """Map an engine WireStep resource scope to a fit link class."""
+    return "dcn" if str(scope) == "dcn" else "ici"
+
+
+def family_op(family: str, options: Optional[Mapping[str, object]] = None) -> str:
+    """The ring collective a family's members run (census vocabulary).
+
+    The collectives family carries its op as an option; every other
+    family's op is pinned by ``frontends.FAMILY_COLLECTIVES`` (imported
+    lazily — frontends imports cost at module level, so the reverse
+    edge must stay function-local). Families with no collective
+    (compute-only) fall back to ppermute; their census has zero wire
+    steps so the choice is inert.
+    """
+    from ddlb_tpu.simulator.frontends import FAMILY_COLLECTIVES
+
+    if family == "collectives":
+        op = str((options or {}).get("op", "all_reduce"))
+    else:
+        op = FAMILY_COLLECTIVES.get(str(family), "ppermute")
+    return canonical_op(op)
+
+
+def schedule_census(
+    op: str,
+    d: int,
+    *,
+    has_compute: bool,
+    has_wire: bool,
+    chunks: Optional[int] = None,
+    link_class: str = "ici",
+) -> Dict[str, object]:
+    """Step/hop counts of the schedule ``program_from_impl`` would build.
+
+    Mirrors the frontend exactly: ``count = max(1, ring_step_count(op,
+    d))`` WireSteps when the wire term is non-zero (else 0), one
+    ComputeStep per chunk when the compute term is non-zero, and the
+    chunked engine repeats both per chunk. One hop per WireStep. Used
+    by both the fitter (features from banked row columns) and
+    ``cost.calibrated_estimate`` (features from a live impl) so the two
+    agree by construction.
+    """
+    d = max(1, int(d))
+    repeat = max(1, int(chunks)) if chunks else 1
+    count = max(1, ring_step_count(canonical_op(op), d)) if has_wire else 0
+    wire_steps = count * repeat
+    compute_steps = repeat if has_compute else 0
+    hops = {cls: 0 for cls in LINK_CLASSES}
+    if wire_steps:
+        hops[link_class if link_class in hops else "ici"] = wire_steps
+    return {
+        "wire_steps": wire_steps,
+        "compute_steps": compute_steps,
+        "steps": wire_steps + compute_steps,
+        "hops": hops,
+    }
+
+
+# ---------------------------------------------------------------------------
+# row features: banked history row -> fit sample
+# ---------------------------------------------------------------------------
+
+
+def _fnum(value: object) -> Optional[float]:
+    try:
+        out = float(value)  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        return None
+    return out if out == out and out not in (float("inf"), float("-inf")) else None
+
+
+def _truthy(value: object) -> bool:
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+def _parse_options(option: object) -> Dict[str, object]:
+    """Minimal ';'-joined ``k=v`` option-string parser with scalar
+    inference — restated from ``validate.parse_option_string`` so the
+    perfmodel tier does not import the simulator at module level (the
+    same restatement precedent validate itself sets against the CLI).
+    """
+    out: Dict[str, object] = {}
+    for part in str(option or "").split(";"):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        key, _, raw = part.partition("=")
+        raw = raw.strip()
+        value: object = raw
+        low = raw.lower()
+        if low in ("true", "false"):
+            value = low == "true"
+        else:
+            try:
+                value = int(raw)
+            except ValueError:
+                try:
+                    value = float(raw)
+                except ValueError:
+                    value = raw
+        out[key.strip()] = value
+    return out
+
+
+def row_features(row: Mapping[str, object]) -> Optional[Dict[str, object]]:
+    """Fit sample from one banked history row; None when ineligible.
+
+    Eligible rows are clean measurements on full worlds: no error, not
+    quarantined, not world-degraded (limp-mode constants are a
+    different machine), finite positive measured median, finite
+    analytical prediction, and a family whose measured time is a
+    schedule replay. The step/hop census is derived from columns every
+    measured row already carries (the attribution phases say whether
+    compute/wire terms exist; the option string carries transport and
+    chunking).
+    """
+    if str(row.get("error") or "").strip():
+        return None
+    if _truthy(row.get("quarantined")) or _truthy(row.get("world_degraded")):
+        return None
+    family = str(row.get("primitive") or "")
+    if not family or family in FIT_FAMILY_EXCLUDE:
+        return None
+    measured_ms = _fnum(row.get("median time (ms)"))
+    predicted = _fnum(row.get("predicted_s"))
+    if measured_ms is None or measured_ms <= 0.0:
+        return None
+    if predicted is None or predicted < 0.0:
+        return None
+    d_raw = _fnum(row.get("world_size"))
+    if d_raw is None or d_raw < 1:
+        return None
+    d = int(d_raw)
+    options = _parse_options(row.get("option"))
+    transport = str(options.get("transport", "ici"))
+    link_class = scope_link_class(transport)
+    has_compute = (_fnum(row.get("phase_compute_s")) or 0.0) > 0.0
+    has_wire = (_fnum(row.get("phase_comm_s")) or 0.0) > 0.0
+    chunks: Optional[int] = None
+    if str(options.get("algorithm", "")) == "chunked":
+        chunk_count = _fnum(options.get("chunk_count"))
+        if chunk_count and chunk_count >= 1:
+            chunks = int(chunk_count)
+    try:
+        census = schedule_census(
+            family_op(family, options),
+            d,
+            has_compute=has_compute,
+            has_wire=has_wire,
+            chunks=chunks,
+            link_class=link_class,
+        )
+    except (KeyError, ValueError):
+        return None
+    measured = measured_ms * 1e-3
+    return {
+        "measured_s": measured,
+        "predicted_s": predicted,
+        "residual_s": measured - predicted,
+        "steps": census["steps"],
+        "hops": census["hops"],
+        "key": "|".join(
+            str(row.get(col, ""))
+            for col in ("primitive", "base_implementation", "option",
+                        "m", "n", "k", "dtype", "world_size")
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the fitted table
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GroupCalibration:
+    """Fitted constants + fit metadata for one (chip, backend) group."""
+
+    chip: str
+    backend: str
+    dispatch_s: float
+    step_s: float
+    hop_s: Dict[str, float] = field(default_factory=dict)
+    rows: int = 0
+    keys: int = 0
+    residual_mad_s: float = 0.0
+    residual_mad_frac: float = 0.0
+    iterations: int = 0
+    converged: bool = True
+
+    def compute_overhead_s(self) -> float:
+        """Additive overhead per ComputeStep."""
+        return self.step_s
+
+    def wire_overhead_s(self, link_class: str = "ici") -> float:
+        """Additive overhead per WireStep of ``link_class`` (step
+        software overhead + one hop of link latency)."""
+        return self.step_s + float(self.hop_s.get(link_class, self.hop_s.get("ici", 0.0)))
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "chip": self.chip,
+            "backend": self.backend,
+            "dispatch_s": self.dispatch_s,
+            "step_s": self.step_s,
+            "hop_s": dict(self.hop_s),
+            "rows": self.rows,
+            "keys": self.keys,
+            "residual_mad_s": self.residual_mad_s,
+            "residual_mad_frac": self.residual_mad_frac,
+            "iterations": self.iterations,
+            "converged": self.converged,
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, object]) -> "GroupCalibration":
+        return cls(
+            chip=str(data.get("chip", "")),
+            backend=str(data.get("backend", "")),
+            dispatch_s=float(data.get("dispatch_s", 0.0)),  # type: ignore[arg-type]
+            step_s=float(data.get("step_s", 0.0)),  # type: ignore[arg-type]
+            hop_s={str(k): float(v) for k, v in dict(data.get("hop_s") or {}).items()},  # type: ignore[arg-type]
+            rows=int(data.get("rows", 0)),  # type: ignore[arg-type]
+            keys=int(data.get("keys", 0)),  # type: ignore[arg-type]
+            residual_mad_s=float(data.get("residual_mad_s", 0.0)),  # type: ignore[arg-type]
+            residual_mad_frac=float(data.get("residual_mad_frac", 0.0)),  # type: ignore[arg-type]
+            iterations=int(data.get("iterations", 0)),  # type: ignore[arg-type]
+            converged=bool(data.get("converged", True)),
+        )
+
+
+@dataclass(frozen=True)
+class CalibrationTable:
+    """Versioned set of per-(chip, backend) fitted constants."""
+
+    version: str
+    git_rev: str = ""
+    banked_at: float = 0.0
+    groups: Dict[Tuple[str, str], GroupCalibration] = field(default_factory=dict)
+
+    def group(
+        self, chip: str, backend: Optional[str] = None
+    ) -> Optional[GroupCalibration]:
+        """Deterministic group lookup: exact (chip, backend) first,
+        then the chip's host_clock fit, then the chip's first group in
+        sorted backend order. None when the chip was never fitted."""
+        chip = str(chip or "")
+        if backend:
+            exact = self.groups.get((chip, str(backend)))
+            if exact is not None:
+                return exact
+        fallback = self.groups.get((chip, "host_clock"))
+        if fallback is not None:
+            return fallback
+        for key in sorted(self.groups):
+            if key[0] == chip:
+                return self.groups[key]
+        return None
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "format": TABLE_FORMAT,
+            "version": self.version,
+            "git_rev": self.git_rev,
+            "banked_at": self.banked_at,
+            "groups": {
+                f"{chip}|{backend}": group.to_json()
+                for (chip, backend), group in sorted(self.groups.items())
+            },
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, object]) -> "CalibrationTable":
+        groups: Dict[Tuple[str, str], GroupCalibration] = {}
+        for raw in dict(data.get("groups") or {}).values():
+            group = GroupCalibration.from_json(raw)
+            groups[(group.chip, group.backend)] = group
+        return cls(
+            version=str(data.get("version", "")),
+            git_rev=str(data.get("git_rev", "")),
+            banked_at=float(data.get("banked_at", 0.0)),  # type: ignore[arg-type]
+            groups=groups,
+        )
+
+
+def table_version(groups: Mapping[Tuple[str, str], GroupCalibration]) -> str:
+    """Content fingerprint of the fitted constants — two tables with the
+    same constants gate against each other's residual baselines; any
+    refit that moves a constant changes the version and fences the
+    drift gate's history off."""
+    canonical = json.dumps(
+        {
+            f"{chip}|{backend}": {
+                "dispatch_s": round(group.dispatch_s, 12),
+                "step_s": round(group.step_s, 12),
+                "hop_s": {k: round(v, 12) for k, v in sorted(group.hop_s.items())},
+                "rows": group.rows,
+            }
+            for (chip, backend), group in sorted(groups.items())
+        },
+        sort_keys=True,
+    )
+    return "v1-" + hashlib.sha256(canonical.encode()).hexdigest()[:10]
+
+
+def make_table(
+    groups: Mapping[Tuple[str, str], GroupCalibration],
+    *,
+    git_rev: str = "",
+    banked_at: float = 0.0,
+) -> CalibrationTable:
+    return CalibrationTable(
+        version=table_version(groups),
+        git_rev=git_rev,
+        banked_at=banked_at,
+        groups=dict(groups),
+    )
+
+
+def save_table(table: CalibrationTable, path: str) -> None:
+    """Atomic write (tmp + rename) so readers never see a torn table."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as handle:
+        json.dump(table.to_json(), handle, indent=2, sort_keys=True)
+        handle.write("\n")
+    os.replace(tmp, path)
+
+
+def load_table(path: str) -> Optional[CalibrationTable]:
+    """Load a table from ``path``; None when missing/corrupt (warned
+    once — a broken table must not take the sweep down)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            data = json.load(handle)
+        if not isinstance(data, dict) or not data.get("groups"):
+            raise ValueError("not a calibration table")
+        return CalibrationTable.from_json(data)
+    except (OSError, ValueError) as exc:
+        _warn_once(path, f"calibration table unreadable at {path}: {exc}")
+        return None
+
+
+_WARNED_PATHS: set = set()
+
+
+def _warn_once(path: str, message: str) -> None:
+    if path in _WARNED_PATHS:
+        return
+    _WARNED_PATHS.add(path)
+    from ddlb_tpu.telemetry.logger import warn
+
+    warn(message)
+
+
+_TABLE_CACHE: Dict[str, object] = {}
+
+
+def get_table() -> Optional[CalibrationTable]:
+    """The env-selected table (``DDLB_TPU_CALIB``), cached by (path,
+    mtime) so the per-row stamping path stays one stat() when
+    calibrated and one env read when not."""
+    from ddlb_tpu import envs
+
+    path = envs.get_calib_path()
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        _warn_once(path, f"DDLB_TPU_CALIB points at a missing file: {path}")
+        return None
+    if _TABLE_CACHE.get("path") == path and _TABLE_CACHE.get("mtime") == mtime:
+        return _TABLE_CACHE.get("table")  # type: ignore[return-value]
+    table = load_table(path)
+    _TABLE_CACHE.update(path=path, mtime=mtime, table=table)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# the IRLS-LAD fitter
+# ---------------------------------------------------------------------------
+
+
+def _median(values: Sequence[float]) -> float:
+    ordered = sorted(values)
+    n = len(ordered)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return ordered[mid] if n % 2 else 0.5 * (ordered[mid - 1] + ordered[mid])
+
+
+def _solve(matrix: List[List[float]], rhs: List[float]) -> Optional[List[float]]:
+    """Gaussian elimination with partial pivoting; None when singular
+    beyond what the ridge already regularized."""
+    n = len(rhs)
+    a = [row[:] + [rhs[i]] for i, row in enumerate(matrix)]
+    for col in range(n):
+        pivot = max(range(col, n), key=lambda r: abs(a[r][col]))
+        if abs(a[pivot][col]) < 1e-300:
+            return None
+        a[col], a[pivot] = a[pivot], a[col]
+        for row in range(col + 1, n):
+            factor = a[row][col] / a[col][col]
+            if factor:
+                for j in range(col, n + 1):
+                    a[row][j] -= factor * a[col][j]
+    out = [0.0] * n
+    for row in range(n - 1, -1, -1):
+        acc = a[row][n] - sum(a[row][j] * out[j] for j in range(row + 1, n))
+        out[row] = acc / a[row][row]
+    return out
+
+
+def _wls(
+    design: Sequence[Sequence[float]],
+    target: Sequence[float],
+    weights: Sequence[float],
+) -> Optional[List[float]]:
+    """Weighted least squares via the normal equations with a tiny
+    relative ridge — deterministic even on collinear designs."""
+    p = len(design[0])
+    ata = [[0.0] * p for _ in range(p)]
+    atb = [0.0] * p
+    for row, y, w in zip(design, target, weights):
+        for j in range(p):
+            wx = w * row[j]
+            atb[j] += wx * y
+            for l in range(j, p):
+                ata[j][l] += wx * row[l]
+    for j in range(p):
+        for l in range(j):
+            ata[j][l] = ata[l][j]
+    ridge = 1e-9 * max(max(ata[j][j] for j in range(p)), 1e-30)
+    for j in range(p):
+        ata[j][j] += ridge
+    return _solve(ata, atb)
+
+
+def fit_group(
+    samples: Iterable[Mapping[str, object]],
+    *,
+    chip: str = "",
+    backend: str = "",
+    min_rows: int = MIN_ROWS,
+    max_iter: int = 50,
+) -> Optional[GroupCalibration]:
+    """IRLS least-absolute-deviation fit of one group's constants.
+
+    Design columns: intercept (dispatch), total step count, per-class
+    hop counts (classes absent from every sample are dropped). LAD via
+    iteratively-reweighted least squares — weights ``1/max(|r|, eps)``
+    — is robust to the handful of grossly-slow rows shared CI hosts
+    bank. Fully deterministic: fixed starting point (unweighted LSQ),
+    fixed iteration cap, no randomness.
+
+    Non-negativity is enforced by ACTIVE SET, not a naive end clamp:
+    steps and hops are near-collinear (one hop per wire step), so the
+    unconstrained optimum can split into a huge +step_s canceled by a
+    negative hop_s — clamping the negative half without refitting
+    would leave the positive half grossly overshooting. Instead the
+    most negative constant is pinned to zero and the remaining columns
+    refit, until every constant is >= 0 (gate 1's zero-when-
+    uncalibrated contract needs non-negative additions). None when the
+    group is too thin to trust.
+    """
+    rows = [s for s in samples if _fnum(s.get("residual_s")) is not None]
+    classes = sorted(
+        {
+            cls
+            for s in rows
+            for cls, hops in dict(s.get("hops") or {}).items()
+            if hops
+        }
+    )
+    width = 2 + len(classes)
+    if len(rows) < max(min_rows, 2 * width):
+        return None
+    full = [
+        [1.0, float(s.get("steps") or 0.0)]
+        + [float(dict(s.get("hops") or {}).get(cls, 0.0)) for cls in classes]
+        for s in rows
+    ]
+    target = [float(s["residual_s"]) for s in rows]
+    eps = max(1e-12, 1e-6 * _median([abs(y) for y in target]))
+
+    def _irls(design):
+        theta = _wls(design, target, [1.0] * len(rows))
+        if theta is None:
+            return None
+        iterations = 0
+        converged = False
+        for iterations in range(1, max_iter + 1):
+            resid = [
+                y - sum(x * t for x, t in zip(row, theta))
+                for row, y in zip(design, target)
+            ]
+            weights = [1.0 / max(abs(r), eps) for r in resid]
+            update = _wls(design, target, weights)
+            if update is None:
+                break
+            delta = max(abs(a - b) for a, b in zip(update, theta))
+            theta = update
+            if delta <= 1e-12 + 1e-9 * max(abs(t) for t in theta):
+                converged = True
+                break
+        return theta, iterations, converged
+
+    active = list(range(width))
+    theta = [0.0] * width
+    iterations = 0
+    converged = True
+    while active:
+        fitted = _irls([[row[j] for j in active] for row in full])
+        if fitted is None:
+            return None
+        partial, iterations, converged = fitted
+        if min(partial) >= 0.0:
+            theta = [0.0] * width
+            for j, value in zip(active, partial):
+                theta[j] = value
+            break
+        worst = min(zip(active, partial), key=lambda jt: jt[1])[0]
+        active.remove(worst)
+    resid = [
+        y - sum(x * t for x, t in zip(row, theta))
+        for row, y in zip(full, target)
+    ]
+    center = _median(resid)
+    mad_s = _median([abs(r - center) for r in resid])
+    mad_frac = _median(
+        [
+            abs(r) / float(s["measured_s"])
+            for r, s in zip(resid, rows)
+            if _fnum(s.get("measured_s")) and float(s["measured_s"]) > 0.0
+        ]
+    )
+    hop_s = {cls: theta[2 + i] for i, cls in enumerate(classes)}
+    for cls in LINK_CLASSES:
+        hop_s.setdefault(cls, 0.0)
+    return GroupCalibration(
+        chip=str(chip),
+        backend=str(backend),
+        dispatch_s=theta[0],
+        step_s=theta[1],
+        hop_s=hop_s,
+        rows=len(rows),
+        keys=len({str(s.get("key", "")) for s in rows}),
+        residual_mad_s=mad_s,
+        residual_mad_frac=mad_frac,
+        iterations=iterations,
+        converged=converged,
+    )
+
+
+def predict_row(
+    row: Mapping[str, object], group: GroupCalibration
+) -> Optional[float]:
+    """Calibrated prediction for a banked row from its own columns —
+    the linear residual model the fitter optimizes, used by the report
+    tier to score before/after error on history banked before stamping
+    existed. None when the row is fit-ineligible."""
+    features = row_features(row)
+    if features is None:
+        return None
+    overhead = group.dispatch_s + group.step_s * float(features["steps"])  # type: ignore[arg-type]
+    for cls, hops in dict(features["hops"]).items():  # type: ignore[arg-type]
+        overhead += float(group.hop_s.get(cls, 0.0)) * float(hops)
+    return float(features["predicted_s"]) + overhead  # type: ignore[arg-type]

@@ -523,3 +523,107 @@ def estimate(impl, spec: Optional[ChipSpec] = None) -> CostEstimate:
         bound=bound,
         chip=spec.name,
     )
+
+
+@dataclass(frozen=True)
+class CalibratedEstimate:
+    """A calibrated absolute-makespan prediction (ISSUE 17).
+
+    The analytical ``CostEstimate`` is a pure-bandwidth lower bound;
+    this adds the fitted per-hop latency / per-step software overhead /
+    per-row dispatch constants (``perfmodel.calib``) through the same
+    schedule-combination laws, so it tracks absolute measured medians
+    instead of bounding them. Only exists when a calibration table
+    covers the chip — the uncalibrated path never sees this type.
+    """
+
+    predicted_cal_s: float
+    overhead_s: float  # predicted_cal_s - the analytical bound
+    version: str  # calibration-table fingerprint (cal_version column)
+    chip: str
+    backend: str
+
+    def residual_frac(self, measured_s: float) -> float:
+        """``(measured - calibrated) / calibrated`` — the drift metric
+        stamped as ``cal_residual_frac`` (positive: slower than the
+        fitted model). NaN when either side is absent/degenerate."""
+        if not (
+            isinstance(measured_s, (int, float))
+            and measured_s == measured_s  # not NaN
+            and measured_s > 0.0
+            and self.predicted_cal_s > 0.0
+        ):
+            return float("nan")
+        return (measured_s - self.predicted_cal_s) / self.predicted_cal_s
+
+
+def calibrated_estimate(
+    impl,
+    spec: Optional[ChipSpec] = None,
+    table=None,
+    backend: Optional[str] = None,
+) -> Optional[CalibratedEstimate]:
+    """The calibrated prediction for one configured implementation.
+
+    Prices the fitted constants onto ``estimate()``'s terms through the
+    impl's own schedule law: every WireStep costs one step overhead plus
+    one hop of its link class, every ComputeStep one step overhead, the
+    dispatch constant lands once per row — the step/hop census
+    (``calib.schedule_census``) mirrors ``frontends.program_from_impl``,
+    so this closed form and a calibrated engine replay agree to float
+    precision exactly as gate 1 pins their uncalibrated halves.
+
+    ``table`` defaults to the env-selected one (``DDLB_TPU_CALIB``);
+    ``backend`` picks the (chip, backend) group (host_clock fallback).
+    None whenever there is no table or no group for the chip — callers
+    stamp the three cal columns at their defaults and the row is
+    byte-identical to the uncalibrated world.
+    """
+    from ddlb_tpu.perfmodel import calib
+
+    if table is None:
+        table = calib.get_table()
+    if table is None:
+        return None
+    est = estimate(impl, spec)
+    group = table.group(est.chip, backend)
+    if group is None:
+        return None
+    family = getattr(impl, "primitive_name", "")
+    schedule = getattr(impl, "COST_SCHEDULE", "sequential")
+    d = max(1, int(impl.num_partitions))
+    transport = str(impl.options.get("transport", "ici"))
+    chunks = overlap_chunks(impl) if schedule == "overlap" else None
+    census = calib.schedule_census(
+        calib.family_op(family, impl.options),
+        d,
+        has_compute=est.compute_s > 0.0,
+        has_wire=est.comm_s > 0.0,
+        chunks=chunks,
+        link_class=calib.scope_link_class(transport),
+    )
+    compute = est.compute_s + census["compute_steps"] * group.compute_overhead_s()
+    comm = est.comm_s + census["wire_steps"] * group.wire_overhead_s(
+        calib.scope_link_class(transport)
+    )
+    hbm = est.hbm_s
+    # the same combination laws as estimate(): overhead inflates each
+    # phase uniformly across chunks, so the fill/drain law carries over
+    if schedule == "compute_only":
+        predicted = max(compute, hbm)
+    elif schedule == "overlap":
+        predicted = max(compute, comm, hbm)
+        if chunks is not None:
+            predicted = max(
+                hbm, max(compute, comm) + min(compute, comm) / chunks
+            )
+    else:
+        predicted = max(compute + comm, hbm)
+    predicted += group.dispatch_s
+    return CalibratedEstimate(
+        predicted_cal_s=predicted,
+        overhead_s=predicted - est.predicted_s,
+        version=table.version,
+        chip=est.chip,
+        backend=group.backend,
+    )

@@ -29,6 +29,7 @@ from ddlb_tpu.ops.quantized_matmul import (
 from ddlb_tpu.primitives.base import jnp_dtype
 from ddlb_tpu.primitives.quantized_mixin import QuantizedGEMMMixin
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
@@ -59,8 +60,10 @@ class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
         if self.options["quantize"] == "static":
             # A pre-quantized per-row; the measured step is AG(int8 shard)
             # + AG(scales) + int8 GEMM + fused dequant.
+            # shard_map_compat: jax.shard_map where it exists, the
+            # pre-0.5 experimental entry point otherwise (jax 0.4.x)
             self.aq, self.sa = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     quantize_rowwise,
                     mesh=self.mesh,
                     in_specs=(P("tp", None),),
@@ -76,7 +79,7 @@ class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
                 return gemm(aq_full, bq, sa_full, sb)
 
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     step,
                     mesh=self.mesh,
                     in_specs=(
@@ -100,7 +103,7 @@ class QuantizedTPColumnwise(QuantizedGEMMMixin, TPColumnwise):
                 return gemm(q_full, bq, s_full, sb)
 
             self._fn = jax.jit(
-                jax.shard_map(
+                shard_map_compat(
                     step,
                     mesh=self.mesh,
                     in_specs=(P("tp", None), P(None, None), P(None, None)),

@@ -65,6 +65,25 @@ ROW_COLUMNS: Dict[str, str] = {
     "roofline_frac": "predicted_s / measured median, clamped to (0, 1]",
     "bound": "dominating roofline term: compute / comm / hbm",
     "chip": "hardware spec the prediction was made against",
+    # -- calibrated perfmodel (ISSUE 17: perfmodel/calib.py constants
+    #    fitted from banked history; all three sit at their defaults —
+    #    NaN / NaN / "" — whenever DDLB_TPU_CALIB is unset, keeping the
+    #    uncalibrated row byte-identical) -------------------------------
+    "predicted_cal_s": (
+        "calibrated absolute prediction: the analytical bound plus"
+        " fitted per-hop latency / per-step overhead / dispatch"
+        " constants through the schedule law (NaN when uncalibrated)"
+    ),
+    "cal_residual_frac": (
+        "(measured median - predicted_cal_s) / predicted_cal_s —"
+        " positive means slower than the fitted model; the drift metric"
+        " regress.detect_calibration gates (NaN when uncalibrated)"
+    ),
+    "cal_version": (
+        "calibration-table fingerprint the row was priced against"
+        " (perfmodel.calib.table_version); '' when uncalibrated —"
+        " residual baselines never mix across refits"
+    ),
     # -- observatory measured-overlap attribution (ISSUE 6) -------------
     "measured_overlap_frac": (
         "achieved overlap fraction: (serial floor - measured) / hideable,"

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ddlb_tpu import telemetry
+from ddlb_tpu.perfmodel.calib import scope_link_class
 from ddlb_tpu.perfmodel.topology import Topology
 from ddlb_tpu.simulator.program import (
     ComputeStep,
@@ -106,9 +107,21 @@ class ReplayResult:
         return out
 
 
-def _duration(step, topology: Topology) -> float:
+def _duration(step, topology: Topology, calibration=None) -> float:
+    """One task's duration; ``calibration`` (a fitted
+    ``calib.GroupCalibration``, duck-typed) prices the additive
+    latency/overhead terms on top of the bandwidth/FLOP floor: every
+    ComputeStep pays one step of software overhead, every WireStep one
+    step plus one hop of its link class (``flat``/``ici*`` scopes are
+    ici hops, ``dcn`` is a dcn hop). HbmStep is untouched — the HBM
+    term is a byte census, not a dispatched schedule step. None adds
+    exactly zero, preserving gate 1's float-precision agreement with
+    the uncalibrated closed form by construction."""
     if isinstance(step, ComputeStep):
-        return step.flops / topology.resource_rate("mxu", step.dtype)
+        base = step.flops / topology.resource_rate("mxu", step.dtype)
+        if calibration is not None:
+            base += calibration.compute_overhead_s()
+        return base
     if isinstance(step, HbmStep):
         return step.nbytes / topology.resource_rate("hbm")
     rate = topology.resource_rate(step.resource)
@@ -117,23 +130,39 @@ def _duration(step, topology: Topology) -> float:
         # an unroutable program honestly replays to an infinite makespan
         # instead of crashing, so degraded rankings can SHOW the outage
         return math.inf if step.nbytes > 0.0 else 0.0
-    return step.nbytes / rate
+    base = step.nbytes / rate
+    if calibration is not None:
+        base += calibration.wire_overhead_s(scope_link_class(step.resource))
+    return base
 
 
-def replay(program: ScheduleProgram, topology: Topology) -> ReplayResult:
-    """Replay ``program`` on ``topology``; see module docstring."""
+def replay(
+    program: ScheduleProgram, topology: Topology, calibration=None
+) -> ReplayResult:
+    """Replay ``program`` on ``topology``; see module docstring.
+
+    ``calibration`` (optional fitted constants for the world's chip +
+    timing backend) turns the lower-bound replay into an absolute
+    prediction: per-step terms via ``_duration`` plus the fixed
+    ``dispatch_s`` once on the makespan — the quantities validation
+    gate 3 holds against banked measured medians.
+    """
     with telemetry.span(
         "sim.replay", cat="sim", program=program.name, topo=topology.name
     ):
-        return _replay(program, topology)
+        return _replay(program, topology, calibration)
 
 
-def _replay(program: ScheduleProgram, topology: Topology) -> ReplayResult:
+def _replay(
+    program: ScheduleProgram, topology: Topology, calibration=None
+) -> ReplayResult:
     flat: List[Tuple[int, object, Optional[int]]] = [
         (si, step, dep) for si, _ji, step, dep in program.tasks()
     ]
     n = len(flat)
-    durations = [_duration(step, topology) for _si, step, _dep in flat]
+    durations = [
+        _duration(step, topology, calibration) for _si, step, _dep in flat
+    ]
     children: Dict[int, List[int]] = {}
     indegree = [0] * n
     for idx, (_si, _step, dep) in enumerate(flat):
@@ -206,6 +235,13 @@ def _replay(program: ScheduleProgram, topology: Topology) -> ReplayResult:
 
     telemetry.record("sim.events", processed)
     makespan = max((e.finish_s for e in timeline), default=0.0)
+    meta = dict(program.meta)
+    if calibration is not None:
+        makespan += calibration.dispatch_s
+        meta["calibration"] = {
+            "chip": calibration.chip,
+            "backend": calibration.backend,
+        }
     if not all(done):  # pragma: no cover - would mean a malformed IR
         stuck = [i for i, d in enumerate(done) if not d]
         raise RuntimeError(
@@ -221,7 +257,7 @@ def _replay(program: ScheduleProgram, topology: Topology) -> ReplayResult:
         busy_s=busy_s,
         payload=payload,
         events=processed,
-        meta=dict(program.meta),
+        meta=meta,
     )
 
 

@@ -1,6 +1,6 @@
 """Simulator validation: closed-form agreement + observatory history.
 
-Two gates keep the simulator honest before anyone trusts a 4096-chip
+Three gates keep the simulator honest before anyone trusts a 4096-chip
 ranking from it:
 
 1. **Closed-form agreement** (``closed_form_check``): on a degenerate
@@ -25,6 +25,15 @@ ranking from it:
    banked predictions depend on measurement-time state (the serving
    families' arrival-horizon floor, the compute_only HBM race) join
    only through gate (b).
+
+3. **Calibration gate** (``calibration_check``): with a fitted
+   calibration table (``perfmodel.calib`` — per-hop latency, per-step
+   software overhead, per-row dispatch, fitted from the same bank),
+   the calibrated replay of every reproducible banked key must land
+   *within* ``CALIBRATION_RTOL`` of the measured median — two-sided,
+   not merely below it. Gate 2 proves the lower bound; gate 3 proves
+   the absolute number, which is what the ROADMAP's capacity-planner
+   item needs before a 16pod4096 world is planned from replays.
 
 This module is the one simulator tier that imports implementation
 classes (and therefore JAX, at module-import level only): rebuilding a
@@ -525,3 +534,126 @@ def history_check(
         "lower_bound_slack": lower_bound_slack,
         "ok": checked > 0 and not violations,
     }
+
+
+#: gate (3): calibrated replay vs the measured median, two-sided. The
+#: residual MAD of a healthy cpu-sim fit sits well under this; real
+#: hardware groups are tighter still (host noise shrinks per-row)
+CALIBRATION_RTOL = 0.05
+
+
+def calibration_check(
+    directory: Optional[str] = None,
+    records: Optional[List[Dict[str, Any]]] = None,
+    table=None,
+    rtol: float = CALIBRATION_RTOL,
+) -> Dict[str, Any]:
+    """Gate (3): calibrated replays must land WITHIN ``rtol`` of banked
+    measured medians — the absolute-makespan promise, two-sided where
+    gate (2b) is one-sided. Joins the same reproducible keys as gate
+    (2a); rows whose (chip, backend) has no fitted group are skipped
+    (a table can legitimately cover one chip of a mixed bank), as are
+    degraded-world rows (the fit excludes them, so must the gate).
+    ``table`` defaults to the env-selected one (``DDLB_TPU_CALIB``);
+    with no table at all the gate reports ``ok: False`` with a reason —
+    an uncalibrated world must not read as a passing absolute check.
+    """
+    from ddlb_tpu.observatory.store import load_history, row_key
+    from ddlb_tpu.perfmodel import calib
+
+    if table is None:
+        table = calib.get_table()
+    summary: Dict[str, Any] = {
+        "checked": 0,
+        "skipped": 0,
+        "skipped_reasons": [],
+        "violations": [],
+        "rtol": rtol,
+        "table_version": getattr(table, "version", ""),
+        "ok": False,
+    }
+    if table is None:
+        summary["skipped_reasons"].append("no calibration table")
+        summary["skipped"] = 1
+        return summary
+    if records is None:
+        records = load_history(directory)
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        if rec.get("kind") != "row":
+            continue
+        row = rec["row"]
+        if str(row.get("error", "") or "").strip():
+            continue
+        if str(row.get("world_degraded", "")).strip().lower() in (
+            "1", "true", "yes", "on",
+        ):
+            continue
+        groups.setdefault(row_key(row), []).append(row)
+
+    checked = 0
+    skipped: List[str] = []
+    violations: List[Dict[str, Any]] = []
+    with telemetry.span("sim.validate", cat="sim", mode="calibration"):
+        for key, rows in sorted(groups.items()):
+            row = rows[0]
+            family = row.get("primitive")
+            member = row.get("base_implementation")
+            if family not in REPRODUCIBLE_FAMILIES:
+                skipped.append(f"{family}/{member}: family not reproducible")
+                continue
+            medians = [
+                v / 1e3
+                for v in (_fnum(r.get("median time (ms)")) for r in rows)
+                if v is not None and v > 0.0
+            ]
+            measured_s = _median(medians)
+            world = _fnum(row.get("world_size"))
+            m, n, k = (
+                _fnum(row.get("m")), _fnum(row.get("n")), _fnum(row.get("k"))
+            )
+            if measured_s is None or not world or world < 1 or not all(
+                (m, n, k)
+            ):
+                skipped.append(f"{family}/{member}: row lacks shape/median")
+                continue
+            chip = str(row.get("chip") or "cpu-sim")
+            group = table.group(
+                chip, str(row.get("time_measurement_backend") or "") or None
+            )
+            if group is None:
+                skipped.append(f"{family}/{member}: no fit for chip {chip}")
+                continue
+            try:
+                topo = flat_topology(int(world), chip)
+                impl = build_stub(
+                    family, member, int(m), int(n), int(k), int(world),
+                    dtype=str(row.get("dtype") or "bfloat16"),
+                    **parse_option_string(row.get("option", "")),
+                )
+                sim_cal_s = replay(
+                    program_from_impl(impl, topo), topo, calibration=group
+                ).makespan_s
+            except (ProgramBuildError, ValueError, KeyError, TypeError) as exc:
+                skipped.append(f"{family}/{member}: {exc}")
+                continue
+            checked += 1
+            rel = abs(sim_cal_s - measured_s) / measured_s
+            if rel > rtol:
+                violations.append(
+                    {
+                        "key": key,
+                        "kind": "calibrated-absolute",
+                        "sim_cal_s": sim_cal_s,
+                        "measured_median_s": measured_s,
+                        "rel_err": rel,
+                    }
+                )
+    summary.update(
+        checked=checked,
+        skipped=len(skipped),
+        skipped_reasons=skipped,
+        violations=violations,
+        ok=checked > 0 and not violations,
+    )
+    return summary

@@ -1,0 +1,359 @@
+#!/usr/bin/env python
+"""Calibration-observatory acceptance demo: bank, fit, gate, drift.
+
+The executable acceptance evidence for ISSUE 17, banked at
+``docs/calib_demo.log``. Everything runs on the 8-device CPU sim, so
+it is reproducible anywhere:
+
+1. **Bank**: two uncalibrated sweep rounds (jax_spmd + chunked overlap
+   members of two families) into a fresh observatory history.
+2. **Fit**: ``calibrate.calibrate_history`` distills the bank into a
+   versioned calibration table — per-row dispatch, per-step software
+   overhead, per-hop link latency for the ``(cpu-sim, host_clock)``
+   group — written via ``DDLB_TPU_CALIB``.
+3. **Gate 3**: ``validate.calibration_check`` replays every banked key
+   WITH the constants and must land within tolerance of the measured
+   medians (two-sided — the calibrated simulator is an estimator, not
+   a lower bound). The loose CPU bar here absorbs host noise; the 5%
+   contract is proven on synthetic banks in tests/test_calib.py.
+4. **Stamp**: three calibrated rounds run with the table active; every
+   row carries ``predicted_cal_s`` / ``cal_residual_frac`` /
+   ``cal_version``, and the drift gate stays SILENT on them.
+5. **Drift teeth**: a seeded 2x-slower copy of the last round must
+   fire ``regress.detect_calibration`` AND surface in the merged
+   ``detect_all`` ranking alongside the plain time regression; the
+   ``calib_report.py`` CLI exits 1 on it (0 on the clean bank).
+
+Usage: python scripts/calib_demo.py [--log PATH] [--no-log]
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# simulated mesh, set before anything touches JAX
+os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "8")
+
+#: (family, (m, n, k)) for the measured sweeps; shapes satisfy every
+#: divisibility rule at d=8 and chunk_count=2
+SWEEP_FAMILIES = [
+    ("tp_columnwise", (256, 64, 64)),
+    ("dp_allreduce", (256, 64, 64)),
+]
+
+
+class Tee:
+    """Print + capture, so the transcript lands in docs/ verbatim."""
+
+    def __init__(self):
+        self.lines = []
+
+    def __call__(self, text=""):
+        print(text, flush=True)
+        self.lines.append(str(text))
+
+
+def run_sweep(family, shape, csv_path):
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    m, n, k = shape
+    impls = {
+        "jax_spmd_0": {"implementation": "jax_spmd"},
+        "overlap_0": {
+            "implementation": "overlap",
+            "algorithm": "chunked",
+            "chunk_count": 2,
+        },
+    }
+    runner = PrimitiveBenchmarkRunner(
+        family, m=m, n=n, k=k,
+        implementations=impls,
+        dtype="float32", num_iterations=15, num_warmups=3,
+        validate=True, isolation="none", progress=False,
+        output_csv=csv_path,
+        barrier_at_each_iteration=False,
+    )
+    return runner.run()
+
+
+def bank_round(name, workdir, say):
+    """One sweep round banked under its own run_id; 0-error checked
+    by the caller."""
+    os.environ["DDLB_TPU_RUN_ID"] = name
+    errors = 0
+    for family, shape in SWEEP_FAMILIES:
+        df = run_sweep(
+            family, shape, os.path.join(workdir, f"{name}_{family}.csv")
+        )
+        errors += int((df["error"].astype(str).str.strip() != "").sum())
+    os.environ.pop("DDLB_TPU_RUN_ID", None)
+    return errors
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--log", default=os.path.join(REPO, "docs", "calib_demo.log"),
+        help="transcript destination (default docs/calib_demo.log)",
+    )
+    parser.add_argument(
+        "--no-log", action="store_true", help="stdout only, write no file"
+    )
+    args = parser.parse_args(argv)
+
+    say = Tee()
+    failures = []
+
+    def check(ok, what):
+        say(f"  {'PASS' if ok else 'FAIL'}  {what}")
+        if not ok:
+            failures.append(what)
+
+    say("==== calibration observatory demo ====")
+    say()
+
+    workdir = tempfile.mkdtemp(prefix="calib_demo_")
+    history_dir = os.path.join(workdir, "history")
+    calib_path = os.path.join(workdir, "calib.json")
+    os.environ["DDLB_TPU_HISTORY"] = history_dir
+    os.environ.pop("DDLB_TPU_CALIB", None)
+
+    # -- 1. bank two uncalibrated rounds ------------------------------------
+    say("-- bank: two uncalibrated cpu-sim rounds --")
+    for name in ("uncal-a", "uncal-b"):
+        errors = bank_round(name, workdir, say)
+        check(errors == 0, f"round {name} measured cleanly (0 errors)")
+
+    from ddlb_tpu.observatory import calibrate, regress, store
+
+    records = store.load_history(history_dir)
+    uncal_rows = [r["row"] for r in records if r.get("kind") == "row"]
+    stamped = [
+        r for r in uncal_rows
+        if str(r.get("cal_version") or "").strip()
+    ]
+    check(
+        uncal_rows and not stamped,
+        f"{len(uncal_rows)} banked rows carry NO calibration stamps "
+        f"(byte-identical uncalibrated schema)",
+    )
+    say()
+
+    # -- 2. fit the table ----------------------------------------------------
+    say("-- fit: IRLS-LAD constants from the bank --")
+    table = calibrate.calibrate_history(directory=history_dir)
+    check(table is not None, "fitter produced a table from the bank")
+    if table is None:
+        say(f"DEMO FAILED: {failures}")
+        return 1
+    group = table.group("cpu-sim")
+    say(f"  table {table.version} (git {table.git_rev or '?'})")
+    say(
+        f"    cpu-sim|{group.backend}: dispatch={group.dispatch_s * 1e6:.1f}us "
+        f"step={group.step_s * 1e6:.1f}us "
+        f"hop_ici={group.hop_s.get('ici', 0.0) * 1e6:.2f}us "
+        f"({group.rows} rows / {group.keys} keys, "
+        f"residual MAD {group.residual_mad_s * 1e6:.1f}us)"
+    )
+    check(
+        group.dispatch_s >= 0.0 and group.step_s >= 0.0,
+        "fitted constants are non-negative (clamped fit contract)",
+    )
+    calibrate.write_table(table, calib_path)
+    check(os.path.exists(calib_path), f"table written to {calib_path}")
+    say()
+
+    # -- 3. gate 3: calibrated replay vs banked medians ----------------------
+    say("-- gate 3: calibrated replays vs banked measured medians --")
+    from ddlb_tpu.simulator.validate import calibration_check
+
+    # how far off is the UNCALIBRATED lower bound here? CPU-sim
+    # predictions are microseconds against millisecond XLA dispatch
+    miss = sorted(
+        float(r["median time (ms)"]) * 1e-3 / float(r["predicted_s"])
+        for r in uncal_rows
+        if float(r.get("predicted_s") or 0.0) > 0.0
+    )
+    say(
+        f"  uncalibrated lower bound misses the measured medians by "
+        f"{miss[len(miss) // 2]:.0f}x (median) on this host"
+    )
+    # loose bar: per-family XLA dispatch on a CPU host varies far
+    # beyond what a 3-constant latency model can absorb (and beyond
+    # real accelerator clocks); the 5% contract on model-true banks is
+    # proven in tests/test_calib.py — here the win is 100x -> 2.5x
+    verdict = calibration_check(
+        directory=history_dir, table=table, rtol=2.5
+    )
+    say(
+        f"  {verdict['checked']} keys checked, {verdict['skipped']} "
+        f"skipped, {len(verdict['violations'])} violations "
+        f"(rtol={verdict['rtol']}, table {verdict['table_version']})"
+    )
+    for violation in verdict["violations"]:
+        say(f"    {violation}")
+    check(
+        verdict["ok"] and verdict["checked"] >= 4,
+        "every banked key replays WITH constants to within the CPU "
+        "bar of its measured median (two-sided)",
+    )
+    no_table = calibration_check(directory=history_dir, table=None)
+    check(
+        not no_table["ok"]
+        and "no calibration table" in no_table["skipped_reasons"],
+        "gate 3 refuses to pass without a table",
+    )
+    say()
+
+    # -- 4. two calibrated rounds: stamped rows, silent gate -----------------
+    say("-- stamp: three calibrated rounds with the table active --")
+    os.environ["DDLB_TPU_CALIB"] = calib_path
+    for name in ("cal-c", "cal-d", "cal-e"):
+        errors = bank_round(name, workdir, say)
+        check(errors == 0, f"round {name} measured cleanly (0 errors)")
+    os.environ.pop("DDLB_TPU_CALIB", None)
+
+    records = store.load_history(history_dir)
+    cal_rows = [
+        r["row"]
+        for r in records
+        if r.get("kind") == "row" and r.get("run_id") == "cal-e"
+    ]
+    stamped = [
+        r for r in cal_rows
+        if str(r.get("cal_version") or "") == table.version
+    ]
+    check(
+        cal_rows and len(stamped) == len(cal_rows),
+        f"all {len(cal_rows)} round-E rows stamped with "
+        f"predicted_cal_s/cal_residual_frac @ {table.version}",
+    )
+    residuals = [
+        abs(float(r.get("cal_residual_frac")))
+        for r in stamped
+        if str(r.get("cal_residual_frac")) not in ("nan", "None")
+    ]
+    if residuals:
+        say(
+            f"  round-E |residual| median "
+            f"{sorted(residuals)[len(residuals) // 2] * 100:.1f}%, "
+            f"worst {max(residuals) * 100:.1f}%"
+        )
+    # clean replays must NOT fire the drift gate. The discriminator on
+    # a jittery CPU host is ABSOLUTE: a real 2x drift adds >= +1.0 to
+    # every stamped residual, while round-to-round host jitter adds
+    # amplified measured-time noise (~0.3 at 25% jitter) — so the demo
+    # raises the metric's abs_excess bar to 0.5 and uses the SAME bar
+    # for the clean round and the seeded drift below
+    cpu_cal_metrics = (("cal_residual_frac", "high", 0.02, 0.5),)
+    clean = regress.detect_calibration(
+        cal_rows, records, exclude_run="cal-e",
+        metrics=cpu_cal_metrics, min_excess=0.5,
+    )
+    check(
+        clean == [],
+        "drift gate SILENT on a clean calibrated round",
+    )
+    say()
+
+    # -- 5. drift teeth ------------------------------------------------------
+    say("-- drift teeth: seeded 2x-slower round must fire the gate --")
+    drift_rows = []
+    for record in records:
+        if record.get("kind") != "row" or record.get("run_id") != "cal-e":
+            continue
+        seeded = copy.deepcopy(record)
+        row = seeded["row"]
+        measured = float(row["median time (ms)"]) * 2.0
+        row["median time (ms)"] = measured
+        pcal = float(row.get("predicted_cal_s") or 0.0)
+        if pcal > 0.0:
+            row["cal_residual_frac"] = (measured * 1e-3 - pcal) / pcal
+        seeded["run_id"] = "drift-2x"
+        drift_rows.append(row)
+        store.bank_row(row, directory=history_dir, run="drift-2x")
+    findings = regress.detect_calibration(
+        drift_rows, records, exclude_run="drift-2x",
+        metrics=cpu_cal_metrics,
+    )
+    check(
+        bool(findings),
+        f"{len(findings)} drift finding(s) fired on 2x at the same bar",
+    )
+    merged = regress.detect_all(
+        drift_rows, records, exclude_run="drift-2x"
+    )
+    cal_hits = [
+        f for f in merged if f.get("metric") == "cal_residual_frac"
+    ]
+    time_hits = [
+        f for f in merged if f.get("metric") == regress.MEASURE_COLUMN
+    ]
+    check(
+        bool(cal_hits) and bool(time_hits),
+        "detect_all merges the drift finding alongside the plain time "
+        "regression (the same slowdown, now ATTRIBUTED to model drift)",
+    )
+
+    # the CLI gates on it: exit 1 with the drift banked, and the report
+    # names the before/after prediction-error win
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "calib_report.py"),
+            "--history", history_dir, "--calib", calib_path, "--json",
+        ],
+        capture_output=True, text=True,
+    )
+    report_ok = False
+    improved = False
+    try:
+        doc = json.loads(out.stdout)
+        report_ok = bool(doc["drift_findings"])
+        ba = doc.get("before_after") or {}
+        improved = (
+            float(ba.get("median_rel_err_calibrated", 1.0))
+            < float(ba.get("median_rel_err_analytical", 0.0))
+        )
+        say(
+            f"  calib_report: analytical "
+            f"{float(ba['median_rel_err_analytical']) * 100:.1f}% -> "
+            f"calibrated {float(ba['median_rel_err_calibrated']) * 100:.1f}% "
+            f"median rel err over {ba['rows']} rows"
+        )
+    except (ValueError, KeyError):
+        pass
+    check(
+        out.returncode == 1 and report_ok,
+        "calib_report exits 1 with the seeded drift banked",
+    )
+    check(
+        improved,
+        "calibrated prediction beats the analytical lower bound on "
+        "banked history (before/after)",
+    )
+
+    os.environ.pop("DDLB_TPU_HISTORY", None)
+    say()
+    if failures:
+        say(f"DEMO FAILED: {len(failures)} check(s): {failures}")
+    else:
+        say("DEMO PASSED: every check green")
+    if not args.no_log:
+        with open(args.log, "w") as f:
+            f.write("\n".join(say.lines) + "\n")
+        print(f"[transcript -> {args.log}]")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -301,7 +301,12 @@ def print_compare_members(summary):
 
 
 def run_validation(history_dir):
-    from ddlb_tpu.simulator.validate import closed_form_check, history_check
+    from ddlb_tpu.perfmodel import calib
+    from ddlb_tpu.simulator.validate import (
+        calibration_check,
+        closed_form_check,
+        history_check,
+    )
 
     closed = closed_form_check()
     summary = {
@@ -313,6 +318,11 @@ def run_validation(history_dir):
     }
     if history_dir:
         summary["history"] = history_check(history_dir)
+        # Gate (3) only binds when a calibration table is active
+        # (DDLB_TPU_CALIB); an uncalibrated world is judged by the
+        # lower-bound gates alone rather than auto-failing --validate.
+        if calib.get_table() is not None:
+            summary["calibration"] = calibration_check(history_dir)
     return summary
 
 
@@ -384,7 +394,8 @@ def main(argv=None) -> int:
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument(
         "--validate", action="store_true",
-        help="run the closed-form + history validation gates instead",
+        help="run the closed-form + history validation gates instead "
+        "(plus the calibration gate when DDLB_TPU_CALIB is set)",
     )
     parser.add_argument(
         "--compare-members", action="store_true",
@@ -421,8 +432,13 @@ def main(argv=None) -> int:
     if args.validate:
         history_dir = args.history or envs.get_history_dir() or None
         summary = run_validation(history_dir)
-        ok = not summary["closed_form"]["failures"] and (
-            "history" not in summary or summary["history"]["ok"]
+        ok = (
+            not summary["closed_form"]["failures"]
+            and ("history" not in summary or summary["history"]["ok"])
+            and (
+                "calibration" not in summary
+                or summary["calibration"]["ok"]
+            )
         )
         if args.as_json:
             print(json.dumps({"validation": summary, "ok": ok}, indent=2))
@@ -444,6 +460,16 @@ def main(argv=None) -> int:
                     f"lb_slack={h['lower_bound_slack']})"
                 )
                 for violation in h["violations"]:
+                    print(f"  FAIL {violation}")
+            if "calibration" in summary:
+                c = summary["calibration"]
+                print(
+                    f"calibration join: {c['checked']} keys checked, "
+                    f"{c['skipped']} skipped, {len(c['violations'])} "
+                    f"violations (rtol={c['rtol']}, "
+                    f"table {c['table_version'] or 'none'})"
+                )
+                for violation in c["violations"]:
                     print(f"  FAIL {violation}")
             print("VALIDATION " + ("PASSED" if ok else "FAILED"))
         return 0 if ok else 1

@@ -1,0 +1,536 @@
+"""Calibration observatory (ISSUE 17): fitter recovery, gate 3, drift.
+
+Three layers, mirroring the acceptance criteria:
+
+- stdlib-only fitter tests: synthetic banked histories with KNOWN
+  injected constants — the IRLS-LAD fit must recover them within 10%
+  (here: to float precision on clean linear data, with gross outliers
+  present for the robustness claim), deterministically;
+- gate-3 tests (JAX stubs): calibrated replays of a synthetic bank
+  must land within CALIBRATION_RTOL of the banked measured medians
+  while the uncalibrated lower bound is demonstrably >20% off;
+- drift-gate tests: ``regress.detect_calibration`` fires on a seeded
+  2x overhead shift, stays silent on clean replays, and fences
+  baselines across ``cal_version`` refits. Plus: the uncalibrated row
+  path is byte-identical (defaults only, no cal stamping).
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from ddlb_tpu.observatory import calibrate, regress, store
+from ddlb_tpu.perfmodel import calib
+
+# the injected ground truth every synthetic history below is built from
+ALPHA = 5e-4  # dispatch_s
+BETA = 1.2e-4  # step_s
+GAMMA_ICI = 3e-5  # hop_s[ici]
+GAMMA_DCN = 4e-4  # hop_s[dcn]
+TRUTH = calib.GroupCalibration(
+    chip="cpu-sim",
+    backend="host_clock",
+    dispatch_s=ALPHA,
+    step_s=BETA,
+    hop_s={"ici": GAMMA_ICI, "dcn": GAMMA_DCN},
+)
+
+
+def _overhead(census) -> float:
+    """The injected linear overhead for one row's census."""
+    over = ALPHA + BETA * census["steps"]
+    for cls, hops in census["hops"].items():
+        over += TRUTH.hop_s[cls] * hops
+    return over
+
+
+def _row(
+    family,
+    member,
+    d,
+    predicted_s,
+    *,
+    option="",
+    has_compute=True,
+    has_wire=True,
+    chunks=None,
+    transport="ici",
+    measured_s=None,
+    m=256,
+    n=64,
+    k=64,
+    **extra,
+):
+    """One synthetic banked row whose measured median embeds the
+    injected constants through the SAME census the fitter derives."""
+    census = calib.schedule_census(
+        calib.family_op(family, calib._parse_options(option)),
+        d,
+        has_compute=has_compute,
+        has_wire=has_wire,
+        chunks=chunks,
+        link_class=calib.scope_link_class(transport),
+    )
+    if measured_s is None:
+        measured_s = predicted_s + _overhead(census)
+    row = {
+        "primitive": family,
+        "base_implementation": member,
+        "implementation": f"{member}_0",
+        "option": option,
+        "m": m,
+        "n": n,
+        "k": k,
+        "dtype": "float32",
+        "world_size": d,
+        "chip": "cpu-sim",
+        "time_measurement_backend": "host_clock",
+        "median time (ms)": measured_s * 1e3,
+        "predicted_s": predicted_s,
+        "phase_compute_s": predicted_s * 0.5 if has_compute else 0.0,
+        "phase_comm_s": predicted_s * 0.5 if has_wire else 0.0,
+        "error": "",
+        "quarantined": False,
+        "world_degraded": False,
+    }
+    row.update(extra)
+    return row
+
+
+def _synthetic_rows():
+    """A linear-exact history spanning compute-only, GEMM+wire (both
+    transports), wire-only and chunked censuses — every constant
+    identifiable — plus two gross outliers the LAD fit must shrug off."""
+    rows = []
+    for d in (2, 4, 8):
+        rows.append(_row("dp_allreduce", "jax_spmd", d, 1e-4 * d))
+        rows.append(
+            _row(
+                "collectives", "jax_spmd", d, 5e-5 * d,
+                option="op=all_reduce", has_compute=False,
+            )
+        )
+        rows.append(
+            _row(
+                "collectives", "jax_spmd", d, 8e-5 * d,
+                option="op=all_reduce;transport=dcn",
+                has_compute=False, transport="dcn",
+            )
+        )
+    rows.append(
+        _row("transformer_step", "compute_only", 8, 2e-4, has_wire=False)
+    )
+    rows.append(
+        _row(
+            "dp_allreduce", "overlap", 8, 3e-4,
+            option="algorithm=chunked;chunk_count=2", chunks=2,
+        )
+    )
+    # gross outliers (a contended host's 10x rows): LAD must not budge
+    rows.append(_row("dp_allreduce", "jax_spmd", 4, 1e-4, measured_s=5e-2))
+    rows.append(
+        _row(
+            "collectives", "jax_spmd", 8, 5e-5,
+            option="op=all_reduce", has_compute=False, measured_s=8e-2,
+        )
+    )
+    return rows
+
+
+def _records(rows, run_id="run-a"):
+    return [
+        {"kind": "row", "run_id": run_id, "key": store.row_key(r), "row": r}
+        for r in rows
+    ]
+
+
+class TestFitter:
+    def test_recovers_injected_constants_within_10pct(self):
+        table = calibrate.calibrate_history(records=_records(_synthetic_rows()))
+        assert table is not None
+        group = table.group("cpu-sim", "host_clock")
+        assert group is not None
+        assert group.dispatch_s == pytest.approx(ALPHA, rel=0.10)
+        assert group.step_s == pytest.approx(BETA, rel=0.10)
+        assert group.hop_s["ici"] == pytest.approx(GAMMA_ICI, rel=0.10)
+        assert group.hop_s["dcn"] == pytest.approx(GAMMA_DCN, rel=0.10)
+        # fit metadata rides the table
+        assert group.rows == len(_synthetic_rows())
+        assert group.keys > 0
+        assert group.residual_mad_s < 1e-3  # outliers inflate it, bounded
+
+    def test_fit_is_deterministic(self):
+        a = calibrate.calibrate_history(records=_records(_synthetic_rows()))
+        b = calibrate.calibrate_history(records=_records(_synthetic_rows()))
+        assert a.group("cpu-sim") == b.group("cpu-sim")
+        assert a.version == b.version
+
+    def test_thin_group_refuses_to_fit(self):
+        rows = _synthetic_rows()[:3]
+        assert calibrate.calibrate_history(records=_records(rows)) is None
+
+    def test_ineligible_rows_are_excluded(self):
+        clean = _synthetic_rows()
+        poisoned = clean + [
+            _row("dp_allreduce", "jax_spmd", 4, 1e-4,
+                 measured_s=1.0, error="worker died"),
+            _row("dp_allreduce", "jax_spmd", 4, 1e-4,
+                 measured_s=1.0, world_degraded=True),
+            _row("serving_load", "static", 8, 1e-4, measured_s=1.0),
+        ]
+        a = calibrate.calibrate_history(records=_records(clean))
+        b = calibrate.calibrate_history(records=_records(poisoned))
+        assert a.group("cpu-sim") == b.group("cpu-sim")
+
+    def test_row_features_census_matches_frontend_counts(self):
+        # dp_allreduce at d=8: 2(d-1)=14 wire steps + 1 compute step
+        feat = calib.row_features(_row("dp_allreduce", "jax_spmd", 8, 1e-4))
+        assert feat["steps"] == 15
+        assert feat["hops"] == {"ici": 14, "dcn": 0}
+        # chunked doubles both
+        feat = calib.row_features(
+            _row("dp_allreduce", "overlap", 8, 1e-4,
+                 option="algorithm=chunked;chunk_count=2", chunks=2)
+        )
+        assert feat["steps"] == 30
+        assert feat["hops"]["ici"] == 28
+
+
+class TestTable:
+    def test_round_trip_and_version(self, tmp_path):
+        table = calibrate.calibrate_history(records=_records(_synthetic_rows()))
+        path = str(tmp_path / "calib.json")
+        calibrate.write_table(table, path)
+        loaded = calib.load_table(path)
+        assert loaded.version == table.version
+        assert loaded.group("cpu-sim") == table.group("cpu-sim")
+        # version is a content fingerprint: same constants, same version
+        assert table.version == calib.table_version(table.groups)
+        moved = {
+            key: calib.GroupCalibration(
+                chip=g.chip, backend=g.backend,
+                dispatch_s=g.dispatch_s * 2, step_s=g.step_s,
+                hop_s=g.hop_s, rows=g.rows,
+            )
+            for key, g in table.groups.items()
+        }
+        assert calib.table_version(moved) != table.version
+
+    def test_corrupt_table_loads_as_none(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text("{not json")
+        assert calib.load_table(str(path)) is None
+
+    def test_get_table_env_gated(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DDLB_TPU_CALIB", raising=False)
+        assert calib.get_table() is None
+        table = calibrate.calibrate_history(records=_records(_synthetic_rows()))
+        path = str(tmp_path / "calib.json")
+        calibrate.write_table(table, path)
+        monkeypatch.setenv("DDLB_TPU_CALIB", path)
+        loaded = calib.get_table()
+        assert loaded is not None and loaded.version == table.version
+        monkeypatch.delenv("DDLB_TPU_CALIB", raising=False)
+        assert calib.get_table() is None
+
+    def test_group_lookup_fallback(self):
+        g1 = calib.GroupCalibration("v5e", "host_clock", 1e-4, 1e-5, {"ici": 0.0, "dcn": 0.0})
+        g2 = calib.GroupCalibration("v5e", "device_loop", 2e-4, 2e-5, {"ici": 0.0, "dcn": 0.0})
+        table = calib.make_table({("v5e", "host_clock"): g1, ("v5e", "device_loop"): g2})
+        assert table.group("v5e", "device_loop") is g2
+        assert table.group("v5e", "unknown") is g1  # host_clock fallback
+        assert table.group("v5e") is g1
+        assert table.group("v6e") is None
+
+
+class TestIterHistory:
+    def _bank(self, tmp_path):
+        directory = str(tmp_path)
+        rows = [
+            _row("dp_allreduce", "jax_spmd", 4, 1e-4),
+            _row("collectives", "jax_spmd", 4, 5e-5, option="op=all_reduce",
+                 has_compute=False),
+        ]
+        rows[1]["chip"] = "v5e"
+        for r in rows:
+            store.bank_row(r, directory=directory)
+        store.bank_row({"metric": "bench", "chip": "cpu-sim"},
+                       kind="bench", directory=directory)
+        path = store.history_path(directory)
+        with open(path, "a", encoding="utf-8") as f:
+            # unknown columns from a future schema ride along untouched
+            future = dict(rows[0])
+            future["column_from_2027"] = "x"
+            f.write(json.dumps({"kind": "row", "row": future}) + "\n")
+            # a torn tail: a process killed mid-append
+            f.write('{"kind": "row", "row": {"chip": "cpu-s')
+        return directory
+
+    def test_filters_and_tolerance(self, tmp_path):
+        directory = self._bank(tmp_path)
+        got = list(store.iter_history(directory))
+        assert len(got) == 3  # 2 rows + future-schema row; bench + torn out
+        assert list(store.iter_history(directory, chip="v5e"))[0]["row"][
+            "primitive"
+        ] == "collectives"
+        assert len(list(store.iter_history(directory, family="dp_allreduce"))) == 2
+        assert len(list(store.iter_history(directory, impl="jax_spmd"))) == 3
+        assert len(list(store.iter_history(directory, kind=None))) == 4
+        assert len(list(store.iter_history(
+            directory, chip=("v5e", "cpu-sim")))) == 3
+        assert any(
+            r["row"].get("column_from_2027") == "x"
+            for r in store.iter_history(directory)
+        )
+
+    def test_predicate_and_missing_file(self, tmp_path):
+        assert list(store.iter_history(str(tmp_path / "nope"))) == []
+        directory = self._bank(tmp_path)
+        got = list(
+            store.iter_history(
+                directory, predicate=lambda rec: rec["row"].get("world_size") == 4
+            )
+        )
+        assert len(got) == 3
+
+
+class TestGate3:
+    """Calibrated replays vs banked medians on real impl stubs."""
+
+    @pytest.fixture(scope="class")
+    def bank(self):
+        from ddlb_tpu.perfmodel.cost import estimate
+        from ddlb_tpu.perfmodel.specs import get_spec
+        from ddlb_tpu.simulator.validate import build_stub
+
+        spec = get_spec("cpu-sim")
+        rows = []
+        for family, member, option, opts in (
+            ("dp_allreduce", "jax_spmd", "", {}),
+            ("collectives", "jax_spmd", "op=all_reduce", {"op": "all_reduce"}),
+            ("tp_columnwise", "jax_spmd", "", {}),
+        ):
+            for d in (2, 4, 8):
+                impl = build_stub(family, member, 256, 64, 64, d,
+                                  dtype="float32", **opts)
+                est = estimate(impl, spec)
+                row = _row(
+                    family, member, d, est.predicted_s,
+                    option=option,
+                    has_compute=est.compute_s > 0.0,
+                    has_wire=est.comm_s > 0.0,
+                )
+                row["phase_compute_s"] = est.compute_s
+                row["phase_comm_s"] = est.comm_s
+                # measured embeds the constants through the SAME census
+                # the fitter will derive from this row's option string
+                feat = calib.row_features(row)
+                census = {"steps": feat["steps"], "hops": feat["hops"]}
+                measured = est.predicted_s + _overhead(census)
+                row["median time (ms)"] = measured * 1e3
+                rows.append(row)
+        return _records(rows)
+
+    def test_calibrated_replay_within_tolerance(self, bank):
+        from ddlb_tpu.simulator.validate import calibration_check
+
+        table = calibrate.calibrate_history(records=bank)
+        assert table is not None
+        summary = calibration_check(records=bank, table=table)
+        assert summary["checked"] == 9
+        assert summary["violations"] == []
+        assert summary["ok"] is True
+        assert summary["table_version"] == table.version
+
+    def test_uncalibrated_bound_is_far_off(self, bank):
+        """The >20% demonstration: without constants the lower bound
+        misses every banked median by a wide margin — the gap the
+        calibration exists to close."""
+        from ddlb_tpu.perfmodel.topology import flat_topology
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import program_from_impl
+        from ddlb_tpu.simulator.validate import build_stub, parse_option_string
+
+        for rec in bank:
+            row = rec["row"]
+            measured = row["median time (ms)"] * 1e-3
+            topo = flat_topology(row["world_size"], "cpu-sim")
+            impl = build_stub(
+                row["primitive"], row["base_implementation"],
+                row["m"], row["n"], row["k"], row["world_size"],
+                dtype=row["dtype"],
+                **parse_option_string(row["option"]),
+            )
+            sim = replay(program_from_impl(impl, topo), topo).makespan_s
+            assert abs(sim - measured) / measured > 0.20
+
+    def test_gate_fails_without_table(self, bank, monkeypatch):
+        from ddlb_tpu.simulator.validate import calibration_check
+
+        monkeypatch.delenv("DDLB_TPU_CALIB", raising=False)
+        summary = calibration_check(records=bank)
+        assert summary["ok"] is False
+        assert "no calibration table" in summary["skipped_reasons"]
+
+    def test_gate_catches_seeded_drift(self, bank):
+        from ddlb_tpu.simulator.validate import calibration_check
+
+        table = calibrate.calibrate_history(records=bank)
+        drifted = []
+        for rec in bank:
+            row = dict(rec["row"])
+            row["median time (ms)"] *= 2.0
+            drifted.append({**rec, "row": row})
+        summary = calibration_check(records=drifted, table=table)
+        assert summary["ok"] is False
+        assert summary["violations"]
+
+
+class TestDriftGate:
+    VERSION = "v1-abcdef0123"
+
+    def _calibrated_rows(self, residual, run_id="cur", version=VERSION):
+        rows = []
+        for d in (4, 8):
+            row = _row("dp_allreduce", "jax_spmd", d, 1e-4 * d)
+            measured = row["median time (ms)"] * 1e-3
+            pcal = measured / (1.0 + residual)
+            row["predicted_cal_s"] = pcal
+            row["cal_residual_frac"] = (measured - pcal) / pcal
+            row["cal_version"] = version
+            rows.append(row)
+        return rows
+
+    def _history(self, runs=3, residual=0.004):
+        records = []
+        for i in range(runs):
+            records.extend(
+                _records(
+                    self._calibrated_rows(residual + 0.001 * i),
+                    run_id=f"base-{i}",
+                )
+            )
+        return records
+
+    def test_fires_on_seeded_2x_overhead_shift(self):
+        history = self._history()
+        # a 2x overhead shift: measured doubles against a model that
+        # predicted it, residual jumps ~1.0
+        current = self._calibrated_rows(1.0)
+        findings = regress.detect_calibration(current, history)
+        assert findings, "2x drift must fire"
+        assert findings[0]["metric"] == "cal_residual_frac"
+        assert findings[0]["cal_version"] == self.VERSION
+        assert findings[0]["z"] > regress.Z_TOL
+        # and it outranks the plain time regression in the merged gate
+        merged = regress.detect_all(current, history)
+        assert merged[0]["metric"] == "cal_residual_frac"
+
+    def test_silent_on_clean_replays(self):
+        history = self._history()
+        current = self._calibrated_rows(0.006)
+        assert regress.detect_calibration(current, history) == []
+
+    def test_version_fence_resets_baseline(self):
+        history = self._history()
+        # same huge residuals, but priced against a REFIT table: the
+        # old version's baselines must not gate the new model
+        current = self._calibrated_rows(1.0, version="v1-ffffffffff")
+        assert regress.detect_calibration(current, history) == []
+
+    def test_noop_when_uncalibrated(self):
+        history = self._history()
+        current = [_row("dp_allreduce", "jax_spmd", 4, 1e-4)]
+        for row in current:
+            row["cal_residual_frac"] = float("nan")
+            row["cal_version"] = ""
+        assert regress.detect_calibration(current, history) == []
+
+    def test_prior_fallback_prefers_calibrated(self):
+        row = _row("dp_allreduce", "jax_spmd", 4, 1e-6)
+        row["median time (ms)"] = 100.0
+        row["predicted_cal_s"] = 1e-3
+        findings = regress.detect(
+            [row], [], prior_factor=regress.PRIOR_FACTOR
+        )
+        assert findings and findings[0]["prior"] == "calibrated"
+        assert findings[0]["baseline_ms"] == pytest.approx(1.0)
+        row.pop("predicted_cal_s")
+        findings = regress.detect([row], [])
+        assert findings and findings[0]["prior"] == "analytical"
+
+
+class TestUncalibratedPath:
+    def test_defaults_registered_and_inert(self, monkeypatch):
+        from ddlb_tpu import schema
+        from ddlb_tpu.benchmark import PERF_ROW_DEFAULTS
+
+        for column in ("predicted_cal_s", "cal_residual_frac", "cal_version"):
+            assert column in schema.ROW_COLUMNS
+            assert column in PERF_ROW_DEFAULTS
+        assert math.isnan(PERF_ROW_DEFAULTS["predicted_cal_s"])
+        assert math.isnan(PERF_ROW_DEFAULTS["cal_residual_frac"])
+        assert PERF_ROW_DEFAULTS["cal_version"] == ""
+
+    def test_calibrated_estimate_none_without_table(self, monkeypatch):
+        from ddlb_tpu.perfmodel.cost import calibrated_estimate
+        from ddlb_tpu.simulator.validate import build_stub
+
+        monkeypatch.delenv("DDLB_TPU_CALIB", raising=False)
+        impl = build_stub("dp_allreduce", "jax_spmd", 256, 64, 64, 8)
+        assert calibrated_estimate(impl) is None
+
+    def test_replay_without_calibration_is_unchanged(self):
+        from ddlb_tpu.perfmodel.cost import estimate
+        from ddlb_tpu.perfmodel.specs import get_spec
+        from ddlb_tpu.perfmodel.topology import flat_topology
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import program_from_impl
+        from ddlb_tpu.simulator.validate import build_stub
+
+        impl = build_stub("dp_allreduce", "jax_spmd", 256, 64, 64, 8,
+                          dtype="float32")
+        topo = flat_topology(8, "cpu-sim")
+        program = program_from_impl(impl, topo)
+        bare = replay(program, topo)
+        explicit = replay(program, topo, calibration=None)
+        assert bare.makespan_s == explicit.makespan_s
+        assert "calibration" not in bare.meta
+        # gate 1 unchanged: the uncalibrated replay still equals the
+        # closed form to float precision
+        est = estimate(impl, get_spec("cpu-sim"))
+        assert bare.makespan_s == pytest.approx(est.predicted_s, rel=1e-9)
+
+    def test_calibrated_closed_form_matches_calibrated_replay(self):
+        """The calibrated gate-1 analogue: overhead inflates each phase
+        uniformly, so the closed form and the engine agree to float
+        precision for sequential, ideal-overlap AND chunked shapes."""
+        from ddlb_tpu.perfmodel.cost import calibrated_estimate
+        from ddlb_tpu.perfmodel.topology import flat_topology
+        from ddlb_tpu.simulator.engine import replay
+        from ddlb_tpu.simulator.frontends import program_from_impl
+        from ddlb_tpu.simulator.validate import build_stub
+
+        table = calib.make_table({("cpu-sim", "host_clock"): TRUTH})
+        for family, member, opts in (
+            ("dp_allreduce", "jax_spmd", {}),
+            ("dp_allreduce", "overlap",
+             {"algorithm": "chunked", "chunk_count": 2}),
+            ("tp_columnwise", "overlap", {}),
+            ("collectives", "jax_spmd", {}),
+        ):
+            impl = build_stub(family, member, 256, 64, 64, 8,
+                              dtype="float32", **opts)
+            topo = flat_topology(8, "cpu-sim")
+            closed = calibrated_estimate(
+                impl, table=table, backend="host_clock"
+            )
+            sim = replay(
+                program_from_impl(impl, topo), topo, calibration=TRUTH
+            )
+            assert sim.makespan_s == pytest.approx(
+                closed.predicted_cal_s, rel=1e-9
+            )
+            assert sim.meta["calibration"]["chip"] == "cpu-sim"
