@@ -149,7 +149,18 @@ SITES: Dict[str, str] = {
     "serve.admit": "serving engine request admission (prefill + slot copy)",
     "serve.decode_tick": (
         "serving engine ragged decode tick (kind=hang + duration_s = "
-        "the per-token latency-injection shape the SLO gate catches)"
+        "the per-token latency-injection shape the SLO gate catches); "
+        "the cluster stamps context shard=<i>, so a plan can slow ONE "
+        "engine of a pool (the indictment drill's seeded straggler)"
+    ),
+    "serve.route": (
+        "serving cluster routing decision (ddlb_tpu/serve/router.py) — "
+        "one call per dispatched request, context shard=<chosen>"
+    ),
+    "serve.handoff": (
+        "prefill->decode KV-bundle handoff (ddlb_tpu/serve/cluster.py); "
+        "payload_bytes carries the bundle size, so link_slow rules "
+        "price a degraded interconnect against the real KV payload"
     ),
 }
 

@@ -51,7 +51,7 @@ import dataclasses
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -167,6 +167,10 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         self.cfg = cfg
         self.params = params
+        #: extra fault-plan match context the engine's injection sites
+        #: pass (``{"shard": "1"}`` from the serving cluster) — a chaos
+        #: plan can then target ONE engine of a multi-engine pool
+        self.fault_context: Dict[str, str] = {}
         self.B = max_batch
         self.S_max = max_len
         self.eos_id = eos_id
@@ -633,6 +637,17 @@ class ContinuousBatchingEngine:
         None when the queue is empty."""
         return self._queue[0] if self._queue else None
 
+    def outstanding_tokens(self) -> int:
+        """Tokens still to generate across queued requests AND active
+        slots — the cluster router's least-outstanding-WORK gauge (a
+        queue of long generations is more load than one of short ones,
+        which ``queue_depth`` alone cannot see)."""
+        queued = sum(self._requests[i].max_new for i in self._queue)
+        active = sum(
+            self.remaining_budget(s) for s in self.active_slots()
+        )
+        return queued + active
+
     def remaining_budget(self, slot: int) -> int:
         """Tokens slot ``slot``'s request may still generate (its
         ``max_new`` minus what it has produced) — the preemption
@@ -648,7 +663,7 @@ class ContinuousBatchingEngine:
         ):
             # chaos surface: a plan can wedge/kill/delay the admission
             # path of a live serving world (faults/plan.SITES)
-            faults.inject("serve.admit")
+            faults.inject("serve.admit", **self.fault_context)
             self._admit_inner(slot, req_idx)
 
     def _admit_inner(self, slot: int, req_idx: int) -> None:
@@ -835,6 +850,26 @@ class ContinuousBatchingEngine:
         provides the mechanism."""
         if requeue not in ("back", "front"):
             raise ValueError(f"requeue must be 'back' or 'front', got {requeue!r}")
+        _, remnant = self.evict(slot)
+        new_idx = len(self._requests)
+        self._requests.append(remnant)
+        if requeue == "front":
+            self._queue.appendleft(new_idx)
+        else:
+            self._queue.append(new_idx)
+        return new_idx
+
+    def evict(self, slot: int) -> Tuple[int, Request]:
+        """``preempt``'s cross-engine half: fold the tokens generated so
+        far into the prompt, park the lane, (paged) release its pages —
+        and hand the remnant ``Request`` to the CALLER instead of
+        requeueing it. This is the serving cluster's drain/migration
+        primitive (``ddlb_tpu/serve``): the remnant re-enters a
+        SURVIVING engine via the KV-handoff path, while this engine's
+        ledger for the request ends here. Returns ``(request_index,
+        remnant)``; the same no-token-ever-re-generated contract as
+        ``preempt`` (the remnant greedy-continues exactly where it
+        stopped, wherever it lands)."""
         req_idx = self._slot_req[slot]
         if req_idx is None:
             raise ValueError(f"slot {slot} is idle; nothing to preempt")
@@ -860,13 +895,17 @@ class ContinuousBatchingEngine:
             self._slot_pages[slot] = []
             self._prefix_slots.discard(slot)
             self._drain_retired_prefix(slot)
-        new_idx = len(self._requests)
-        self._requests.append(Request(prompt, max_new=remaining))
-        if requeue == "front":
-            self._queue.appendleft(new_idx)
-        else:
-            self._queue.append(new_idx)
-        return new_idx
+        return req_idx, Request(prompt, max_new=remaining)
+
+    def drop_queue(self) -> List[Tuple[int, Request]]:
+        """Empty the admission queue, returning ``(request_index,
+        Request)`` pairs in FIFO order — the cluster drain's companion
+        to ``evict`` for requests an excluded engine accepted but never
+        admitted (they re-route to survivors as fresh submissions: no
+        KV exists yet, so no handoff to price)."""
+        out = [(idx, self._requests[idx]) for idx in self._queue]
+        self._queue.clear()
+        return out
 
     # -- the tick ----------------------------------------------------------
 
@@ -878,7 +917,7 @@ class ContinuousBatchingEngine:
         # chaos surface: a plan can stall (kind=hang + duration_s — the
         # decode-slowdown shape the SLO gate must catch), error, or kill
         # the tick path of a live serving world (faults/plan.SITES)
-        faults.inject("serve.decode_tick")
+        faults.inject("serve.decode_tick", **self.fault_context)
         # no per-tick span: a locked trace write per decoded token would
         # perturb the measured loop this engine runs inside — ticks are
         # counted into the metrics registry and summarized as one

@@ -242,6 +242,15 @@ def fold(
                 "done": e.get("done"),
                 "total": e.get("total"),
             }
+            shard_depths = e.get("shard_depths")
+            if isinstance(shard_depths, list):
+                # cluster members (ISSUE 18): per-decode-shard queue
+                # gauges; -1 marks a drained/excluded shard (rendered
+                # as dead, not merely idle)
+                serving["shard_depths"] = [
+                    int(d) for d in shard_depths
+                    if _finite(d) is not None
+                ]
         elif kind == "worker_spawn":
             state["workers"][e.get("worker")] = {
                 "state": "spawning",

@@ -376,16 +376,47 @@ def detect_slo(
     Finding shape matches ``detect`` (``metric`` names the column;
     ``ratio`` is always worse/better oriented so >1 reads "this much
     worse" for both directions).
+
+    SLO distributions are only comparable under the SAME cluster
+    composition (ISSUE 18): a routed dp=2 row's TTFT tail, a
+    disaggregated row's (handoff in the path), and a degraded row's
+    (one shard drained mid-drill) are different populations, so
+    history is fenced per ``serve_topology`` group — each current
+    row gates only against records carrying ITS stamp. Unstamped
+    history (rows banked before the cluster existed) folds into the
+    legacy ``"single"`` bucket, so pre-cluster baselines keep gating
+    single-engine rows instead of being orphaned by the new column.
+    Each finding carries its ``serve_topology``.
     """
-    return _detect_metrics(
-        current_rows,
-        history,
-        [(metric, direction, 0.0, 0.0) for metric, direction in metrics],
-        exclude_run,
-        z_tol,
-        min_excess,
-        rel_floor,
-    )
+    specs = [(metric, direction, 0.0, 0.0) for metric, direction in metrics]
+
+    def _topology(row: Dict[str, Any]) -> str:
+        return str(row.get("serve_topology") or "") or "single"
+
+    def _stamp_topology(finding, row):
+        finding["serve_topology"] = _topology(row)
+
+    findings: List[Dict[str, Any]] = []
+    for topo in sorted({_topology(row) for row in current_rows}):
+        rows = [row for row in current_rows if _topology(row) == topo]
+        fenced = [
+            rec
+            for rec in history
+            if _topology(rec.get("row") or {}) == topo
+        ]
+        findings.extend(
+            _detect_metrics(
+                rows,
+                fenced,
+                specs,
+                exclude_run,
+                z_tol,
+                min_excess,
+                rel_floor,
+                decorate=_stamp_topology,
+            )
+        )
+    return _rank(findings)
 
 
 def detect_skew(

@@ -170,6 +170,43 @@ def link_slow_extra_s(nbytes: float, bw: float, factor: float) -> float:
     return nbytes / slow - nbytes / bw
 
 
+def kv_bundle_bytes(
+    d_model: int,
+    n_heads: int,
+    n_kv_heads: int,
+    layers: int,
+    kv_cache: str,
+    tokens: int,
+) -> float:
+    """Bytes of K/V cache a ``tokens``-row handoff bundle carries
+    between a prefill and a decode worker (``ddlb_tpu/serve``): two
+    tensors (K and V) x layers x kv heads x head_dim per row, at the
+    cache dtype's width — the SAME per-row convention as the decode HBM
+    census (``utils/hbm_budget.decode_budget``'s ``kv_cache``
+    component), so the handoff term and the decode floor cannot drift
+    on what a cache row weighs."""
+    head_dim = d_model // max(1, n_heads)
+    kvh = n_kv_heads or n_heads
+    itemsize = 1.0 if kv_cache == "int8" else 2.0
+    return 2.0 * layers * kvh * head_dim * itemsize * float(tokens)
+
+
+def kv_handoff_seconds(payload_bytes: float, spec: ChipSpec) -> float:
+    """Latency floor of moving one KV bundle from a prefill worker to a
+    decode worker: read out of the producer's HBM, one ICI crossing,
+    write into the consumer's HBM — ``bytes * (2/hbm_bw + 1/ici_bw)``.
+    The disaggregated serving cost term (``_serving_cost``) prices the
+    whole trace's bundles through this; the CPU-sim cluster COUNTS it
+    per handoff (``serve_handoff_ms``) rather than sleeping it, since a
+    simulated host never actually moves bytes at ICI speeds (the same
+    honesty rule as the fault plan's ``sim_link_gbs``)."""
+    if payload_bytes <= 0.0:
+        return 0.0
+    return float(payload_bytes) * (
+        2.0 / spec.hbm_bw + 1.0 / spec.link_bw("ici")
+    )
+
+
 def degraded_ring_time_s(
     op: str, nbytes: float, d: int, bw: float, factor: float = 1.0
 ) -> float:
@@ -440,6 +477,22 @@ def _decode_cost(impl, spec: ChipSpec) -> Terms:
     return compute, 0.0, hbm
 
 
+def _serving_cost(impl, spec: ChipSpec) -> Terms:
+    """serving_load: the decode census floor (``_decode_cost``) plus,
+    for disaggregated members, the KV-handoff wire term — every
+    prefill->decode bundle the trace will move, priced by
+    ``kv_handoff_seconds`` from the member's own bundle census
+    (``impl.handoff_bytes()``; members without one — the single-engine
+    and routed members — price zero and stay byte-identical to the
+    pre-cluster model). The family's ``cost_model()`` additionally
+    floors the prediction at the open-loop arrival horizon."""
+    compute, comm, hbm = _decode_cost(impl, spec)
+    census = getattr(impl, "handoff_bytes", None)
+    if callable(census):
+        comm += kv_handoff_seconds(float(census()), spec)
+    return compute, comm, hbm
+
+
 def _collective_cost(impl, spec: ChipSpec) -> Terms:
     """collectives: pure wire time for the ring members; for the
     compute_only member (an HBM copy — its payload census is
@@ -466,9 +519,10 @@ FAMILY_COST_MODELS: Dict[str, Callable[[object, ChipSpec], Terms]] = {
     "transformer_step": _model_step_cost,
     "transformer_decode": _decode_cost,
     # serving_load shares the decode census (weights+KV re-read floor vs
-    # compute); the family's cost_model() additionally floors the
-    # prediction at the open-loop trace's arrival horizon
-    "serving_load": _decode_cost,
+    # compute) plus the disaggregated members' KV-handoff wire term;
+    # the family's cost_model() additionally floors the prediction at
+    # the open-loop trace's arrival horizon
+    "serving_load": _serving_cost,
     "collectives": _collective_cost,
 }
 

@@ -276,6 +276,17 @@ _REGISTRY = {
             "ddlb_tpu.primitives.serving_load.static",
             "StaticServingLoad",
         ),
+        # serving cluster members (ISSUE 18, ddlb_tpu/serve): dp>1 as
+        # one engine per shard behind the prefix-affinity router, and
+        # disaggregated prefill/decode pools with a priced KV handoff
+        "router": (
+            "ddlb_tpu.primitives.serving_load.router",
+            "RouterServingLoad",
+        ),
+        "disagg": (
+            "ddlb_tpu.primitives.serving_load.disagg",
+            "DisaggServingLoad",
+        ),
     },
     # pipeline-parallel staged GEMM chain: no reference analogue
     # (SURVEY.md section 2.5 lists PP among the absent strategies);

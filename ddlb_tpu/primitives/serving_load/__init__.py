@@ -15,6 +15,18 @@ _LAZY = {
         "ddlb_tpu.primitives.serving_load.static",
         "StaticServingLoad",
     ),
+    "ClusterServingLoad": (
+        "ddlb_tpu.primitives.serving_load.cluster_base",
+        "ClusterServingLoad",
+    ),
+    "RouterServingLoad": (
+        "ddlb_tpu.primitives.serving_load.router",
+        "RouterServingLoad",
+    ),
+    "DisaggServingLoad": (
+        "ddlb_tpu.primitives.serving_load.disagg",
+        "DisaggServingLoad",
+    ),
 }
 
 

@@ -531,6 +531,20 @@ class ServingLoad(Primitive):
                 # the appender aligns to the first row written
                 "serve_peak_pages": s.peak_pages_in_use,
                 "serve_pages_capacity": s.pages_capacity,
+                # cluster ledger columns (ddlb_tpu/serve members
+                # override these; single-engine rows carry the neutral
+                # values for the same one-CSV-header reason, and the
+                # "single" topology stamp is the legacy bucket the SLO
+                # gate's composition fencing falls back to)
+                "serve_topology": "single",
+                "serve_shards": 1,
+                "serve_shards_excluded": 0,
+                "serve_rejected": 0,
+                "serve_handoffs": 0,
+                "serve_handoff_bytes": 0.0,
+                "serve_handoff_ms": 0.0,
+                "serve_drained": 0,
+                "serve_affinity_hits": 0,
             }
         )
         return out

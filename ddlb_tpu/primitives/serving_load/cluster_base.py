@@ -1,0 +1,573 @@
+"""ClusterServingLoad: the serving CLUSTER measured under open-loop
+traffic — the multi-engine members' shared drive loop.
+
+Where ``ServingLoad`` drives one ``ContinuousBatchingEngine``, this base
+drives a ``ddlb_tpu.serve.ServingCluster``: the trace's requests enter
+through the cluster's front door (token-bucket admission when enabled —
+a shed request is a COUNTED ``rejected`` outcome, never a lost one),
+are routed/disaggregated across engines, and the row reports the same
+``slo_*`` distribution columns plus the cluster's own ledger
+(``serve_rejected``, ``serve_handoffs``/``serve_handoff_bytes``/
+``serve_handoff_ms``, ``serve_drained``, ``serve_shards`` /
+``serve_shards_excluded``, ``serve_affinity_hits``) and a
+``serve_topology`` stamp (``router:dp=2``, ``disagg:p1+d1``, with a
+``:degraded=K`` suffix after a drill) the observatory's SLO gate fences
+baselines by — a degraded cluster's latencies must never set the bar
+for a healthy one (observatory/regress.detect_slo).
+
+Engine placement: with ``num_devices`` divisible by the engine count,
+every engine gets a DISJOINT device group (the real disaggregated
+shape); otherwise every engine spans the full device set (the CPU-sim
+fallback — correctness-identical, contention-shared). Either way the
+cost-model denominator stays ``num_devices``: the cluster's useful work
+rides the same chips.
+
+Validation extends the single-engine accounting invariant ACROSS the
+cluster: completed + rejected partition the trace exactly, every
+completion's prompt round-trips byte-identically (through any number of
+handoffs/drains — the bundle prompt is the ``preempt()`` fold, PR 11's
+no-token-ever-regenerated ledger extended across engines), and the SLO
+ledger agrees with the pooled completion count."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ddlb_tpu import telemetry
+from ddlb_tpu.observatory import live
+from ddlb_tpu.primitives.serving_load.base import (
+    _TICK_POST_INTERVAL_S,
+    ServingLoad,
+)
+from ddlb_tpu.workload import SLOTracker
+
+#: cluster knobs every multi-engine member shares (subclasses merge
+#: these into DEFAULT_OPTIONS next to their pool-shape knobs)
+CLUSTER_OPTIONS = {
+    #: front-door admission policy: "open" admits everything (the
+    #: uncontrolled baseline), "token_bucket" sheds past capacity
+    "admission": "open",
+    #: scale on the census-derived sustainable rate (prefix caching and
+    #: compute-bound prefill move real capacity off the census floor)
+    "admission_overcommit": 1.0,
+    #: explicit tokens/second override (0 = derive from the decode HBM
+    #: census, ddlb_tpu/serve/admission.decode_token_rate)
+    "admission_rate_tps": 0.0,
+    #: bucket depth in seconds of sustained rate (the tolerated burst)
+    "admission_burst_s": 0.5,
+    #: router affinity gives way to load above this imbalance ratio
+    "affinity_imbalance": 2.0,
+    #: SLO-aware straggler indictment: timed decode ticks per shard
+    #: before the watch may act (0 = watch off)
+    "watch_ticks": 0,
+    #: indictment needs worst median > dominance * best median
+    "watch_dominance": 2.0,
+}
+CLUSTER_ALLOWED = {
+    "admission": ["open", "token_bucket"],
+    "admission_overcommit": (0.01, None),
+    "admission_rate_tps": (0.0, None),
+    "admission_burst_s": (0.01, None),
+    "affinity_imbalance": (1.0, None),
+    "watch_ticks": (0, None),
+    "watch_dominance": (1.0, None),
+}
+
+
+class ClusterServingLoad(ServingLoad):
+    """ABC for multi-engine serving members. Subclasses declare the
+    pool shape (``_pool_sizes``) and the topology stamp prefix
+    (``_topology_base``); everything else — placement, the cluster
+    drive loop, ledger columns, validation — lives here."""
+
+    def _pool_sizes(self) -> Tuple[int, int]:
+        """(n_prefill_engines, n_decode_engines)."""
+        raise NotImplementedError
+
+    def _topology_base(self) -> str:
+        """Topology stamp before any ``:degraded=K`` suffix."""
+        raise NotImplementedError
+
+    def _admission_open(self, engine) -> bool:  # pragma: no cover
+        # the single-engine hook never runs here (the cluster pump owns
+        # admission); defined so the ABC is satisfied
+        return True
+
+    # -- shapes --------------------------------------------------------------
+
+    def _n_engines(self) -> int:
+        n_pre, n_dec = self._pool_sizes()
+        return n_pre + n_dec
+
+    def _mesh_factors(self) -> Tuple[int, int]:
+        """(n_engines, tp_per_engine): disjoint device groups when the
+        world divides evenly, else every engine spans all devices (the
+        CPU-sim fallback; see the module docstring)."""
+        n_eng = self._n_engines()
+        nd = self.runtime.num_devices
+        if nd >= n_eng and nd % n_eng == 0:
+            return n_eng, nd // n_eng
+        return n_eng, nd
+
+    def _check_shapes(self) -> None:
+        super()._check_shapes()
+        o = self.options
+        n_pre, n_dec = self._pool_sizes()
+        _, tp_per = self._mesh_factors()
+        if o["batch"] % n_dec != 0:
+            raise ValueError(
+                f"batch={o['batch']} not divisible by the decode pool "
+                f"size {n_dec} (slots split evenly across shards)"
+            )
+        if (o["batch"] // n_dec) % tp_per != 0:
+            raise ValueError(
+                f"per-shard batch {o['batch'] // n_dec} not divisible "
+                f"by per-engine tp={tp_per} (the MoE block router)"
+            )
+
+    # -- engine/cluster construction ----------------------------------------
+
+    def _device_groups(self):
+        import numpy as _np
+
+        n_eng, tp_per = self._mesh_factors()
+        devs = list(self.runtime.devices)
+        if len(devs) >= n_eng and len(devs) % n_eng == 0:
+            groups = [
+                devs[i * tp_per : (i + 1) * tp_per] for i in range(n_eng)
+            ]
+        else:
+            groups = [devs for _ in range(n_eng)]
+        import jax
+
+        return [
+            jax.sharding.Mesh(
+                _np.asarray(g, dtype=object).reshape(1, len(g)),
+                ("dp", "tp"),
+            )
+            for g in groups
+        ]
+
+    def _build_engine(self, mesh, cfg, max_batch, max_need, num_pages):
+        import jax
+
+        from ddlb_tpu.models.decode import make_decode_fn
+        from ddlb_tpu.models.serving import ContinuousBatchingEngine
+        from ddlb_tpu.models.transformer import init_params
+
+        tp = mesh.shape["tp"]
+        params = init_params(cfg, pp=1, n_experts=tp, seed=self.seed)
+        _, shardings = make_decode_fn(mesh, cfg)
+        params = {
+            k: jax.device_put(v, shardings[k]) for k, v in params.items()
+        }
+        jax.block_until_ready(params)
+        return ContinuousBatchingEngine(
+            mesh, cfg, params,
+            max_batch=max_batch, max_len=max_need, num_pages=num_pages,
+        )
+
+    def _make_admission(self):
+        o = self.options
+        if o["admission"] != "token_bucket":
+            return None
+        from ddlb_tpu.serve.admission import TokenBucket, decode_token_rate
+
+        rate = float(o["admission_rate_tps"])
+        if rate <= 0.0:
+            rate = decode_token_rate(
+                ctx=self.m,
+                d_model=self.n,
+                d_ff=self.k,
+                vocab=o["vocab"],
+                n_heads=o["n_heads"],
+                batch=o["batch"],
+                n_kv_heads=o["n_kv_heads"],
+                layers=o["layers"],
+                kv_cache=o["kv_cache"],
+                mlp_kernel=o["mlp_kernel"],
+                attn_kernel=o["attn_kernel"],
+                spec=self.runtime.chip_spec,
+                n_devices=self.runtime.num_devices,
+            ) * float(o["admission_overcommit"])
+        burst = max(1.0, rate * float(o["admission_burst_s"]))
+        return TokenBucket(rate, burst)
+
+    def _bundle_pricer(self):
+        from ddlb_tpu.perfmodel.cost import kv_bundle_bytes
+
+        o = self.options
+        return lambda kv_tokens: kv_bundle_bytes(
+            d_model=self.n,
+            n_heads=o["n_heads"],
+            n_kv_heads=o["n_kv_heads"],
+            layers=o["layers"],
+            kv_cache=o["kv_cache"],
+            tokens=kv_tokens,
+        )
+
+    def _prewarm(self, engines, n_dec, spec) -> None:
+        """Deterministic compile prewarm — the cluster analogue of the
+        single-engine rule that drain 1 carries every XLA compile.
+
+        With ONE engine the warmup drain necessarily visits every
+        admission bucket, so pooled drains replay against a warm jit
+        cache. Across a cluster the router's placement reacts to
+        wall-clock load, so a prompt bucket can reach some engine for
+        the FIRST time during a pooled drain and bill ~100 ms of XLA
+        compile to real request TTFTs (a one-drain stall that poisons
+        the pooled p95 for the whole row). Admit one ``max_new=1``
+        probe per distinct admission shape — prefix-hit x pad bucket,
+        including the one-token-longer handoff-resume prompts a
+        prefill pool produces — into EVERY engine (a 1-token request
+        prefill-completes at admission), plus one 2-token probe on
+        each decode engine for its decode-step program, then reset.
+
+        The probes must run under the same matmul-precision scope the
+        runner wraps measured calls in: jit's tracing cache keys on the
+        trace context, so a program compiled outside the scope is a
+        cache MISS inside it and the prewarm would buy nothing."""
+        from ddlb_tpu.models.serving import (
+            ContinuousBatchingEngine,
+            Request,
+        )
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+        from ddlb_tpu.workload import prefix_tokens
+
+        pfx = prefix_tokens(spec, 0) if spec.prefix_pop else None
+        P = int(pfx.size) if pfx is not None else 0
+        S_max = engines[0].S_max
+        bucket = ContinuousBatchingEngine._bucket
+        n_pre = len(engines) - n_dec
+        probes: Dict[Tuple[bool, int], np.ndarray] = {}
+        for r in self._trace:
+            lengths = {r.prompt.size}
+            if n_pre and r.max_new > 1:
+                # the decode pool re-prefills a handoff bundle whose
+                # prompt is one (prefill-pool) token longer
+                lengths.add(r.prompt.size + 1)
+            for L in lengths:
+                hit = (
+                    P > 0
+                    and L > P
+                    and r.prompt.size >= P
+                    and np.array_equal(r.prompt[:P], pfx)
+                )
+                key = (
+                    (True, P + min(bucket(L - P), S_max - P))
+                    if hit
+                    else (False, min(bucket(L), S_max))
+                )
+                if key in probes:
+                    continue
+                probe = np.zeros(L, np.int32)
+                probe[: r.prompt.size] = r.prompt
+                probes[key] = probe
+        with matmul_precision_scope(self.dtype):
+            for i, e in enumerate(engines):
+                for probe in probes.values():
+                    e.submit(Request(probe, max_new=1))
+                    e.admit_ready()
+                if i < n_dec:
+                    e.submit(Request(self._trace[0].prompt, max_new=2))
+                    e.admit_ready()
+                    e.step()
+                e.reset()
+
+    def _input_setup(self) -> None:
+        import jax
+
+        from ddlb_tpu.perfmodel.cost import kv_handoff_seconds
+        from ddlb_tpu.serve.cluster import ServingCluster
+        from ddlb_tpu.serve.router import PrefixAffinityRouter
+        from ddlb_tpu.workload import generate_trace, prefix_tokens
+
+        cfg = self._model_config()
+        o = self.options
+        n_pre, n_dec = self._pool_sizes()
+        # cost-model denominator: the cluster's work rides every device
+        # regardless of how engines partition them
+        self.num_partitions = self.runtime.num_devices
+        spec = self.workload_spec()
+        self._trace = generate_trace(spec)
+        max_need = max(r.prompt.size + r.max_new for r in self._trace)
+        batch_per = o["batch"] // n_dec
+        num_pages = None
+        if cfg.cache_layout == "paged":
+            ps = cfg.page_size
+            max_need = -(-max_need // ps) * ps
+            per_slot = max_need // ps
+            num_pages = max(
+                1, round(o["page_pool_frac"] * batch_per * per_slot)
+            )
+        meshes = self._device_groups()
+        engines = [
+            self._build_engine(m, cfg, batch_per, max_need, num_pages)
+            for m in meshes
+        ]
+        decode_engines = engines[:n_dec]
+        prefill_engines = engines[n_dec:]
+        if spec.prefix_pop:
+            # EVERY engine caches the hot prefix: resumed prompts still
+            # start with it, so decode-pool prefix hits survive handoff
+            for e in engines:
+                e.set_shared_prefix(prefix_tokens(spec, 0))
+        self._prewarm(engines, n_dec, spec)
+        chip = self.runtime.chip_spec
+        self._cluster = ServingCluster(
+            decode_engines,
+            prefill_engines,
+            router=PrefixAffinityRouter(
+                n_dec, imbalance=float(o["affinity_imbalance"])
+            ),
+            admission=self._make_admission(),
+            bundle_bytes=self._bundle_pricer(),
+            handoff_seconds=lambda b: kv_handoff_seconds(b, chip),
+            preempt_hol_ticks=o["preempt_hol_ticks"],
+            watch_ticks=o["watch_ticks"],
+            watch_dominance=float(o["watch_dominance"]),
+            slo_tpot_ms=float(o["slo_tpot_ms"]),
+        )
+        self.mesh = meshes[0]
+        self._last: Optional[Dict[str, Any]] = None
+        self._drains = 0
+        self._pooled: Optional[SLOTracker] = None
+        self._pooled_completed = 0
+        self._makespan_total = 0.0
+
+        def run_trace(tok0):
+            import jax.core as _core
+
+            if isinstance(tok0, _core.Tracer):
+                raise ValueError(
+                    "serving_load requires "
+                    "time_measurement_backend='host_clock' (the drain "
+                    "is host-scheduled open-loop replay)"
+                )
+            self._drain()
+            # fence on a decode-shard cache so timing includes the
+            # cluster's last step
+            return self._cluster.shards[0].engine.cache["k"]
+
+        self._fn = run_trace
+        self._args = (np.int32(0),)
+
+    # -- the cluster drive loop ---------------------------------------------
+
+    def _drain(self) -> None:
+        """One full open-loop replay against a freshly reset cluster.
+        Identical protocol to the single-engine drain; the termination
+        condition is the CLUSTER ledger — completed + rejected == trace
+        length (a shed request is an outcome, not a hang)."""
+        o = self.options
+        cl = self._cluster
+        cl.reset()
+        trace = self._trace
+        n = len(trace)
+        self._drains += 1
+        if self._drains == 1:
+            tracker = SLOTracker(o["slo_ttft_ms"], o["slo_tpot_ms"])
+        elif self._pooled is None:
+            tracker = self._pooled = SLOTracker(
+                o["slo_ttft_ms"], o["slo_tpot_ms"]
+            )
+        else:
+            tracker = self._pooled
+            tracker.new_drain()
+        gid2trace: Dict[int, int] = {}
+        orig_prompt = {r.index: r.prompt.size for r in trace}
+        submitted = 0
+        done_seen = 0
+        last_post = -_TICK_POST_INTERVAL_S
+        with telemetry.span(
+            "serve.drain", cat="serve", requests=n,
+            topology=self._topology_base(),
+        ):
+            t0 = time.perf_counter()
+            while cl.accounted < n:
+                now = time.perf_counter() - t0
+                while submitted < n and trace[submitted].arrival_s <= now:
+                    r = trace[submitted]
+                    gid, _admitted = cl.submit(
+                        r.prompt, r.max_new, r.prefix_id, now_s=now
+                    )
+                    gid2trace[gid] = r.index
+                    tracker.arrived(r.index, r.arrival_s)
+                    submitted += 1
+                tracker.observe_queue(cl.queue_depth)
+                active = cl.pump(time.perf_counter() - t0)
+                t_now = time.perf_counter() - t0
+                for c in cl.completions[done_seen:]:
+                    orig = gid2trace[c.request_id]
+                    tracker.first_token(orig, c.first_s)
+                    tracker.finished(
+                        orig,
+                        c.finished_s,
+                        c.tokens.size - orig_prompt[orig],
+                    )
+                done_seen = len(cl.completions)
+                if t_now - last_post >= _TICK_POST_INTERVAL_S:
+                    live.post_event(
+                        "serving_tick",
+                        queue_depth=cl.queue_depth,
+                        active=active,
+                        done=cl.accounted,
+                        total=n,
+                        shard_depths=cl.queue_depths(),
+                    )
+                    last_post = t_now
+                if (
+                    active == 0
+                    and not cl.queue_depth
+                    and submitted < n
+                ):
+                    wait = trace[submitted].arrival_s - (
+                        time.perf_counter() - t0
+                    )
+                    if wait > 0:
+                        time.sleep(wait)
+            makespan = time.perf_counter() - t0
+        horizon = max(self._trace_horizon_s(), 1e-9)
+        if tracker is self._pooled:
+            self._makespan_total += makespan
+            self._pooled_completed += len(cl.completions)
+            goodput_window = self._makespan_total
+        else:
+            goodput_window = makespan
+        fields = tracker.row_fields(goodput_window, offered_rps=n / horizon)
+        telemetry.record_max("serve.queue_depth", tracker.queue_peak)
+        telemetry.instant(
+            "serve.slo", cat="serve",
+            completed=tracker.completed,
+            rejected=len(cl.rejections),
+            ttft_p95_ms=fields["slo_ttft_p95_ms"],
+            goodput_rps=fields["slo_goodput_rps"],
+            queue_peak=tracker.queue_peak,
+        )
+        self._last = {
+            "tracker": tracker,
+            "fields": fields,
+            "makespan_s": makespan,
+            "completions": [
+                (gid2trace[c.request_id], c.tokens)
+                for c in cl.completions
+            ],
+            "rejected": [gid2trace[g] for g in cl.rejections],
+            "counters": dict(cl.counters),
+            "stats": cl.engine_stats(),
+            "affinity_hits": cl.router.affinity_hits,
+        }
+
+    # -- row columns ---------------------------------------------------------
+
+    def _topology(self) -> str:
+        base = self._topology_base()
+        excl = int(self._last["counters"]["shards_excluded"]) if self._last else 0
+        return f"{base}:degraded={excl}" if excl else base
+
+    def extra_row_fields(self) -> dict:
+        if self._last is None:
+            return {}
+        s = self._last["stats"]
+        c = self._last["counters"]
+        n_pre, n_dec = self._pool_sizes()
+        out = dict(self._last["fields"])
+        out.update(
+            {
+                "serve_occupancy": round(s.occupancy, 4),
+                "serve_prefix_hits": s.prefix_hits,
+                "serve_admissions_deferred": s.admissions_deferred,
+                "serve_preemptions": s.preemptions,
+                "serve_kv_evicted_tokens": s.kv_evicted_tokens,
+                "serve_peak_pages": s.peak_pages_in_use,
+                "serve_pages_capacity": s.pages_capacity,
+                "serve_topology": self._topology(),
+                "serve_shards": n_pre + n_dec,
+                "serve_shards_excluded": int(c["shards_excluded"]),
+                "serve_rejected": int(c["rejected"]),
+                "serve_handoffs": int(c["handoffs"]),
+                "serve_handoff_bytes": float(c["handoff_bytes"]),
+                "serve_handoff_ms": round(c["handoff_s"] * 1000.0, 4),
+                "serve_drained": int(c["drained"]),
+                "serve_affinity_hits": int(self._last["affinity_hits"]),
+            }
+        )
+        return out
+
+    # -- validation ----------------------------------------------------------
+
+    def validate(self, result) -> bool:
+        """The single-engine accounting invariant, extended across the
+        cluster: completed and rejected DISJOINTLY partition the trace
+        (exactly-once on both sides), every completion honors its
+        budget with its prompt byte-identical through any handoffs, and
+        the SLO ledger agrees with the pooled completion count."""
+        if self._last is None:
+            telemetry.log("serving_load validation FAILED: no drain ran")
+            return False
+        o = self.options
+        trace = {r.index: r for r in self._trace}
+        seen: Dict[int, int] = {}
+        ok = True
+        for orig, tokens in self._last["completions"]:
+            seen[orig] = seen.get(orig, 0) + 1
+            r = trace[orig]
+            S0 = r.prompt.size
+            if tokens.size != S0 + r.max_new:
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"length {tokens.size} != {S0 + r.max_new}"
+                )
+                ok = False
+                continue
+            if not np.array_equal(tokens[:S0], r.prompt):
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"prompt mangled (handoff chain broke the ledger)"
+                )
+                ok = False
+            if ((tokens < 0) | (tokens >= o["vocab"])).any():
+                telemetry.log(
+                    f"serving_load validation FAILED: request {orig} "
+                    f"token out of vocab range"
+                )
+                ok = False
+        rejected = list(self._last["rejected"])
+        if any(v != 1 for v in seen.values()):
+            telemetry.log(
+                "serving_load validation FAILED: a request completed "
+                "more than once (exactly-once broken across the cluster)"
+            )
+            ok = False
+        overlap = set(seen) & set(rejected)
+        if overlap:
+            telemetry.log(
+                f"serving_load validation FAILED: requests {sorted(overlap)} "
+                f"both completed AND rejected"
+            )
+            ok = False
+        if sorted(set(seen) | set(rejected)) != sorted(trace) or len(
+            rejected
+        ) != len(set(rejected)):
+            telemetry.log(
+                f"serving_load validation FAILED: outcomes do not "
+                f"partition the trace ({len(seen)} completed + "
+                f"{len(rejected)} rejected of {len(trace)})"
+            )
+            ok = False
+        tracker = self._last["tracker"]
+        expected = (
+            self._pooled_completed
+            if tracker is self._pooled
+            else len(self._last["completions"])
+        )
+        if tracker.completed != expected:
+            telemetry.log(
+                "serving_load validation FAILED: SLO ledger count "
+                f"{tracker.completed} != {expected}"
+            )
+            ok = False
+        return ok

@@ -1,0 +1,77 @@
+"""The disaggregated member: separate prefill and decode engine pools
+with an explicit, priced KV handoff between them.
+
+Prefill and decode want different hardware shapes (compute-bound vs
+HBM-bound — the reason disaggregated serving exists); this member
+realizes the split on the engine's own mechanism: a request enters the
+prefill pool as ``max_new=1`` (the engine completes ``max_new=1`` AT
+admission, so a prefill engine is a pure prefill server), and the
+remnant continues in the decode pool via a ``KVBundle`` — the bundle
+prompt is exactly the ``preempt()`` fold, so no token is ever
+re-generated and the prompt stays byte-identical through the seam
+(PR 11's ledger invariant, extended across engines).
+
+The handoff is PRICED, not slept: ``perfmodel.cost.kv_bundle_bytes``
+weighs the bundle with the same per-row convention as the decode HBM
+census, ``kv_handoff_seconds`` floors its latency (2 HBM crossings +
+one ICI hop), and the row counts both (``serve_handoff_bytes`` /
+``serve_handoff_ms``). The family cost model adds the same census as a
+wire term (``perfmodel.cost._serving_cost`` reads this member's
+``handoff_bytes``), so the predicted floor and the measured row price
+the seam identically. The ``serve.handoff`` fault site carries the
+real payload, so a ``link_slow`` chaos rule degrades exactly that wire.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ddlb_tpu.primitives.serving_load.cluster_base import (
+    CLUSTER_ALLOWED,
+    CLUSTER_OPTIONS,
+    ClusterServingLoad,
+)
+
+
+class DisaggServingLoad(ClusterServingLoad):
+    DEFAULT_OPTIONS = {
+        **CLUSTER_OPTIONS,
+        "prefill_shards": 1,
+        "decode_shards": 1,
+    }
+    ALLOWED_VALUES = {
+        **CLUSTER_ALLOWED,
+        "prefill_shards": (1, None),
+        "decode_shards": (1, None),
+    }
+
+    def _pool_sizes(self) -> Tuple[int, int]:
+        o = self.options
+        return o["prefill_shards"], o["decode_shards"]
+
+    def _topology_base(self) -> str:
+        o = self.options
+        return f"disagg:p{o['prefill_shards']}+d{o['decode_shards']}"
+
+    def handoff_bytes(self) -> float:
+        """Planned KV-handoff census for the whole trace: every request
+        with budget past its prefill token bundles ``S0 + 1`` rows to
+        the decode pool. The family cost model's wire term
+        (``perfmodel.cost._serving_cost``) prices exactly this — the
+        predicted floor and the measured ``serve_handoff_bytes`` column
+        count the same bytes."""
+        from ddlb_tpu.perfmodel.cost import kv_bundle_bytes
+
+        o = self.options
+        return sum(
+            kv_bundle_bytes(
+                d_model=self.n,
+                n_heads=o["n_heads"],
+                n_kv_heads=o["n_kv_heads"],
+                layers=o["layers"],
+                kv_cache=o["kv_cache"],
+                tokens=r.prompt.size + 1,
+            )
+            for r in self._trace
+            if r.max_new > 1
+        )

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu.ops.collective_matmul import ring_matmul_rs
 from ddlb_tpu.ops.matmul import matmul
 from ddlb_tpu.primitives.tp_rowwise.base import TPRowwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class PallasTPRowwise(TPRowwise):
@@ -98,8 +99,10 @@ class PallasTPRowwise(TPRowwise):
                         partial, "tp", scatter_dimension=0, tiled=True
                     )
 
+                # shard_map_compat: jax.shard_map where it exists, the
+                # pre-0.5 experimental entry point otherwise (jax 0.4.x)
                 return jax.jit(
-                    jax.shard_map(
+                    shard_map_compat(
                         step,
                         mesh=self.mesh,
                         in_specs=(P(None, "tp"), P("tp", None)),
@@ -128,7 +131,7 @@ class PallasTPRowwise(TPRowwise):
             return
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P(None, "tp"), P("tp", None)),

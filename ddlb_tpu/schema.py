@@ -172,4 +172,16 @@ ROW_COLUMNS: Dict[str, str] = {
     "serve_queue_mean": "mean admission-queue depth over the drain",
     "serve_preemptions": "requests preempted (requeued, KV evicted)",
     "serve_kv_evicted_tokens": "KV cache rows abandoned by preemptions",
+    # -- serving cluster ledger (ISSUE 18: ddlb_tpu/serve — routed dp>1
+    #    and disaggregated prefill/decode members; single-engine rows
+    #    carry "single" / zeros so a mixed sweep keeps one CSV header) --
+    "serve_topology": "cluster composition stamp (single, router:dp=N, disagg:pP+dD; :degraded=K after a drill)",
+    "serve_shards": "engines in the serving cluster (1 = single engine)",
+    "serve_shards_excluded": "decode shards indicted and drained this row",
+    "serve_rejected": "requests shed at the admission-control door",
+    "serve_handoffs": "prefill->decode / drain KV-bundle handoffs",
+    "serve_handoff_bytes": "KV bytes moved across engine handoffs (priced census)",
+    "serve_handoff_ms": "priced cumulative handoff latency (not slept on CPU-sim)",
+    "serve_drained": "in-flight/queued requests migrated off indicted shards",
+    "serve_affinity_hits": "router dispatches that honored prefix affinity",
 }

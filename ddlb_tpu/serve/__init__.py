@@ -1,0 +1,38 @@
+"""Serving cluster in front of ``ContinuousBatchingEngine`` (ISSUE 18).
+
+Three mechanisms behind one facade (``ServingCluster``):
+
+- **disaggregated prefill/decode** (``cluster.py`` + ``handoff.py``):
+  separate engine pools; prefill produces a ``KVBundle`` (prompt + the
+  first generated token), decode admits it — the KV-handoff step,
+  priced by ``perfmodel.cost.kv_handoff_seconds`` and counted in
+  ``serve_handoff*`` columns;
+- a **prefix-affinity router** (``router.py``) for dp>1: one engine
+  per dp shard, Zipf-prefix-cache affinity first, least-outstanding-
+  work tiebreak;
+- a **token-bucket admission controller** (``admission.py``) tuned
+  against the perfmodel decode HBM census — load beyond capacity is
+  shed at the door with a counted ``rejected`` outcome.
+
+Lazy re-exports, matching the package-wide pattern (importing the
+package must not trigger backend imports)."""
+
+from __future__ import annotations
+
+_LAZY = {
+    "KVBundle": ("ddlb_tpu.serve.handoff", "KVBundle"),
+    "TokenBucket": ("ddlb_tpu.serve.admission", "TokenBucket"),
+    "decode_token_rate": ("ddlb_tpu.serve.admission", "decode_token_rate"),
+    "PrefixAffinityRouter": ("ddlb_tpu.serve.router", "PrefixAffinityRouter"),
+    "ServingCluster": ("ddlb_tpu.serve.cluster", "ServingCluster"),
+    "ClusterCompletion": ("ddlb_tpu.serve.cluster", "ClusterCompletion"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module_name, attr = _LAZY[name]
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

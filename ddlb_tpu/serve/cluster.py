@@ -1,0 +1,536 @@
+"""The serving cluster: N engines behind one submit/pump facade.
+
+One ``ContinuousBatchingEngine`` is a dp=1 world by design (its batch
+axis IS the slot axis). The cluster composes engines two ways, behind
+the same API:
+
+- **routed** (``prefill_engines=()``): one engine per dp shard; the
+  ``PrefixAffinityRouter`` picks a shard per request (prefix-cache
+  affinity first, least-outstanding-work tiebreak);
+- **disaggregated** (``prefill_engines`` non-empty): prompts go to the
+  prefill pool as ``max_new=1`` requests — the engine completes
+  ``max_new=1`` AT admission, so a prefill engine is a pure prefill
+  server whose completions surface one tick later — and the remnant
+  continues in the decode pool via an explicit ``KVBundle`` handoff
+  (the bundle prompt is exactly the ``preempt()`` fold, so no token is
+  ever re-generated; the transfer is PRICED, not slept, on CPU-sim).
+
+An optional ``TokenBucket`` sheds load at the door (``submit`` returns
+``admitted=False``; the ledger counts rejections, it never loses them)
+and an optional SLO-aware watch indicts a decode shard whose median
+tick time both dominates its peers AND breaks the TPOT SLO on its own
+— the indicted shard drains in-flight work to the survivors over the
+same handoff path (``drain_shard``), so a chaos drill completes every
+admitted request.
+
+Time is explicit: every mutating call takes ``now_s`` from the
+caller's drain clock, so the drive loop (and tests) replay exact
+schedules. The cluster itself never sleeps.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddlb_tpu import faults, telemetry
+from ddlb_tpu.models.serving import EngineStats, Request
+from ddlb_tpu.serve.handoff import KVBundle
+from ddlb_tpu.serve.router import PrefixAffinityRouter
+
+#: per-shard tick-time window the indictment watch keeps (enough for a
+#: stable median, bounded so a long drain cannot grow it unboundedly)
+_TICK_WINDOW = 64
+
+
+@dataclass
+class ClusterCompletion:
+    """A finished request, in cluster terms: ``request_id`` is the
+    cluster-global id ``submit`` returned (stable across pools and
+    handoffs), ``shard`` the decode shard that finished it, and
+    ``first_s``/``finished_s`` the drain-clock stamps the SLO tracker
+    consumes (``first_s`` is recorded at the pump that admitted the
+    request — the real TTFT, not the completion time)."""
+
+    request_id: int
+    tokens: np.ndarray
+    finished_by: str
+    shard: int
+    first_s: float
+    finished_s: float
+    handoffs: int
+
+
+@dataclass
+class _ReqState:
+    """Host-side ledger entry for one submitted request."""
+
+    gid: int
+    prompt_size: int
+    max_new: int
+    prefix_id: int
+    first_s: Optional[float] = None
+    handoffs: int = 0
+    drained: bool = False
+
+
+class _Shard:
+    """One engine plus the cluster's per-engine bookkeeping."""
+
+    def __init__(self, engine, index: int, pool: str):
+        self.engine = engine
+        self.index = index          # cluster-global shard index
+        self.pool = pool            # "prefill" | "decode"
+        # fault-plan match context: a chaos rule with
+        # match={"shard": "1"} targets exactly this engine's sites
+        engine.fault_context = {"shard": str(index)}
+        self.alias: Dict[int, int] = {}   # engine req idx -> gid
+        self.excluded = False
+        self.done_seen = 0          # engine completions consumed
+        self.tick_s: List[float] = []     # active-tick host seconds
+        self.hol_ticks = 0
+        self.last_head: Optional[int] = None
+
+    def reset(self) -> None:
+        self.engine.reset()
+        self.alias = {}
+        self.excluded = False
+        self.done_seen = 0
+        self.tick_s = []
+        self.hol_ticks = 0
+        self.last_head = None
+
+
+class ServingCluster:
+    """See the module docstring. ``decode_engines`` are the routed /
+    decode pool (router indices = positions in this list);
+    ``prefill_engines`` non-empty selects disaggregated mode.
+
+    ``bundle_bytes(kv_tokens)`` and ``handoff_seconds(payload_bytes)``
+    price the KV handoff (``perfmodel.cost.kv_bundle_bytes`` /
+    ``kv_handoff_seconds`` in production; tests pass stubs).
+    ``admission`` is an optional ``TokenBucket``. ``watch_ticks > 0``
+    arms the indictment watch (needs ``slo_tpot_ms`` finite to ever
+    fire — the watch is SLO-aware by construction)."""
+
+    def __init__(
+        self,
+        decode_engines: Sequence,
+        prefill_engines: Sequence = (),
+        *,
+        router: Optional[PrefixAffinityRouter] = None,
+        admission=None,
+        bundle_bytes: Optional[Callable[[int], float]] = None,
+        handoff_seconds: Optional[Callable[[float], float]] = None,
+        preempt_hol_ticks: int = 0,
+        watch_ticks: int = 0,
+        watch_dominance: float = 2.0,
+        slo_tpot_ms: float = float("inf"),
+    ):
+        if not decode_engines:
+            raise ValueError("need at least one decode engine")
+        self.shards = [
+            _Shard(e, i, "decode") for i, e in enumerate(decode_engines)
+        ]
+        n_dec = len(self.shards)
+        self.prefill = [
+            _Shard(e, n_dec + i, "prefill")
+            for i, e in enumerate(prefill_engines)
+        ]
+        self.disagg = bool(self.prefill)
+        self.router = router or PrefixAffinityRouter(n_dec)
+        if self.router.n_shards != n_dec:
+            raise ValueError(
+                f"router covers {self.router.n_shards} shards but the "
+                f"decode pool has {n_dec}"
+            )
+        self.admission = admission
+        self._bundle_bytes = bundle_bytes or (lambda kv_tokens: 0.0)
+        self._handoff_seconds = handoff_seconds or (lambda b: 0.0)
+        self.preempt_hol_ticks = int(preempt_hol_ticks)
+        self.watch_ticks = int(watch_ticks)
+        self.watch_dominance = float(watch_dominance)
+        self.slo_tpot_ms = float(slo_tpot_ms)
+        self._clear_run_state()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _clear_run_state(self) -> None:
+        self._reqs: List[_ReqState] = []
+        self.completions: List[ClusterCompletion] = []
+        self.rejections: List[int] = []
+        self.counters: Dict[str, float] = {
+            "rejected": 0,
+            "handoffs": 0,
+            "handoff_bytes": 0.0,
+            "handoff_s": 0.0,
+            "drained": 0,
+            "shards_excluded": 0,
+        }
+
+    def reset(self) -> None:
+        """Fresh drain against compile-cached engines: every engine
+        resets (shared prefixes survive, per the engine contract), the
+        router forgets learned affinities and exclusions, the admission
+        bucket refills, the ledger clears."""
+        for sh in self.prefill + self.shards:
+            sh.reset()
+        self.router = PrefixAffinityRouter(
+            len(self.shards), self.router.imbalance
+        )
+        if self.admission is not None:
+            self.admission._level = self.admission.burst_tokens
+            self.admission._last_s = 0.0
+            self.admission.admitted = 0
+            self.admission.rejected = 0
+        self._clear_run_state()
+
+    # -- gauges ------------------------------------------------------------
+
+    def _live(self, pool: List[_Shard]) -> List[_Shard]:
+        return [sh for sh in pool if not sh.excluded]
+
+    def queue_depths(self) -> List[int]:
+        """Per-decode-shard queued-request gauge for the live dashboard
+        (-1 marks an excluded shard — visibly dead, not merely idle)."""
+        return [
+            -1 if sh.excluded else sh.engine.queue_depth
+            for sh in self.shards
+        ]
+
+    @property
+    def queue_depth(self) -> int:
+        """Total queued requests across every live engine (both pools)
+        — the saturation gauge the drive loop samples per tick."""
+        return sum(
+            sh.engine.queue_depth
+            for sh in self._live(self.prefill) + self._live(self.shards)
+        )
+
+    @property
+    def accounted(self) -> int:
+        """Requests with a final outcome: completed + rejected. The
+        drive loop terminates when this reaches the trace length —
+        every submitted request ends in exactly one of the two."""
+        return len(self.completions) + len(self.rejections)
+
+    def engine_stats(self) -> EngineStats:
+        """Cluster-aggregate engine counters (prefill engines contribute
+        admissions/prefix hits but no lane ticks — they never decode, so
+        the occupancy ratio stays a decode-pool statement)."""
+        total = EngineStats()
+        for sh in self.prefill + self.shards:
+            s = sh.engine.stats
+            total.steps += s.steps
+            total.generated += s.generated
+            total.admissions += s.admissions
+            total.lane_ticks_active += s.lane_ticks_active
+            total.lane_ticks_total += s.lane_ticks_total
+            total.prefix_hits += s.prefix_hits
+            total.prefill_tokens_saved += s.prefill_tokens_saved
+            total.preemptions += s.preemptions
+            total.kv_evicted_tokens += s.kv_evicted_tokens
+            total.pages_capacity += s.pages_capacity
+            total.pages_in_use += s.pages_in_use
+            total.peak_pages_in_use += s.peak_pages_in_use
+            total.admissions_deferred += s.admissions_deferred
+        return total
+
+    # -- submission --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new: int,
+        prefix_id: int = -1,
+        now_s: float = 0.0,
+    ) -> Tuple[int, bool]:
+        """One request at the cluster's front door. Returns ``(gid,
+        admitted)``; a shed request gets a gid too (the ledger counts
+        rejections, it never loses them) but touches no engine."""
+        prompt = np.asarray(prompt, np.int32)
+        gid = len(self._reqs)
+        self._reqs.append(
+            _ReqState(
+                gid=gid,
+                prompt_size=int(prompt.size),
+                max_new=int(max_new),
+                prefix_id=int(prefix_id),
+            )
+        )
+        if self.admission is not None and not self.admission.try_take(
+            float(max_new), now_s
+        ):
+            self.rejections.append(gid)
+            self.counters["rejected"] += 1
+            telemetry.instant(
+                "serve.reject", cat="serve", request=gid, tokens=max_new
+            )
+            return gid, False
+        if self.disagg:
+            # prefill pool: least-outstanding live prefill engine gets a
+            # max_new=1 request (completes AT admission — pure prefill)
+            live = self._live(self.prefill)
+            if not live:
+                raise RuntimeError("no live prefill shards")
+            sh = min(
+                live, key=lambda s: (s.engine.outstanding_tokens(), s.index)
+            )
+            idx = sh.engine.submit(Request(prompt, max_new=1))
+            sh.alias[idx] = gid
+        else:
+            self._dispatch(gid, Request(prompt, max_new=max_new))
+        return gid, True
+
+    def _dispatch(self, gid: int, req: Request) -> None:
+        """Route a fresh (no-KV) request into the decode pool."""
+        st = self._reqs[gid]
+        out = [sh.engine.outstanding_tokens() for sh in self.shards]
+        s = self.router.route(st.prefix_id, out)
+        idx = self.shards[s].engine.submit(req)
+        self.shards[s].alias[idx] = gid
+
+    # -- the pump ----------------------------------------------------------
+
+    def pump(self, now_s: float) -> int:
+        """One cluster tick: admit on every live engine, stamp first
+        tokens, apply HOL relief, step every live engine (timing decode
+        ticks for the watch), collect completions (prefill completions
+        become handoffs), then let the watch act. Returns the total
+        active-lane count (0 + empty queues = idle)."""
+        live_pre = self._live(self.prefill)
+        live_dec = self._live(self.shards)
+        # 1. admissions; routed decode admissions stamp TTFT here (the
+        # admission prefill computed the request's first token)
+        for sh in live_pre:
+            sh.engine.admit_ready()
+        for sh in live_dec:
+            admitted = sh.engine.admit_ready()
+            if admitted:
+                for slot in sh.engine.active_slots():
+                    gid = sh.alias[sh.engine.slot_request(slot)]
+                    self._stamp_first(gid, now_s)
+            # head-of-line relief, per shard (same policy as the
+            # single-engine driver: a head stuck while nothing was
+            # admitted accrues ticks; relief preempts the active slot
+            # with the MOST remaining budget)
+            if self.preempt_hol_ticks > 0:
+                head = sh.engine.queue_head()
+                if head is None or admitted:
+                    sh.hol_ticks = 0
+                elif head == sh.last_head:
+                    sh.hol_ticks += 1
+                else:
+                    sh.hol_ticks = 1
+                sh.last_head = head
+                if sh.hol_ticks >= self.preempt_hol_ticks:
+                    self._relieve_head(sh)
+                    sh.hol_ticks = 0
+        # 2. step every live engine, timing decode ticks for the watch
+        total_active = 0
+        for sh in live_pre:
+            total_active += sh.engine.step()
+        for sh in live_dec:
+            t0 = time.perf_counter()
+            active = sh.engine.step()
+            if active:
+                sh.tick_s.append(time.perf_counter() - t0)
+                del sh.tick_s[:-_TICK_WINDOW]
+            total_active += active
+        # 3. collect completions (order: prefill first, so a bundle can
+        # reach a decode queue in the same pump it was produced)
+        for sh in live_pre:
+            for c in sh.engine.completions[sh.done_seen:]:
+                gid = sh.alias[c.request_index]
+                st = self._reqs[gid]
+                self._stamp_first(gid, now_s)
+                generated = int(c.tokens.size) - st.prompt_size
+                remaining = st.max_new - generated
+                if remaining <= 0:
+                    # max_new=1 request: prefill WAS the whole job
+                    self._finalize(gid, c, sh.index, now_s)
+                else:
+                    self._handoff(
+                        KVBundle(
+                            request_id=gid,
+                            tokens=c.tokens,
+                            generated=generated,
+                            remaining=remaining,
+                            prefix_id=st.prefix_id,
+                            kv_tokens=int(c.tokens.size),
+                            payload_bytes=float(
+                                self._bundle_bytes(int(c.tokens.size))
+                            ),
+                            produced_s=now_s,
+                        ),
+                        now_s,
+                    )
+            sh.done_seen = len(sh.engine.completions)
+        for sh in live_dec:
+            for c in sh.engine.completions[sh.done_seen:]:
+                gid = sh.alias[c.request_index]
+                self._stamp_first(gid, now_s)
+                self._finalize(gid, c, sh.index, now_s)
+            sh.done_seen = len(sh.engine.completions)
+        # 4. the indictment watch
+        self._watch(now_s)
+        return total_active
+
+    def _stamp_first(self, gid: int, now_s: float) -> None:
+        st = self._reqs[gid]
+        if st.first_s is None:
+            st.first_s = now_s
+
+    def _finalize(self, gid: int, c, shard: int, now_s: float) -> None:
+        st = self._reqs[gid]
+        self.completions.append(
+            ClusterCompletion(
+                request_id=gid,
+                tokens=c.tokens,
+                finished_by=c.finished_by,
+                shard=shard,
+                first_s=st.first_s if st.first_s is not None else now_s,
+                finished_s=now_s,
+                handoffs=st.handoffs,
+            )
+        )
+
+    def _relieve_head(self, sh: _Shard) -> None:
+        """Preempt the active slot with the most remaining budget so the
+        stuck head can admit (the single-engine HOL policy, applied
+        per shard — the remnant requeues on the SAME engine, so this is
+        ``preempt``, not a handoff)."""
+        slots = sh.engine.active_slots()
+        if not slots:
+            return
+        victim = max(slots, key=lambda s: sh.engine.remaining_budget(s))
+        if sh.engine.remaining_budget(victim) <= 1:
+            return  # nothing worth evicting
+        old_idx = sh.engine.slot_request(victim)
+        new_idx = sh.engine.preempt(victim, requeue="back")
+        sh.alias[new_idx] = sh.alias[old_idx]
+
+    # -- the handoff -------------------------------------------------------
+
+    def _handoff(self, bundle: KVBundle, now_s: float) -> None:
+        """Move one in-flight request into the decode pool: price the
+        bundle, fire the ``serve.handoff`` chaos site with the REAL
+        payload (a ``link_slow`` rule scales with it), route by
+        surviving affinity, and resume as ``Request(bundle.tokens,
+        max_new=remaining)`` — exactly the ``preempt()`` fold, so the
+        consumer re-prefills to an identical greedy chain."""
+        st = self._reqs[bundle.request_id]
+        out = [sh.engine.outstanding_tokens() for sh in self.shards]
+        target = self.router.route(bundle.prefix_id, out)
+        # chaos surface: wedge/error/slow the handoff itself, priced
+        # against the real KV payload (faults/plan.SITES)
+        faults.inject(
+            "serve.handoff",
+            payload_bytes=bundle.payload_bytes,
+            shard=str(target),
+        )
+        priced = float(self._handoff_seconds(bundle.payload_bytes))
+        self.counters["handoffs"] += 1
+        self.counters["handoff_bytes"] += bundle.payload_bytes
+        self.counters["handoff_s"] += priced
+        st.handoffs += 1
+        sh = self.shards[target]
+        idx = sh.engine.submit(
+            Request(bundle.tokens, max_new=bundle.remaining)
+        )
+        sh.alias[idx] = bundle.request_id
+        telemetry.instant(
+            "serve.handoff", cat="serve",
+            request=bundle.request_id, shard=target,
+            kv_tokens=bundle.kv_tokens, bytes=bundle.payload_bytes,
+        )
+
+    # -- degradation -------------------------------------------------------
+
+    def _watch(self, now_s: float) -> None:
+        """SLO-aware straggler indictment over decode shards: once every
+        live shard has ``watch_ticks`` timed ticks, indict the shard
+        whose median tick BOTH dominates the best by
+        ``watch_dominance`` AND breaks the TPOT SLO on its own — a
+        shard that is slower but still inside the SLO is left alone
+        (rebalancing healthy skew is the router's job, not the
+        watch's)."""
+        if self.watch_ticks <= 0:
+            return
+        live = self._live(self.shards)
+        if len(live) < 2:
+            return  # serving relaunch rule: never drain the last shard
+        if any(len(sh.tick_s) < self.watch_ticks for sh in live):
+            return
+        meds = {sh.index: statistics.median(sh.tick_s) for sh in live}
+        worst = max(live, key=lambda sh: meds[sh.index])
+        best = min(live, key=lambda sh: meds[sh.index])
+        w, b = meds[worst.index], meds[best.index]
+        if w <= self.watch_dominance * b:
+            return
+        if w * 1000.0 <= self.slo_tpot_ms:
+            return
+        telemetry.instant(
+            "serve.indict", cat="serve", shard=worst.index,
+            median_ms=round(w * 1000.0, 3),
+            best_ms=round(b * 1000.0, 3),
+        )
+        self.drain_shard(worst.index, now_s)
+
+    def drain_shard(self, shard: int, now_s: float) -> None:
+        """Exclude decode shard ``shard`` and migrate its in-flight work
+        to the survivors: active slots evict into ``KVBundle``s (the
+        drain IS a handoff — priced, counted, greedy chain preserved),
+        queued-but-unadmitted requests re-route as fresh submissions
+        (no KV exists yet, nothing to price). The shard's engine stays
+        constructed (its stats still aggregate) but receives no further
+        traffic. Requires at least one surviving decode shard."""
+        sh = self.shards[shard]
+        if sh.excluded:
+            return
+        survivors = [
+            s for s in self._live(self.shards) if s.index != shard
+        ]
+        if not survivors:
+            raise RuntimeError(
+                "cannot drain the last live decode shard"
+            )
+        sh.excluded = True
+        self.counters["shards_excluded"] += 1
+        # router first: re-routes below must not land on the corpse
+        self.router.drop_shard(shard)
+        for slot in list(sh.engine.active_slots()):
+            idx, remnant = sh.engine.evict(slot)
+            gid = sh.alias[idx]
+            st = self._reqs[gid]
+            st.drained = True
+            self.counters["drained"] += 1
+            self._handoff(
+                KVBundle(
+                    request_id=gid,
+                    tokens=remnant.prompt,
+                    generated=int(remnant.prompt.size) - st.prompt_size,
+                    remaining=remnant.max_new,
+                    prefix_id=st.prefix_id,
+                    kv_tokens=int(remnant.prompt.size),
+                    payload_bytes=float(
+                        self._bundle_bytes(int(remnant.prompt.size))
+                    ),
+                    produced_s=now_s,
+                ),
+                now_s,
+            )
+        for idx, req in sh.engine.drop_queue():
+            gid = sh.alias[idx]
+            self._reqs[gid].drained = True
+            self.counters["drained"] += 1
+            self._dispatch(gid, req)
+        telemetry.instant(
+            "serve.drain_shard", cat="serve", shard=shard,
+            drained=int(self.counters["drained"]),
+            survivors=len(survivors),
+        )

@@ -1,0 +1,60 @@
+"""The KV-handoff unit: what a prefill worker hands a decode worker.
+
+Disaggregated serving splits one request's life across two engines:
+the prefill pool computes the prompt's KV rows and the FIRST generated
+token, then the decode pool continues the greedy chain. The bundle is
+the explicit seam: the tokens materialized so far (original prompt +
+everything generated — byte-identity of the prompt prefix is the
+ledger invariant, extended across the handoff), the remaining budget,
+and the priced size of the KV rows that would move over the wire on
+real hardware (``perfmodel.cost.kv_bundle_bytes``).
+
+On CPU-sim the consumer RE-PREFILLS the bundle's tokens instead of
+receiving cache rows (the engines do not share HBM); the token stream
+is identical by the engine's own greedy-chain contract — the bundle
+prompt is exactly the fold ``preempt()`` performs, so no token is
+ever re-generated — while the transfer is PRICED, not slept
+(``serve_handoff_bytes`` / ``serve_handoff_ms`` columns, the
+``serve.handoff`` fault site carrying ``payload_bytes`` so a
+``link_slow`` rule can realize a degraded interconnect).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class KVBundle:
+    """One prefill->decode (or drain->survivor) migration unit."""
+
+    #: cluster-global request id (stable across pools/handoffs — the
+    #: exactly-once ledger keys on it)
+    request_id: int
+    #: tokens materialized so far: original prompt + generated prefix
+    #: (the resume prompt; its head is byte-identical to the original)
+    tokens: np.ndarray
+    #: generated tokens folded into ``tokens`` (ledger bookkeeping)
+    generated: int
+    #: budget still to generate on the consumer side (>= 1; a request
+    #: whose budget is exhausted completes in place and never bundles)
+    remaining: int
+    #: workload prefix-population rank (-1 = none) — the router's
+    #: affinity signal survives the handoff
+    prefix_id: int
+    #: KV rows the bundle carries (``tokens.size``)
+    kv_tokens: int
+    #: priced bundle size (``perfmodel.cost.kv_bundle_bytes``)
+    payload_bytes: float
+    #: cluster-clock second the producer finished (handoff latency
+    #: accounting starts here)
+    produced_s: float
+
+    def __post_init__(self):
+        self.tokens = np.asarray(self.tokens, np.int32)
+        if self.remaining < 1:
+            raise ValueError(
+                f"a bundle needs remaining budget >= 1, got {self.remaining}"
+            )

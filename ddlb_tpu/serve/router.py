@@ -1,0 +1,82 @@
+"""Prefix-affinity request router: dp>1 as one engine per dp shard.
+
+The engine's shared-prefix cache is per engine — a Zipf workload's hot
+prefix only pays its prefill once PER SHARD that serves it, so the
+router's first preference is affinity: requests carrying a prefix the
+cluster has already routed go back to the same shard (a cache hit
+there, a guaranteed miss anywhere else). Affinity yields to load: when
+the affine shard's outstanding work exceeds ``imbalance * (best + 1)``
+the request falls through to the least-outstanding-work shard (ties:
+lowest index — deterministic), which is also the policy for
+prefix-less requests. Outstanding work is measured in TOKENS still to
+generate (queued budgets + active remainders), not request counts —
+a queue of long generations is more load than one of short ones.
+
+Every decision is one ``serve.route`` fault-site call (context
+``shard=<chosen>``), so a chaos plan can wedge or error the dispatch
+path itself. ``drop_shard`` removes an indicted shard from the
+candidate set and forgets affinities pointing at it — subsequent
+traffic re-homes on the survivors (the degraded-relaunch half of the
+drill; the in-flight half is the cluster's ``drain_shard``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from ddlb_tpu import faults
+
+
+class PrefixAffinityRouter:
+    def __init__(self, n_shards: int, imbalance: float = 2.0) -> None:
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        if imbalance < 1.0:
+            raise ValueError(f"imbalance must be >= 1.0, got {imbalance}")
+        self.n_shards = int(n_shards)
+        self.imbalance = float(imbalance)
+        #: prefix population rank -> shard that first served it
+        self.affinity: Dict[int, int] = {}
+        self.excluded: set = set()
+        self.affinity_hits = 0
+        self.routed = 0
+
+    def live_shards(self) -> List[int]:
+        return [
+            s for s in range(self.n_shards) if s not in self.excluded
+        ]
+
+    def drop_shard(self, shard: int) -> None:
+        """Exclude ``shard`` and forget affinities homed on it (their
+        prefixes re-home on whichever survivor serves them next)."""
+        self.excluded.add(int(shard))
+        self.affinity = {
+            p: s for p, s in self.affinity.items() if s != shard
+        }
+
+    def route(self, prefix_id: int, outstanding: Sequence[float]) -> int:
+        """Pick a live shard for one request. ``outstanding[s]`` is
+        shard ``s``'s tokens-still-to-generate gauge (indexed over ALL
+        shards; excluded entries are ignored)."""
+        live = self.live_shards()
+        if not live:
+            raise RuntimeError("no live shards to route to")
+        best = min(live, key=lambda s: (outstanding[s], s))
+        choice = best
+        if prefix_id >= 0:
+            aff = self.affinity.get(prefix_id)
+            if aff is not None and aff in live:
+                # affinity wins unless the affine shard is drowning
+                # relative to the best (+1 keeps a zero-load best from
+                # making ANY affine load "imbalanced")
+                if outstanding[aff] <= self.imbalance * (
+                    outstanding[best] + 1.0
+                ):
+                    choice = aff
+                    self.affinity_hits += 1
+            else:
+                self.affinity[prefix_id] = choice
+        self.routed += 1
+        # chaos surface: a plan can wedge/error/delay the dispatch
+        # decision of a live cluster (faults/plan.SITES)
+        faults.inject("serve.route", shard=str(choice))
+        return choice

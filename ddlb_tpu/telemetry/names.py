@@ -72,7 +72,20 @@ INSTANT_NAMES: Dict[str, str] = {
     "pool.reuse": "a row dispatched onto an already-warm pool worker",
     "queue.parked": "measure_queue parked a row (deterministic failure)",
     "runner.quarantine": "an impl crossed the consecutive-failure gate",
+    "serve.drain_shard": (
+        "serving cluster drained an excluded shard's in-flight "
+        "requests to survivors over KV handoffs"
+    ),
+    "serve.handoff": (
+        "serving cluster KV bundle shipped prefill pool -> decode "
+        "pool (or shard -> shard on a drain)"
+    ),
+    "serve.indict": (
+        "serving cluster SLO watch indicted a dominated shard "
+        "(dropped from the router's live set)"
+    ),
     "serve.preempt": "serving engine preempted a slot (requeued, KV evicted)",
+    "serve.reject": "serving cluster admission controller shed a request",
     "serve.slo": "serving_load end-of-drain SLO summary (TTFT/goodput)",
     "serve.ticks": "serving engine decode-tick marker",
 }

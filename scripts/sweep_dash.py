@@ -126,6 +126,15 @@ def _serving_lines(state):
     if depths:
         head = f"  queue depth (peak {max(depths)}): "
         lines.append(head + _sparkline(depths))
+    shards = serving.get("shard_depths")
+    if shards:
+        # cluster members (ISSUE 18): per-decode-shard queue gauges;
+        # -1 marks a drained shard (dead, not merely idle)
+        cells = " ".join(
+            f"s{i}:{'drained' if d < 0 else d}"
+            for i, d in enumerate(shards)
+        )
+        lines.append(f"  shard queues: {cells}")
     if progress and progress.get("total"):
         lines.append(
             f"  drain: {progress.get('done')}/{progress.get('total')} done, "
@@ -397,6 +406,13 @@ def render_html(state, source=""):
             out.append("</div>")
         if depths:
             out.append(_spark_svg(depths))
+        shards = serving.get("shard_depths")
+        if shards:
+            cells = ", ".join(
+                f"shard {i}: {'drained' if d < 0 else d}"
+                for i, d in enumerate(shards)
+            )
+            out.append(f'<p class="note">{esc("queues — " + cells)}</p>')
     note = _unknown_note(state)
     if note:
         out.append(f'<p class="note">{esc(note)}</p>')

@@ -1,0 +1,621 @@
+"""Disaggregated serving cluster (ISSUE 18).
+
+Layered cheapest-first: the admission bucket's deterministic math, the
+prefix-affinity router's policy, the cluster facade driven with REAL
+(tiny, tp=1) engines — where token-level exactness against solo greedy
+chains is provable, including through the prefill->decode handoff and
+a mid-flight drain — then the family members end to end through
+``benchmark_worker``, and the SLO gate's composition fencing.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+# ---------------------------------------------------------------------------
+# admission: the token bucket + the census rate
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def _bucket(self, rate=10.0, burst=20.0):
+        from ddlb_tpu.serve import TokenBucket
+
+        return TokenBucket(rate, burst)
+
+    def test_starts_full_no_cold_start_shed(self):
+        b = self._bucket()
+        assert b.level(0.0) == 20.0
+        assert b.try_take(20.0, 0.0)  # the whole burst admits at t=0
+
+    def test_all_or_nothing_debit(self):
+        """A rejected request debits NOTHING — partial admission would
+        starve every later request without admitting anyone."""
+        b = self._bucket()
+        assert b.try_take(15.0, 0.0)
+        level = b.level(0.0)
+        assert not b.try_take(10.0, 0.0)  # 5 < 10: reject
+        assert b.level(0.0) == level      # untouched
+        assert b.try_take(5.0, 0.0)       # exactly-fitting still admits
+
+    def test_refill_rate_and_cap(self):
+        b = self._bucket(rate=10.0, burst=20.0)
+        assert b.try_take(20.0, 0.0)
+        assert b.level(1.0) == pytest.approx(10.0)   # 1 s * 10 tps
+        assert b.level(100.0) == pytest.approx(20.0)  # capped at burst
+
+    def test_clock_is_monotone(self):
+        """A caller stepping time backwards must not drain the bucket
+        (refill clamps dt at 0 and keeps the furthest-seen clock)."""
+        b = self._bucket()
+        b.try_take(5.0, 10.0)
+        level = b.level(10.0)
+        assert b.level(3.0) == level
+
+    def test_counters_and_validation(self):
+        from ddlb_tpu.serve import TokenBucket
+
+        b = self._bucket(rate=1.0, burst=1.0)
+        assert b.try_take(1.0, 0.0)
+        assert not b.try_take(5.0, 0.0)
+        assert (b.admitted, b.rejected) == (1, 1)
+        with pytest.raises(ValueError, match="rate_tps"):
+            TokenBucket(0.0, 1.0)
+        with pytest.raises(ValueError, match="burst_tokens"):
+            TokenBucket(1.0, 0.0)
+
+    def test_census_rate_finite_and_scales(self):
+        from ddlb_tpu.perfmodel import ChipSpec
+        from ddlb_tpu.perfmodel.specs import get_spec
+        from ddlb_tpu.serve import decode_token_rate
+
+        spec = get_spec("v5e")
+        assert isinstance(spec, ChipSpec)
+        kw = dict(
+            ctx=64, d_model=64, d_ff=128, vocab=128, n_heads=4,
+            batch=4, n_kv_heads=0, layers=2, kv_cache="bf16",
+            mlp_kernel="bf16", attn_kernel="einsum", spec=spec,
+        )
+        r1 = decode_token_rate(n_devices=1, **kw)
+        r4 = decode_token_rate(n_devices=4, **kw)
+        assert 0.0 < r1 < float("inf")
+        assert r4 == pytest.approx(4.0 * r1)
+
+
+# ---------------------------------------------------------------------------
+# the router policy
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixAffinityRouter:
+    def _router(self, n=3, imbalance=2.0):
+        from ddlb_tpu.serve import PrefixAffinityRouter
+
+        return PrefixAffinityRouter(n, imbalance)
+
+    def test_least_outstanding_with_index_tiebreak(self):
+        r = self._router()
+        assert r.route(-1, [5, 2, 9]) == 1
+        assert r.route(-1, [4, 4, 4]) == 0  # tie: lowest index
+
+    def test_affinity_sticks_until_imbalanced(self):
+        r = self._router()
+        first = r.route(7, [0, 0, 0])     # records affinity for prefix 7
+        assert r.affinity[7] == first
+        # affine shard busier but within imbalance: affinity wins
+        out = [0, 0, 0]
+        out[first] = 2                     # 2 <= 2.0 * (0 + 1)
+        assert r.route(7, out) == first
+        assert r.affinity_hits == 1
+        # drowning: 9 > 2.0 * (0 + 1) -> falls through to best
+        out[first] = 9
+        assert r.route(7, out) != first
+
+    def test_drop_shard_forgets_and_rehomes(self):
+        r = self._router()
+        first = r.route(3, [0, 1, 1])
+        assert first == 0
+        r.drop_shard(0)
+        assert 3 not in r.affinity
+        nxt = r.route(3, [0, 1, 1])
+        assert nxt in (1, 2)
+        assert r.affinity[3] == nxt        # re-homed on a survivor
+        r.drop_shard(1)
+        r.drop_shard(2)
+        with pytest.raises(RuntimeError, match="no live shards"):
+            r.route(-1, [0, 0, 0])
+
+    def test_validation(self):
+        from ddlb_tpu.serve import PrefixAffinityRouter
+
+        with pytest.raises(ValueError, match="n_shards"):
+            PrefixAffinityRouter(0)
+        with pytest.raises(ValueError, match="imbalance"):
+            PrefixAffinityRouter(2, imbalance=0.5)
+
+
+class TestKVBundle:
+    def test_coerces_and_validates(self):
+        from ddlb_tpu.serve import KVBundle
+
+        b = KVBundle(
+            request_id=0, tokens=[1, 2, 3], generated=1, remaining=2,
+            prefix_id=-1, kv_tokens=3, payload_bytes=10.0, produced_s=0.0,
+        )
+        assert b.tokens.dtype == np.int32
+        with pytest.raises(ValueError, match="remaining"):
+            KVBundle(
+                request_id=0, tokens=[1], generated=1, remaining=0,
+                prefix_id=-1, kv_tokens=1, payload_bytes=0.0,
+                produced_s=0.0,
+            )
+
+
+# ---------------------------------------------------------------------------
+# the cluster facade on real engines: token-level exactness
+# ---------------------------------------------------------------------------
+
+
+def _tiny_world(n_engines):
+    """``n_engines`` tp=1 engines sharing one set of params: with tp=1
+    the block router pins every slot to expert 0, so a request's greedy
+    chain is slot- AND engine-independent — solo replay is an exact
+    oracle for anything the cluster schedules."""
+    import jax
+
+    from ddlb_tpu.models.decode import make_decode_fn
+    from ddlb_tpu.models.serving import ContinuousBatchingEngine
+    from ddlb_tpu.models.transformer import TransformerConfig, init_params
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, d_ff=64,
+        layers_per_stage=1, microbatches=1, attn_kernel="einsum",
+    )
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()[:1], dtype=object).reshape(1, 1),
+        ("dp", "tp"),
+    )
+    params = init_params(cfg, pp=1, n_experts=1, seed=0)
+    _, sh = make_decode_fn(mesh, cfg)
+    params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+
+    def make():
+        return ContinuousBatchingEngine(
+            mesh, cfg, params, max_batch=2, max_len=48
+        )
+
+    return [make() for _ in range(n_engines)], make
+
+
+def _solo_tokens(make_engine, prompt, max_new):
+    from ddlb_tpu.models.serving import Request
+
+    eng = make_engine()
+    eng.submit(Request(prompt, max_new=max_new))
+    return eng.run()[0].tokens
+
+
+def _pump_until_done(cluster, n, limit=500):
+    t = 0.0
+    while cluster.accounted < n:
+        cluster.pump(t)
+        t += 0.01
+        limit -= 1
+        assert limit > 0, "cluster failed to drain"
+
+
+def _requests(rng, count, max_new_lo=1, max_new_hi=6):
+    return [
+        (
+            rng.integers(1, 64, int(rng.integers(4, 10))).astype(np.int32),
+            int(rng.integers(max_new_lo, max_new_hi + 1)),
+        )
+        for _ in range(count)
+    ]
+
+
+class TestClusterExactness:
+    def test_routed_matches_solo_chains(self):
+        from ddlb_tpu.serve import ServingCluster
+
+        engines, make = _tiny_world(2)
+        cluster = ServingCluster(engines)
+        reqs = _requests(np.random.default_rng(0), 5)
+        gids = {}
+        for i, (prompt, max_new) in enumerate(reqs):
+            gid, ok = cluster.submit(prompt, max_new, now_s=0.0)
+            assert ok
+            gids[gid] = i
+        _pump_until_done(cluster, len(reqs))
+        assert len(cluster.completions) == len(reqs)
+        for c in cluster.completions:
+            prompt, max_new = reqs[gids[c.request_id]]
+            np.testing.assert_array_equal(
+                c.tokens, _solo_tokens(make, prompt, max_new)
+            )
+
+    def test_disagg_handoff_chain_exact(self):
+        """The tentpole invariant: prefill-pool first token + decode-
+        pool continuation == the solo greedy chain, byte for byte; one
+        handoff per request with budget past its prefill, zero for
+        ``max_new=1`` (prefill WAS the whole job)."""
+        from ddlb_tpu.serve import ServingCluster
+
+        engines, make = _tiny_world(3)
+        cluster = ServingCluster(
+            engines[:2], engines[2:],
+            bundle_bytes=lambda kv_tokens: 100.0 * kv_tokens,
+            handoff_seconds=lambda b: b * 1e-9,
+        )
+        reqs = _requests(np.random.default_rng(1), 5)
+        reqs[0] = (reqs[0][0], 1)  # force one prefill-only completion
+        gids = {}
+        for i, (prompt, max_new) in enumerate(reqs):
+            gid, ok = cluster.submit(prompt, max_new, now_s=0.0)
+            assert ok
+            gids[gid] = i
+        _pump_until_done(cluster, len(reqs))
+        expect_handoffs = sum(1 for _, mn in reqs if mn > 1)
+        assert cluster.counters["handoffs"] == expect_handoffs
+        assert cluster.counters["handoff_bytes"] > 0
+        assert cluster.counters["handoff_s"] > 0
+        for c in cluster.completions:
+            prompt, max_new = reqs[gids[c.request_id]]
+            np.testing.assert_array_equal(
+                c.tokens, _solo_tokens(make, prompt, max_new)
+            )
+            assert c.handoffs == (1 if max_new > 1 else 0)
+
+    def test_drain_mid_flight_exact_and_zero_lost(self):
+        """The chaos-drill half: evict mid-generation on the indicted
+        shard, hand off to the survivor, and STILL land every request
+        on its exact solo chain (the preempt-then-handoff ledger)."""
+        from ddlb_tpu.serve import ServingCluster
+
+        engines, make = _tiny_world(2)
+        cluster = ServingCluster(engines)
+        reqs = _requests(np.random.default_rng(2), 6, max_new_lo=4,
+                         max_new_hi=8)
+        gids = {}
+        for i, (prompt, max_new) in enumerate(reqs):
+            gid, _ = cluster.submit(prompt, max_new, now_s=0.0)
+            gids[gid] = i
+        cluster.pump(0.0)
+        cluster.pump(0.01)  # some generation happens on both shards
+        cluster.drain_shard(1, 0.02)
+        assert cluster.queue_depths()[1] == -1
+        assert cluster.counters["shards_excluded"] == 1
+        assert cluster.counters["drained"] > 0
+        _pump_until_done(cluster, len(reqs))
+        assert len(cluster.completions) == len(reqs)  # zero lost
+        for c in cluster.completions:
+            assert c.shard == 0  # everything finished on the survivor
+            prompt, max_new = reqs[gids[c.request_id]]
+            np.testing.assert_array_equal(
+                c.tokens, _solo_tokens(make, prompt, max_new)
+            )
+
+    def test_drain_last_shard_refused(self):
+        from ddlb_tpu.serve import ServingCluster
+
+        engines, _ = _tiny_world(1)
+        cluster = ServingCluster(engines)
+        with pytest.raises(RuntimeError, match="last live decode shard"):
+            cluster.drain_shard(0, 0.0)
+
+    def test_rejection_is_a_counted_outcome(self):
+        from ddlb_tpu.serve import ServingCluster, TokenBucket
+
+        engines, _ = _tiny_world(1)
+        cluster = ServingCluster(
+            engines, admission=TokenBucket(1.0, 4.0)
+        )
+        g0, ok0 = cluster.submit(np.array([1, 2, 3]), 4, now_s=0.0)
+        g1, ok1 = cluster.submit(np.array([4, 5, 6]), 4, now_s=0.0)
+        assert ok0 and not ok1  # bucket held 4 tokens, first took them
+        _pump_until_done(cluster, 2)
+        assert [c.request_id for c in cluster.completions] == [g0]
+        assert cluster.rejections == [g1]
+        assert cluster.counters["rejected"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the family members end to end
+# ---------------------------------------------------------------------------
+
+
+def _cluster_config(member, **options):
+    base = {
+        "batch": 8, "vocab": 64, "n_heads": 8, "layers": 1,
+        "rate": 200.0, "n_requests": 10, "out_mean": 3, "out_max": 5,
+        "slo_ttft_ms": 4000.0, "slo_tpot_ms": 2000.0,
+    }
+    base.update(options)
+    return {
+        "primitive": "serving_load",
+        "impl_id": f"{member}_0",
+        "base_implementation": member,
+        "options": base,
+        "m": 8, "n": 32, "k": 64, "dtype": "float32",
+        "num_iterations": 1, "num_warmups": 1, "validate": True,
+        "time_measurement_backend": "host_clock",
+        "barrier_at_each_iteration": False,
+    }
+
+
+class TestClusterFamily:
+    def test_router_row_valid_with_cluster_columns(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+        from ddlb_tpu.schema import ROW_COLUMNS
+
+        row = benchmark_worker(_cluster_config("router", dp=2))
+        assert row["error"] == "" and bool(row["valid"])
+        for col in (
+            "serve_topology", "serve_shards", "serve_shards_excluded",
+            "serve_rejected", "serve_handoffs", "serve_handoff_bytes",
+            "serve_handoff_ms", "serve_drained", "serve_affinity_hits",
+        ):
+            assert col in row, col
+            assert col in ROW_COLUMNS, col
+        assert row["serve_topology"] == "router:dp=2"
+        assert int(row["serve_shards"]) == 2
+        assert row["slo_completed"] == 2 * 10  # exactly-once, both drains
+
+    def test_router_prefix_affinity_hits(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            _cluster_config(
+                "router", dp=2, n_requests=16,
+                prefix_pop=2, prefix_len=8,
+            )
+        )
+        assert row["error"] == "" and bool(row["valid"])
+        assert int(row["serve_affinity_hits"]) > 0
+        assert int(row["serve_prefix_hits"]) > 0
+
+    def test_disagg_row_prices_handoffs(self):
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(_cluster_config("disagg"))
+        assert row["error"] == "" and bool(row["valid"])
+        assert row["serve_topology"] == "disagg:p1+d1"
+        assert int(row["serve_handoffs"]) > 0
+        assert float(row["serve_handoff_bytes"]) > 0
+        assert float(row["serve_handoff_ms"]) > 0
+
+    def test_admission_sheds_with_exact_accounting(self):
+        """Overload against a deliberately tiny bucket: rejections are
+        counted outcomes and the accounting validation (completed +
+        rejected partition the trace) holds."""
+        from ddlb_tpu.benchmark import benchmark_worker
+
+        row = benchmark_worker(
+            _cluster_config(
+                "router", dp=2, rate=500.0, n_requests=12,
+                out_mean=6, out_max=10,
+                admission="token_bucket", admission_rate_tps=5.0,
+                admission_burst_s=1.0,
+            )
+        )
+        assert row["error"] == "" and bool(row["valid"])
+        assert int(row["serve_rejected"]) > 0
+
+    def test_chaos_drill_drains_indicted_shard(self, monkeypatch):
+        """The full drill: a seeded hang on shard 1's decode ticks
+        breaks its TPOT SLO, the watch indicts it, in-flight work
+        drains to shard 0 over the handoff path, and the accounting
+        validation proves zero requests lost."""
+        from ddlb_tpu.faults import plan as fault_plan
+
+        plan = {
+            "seed": 1,
+            "rules": [{
+                "site": "serve.decode_tick", "kind": "hang",
+                "duration_s": 0.05, "match": {"shard": "1"},
+                "fail_attempts": 1000000,
+            }],
+        }
+        monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps(plan))
+        fault_plan.reset()
+        try:
+            from ddlb_tpu.benchmark import benchmark_worker
+
+            row = benchmark_worker(
+                _cluster_config(
+                    "router", dp=2, rate=300.0, n_requests=16,
+                    out_mean=8, out_max=12,
+                    slo_tpot_ms=10.0, watch_ticks=4,
+                )
+            )
+            assert row["error"] == "" and bool(row["valid"])
+            assert int(row["serve_shards_excluded"]) == 1
+            assert int(row["serve_drained"]) > 0
+            assert int(row["serve_handoffs"]) > 0
+            assert row["serve_topology"] == "router:dp=2:degraded=1"
+            assert "serve.decode_tick" in str(row["fault_injected"])
+        finally:
+            monkeypatch.delenv("DDLB_TPU_FAULT_PLAN")
+            fault_plan.reset()
+
+    def test_disagg_cost_model_carries_handoff_wire_term(self):
+        """The family cost model prices the planned handoff census as a
+        wire term — a disagg member predicts strictly more than the
+        same shape with the handoff bytes zeroed."""
+        from ddlb_tpu.perfmodel.cost import _serving_cost
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        cls = load_impl_class("serving_load", "disagg")
+        impl = cls(
+            8, 32, 64, dtype="float32", rate=50.0, n_requests=6,
+            batch=8, vocab=64, n_heads=8,
+        )
+        assert impl.handoff_bytes() > 0
+        _, comm, _ = _serving_cost(impl, impl.runtime.chip_spec)
+        assert comm > 0.0
+
+
+# ---------------------------------------------------------------------------
+# SLO gate composition fencing
+# ---------------------------------------------------------------------------
+
+
+def _record(run, topology=None, ttft95=20.0, goodput=5.0, impl="engine"):
+    from ddlb_tpu.observatory import regress
+
+    row = {
+        "implementation": f"{impl}_0", "base_implementation": impl,
+        "primitive": "serving_load", "option": "out_mean=4;rate=8.0",
+        "m": 8, "n": 32, "k": 64, "dtype": "float32", "world_size": 4,
+        "chip": "cpu-sim", "time_measurement_backend": "host_clock",
+        "median time (ms)": 10.0,
+        "slo_ttft_p50_ms": ttft95 * 0.6,
+        "slo_ttft_p95_ms": ttft95,
+        "slo_ttft_p99_ms": ttft95 * 1.2,
+        "slo_tpot_p95_ms": 3.0,
+        "slo_goodput_rps": goodput,
+    }
+    if topology is not None:
+        row["serve_topology"] = topology
+    return {
+        "kind": "row", "run_id": run, "key": regress.row_key(row),
+        "row": row,
+    }
+
+
+class TestSLOGateTopologyFencing:
+    def _history(self, topology=None, n=4):
+        return [
+            _record(f"r{i}", topology=topology, ttft95=20.0 + 0.3 * i)
+            for i in range(n)
+        ]
+
+    def test_cross_topology_never_gates(self):
+        """A routed row 3x worse than single-engine history stays
+        silent — different composition, different population; the
+        healthy single-engine baseline must not indict the cluster
+        (nor vice versa)."""
+        from ddlb_tpu.observatory import regress
+
+        cur = [_record("cur", topology="router:dp=2", ttft95=60.0)["row"]]
+        assert (
+            regress.detect_slo(
+                cur, self._history(topology="single"), exclude_run="cur"
+            )
+            == []
+        )
+        # and degraded rows never gate against healthy cluster history
+        deg = [
+            _record(
+                "cur", topology="router:dp=2:degraded=1", ttft95=60.0
+            )["row"]
+        ]
+        assert (
+            regress.detect_slo(
+                deg,
+                self._history(topology="router:dp=2"),
+                exclude_run="cur",
+            )
+            == []
+        )
+
+    def test_same_topology_fires_and_stamps(self):
+        from ddlb_tpu.observatory import regress
+
+        cur = [_record("cur", topology="router:dp=2", ttft95=41.0)["row"]]
+        findings = regress.detect_slo(
+            cur, self._history(topology="router:dp=2"), exclude_run="cur"
+        )
+        assert findings
+        assert all(
+            f["serve_topology"] == "router:dp=2" for f in findings
+        )
+
+    def test_unstamped_history_is_the_legacy_single_bucket(self):
+        """Rows banked before the cluster existed carry no
+        serve_topology; they must keep gating single-engine rows (both
+        unstamped and explicitly stamped "single") instead of being
+        orphaned by the new column."""
+        from ddlb_tpu.observatory import regress
+
+        legacy_history = self._history(topology=None)
+        unstamped = [_record("cur", ttft95=41.0)["row"]]
+        stamped = [_record("cur", topology="single", ttft95=41.0)["row"]]
+        for cur in (unstamped, stamped):
+            findings = regress.detect_slo(
+                cur, legacy_history, exclude_run="cur"
+            )
+            assert findings
+            assert findings[0]["serve_topology"] == "single"
+
+
+# ---------------------------------------------------------------------------
+# fault sites, live stream, dashboard
+# ---------------------------------------------------------------------------
+
+
+class TestClusterPlumbing:
+    def test_serve_cluster_sites_registered(self):
+        from ddlb_tpu.faults.plan import SITES
+
+        assert "serve.route" in SITES
+        assert "serve.handoff" in SITES
+
+    def test_live_fold_keeps_shard_depths(self):
+        from ddlb_tpu.observatory import live
+
+        state = live.fold(
+            [
+                {
+                    "kind": "serving_tick", "pid": 1, "ts": 0.0,
+                    "queue_depth": 3, "active": 2, "done": 1,
+                    "total": 8, "shard_depths": [2, -1],
+                },
+            ]
+        )
+        assert state["serving"]["shard_depths"] == [2, -1]
+
+    def test_dash_renders_shard_queues(self):
+        import sweep_dash
+        from ddlb_tpu.observatory import live
+
+        state = live.fold(
+            [
+                {
+                    "kind": "serving_tick", "pid": 1, "ts": 0.0,
+                    "queue_depth": 3, "active": 2, "done": 1,
+                    "total": 8, "shard_depths": [2, -1],
+                },
+            ]
+        )
+        text = sweep_dash.render_text(state)
+        assert "shard queues" in text
+        assert "s0:2" in text and "s1:drained" in text
+        html = sweep_dash.render_html(state)
+        assert "shard 1: drained" in html
+
+    def test_option_schema_covers_cluster_knobs(self):
+        """DDLB007's convention, asserted directly: every cluster knob
+        is a schema-documented option with an allowed-values entry."""
+        from ddlb_tpu.primitives.registry import load_impl_class
+
+        for member, extra in (
+            ("router", ("dp",)),
+            ("disagg", ("prefill_shards", "decode_shards")),
+        ):
+            cls = load_impl_class("serving_load", member)
+            defaults, allowed = cls.option_schema()
+            for knob in (
+                "admission", "admission_overcommit",
+                "admission_rate_tps", "admission_burst_s",
+                "affinity_imbalance", "watch_ticks", "watch_dominance",
+            ) + extra:
+                assert knob in defaults, (member, knob)
+                assert knob in allowed, (member, knob)

@@ -87,6 +87,10 @@ def test_ring_attention_matches_oracle():
     assert np.isclose(float(loss), expected, rtol=0, atol=1e-4)
 
 
+@pytest.mark.slow  # four sequential ring-attention train steps (~18 s,
+# dominated by the ring train_step compile) for a descent smoke the
+# oracle-parity test above already implies — outside the tier-1 870 s
+# budget; exact ring-vs-oracle equality stays in-tier
 def test_ring_attention_descends():
     import dataclasses
 
