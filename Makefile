@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test native bench lint analyze analyze-fast analyze-changed \
-	hooks ci calib-report chaos-launch chaos-degrade overlap-report \
+	hooks ci calib-report chaos-launch chaos-degrade chaos-elastic \
+	overlap-report \
 	serving-load-report serving-cluster-report sim-report \
 	sim-report-degrade skew-report clean
 
@@ -63,6 +64,7 @@ ci:
 	$(MAKE) sim-report-degrade
 	$(MAKE) sim-report-compare
 	$(MAKE) chaos-degrade
+	$(MAKE) chaos-elastic
 	$(MAKE) calib-report
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
@@ -136,6 +138,17 @@ chaos-launch:
 # transcript at docs/chaos_degrade_demo.log)
 chaos-degrade:
 	$(PYTHON) scripts/chaos_degrade.py
+
+# elastic-serving chaos battery: a seeded decode-tick hang must be
+# indicted by the per-shard SLO watch, its work drained with zero
+# requests lost, a prefill shard promoted into the decode pool, TPOT
+# p95 recovered inside the SLO, and the healed shard exonerated and
+# re-admitted after probation — with four clean baselines banking zero
+# detect_slo/health false positives and the chaos row fenced out of
+# the static baselines by its topology stamp (ISSUE 19; banked
+# transcript at docs/chaos_elastic_demo.log)
+chaos-elastic:
+	$(PYTHON) scripts/chaos_elastic.py
 
 # degraded-topology ranking: flat vs hierarchical vs striped AR under a
 # failing DCN trunk link (dcn=0.25) and a downed torus axis (ici1=0) on

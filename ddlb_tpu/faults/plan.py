@@ -81,6 +81,13 @@ relaunch's trigger), never transient.
   any process;
 - ``at``: explicit 0-based per-site call indices to fire on (overrides
   ``probability``);
+- ``until``: fire only while the per-site call count is BELOW this —
+  the fault-that-clears-mid-run shape (ISSUE 19): a ``hang`` rule on
+  ``serve.decode_tick`` with ``until: 400`` inflates TPOT for the
+  first 400 ticks and then goes quiet, which is what lets a chaos
+  drill exercise probation/exoneration (the indicted shard's probes
+  run fast once the fault exhausts). Composes with ``at``/
+  ``probability`` (the ``until`` gate applies first);
 - ``fail_attempts``: fire only while the row's retry attempt (from the
   active ``scope``) is below this (default 1: the first attempt faults,
   the retry runs clean — the transient-recovery shape). Set it high to
@@ -208,6 +215,9 @@ class FaultRule:
         self.at = spec.get("at")
         if self.at is not None:
             self.at = [int(i) for i in self.at]
+        self.until = spec.get("until")
+        if self.until is not None:
+            self.until = int(self.until)
         self.fail_attempts = int(spec.get("fail_attempts", 1))
         self.duration_s = float(spec.get("duration_s", 3600.0))
         self.exit_code = int(spec.get("exit_code", 1))
@@ -308,6 +318,8 @@ class FaultRule:
     def fires(self, seed: int, site: str, count: int, attempt: int) -> bool:
         """Deterministic firing decision for per-site call ``count``."""
         if attempt >= self.fail_attempts:
+            return False
+        if self.until is not None and count >= self.until:
             return False
         if self.at is not None:
             return count in self.at

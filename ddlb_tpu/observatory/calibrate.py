@@ -17,6 +17,7 @@ persistence).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -57,6 +58,35 @@ def collect_samples(
     return groups
 
 
+def collect_kv_samples(
+    directory: Optional[str] = None,
+    records: Optional[Iterable[Dict[str, Any]]] = None,
+    *,
+    chip: Optional[str] = None,
+    family: Optional[str] = None,
+) -> Dict[Tuple[str, str], List[Dict[str, Any]]]:
+    """KV-handoff fit samples grouped per (chip, backend) — the serving
+    rows the residual fit excludes (``calib.kv_row_features``)."""
+    if records is None:
+        records = store.iter_history(
+            directory, kind="row", chip=chip, family=family
+        )
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for record in records:
+        row = record.get("row") if isinstance(record, dict) else None
+        if not isinstance(row, dict):
+            continue
+        features = calib.kv_row_features(row)
+        if features is None:
+            continue
+        row_chip = str(row.get("chip") or "")
+        if not row_chip:
+            continue
+        backend = str(row.get("time_measurement_backend") or "")
+        groups.setdefault((row_chip, backend), []).append(features)
+    return groups
+
+
 def calibrate_history(
     directory: Optional[str] = None,
     records: Optional[Iterable[Dict[str, Any]]] = None,
@@ -71,7 +101,17 @@ def calibrate_history(
     returns None below ``min_rows``); the table carries only groups
     that fit. None when nothing fit — an empty table must not be
     mistaken for a calibrated world.
+
+    The KV-handoff constants (ISSUE 19) ride the same table: serving
+    rows with a handoff ledger fit ``kv_setup_s``/``kv_per_byte_s`` per
+    group and attach to that group's residual fit — or stand alone as a
+    residual-zero group when a bank holds only serving rows (the zero
+    constants add nothing, the standard uncalibrated contract).
+    ``records``, when given, feeds BOTH fits (one pass of synthetic
+    history exercises both on CI).
     """
+    if records is not None:
+        records = list(records)
     groups = collect_samples(directory, records, chip=chip, family=family)
     fitted: Dict[Tuple[str, str], calib.GroupCalibration] = {}
     for (group_chip, backend), samples in sorted(groups.items()):
@@ -80,6 +120,23 @@ def calibrate_history(
         )
         if fit is not None:
             fitted[(group_chip, backend)] = fit
+    kv_groups = collect_kv_samples(
+        directory, records, chip=chip, family=family
+    )
+    for (group_chip, backend), samples in sorted(kv_groups.items()):
+        kv = calib.fit_kv_group(samples, min_rows=min_rows)
+        if kv is None:
+            continue
+        setup_s, per_byte_s, kv_rows = kv
+        base = fitted.get((group_chip, backend)) or calib.GroupCalibration(
+            chip=group_chip, backend=backend, dispatch_s=0.0, step_s=0.0
+        )
+        fitted[(group_chip, backend)] = dataclasses.replace(
+            base,
+            kv_setup_s=setup_s,
+            kv_per_byte_s=per_byte_s,
+            kv_rows=kv_rows,
+        )
     if not fitted:
         return None
     return calib.make_table(

@@ -250,6 +250,29 @@ def verdict_from_observations(
     return doc
 
 
+def exoneration_verdict(
+    healthy_windows: Sequence[bool],
+    min_observations: int = MIN_OBSERVATIONS,
+    dominance: float = DOMINANCE,
+) -> bool:
+    """The indictment machinery run in reverse (ISSUE 19): may an
+    indicted-and-drained serving shard be re-admitted? Each element is
+    one post-indictment probation window's verdict (the serving
+    cluster's probe-tick median inside both its dominance bar and the
+    TPOT SLO). Exoneration demands the SAME corroboration an
+    indictment does — at least ``MIN_OBSERVATIONS`` windows with a
+    ``DOMINANCE`` share of them healthy — plus a healthy LATEST window
+    (a shard that just relapsed must not ride its earlier good windows
+    back in). Symmetric thresholds mean a component is never excluded
+    on more evidence than would re-admit it."""
+    windows = [bool(w) for w in healthy_windows]
+    if len(windows) < min_observations:
+        return False
+    if not windows[-1]:
+        return False
+    return sum(windows) / len(windows) >= dominance
+
+
 def relaunch_policy(n_ranks: int, n_excluded: int = 0) -> str:
     """What a persistent indictment permits: ``"exclude"`` when
     shrinking the world around the indicted rank still leaves a

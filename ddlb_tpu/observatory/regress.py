@@ -69,6 +69,29 @@ SLO_METRICS = (
     ("slo_goodput_rps", "low"),
 )
 
+#: absolute ``(noise_floor, min_excess)`` per SLO metric, same role as
+#: the skew/calibration floors: on a CPU-sim drill the latency
+#: percentiles live in single-digit milliseconds with near-zero MAD
+#: across a two-row baseline, so the relative machinery alone z-scores
+#: sub-millisecond host jitter into a finding. A latency percentile
+#: must worsen by a real millisecond (goodput by a quarter rps) before
+#: it counts; against production-scale baselines (tens to thousands of
+#: ms) the floors are invisible. Keyed separately so ``SLO_METRICS``
+#: keeps its public ``(metric, direction)`` shape.
+SLO_ABS_DEFAULT = (0.5, 1.0)
+SLO_ABS = {
+    "slo_goodput_rps": (0.05, 0.25),
+}
+
+#: minimum baseline depth before the SLO gate may judge a row: the
+#: per-topology fencing keeps SLO populations small, and a MAD
+#: estimated from one or two samples is no spread estimate at all
+#: (n=1 gives identically-zero MAD, so any host wobble z-scores to a
+#: finding). The time gate keeps its prior fallback and the skew/cal
+#: gates their absolute floors; only the SLO gate is fenced finely
+#: enough to need a depth requirement.
+SLO_MIN_HISTORY = 3
+
 #: cross-rank skew metrics gated per key (ISSUE 14): ``(metric,
 #: direction, abs_floor, abs_excess)``. The skew columns live near
 #: zero on clean runs (scheduler jitter), so the relative machinery
@@ -326,13 +349,16 @@ def _detect_metrics(
     min_excess: float,
     rel_floor: float,
     decorate=None,
+    min_history: int = 1,
 ) -> List[Dict[str, Any]]:
     """The one per-metric history gate the SLO and skew detectors
     share: every ``(metric, direction, abs_floor, abs_excess)`` spec
     gated per key against its own baseline (rows that don't carry a
     metric contribute nothing), ``decorate(finding, row)`` adding any
-    metric-family extras. Factored so the three gates ``detect_all``
-    merges can never drift apart on the gating loop itself."""
+    metric-family extras, ``min_history`` withholding judgment until
+    the baseline is deep enough to carry a spread estimate. Factored
+    so the three gates ``detect_all`` merges can never drift apart on
+    the gating loop itself."""
     findings: List[Dict[str, Any]] = []
     for metric, direction, abs_floor, abs_excess in specs:
         base = baselines(history, metric=metric, exclude_run=exclude_run)
@@ -342,7 +368,7 @@ def _detect_metrics(
                 continue
             key = row_key(row)
             stats = base.get(key)
-            if stats is None:
+            if stats is None or stats["n"] < min_history:
                 continue
             finding = _history_finding(
                 row, key, metric, measured, stats, direction,
@@ -364,6 +390,7 @@ def detect_slo(
     z_tol: float = Z_TOL,
     min_excess: float = MIN_EXCESS,
     rel_floor: float = REL_FLOOR,
+    min_history: int = SLO_MIN_HISTORY,
 ) -> List[Dict[str, Any]]:
     """SLO-metric regression findings (ISSUE 11): every metric in
     ``metrics`` gated per key against its own per-key history baseline,
@@ -386,9 +413,24 @@ def detect_slo(
     history (rows banked before the cluster existed) folds into the
     legacy ``"single"`` bucket, so pre-cluster baselines keep gating
     single-engine rows instead of being orphaned by the new column.
-    Each finding carries its ``serve_topology``.
+    Each finding carries its ``serve_topology``. Elastic rows (ISSUE
+    19) fence for free through the same mechanism: a run whose pools
+    resized stamps an ``:elastic=R`` suffix (after any ``:degraded=K``),
+    so transition-bearing latency distributions never pool with — or
+    set the bar for — static baselines of the same nominal shape.
+
+    Two robustness rails for the near-zero CPU-sim regime (ISSUE 19):
+    per-metric ABSOLUTE floors (``SLO_ABS``) so sub-millisecond host
+    jitter never z-scores into a finding off a tiny baseline, and
+    ``min_history`` (default ``SLO_MIN_HISTORY``) so the gate withholds
+    judgment until the fenced per-key baseline actually carries a
+    spread estimate — one banked row has identically-zero MAD, and a
+    z against zero spread is not evidence.
     """
-    specs = [(metric, direction, 0.0, 0.0) for metric, direction in metrics]
+    specs = [
+        (metric, direction, *SLO_ABS.get(metric, SLO_ABS_DEFAULT))
+        for metric, direction in metrics
+    ]
 
     def _topology(row: Dict[str, Any]) -> str:
         return str(row.get("serve_topology") or "") or "single"
@@ -414,6 +456,7 @@ def detect_slo(
                 min_excess,
                 rel_floor,
                 decorate=_stamp_topology,
+                min_history=min_history,
             )
         )
     return _rank(findings)

@@ -61,6 +61,11 @@ MIN_ROWS = 8
 #: horizons, open-loop drains) — their residuals would poison the fit.
 FIT_FAMILY_EXCLUDE: Tuple[str, ...] = ("serving_load",)
 
+#: families whose rows carry the KV-handoff ledger the kv fit consumes
+#: (the exact complement of the residual fit's exclusion: serving rows
+#: are the ONLY place handoffs happen).
+KV_FIT_FAMILIES: Tuple[str, ...] = ("serving_load",)
+
 
 def scope_link_class(scope: str) -> str:
     """Map an engine WireStep resource scope to a fit link class."""
@@ -232,6 +237,48 @@ def row_features(row: Mapping[str, object]) -> Optional[Dict[str, object]]:
     }
 
 
+def kv_row_features(row: Mapping[str, object]) -> Optional[Dict[str, object]]:
+    """KV-handoff fit sample from one banked serving row; None when
+    ineligible (ISSUE 19 satellite). The residual fit EXCLUDES serving
+    rows (their measured time is an arrival horizon); this fit reads
+    the opposite slice — clean serving-cluster rows whose ledger
+    carries a non-zero handoff census — and models the row's cumulative
+    handoff time (``serve_handoff_ms``) as::
+
+        handoff_s = kv_setup_s * handoffs + kv_per_byte_s * bytes
+
+    i.e. a per-bundle setup latency plus a per-byte wire term, the same
+    two-constant shape the hop fit uses for collectives. On CPU-sim the
+    column is the PRICED census (the closed form talking to itself — a
+    fixed-point the CI fit exercises end to end); on hardware it is a
+    measured transfer, which is the whole point of fitting it."""
+    if str(row.get("error") or "").strip():
+        return None
+    if _truthy(row.get("quarantined")) or _truthy(row.get("world_degraded")):
+        return None
+    if str(row.get("primitive") or "") not in KV_FIT_FAMILIES:
+        return None
+    handoffs = _fnum(row.get("serve_handoffs"))
+    nbytes = _fnum(row.get("serve_handoff_bytes"))
+    total_ms = _fnum(row.get("serve_handoff_ms"))
+    if not handoffs or handoffs <= 0.0:
+        return None
+    if nbytes is None or nbytes < 0.0:
+        return None
+    if total_ms is None or total_ms <= 0.0:
+        return None
+    return {
+        "handoffs": float(handoffs),
+        "bytes": float(nbytes),
+        "handoff_s": total_ms * 1e-3,
+        "key": "|".join(
+            str(row.get(col, ""))
+            for col in ("primitive", "base_implementation", "option",
+                        "m", "n", "k", "dtype", "world_size")
+        ),
+    }
+
+
 # ---------------------------------------------------------------------------
 # the fitted table
 # ---------------------------------------------------------------------------
@@ -252,10 +299,27 @@ class GroupCalibration:
     residual_mad_frac: float = 0.0
     iterations: int = 0
     converged: bool = True
+    #: KV-handoff constants (ISSUE 19): fitted from serving rows'
+    #: handoff ledger; kv_rows == 0 means uncalibrated (the closed-form
+    #: census prices handoffs, the zero-when-uncalibrated contract).
+    kv_setup_s: float = 0.0
+    kv_per_byte_s: float = 0.0
+    kv_rows: int = 0
 
     def compute_overhead_s(self) -> float:
         """Additive overhead per ComputeStep."""
         return self.step_s
+
+    def kv_handoff_s(self, payload_bytes: float) -> Optional[float]:
+        """Calibrated seconds one KV-bundle handoff of ``payload_bytes``
+        costs (setup + per-byte wire); None when this group never fitted
+        the kv constants — the caller falls back to the census closed
+        form (``cost.kv_handoff_seconds``)."""
+        if self.kv_rows <= 0:
+            return None
+        return self.kv_setup_s + self.kv_per_byte_s * max(
+            0.0, float(payload_bytes)
+        )
 
     def wire_overhead_s(self, link_class: str = "ici") -> float:
         """Additive overhead per WireStep of ``link_class`` (step
@@ -275,6 +339,9 @@ class GroupCalibration:
             "residual_mad_frac": self.residual_mad_frac,
             "iterations": self.iterations,
             "converged": self.converged,
+            "kv_setup_s": self.kv_setup_s,
+            "kv_per_byte_s": self.kv_per_byte_s,
+            "kv_rows": self.kv_rows,
         }
 
     @classmethod
@@ -291,6 +358,9 @@ class GroupCalibration:
             residual_mad_frac=float(data.get("residual_mad_frac", 0.0)),  # type: ignore[arg-type]
             iterations=int(data.get("iterations", 0)),  # type: ignore[arg-type]
             converged=bool(data.get("converged", True)),
+            kv_setup_s=float(data.get("kv_setup_s", 0.0)),  # type: ignore[arg-type]
+            kv_per_byte_s=float(data.get("kv_per_byte_s", 0.0)),  # type: ignore[arg-type]
+            kv_rows=int(data.get("kv_rows", 0)),  # type: ignore[arg-type]
         )
 
 
@@ -360,6 +430,18 @@ def table_version(groups: Mapping[Tuple[str, str], GroupCalibration]) -> str:
                 "step_s": round(group.step_s, 12),
                 "hop_s": {k: round(v, 12) for k, v in sorted(group.hop_s.items())},
                 "rows": group.rows,
+                # kv constants enter the fingerprint only once fitted —
+                # a kv-uncalibrated refit keeps its pre-ISSUE-19 version
+                # so the drift gate's banked residual history survives
+                **(
+                    {
+                        "kv_setup_s": round(group.kv_setup_s, 15),
+                        "kv_per_byte_s": round(group.kv_per_byte_s, 18),
+                        "kv_rows": group.kv_rows,
+                    }
+                    if group.kv_rows > 0
+                    else {}
+                ),
             }
             for (chip, backend), group in sorted(groups.items())
         },
@@ -616,6 +698,81 @@ def fit_group(
         iterations=iterations,
         converged=converged,
     )
+
+
+def fit_kv_group(
+    samples: Iterable[Mapping[str, object]],
+    *,
+    min_rows: int = MIN_ROWS,
+    max_iter: int = 50,
+) -> Optional[Tuple[float, float, int]]:
+    """IRLS-LAD fit of the two KV-handoff constants from one group's
+    serving-row samples (``kv_row_features`` shape). Returns
+    ``(kv_setup_s, kv_per_byte_s, rows)`` or None below ``min_rows``.
+
+    Design columns: handoff count, handoff bytes — NO intercept (a row
+    with zero handoffs has zero handoff time by construction, and
+    ``kv_row_features`` never emits one). Non-negativity by the same
+    active-set rule as the residual fit: count and bytes are collinear
+    when every bundle weighs the same (one trace, one model shape), so
+    a naive clamp of a negative half would leave the positive half
+    overshooting — pin it to zero and refit instead."""
+    rows = [
+        s for s in samples
+        if _fnum(s.get("handoff_s")) is not None
+        and float(s["handoff_s"]) > 0.0
+    ]
+    if len(rows) < max(min_rows, 4):
+        return None
+    full = [
+        [float(s.get("handoffs") or 0.0), float(s.get("bytes") or 0.0)]
+        for s in rows
+    ]
+    # column normalization: handoff counts (~1e1) and byte totals
+    # (~1e7) sit orders of magnitude apart, and _wls's relative ridge
+    # keys off the LARGEST diagonal — unscaled, it would crush the
+    # count column's coefficient to zero on any realistic trace
+    scales = [
+        max((abs(row[j]) for row in full), default=0.0) or 1.0
+        for j in range(2)
+    ]
+    full = [[row[j] / scales[j] for j in range(2)] for row in full]
+    target = [float(s["handoff_s"]) for s in rows]
+    eps = max(1e-15, 1e-6 * _median([abs(y) for y in target]))
+
+    def _irls(design):
+        theta = _wls(design, target, [1.0] * len(rows))
+        if theta is None:
+            return None
+        for _ in range(max_iter):
+            resid = [
+                y - sum(x * t for x, t in zip(row, theta))
+                for row, y in zip(design, target)
+            ]
+            weights = [1.0 / max(abs(r), eps) for r in resid]
+            update = _wls(design, target, weights)
+            if update is None:
+                break
+            delta = max(abs(a - b) for a, b in zip(update, theta))
+            theta = update
+            if delta <= 1e-15 + 1e-9 * max(abs(t) for t in theta):
+                break
+        return theta
+
+    active = [0, 1]
+    theta = [0.0, 0.0]
+    while active:
+        partial = _irls([[row[j] for j in active] for row in full])
+        if partial is None:
+            return None
+        if min(partial) >= 0.0:
+            theta = [0.0, 0.0]
+            for j, value in zip(active, partial):
+                theta[j] = value
+            break
+        worst = min(zip(active, partial), key=lambda jt: jt[1])[0]
+        active.remove(worst)
+    return theta[0] / scales[0], theta[1] / scales[1], len(rows)
 
 
 def predict_row(

@@ -191,7 +191,9 @@ def kv_bundle_bytes(
     return 2.0 * layers * kvh * head_dim * itemsize * float(tokens)
 
 
-def kv_handoff_seconds(payload_bytes: float, spec: ChipSpec) -> float:
+def kv_handoff_seconds(
+    payload_bytes: float, spec: ChipSpec, calib=None
+) -> float:
     """Latency floor of moving one KV bundle from a prefill worker to a
     decode worker: read out of the producer's HBM, one ICI crossing,
     write into the consumer's HBM — ``bytes * (2/hbm_bw + 1/ici_bw)``.
@@ -199,9 +201,20 @@ def kv_handoff_seconds(payload_bytes: float, spec: ChipSpec) -> float:
     whole trace's bundles through this; the CPU-sim cluster COUNTS it
     per handoff (``serve_handoff_ms``) rather than sleeping it, since a
     simulated host never actually moves bytes at ICI speeds (the same
-    honesty rule as the fault plan's ``sim_link_gbs``)."""
+    honesty rule as the fault plan's ``sim_link_gbs``).
+
+    ``calib`` is an optional fitted ``GroupCalibration`` whose
+    KV-handoff constants (``kv_setup_s + kv_per_byte_s * bytes``,
+    ISSUE 19) REPLACE the census floor — a fitted group's numbers come
+    from banked serving history, so they already contain the setup
+    latency the floor cannot see. An unfitted group (``kv_rows == 0``)
+    or ``calib=None`` keeps the closed form byte-identical."""
     if payload_bytes <= 0.0:
         return 0.0
+    if calib is not None:
+        fitted = calib.kv_handoff_s(payload_bytes)
+        if fitted is not None:
+            return fitted
     return float(payload_bytes) * (
         2.0 / spec.hbm_bw + 1.0 / spec.link_bw("ici")
     )

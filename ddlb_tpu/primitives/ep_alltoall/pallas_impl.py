@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu.ops.alltoall_matmul import alltoall_expert_matmul
 from ddlb_tpu.ops.matmul import matmul
 from ddlb_tpu.primitives.ep_alltoall.base import EPAllToAll
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class PallasEPAllToAll(EPAllToAll):
@@ -112,7 +113,7 @@ class PallasEPAllToAll(EPAllToAll):
                 return y.reshape(d * g, self.n)
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None), P("tp", None, None)),

@@ -545,6 +545,9 @@ class ServingLoad(Primitive):
                 "serve_handoff_ms": 0.0,
                 "serve_drained": 0,
                 "serve_affinity_hits": 0,
+                "serve_resizes": 0,
+                "serve_pool_history": "",
+                "serve_readmitted": 0,
             }
         )
         return out

@@ -65,6 +65,22 @@ CLUSTER_OPTIONS = {
     "watch_ticks": 0,
     #: indictment needs worst median > dominance * best median
     "watch_dominance": 2.0,
+    #: elastic pool resizing (ISSUE 19): 1 arms the promote/demote
+    #: controller on disaggregated members (routed members ignore it —
+    #: no second pool to breathe with)
+    "elastic": 0,
+    #: per-shard queued-request pressure that marks a pool as the
+    #: bottleneck (the promote/demote trigger)
+    "resize_backlog": 8,
+    #: pumps between pool transitions (resizing every tick thrashes)
+    "resize_cooldown": 64,
+    #: exoneration probe-window size in decode ticks (0 = an indicted
+    #: shard stays excluded forever, the PR 18 behavior)
+    "probation_ticks": 0,
+    #: pumps between probation probe ticks — probes run synchronously
+    #: in the pump loop, so probing a HUNG shard every pump stalls
+    #: every live lane for the hang's duration
+    "probe_interval": 4,
 }
 CLUSTER_ALLOWED = {
     "admission": ["open", "token_bucket"],
@@ -74,6 +90,11 @@ CLUSTER_ALLOWED = {
     "affinity_imbalance": (1.0, None),
     "watch_ticks": (0, None),
     "watch_dominance": (1.0, None),
+    "elastic": [0, 1],
+    "resize_backlog": (1, None),
+    "resize_cooldown": (1, None),
+    "probation_ticks": (0, None),
+    "probe_interval": (1, None),
 }
 
 
@@ -225,6 +246,10 @@ class ClusterServingLoad(ServingLoad):
         prefill pool produces — into EVERY engine (a 1-token request
         prefill-completes at admission), plus one 2-token probe on
         each decode engine for its decode-step program, then reset.
+        An ELASTIC member decode-probes the prefill engines too: a
+        promotion must not bill the flipped engine's first decode
+        compile to a real request (the promote-time re-prewarm then
+        hits a warm jit cache and costs milliseconds, not a compile).
 
         The probes must run under the same matmul-precision scope the
         runner wraps measured calls in: jit's tracing cache keys on the
@@ -271,11 +296,74 @@ class ClusterServingLoad(ServingLoad):
                 for probe in probes.values():
                     e.submit(Request(probe, max_new=1))
                     e.admit_ready()
-                if i < n_dec:
+                if i < n_dec or self.options["elastic"]:
                     e.submit(Request(self._trace[0].prompt, max_new=2))
                     e.admit_ready()
                     e.step()
                 e.reset()
+
+    def _tick_floor_s(self, n_dec: int) -> float:
+        """The perfmodel's per-decode-tick cost estimate for ONE shard:
+        the census-derived cluster token rate (the admission bucket's
+        capacity formula) split across the decode pool, inverted over
+        the per-shard batch — seconds one full tick should take. The
+        cluster's watch uses it as the floor under the live best-shard
+        median when resolving cost weights, so a cluster where EVERY
+        shard is degraded still sees raised weights instead of grading
+        the stragglers on each other's curve. 0.0 (no floor) when the
+        census cannot price this shape."""
+        from ddlb_tpu.serve.admission import decode_token_rate
+
+        o = self.options
+        try:
+            rate = decode_token_rate(
+                ctx=self.m,
+                d_model=self.n,
+                d_ff=self.k,
+                vocab=o["vocab"],
+                n_heads=o["n_heads"],
+                batch=o["batch"],
+                n_kv_heads=o["n_kv_heads"],
+                layers=o["layers"],
+                kv_cache=o["kv_cache"],
+                mlp_kernel=o["mlp_kernel"],
+                attn_kernel=o["attn_kernel"],
+                spec=self.runtime.chip_spec,
+                n_devices=self.runtime.num_devices,
+            )
+        except (KeyError, ValueError, ZeroDivisionError):
+            return 0.0
+        if rate <= 0.0 or rate == float("inf"):
+            return 0.0
+        return (o["batch"] // n_dec) * n_dec / rate
+
+    def _promote_prewarm_hook(self):
+        """The promote-time re-prewarm the elastic cluster runs on a
+        freshly-flipped engine: one 2-token probe driven to completion
+        under the runner's matmul-precision scope, so the shard's first
+        real decode tick replays a warm jit cache (the setup-time
+        ``_prewarm`` already compiled the program — this re-touch is
+        milliseconds — and its wall clock lands inside the measured
+        drain, keeping transitions priced, never free). The hook must
+        NOT reset the engine: reset clears completions, and the cluster
+        resyncs its ``done_seen`` cursor instead."""
+        if not self.options["elastic"]:
+            return None
+        from ddlb_tpu.models.serving import Request
+        from ddlb_tpu.primitives.base import matmul_precision_scope
+
+        prompt = self._trace[0].prompt
+        dtype = self.dtype
+
+        def hook(engine) -> None:
+            with matmul_precision_scope(dtype):
+                engine.submit(Request(prompt, max_new=2))
+                engine.admit_ready()
+                while engine.active_slots() or engine.queue_depth:
+                    engine.step()
+                    engine.admit_ready()
+
+        return hook
 
     def _input_setup(self) -> None:
         import jax
@@ -317,6 +405,19 @@ class ClusterServingLoad(ServingLoad):
                 e.set_shared_prefix(prefix_tokens(spec, 0))
         self._prewarm(engines, n_dec, spec)
         chip = self.runtime.chip_spec
+        # calibrated KV-handoff pricing (ISSUE 19): a fitted (chip,
+        # backend) group's kv constants replace the census floor; no
+        # table / unfitted group keeps the closed form byte-identical
+        from ddlb_tpu.perfmodel.calib import get_table
+
+        table = get_table()
+        # the drain REQUIRES host_clock (run_trace raises otherwise),
+        # so that is the backend serving rows bank under
+        calib_group = (
+            table.group(chip.name, "host_clock")
+            if table is not None
+            else None
+        )
         self._cluster = ServingCluster(
             decode_engines,
             prefill_engines,
@@ -325,11 +426,20 @@ class ClusterServingLoad(ServingLoad):
             ),
             admission=self._make_admission(),
             bundle_bytes=self._bundle_pricer(),
-            handoff_seconds=lambda b: kv_handoff_seconds(b, chip),
+            handoff_seconds=lambda b: kv_handoff_seconds(
+                b, chip, calib=calib_group
+            ),
             preempt_hol_ticks=o["preempt_hol_ticks"],
             watch_ticks=o["watch_ticks"],
             watch_dominance=float(o["watch_dominance"]),
             slo_tpot_ms=float(o["slo_tpot_ms"]),
+            elastic=bool(o["elastic"]),
+            resize_backlog=int(o["resize_backlog"]),
+            resize_cooldown=int(o["resize_cooldown"]),
+            probation_ticks=int(o["probation_ticks"]),
+            probe_interval=int(o["probe_interval"]),
+            tick_floor_s=self._tick_floor_s(n_dec),
+            prewarm=self._promote_prewarm_hook(),
         )
         self.mesh = meshes[0]
         self._last: Optional[Dict[str, Any]] = None
@@ -459,14 +569,29 @@ class ClusterServingLoad(ServingLoad):
             "counters": dict(cl.counters),
             "stats": cl.engine_stats(),
             "affinity_hits": cl.router.affinity_hits,
+            "pool_history": list(cl.pool_history),
         }
 
     # -- row columns ---------------------------------------------------------
 
     def _topology(self) -> str:
+        """The stamp the SLO gate fences baselines by: base shape, then
+        ``:degraded=K`` when shards were excluded, then ``:elastic=R``
+        when the pools resized (ISSUE 19) — an elastic row's latency
+        distribution reflects transition drains and a different pool
+        shape, so it must never set the bar for (or be judged against)
+        a static run. ``detect_slo`` groups per distinct stamp string,
+        so the suffixes buy the fencing with no detector change."""
         base = self._topology_base()
-        excl = int(self._last["counters"]["shards_excluded"]) if self._last else 0
-        return f"{base}:degraded={excl}" if excl else base
+        if not self._last:
+            return base
+        excl = int(self._last["counters"]["shards_excluded"])
+        resizes = int(self._last["counters"].get("resizes", 0))
+        if excl:
+            base = f"{base}:degraded={excl}"
+        if resizes:
+            base = f"{base}:elastic={resizes}"
+        return base
 
     def extra_row_fields(self) -> dict:
         if self._last is None:
@@ -493,6 +618,11 @@ class ClusterServingLoad(ServingLoad):
                 "serve_handoff_ms": round(c["handoff_s"] * 1000.0, 4),
                 "serve_drained": int(c["drained"]),
                 "serve_affinity_hits": int(self._last["affinity_hits"]),
+                "serve_resizes": int(c.get("resizes", 0)),
+                "serve_pool_history": ";".join(
+                    self._last.get("pool_history", ())
+                ),
+                "serve_readmitted": int(c.get("readmitted", 0)),
             }
         )
         return out

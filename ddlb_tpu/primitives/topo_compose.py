@@ -37,6 +37,7 @@ identically to the live runtime.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from ddlb_tpu import envs
@@ -123,6 +124,35 @@ def health_link_verdict(world: Optional[int] = None) -> Dict[str, Any]:
         return {"status": "healthy", "links": []}
 
 
+def composition_signature() -> Tuple[Any, ...]:
+    """Cheap fingerprint of every input ``select_composition`` consults
+    for ``auto``: the degraded-world stamp, the fault-plan knob, and the
+    history bank's identity + mtime (the bank is ONE append-only file,
+    so any row the SLO/health gates bank moves its mtime). A cached
+    ``auto`` resolution is valid exactly while this tuple is unchanged —
+    which is what lets a long-lived member re-resolve at the next row
+    boundary when the health verdict flips MID-SWEEP (ISSUE 19
+    satellite: a gate firing re-ranks compositions without a relaunch)
+    while costing two env reads and one stat() on the happy path."""
+    directory = envs.get_history_dir()
+    mtime = 0
+    if directory:
+        from ddlb_tpu.observatory.store import history_path
+
+        path = history_path(directory)
+        if path:
+            try:
+                mtime = os.stat(path).st_mtime_ns
+            except OSError:
+                mtime = 0
+    return (
+        bool(envs.get_world_degraded()),
+        str(envs.get_fault_plan() or ""),
+        str(directory or ""),
+        mtime,
+    )
+
+
 def select_composition(
     requested: str,
     num_partitions: int,
@@ -191,18 +221,41 @@ class ComposedMember:
     """
 
     def _resolved_composition(self) -> str:
+        """The member's resolved composition. A PINNED request resolves
+        once and is never second-guessed. An ``auto`` resolution is
+        cached against ``composition_signature()``: when the world's
+        health inputs move under a live member — the observatory banks
+        an indicting row mid-sweep, a fault plan lands, a degraded
+        relaunch stamps the env — the next call re-resolves instead of
+        replaying a stale verdict, and the flip is visible in the
+        ``composition`` column of every subsequent row (plus a
+        ``topo.recompose`` telemetry instant naming old -> new)."""
+        requested = self.options.get("composition", "auto")
         cached = getattr(self, "_composition", None)
-        if cached is None:
-            runtime = getattr(self, "runtime", None)
-            num_slices = int(getattr(runtime, "num_slices", 1) or 1)
-            cached, reason = select_composition(
-                self.options.get("composition", "auto"),
-                self.num_partitions,
-                num_slices,
+        if cached is not None and requested != "auto":
+            return cached
+        signature = composition_signature() if requested == "auto" else None
+        if (
+            cached is not None
+            and signature == getattr(self, "_composition_sig", None)
+        ):
+            return cached
+        runtime = getattr(self, "runtime", None)
+        num_slices = int(getattr(runtime, "num_slices", 1) or 1)
+        resolved, reason = select_composition(
+            requested, self.num_partitions, num_slices
+        )
+        if cached is not None and resolved != cached:
+            from ddlb_tpu import telemetry
+
+            telemetry.instant(
+                "topo.recompose", cat="topo",
+                previous=cached, composition=resolved, reason=reason,
             )
-            self._composition = cached
-            self._composition_reason = reason
-        return cached
+        self._composition = resolved
+        self._composition_reason = reason
+        self._composition_sig = signature
+        return resolved
 
     def _two_level(self) -> Tuple[int, int]:
         """(intra, inter) for this instance's world."""

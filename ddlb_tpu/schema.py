@@ -175,13 +175,17 @@ ROW_COLUMNS: Dict[str, str] = {
     # -- serving cluster ledger (ISSUE 18: ddlb_tpu/serve — routed dp>1
     #    and disaggregated prefill/decode members; single-engine rows
     #    carry "single" / zeros so a mixed sweep keeps one CSV header) --
-    "serve_topology": "cluster composition stamp (single, router:dp=N, disagg:pP+dD; :degraded=K after a drill)",
+    "serve_topology": "cluster composition stamp (single, router:dp=N, disagg:pP+dD; :degraded=K after a drill; :elastic=R after pool resizes)",
     "serve_shards": "engines in the serving cluster (1 = single engine)",
     "serve_shards_excluded": "decode shards indicted and drained this row",
     "serve_rejected": "requests shed at the admission-control door",
     "serve_handoffs": "prefill->decode / drain KV-bundle handoffs",
     "serve_handoff_bytes": "KV bytes moved across engine handoffs (priced census)",
     "serve_handoff_ms": "priced cumulative handoff latency (not slept on CPU-sim)",
-    "serve_drained": "in-flight/queued requests migrated off indicted shards",
+    "serve_drained": "in-flight/queued requests migrated off indicted or resized shards",
     "serve_affinity_hits": "router dispatches that honored prefix affinity",
+    # -- elastic serving cluster (ISSUE 19: pools that breathe) --
+    "serve_resizes": "elastic pool transitions this row (promote + demote)",
+    "serve_pool_history": "semicolon-joined transition journal (promote:3@120;exonerate:1@300)",
+    "serve_readmitted": "indicted shards exonerated and re-admitted after probation",
 }

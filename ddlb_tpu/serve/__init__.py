@@ -14,6 +14,16 @@ Three mechanisms behind one facade (``ServingCluster``):
   against the perfmodel decode HBM census — load beyond capacity is
   shed at the door with a counted ``rejected`` outcome.
 
+The cluster is ELASTIC and SELF-HEALING (ISSUE 19): with
+``elastic=True`` the prefill/decode pools resize mid-run toward
+whichever pool is the bottleneck (drain-to-survivors → role-flip →
+re-prewarm, zero requests lost, journaled in ``serve_pool_history``);
+an indicted shard earns re-admission through a probation window
+(``probation_ticks``, verdict via ``observatory.health``
+``exoneration_verdict``); and the router's load comparisons are
+COST-WEIGHTED so a degraded-but-alive shard attracts proportionally
+less load instead of binary exclusion.
+
 Lazy re-exports, matching the package-wide pattern (importing the
 package must not trigger backend imports)."""
 

@@ -103,6 +103,16 @@ class TokenBucket:
         self._refill(now_s)
         return self._level
 
+    def pressure(self, now_s: float) -> float:
+        """Demand pressure at the door in [0, 1]: how empty the bucket
+        is after refilling to ``now_s``. 0 = idle (bucket full), 1 =
+        admissions are consuming every token the refill produces. The
+        elastic cluster reads this as the admission controller's vote
+        in a pool-resize decision (``ServingCluster._breathe``) and
+        stamps it on every ``serve.resize`` event."""
+        self._refill(now_s)
+        return 1.0 - self._level / self.burst_tokens
+
     def try_take(self, tokens: float, now_s: float) -> bool:
         """Admit (debit ``tokens``) or reject (debit nothing)."""
         self._refill(now_s)

@@ -6,7 +6,7 @@ the same API:
 
 - **routed** (``prefill_engines=()``): one engine per dp shard; the
   ``PrefixAffinityRouter`` picks a shard per request (prefix-cache
-  affinity first, least-outstanding-work tiebreak);
+  affinity first, cost-weighted least-outstanding-work tiebreak);
 - **disaggregated** (``prefill_engines`` non-empty): prompts go to the
   prefill pool as ``max_new=1`` requests — the engine completes
   ``max_new=1`` AT admission, so a prefill engine is a pure prefill
@@ -22,6 +22,30 @@ tick time both dominates its peers AND breaks the TPOT SLO on its own
 — the indicted shard drains in-flight work to the survivors over the
 same handoff path (``drain_shard``), so a chaos drill completes every
 admitted request.
+
+**Elasticity (ISSUE 19) — pools that breathe.** With ``elastic=True``
+the pools resize themselves mid-run instead of limping on a fixed
+shape: when the decode pool is the bottleneck (per-shard decode
+backlog past ``resize_backlog`` while the prefill pool has headroom —
+the TPOT-pressure-dominates-TTFT-pressure signal) a prefill shard is
+PROMOTED into the decode pool, executed as drain-to-survivors →
+role-flip → re-prewarm with zero requests lost; when the prefill
+queue backs up instead, a previously-promoted shard is DEMOTED back.
+Every transition is counted (``serve_resizes``), journaled
+(``serve_pool_history``) and priced (its drain handoffs ride the same
+priced ``KVBundle`` path, and the re-prewarm's wall clock lands inside
+the measured drain — a transition is never free).
+
+**Exoneration.** An indicted shard is not excluded forever: with
+``probation_ticks > 0`` the cluster keeps probing it — a synthetic
+probe request per probation window, decode ticks timed exactly like
+the watch's — and re-admits it once the health verdict clears under
+the observatory's own corroboration thresholds
+(``observatory.health.exoneration_verdict``: ``MIN_OBSERVATIONS``
+windows, ``DOMINANCE`` share healthy, latest window healthy). A
+re-admitted shard re-enters COST-WEIGHTED (see the router): it
+attracts proportionally less load until the watch sees it fully
+healthy and re-resolves its weight to nominal.
 
 Time is explicit: every mutating call takes ``now_s`` from the
 caller's drain clock, so the drive loop (and tests) replay exact
@@ -39,6 +63,7 @@ import numpy as np
 
 from ddlb_tpu import faults, telemetry
 from ddlb_tpu.models.serving import EngineStats, Request
+from ddlb_tpu.observatory.health import exoneration_verdict
 from ddlb_tpu.serve.handoff import KVBundle
 from ddlb_tpu.serve.router import PrefixAffinityRouter
 
@@ -79,12 +104,15 @@ class _ReqState:
 
 
 class _Shard:
-    """One engine plus the cluster's per-engine bookkeeping."""
+    """One engine plus the cluster's per-engine bookkeeping. ``index``
+    is cluster-global and never changes; ``pool`` flips on an elastic
+    transition and returns to ``home_pool`` on reset."""
 
     def __init__(self, engine, index: int, pool: str):
         self.engine = engine
         self.index = index          # cluster-global shard index
-        self.pool = pool            # "prefill" | "decode"
+        self.pool = pool            # "prefill" | "decode" (mutable)
+        self.home_pool = pool       # construction-time role
         # fault-plan match context: a chaos rule with
         # match={"shard": "1"} targets exactly this engine's sites
         engine.fault_context = {"shard": str(index)}
@@ -94,6 +122,18 @@ class _Shard:
         self.tick_s: List[float] = []     # active-tick host seconds
         self.hol_ticks = 0
         self.last_head: Optional[int] = None
+        self.degraded = False       # watch verdict: cost-weighted
+        self.probation = False      # excluded but under probe
+        self.probe_s: List[float] = []    # current probe window ticks
+        self.probe_obs: List[bool] = []   # per-window health verdicts
+
+    def flip(self, pool: str) -> None:
+        """Role flip bookkeeping: fresh tick window and HOL state (the
+        watch must not judge a decode shard on its prefill history)."""
+        self.pool = pool
+        self.tick_s = []
+        self.hol_ticks = 0
+        self.last_head = None
 
     def reset(self) -> None:
         self.engine.reset()
@@ -103,6 +143,11 @@ class _Shard:
         self.tick_s = []
         self.hol_ticks = 0
         self.last_head = None
+        self.pool = self.home_pool
+        self.degraded = False
+        self.probation = False
+        self.probe_s = []
+        self.probe_obs = []
 
 
 class ServingCluster:
@@ -115,7 +160,23 @@ class ServingCluster:
     ``kv_handoff_seconds`` in production; tests pass stubs).
     ``admission`` is an optional ``TokenBucket``. ``watch_ticks > 0``
     arms the indictment watch (needs ``slo_tpot_ms`` finite to ever
-    fire — the watch is SLO-aware by construction)."""
+    fire — the watch is SLO-aware by construction).
+
+    Elasticity knobs: ``elastic`` arms pool resizing (disaggregated
+    mode only — the routed composition has no second pool to breathe
+    with), ``resize_backlog`` is the per-shard queued-request pressure
+    that marks a pool as the bottleneck, ``resize_cooldown`` the pumps
+    between transitions (resizing every tick would thrash), and
+    ``prewarm(engine)`` an optional hook run at a promotion so the
+    flipped engine's decode program is compiled before real traffic
+    lands on it. ``probation_ticks > 0`` arms exoneration (probe
+    window size, in decode ticks); ``probe_interval`` is the probe
+    cadence in pumps — probe ticks run synchronously in the pump loop,
+    so probing a hung shard every pump would stall the whole cluster
+    for the hang's duration. ``tick_floor_s`` is the perfmodel's
+    calibrated per-decode-tick cost estimate — the reference the
+    watch's cost weights are resolved against (0 = use the live best
+    shard's median alone)."""
 
     def __init__(
         self,
@@ -130,6 +191,13 @@ class ServingCluster:
         watch_ticks: int = 0,
         watch_dominance: float = 2.0,
         slo_tpot_ms: float = float("inf"),
+        elastic: bool = False,
+        resize_backlog: int = 8,
+        resize_cooldown: int = 64,
+        probation_ticks: int = 0,
+        probe_interval: int = 1,
+        tick_floor_s: float = 0.0,
+        prewarm: Optional[Callable] = None,
     ):
         if not decode_engines:
             raise ValueError("need at least one decode engine")
@@ -142,12 +210,18 @@ class ServingCluster:
             for i, e in enumerate(prefill_engines)
         ]
         self.disagg = bool(self.prefill)
+        #: every shard, indexed by its cluster-global index (the
+        #: router's index space; pool membership is the mutable part)
+        self._all: List[_Shard] = self.shards + self.prefill
         self.router = router or PrefixAffinityRouter(n_dec)
         if self.router.n_shards != n_dec:
             raise ValueError(
                 f"router covers {self.router.n_shards} shards but the "
                 f"decode pool has {n_dec}"
             )
+        # prefill shards are registered (non-routable) so a promotion
+        # needs no re-indexing — global indices ARE router indices
+        self.router.grow(len(self._all))
         self.admission = admission
         self._bundle_bytes = bundle_bytes or (lambda kv_tokens: 0.0)
         self._handoff_seconds = handoff_seconds or (lambda b: 0.0)
@@ -155,6 +229,13 @@ class ServingCluster:
         self.watch_ticks = int(watch_ticks)
         self.watch_dominance = float(watch_dominance)
         self.slo_tpot_ms = float(slo_tpot_ms)
+        self.elastic = bool(elastic)
+        self.resize_backlog = int(resize_backlog)
+        self.resize_cooldown = int(resize_cooldown)
+        self.probation_ticks = int(probation_ticks)
+        self.probe_interval = max(1, int(probe_interval))
+        self.tick_floor_s = float(tick_floor_s)
+        self._prewarm = prewarm
         self._clear_run_state()
 
     # -- lifecycle ---------------------------------------------------------
@@ -163,6 +244,10 @@ class ServingCluster:
         self._reqs: List[_ReqState] = []
         self.completions: List[ClusterCompletion] = []
         self.rejections: List[int] = []
+        self.pool_history: List[str] = []
+        self._probe_prompt: Optional[np.ndarray] = None
+        self._pump_count = 0
+        self._last_resize = -(10 ** 9)
         self.counters: Dict[str, float] = {
             "rejected": 0,
             "handoffs": 0,
@@ -170,17 +255,25 @@ class ServingCluster:
             "handoff_s": 0.0,
             "drained": 0,
             "shards_excluded": 0,
+            "resizes": 0,
+            "readmitted": 0,
         }
 
     def reset(self) -> None:
         """Fresh drain against compile-cached engines: every engine
-        resets (shared prefixes survive, per the engine contract), the
-        router forgets learned affinities and exclusions, the admission
-        bucket refills, the ledger clears."""
-        for sh in self.prefill + self.shards:
+        resets (shared prefixes survive, per the engine contract),
+        every shard returns to its HOME pool (elastic transitions do
+        not leak across drains), the router forgets learned affinities,
+        exclusions and cost weights, the admission bucket refills, the
+        ledger clears."""
+        for sh in self._all:
             sh.reset()
+        self.shards = [sh for sh in self._all if sh.pool == "decode"]
+        self.prefill = [sh for sh in self._all if sh.pool == "prefill"]
         self.router = PrefixAffinityRouter(
-            len(self.shards), self.router.imbalance
+            len(self._all),
+            self.router.imbalance,
+            routable=[sh.index for sh in self.shards],
         )
         if self.admission is not None:
             self.admission._level = self.admission.burst_tokens
@@ -196,7 +289,9 @@ class ServingCluster:
 
     def queue_depths(self) -> List[int]:
         """Per-decode-shard queued-request gauge for the live dashboard
-        (-1 marks an excluded shard — visibly dead, not merely idle)."""
+        (-1 marks an excluded shard — visibly dead, not merely idle).
+        Elastic runs change the list's length mid-drill: a promoted
+        shard joins the gauge, a demoted one leaves it."""
         return [
             -1 if sh.excluded else sh.engine.queue_depth
             for sh in self.shards
@@ -223,7 +318,7 @@ class ServingCluster:
         admissions/prefix hits but no lane ticks — they never decode, so
         the occupancy ratio stays a decode-pool statement)."""
         total = EngineStats()
-        for sh in self.prefill + self.shards:
+        for sh in self._all:
             s = sh.engine.stats
             total.steps += s.steps
             total.generated += s.generated
@@ -253,6 +348,10 @@ class ServingCluster:
         admitted)``; a shed request gets a gid too (the ledger counts
         rejections, it never loses them) but touches no engine."""
         prompt = np.asarray(prompt, np.int32)
+        if self._probe_prompt is None:
+            # probation probes replay a real admitted prompt shape (the
+            # cluster cannot invent vocab-valid tokens on its own)
+            self._probe_prompt = prompt.copy()
         gid = len(self._reqs)
         self._reqs.append(
             _ReqState(
@@ -271,28 +370,41 @@ class ServingCluster:
                 "serve.reject", cat="serve", request=gid, tokens=max_new
             )
             return gid, False
-        if self.disagg:
+        if self.disagg and self._live(self.prefill):
             # prefill pool: least-outstanding live prefill engine gets a
             # max_new=1 request (completes AT admission — pure prefill)
-            live = self._live(self.prefill)
-            if not live:
-                raise RuntimeError("no live prefill shards")
-            sh = min(
-                live, key=lambda s: (s.engine.outstanding_tokens(), s.index)
-            )
-            idx = sh.engine.submit(Request(prompt, max_new=1))
-            sh.alias[idx] = gid
+            self._submit_prefill(gid, Request(prompt, max_new=1))
         else:
+            # routed mode — or an elastic cluster whose prefill pool is
+            # momentarily all-promoted: the decode pool prefills inline
             self._dispatch(gid, Request(prompt, max_new=max_new))
         return gid, True
+
+    def _submit_prefill(self, gid: int, req: Request) -> None:
+        live = self._live(self.prefill)
+        if not live:
+            raise RuntimeError("no live prefill shards")
+        sh = min(
+            live, key=lambda s: (s.engine.outstanding_tokens(), s.index)
+        )
+        idx = sh.engine.submit(req)
+        sh.alias[idx] = gid
 
     def _dispatch(self, gid: int, req: Request) -> None:
         """Route a fresh (no-KV) request into the decode pool."""
         st = self._reqs[gid]
-        out = [sh.engine.outstanding_tokens() for sh in self.shards]
+        out = self._outstanding()
         s = self.router.route(st.prefix_id, out)
-        idx = self.shards[s].engine.submit(req)
-        self.shards[s].alias[idx] = gid
+        sh = self._all[s]
+        idx = sh.engine.submit(req)
+        sh.alias[idx] = gid
+
+    def _outstanding(self) -> List[float]:
+        """Tokens-still-to-generate per shard, indexed by GLOBAL shard
+        index (the router's index space covers both pools)."""
+        return [
+            float(sh.engine.outstanding_tokens()) for sh in self._all
+        ]
 
     # -- the pump ----------------------------------------------------------
 
@@ -300,8 +412,10 @@ class ServingCluster:
         """One cluster tick: admit on every live engine, stamp first
         tokens, apply HOL relief, step every live engine (timing decode
         ticks for the watch), collect completions (prefill completions
-        become handoffs), then let the watch act. Returns the total
-        active-lane count (0 + empty queues = idle)."""
+        become handoffs), let the watch act, advance probations, then
+        let the pools breathe. Returns the total active-lane count
+        (0 + empty queues = idle)."""
+        self._pump_count += 1
         live_pre = self._live(self.prefill)
         live_dec = self._live(self.shards)
         # 1. admissions; routed decode admissions stamp TTFT here (the
@@ -376,8 +490,12 @@ class ServingCluster:
                 self._stamp_first(gid, now_s)
                 self._finalize(gid, c, sh.index, now_s)
             sh.done_seen = len(sh.engine.completions)
-        # 4. the indictment watch
+        # 4. the indictment watch (+ cost-weight re-resolution)
         self._watch(now_s)
+        # 5. probation: probe excluded shards toward exoneration
+        total_active += self._probe(now_s)
+        # 6. elastic pool resizing
+        self._breathe(now_s)
         return total_active
 
     def _stamp_first(self, gid: int, now_s: float) -> None:
@@ -424,7 +542,7 @@ class ServingCluster:
         max_new=remaining)`` — exactly the ``preempt()`` fold, so the
         consumer re-prefills to an identical greedy chain."""
         st = self._reqs[bundle.request_id]
-        out = [sh.engine.outstanding_tokens() for sh in self.shards]
+        out = self._outstanding()
         target = self.router.route(bundle.prefix_id, out)
         # chaos surface: wedge/error/slow the handoff itself, priced
         # against the real KV payload (faults/plan.SITES)
@@ -438,7 +556,7 @@ class ServingCluster:
         self.counters["handoff_bytes"] += bundle.payload_bytes
         self.counters["handoff_s"] += priced
         st.handoffs += 1
-        sh = self.shards[target]
+        sh = self._all[target]
         idx = sh.engine.submit(
             Request(bundle.tokens, max_new=bundle.remaining)
         )
@@ -451,14 +569,25 @@ class ServingCluster:
 
     # -- degradation -------------------------------------------------------
 
+    def _cost_ref_s(self, best_median: float) -> float:
+        """The reference a shard's tick median is judged against: the
+        perfmodel's calibrated per-tick estimate when the caller
+        supplied one, floored by the live best shard's median (the
+        estimate is a lower bound; the healthiest peer is reality)."""
+        return max(float(best_median), self.tick_floor_s)
+
     def _watch(self, now_s: float) -> None:
-        """SLO-aware straggler indictment over decode shards: once every
-        live shard has ``watch_ticks`` timed ticks, indict the shard
-        whose median tick BOTH dominates the best by
-        ``watch_dominance`` AND breaks the TPOT SLO on its own — a
-        shard that is slower but still inside the SLO is left alone
-        (rebalancing healthy skew is the router's job, not the
-        watch's)."""
+        """SLO-aware straggler verdicts over decode shards, two tiers:
+
+        - **cost-weighted** (degraded-but-alive): a shard whose median
+          tick dominates the reference by ``watch_dominance`` but stays
+          inside the TPOT SLO keeps serving at a raised router weight
+          (``median / reference`` — proportionally less load, FlexLink
+          style, instead of abandonment); the weight re-resolves
+          whenever this verdict flips either way;
+        - **indicted**: dominance AND an SLO breach on its own — the
+          shard drains to the survivors (``drain_shard``) and, when
+          probation is armed, starts earning exoneration."""
         if self.watch_ticks <= 0:
             return
         live = self._live(self.shards)
@@ -470,6 +599,22 @@ class ServingCluster:
         worst = max(live, key=lambda sh: meds[sh.index])
         best = min(live, key=lambda sh: meds[sh.index])
         w, b = meds[worst.index], meds[best.index]
+        ref = self._cost_ref_s(b)
+        # tier 1: re-resolve cost weights on verdict flips
+        for sh in live:
+            m = meds[sh.index]
+            degraded = m > self.watch_dominance * ref
+            if degraded != sh.degraded:
+                sh.degraded = degraded
+                weight = max(1.0, m / ref) if degraded else 1.0
+                self.router.set_weight(sh.index, weight)
+                telemetry.instant(
+                    "serve.reweigh", cat="serve", shard=sh.index,
+                    weight=round(weight, 3),
+                    median_ms=round(m * 1000.0, 3),
+                    ref_ms=round(ref * 1000.0, 3),
+                )
+        # tier 2: indict only when the SLO itself is broken
         if w <= self.watch_dominance * b:
             return
         if w * 1000.0 <= self.slo_tpot_ms:
@@ -482,16 +627,20 @@ class ServingCluster:
         self.drain_shard(worst.index, now_s)
 
     def drain_shard(self, shard: int, now_s: float) -> None:
-        """Exclude decode shard ``shard`` and migrate its in-flight work
-        to the survivors: active slots evict into ``KVBundle``s (the
-        drain IS a handoff — priced, counted, greedy chain preserved),
-        queued-but-unadmitted requests re-route as fresh submissions
-        (no KV exists yet, nothing to price). The shard's engine stays
-        constructed (its stats still aggregate) but receives no further
-        traffic. Requires at least one surviving decode shard."""
-        sh = self.shards[shard]
+        """Exclude decode shard ``shard`` (cluster-global index) and
+        migrate its in-flight work to the survivors: active slots evict
+        into ``KVBundle``s (the drain IS a handoff — priced, counted,
+        greedy chain preserved), queued-but-unadmitted requests
+        re-route as fresh submissions (no KV exists yet, nothing to
+        price). The shard's engine stays constructed (its stats still
+        aggregate); with probation armed it keeps serving PROBES toward
+        exoneration, otherwise it receives no further traffic. Requires
+        at least one surviving decode shard."""
+        sh = self._all[shard]
         if sh.excluded:
             return
+        if sh.pool != "decode":
+            raise ValueError(f"shard {shard} is not in the decode pool")
         survivors = [
             s for s in self._live(self.shards) if s.index != shard
         ]
@@ -503,6 +652,21 @@ class ServingCluster:
         self.counters["shards_excluded"] += 1
         # router first: re-routes below must not land on the corpse
         self.router.drop_shard(shard)
+        self._migrate_decode_work(sh, now_s)
+        if self.probation_ticks > 0 and self._probe_prompt is not None:
+            sh.probation = True
+            sh.probe_s = []
+            sh.probe_obs = []
+        telemetry.instant(
+            "serve.drain_shard", cat="serve", shard=shard,
+            drained=int(self.counters["drained"]),
+            survivors=len(survivors),
+        )
+
+    def _migrate_decode_work(self, sh: _Shard, now_s: float) -> None:
+        """Move EVERYTHING off a decode shard: active slots evict into
+        priced handoffs, the queue re-dispatches (shared by indictment
+        drains and elastic demotions — the zero-requests-lost path)."""
         for slot in list(sh.engine.active_slots()):
             idx, remnant = sh.engine.evict(slot)
             gid = sh.alias[idx]
@@ -529,8 +693,238 @@ class ServingCluster:
             self._reqs[gid].drained = True
             self.counters["drained"] += 1
             self._dispatch(gid, req)
-        telemetry.instant(
-            "serve.drain_shard", cat="serve", shard=shard,
-            drained=int(self.counters["drained"]),
-            survivors=len(survivors),
+
+    # -- probation / exoneration -------------------------------------------
+
+    def _probe(self, now_s: float) -> int:
+        """Step every excluded-under-probation shard on a synthetic
+        probe request, timing its decode ticks exactly as the watch
+        times live ones. Each completed probe closes one probation
+        window; the window verdict is the indictment test run in
+        reverse (median inside both the dominance bar and the TPOT
+        SLO), and ``observatory.health.exoneration_verdict`` decides
+        re-admission over the window history. Probe completions never
+        touch the request ledger."""
+        probing = [
+            sh for sh in self.shards if sh.excluded and sh.probation
+        ]
+        if not probing:
+            return 0
+        if self._pump_count % self.probe_interval != 0:
+            # probes ride the pump loop synchronously, so a probe tick
+            # against a HUNG shard stalls every live lane for its
+            # duration — probation runs at a cadence, not every pump
+            return 0
+        live_meds = [
+            statistics.median(sh.tick_s)
+            for sh in self._live(self.shards)
+            if len(sh.tick_s) >= self.watch_ticks
+        ]
+        ref = self._cost_ref_s(min(live_meds) if live_meds else 0.0)
+        active_total = 0
+        for sh in probing:
+            eng = sh.engine
+            if not eng.active_slots() and eng.queue_depth == 0:
+                eng.submit(
+                    Request(
+                        self._probe_prompt,
+                        max_new=max(1, self.probation_ticks),
+                    )
+                )
+            eng.admit_ready()
+            t0 = time.perf_counter()
+            active = eng.step()
+            if active:
+                sh.probe_s.append(time.perf_counter() - t0)
+            active_total += active
+            if len(eng.completions) > sh.done_seen:
+                # one probe window closed: verdict + maybe exoneration
+                sh.done_seen = len(eng.completions)
+                window = sh.probe_s
+                sh.probe_s = []
+                if not window:
+                    continue
+                med = statistics.median(window)
+                healthy = (
+                    med <= self.watch_dominance * ref if ref > 0.0 else True
+                ) and med * 1000.0 <= self.slo_tpot_ms
+                sh.probe_obs.append(healthy)
+                telemetry.instant(
+                    "serve.probe", cat="serve", shard=sh.index,
+                    healthy=healthy,
+                    median_ms=round(med * 1000.0, 3),
+                    windows=len(sh.probe_obs),
+                )
+                if exoneration_verdict(sh.probe_obs):
+                    self._exonerate(sh, med, ref, now_s)
+        return active_total
+
+    def _exonerate(
+        self, sh: _Shard, median_s: float, ref_s: float, now_s: float
+    ) -> None:
+        """Re-admit an excluded shard that survived probation: back in
+        the router's candidate set at a cost weight resolved from its
+        probe medians (degraded-but-alive until the watch sees it fully
+        healthy and re-resolves to nominal)."""
+        sh.excluded = False
+        sh.probation = False
+        sh.probe_s = []
+        sh.probe_obs = []
+        sh.tick_s = []
+        weight = max(1.0, median_s / ref_s) if ref_s > 0.0 else 1.0
+        sh.degraded = weight > 1.0
+        self.router.readmit_shard(sh.index, weight)
+        self.counters["readmitted"] += 1
+        self.pool_history.append(
+            f"exonerate:{sh.index}@{self._pump_count}"
         )
+        telemetry.instant(
+            "serve.exonerate", cat="serve", shard=sh.index,
+            weight=round(weight, 3),
+            median_ms=round(median_s * 1000.0, 3),
+        )
+
+    # -- elasticity --------------------------------------------------------
+
+    def _breathe(self, now_s: float) -> None:
+        """The pool-resize controller: compare per-shard backlog across
+        the two pools (decode backlog inflates time-between-tokens, the
+        TPOT pressure; prefill backlog inflates TTFT) and move ONE
+        shard per cooldown window toward the bottleneck. The admission
+        bucket's demand pressure rides along on every transition event
+        — overload shed at the door is context a resize decision is
+        judged by, even though shedding itself stays the bucket's job."""
+        if not self.elastic or not self.disagg:
+            return
+        if self._pump_count - self._last_resize < self.resize_cooldown:
+            return
+        live_pre = self._live(self.prefill)
+        live_dec = self._live(self.shards)
+        if not live_dec:
+            return
+        dec_backlog = sum(sh.engine.queue_depth for sh in live_dec) / len(
+            live_dec
+        )
+        pre_backlog = (
+            sum(sh.engine.queue_depth for sh in live_pre) / len(live_pre)
+            if live_pre
+            else 0.0
+        )
+        if (
+            dec_backlog >= self.resize_backlog
+            and pre_backlog < self.resize_backlog
+            and len(live_pre) >= 2
+        ):
+            self._promote(live_pre, dec_backlog, pre_backlog, now_s)
+        elif (
+            pre_backlog >= self.resize_backlog
+            and dec_backlog < self.resize_backlog
+            and len(live_dec) >= 2
+        ):
+            self._demote(live_dec, dec_backlog, pre_backlog, now_s)
+
+    def _resize_event(
+        self, action: str, sh: _Shard, dec_backlog: float,
+        pre_backlog: float, now_s: float,
+    ) -> None:
+        self.counters["resizes"] += 1
+        self._last_resize = self._pump_count
+        self.pool_history.append(
+            f"{action}:{sh.index}@{self._pump_count}"
+        )
+        telemetry.instant(
+            "serve.resize", cat="serve", action=action, shard=sh.index,
+            decode_backlog=round(dec_backlog, 2),
+            prefill_backlog=round(pre_backlog, 2),
+            admission_pressure=(
+                round(self.admission.pressure(now_s), 3)
+                if self.admission is not None
+                else 0.0
+            ),
+            prefill_pool=len(self._live(self.prefill)),
+            decode_pool=len(self._live(self.shards)),
+        )
+
+    def _promote(
+        self,
+        live_pre: List[_Shard],
+        dec_backlog: float,
+        pre_backlog: float,
+        now_s: float,
+    ) -> None:
+        """Prefill shard -> decode pool, as drain-to-survivors →
+        role-flip → re-prewarm, zero requests lost: its prefill work
+        moves to the surviving prefill shards first (max_new=1
+        remnants carry no decode KV worth pricing — they re-enter as
+        fresh prefill submissions), then the engine's decode program is
+        prewarmed (the hook's wall clock lands inside the measured
+        drain: a transition is never free), then the router admits the
+        shard at nominal weight (no tick history to judge it by)."""
+        sh = min(
+            live_pre,
+            key=lambda s: (s.engine.outstanding_tokens(), s.index),
+        )
+        survivors = [s for s in live_pre if s.index != sh.index]
+        for slot in list(sh.engine.active_slots()):
+            idx, remnant = sh.engine.evict(slot)
+            gid = sh.alias[idx]
+            self._reqs[gid].drained = True
+            self.counters["drained"] += 1
+            self._submit_prefill_to(survivors, gid, remnant)
+        for idx, req in sh.engine.drop_queue():
+            gid = sh.alias[idx]
+            self._reqs[gid].drained = True
+            self.counters["drained"] += 1
+            self._submit_prefill_to(survivors, gid, req)
+        if self._prewarm is not None:
+            self._prewarm(sh.engine)
+        # the prewarm's own completions are not cluster traffic
+        sh.done_seen = len(sh.engine.completions)
+        self.prefill.remove(sh)
+        sh.flip("decode")
+        self.shards.append(sh)
+        self.shards.sort(key=lambda s: s.index)
+        self.router.add_shard(sh.index)
+        self._resize_event("promote", sh, dec_backlog, pre_backlog, now_s)
+
+    def _submit_prefill_to(
+        self, survivors: List[_Shard], gid: int, req: Request
+    ) -> None:
+        sh = min(
+            survivors,
+            key=lambda s: (s.engine.outstanding_tokens(), s.index),
+        )
+        idx = sh.engine.submit(req)
+        sh.alias[idx] = gid
+
+    def _demote(
+        self,
+        live_dec: List[_Shard],
+        dec_backlog: float,
+        pre_backlog: float,
+        now_s: float,
+    ) -> None:
+        """Promoted shard -> back to the prefill pool (only shards
+        whose home pool IS prefill demote — the constructed decode pool
+        never shrinks below its engineered size). Decode work drains to
+        the surviving decode shards over the priced handoff path, then
+        the shard resumes prefill duty."""
+        returnable = [
+            s
+            for s in live_dec
+            if s.home_pool == "prefill" and not s.excluded
+        ]
+        if not returnable or len(live_dec) < 2:
+            return
+        sh = min(
+            returnable,
+            key=lambda s: (s.engine.outstanding_tokens(), s.index),
+        )
+        # router first: the drain's handoffs must not land back on it
+        self.router.remove_shard(sh.index)
+        self.shards.remove(sh)
+        self._migrate_decode_work(sh, now_s)
+        sh.flip("prefill")
+        self.prefill.append(sh)
+        self.prefill.sort(key=lambda s: s.index)
+        self._resize_event("demote", sh, dec_backlog, pre_backlog, now_s)

@@ -12,6 +12,23 @@ prefix-less requests. Outstanding work is measured in TOKENS still to
 generate (queued budgets + active remainders), not request counts —
 a queue of long generations is more load than one of short ones.
 
+Outstanding work is COST-WEIGHTED (ISSUE 19): every shard carries a
+``weight`` (1.0 = nominal; 2.0 = each of its tokens costs twice the
+perfmodel's calibrated per-tick estimate), and the router compares
+``weight * outstanding`` — a degraded-but-alive shard attracts
+proportionally less load instead of being excluded outright. The
+cluster re-resolves weights whenever a shard's health verdict flips
+(``ServingCluster._reweigh``); binary exclusion (``drop_shard``) is
+reserved for shards that also break the SLO on their own.
+
+The routable set is ELASTIC: ``add_shard`` admits a newly-promoted
+decode shard mid-run, ``remove_shard`` retires a demoted one (its
+affinities re-home like a drop), and ``readmit_shard`` reverses an
+exclusion after the cluster's probation window exonerates the shard.
+Indices are CLUSTER-GLOBAL so a promoted prefill engine keeps its
+identity across role flips; ``grow`` widens the index space when the
+cluster wraps a router that was sized to the decode pool only.
+
 Every decision is one ``serve.route`` fault-site call (context
 ``shard=<chosen>``), so a chaos plan can wedge or error the dispatch
 path itself. ``drop_shard`` removes an indicted shard from the
@@ -21,13 +38,18 @@ drill; the in-flight half is the cluster's ``drain_shard``)."""
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ddlb_tpu import faults
 
 
 class PrefixAffinityRouter:
-    def __init__(self, n_shards: int, imbalance: float = 2.0) -> None:
+    def __init__(
+        self,
+        n_shards: int,
+        imbalance: float = 2.0,
+        routable: Optional[Sequence[int]] = None,
+    ) -> None:
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
         if imbalance < 1.0:
@@ -36,14 +58,50 @@ class PrefixAffinityRouter:
         self.imbalance = float(imbalance)
         #: prefix population rank -> shard that first served it
         self.affinity: Dict[int, int] = {}
+        #: shards the router may pick from (the decode pool; elastic)
+        self.routable: set = (
+            set(range(self.n_shards))
+            if routable is None
+            else {int(s) for s in routable}
+        )
         self.excluded: set = set()
+        #: per-shard cost weight (1.0 nominal; >1 = degraded, attracts
+        #: proportionally less load)
+        self.weights: Dict[int, float] = {
+            s: 1.0 for s in range(self.n_shards)
+        }
         self.affinity_hits = 0
         self.routed = 0
 
+    def grow(self, n_shards: int) -> None:
+        """Widen the index space to ``n_shards`` WITHOUT making the new
+        indices routable — the cluster registers prefill engines here
+        so a later ``add_shard`` (promotion) needs no re-indexing."""
+        if n_shards > self.n_shards:
+            for s in range(self.n_shards, int(n_shards)):
+                self.weights.setdefault(s, 1.0)
+            self.n_shards = int(n_shards)
+
     def live_shards(self) -> List[int]:
-        return [
-            s for s in range(self.n_shards) if s not in self.excluded
-        ]
+        return sorted(s for s in self.routable if s not in self.excluded)
+
+    def add_shard(self, shard: int) -> None:
+        """Admit ``shard`` to the routable set (a prefill shard
+        promoted into the decode pool mid-run)."""
+        shard = int(shard)
+        if shard >= self.n_shards:
+            self.grow(shard + 1)
+        self.routable.add(shard)
+        self.weights.setdefault(shard, 1.0)
+
+    def remove_shard(self, shard: int) -> None:
+        """Retire ``shard`` from the routable set (demotion back to the
+        prefill pool); its affinities re-home on the survivors."""
+        shard = int(shard)
+        self.routable.discard(shard)
+        self.affinity = {
+            p: s for p, s in self.affinity.items() if s != shard
+        }
 
     def drop_shard(self, shard: int) -> None:
         """Exclude ``shard`` and forget affinities homed on it (their
@@ -53,14 +111,35 @@ class PrefixAffinityRouter:
             p: s for p, s in self.affinity.items() if s != shard
         }
 
+    def readmit_shard(self, shard: int, weight: float = 1.0) -> None:
+        """Reverse an exclusion after probation exonerates the shard:
+        back in the candidate set at ``weight`` (>= 1.0 — a freshly
+        exonerated shard usually re-enters cost-weighted until the
+        verdict flips fully healthy)."""
+        self.excluded.discard(int(shard))
+        self.set_weight(shard, weight)
+
+    def set_weight(self, shard: int, weight: float) -> None:
+        """Pin ``shard``'s cost weight (the cluster re-resolves it from
+        the perfmodel estimate whenever the health verdict flips)."""
+        if weight < 1.0:
+            raise ValueError(f"weight must be >= 1.0, got {weight}")
+        self.weights[int(shard)] = float(weight)
+
+    def _load(self, shard: int, outstanding: Sequence[float]) -> float:
+        return self.weights.get(shard, 1.0) * float(outstanding[shard])
+
     def route(self, prefix_id: int, outstanding: Sequence[float]) -> int:
         """Pick a live shard for one request. ``outstanding[s]`` is
         shard ``s``'s tokens-still-to-generate gauge (indexed over ALL
-        shards; excluded entries are ignored)."""
+        shards; non-routable/excluded entries are ignored). Load
+        comparisons are cost-weighted: ``weights[s] * outstanding[s]``
+        approximates seconds-of-work, so a 2x-slow shard at weight 2.0
+        looks twice as loaded and attracts half the traffic."""
         live = self.live_shards()
         if not live:
             raise RuntimeError("no live shards to route to")
-        best = min(live, key=lambda s: (outstanding[s], s))
+        best = min(live, key=lambda s: (self._load(s, outstanding), s))
         choice = best
         if prefix_id >= 0:
             aff = self.affinity.get(prefix_id)
@@ -68,8 +147,8 @@ class PrefixAffinityRouter:
                 # affinity wins unless the affine shard is drowning
                 # relative to the best (+1 keeps a zero-load best from
                 # making ANY affine load "imbalanced")
-                if outstanding[aff] <= self.imbalance * (
-                    outstanding[best] + 1.0
+                if self._load(aff, outstanding) <= self.imbalance * (
+                    self._load(best, outstanding) + 1.0
                 ):
                     choice = aff
                     self.affinity_hits += 1

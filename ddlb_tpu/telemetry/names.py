@@ -84,10 +84,30 @@ INSTANT_NAMES: Dict[str, str] = {
         "serving cluster SLO watch indicted a dominated shard "
         "(dropped from the router's live set)"
     ),
+    "serve.exonerate": (
+        "an indicted shard passed its probation window and was "
+        "re-admitted to the router's live set (cost-weighted)"
+    ),
     "serve.preempt": "serving engine preempted a slot (requeued, KV evicted)",
+    "serve.probe": (
+        "one probation probe window closed on an excluded shard "
+        "(healthy=... is the window's verdict)"
+    ),
     "serve.reject": "serving cluster admission controller shed a request",
+    "serve.resize": (
+        "elastic pool transition: a prefill shard promoted into the "
+        "decode pool (or a promoted shard demoted back)"
+    ),
+    "serve.reweigh": (
+        "the SLO watch re-resolved a shard's cost weight on a health-"
+        "verdict flip (degraded-but-alive attracts less load)"
+    ),
     "serve.slo": "serving_load end-of-drain SLO summary (TTFT/goodput)",
     "serve.ticks": "serving engine decode-tick marker",
+    "topo.recompose": (
+        "a composition=auto member re-resolved to a different "
+        "composition mid-sweep (health/fault/degraded inputs moved)"
+    ),
 }
 
 #: counters / gauges (``telemetry.record`` / ``record_max``)

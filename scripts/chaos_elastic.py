@@ -1,0 +1,480 @@
+#!/usr/bin/env python
+"""Elastic-serving chaos battery: detect -> resize -> recover -> exonerate.
+
+The executable acceptance evidence for ISSUE 19, banked at
+``docs/chaos_elastic_demo.log`` (``make chaos-elastic``). Where
+``serving_cluster_demo.py`` proves a FIXED cluster survives an indicted
+shard by limping on the survivors, this battery proves the ELASTIC
+cluster closes the whole loop on CPU-sim:
+
+1. **Clean baselines, banked, gate-checked**: the elastic disagg
+   member (p2+d2) drains the seeded trace four times fault-free with
+   the SLO watch, probation and the resize controller all ARMED. Every
+   row banks into a history dir; no run may indict a shard or re-admit
+   one (zero false indictments / exonerations on clean hardware), and
+   the observatory's ``detect_slo`` gate over the banked rows must
+   produce zero findings on the drill's subject metrics (TPOT p95,
+   goodput) — the zero-false-positive side of the detectors the chaos
+   run then relies on. Four baselines because the gate rightly refuses
+   to judge against fewer than ``SLO_MIN_HISTORY`` banked rows.
+2. **Seeded decode TPOT inflation that CLEARS mid-run**: the fault plan
+   hangs shard 0's decode ticks (``match: {"shard": "0"}``) but only
+   while the site's call count is below ``until`` — the
+   fault-that-heals shape (a thermal excursion, a transient co-tenant).
+   Shard 0 because the router's least-outstanding tiebreak routes the
+   first idle-cluster arrivals there: the faulted shard sees traffic
+   from the first pump, so the watch's evidence accrues
+   deterministically inside the fault window.
+3. **Detect -> drain**: the SLO watch indicts shard 0 (tick median
+   dominant AND over the TPOT SLO) and drains its in-flight work to the
+   surviving decode shard over priced KV handoffs.
+4. **Resize**: down a decode shard, the survivor's backlog crosses
+   ``resize_backlog`` while the prefill pool has headroom — the elastic
+   controller PROMOTES a prefill shard into the decode pool
+   (drain-to-survivors -> role-flip -> re-prewarm), restoring decode
+   capacity; the row's TPOT p95 must land back inside the SLO bound.
+5. **Exonerate -> re-admit**: the fault exhausts; the indicted shard's
+   probation probes start coming back healthy, and once the window
+   history clears ``observatory.health.exoneration_verdict`` the shard
+   re-enters the router's live set cost-weighted. The row stamps
+   ``serve_readmitted=1`` and journals every transition in
+   ``serve_pool_history``.
+6. **Zero lost, fenced**: the chaos row's ledger must balance —
+   completed + rejected == submitted, exactly-once across indictment,
+   promotion and re-admission — and its ``:degraded=1:elastic=R``
+   topology stamp must fence it out of the clean baselines'
+   ``detect_slo`` population (a transition-bearing latency distribution
+   never sets the bar for a static one).
+
+The chaos pass runs with ``validate=False``: the benchmark harness's
+validation phase re-runs ``impl.run()`` (a SECOND drain), and the row's
+``serve_*`` columns report the LAST drain — but the fault plan's
+``until`` clock is process-global, so it would be exhausted before that
+second drain began and the reported row would be fault-free. One
+measured drain keeps the row's columns and the fault window on the same
+drain; the clean baselines keep the full validation (and its
+exactly-once trace check), and the chaos ledger is balanced from the
+row's own columns instead.
+
+Usage: python scripts/chaos_elastic.py [--out-dir DIR] [--log FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# simulated mesh, set before anything touches JAX. 4 devices: the
+# disagg p2+d2 member gives each engine a disjoint tp=1 device
+os.environ.setdefault("DDLB_TPU_SIM_DEVICES", "4")
+
+# the serving demos' tiny model, soaked: many LONG requests. The
+# row's TPOT p95 is a per-request average (workload/slo.py), and the
+# pump loop is serial, so every hang-stalled pump gaps the in-flight
+# requests of EVERY live lane — the excursion is a global tax, not a
+# shard-0 tax. Two levers keep the recovered row's p95 inside the
+# SLO: (a) long outputs amortize the tax — with ~63 decode gaps per
+# request, even a request that eats every stalled pump of a 24-call
+# fault window averages 80*24/63 ~= 30 ms/gap, under the 50 ms bound;
+# (b) 240 requests put the distribution's top 5% at 12 requests,
+# comfortably above the drained cohort (the only requests that also
+# carry a re-queue wait in one of their gaps)
+M, N, K = 16, 64, 128
+MODEL = {
+    "batch": 4, "vocab": 128, "n_heads": 4, "layers": 1,
+    "n_requests": 240, "out_mean": 64, "out_max": 96,
+}
+#: arrivals spread over ~12s, well under the 8-lane cluster's token
+#: throughput: clean queues stay shallow (admission waits land in a
+#: request's first decode gap and would otherwise dominate its
+#: average), while the hang still piles the decode backlog that trips
+#: the resize controller — the stall, not arrival pressure, promotes
+RATE = 20.0
+#: the TPOT SLO the watch indicts against AND the recovery bound the
+#: chaos row's pooled p95 must land back inside; TTFT is unconstrained
+#: (this battery is about time-between-tokens, not queue position)
+SLO_TPOT_MS = 50.0
+ELASTIC = {
+    "elastic": 1, "resize_backlog": 2, "resize_cooldown": 16,
+    "probation_ticks": 3, "watch_ticks": 4, "watch_dominance": 2.0,
+    "slo_ttft_ms": 10000.0, "slo_tpot_ms": SLO_TPOT_MS,
+}
+#: the seeded fault: +80 ms on every decode tick of shard 0 while the
+#: site's call count is below ``until``. The window is sized for two
+#: deadlines at once: long enough that the watch accrues its
+#: ``watch_ticks`` of faulted evidence and indicts (~count 9-13 on
+#: this trace: shard 0 takes the first arrivals), short enough that
+#: the total stall budget — ``until * duration_s``, every stalled
+#: pump gapping every live lane — amortizes under the SLO across each
+#: request's ~63 gaps, and the probation probes turn healthy with
+#: most of the drain still ahead so exoneration lands in-run
+FAULT_HANG_S = 0.08
+FAULT_UNTIL = 24
+
+
+def impl_config():
+    return {
+        "implementation": "disagg", "rate": RATE,
+        "prefill_shards": 2, "decode_shards": 2,
+        **MODEL, **ELASTIC,
+    }
+
+
+class _Tee:
+    """Mirror stdout into the banked demo log, minus the runner's
+    per-row telemetry echo (the ``[ddlb_tpu]`` lines stay on the
+    console; the banked transcript keeps the curated narrative)."""
+
+    def __init__(self, path):
+        self._file = open(path, "w", encoding="utf-8")
+        self._stdout = sys.stdout
+        self._at_line_start = True
+        self._skipping = False
+
+    def write(self, data):
+        self._stdout.write(data)
+        for line in data.splitlines(keepends=True):
+            if self._at_line_start:
+                self._skipping = line.startswith("[ddlb_tpu]")
+            if not self._skipping:
+                self._file.write(line)
+            self._at_line_start = line.endswith("\n")
+
+    def flush(self):
+        self._stdout.flush()
+        self._file.flush()
+
+
+def run_pass(label, impls, csv_path, run_id, validate=True):
+    from ddlb_tpu.benchmark import PrimitiveBenchmarkRunner
+
+    print(f"\n==== {label} ====", flush=True)
+    os.environ["DDLB_TPU_RUN_ID"] = run_id
+    if os.path.exists(csv_path):
+        os.remove(csv_path)
+    runner = PrimitiveBenchmarkRunner(
+        "serving_load", m=M, n=N, k=K,
+        implementations=impls,
+        # ONE measured drain, no warmup drain: the fault plan's ``until``
+        # clock is the process-global site call count, so the measured
+        # drain must be the FIRST drain that burns it (the chaos pass
+        # also sets validate=False — the validation phase would re-drain
+        # and overwrite the row's serve_* columns with a fault-free run)
+        dtype="float32", num_iterations=1, num_warmups=0,
+        validate=validate, isolation="none", progress=False,
+        barrier_at_each_iteration=False,
+        output_csv=csv_path,
+    )
+    t0 = time.monotonic()
+    df = runner.run()
+    wall = time.monotonic() - t0
+    errors = int((df["error"].astype(str) != "").sum())
+    invalid = int((~df["valid"].astype(bool)).sum())
+    print(
+        f"{label}: {len(df)} rows in {wall:.1f}s, {errors} error(s), "
+        f"{invalid} invalid", flush=True,
+    )
+    assert errors == 0 and invalid == 0, f"{label} must run clean"
+    return df
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out-dir", default=None, metavar="DIR")
+    parser.add_argument(
+        "--log",
+        default=os.path.join(REPO, "docs", "chaos_elastic_demo.log"),
+    )
+    args = parser.parse_args(argv)
+    os.makedirs(os.path.dirname(args.log), exist_ok=True)
+    tee = _Tee(args.log)
+    sys.stdout = tee
+    work = args.out_dir or tempfile.mkdtemp(prefix="ddlb_chaos_elastic_")
+    os.makedirs(work, exist_ok=True)
+    history = os.path.join(work, "history")
+    failures: list = []
+
+    def check(ok, what):
+        print(f"  {'PASS' if ok else 'FAIL'}  {what}", flush=True)
+        if not ok:
+            failures.append(what)
+
+    saved_history = os.environ.get("DDLB_TPU_HISTORY")
+    os.environ["DDLB_TPU_HISTORY"] = history
+    try:
+        import pandas as pd
+
+        from ddlb_tpu.faults import plan as fault_plan
+        from ddlb_tpu.observatory import health, regress, store
+
+        print(
+            f"elastic chaos battery — sim devices "
+            f"{os.environ['DDLB_TPU_SIM_DEVICES']}, disagg p2+d2 elastic, "
+            f"model {N}x{K} (batch {MODEL['batch']}, "
+            f"{MODEL['n_requests']} requests at {RATE:.0f} req/s), "
+            f"TPOT SLO {SLO_TPOT_MS:.0f} ms"
+        )
+        print(
+            f"seeded fault: +{FAULT_HANG_S * 1000:.0f} ms on every decode "
+            f"tick of shard 0 until site call {FAULT_UNTIL} (then it heals)"
+        )
+
+        # -- 1: clean baselines, banked, detectors armed ------------------
+        # four, not two: the SLO gate withholds judgment below
+        # SLO_MIN_HISTORY banked rows per fenced key (a one-row baseline
+        # has zero MAD), so the zero-false-positive check is only
+        # non-vacuous once each clean row faces >= that many others
+        clean_rows = {}
+        for run in (
+            "elastic-clean-1", "elastic-clean-2",
+            "elastic-clean-3", "elastic-clean-4",
+        ):
+            df = run_pass(
+                f"clean baseline '{run}' (watch + probation + resize "
+                f"controller armed, no fault)",
+                {"disagg_0": impl_config()},
+                os.path.join(work, f"{run}.csv"), run,
+            )
+            row = df.iloc[0]
+            clean_rows[run] = row
+            check(
+                int(row["serve_shards_excluded"]) == 0
+                and int(row["serve_readmitted"]) == 0,
+                f"'{run}': zero false indictments / exonerations "
+                f"(excluded={int(row['serve_shards_excluded'])}, "
+                f"readmitted={int(row['serve_readmitted'])})",
+            )
+            check(
+                ":degraded=" not in str(row["serve_topology"]),
+                f"'{run}': topology {row['serve_topology']!r} carries no "
+                f"degraded stamp",
+            )
+            print(
+                f"  {run}: TPOT p95 {float(row['slo_tpot_p95_ms']):.1f} ms, "
+                f"resizes={int(row['serve_resizes'])} "
+                f"(pool breathing on clean load is policy, not a fault)"
+            )
+
+        # -- 2-5: the seeded chaos run ------------------------------------
+        plan = {
+            "seed": 19,
+            "rules": [
+                {
+                    "site": "serve.decode_tick", "kind": "hang",
+                    "duration_s": FAULT_HANG_S,
+                    "match": {"shard": "0"},
+                    "until": FAULT_UNTIL,
+                    "fail_attempts": 1000000,
+                }
+            ],
+        }
+        print(
+            "\n==== chaos run: TPOT inflation on decode shard 0 that "
+            "clears mid-run ===="
+        )
+        drill = None
+        for attempt in range(1, 4):
+            os.environ["DDLB_TPU_FAULT_PLAN"] = json.dumps(plan)
+            # fresh plan cache AND per-site call counters: the ``until``
+            # window must restart for every attempt in this process
+            fault_plan.reset()
+            try:
+                df = run_pass(
+                    f"seeded elastic drill (attempt {attempt})",
+                    {"disagg_chaos": impl_config()},
+                    os.path.join(work, f"chaos{attempt}.csv"),
+                    f"elastic-chaos-{attempt}",
+                    validate=False,
+                )
+            finally:
+                os.environ.pop("DDLB_TPU_FAULT_PLAN", None)
+                fault_plan.reset()
+            drill = df.iloc[0]
+            history_str = str(drill["serve_pool_history"])
+            topo = str(drill["serve_topology"])
+            tpot_p95 = float(drill["slo_tpot_p95_ms"])
+            # every leg of the loop is re-measurable: a host-contention
+            # window can shift WHEN the watch/probes see their evidence,
+            # so a failed leg retries the whole drill rather than
+            # crashing the battery
+            problems = []
+            if "serve.decode_tick" not in str(drill["fault_injected"]):
+                problems.append("the seeded hang never fired")
+            if int(drill["serve_shards_excluded"]) != 1:
+                problems.append(
+                    f"expected exactly one indictment, got "
+                    f"{int(drill['serve_shards_excluded'])}"
+                )
+            if int(drill["serve_drained"]) <= 0:
+                problems.append("no in-flight requests drained")
+            if int(drill["serve_resizes"]) < 1 or "promote:" not in (
+                history_str
+            ):
+                problems.append(f"no promotion (journal [{history_str}])")
+            if int(drill["serve_readmitted"]) != 1 or (
+                "exonerate:0@" not in history_str
+            ):
+                problems.append(
+                    f"shard 0 never exonerated (journal [{history_str}])"
+                )
+            if ":degraded=1" not in topo or ":elastic=" not in topo:
+                problems.append(f"topology stamp {topo!r} incomplete")
+            if tpot_p95 > SLO_TPOT_MS:
+                problems.append(
+                    f"TPOT p95 {tpot_p95:.1f} ms above the SLO bound"
+                )
+            print(
+                f"attempt {attempt}: {topo}, pool history "
+                f"[{history_str}], {int(drill['serve_drained'])} drained "
+                f"over {int(drill['serve_handoffs'])} handoffs, TPOT p95 "
+                f"{tpot_p95:.1f} ms (SLO {SLO_TPOT_MS:.0f} ms)"
+            )
+            if not problems:
+                break
+            for p in problems:
+                print(f"attempt {attempt}: {p}", flush=True)
+            if attempt < 3:
+                print(f"attempt {attempt}: re-running the drill",
+                      flush=True)
+        check(
+            "serve.decode_tick" in str(drill["fault_injected"]),
+            "seeded decode-tick hang fired on the drill row",
+        )
+        check(
+            int(drill["serve_shards_excluded"]) == 1
+            and int(drill["serve_drained"]) > 0,
+            f"SLO watch indicted shard 0 and drained its work "
+            f"({int(drill['serve_drained'])} requests over "
+            f"{int(drill['serve_handoffs'])} KV handoffs)",
+        )
+        check(
+            int(drill["serve_resizes"]) >= 1
+            and "promote:" in str(drill["serve_pool_history"]),
+            f"elastic controller promoted a prefill shard into the "
+            f"decode pool (journal: {drill['serve_pool_history']})",
+        )
+        check(
+            float(drill["slo_tpot_p95_ms"]) <= SLO_TPOT_MS,
+            f"TPOT p95 recovered inside the SLO bound "
+            f"({float(drill['slo_tpot_p95_ms']):.1f} <= "
+            f"{SLO_TPOT_MS:.0f} ms)",
+        )
+        check(
+            int(drill["serve_readmitted"]) == 1
+            and "exonerate:0@" in str(drill["serve_pool_history"]),
+            "indicted shard passed probation, was exonerated and "
+            "re-admitted",
+        )
+        check(
+            ":degraded=1" in str(drill["serve_topology"])
+            and ":elastic=" in str(drill["serve_topology"]),
+            f"topology stamped {drill['serve_topology']!r}",
+        )
+        # the chaos pass skipped the harness validation phase (it would
+        # re-drain fault-free and overwrite the row) — so balance the
+        # ledger from the row's own columns: every submitted request
+        # either completed or was shed at the door, exactly once, across
+        # the indictment drain, the promotion and the re-admission
+        completed = int(drill["slo_completed"])
+        rejected = int(drill["serve_rejected"])
+        check(
+            completed + rejected == MODEL["n_requests"],
+            f"ledger balances: {completed} completed + {rejected} "
+            f"rejected == {MODEL['n_requests']} submitted (zero requests "
+            f"lost across every transition)",
+        )
+
+        # -- 6: the observatory gates over the banked history -------------
+        print("\n==== observatory gates over the banked history ====")
+        records = store.load_history(history)
+        banked = [r for r in records if r.get("kind", "row") == "row"]
+        check(
+            len(banked) >= 5,
+            f"history banked every pass ({len(banked)} rows)",
+        )
+        # the gate metrics the chaos run relies on: the drill is about
+        # time-between-tokens and throughput. The TTFT tail percentiles
+        # stay out of the drill's zero-FP contract — on CPU-sim a
+        # single mid-drain retrace lands ~25 ms in a ~5 ms p99, which
+        # is real host behavior, not a detector defect
+        drill_metrics = tuple(
+            (m, d) for m, d in regress.SLO_METRICS
+            if m in ("slo_tpot_p95_ms", "slo_goodput_rps")
+        )
+        for run, row in clean_rows.items():
+            findings = regress.detect_slo(
+                [row.to_dict()], records, metrics=drill_metrics,
+                exclude_run=run,
+            )
+            check(
+                findings == [],
+                f"detect_slo over '{run}' vs the bank: zero findings "
+                f"(no false positives on clean hardware)",
+            )
+        chaos_findings = regress.detect_slo(
+            [drill.to_dict()], records,
+            exclude_run=str(os.environ.get("DDLB_TPU_RUN_ID", "")),
+        )
+        check(
+            chaos_findings == [],
+            "detect_slo fences the chaos row out of the static "
+            "baselines (distinct serve_topology stamp, zero findings)",
+        )
+        verdict = health.verdict_from_observations(
+            health.observations_from_history(records)
+        )
+        check(
+            verdict.get("status") != health.PERSISTENT,
+            f"health verdict over the bank indicts nobody "
+            f"({verdict.get('status')})",
+        )
+        print()
+    finally:
+        os.environ.pop("DDLB_TPU_FAULT_PLAN", None)
+        if saved_history is None:
+            os.environ.pop("DDLB_TPU_HISTORY", None)
+        else:
+            os.environ["DDLB_TPU_HISTORY"] = saved_history
+        if not args.out_dir:
+            shutil.rmtree(work, ignore_errors=True)
+        sys.stdout = tee._stdout
+
+    with open(args.log, "a", encoding="utf-8") as f:
+        if failures:
+            f.write(
+                f"\nchaos_elastic: {len(failures)} assertion(s) FAILED\n"
+            )
+        else:
+            f.write(
+                "\nchaos_elastic: seeded TPOT inflation detected and "
+                "indicted, a prefill shard promoted to recover the decode "
+                "pool inside the SLO, the healed shard exonerated and "
+                "re-admitted after probation, zero requests lost, and "
+                "the clean baselines banked with zero detector false "
+                "positives — OK\n"
+            )
+    if failures:
+        print(f"\nchaos_elastic: {len(failures)} assertion(s) FAILED",
+              flush=True)
+        for what in failures:
+            print(f"  FAIL {what}", flush=True)
+        return 1
+    print(
+        "\nchaos_elastic: detect -> resize -> recover -> exonerate -> "
+        "re-admit, zero requests lost — OK",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

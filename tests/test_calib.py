@@ -534,3 +534,103 @@ class TestUncalibratedPath:
                 closed.predicted_cal_s, rel=1e-9
             )
             assert sim.meta["calibration"]["chip"] == "cpu-sim"
+
+
+# ---------------------------------------------------------------------------
+# the KV-handoff fit (ISSUE 19): serving rows, the residual fit's
+# excluded slice, feed their own two-constant model
+# ---------------------------------------------------------------------------
+
+KV_SETUP = 2e-4   # s per bundle
+KV_PER_BYTE = 1.5e-9  # s per byte
+
+
+def _kv_row(i, *, handoffs=None, nbytes=None, error="", chip="cpu-sim"):
+    h = float(handoffs if handoffs is not None else 4 + i)
+    b = float(
+        nbytes if nbytes is not None else h * (1.0e6 + 2.0e5 * i)
+    )
+    return {
+        "primitive": "serving_load",
+        "base_implementation": "disagg",
+        "implementation": f"disagg_{i}",
+        "option": "-", "m": 16, "n": 64, "k": 128,
+        "dtype": "float32", "world_size": 4,
+        "chip": chip, "time_measurement_backend": "host_clock",
+        "error": error, "quarantined": False, "world_degraded": False,
+        "serve_handoffs": h,
+        "serve_handoff_bytes": b,
+        "serve_handoff_ms": (KV_SETUP * h + KV_PER_BYTE * b) * 1e3,
+    }
+
+
+class TestKVFit:
+    def test_features_eligibility(self):
+        assert calib.kv_row_features(_kv_row(0)) is not None
+        assert calib.kv_row_features(_kv_row(0, error="boom")) is None
+        assert calib.kv_row_features(_kv_row(0, handoffs=0)) is None
+        no_serve = {k: v for k, v in _kv_row(0).items()
+                    if not k.startswith("serve_")}
+        assert calib.kv_row_features(no_serve) is None
+        wrong_family = dict(_kv_row(0), primitive="tp_columnwise")
+        assert calib.kv_row_features(wrong_family) is None
+
+    def test_fit_recovers_injected_constants(self):
+        samples = [calib.kv_row_features(_kv_row(i)) for i in range(10)]
+        fit = calib.fit_kv_group(samples, min_rows=8)
+        assert fit is not None
+        setup_s, per_byte_s, rows = fit
+        assert rows == 10
+        assert setup_s == pytest.approx(KV_SETUP, rel=0.05)
+        assert per_byte_s == pytest.approx(KV_PER_BYTE, rel=0.05)
+
+    def test_collinear_bundles_pin_one_constant_nonnegative(self):
+        """Every bundle the same size makes count and bytes collinear;
+        the active-set rule must keep both constants >= 0 while the
+        surviving pair still reproduces the per-row handoff time."""
+        rows = [
+            _kv_row(i, handoffs=3 + i, nbytes=(3 + i) * 2.0e6)
+            for i in range(10)
+        ]
+        samples = [calib.kv_row_features(r) for r in rows]
+        fit = calib.fit_kv_group(samples, min_rows=8)
+        assert fit is not None
+        setup_s, per_byte_s, _ = fit
+        assert setup_s >= 0.0 and per_byte_s >= 0.0
+        predicted = setup_s * 7.0 + per_byte_s * 7.0 * 2.0e6
+        assert predicted == pytest.approx(
+            KV_SETUP * 7.0 + KV_PER_BYTE * 7.0 * 2.0e6, rel=0.05
+        )
+
+    def test_calibrate_history_attaches_kv_constants(self):
+        """A bank holding only serving rows still yields a table: the
+        group stands residual-zero (dispatch/step contribute nothing)
+        but carries the fitted kv constants, and
+        ``cost.kv_handoff_seconds`` prefers them over the census floor
+        exactly when ``kv_rows > 0``."""
+        from ddlb_tpu.perfmodel.cost import kv_handoff_seconds
+        from ddlb_tpu.perfmodel.specs import get_spec
+
+        records = [
+            {"kind": "row", "run_id": f"r{i}", "row": _kv_row(i)}
+            for i in range(10)
+        ]
+        table = calibrate.calibrate_history(records=records, min_rows=8)
+        assert table is not None
+        group = table.group("cpu-sim", "host_clock")
+        assert group is not None
+        assert group.kv_rows == 10
+        payload = 3.0e6
+        spec = get_spec("v5e")
+        fitted = kv_handoff_seconds(payload, spec, calib=group)
+        assert fitted == pytest.approx(
+            KV_SETUP + KV_PER_BYTE * payload, rel=0.05
+        )
+        # uncalibrated paths are byte-identical to the census floor
+        floor = kv_handoff_seconds(payload, spec)
+        assert kv_handoff_seconds(payload, spec, calib=None) == floor
+        unfitted = calib.GroupCalibration(
+            chip="cpu-sim", backend="host_clock",
+            dispatch_s=0.0, step_s=0.0,
+        )
+        assert kv_handoff_seconds(payload, spec, calib=unfitted) == floor

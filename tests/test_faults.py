@@ -118,6 +118,23 @@ def test_fail_attempts_gates_on_retry_attempt(monkeypatch):
         faults.inject("s")  # no raise
 
 
+def test_until_expires_rule_by_site_call_count(monkeypatch):
+    """The fault-that-clears-mid-run shape (ISSUE 19): a rule with
+    ``until: 2`` fires on the site's first two calls and never again —
+    the chaos-elastic drill's probation probes depend on the fault
+    going quiet while the drill is still running."""
+    _set_plan(monkeypatch, [
+        {"site": "s", "kind": "transient_error", "until": 2,
+         "fail_attempts": 99},
+    ])
+    with faults.scope(attempt=0):
+        for _ in range(2):
+            with pytest.raises(TimeoutError):
+                faults.inject("s")
+        for _ in range(5):
+            faults.inject("s")  # expired: quiet forever after
+
+
 def test_scope_collects_fired_sites(monkeypatch):
     _set_plan(monkeypatch, [
         {"site": "a", "kind": "transient_error", "fail_attempts": 99}

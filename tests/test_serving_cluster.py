@@ -140,6 +140,48 @@ class TestPrefixAffinityRouter:
         with pytest.raises(ValueError, match="imbalance"):
             PrefixAffinityRouter(2, imbalance=0.5)
 
+    def test_cost_weight_biases_load(self):
+        """A degraded shard at weight w looks w-times as loaded, so it
+        attracts proportionally less traffic instead of none (ISSUE 19:
+        degraded-but-alive is a weight, not an exclusion)."""
+        from ddlb_tpu.serve import PrefixAffinityRouter
+
+        r = self._router()
+        r.set_weight(0, 3.0)
+        assert r.route(-1, [2, 2, 2]) == 1  # loads: 6, 2, 2
+        assert r.route(-1, [1, 4, 4]) == 0  # 3 < 4: cheap enough again
+        with pytest.raises(ValueError, match="weight"):
+            r.set_weight(0, 0.5)
+
+    def test_readmit_restores_excluded_shard_at_weight(self):
+        from ddlb_tpu.serve import PrefixAffinityRouter
+
+        r = self._router()
+        r.drop_shard(0)
+        assert 0 not in r.live_shards()
+        r.readmit_shard(0, weight=2.0)
+        assert 0 in r.live_shards()
+        assert r.route(-1, [1, 3, 3]) == 0  # 2 < 3: back, cost-aware
+
+    def test_grow_add_remove_track_elastic_pools(self):
+        """Promotion wiring: ``grow`` widens the index space WITHOUT
+        making the prefill indices routable; ``add_shard`` admits one
+        mid-run; ``remove_shard`` retires it and forgets its
+        affinities (a demoted shard must not keep attracting its old
+        prefixes)."""
+        from ddlb_tpu.serve import PrefixAffinityRouter
+
+        r = PrefixAffinityRouter(2)
+        r.grow(3)
+        assert r.live_shards() == [0, 1]
+        assert r.route(-1, [9, 9, 0]) in (0, 1)  # index 2 not routable
+        r.add_shard(2)
+        assert r.route(5, [9, 9, 0]) == 2
+        assert r.affinity[5] == 2
+        r.remove_shard(2)
+        assert 5 not in r.affinity
+        assert r.route(5, [0, 1, 0]) == 0
+
 
 class TestKVBundle:
     def test_coerces_and_validates(self):
@@ -619,3 +661,200 @@ class TestClusterPlumbing:
             ) + extra:
                 assert knob in defaults, (member, knob)
                 assert knob in allowed, (member, knob)
+
+
+# ---------------------------------------------------------------------------
+# elastic pools: promote / demote / probation (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticPools:
+    """The resize controller and the exoneration loop on REAL tiny
+    engines, where token-level exactness against solo greedy chains is
+    still the oracle — a transition that generated a token twice, lost
+    a request, or double-stamped a first token cannot match."""
+
+    def _elastic_cluster(self, make_engines, **kw):
+        from ddlb_tpu.serve import ServingCluster
+
+        defaults = dict(
+            elastic=True, resize_backlog=2, resize_cooldown=1000,
+        )
+        defaults.update(kw)
+        return ServingCluster(*make_engines, **defaults)
+
+    def test_promote_exact_and_zero_lost(self):
+        """Decode backlog with prefill headroom promotes ONE prefill
+        shard: its prefill work drains to the surviving prefill shard,
+        the router gains a decode column, and every request still lands
+        on its exact solo chain with exactly-once accounting."""
+        engines, make = _tiny_world(3)
+        cluster = self._elastic_cluster((engines[:1], engines[1:]))
+        reqs = _requests(np.random.default_rng(7), 8, max_new_lo=4,
+                         max_new_hi=6)
+        gids = {}
+        for i, (prompt, max_new) in enumerate(reqs):
+            gid, ok = cluster.submit(prompt, max_new, now_s=0.0)
+            assert ok
+            gids[gid] = i
+        assert len(cluster.queue_depths()) == 1
+        _pump_until_done(cluster, len(reqs))
+        assert cluster.counters["resizes"] >= 1
+        assert any(
+            ev.startswith("promote:") for ev in cluster.pool_history
+        )
+        assert len(cluster.queue_depths()) == 2  # gauge grew mid-run
+        assert len(cluster.completions) == len(reqs)  # zero lost
+        seen = set()
+        for c in cluster.completions:
+            assert c.request_id not in seen  # exactly-once
+            seen.add(c.request_id)
+            assert c.first_s <= c.finished_s
+            prompt, max_new = reqs[gids[c.request_id]]
+            np.testing.assert_array_equal(
+                c.tokens, _solo_tokens(make, prompt, max_new)
+            )
+
+    def test_demote_returns_promoted_shard_home(self):
+        """The reverse breath: once decode pressure clears and prefill
+        backlog builds, the PROMOTED shard (home pool prefill) returns
+        — the constructed decode pool never shrinks below its
+        engineered size — and the max_new=1 burst that forced the
+        demotion still completes exactly."""
+        engines, make = _tiny_world(3)
+        cluster = self._elastic_cluster(
+            (engines[:1], engines[1:]), resize_cooldown=2
+        )
+        reqs = _requests(np.random.default_rng(8), 8, max_new_lo=4,
+                        max_new_hi=6)
+        for prompt, max_new in reqs:
+            cluster.submit(prompt, max_new, now_s=0.0)
+        _pump_until_done(cluster, len(reqs))
+        assert any(
+            ev.startswith("promote:") for ev in cluster.pool_history
+        )
+        # phase 2: a prefill-only burst piles the (now single-shard)
+        # prefill pool while the decode pool sits idle
+        burst = _requests(np.random.default_rng(9), 10, max_new_lo=1,
+                          max_new_hi=1)
+        gids = {}
+        for prompt, max_new in burst:
+            gid, ok = cluster.submit(prompt, max_new, now_s=1.0)
+            assert ok
+            gids[gid] = (prompt, max_new)
+        _pump_until_done(cluster, len(reqs) + len(burst))
+        assert any(
+            ev.startswith("demote:") for ev in cluster.pool_history
+        )
+        demoted = [sh for sh in cluster.prefill if sh.home_pool == "prefill"]
+        assert len(demoted) == 2  # both construction prefill shards home
+        for sh in cluster.shards:
+            assert sh.home_pool == "decode"  # engineered pool intact
+        for c in cluster.completions:
+            if c.request_id in gids:
+                prompt, max_new = gids[c.request_id]
+                np.testing.assert_array_equal(
+                    c.tokens, _solo_tokens(make, prompt, max_new)
+                )
+
+    def test_probation_exonerates_healthy_shard(self):
+        """A drained-but-healthy shard earns its way back: probe
+        windows close healthy, ``exoneration_verdict`` corroborates,
+        and the shard re-enters the router's candidate set with the
+        re-admission counted and journaled."""
+        engines, make = _tiny_world(2)
+        from ddlb_tpu.serve import ServingCluster
+
+        cluster = ServingCluster(
+            engines, watch_ticks=2, probation_ticks=2, probe_interval=1
+        )
+        reqs = _requests(np.random.default_rng(10), 6, max_new_lo=3,
+                         max_new_hi=5)
+        gids = {}
+        for i, (prompt, max_new) in enumerate(reqs):
+            gid, _ = cluster.submit(prompt, max_new, now_s=0.0)
+            gids[gid] = i
+        cluster.pump(0.0)
+        cluster.pump(0.01)
+        cluster.drain_shard(1, 0.02)
+        assert cluster.queue_depths()[1] == -1
+        sh = cluster._all[1]
+        assert sh.probation
+        t, limit = 0.03, 400
+        while cluster.counters["readmitted"] < 1:
+            cluster.pump(t)
+            t += 0.01
+            limit -= 1
+            assert limit > 0, "healthy shard never exonerated"
+        assert any(
+            ev.startswith("exonerate:1@") for ev in cluster.pool_history
+        )
+        assert not sh.excluded and not sh.probation
+        assert 1 in cluster.router.live_shards()
+        assert cluster.queue_depths()[1] >= 0
+        # the ledger never saw a probe completion
+        _pump_until_done(cluster, len(reqs))
+        assert len(cluster.completions) == len(reqs)
+        for c in cluster.completions:
+            prompt, max_new = reqs[gids[c.request_id]]
+            np.testing.assert_array_equal(
+                c.tokens, _solo_tokens(make, prompt, max_new)
+            )
+
+    def test_probe_interval_paces_probe_ticks(self):
+        """Probes ride the pump loop synchronously, so cadence is a
+        live-traffic protection: with ``probe_interval=5`` the excluded
+        engine steps on every fifth pump only."""
+        engines, _ = _tiny_world(2)
+        from ddlb_tpu.serve import ServingCluster
+
+        cluster = ServingCluster(
+            engines, watch_ticks=2, probation_ticks=3, probe_interval=5
+        )
+        reqs = _requests(np.random.default_rng(11), 4)
+        for prompt, max_new in reqs:
+            cluster.submit(prompt, max_new, now_s=0.0)
+        cluster.pump(0.0)
+        cluster.drain_shard(1, 0.01)
+        probed = cluster._all[1].engine
+        calls = {"n": 0}
+        orig_step = probed.step
+
+        def counted_step():
+            calls["n"] += 1
+            return orig_step()
+
+        probed.step = counted_step
+        start = cluster._pump_count
+        for i in range(10):
+            cluster.pump(0.02 + 0.01 * i)
+        expect = sum(
+            1 for p in range(start + 1, cluster._pump_count + 1)
+            if p % 5 == 0
+        )
+        assert calls["n"] == expect
+
+
+@pytest.mark.slow
+def test_near_critical_load_elastic_member_accounts_exactly():
+    """ROADMAP's measurement-hostility case: the disaggregated member
+    driven at a near-critical arrival rate with elasticity armed. The
+    interesting property is not latency (CPU-sim makes no promises
+    there) but conservation: whatever the pools did — promote, demote,
+    shed at the door — the row validates and the ledger partitions the
+    trace exactly (completed + rejected == submitted, both drains)."""
+    from ddlb_tpu.benchmark import benchmark_worker
+
+    row = benchmark_worker(
+        _cluster_config(
+            "disagg", prefill_shards=2, decode_shards=2,
+            rate=400.0, n_requests=60, out_mean=8, out_max=12,
+            elastic=1, resize_backlog=2, resize_cooldown=8,
+            probation_ticks=2, watch_ticks=4,
+        )
+    )
+    assert row["error"] == "" and bool(row["valid"])
+    assert int(row["slo_completed"]) + int(row["serve_rejected"]) == 2 * 60
+    assert row["serve_topology"].startswith("disagg:p2+d2")
+    for col in ("serve_resizes", "serve_pool_history", "serve_readmitted"):
+        assert col in row, col

@@ -275,6 +275,58 @@ class TestSLOGate:
             == []
         )
 
+    def test_min_history_withholds_judgment_on_thin_baselines(self):
+        """ISSUE 19's false-positive rail #1: below SLO_MIN_HISTORY
+        banked runs the MAD is meaningless (one or two rows -> spread
+        ~0, every jitter z-scores to infinity), so the gate abstains
+        even on a gross apparent slowdown — and fires once the
+        baseline is deep enough."""
+        from ddlb_tpu.observatory import regress
+
+        slowed = [_serving_record("cur", ttft95=41.0)["row"]]
+        thin = self._history(regress.SLO_MIN_HISTORY - 1)
+        assert regress.detect_slo(slowed, thin, exclude_run="cur") == []
+        deep = self._history(regress.SLO_MIN_HISTORY + 1)
+        assert regress.detect_slo(slowed, deep, exclude_run="cur")
+
+    def test_absolute_floors_ignore_sub_noise_excursions(self):
+        """Rail #2: on a CPU-sim drill the percentiles live in
+        single-digit milliseconds with near-zero MAD, so the relative
+        machinery alone would flag sub-millisecond jitter. The
+        ``SLO_ABS`` floors demand a real excess — and the same floors
+        let a genuine excursion through."""
+        from ddlb_tpu.observatory import regress
+
+        history = [
+            _serving_record(f"r{i}", ttft95=2.0) for i in range(4)
+        ]
+        _, min_excess = regress.SLO_ABS_DEFAULT
+        # huge ratio (1.45x) and huge z (MAD ~ 0), excess below floor
+        jitter = [_serving_record("cur", ttft95=2.0 + 0.9 * min_excess)["row"]]
+        assert regress.detect_slo(jitter, history, exclude_run="cur") == []
+        real = [_serving_record("cur", ttft95=2.0 + 2.0 * min_excess)["row"]]
+        findings = regress.detect_slo(real, history, exclude_run="cur")
+        # the fixture derives p99 from the same knob: both TTFT tails
+        # clear the floors, nothing else does
+        assert sorted(f["metric"] for f in findings) == [
+            "slo_ttft_p95_ms", "slo_ttft_p99_ms",
+        ]
+
+    def test_goodput_floor_is_metric_scaled(self):
+        """Goodput lives in single-digit rps, so it carries its own
+        ``SLO_ABS`` entry — a 0.1 rps wobble is noise, a 1 rps drop on
+        a 3 rps baseline is an incident."""
+        from ddlb_tpu.observatory import regress
+
+        history = [
+            _serving_record(f"r{i}", goodput=3.0) for i in range(4)
+        ]
+        wobble = [_serving_record("cur", goodput=2.9)["row"]]
+        assert regress.detect_slo(wobble, history, exclude_run="cur") == []
+        drop = [_serving_record("cur", goodput=2.0)["row"]]
+        findings = regress.detect_slo(drop, history, exclude_run="cur")
+        assert [f["metric"] for f in findings] == ["slo_goodput_rps"]
+
     def test_non_serving_rows_contribute_nothing(self):
         from ddlb_tpu.observatory import regress
 
@@ -361,7 +413,10 @@ class TestServingLoadReport:
 
         monkeypatch.delenv("DDLB_TPU_HISTORY", raising=False)
         hist = tmp_path / "hist"
-        for i, run in enumerate(("base-1", "base-2", "base-3")):
+        # four banked runs: the gate's self-copy exclusion drops the
+        # one whose (key, median) matches the current CSV, and the
+        # survivors must still clear SLO_MIN_HISTORY
+        for i, run in enumerate(("base-1", "base-2", "base-3", "base-4")):
             for rate in (4.0, 64.0):
                 banked = _curve_row(rate, 5.0 + 0.1 * i, 9.0 + 0.1 * i, 3.9)
                 # distinct medians per run: identical (key, median)

@@ -93,7 +93,54 @@ def test_select_composition_auto_fault_plan(monkeypatch, tmp_path):
     assert "link" in reason
 
 
+def test_composition_signature_tracks_health_inputs(monkeypatch, tmp_path):
+    """The auto-resolution cache key (ISSUE 19): any input
+    ``select_composition`` consults — degraded stamp, fault plan,
+    history bank identity or content — moves the signature."""
+    from ddlb_tpu.observatory import store
+    from ddlb_tpu.primitives.topo_compose import composition_signature
+
+    monkeypatch.delenv("DDLB_TPU_WORLD_DEGRADED", raising=False)
+    monkeypatch.delenv("DDLB_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("DDLB_TPU_HISTORY", raising=False)
+    base = composition_signature()
+    assert composition_signature() == base  # stable while inputs hold
+    monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "1")
+    degraded = composition_signature()
+    assert degraded != base
+    monkeypatch.setenv("DDLB_TPU_FAULT_PLAN", json.dumps({"rules": []}))
+    planned = composition_signature()
+    assert planned != degraded
+    monkeypatch.setenv("DDLB_TPU_HISTORY", str(tmp_path))
+    banked_0 = composition_signature()
+    assert banked_0 != planned
+    store.bank_row(
+        {"primitive": "collectives", "implementation": "x",
+         "median time (ms)": 1.0},
+        run="r1", directory=str(tmp_path),
+    )
+    assert composition_signature() != banked_0  # bank mtime moved
+
+
+def test_auto_reresolves_when_world_degrades_mid_member(monkeypatch):
+    """A live ``auto`` member re-resolves at the next row boundary when
+    the degraded stamp lands mid-sweep — no relaunch — while a PINNED
+    composition is never second-guessed."""
+    monkeypatch.delenv("DDLB_TPU_WORLD_DEGRADED", raising=False)
+    cls = load_impl_class("collectives", "jax_spmd_striped")
+    auto = cls(M, 1, K, dtype="float32", composition="auto")
+    assert auto._resolved_composition() == "flat"  # healthy 1-slice
+    pinned = cls(M, 1, K, dtype="float32", composition="striped")
+    assert pinned._resolved_composition() == "striped"
+    monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "1")
+    assert auto._resolved_composition() == "striped"
+    assert pinned._resolved_composition() == "striped"
+    monkeypatch.delenv("DDLB_TPU_WORLD_DEGRADED")
+    assert auto._resolved_composition() == "flat"  # and back
+
+
 # -- torus mesh ---------------------------------------------------------------
+
 
 
 def test_torus_mesh_shape():
