@@ -7,7 +7,7 @@ PYTHON ?= python
 	hooks ci calib-report chaos-launch chaos-degrade chaos-elastic \
 	overlap-report \
 	serving-load-report serving-cluster-report sim-report \
-	sim-report-degrade skew-report clean
+	sim-report-degrade skew-report tune-report clean
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -66,6 +66,7 @@ ci:
 	$(MAKE) chaos-degrade
 	$(MAKE) chaos-elastic
 	$(MAKE) calib-report
+	$(MAKE) tune-report
 
 # chunked-fusion engine acceptance: the CPU-sim demo sweep (chunked vs
 # unchunked overlap members, schedule-law self-check, banked transcript
@@ -120,6 +121,18 @@ skew-report:
 # docs/calib_demo.log (docs/source/simulator.rst "Calibration")
 calib-report:
 	$(PYTHON) scripts/calib_demo.py
+
+# prior-guided autotuner acceptance: four 2-device CPU-sim searches
+# (Pallas tiles, chunked depths, composition) with >= 50% of the
+# combined feasible space pruned by the priors before any compile, the
+# banked winner never worse than the registered default, a forced
+# re-run reproducing a byte-identical table from the banked trials,
+# table-primed searches short-circuiting with zero trials, and a real
+# sweep row carrying the tuned/tuning_version/prior_rank stamps —
+# banked transcript at docs/tune_demo.log
+# (docs/source/performance.rst "Prior-guided autotuning")
+tune-report:
+	$(PYTHON) scripts/tune_demo.py
 
 # multi-process chaos battery: rank-targeted hang/exit/SIGKILL under the
 # supervised launcher (detection, attribution, world relaunch, zero rows

@@ -216,4 +216,61 @@ class RowSchemaCoverageRule(ProjectRule):
         return problems
 
 
-RULES = [CostModelCoverageRule(), RowSchemaCoverageRule()]
+class KnobSpaceCoverageRule(ProjectRule):
+    """Every registered family declares a knob space or is knob-free."""
+
+    id = "DDLB140"
+    name = "knob-space-coverage"
+    rationale = (
+        "a family absent from both tuner SPACES and KNOB_FREE has no "
+        "tuning story at all — the autotuner silently skips it and "
+        "nothing records whether that was a decision or an omission"
+    )
+
+    def check_project(
+        self, contexts: Sequence[FileContext]
+    ) -> Iterable[Finding]:
+        if not _covers_package(contexts):
+            return []
+        anchor = "ddlb_tpu/tuner/space.py"
+        try:
+            from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+            from ddlb_tpu.tuner.space import KNOB_FREE, SPACES
+        except Exception as exc:
+            return [
+                Finding(
+                    self.id, anchor, 1, 1,
+                    f"tuner: knob-space coverage check failed to "
+                    f"import: {type(exc).__name__}: {exc}",
+                )
+            ]
+        declared = {family for family, _impl in SPACES}
+        problems = [
+            Finding(
+                self.id, anchor, 1, 1,
+                f"tuner: primitive family '{fam}' declares no knob "
+                f"space in SPACES and is not listed knob-free in "
+                f"KNOB_FREE (ddlb_tpu/tuner/space.py) — the autotuner "
+                f"silently skips it",
+            )
+            for fam in ALLOWED_PRIMITIVES
+            if fam not in declared and fam not in KNOB_FREE
+        ]
+        # a family both searchable and declared knob-free is a
+        # contradiction the registry must not carry
+        problems.extend(
+            Finding(
+                self.id, anchor, 1, 1,
+                f"tuner: primitive family '{fam}' appears in BOTH "
+                f"SPACES and KNOB_FREE — pick one",
+            )
+            for fam in sorted(declared & set(KNOB_FREE))
+        )
+        return problems
+
+
+RULES = [
+    CostModelCoverageRule(),
+    RowSchemaCoverageRule(),
+    KnobSpaceCoverageRule(),
+]

@@ -89,6 +89,13 @@ PERF_ROW_DEFAULTS: Dict[str, Any] = {
     "predicted_cal_s": float("nan"),
     "cal_residual_frac": float("nan"),
     "cal_version": "",
+    # the tuning trio (ISSUE 20): stamped only when an active
+    # DDLB_TPU_TUNING table hit applied banked knobs to this impl;
+    # these defaults otherwise, so an untuned sweep's rows are
+    # byte-identical to pre-tuner ones
+    "tuned": False,
+    "tuning_version": "",
+    "prior_rank": float("nan"),
     **overlap_attribution.ATTRIBUTION_ROW_DEFAULTS,
 }
 
@@ -107,13 +114,24 @@ def _perfmodel_fields(
     columns with a warning."""
     if impl is None:
         return {}
+    # the tuning trio (ISSUE 20): which banked winner this construction
+    # applied, if any (Primitive._consult_tuning_table) — stamped even
+    # when the cost model below fails, so tuned rows stay fenceable
+    stamp = getattr(impl, "tuning_stamp", None)
+    tuning_fields: Dict[str, Any] = {}
+    if isinstance(stamp, dict):
+        tuning_fields = {
+            "tuned": bool(stamp.get("tuned", False)),
+            "tuning_version": str(stamp.get("tuning_version", "")),
+            "prior_rank": float(stamp.get("prior_rank", float("nan"))),
+        }
     try:
         est = impl.cost_model()
     except Exception as exc:
         telemetry.warn(
             f"perfmodel cost estimate failed: {type(exc).__name__}: {exc}"
         )
-        return {}
+        return tuning_fields
     finite = times_ms[np.isfinite(times_ms)]
     measured_s = float(np.median(finite)) * 1e-3 if finite.size else float("nan")
     fields = {
@@ -142,6 +160,7 @@ def _perfmodel_fields(
         fields["predicted_cal_s"] = cal.predicted_cal_s
         fields["cal_residual_frac"] = cal.residual_frac(measured_s)
         fields["cal_version"] = cal.version
+    fields.update(tuning_fields)
     return fields
 
 

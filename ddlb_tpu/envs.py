@@ -214,6 +214,22 @@ def get_calib_path() -> str:
     return os.environ.get("DDLB_TPU_CALIB", "").strip()
 
 
+def get_tuning_table_path() -> str:
+    """Tuning-table JSON path ("" = untuned defaults).
+
+    When set, member construction consults the versioned per-chip
+    tuning table (``ddlb_tpu.tuner.table``) banked by the prior-guided
+    search driver (``ddlb_tpu.tuner.driver``): a table hit applies the
+    banked winning knobs (Pallas tiles, ``chunk_count``, composition)
+    in place of the registered defaults — explicit per-config options
+    always win — and stamps the row's ``tuned`` / ``tuning_version`` /
+    ``prior_rank`` columns. Unset keeps every member on its registered
+    defaults and the three columns inert — byte-identical rows.
+    Follows the DDLB_TPU_* convention: empty/unset disables.
+    """
+    return os.environ.get("DDLB_TPU_TUNING", "").strip()
+
+
 def get_live_path() -> str:
     """Live sweep-stream file ("" = stream disabled).
 

@@ -203,15 +203,39 @@ def detect(
     Each finding carries the evidence a report needs: the key's
     identity columns, measured vs baseline, the robust z, the slowdown
     ratio, and ``source`` (``history`` | ``perfmodel_prior``).
+
+    Baselines are fenced per ``tuning_version`` (ISSUE 20), exactly as
+    ``detect_calibration`` fences per ``cal_version``: a row measured
+    under a tuning table only baselines against history measured under
+    the SAME table fingerprint — a re-tune that changes the applied
+    knobs starts a fresh baseline instead of reading as a step change.
+    Untuned rows (version "") compare against untuned history, which on
+    a pre-tuner bank is ALL of it — behavior unchanged.
     """
-    base = baselines(history, metric=metric, exclude_run=exclude_run)
+
+    def _tuning_version(row: Dict[str, Any]) -> str:
+        return str(row.get("tuning_version") or "")
+
+    versions = {_tuning_version(row) for row in current_rows}
+    base_by_version = {
+        version: baselines(
+            [
+                rec
+                for rec in history
+                if _tuning_version(rec.get("row") or {}) == version
+            ],
+            metric=metric,
+            exclude_run=exclude_run,
+        )
+        for version in versions
+    }
     findings: List[Dict[str, Any]] = []
     for row in current_rows:
         measured = finite(row.get(metric))
         if measured is None:
             continue  # error rows have no measurement to regress
         key = row_key(row)
-        stats = base.get(key)
+        stats = base_by_version[_tuning_version(row)].get(key)
         if stats is not None:
             finding = _history_finding(
                 row, key, metric, measured, stats, "high",

@@ -36,7 +36,7 @@ class QuantizedGEMMMixin:
         "block_m": (128, None),
         "block_n": (128, None),
         "block_k": (128, None),
-        "tune": [True, False],
+        "tune": [True, False, "auto"],
     }
 
     def _check_quantized_options(self) -> None:
@@ -86,7 +86,7 @@ class QuantizedGEMMMixin:
         bm = min(self.options["block_m"], gemm_m)
         bn = min(self.options["block_n"], self.n)
         bk = min(self.options["block_k"], max_k)
-        if self.options["tune"]:
+        if self.options["tune"] is True:  # "auto" consults the table only
             from ddlb_tpu.utils.autotune import (
                 autotune,
                 cached_blocks,

@@ -352,6 +352,21 @@ def load_impl_class(primitive: str, name: str) -> Type:
     return getattr(module, class_name)
 
 
+def impl_name_of(cls: Type) -> str:
+    """Reverse lookup: the registry name of an implementation class
+    (``PallasTPColumnwise`` -> ``"pallas"``), by (module, class-name)
+    match so subclasses outside the registry resolve to "". The tuning
+    consult path (``Primitive._consult_tuning_table``) keys table
+    entries by this name — the same identity the sweep configs and the
+    search driver use."""
+    family = getattr(cls, "primitive_name", "")
+    table = _REGISTRY.get(family, {})
+    for name, (module_name, class_name) in table.items():
+        if cls.__module__ == module_name and cls.__name__ == class_name:
+            return name
+    return ""
+
+
 def _check_primitive(primitive: str) -> None:
     if primitive not in ALLOWED_PRIMITIVES:
         # reference ALLOWED_PRIMITIVES check, ddlb/benchmark.py:267

@@ -124,16 +124,39 @@ def health_link_verdict(world: Optional[int] = None) -> Dict[str, Any]:
         return {"status": "healthy", "links": []}
 
 
+def degraded_world_signal(world: Optional[int] = None) -> bool:
+    """Is the world running degraded by ANY of the three detectors the
+    composition logic consults: the supervised launcher's relaunch
+    stamp (``DDLB_TPU_WORLD_DEGRADED``), a seeded link fault in the
+    fault plan, or a persistent health indictment with named links.
+    The tuning table's online re-tune hook (ISSUE 20 stretch) keys off
+    this ONE signal: a banked ``composition`` winner is invalidated
+    while it holds (``tuner.table.TuningTable.lookup``), so the next
+    construction falls back to its default / ``auto`` re-resolve and
+    the next search re-banks under the degraded topology."""
+    if envs.get_world_degraded():
+        return True
+    if fault_plan_link_faults():
+        return True
+    verdict = health_link_verdict(world)
+    return bool(
+        verdict.get("status") == "persistent" and verdict.get("links")
+    )
+
+
 def composition_signature() -> Tuple[Any, ...]:
     """Cheap fingerprint of every input ``select_composition`` consults
-    for ``auto``: the degraded-world stamp, the fault-plan knob, and the
+    for ``auto``: the degraded-world stamp, the fault-plan knob, the
     history bank's identity + mtime (the bank is ONE append-only file,
-    so any row the SLO/health gates bank moves its mtime). A cached
-    ``auto`` resolution is valid exactly while this tuple is unchanged —
-    which is what lets a long-lived member re-resolve at the next row
-    boundary when the health verdict flips MID-SWEEP (ISSUE 19
-    satellite: a gate firing re-ranks compositions without a relaunch)
-    while costing two env reads and one stat() on the happy path."""
+    so any row the SLO/health gates bank moves its mtime), and the
+    tuning table's identity + mtime (ISSUE 20: a re-banked composition
+    winner must re-resolve a cached ``auto`` the same way a health flip
+    does). A cached ``auto`` resolution is valid exactly while this
+    tuple is unchanged — which is what lets a long-lived member
+    re-resolve at the next row boundary when the health verdict flips
+    MID-SWEEP (ISSUE 19 satellite: a gate firing re-ranks compositions
+    without a relaunch) while costing three env reads and two stat()s
+    on the happy path."""
     directory = envs.get_history_dir()
     mtime = 0
     if directory:
@@ -145,11 +168,20 @@ def composition_signature() -> Tuple[Any, ...]:
                 mtime = os.stat(path).st_mtime_ns
             except OSError:
                 mtime = 0
+    tuning_path = envs.get_tuning_table_path()
+    tuning_mtime = 0
+    if tuning_path:
+        try:
+            tuning_mtime = os.stat(tuning_path).st_mtime_ns
+        except OSError:
+            tuning_mtime = 0
     return (
         bool(envs.get_world_degraded()),
         str(envs.get_fault_plan() or ""),
         str(directory or ""),
         mtime,
+        str(tuning_path or ""),
+        tuning_mtime,
     )
 
 

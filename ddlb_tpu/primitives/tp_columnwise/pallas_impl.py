@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ddlb_tpu.ops.collective_matmul import ring_ag_matmul
 from ddlb_tpu.ops.matmul import matmul
 from ddlb_tpu.primitives.tp_columnwise.base import TPColumnwise
+from ddlb_tpu.runtime import shard_map_compat
 
 
 class PallasTPColumnwise(TPColumnwise):
@@ -49,7 +50,7 @@ class PallasTPColumnwise(TPColumnwise):
         "block_n": (128, None),
         "block_k": (128, None),
         "detect_races": [True, False],
-        "tune": [True, False],
+        "tune": [True, False, "auto"],
     }
 
     def _check_shapes(self) -> None:
@@ -119,8 +120,10 @@ class PallasTPColumnwise(TPColumnwise):
                             partial, "tp", axis=0, tiled=True
                         )
 
+                # shard_map_compat: jax.shard_map where it exists, the
+                # pre-0.5 experimental entry point otherwise
                 return jax.jit(
-                    jax.shard_map(
+                    shard_map_compat(
                         step,
                         mesh=self.mesh,
                         in_specs=(P("tp", None), P(None, None)),
@@ -130,7 +133,7 @@ class PallasTPColumnwise(TPColumnwise):
                 )
 
             bm, bn, bk = opts["block_m"], opts["block_n"], opts["block_k"]
-            if opts["tune"]:
+            if opts["tune"] is True:  # "auto" consults the table only
                 from ddlb_tpu.utils.autotune import (
                     autotune,
                     gemm_block_candidates,
@@ -159,7 +162,7 @@ class PallasTPColumnwise(TPColumnwise):
             return
 
         self._fn = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step,
                 mesh=self.mesh,
                 in_specs=(P("tp", None), P(None, None)),

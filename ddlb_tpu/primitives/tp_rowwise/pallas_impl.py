@@ -41,7 +41,7 @@ class PallasTPRowwise(TPRowwise):
         "block_n": (128, None),
         "block_k": (128, None),
         "detect_races": [True, False],
-        "tune": [True, False],
+        "tune": [True, False, "auto"],
     }
 
     def _check_shapes(self) -> None:
@@ -112,7 +112,7 @@ class PallasTPRowwise(TPRowwise):
                 )
 
             bm, bn, bk = opts["block_m"], opts["block_n"], opts["block_k"]
-            if opts["tune"]:
+            if opts["tune"] is True:  # "auto" consults the table only
                 from ddlb_tpu.utils.autotune import (
                     autotune,
                     gemm_block_candidates,

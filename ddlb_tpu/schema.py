@@ -84,6 +84,23 @@ ROW_COLUMNS: Dict[str, str] = {
         " (perfmodel.calib.table_version); '' when uncalibrated —"
         " residual baselines never mix across refits"
     ),
+    # -- tuning-table consult (ISSUE 20: ddlb_tpu/tuner; all three sit
+    #    at their defaults — False / "" / NaN — whenever DDLB_TPU_TUNING
+    #    is unset, keeping the untuned row byte-identical) --------------
+    "tuned": (
+        "an active tuning-table hit applied banked knobs to this"
+        " construction (Primitive._consult_tuning_table); False on"
+        " untuned rows and table misses"
+    ),
+    "tuning_version": (
+        "tuning-table fingerprint the applied knobs came from"
+        " (tuner.table.table_version); '' when untuned — regression"
+        " baselines never mix across re-tunes"
+    ),
+    "prior_rank": (
+        "the applied winner's 1-based rank in the search's prior order"
+        " (rank 1 = the cost model called it); NaN when untuned"
+    ),
     # -- observatory measured-overlap attribution (ISSUE 6) -------------
     "measured_overlap_frac": (
         "achieved overlap fraction: (serial floor - measured) / hideable,"

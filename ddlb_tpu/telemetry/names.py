@@ -44,6 +44,10 @@ SPAN_NAMES: Dict[str, str] = {
     "sim.validate": "simulator validation pass (closed-form or history join)",
     "skew.fold": "cross-rank skew fold: stamp allgather + clock-aligned fold",
     "timeline.merge": "world-timeline build over a flight-recorder run dir",
+    "tune.search": (
+        "one prior-guided knob search: propose -> prune -> measure -> "
+        "bank (tuner.driver.search)"
+    ),
     "worker.profile": "benchmark_worker optional profiling phase",
     "worker.row": "benchmark_worker one full row (the report join key)",
     "worker.setup": "benchmark_worker input/mesh setup phase",
@@ -107,6 +111,15 @@ INSTANT_NAMES: Dict[str, str] = {
     "topo.recompose": (
         "a composition=auto member re-resolved to a different "
         "composition mid-sweep (health/fault/degraded inputs moved)"
+    ),
+    "tune.bank": "a tuner trial row banked to the store (kind=tune)",
+    "tune.prune": (
+        "the priors cut a feasible candidate before any compile "
+        "(outside prior_margin of the best prior)"
+    ),
+    "tune.trial": (
+        "one measured (or bank-reused) tuner candidate with its "
+        "prior rank and median"
     ),
 }
 

@@ -1,0 +1,26 @@
+"""Prior-guided autotuner: propose -> prune -> measure -> bank -> consult.
+
+The search driver the ROADMAP's "perfmodel+simulator-guided autotuning
+at sweep scale" item names (ISSUE 20). Submodules, in loop order:
+
+- ``space``   — knob registry + static feasibility (the propose half)
+- ``priors``  — cost/calibrated scoring and margin pruning
+- ``driver``  — the measurement loop (pool leases, compile-ahead,
+  ``kind="tune"`` banking, early stop)
+- ``table``   — versioned per-(chip, backend) winner tables the
+  runners consult by default (``DDLB_TPU_TUNING``)
+
+Only ``table`` is imported eagerly: it is stdlib-only, and it is the
+one module the hot consult path (``Primitive.__init__``) and
+``utils.autotune``'s cache need — searching imports the heavier
+submodules on demand.
+"""
+
+from ddlb_tpu.tuner import table  # noqa: F401  (the consult-path module)
+from ddlb_tpu.tuner.table import (  # noqa: F401
+    TuneEntry,
+    TuningTable,
+    get_table,
+    load_table,
+    save_table,
+)

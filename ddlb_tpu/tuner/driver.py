@@ -1,0 +1,362 @@
+"""The search driver: propose -> prune -> measure -> bank -> consult.
+
+One ``search()`` call closes the loop for a single ``(family, impl,
+shape, dtype, world)`` target: the knob registry proposes the feasible
+space (``tuner.space``), the priors price and prune it
+(``tuner.priors``), the survivors are measured in prior-rank order —
+on a leased warm-pool worker with the NEXT candidate prefetch-compiling
+in the worker's background thread when a pool is provided
+(``pool.run_one_row(prefetch=...)`` -> ``compile_ahead
+.make_worker_scheduler``, the workload in-worker compile-ahead was
+built for), in-process otherwise — with ``patience`` early-stop, and
+every trial is banked to the observatory store under ``kind="tune"`` so
+tuning history is queryable exactly like sweep history.
+
+Determinism: trials already banked for the same ``tune_key`` +
+``tune_candidate`` are REUSED instead of re-measured (``reuse_banked``),
+so a re-run against the same history bank reproduces identical medians,
+identical winners, and a byte-identical table fingerprint — the
+``scripts/tune_demo.py`` contract. The registered default knobs are
+always measured (prior-exempt), so the banked winner is never worse
+than what an untuned run would have used.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ddlb_tpu import telemetry
+from ddlb_tpu.tuner import priors
+from ddlb_tpu.tuner import space as spaces
+from ddlb_tpu.tuner import table as tables
+from ddlb_tpu.tuner.space import SearchSpec
+from ddlb_tpu.tuner.table import TuneEntry, canonical_knobs
+
+#: the sweep schema's measurement column (observatory.regress reads the
+#: same literal) — the driver ranks trials by it
+MEASURE_COLUMN = "median time (ms)"
+
+
+@dataclass(frozen=True)
+class Trial:
+    """One measured candidate."""
+
+    knobs: Dict[str, Any]
+    prior_s: float
+    prior_rank: int
+    median_ms: float
+    from_bank: bool = False  # reused a banked trial, no re-measure
+    error: str = ""
+
+
+@dataclass
+class SearchResult:
+    """Everything one search produced (the demo transcript's facts)."""
+
+    spec: SearchSpec
+    entry: Optional[TuneEntry] = None
+    trials: List[Trial] = field(default_factory=list)
+    #: candidates the priors cut before any compile
+    pruned: List[priors.ScoredCandidate] = field(default_factory=list)
+    #: statically infeasible points (never scored, never built)
+    rejected: List[Tuple[Dict[str, Any], str]] = field(default_factory=list)
+    #: feasible candidates proposed (scored)
+    candidates: int = 0
+    #: the search short-circuited on an existing table hit
+    table_hit: bool = False
+    #: early-stop fired after `patience` non-improvements
+    early_stopped: bool = False
+    default_ms: float = float("nan")
+
+    def spearman(self) -> float:
+        """Prior-vs-measured rank agreement over the finite trials."""
+        xs = [t.prior_s for t in self.trials if t.median_ms == t.median_ms]
+        ys = [t.median_ms for t in self.trials if t.median_ms == t.median_ms]
+        return priors.spearman(xs, ys)
+
+
+def trial_config(
+    spec: SearchSpec,
+    knobs: Dict[str, Any],
+    *,
+    num_iterations: int = 5,
+    num_warmups: int = 2,
+) -> Dict[str, Any]:
+    """The benchmark-worker config for one candidate — the same contract
+    the sweep runner dispatches, so pool leasing, compile-ahead and
+    fault classification all behave identically under the tuner."""
+    options = spec.options_base()
+    options.update(knobs)
+    return {
+        "primitive": spec.family,
+        "impl_id": f"tune:{spec.family}/{spec.impl}",
+        "base_implementation": spec.impl,
+        "options": options,
+        "m": spec.m,
+        "n": spec.n,
+        "k": spec.k,
+        "dtype": spec.dtype,
+        "num_iterations": num_iterations,
+        "num_warmups": num_warmups,
+        "time_measurement_backend": spec.backend,
+        "barrier_at_each_iteration": False,
+        "validate": False,
+    }
+
+
+def _median_ms(row: Optional[Dict[str, Any]]) -> float:
+    if not isinstance(row, dict):
+        return float("nan")
+    try:
+        value = float(row.get(MEASURE_COLUMN))  # type: ignore[arg-type]
+    except (TypeError, ValueError):
+        return float("nan")
+    return value
+
+
+def _error_row(config: Dict[str, Any], error: str) -> Dict[str, Any]:
+    """Dead/hung-worker row for the pool path: enough columns for the
+    trial record and the bank, nothing the sweep schema would miss."""
+    return {
+        "primitive": config.get("primitive", ""),
+        "implementation": config.get("impl_id", ""),
+        MEASURE_COLUMN: float("nan"),
+        "error": str(error or "worker died"),
+    }
+
+
+def _banked_median(
+    history_dir: str, tune_key: str, cand_key: str
+) -> Optional[float]:
+    """The most recent banked ``kind="tune"`` trial for this exact
+    (search target, candidate), when one exists with a clean finite
+    median — the reuse that makes re-runs byte-identical."""
+    from ddlb_tpu.observatory import store
+
+    found: Optional[float] = None
+    try:
+        records = store.iter_history(history_dir, kind="tune")
+    except Exception:
+        return None
+    for record in records:
+        row = record.get("row") or {}
+        if row.get("tune_key") != tune_key:
+            continue
+        if row.get("tune_candidate") != cand_key:
+            continue
+        if str(row.get("error") or ""):
+            continue
+        median = _median_ms(row)
+        if median == median:
+            found = median
+    return found
+
+
+def search(
+    spec: SearchSpec,
+    *,
+    prior_margin: float = 1.5,
+    patience: int = 3,
+    pool: Optional[Any] = None,
+    measure: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None,
+    history_dir: Optional[str] = None,
+    num_iterations: int = 5,
+    num_warmups: int = 2,
+    reuse_banked: bool = True,
+    force: bool = False,
+) -> SearchResult:
+    """Run one prior-guided search. ``pool``: a ``WorkerPool`` to lease
+    measurement workers from (with next-candidate prefetch-compile);
+    ``measure``: explicit row function (tests inject synthetic
+    landscapes here); neither -> in-process ``benchmark_worker``.
+    ``force=False`` short-circuits on an existing table hit — the
+    zero-search-trials path a primed sweep pays."""
+    result = SearchResult(spec=spec)
+    if not force:
+        tbl = tables.get_table()
+        if tbl is not None:
+            hit = tbl.lookup(
+                spec.family, spec.impl, spec.m, spec.n, spec.k,
+                spec.dtype, spec.num_partitions, chip=spec.chip,
+            )
+            if hit is not None:
+                result.entry = hit
+                result.table_hit = True
+                return result
+
+    with telemetry.span(
+        "tune.search", cat="tune",
+        family=spec.family, impl=spec.impl,
+        shape=f"{spec.m}x{spec.n}x{spec.k}", dtype=spec.dtype,
+    ):
+        proposal = spaces.propose(spec)
+        result.rejected = list(proposal.rejected)
+        candidates = list(proposal.candidates)
+        default = spaces.default_knobs(spec)
+        default_key = canonical_knobs(default)
+        if default_key not in {canonical_knobs(c) for c in candidates}:
+            candidates.append(dict(default))
+        chip = priors.chip_spec_for(spec)
+        scored = priors.score_all(spec, candidates, chip)
+        result.candidates = len(scored)
+        survivors, pruned = priors.prune(
+            scored, margin=prior_margin, keep=default
+        )
+        result.pruned = pruned
+        for cand in pruned:
+            telemetry.instant(
+                "tune.prune", cat="tune",
+                family=spec.family, impl=spec.impl,
+                knobs=cand.key(), prior_s=round(cand.prior_s, 9),
+            )
+
+        # measurement order: the registered default FIRST (the untuned
+        # baseline every winner must beat), then prior-rank order
+        ordered = sorted(
+            survivors, key=lambda s: (s.key() != default_key, s.prior_rank)
+        )
+        tune_key = tables.entry_key(
+            spec.family, spec.impl, spec.m, spec.n, spec.k,
+            spec.dtype, spec.num_partitions,
+        )
+        run_row = measure
+        if run_row is None and pool is None:
+            from ddlb_tpu.benchmark import benchmark_worker
+
+            run_row = benchmark_worker
+
+        best_ms = float("inf")
+        stale = 0
+        for index, cand in enumerate(ordered):
+            cand_key = cand.key()
+            config = trial_config(
+                spec, cand.knobs,
+                num_iterations=num_iterations, num_warmups=num_warmups,
+            )
+            banked = (
+                _banked_median(history_dir, tune_key, cand_key)
+                if (reuse_banked and history_dir)
+                else None
+            )
+            if banked is not None:
+                trial = Trial(
+                    dict(cand.knobs), cand.prior_s, cand.prior_rank,
+                    banked, from_bank=True,
+                )
+            else:
+                if pool is not None:
+                    from ddlb_tpu.pool import run_one_row
+
+                    nxt = (
+                        trial_config(
+                            spec, ordered[index + 1].knobs,
+                            num_iterations=num_iterations,
+                            num_warmups=num_warmups,
+                        )
+                        if index + 1 < len(ordered)
+                        else None
+                    )
+                    row = run_one_row(pool, config, _error_row, prefetch=nxt)
+                else:
+                    try:
+                        row = run_row(config)  # type: ignore[misc]
+                    except Exception as exc:  # a trial must never
+                        row = _error_row(config, repr(exc))  # kill the search
+                median = _median_ms(row)
+                trial = Trial(
+                    dict(cand.knobs), cand.prior_s, cand.prior_rank,
+                    median, error=str(row.get("error") or ""),
+                )
+                if history_dir:
+                    from ddlb_tpu.observatory import store
+
+                    banked_row = dict(row)
+                    banked_row["tune_key"] = tune_key
+                    banked_row["tune_candidate"] = cand_key
+                    banked_row["prior_rank"] = cand.prior_rank
+                    store.bank_row(
+                        banked_row, kind="tune", directory=history_dir
+                    )
+                    telemetry.instant(
+                        "tune.bank", cat="tune", knobs=cand_key,
+                    )
+            telemetry.instant(
+                "tune.trial", cat="tune",
+                family=spec.family, impl=spec.impl, knobs=cand_key,
+                prior_rank=cand.prior_rank,
+                median_ms=trial.median_ms if trial.median_ms == trial.median_ms
+                else None,
+                from_bank=trial.from_bank,
+            )
+            result.trials.append(trial)
+            if cand_key == default_key and trial.median_ms == trial.median_ms:
+                result.default_ms = trial.median_ms
+            # early-stop bookkeeping over the prior-ranked tail (the
+            # default seeds `best` but never counts as a stale probe)
+            if trial.median_ms == trial.median_ms and (
+                trial.median_ms < best_ms
+            ):
+                best_ms = trial.median_ms
+                if cand_key != default_key:
+                    stale = 0
+            elif cand_key != default_key:
+                stale += 1
+                if stale >= max(1, patience):
+                    result.early_stopped = True
+                    break
+
+        finite = [t for t in result.trials if t.median_ms == t.median_ms]
+        if not finite:
+            return result  # nothing measured cleanly: no entry banked
+        winner = min(
+            finite,
+            key=lambda t: (t.median_ms, t.prior_rank, canonical_knobs(t.knobs)),
+        )
+        result.entry = TuneEntry(
+            family=spec.family,
+            impl=spec.impl,
+            m=spec.m,
+            n=spec.n,
+            k=spec.k,
+            dtype=spec.dtype,
+            world_size=spec.num_partitions,
+            knobs=dict(winner.knobs),
+            measured_ms=winner.median_ms,
+            prior_s=winner.prior_s,
+            prior_rank=winner.prior_rank,
+            trials=len(result.trials),
+            pruned=len(result.pruned),
+            candidates=result.candidates,
+        )
+    return result
+
+
+def bank_winners(
+    results: List[SearchResult],
+    path: str,
+    *,
+    chip: str = "",
+    backend: str = "",
+) -> Optional[tables.TuningTable]:
+    """Merge the searches' winners into the table at ``path`` (atomic;
+    existing entries for other keys survive) and return the new table.
+    None when no search produced an entry — an all-failed search must
+    not version-churn a good table."""
+    entries = {
+        r.entry.key(): r.entry
+        for r in results
+        if r.entry is not None and not r.table_hit
+    }
+    if not entries:
+        return None
+    from ddlb_tpu.observatory import store
+
+    existing = tables.load_table(path) if os.path.exists(path) else None
+    merged = tables.merge_entries(existing, entries)
+    table = tables.make_table(
+        merged, chip=chip, backend=backend, git_rev=store.git_rev()
+    )
+    tables.save_table(table, path)
+    return table

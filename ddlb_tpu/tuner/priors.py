@@ -1,0 +1,273 @@
+"""Prior scoring and pruning: the model-guided half of the autotuner.
+
+Every feasible candidate (``tuner.space``) is priced BEFORE any compile
+by the same cost model the rows are audited against — ``cost.estimate``
+over a duck-typed stub that restates the family's published closed
+forms (``flops() = 2mnk``, the family bases' ring ``wire_bytes()``,
+the chunked engine's ``overlap_chunks()``), plus the calibrated replay
+(``cost.calibrated_estimate``) whenever a ``DDLB_TPU_CALIB`` table is
+active, so fitted overheads sharpen the ranking on the machine being
+tuned. Candidates worse than ``prior_margin`` x the best prior are
+pruned; survivors carry a deterministic 1-based ``prior_rank`` the
+driver measures in, so early-stop cuts the tail and the demo can report
+Spearman prior-vs-measured rank agreement.
+
+The analytic schedule laws are tile-blind (a GEMM's roofline does not
+see ``block_m``), so tile candidates add the census's HBM-traffic term
+— operand re-streaming per tile pass, the DDLB130/131 arithmetic — as
+the differentiator. Deliberately JAX-free (imports only ``perfmodel``),
+like the cost layer itself.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from ddlb_tpu.perfmodel import cost
+from ddlb_tpu.perfmodel.specs import ChipSpec, detect_spec, get_spec
+from ddlb_tpu.tuner.space import SearchSpec
+from ddlb_tpu.tuner.table import canonical_knobs
+
+#: impls whose members declare COST_SCHEDULE = "overlap" (every
+#: overlap.py / pallas_impl.py member of the searchable families);
+#: the jax_spmd*/xla_gspmd members keep the "sequential" default
+_OVERLAP_IMPLS = ("overlap", "pallas")
+
+
+def chip_spec_for(spec: SearchSpec) -> ChipSpec:
+    """The chip the priors price against: the named spec when the
+    search pins one, else the runtime-detected chip (respects
+    ``DDLB_TPU_CHIP``, so CPU-sim searches price the cpu profile)."""
+    if spec.chip:
+        try:
+            return get_spec(spec.chip)
+        except KeyError:
+            from ddlb_tpu.telemetry.logger import warn
+
+            warn(
+                f"tuner: unknown chip {spec.chip!r} in search spec; "
+                f"pricing against the detected chip instead"
+            )
+    return detect_spec()
+
+
+def _two_level(d: int, num_slices: int) -> Tuple[int, int]:
+    """(intra, inter) factorization — ``topo_compose.two_level_factors``
+    restated here so the prior tier stays importable without the
+    primitives tier (which pulls JAX)."""
+    d = max(1, int(d))
+    inter = max(1, int(num_slices or 1))
+    if inter > d or d % inter:
+        inter = 1
+    return d // inter, inter
+
+
+def _family_payload(
+    spec: SearchSpec, options: Mapping[str, Any]
+) -> Optional[Tuple[str, float]]:
+    """(collective op, LOCAL payload bytes) in the ``ring_wire_bytes``
+    convention — the same closed forms the family bases state, so the
+    stub's flat wire EQUALS the real member's ``wire_bytes()``."""
+    d = max(1, spec.num_partitions)
+    isz = cost.wire_itemsize(spec.dtype)
+    if spec.family == "tp_columnwise":
+        return "all_gather", float((spec.m // d) * spec.k * isz)
+    if spec.family == "tp_rowwise":
+        return "psum_scatter", float(spec.m * spec.n * isz)
+    if spec.family == "dp_allreduce":
+        return "psum", float(spec.m * spec.n * isz)
+    if spec.family == "ep_alltoall":
+        return "all_to_all", float((spec.m // d) * (spec.k + spec.n) * isz)
+    if spec.family == "collectives":
+        op = str(options.get("op", "all_gather"))
+        return op, float((spec.m // d) * spec.k * isz)
+    return None
+
+
+class _Stub:
+    """Duck-typed impl for ``cost.estimate``/``calibrated_estimate``:
+    one candidate's knobs wearing the member's published cost facts,
+    without constructing (or compiling) the member."""
+
+    def __init__(self, spec: SearchSpec, knobs: Mapping[str, Any]):
+        self.primitive_name = spec.family
+        self.COST_SCHEDULE = (
+            "overlap" if spec.impl in _OVERLAP_IMPLS else "sequential"
+        )
+        self.m, self.n, self.k = spec.m, spec.n, spec.k
+        self.dtype = spec.dtype
+        self.num_partitions = max(1, spec.num_partitions)
+        self._spec = spec
+        self.options: Dict[str, Any] = {"transport": "ici"}
+        self.options.update(spec.options_base())
+        self.options.update(knobs)
+
+    def flops(self) -> float:
+        if self._spec.family == "collectives":
+            return 0.0  # pure wire; the family reports bandwidth
+        return 2.0 * self.m * self.n * self.k
+
+    def overlap_chunks(self) -> Optional[int]:
+        # the prior differentiates chunk_count whenever the candidate
+        # carries one (the knob IS the pipeline depth), not only under
+        # the engine's algorithm="chunked" spelling
+        chunks = self.options.get("chunk_count")
+        if isinstance(chunks, (int, float)) and chunks >= 1:
+            return int(chunks)
+        return None
+
+    def wire_bytes(self) -> float:
+        payload = _family_payload(self._spec, self.options)
+        if payload is None:
+            return 0.0
+        op, nbytes = payload
+        d = self.num_partitions
+        comp = str(self.options.get("composition", "flat"))
+        if comp in ("hierarchical", "striped") and d > 1:
+            intra, inter = _two_level(d, self._spec.num_slices)
+            if comp == "striped":
+                cls = cost.striped_wire_bytes(
+                    op, nbytes, inter, cost.torus_factors(intra)
+                )
+            else:
+                cls = cost.hierarchical_wire_bytes(op, nbytes, intra, inter)
+            return float(cls["ici"] + cls["dcn"])
+        return cost.ring_wire_bytes(op, nbytes, d)
+
+
+def tile_traffic_s(
+    spec: SearchSpec, knobs: Mapping[str, Any], chip: ChipSpec
+) -> float:
+    """HBM re-streaming seconds of one tiled GEMM pass — the census's
+    traffic arithmetic (each A tile re-reads per ``n/bn`` column pass,
+    each B tile per ``m/bm`` row pass, the product written once). Zero
+    for candidates without tile knobs: the analytic laws already rank
+    those."""
+    if not any(key in knobs for key in ("block_m", "block_n", "block_k")):
+        return 0.0
+    d = max(1, spec.num_partitions)
+    m_eff = spec.m
+    if spec.options_base().get("order") == "AG_after":
+        m_eff = max(1, spec.m // d)
+    k_eff = spec.k
+    if spec.family == "tp_rowwise":
+        k_eff = max(1, spec.k // d)  # the kernel GEMMs the k shard
+    bm = int(knobs.get("block_m", m_eff) or m_eff)
+    bn = int(knobs.get("block_n", spec.n) or spec.n)
+    isz = float(spec.itemsize())
+    passes_a = max(1.0, spec.n / max(1, bn))
+    passes_b = max(1.0, m_eff / max(1, bm))
+    traffic = isz * (
+        m_eff * k_eff * passes_a + k_eff * spec.n * passes_b
+        + m_eff * spec.n
+    )
+    return traffic / max(1.0, float(chip.hbm_bw))
+
+
+@dataclass(frozen=True)
+class ScoredCandidate:
+    """One candidate with its prior verdict attached."""
+
+    knobs: Dict[str, Any]
+    prior_s: float
+    prior_source: str  # "calibrated" | "analytic"
+    prior_rank: int = 0  # 1-based, assigned by prune()
+
+    def key(self) -> str:
+        return canonical_knobs(self.knobs)
+
+
+def score(
+    spec: SearchSpec,
+    knobs: Mapping[str, Any],
+    chip: Optional[ChipSpec] = None,
+) -> ScoredCandidate:
+    """Price one candidate: analytic roofline (``cost.estimate``),
+    upgraded to the calibrated replay when a ``DDLB_TPU_CALIB`` table is
+    active, plus the tile-traffic differentiator."""
+    chip = chip or chip_spec_for(spec)
+    stub = _Stub(spec, knobs)
+    est = cost.estimate(stub, spec=chip)
+    prior_s = float(est.predicted_s)
+    source = "analytic"
+    try:
+        cal = cost.calibrated_estimate(stub, spec=chip, backend=spec.backend)
+    except Exception:
+        cal = None
+    if cal is not None and math.isfinite(cal.predicted_cal_s):
+        prior_s = float(cal.predicted_cal_s)
+        source = "calibrated"
+    prior_s += tile_traffic_s(spec, knobs, chip)
+    return ScoredCandidate(dict(knobs), prior_s, source)
+
+
+def score_all(
+    spec: SearchSpec,
+    candidates: Sequence[Mapping[str, Any]],
+    chip: Optional[ChipSpec] = None,
+) -> List[ScoredCandidate]:
+    chip = chip or chip_spec_for(spec)
+    return [score(spec, knobs, chip) for knobs in candidates]
+
+
+def prune(
+    scored: Sequence[ScoredCandidate],
+    *,
+    margin: float = 1.5,
+    keep: Optional[Mapping[str, Any]] = None,
+) -> Tuple[List[ScoredCandidate], List[ScoredCandidate]]:
+    """(survivors, pruned): candidates beyond ``margin`` x the best
+    prior are cut before any compile. Survivors come back in prior-rank
+    order — ``(prior_s, canonical knobs)``, a total order with no
+    float-tie churn — wearing their 1-based rank. ``keep``: knobs that
+    bypass the margin (the registered default, so the measured winner
+    is never worse than the default by construction)."""
+    keep_key = canonical_knobs(keep) if keep is not None else None
+    ordered = sorted(scored, key=lambda s: (s.prior_s, s.key()))
+    if not ordered:
+        return [], []
+    best = ordered[0].prior_s
+    cut = margin * best if best > 0.0 else float("inf")
+    survivors: List[ScoredCandidate] = []
+    pruned: List[ScoredCandidate] = []
+    for cand in ordered:
+        if cand.prior_s <= cut or cand.key() == keep_key:
+            survivors.append(replace(cand, prior_rank=len(survivors) + 1))
+        else:
+            pruned.append(cand)
+    return survivors, pruned
+
+
+def spearman(xs: Sequence[float], ys: Sequence[float]) -> float:
+    """Spearman rank correlation (average-rank ties), stdlib-only —
+    the demo's prior-vs-measured agreement number. NaN for degenerate
+    inputs (n < 2 or a constant side)."""
+    n = len(xs)
+    if n != len(ys) or n < 2:
+        return float("nan")
+
+    def _ranks(vals: Sequence[float]) -> List[float]:
+        order = sorted(range(n), key=lambda i: (vals[i], i))
+        ranks = [0.0] * n
+        i = 0
+        while i < n:
+            j = i
+            while j + 1 < n and vals[order[j + 1]] == vals[order[i]]:
+                j += 1
+            avg = (i + j) / 2.0 + 1.0
+            for t in range(i, j + 1):
+                ranks[order[t]] = avg
+            i = j + 1
+        return ranks
+
+    rx, ry = _ranks(xs), _ranks(ys)
+    mx = sum(rx) / n
+    my = sum(ry) / n
+    cov = sum((a - mx) * (b - my) for a, b in zip(rx, ry))
+    vx = sum((a - mx) ** 2 for a in rx)
+    vy = sum((b - my) ** 2 for b in ry)
+    if vx <= 0.0 or vy <= 0.0:
+        return float("nan")
+    return cov / math.sqrt(vx * vy)

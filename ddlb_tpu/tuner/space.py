@@ -1,0 +1,374 @@
+"""Knob registry: the tunable axes each family declares, with static
+feasibility.
+
+The propose half of the prior-guided autotuner (ISSUE 20). A candidate
+is one point in a family member's knob product space — a Pallas tile
+triple, a chunked-engine ``chunk_count``, a collective ``composition``,
+or a named XLA option set — expressed as the option dict the member
+would be constructed with. Candidates are validated HERE, statically,
+by the same divisibility / tile-granule / VMEM-budget rules the Pallas
+census (DDLB130/131, ``analysis/pallas/model.py``) encodes, so an
+unbuildable point is rejected before it costs a compile — the search
+driver only ever measures points that can build.
+
+Coverage is an analyzer invariant (DDLB140, the same shape as
+DDLB007's cost-model coverage): every family in
+``registry.ALLOWED_PRIMITIVES`` either appears in ``SPACES`` or is
+listed in ``KNOB_FREE`` with a reason — a new family cannot silently
+ship with no tuning story.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Mapping, Tuple
+
+# the census's tile-granule constants (DDLB131 tile alignment): the
+# second-to-last dim packs SUBLANE[dtype] rows per register tile, the
+# last dim LANE columns — a block off the granule (unless it spans the
+# whole axis, one un-tiled block) repacks on every load
+from ddlb_tpu.analysis.pallas.model import LANE, SUBLANE
+
+_ITEMSIZE = {
+    "float64": 8, "int64": 8,
+    "float32": 4, "int32": 4,
+    "bfloat16": 2, "float16": 2,
+    "int8": 1, "bool": 1,
+}
+
+
+@dataclass(frozen=True)
+class SearchSpec:
+    """One search target: which member, at which shape, on which world.
+
+    ``base_options`` are the FIXED options every candidate shares (the
+    member's algorithm selector, e.g. ``algorithm="chunked"``); knob
+    values layer on top. ``vmem_bytes`` defaults to the conservative
+    16 MiB census budget (``perfmodel.specs`` raises it per chip)."""
+
+    family: str
+    impl: str
+    m: int
+    n: int
+    k: int
+    dtype: str = "float32"
+    num_partitions: int = 1
+    num_slices: int = 1
+    chip: str = ""
+    backend: str = "host_clock"
+    vmem_bytes: float = 16 * (1 << 20)
+    seed: int = 42
+    base_options: Tuple[Tuple[str, Any], ...] = ()
+
+    def options_base(self) -> Dict[str, Any]:
+        return dict(self.base_options)
+
+    def itemsize(self) -> int:
+        return _ITEMSIZE.get(self.dtype, 4)
+
+
+@dataclass(frozen=True)
+class FeasibleSpace:
+    """The proposed space after static feasibility: what survives, and
+    what was rejected with the rule that rejected it (the census-style
+    evidence the demo transcript prints)."""
+
+    candidates: List[Dict[str, Any]] = field(default_factory=list)
+    rejected: List[Tuple[Dict[str, Any], str]] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# feasibility checks (the census rules, statically)
+# ---------------------------------------------------------------------------
+
+
+def tile_feasible(
+    spec: SearchSpec, bm: int, bn: int, bk: int, m_eff: int = 0
+) -> Tuple[bool, str]:
+    """Why (or that) one GEMM tile triple can build at this shape:
+    divisibility (DDLB133 grid/block mismatch), tile-granule alignment
+    (DDLB131), and the double-buffered VMEM working set against the
+    chip budget (DDLB130). ``m_eff``: the per-device m the kernel
+    actually sees (0 = the global m)."""
+    m_eff = m_eff or spec.m
+    if bm <= 0 or bn <= 0 or bk <= 0:
+        return False, "non-positive block"
+    if m_eff % bm or spec.n % bn or spec.k % bk:
+        return False, (
+            f"divisibility: ({bm},{bn},{bk}) does not divide "
+            f"{m_eff}x{spec.n}x{spec.k}"
+        )
+    sublane = SUBLANE.get(spec.dtype, 8)
+    if bm % sublane and bm != m_eff:
+        return False, f"granule: block_m={bm} off the {sublane}-row sublane"
+    if bn % LANE and bn != spec.n:
+        return False, f"granule: block_n={bn} off the {LANE}-lane register"
+    # resident working set, double-buffered: one A tile, one B tile,
+    # one accumulator tile, times two for the pipeline's in-flight copy
+    itemsize = spec.itemsize()
+    working = 2.0 * itemsize * (bm * bk + bk * bn + bm * bn)
+    if working > spec.vmem_bytes:
+        return False, (
+            f"vmem: working set {working / (1 << 20):.1f} MiB over the "
+            f"{spec.vmem_bytes / (1 << 20):.0f} MiB budget"
+        )
+    return True, ""
+
+
+def chunk_feasible(spec: SearchSpec, chunk_count: int) -> Tuple[bool, str]:
+    """The chunked-fusion engine's constraint: every chunk is a whole
+    per-device row slab, so ``m % (partitions * chunk_count) == 0``."""
+    d = max(1, spec.num_partitions)
+    if chunk_count < 1:
+        return False, "chunk_count < 1"
+    if spec.m % (d * chunk_count):
+        return False, (
+            f"divisibility: m={spec.m} not divisible by partitions*"
+            f"chunk_count={d * chunk_count}"
+        )
+    return True, ""
+
+
+def composition_feasible(spec: SearchSpec, composition: str) -> Tuple[bool, str]:
+    """The composed members scatter the payload across the world: the
+    row dim must split across every device regardless of composition
+    (the members' own ``_check_shapes`` contract)."""
+    d = max(1, spec.num_partitions)
+    if spec.m % d:
+        return False, f"divisibility: m={spec.m} not divisible by d={d}"
+    return True, ""
+
+
+# ---------------------------------------------------------------------------
+# axis generators
+# ---------------------------------------------------------------------------
+
+#: power-of-two tile dims the generalized grid draws from — the curated
+#: 8-entry ``_GEMM_TILE_GRID`` is a hand-picked subset of this product;
+#: priors are what make the larger space affordable (ISSUE 20)
+TILE_DIMS = (128, 256, 512, 1024, 2048)
+
+#: chunked-engine pipeline depths worth proposing: 1 (no pipelining,
+#: the degenerate baseline) through deep; infeasible depths filter out
+CHUNK_COUNTS = (1, 2, 4, 8, 16)
+
+#: composition vocabulary (mirrors topo_compose.COMPOSITIONS — kept
+#: literal so this module stays importable without the primitives tier)
+COMPOSITION_CHOICES = ("flat", "hierarchical", "striped")
+
+#: named XLA option sets for the GSPMD members — each candidate is one
+#: coherent scheduler posture (primitives/xla_options.py knobs), not a
+#: free product of bools that would mostly measure identical binaries
+XLA_OPTION_SETS: Dict[str, Dict[str, Any]] = {
+    "default": {
+        "latency_hiding_scheduler": True,
+        "async_collective_fusion": True,
+        "collective_matmul": "auto",
+    },
+    "no_latency_hiding": {
+        "latency_hiding_scheduler": False,
+        "async_collective_fusion": True,
+        "collective_matmul": "auto",
+    },
+    "windowed_einsum": {
+        "latency_hiding_scheduler": True,
+        "async_collective_fusion": True,
+        "collective_matmul": "force",
+    },
+    "plain": {
+        "latency_hiding_scheduler": False,
+        "async_collective_fusion": False,
+        "collective_matmul": "off",
+    },
+}
+
+
+def _tile_axis(spec: SearchSpec, size: int, granule: int = 1) -> List[int]:
+    """Candidate block sizes for one axis of extent ``size``: the
+    power-of-two dims clamped to the axis (the ``min(bm, m)`` clamp
+    ``gemm_block_candidates`` applies), deduplicated, divisors only."""
+    dims = sorted({min(d, size) for d in TILE_DIMS} | {size})
+    return [d for d in dims if d > 0 and size % d == 0]
+
+
+def _gemm_tile_space(spec: SearchSpec, m_eff: int = 0) -> FeasibleSpace:
+    m_eff = m_eff or spec.m
+    out = FeasibleSpace()
+    for bm in _tile_axis(spec, m_eff):
+        for bn in _tile_axis(spec, spec.n):
+            for bk in _tile_axis(spec, spec.k):
+                knobs = {"block_m": bm, "block_n": bn, "block_k": bk}
+                ok, why = tile_feasible(spec, bm, bn, bk, m_eff=m_eff)
+                if ok:
+                    out.candidates.append(knobs)
+                else:
+                    out.rejected.append((knobs, why))
+    return out
+
+
+def _chunked_space(spec: SearchSpec) -> FeasibleSpace:
+    out = FeasibleSpace()
+    for c in CHUNK_COUNTS:
+        knobs = {"chunk_count": c}
+        ok, why = chunk_feasible(spec, c)
+        if ok:
+            out.candidates.append(knobs)
+        else:
+            out.rejected.append((knobs, why))
+    return out
+
+
+def _composition_space(spec: SearchSpec) -> FeasibleSpace:
+    out = FeasibleSpace()
+    for comp in COMPOSITION_CHOICES:
+        knobs = {"composition": comp}
+        ok, why = composition_feasible(spec, comp)
+        if ok:
+            out.candidates.append(knobs)
+        else:
+            out.rejected.append((knobs, why))
+    return out
+
+
+def _xla_space(spec: SearchSpec) -> FeasibleSpace:
+    # every named set is buildable by construction (CPU degrades the
+    # options to a no-op — xla_options.build_compiler_options)
+    return FeasibleSpace(
+        candidates=[dict(XLA_OPTION_SETS[name]) for name in XLA_OPTION_SETS]
+    )
+
+
+def _tp_pallas_space(spec: SearchSpec) -> FeasibleSpace:
+    """The tp pallas members' tile space. The GEMM sees the gathered m
+    (AG_before, the registered default order) so candidates divide the
+    global m; AG_after searches would pass the shard via base_options
+    ``order`` and the sharded clamp applies."""
+    m_eff = spec.m
+    if spec.options_base().get("order") == "AG_after":
+        m_eff = spec.m // max(1, spec.num_partitions)
+    return _gemm_tile_space(spec, m_eff=m_eff)
+
+
+def _tp_rowwise_pallas_space(spec: SearchSpec) -> FeasibleSpace:
+    # the rowwise kernel GEMMs the k-sharded slab: [m, k/d] x [k/d, n]
+    out = FeasibleSpace()
+    k_local = spec.k // max(1, spec.num_partitions)
+    for bn in _tile_axis(spec, spec.n):
+        for bk in _tile_axis(spec, k_local):
+            knobs = {"block_n": bn, "block_k": bk}
+            ok, why = tile_feasible(
+                spec, spec.m, bn, bk, m_eff=spec.m
+            )
+            if bk > 0 and k_local % bk:
+                ok, why = False, (
+                    f"divisibility: block_k={bk} does not divide the "
+                    f"k shard {k_local}"
+                )
+            if ok:
+                out.candidates.append(knobs)
+            else:
+                out.rejected.append((knobs, why))
+    return out
+
+
+#: (family, impl) -> candidate generator. The registry the coverage
+#: rule (DDLB140) and the search driver both read.
+SPACES: Dict[Tuple[str, str], Callable[[SearchSpec], FeasibleSpace]] = {
+    ("tp_columnwise", "pallas"): _tp_pallas_space,
+    ("tp_columnwise", "overlap"): _chunked_space,
+    ("tp_columnwise", "xla_gspmd"): _xla_space,
+    ("tp_rowwise", "pallas"): _tp_rowwise_pallas_space,
+    ("tp_rowwise", "overlap"): _chunked_space,
+    ("tp_rowwise", "xla_gspmd"): _xla_space,
+    ("dp_allreduce", "overlap"): _chunked_space,
+    ("dp_allreduce", "jax_spmd_hier"): _composition_space,
+    ("dp_allreduce", "jax_spmd_striped"): _composition_space,
+    ("dp_allreduce", "xla_gspmd"): _xla_space,
+    ("ep_alltoall", "overlap"): _chunked_space,
+    ("ep_alltoall", "jax_spmd_hier"): _composition_space,
+    ("ep_alltoall", "jax_spmd_striped"): _composition_space,
+    ("collectives", "jax_spmd_hier"): _composition_space,
+    ("collectives", "jax_spmd_striped"): _composition_space,
+}
+
+#: families with no declared knob space, each with the reason — the
+#: DDLB140 coverage rule requires every registered family to appear in
+#: SPACES or here, so "we never thought about tuning it" is impossible
+KNOB_FREE: Dict[str, str] = {
+    "cp_ring_attention": (
+        "ring schedule granularity is pinned to the context shard; the "
+        "window/causal options are workload axes, not perf knobs"
+    ),
+    "pp_pipeline": (
+        "microbatch count is a swept workload axis (the bubble law is "
+        "what the sweep measures, not a knob to hide)"
+    ),
+    "transformer_step": (
+        "the (dp, tp, pp) factorization is the sweep's subject — "
+        "tuning it away would erase the measurement"
+    ),
+    "transformer_decode": (
+        "decode batch/page geometry is the serving workload's contract, "
+        "owned by the serving engine, not a member knob"
+    ),
+    "serving_load": (
+        "admission/routing knobs are controlled by the serving cluster "
+        "policies (serve/), tuned by the elastic controller at runtime"
+    ),
+}
+
+
+def default_knobs(spec: SearchSpec) -> Dict[str, Any]:
+    """The member's registered default point, clamped to the shape the
+    way the members themselves clamp (``min(block, axis)``) — the
+    untuned baseline the driver always measures so a banked winner is
+    never worse than what an untuned run would have used."""
+    d = max(1, spec.num_partitions)
+    generator = SPACES.get((spec.family, spec.impl))
+    if generator is _tp_pallas_space:
+        m_eff = spec.m
+        if spec.options_base().get("order") == "AG_after":
+            m_eff = spec.m // d
+        return {
+            "block_m": min(1024, m_eff),
+            "block_n": min(1024, spec.n),
+            "block_k": min(512, spec.k),
+        }
+    if generator is _tp_rowwise_pallas_space:
+        return {
+            "block_n": min(1024, spec.n),
+            "block_k": min(512, spec.k // d),
+        }
+    if generator is _chunked_space:
+        return {"chunk_count": 2}
+    if generator is _composition_space:
+        return {
+            "composition": "striped" if "striped" in spec.impl
+            else "hierarchical"
+        }
+    if generator is _xla_space:
+        return dict(XLA_OPTION_SETS["default"])
+    raise ValueError(
+        f"no knob space declared for ({spec.family!r}, {spec.impl!r})"
+    )
+
+
+def tunable_families() -> Dict[str, List[str]]:
+    """family -> its searchable impl names (registry view)."""
+    out: Dict[str, List[str]] = {}
+    for family, impl in sorted(SPACES):
+        out.setdefault(family, []).append(impl)
+    return out
+
+
+def propose(spec: SearchSpec) -> FeasibleSpace:
+    """The feasible candidate space for one search target. Raises for
+    a (family, impl) with no declared space — the caller asked to
+    search something the registry says is not searchable."""
+    generator = SPACES.get((spec.family, spec.impl))
+    if generator is None:
+        raise ValueError(
+            f"no knob space declared for ({spec.family!r}, {spec.impl!r});"
+            f" searchable: {sorted(SPACES)}"
+        )
+    return generator(spec)

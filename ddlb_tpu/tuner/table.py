@@ -1,0 +1,393 @@
+"""Versioned per-chip tuning tables: the bank the runners consult.
+
+The persistence half of the prior-guided autotuner (ISSUE 20). The
+search driver (``tuner.driver``) measures pruned candidate spaces and
+banks each winner here as a ``TuneEntry`` keyed by
+``(family, impl, m, n, k, dtype, world_size)``; the whole table is
+scoped to one ``(chip, backend)`` pair — a table primed on a v5e is
+never silently applied to a v5p (or to the CPU sim), the same guard
+``utils.autotune.make_key`` bakes into its cache keys.
+
+The format follows ``perfmodel.calib.CalibrationTable`` deliberately:
+
+- frozen dataclasses with ``to_json`` / ``from_json``;
+- a content fingerprint ``version`` (``t1-`` + sha256 of the canonical
+  sorted entries) so two searches that landed the same winners produce
+  byte-identical tables, and regression gates can fence baselines per
+  table version exactly as ``detect_calibration`` fences per
+  ``cal_version``;
+- atomic writes (tmp + rename), warn-once tolerant loads, and an
+  env-selected ``get_table()`` cached by (path, mtime) so the consult
+  path in ``Primitive.__init__`` costs one env read when untuned and
+  one stat() when tuned.
+
+No wall-clock field enters the table or its fingerprint — re-running
+the search under the same seed and banked trials reproduces the file
+byte-identically (the determinism contract ``scripts/tune_demo.py``
+asserts). Provenance is ``git_rev`` only.
+
+The generic JSON helpers at the bottom (``load_json_file`` /
+``atomic_write_json``) are the ONE persistence path shared with
+``utils.autotune``'s block cache — the ISSUE 20 satellite that stops
+the cache and the table growing divergent atomicity/tolerance rules.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional
+
+TABLE_FORMAT = "ddlb-tpu-tuning-v1"
+
+
+def canonical_knobs(knobs: Mapping[str, Any]) -> str:
+    """A knob dict as its canonical sorted-JSON string — the identity
+    the banked trial rows and the table fingerprint both use, so a
+    re-run matches its predecessor's trials key-for-key."""
+    return json.dumps(dict(knobs), sort_keys=True, default=str)
+
+
+def entry_key(
+    family: str,
+    impl: str,
+    m: int,
+    n: int,
+    k: int,
+    dtype: str,
+    world_size: int,
+) -> str:
+    """The stable identity of one tuning decision — everything that
+    changes which knobs are optimal is in (shape, dtype, world size);
+    chip and backend scope the whole table, not the entry."""
+    return json.dumps(
+        {
+            "family": str(family),
+            "impl": str(impl),
+            "m": int(m),
+            "n": int(n),
+            "k": int(k),
+            "dtype": str(dtype),
+            "world_size": int(world_size),
+        },
+        sort_keys=True,
+    )
+
+
+@dataclass(frozen=True)
+class TuneEntry:
+    """One banked winner plus the search metadata behind it."""
+
+    family: str
+    impl: str
+    m: int
+    n: int
+    k: int
+    dtype: str
+    world_size: int
+    #: the winning knob assignment the consult path applies
+    knobs: Dict[str, Any] = field(default_factory=dict)
+    #: the winner's measured median (ms) from the search trials
+    measured_ms: float = float("nan")
+    #: the winner's prior score (seconds; calibrated when a table was
+    #: active during the search, analytical otherwise)
+    prior_s: float = float("nan")
+    #: the winner's 1-based rank in prior order among the survivors —
+    #: rank 1 means the priors called it; stamped on consuming rows
+    prior_rank: int = 0
+    #: candidates actually measured (after pruning + early stop)
+    trials: int = 0
+    #: candidates the priors pruned before any compile
+    pruned: int = 0
+    #: feasible candidates proposed (after static feasibility rejects)
+    candidates: int = 0
+
+    def key(self) -> str:
+        return entry_key(
+            self.family, self.impl, self.m, self.n, self.k,
+            self.dtype, self.world_size,
+        )
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "family": self.family,
+            "impl": self.impl,
+            "m": self.m,
+            "n": self.n,
+            "k": self.k,
+            "dtype": self.dtype,
+            "world_size": self.world_size,
+            "knobs": dict(self.knobs),
+            "measured_ms": self.measured_ms,
+            "prior_s": self.prior_s,
+            "prior_rank": self.prior_rank,
+            "trials": self.trials,
+            "pruned": self.pruned,
+            "candidates": self.candidates,
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, Any]) -> "TuneEntry":
+        return cls(
+            family=str(data.get("family", "")),
+            impl=str(data.get("impl", "")),
+            m=int(data.get("m", 0)),  # type: ignore[arg-type]
+            n=int(data.get("n", 0)),  # type: ignore[arg-type]
+            k=int(data.get("k", 0)),  # type: ignore[arg-type]
+            dtype=str(data.get("dtype", "")),
+            world_size=int(data.get("world_size", 0)),  # type: ignore[arg-type]
+            knobs=dict(data.get("knobs") or {}),
+            measured_ms=float(data.get("measured_ms", float("nan"))),  # type: ignore[arg-type]
+            prior_s=float(data.get("prior_s", float("nan"))),  # type: ignore[arg-type]
+            prior_rank=int(data.get("prior_rank", 0)),  # type: ignore[arg-type]
+            trials=int(data.get("trials", 0)),  # type: ignore[arg-type]
+            pruned=int(data.get("pruned", 0)),  # type: ignore[arg-type]
+            candidates=int(data.get("candidates", 0)),  # type: ignore[arg-type]
+        )
+
+
+@dataclass(frozen=True)
+class TuningTable:
+    """Versioned set of banked winners for one (chip, backend)."""
+
+    version: str
+    chip: str = ""
+    backend: str = ""
+    git_rev: str = ""
+    entries: Dict[str, TuneEntry] = field(default_factory=dict)
+
+    def lookup(
+        self,
+        family: str,
+        impl: str,
+        m: int,
+        n: int,
+        k: int,
+        dtype: str,
+        world_size: int,
+        chip: str = "",
+        degraded: Optional[bool] = None,
+    ) -> Optional[TuneEntry]:
+        """The banked winner for this exact config, or None (a miss
+        falls back to the registered defaults).
+
+        ``chip`` (when both sides name one) must match the table's
+        scope — a mismatch is a miss, never a cross-chip apply.
+
+        The online re-tune hook (ISSUE 20 stretch): an entry that
+        pins a ``composition`` knob is INVALIDATED while the world is
+        degraded — ``degraded`` None consults
+        ``topo_compose.degraded_world_signal`` (the degraded-relaunch
+        stamp, a seeded link fault, or a persistent health indictment)
+        lazily, only when the hit actually carries the knob. The miss
+        sends the member back to its default (``composition=auto``
+        re-resolves via ``select_composition`` against the degraded
+        topology) and the next search re-banks under that world.
+        """
+        if chip and self.chip and chip != self.chip:
+            return None
+        entry = self.entries.get(
+            entry_key(family, impl, m, n, k, dtype, world_size)
+        )
+        if entry is None:
+            return None
+        if "composition" in entry.knobs:
+            if degraded is None:
+                from ddlb_tpu.primitives.topo_compose import (
+                    degraded_world_signal,
+                )
+
+                degraded = degraded_world_signal(world_size)
+            if degraded:
+                return None
+        return entry
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "format": TABLE_FORMAT,
+            "version": self.version,
+            "chip": self.chip,
+            "backend": self.backend,
+            "git_rev": self.git_rev,
+            "entries": {
+                key: entry.to_json()
+                for key, entry in sorted(self.entries.items())
+            },
+        }
+
+    @classmethod
+    def from_json(cls, data: Mapping[str, Any]) -> "TuningTable":
+        entries: Dict[str, TuneEntry] = {}
+        for raw in dict(data.get("entries") or {}).values():
+            entry = TuneEntry.from_json(raw)
+            entries[entry.key()] = entry
+        return cls(
+            version=str(data.get("version", "")),
+            chip=str(data.get("chip", "")),
+            backend=str(data.get("backend", "")),
+            git_rev=str(data.get("git_rev", "")),
+            entries=entries,
+        )
+
+
+def table_version(entries: Mapping[str, TuneEntry]) -> str:
+    """Content fingerprint of the banked winners. Floats are rounded
+    before hashing (the same tolerance trick as
+    ``calib.table_version``) so re-serialization noise can never move
+    the version; any winner or knob that actually changes does."""
+    canonical = json.dumps(
+        {
+            key: {
+                "knobs": {
+                    k: v for k, v in sorted(entry.knobs.items())
+                },
+                "measured_ms": round(float(entry.measured_ms), 9)
+                if entry.measured_ms == entry.measured_ms
+                else None,
+                "prior_s": round(float(entry.prior_s), 12)
+                if entry.prior_s == entry.prior_s
+                else None,
+                "prior_rank": entry.prior_rank,
+                "trials": entry.trials,
+                "pruned": entry.pruned,
+                "candidates": entry.candidates,
+            }
+            for key, entry in sorted(entries.items())
+        },
+        sort_keys=True,
+        default=str,
+    )
+    return "t1-" + hashlib.sha256(canonical.encode()).hexdigest()[:10]
+
+
+def make_table(
+    entries: Mapping[str, TuneEntry],
+    *,
+    chip: str = "",
+    backend: str = "",
+    git_rev: str = "",
+) -> TuningTable:
+    return TuningTable(
+        version=table_version(entries),
+        chip=chip,
+        backend=backend,
+        git_rev=git_rev,
+        entries=dict(entries),
+    )
+
+
+def merge_entries(
+    table: Optional[TuningTable], entries: Mapping[str, TuneEntry]
+) -> Dict[str, TuneEntry]:
+    """Existing entries with ``entries`` layered on top (new winners
+    replace old ones for the same key) — the re-tune update path."""
+    merged: Dict[str, TuneEntry] = dict(table.entries) if table else {}
+    merged.update(entries)
+    return merged
+
+
+def save_table(table: TuningTable, path: str) -> None:
+    """Atomic write (tmp + rename) so readers never see a torn table."""
+    atomic_write_json(path, table.to_json(), label="tuning table")
+
+
+def load_table(path: str) -> Optional[TuningTable]:
+    """Load a table from ``path``; None when missing/corrupt (warned
+    once — a broken table must never take a sweep down, the sweep just
+    runs untuned)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            data = json.load(handle)
+        if not isinstance(data, dict) or not isinstance(
+            data.get("entries"), dict
+        ):
+            raise ValueError("not a tuning table")
+        return TuningTable.from_json(data)
+    except (OSError, ValueError) as exc:
+        _warn_once(path, f"tuning table unreadable at {path}: {exc}")
+        return None
+
+
+_WARNED_PATHS: set = set()
+
+
+def _warn_once(path: str, message: str) -> None:
+    if path in _WARNED_PATHS:
+        return
+    _WARNED_PATHS.add(path)
+    from ddlb_tpu.telemetry.logger import warn
+
+    warn(message)
+
+
+_TABLE_CACHE: Dict[str, object] = {}
+
+
+def get_table() -> Optional[TuningTable]:
+    """The env-selected table (``DDLB_TPU_TUNING``), cached by (path,
+    mtime) so the per-construction consult stays one stat() when tuned
+    and one env read when not. A path pointing at a file that does not
+    exist YET (the search is about to create it) is a quiet miss, not
+    a warning — ``tune_demo`` sets the env before the first search."""
+    from ddlb_tpu import envs
+
+    path = envs.get_tuning_table_path()
+    if not path:
+        return None
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return None
+    if _TABLE_CACHE.get("path") == path and _TABLE_CACHE.get("mtime") == mtime:
+        return _TABLE_CACHE.get("table")  # type: ignore[return-value]
+    table = load_table(path)
+    _TABLE_CACHE.update(path=path, mtime=mtime, table=table)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# the shared JSON persistence path (utils.autotune routes through these)
+# ---------------------------------------------------------------------------
+
+
+def load_json_file(path: str) -> Dict[str, Any]:
+    """A JSON object from ``path``, or {} on any failure — the tolerant
+    read contract every cache consumer here shares (a corrupt cache
+    must degrade to 'cold', never to a crash)."""
+    try:
+        with open(path, encoding="utf-8") as handle:
+            data = json.load(handle)
+        return data if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def atomic_write_json(
+    path: str, data: Mapping[str, Any], label: str = "json"
+) -> bool:
+    """Best-effort atomic JSON write (tmp.PID + os.replace): a
+    persistence failure warns and returns False, never raises — a full
+    disk must not fail the measurement whose winner it was recording."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as handle:
+            json.dump(data, handle, indent=1, sort_keys=True)
+            handle.write("\n")
+        os.replace(tmp, path)
+    except OSError as exc:
+        from ddlb_tpu import telemetry
+
+        telemetry.warn(
+            f"{label} write to {path} failed: {type(exc).__name__}: {exc}"
+        )
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True

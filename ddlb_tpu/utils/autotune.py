@@ -29,9 +29,7 @@ Design points:
 
 from __future__ import annotations
 
-import json
 import os
-import time
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -52,26 +50,25 @@ def cache_path() -> str:
 
 
 def _load_cache(path: str) -> Dict[str, Any]:
-    try:
-        with open(path) as f:
-            data = json.load(f)
-        return data if isinstance(data, dict) else {}
-    except Exception:
-        return {}
+    # ONE persistence path with the tuner's tables (ISSUE 20): the
+    # tolerant-read / atomic-write pair lives in tuner.table
+    from ddlb_tpu.tuner.table import load_json_file
+
+    return load_json_file(path)
 
 
 def _save_cache(path: str, data: Dict[str, Any]) -> None:
     """Best effort: a cache write failure must never fail the benchmark."""
-    try:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
-    except Exception as exc:
-        telemetry.warn(
-            f"autotune cache write to {path} failed: "
-            f"{type(exc).__name__}: {exc}"
-        )
+    from ddlb_tpu.tuner.table import atomic_write_json
+
+    atomic_write_json(path, data, label="autotune cache")
+
+
+def _git_rev() -> str:
+    """Entry provenance (deterministic — the observatory's git_rev)."""
+    from ddlb_tpu.observatory.store import git_rev
+
+    return git_rev()
 
 
 def make_key(
@@ -94,7 +91,12 @@ def make_key(
 def reject_block_override_with_tune(options, overridden) -> None:
     """The one tune-vs-explicit-blocks rule, shared by every member that
     exposes both (schema drift guard — see quantized_mixin docstring)."""
-    if options["tune"] and ({"block_m", "block_n", "block_k"} & overridden):
+    # `is True` deliberately: tune="auto" (the tuning-table consult mode,
+    # ddlb_tpu.tuner) applies banked knobs only where nothing was
+    # explicitly set, so explicit blocks are legal alongside it
+    if options["tune"] is True and (
+        {"block_m", "block_n", "block_k"} & overridden
+    ):
         raise ValueError(
             "tune=true picks the blocks; do not also set block_m/n/k"
         )
@@ -171,6 +173,10 @@ def autotune(
             f"autotune: no candidate for {kernel} at {m}x{n}x{k} ({dtype}) "
             f"could be built — tried {list(candidates)}"
         )
+    # deterministic total order: (median, blocks) — an exact median tie
+    # resolves by the block tuple, so two runs that measure identical
+    # medians persist the identical winner (tuner tables built on this
+    # cache never churn on re-runs)
     results.sort()
     best_ms, best = results[0]
     cache = _load_cache(path)  # re-read: another process may have written
@@ -180,7 +186,9 @@ def autotune(
         "tried": [
             {"blocks": list(c), "median_ms": t} for t, c in results
         ],
-        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        # provenance is deterministic (no wall clock): the same
+        # measurements reproduce the same cache file byte-for-byte
+        "git_rev": _git_rev(),
     }
     _save_cache(path, cache)
     telemetry.log(

@@ -28,6 +28,14 @@ fraction, with the chunked-fusion engine's ``chunk_count`` split out of
 the option string as its own column — the view that answers "which
 schedule granularity actually hides the collective". Composes with
 ``--json``.
+
+``--tuned`` switches to the tuned-vs-default comparison (ISSUE 20): per
+banked tuning-table winner, the winner's measured median next to the
+registered default's (joined from the observatory's ``kind="tune"``
+trials), the speedup, and the search evidence (prior rank, trials run,
+candidates pruned). Reads the table from ``--table``/``DDLB_TPU_TUNING``
+and trials from ``--history``/``DDLB_TPU_HISTORY``; CSVs are not needed.
+Composes with ``--json``.
 """
 
 from __future__ import annotations
@@ -255,6 +263,107 @@ def render_overlap_text(families, skipped):
     return "\n".join(lines)
 
 
+def summarize_tuned(table, history_dir):
+    """Per-family tuned-vs-default comparison from the tuning table plus
+    the banked ``kind="tune"`` trials (ISSUE 20): one entry per banked
+    winner, with the registered-default candidate's banked median next
+    to the winner's — the "what did tuning buy" column. ``default_ms``
+    is None when the search's default trial was not banked (foreign
+    bank)."""
+    from ddlb_tpu.tuner.space import SearchSpec, default_knobs
+    from ddlb_tpu.tuner.table import canonical_knobs
+
+    trials = {}
+    if history_dir:
+        from ddlb_tpu.observatory.store import iter_history
+
+        try:
+            records = iter_history(history_dir, kind="tune")
+        except OSError:
+            records = []
+        for record in records:
+            row = record.get("row") or {}
+            if (row.get("error") or "").strip():
+                continue
+            median = _fnum(row.get("median time (ms)"))
+            if median is None:
+                continue
+            trials[(row.get("tune_key"), row.get("tune_candidate"))] = median
+
+    families = {}
+    for entry in table.entries.values():
+        spec = SearchSpec(
+            family=entry.family, impl=entry.impl,
+            m=entry.m, n=entry.n, k=entry.k, dtype=entry.dtype,
+            num_partitions=entry.world_size,
+        )
+        try:
+            default = canonical_knobs(default_knobs(spec))
+        except ValueError:
+            default = None
+        default_ms = trials.get((entry.key(), default))
+        tuned_ms = _fnum(entry.measured_ms)
+        speedup = (
+            default_ms / tuned_ms
+            if default_ms is not None and tuned_ms
+            else None
+        )
+        families.setdefault(entry.family, []).append(
+            {
+                "implementation": entry.impl,
+                "shape": f"{entry.m}x{entry.n}x{entry.k}",
+                "dtype": entry.dtype,
+                "world_size": entry.world_size,
+                "knobs": dict(entry.knobs),
+                "tuned_ms": tuned_ms,
+                "default_ms": default_ms,
+                "speedup": speedup,
+                "prior_rank": entry.prior_rank,
+                "trials": entry.trials,
+                "pruned": entry.pruned,
+                "candidates": entry.candidates,
+            }
+        )
+    for family in families:
+        families[family].sort(
+            key=lambda e: (e["implementation"], e["shape"], e["dtype"])
+        )
+    return families
+
+
+def render_tuned_text(families, table):
+    lines = [
+        f"tuning table {table.version} (chip: {table.chip or '?'}, "
+        f"backend: {table.backend or '?'})"
+    ]
+    for family in sorted(families):
+        lines.append(f"== {family} (tuned vs default) ==")
+        lines.append(
+            f"{'impl':<16} {'shape':<16} {'tuned ms':>10} {'default ms':>11} "
+            f"{'speedup':>8} {'p-rank':>6} {'trials':>6} {'pruned':>6}  knobs"
+        )
+        for e in families[family]:
+            tuned = f"{e['tuned_ms']:.4f}" if e["tuned_ms"] is not None else "-"
+            default = (
+                f"{e['default_ms']:.4f}"
+                if e["default_ms"] is not None
+                else "-"
+            )
+            speedup = (
+                f"{e['speedup']:.3f}x" if e["speedup"] is not None else "-"
+            )
+            knobs = ";".join(f"{k}={v}" for k, v in sorted(e["knobs"].items()))
+            lines.append(
+                f"{e['implementation']:<16} {e['shape']:<16} {tuned:>10} "
+                f"{default:>11} {speedup:>8} {e['prior_rank']:>6} "
+                f"{e['trials']:>6} {e['pruned']:>6}  {knobs}"
+            )
+        lines.append("")
+    if not families:
+        lines.append("tuning table has no entries — run a search first")
+    return "\n".join(lines)
+
+
 def render_text(families, skipped):
     lines = []
     for primitive in sorted(families):
@@ -299,7 +408,9 @@ def render_text(families, skipped):
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("csvs", nargs="+", help="result CSV path(s)")
+    parser.add_argument(
+        "csvs", nargs="*", help="result CSV path(s) (unused with --tuned)"
+    )
     parser.add_argument(
         "--json", action="store_true",
         help="emit the ranking as JSON instead of the text table",
@@ -309,8 +420,69 @@ def main(argv=None) -> int:
         help="rank overlap members by measured_overlap_frac (next to "
              "roofline_frac), per family and chunk_count",
     )
+    parser.add_argument(
+        "--tuned", action="store_true",
+        help="per-family tuned-vs-default comparison from the tuning "
+             "table (--table / DDLB_TPU_TUNING) and banked kind=tune "
+             "trials (--history / DDLB_TPU_HISTORY)",
+    )
+    parser.add_argument(
+        "--table", default=None,
+        help="tuning-table JSON path (default: DDLB_TPU_TUNING)",
+    )
+    parser.add_argument(
+        "--history", default=None,
+        help="observatory history dir for banked tune trials "
+             "(default: DDLB_TPU_HISTORY)",
+    )
     args = parser.parse_args(argv)
 
+    if args.tuned:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from ddlb_tpu import envs
+        from ddlb_tpu.tuner.table import load_table
+
+        table_path = args.table or envs.get_tuning_table_path()
+        if not table_path:
+            print(
+                "perf_report: --tuned needs a tuning table "
+                "(--table or DDLB_TPU_TUNING)",
+                file=sys.stderr,
+            )
+            return 2
+        table = load_table(table_path)
+        if table is None:
+            print(
+                f"perf_report: no tuning table at {table_path}",
+                file=sys.stderr,
+            )
+            return 2
+        history_dir = args.history or envs.get_history_dir()
+        families = summarize_tuned(table, history_dir)
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "table": {
+                            "version": table.version,
+                            "chip": table.chip,
+                            "backend": table.backend,
+                            "path": table_path,
+                        },
+                        "families": families,
+                    },
+                    indent=1, sort_keys=True,
+                )
+            )
+        else:
+            print(render_tuned_text(families, table))
+        return 0
+
+    if not args.csvs:
+        print("perf_report: result CSV path(s) required", file=sys.stderr)
+        return 2
     missing = [p for p in args.csvs if not os.path.exists(p)]
     if missing:
         print(f"perf_report: no such file: {missing}", file=sys.stderr)

@@ -1,0 +1,424 @@
+"""Prior-guided autotuner (ISSUE 20): knob spaces, priors, driver, table.
+
+The search loop is exercised with INJECTED synthetic landscapes
+(``driver.search(measure=...)``) so every contract — feasibility,
+prune-keeps-the-winner, early-stop, banked-trial determinism — is
+checked without a single real compile; the consult path is exercised on
+a real overlap member against the 8-device CPU sim. The end-to-end
+measured run lives in ``scripts/tune_demo.py``.
+"""
+
+import json
+import os
+
+import pytest
+
+from ddlb_tpu.tuner import driver, priors
+from ddlb_tpu.tuner import table as tables
+from ddlb_tpu.tuner.space import (
+    KNOB_FREE,
+    SPACES,
+    SearchSpec,
+    chunk_feasible,
+    default_knobs,
+    propose,
+    tile_feasible,
+)
+from ddlb_tpu.tuner.table import TuneEntry, canonical_knobs
+
+
+def chunk_spec(m=256, n=64, k=64, d=8, **kw):
+    return SearchSpec(
+        family="dp_allreduce", impl="overlap", m=m, n=n, k=k,
+        num_partitions=d, chip="cpu-sim",
+        base_options=(("algorithm", "chunked"),), **kw,
+    )
+
+
+def landscape(medians):
+    """A synthetic measure fn: chunk_count -> median ms."""
+    def measure(config):
+        chunk = config["options"]["chunk_count"]
+        return {driver.MEASURE_COLUMN: medians[chunk], "error": ""}
+    return measure
+
+
+def entry_for(spec, knobs, measured_ms=1.0, prior_rank=1):
+    return TuneEntry(
+        family=spec.family, impl=spec.impl, m=spec.m, n=spec.n,
+        k=spec.k, dtype=spec.dtype, world_size=spec.num_partitions,
+        knobs=dict(knobs), measured_ms=measured_ms, prior_s=1e-4,
+        prior_rank=prior_rank, trials=3, pruned=2, candidates=5,
+    )
+
+
+# -- space: static feasibility ----------------------------------------------
+
+
+def test_tile_feasibility_rules():
+    spec = SearchSpec("tp_columnwise", "pallas", 1024, 1024, 512,
+                      num_partitions=2)
+    ok, _ = tile_feasible(spec, 512, 512, 256)
+    assert ok
+    ok, why = tile_feasible(spec, 100, 128, 128)
+    assert not ok and "divisibility" in why
+    ok, why = tile_feasible(spec, 4, 128, 128)
+    assert not ok and "granule" in why
+    # the double-buffered working set of a huge tile blows the
+    # conservative 16 MiB census budget at f32
+    big = SearchSpec("tp_columnwise", "pallas", 2048, 2048, 2048,
+                     num_partitions=2)
+    ok, why = tile_feasible(big, 2048, 2048, 2048)
+    assert not ok and "vmem" in why
+
+
+def test_tile_space_only_proposes_buildable_points():
+    spec = SearchSpec("tp_columnwise", "pallas", 2048, 2048, 2048,
+                      num_partitions=2)
+    space = propose(spec)
+    assert space.candidates and space.rejected
+    for knobs in space.candidates:
+        ok, why = tile_feasible(
+            spec, knobs["block_m"], knobs["block_n"], knobs["block_k"]
+        )
+        assert ok, why
+    assert any("vmem" in why for _knobs, why in space.rejected)
+
+
+def test_chunk_space_divisibility():
+    spec = chunk_spec(m=48)
+    space = propose(spec)
+    assert [c["chunk_count"] for c in space.candidates] == [1, 2]
+    assert all("divisibility" in why for _k, why in space.rejected)
+    assert chunk_feasible(spec, 4) == (False, space.rejected[0][1])
+
+
+def test_propose_unknown_target_raises():
+    with pytest.raises(ValueError, match="no knob space"):
+        propose(SearchSpec("dp_allreduce", "nope", 64, 64, 64))
+
+
+def test_default_knobs_are_feasible_candidates():
+    for spec in (
+        chunk_spec(),
+        SearchSpec("tp_columnwise", "pallas", 1024, 1024, 512,
+                   num_partitions=2),
+        SearchSpec("dp_allreduce", "jax_spmd_hier", 256, 64, 64,
+                   num_partitions=8),
+        SearchSpec("dp_allreduce", "xla_gspmd", 256, 64, 64,
+                   num_partitions=8),
+    ):
+        default = default_knobs(spec)
+        keys = {canonical_knobs(c) for c in propose(spec).candidates}
+        assert canonical_knobs(default) in keys
+
+
+def test_every_family_has_a_tuning_story():
+    # the DDLB140 invariant, stated here as well so a coverage break
+    # fails the fast tier too, not only `make analyze`
+    from ddlb_tpu.primitives.registry import ALLOWED_PRIMITIVES
+
+    declared = {family for family, _impl in SPACES}
+    for family in ALLOWED_PRIMITIVES:
+        assert family in declared or family in KNOB_FREE
+        assert not (family in declared and family in KNOB_FREE)
+
+
+# -- priors: pruning and rank agreement -------------------------------------
+
+
+def test_prune_margin_and_keep():
+    scored = [
+        priors.ScoredCandidate({"chunk_count": c}, s, "analytic")
+        for c, s in ((1, 2.0), (2, 1.0), (4, 1.2))
+    ]
+    survivors, pruned = priors.prune(scored, margin=1.5)
+    assert [s.knobs["chunk_count"] for s in survivors] == [2, 4]
+    assert [s.prior_rank for s in survivors] == [1, 2]
+    assert [p.knobs["chunk_count"] for p in pruned] == [1]
+    # keep= (the registered default) bypasses the margin
+    survivors, pruned = priors.prune(
+        scored, margin=1.5, keep={"chunk_count": 1}
+    )
+    assert [s.knobs["chunk_count"] for s in survivors] == [2, 4, 1]
+    assert pruned == []
+
+
+def test_prune_keeps_the_true_winner_under_a_decent_prior():
+    # a synthetic landscape where the prior's ORDER is right but its
+    # magnitudes are off 30%: the winner must survive a 1.5x margin
+    truth = {1: 4.0, 2: 2.0, 4: 1.0, 8: 1.5, 16: 3.5}
+    scored = [
+        priors.ScoredCandidate({"chunk_count": c}, truth[c] * 1.3, "analytic")
+        for c in truth
+    ]
+    survivors, _pruned = priors.prune(scored, margin=1.5)
+    assert {"chunk_count": 4} in [s.knobs for s in survivors]
+    assert survivors[0].knobs == {"chunk_count": 4}
+
+
+def test_spearman():
+    assert priors.spearman([1, 2, 3], [10, 20, 30]) == pytest.approx(1.0)
+    assert priors.spearman([1, 2, 3], [30, 20, 10]) == pytest.approx(-1.0)
+    assert priors.spearman([1.0, 1.0], [1.0, 2.0]) != priors.spearman(
+        [1.0, 1.0], [1.0, 2.0]
+    )  # constant side -> NaN
+    assert priors.spearman([1.0], [1.0]) != priors.spearman([1.0], [1.0])
+
+
+def test_priors_differentiate_chunk_depth_and_composition():
+    chip = priors.chip_spec_for(chunk_spec())
+    deep = priors.score(chunk_spec(), {"chunk_count": 16}, chip)
+    shallow = priors.score(chunk_spec(), {"chunk_count": 1}, chip)
+    assert deep.prior_s < shallow.prior_s  # pipelining hides wire time
+    # all_to_all is where the two-level factorization moves real bytes
+    # (a psum's hierarchical total equals its flat total by algebra)
+    spec = SearchSpec("ep_alltoall", "jax_spmd_hier", 256, 64, 64,
+                      num_partitions=8, num_slices=2, chip="cpu-sim")
+    flat = priors.score(spec, {"composition": "flat"}, chip)
+    hier = priors.score(spec, {"composition": "hierarchical"}, chip)
+    assert flat.prior_s != hier.prior_s
+
+
+# -- driver: search on synthetic landscapes ---------------------------------
+
+
+def test_search_measures_default_first_and_finds_the_winner():
+    truth = {1: 2.0, 2: 1.5, 4: 1.0, 8: 1.2, 16: 3.0}
+    result = driver.search(
+        chunk_spec(), measure=landscape(truth), prior_margin=100.0,
+        force=True,
+    )
+    assert result.trials[0].knobs == {"chunk_count": 2}  # the default
+    assert result.default_ms == pytest.approx(1.5)
+    assert result.entry is not None
+    assert result.entry.knobs == {"chunk_count": 4}
+    assert result.entry.measured_ms <= result.default_ms
+    assert result.candidates == 5 and not result.early_stopped
+    assert -1.0 <= result.spearman() <= 1.0
+
+
+def test_search_early_stops_at_patience():
+    # default wins outright: every later probe is stale
+    truth = {c: (1.0 if c == 2 else 2.0 + c) for c in (1, 2, 4, 8, 16)}
+    result = driver.search(
+        chunk_spec(), measure=landscape(truth), prior_margin=100.0,
+        patience=2, force=True,
+    )
+    assert result.early_stopped
+    assert len(result.trials) == 3  # default + `patience` stale probes
+    assert result.entry.knobs == {"chunk_count": 2}
+
+
+def test_search_survives_a_crashing_trial():
+    def measure(config):
+        if config["options"]["chunk_count"] == 16:
+            raise RuntimeError("boom")
+        return {driver.MEASURE_COLUMN: config["options"]["chunk_count"],
+                "error": ""}
+
+    result = driver.search(
+        chunk_spec(), measure=measure, prior_margin=100.0, patience=10,
+        force=True,
+    )
+    errored = [t for t in result.trials if t.error]
+    assert errored and errored[0].median_ms != errored[0].median_ms
+    assert result.entry.knobs == {"chunk_count": 1}
+
+
+def test_trial_config_contract():
+    config = driver.trial_config(chunk_spec(), {"chunk_count": 4})
+    assert config["impl_id"] == "tune:dp_allreduce/overlap"
+    assert config["base_implementation"] == "overlap"
+    assert config["options"] == {"algorithm": "chunked", "chunk_count": 4}
+    assert config["validate"] is False
+
+
+def test_search_banks_trials_and_rerun_is_deterministic(tmp_path):
+    from ddlb_tpu.observatory import store
+
+    history = str(tmp_path / "hist")
+    truth = {1: 2.0, 2: 1.5, 4: 1.0, 8: 1.2, 16: 3.0}
+    first = driver.search(
+        chunk_spec(), measure=landscape(truth), prior_margin=100.0,
+        history_dir=history, force=True,
+    )
+    records = list(store.iter_history(history, kind="tune"))
+    assert len(records) == len(first.trials)
+    for record in records:
+        assert record["kind"] == "tune"
+        row = record["row"]
+        assert row["tune_key"] == first.entry.key()
+        assert json.loads(row["tune_candidate"])  # a knob dict
+        assert row["prior_rank"] >= 1
+
+    def exploded(_config):
+        raise AssertionError("banked trials must be reused, not re-run")
+
+    second = driver.search(
+        chunk_spec(), measure=exploded, prior_margin=100.0,
+        history_dir=history, force=True,
+    )
+    assert all(t.from_bank for t in second.trials)
+    assert second.entry == first.entry
+
+    path_a, path_b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    assert driver.bank_winners(
+        [first], path_a, chip="cpu-sim", backend="host_clock"
+    ) is not None
+    driver.bank_winners(
+        [second], path_b, chip="cpu-sim", backend="host_clock"
+    )
+    with open(path_a, "rb") as fa, open(path_b, "rb") as fb:
+        assert fa.read() == fb.read()  # byte-identical table
+
+
+def test_bank_winners_merges_and_skips_empty(tmp_path):
+    path = str(tmp_path / "table.json")
+    assert driver.bank_winners([], path) is None
+    spec_a, spec_b = chunk_spec(), chunk_spec(m=512)
+    result_a = driver.SearchResult(
+        spec=spec_a, entry=entry_for(spec_a, {"chunk_count": 4})
+    )
+    table = driver.bank_winners([result_a], path, chip="cpu-sim")
+    assert table is not None and len(table.entries) == 1
+    result_b = driver.SearchResult(
+        spec=spec_b, entry=entry_for(spec_b, {"chunk_count": 8})
+    )
+    merged = driver.bank_winners([result_b], path, chip="cpu-sim")
+    assert len(merged.entries) == 2  # the earlier winner survived
+    assert merged.version != table.version
+
+
+# -- table: round-trip, fingerprint, env gating, invalidation ----------------
+
+
+def test_table_roundtrip_and_fingerprint(tmp_path):
+    spec = chunk_spec()
+    entry = entry_for(spec, {"chunk_count": 4})
+    table = tables.make_table(
+        {entry.key(): entry}, chip="cpu-sim", backend="host_clock",
+        git_rev="abc123",
+    )
+    path = str(tmp_path / "table.json")
+    tables.save_table(table, path)
+    loaded = tables.load_table(path)
+    assert loaded is not None and loaded.to_json() == table.to_json()
+    # the fingerprint is content-only: same winners -> same version,
+    # a moved winner -> a new version (what the regression fence keys)
+    assert tables.table_version({entry.key(): entry}) == table.version
+    moved = entry_for(spec, {"chunk_count": 8})
+    assert tables.table_version({moved.key(): moved}) != table.version
+
+
+def test_load_table_tolerates_corruption(tmp_path):
+    path = str(tmp_path / "broken.json")
+    with open(path, "w") as handle:
+        handle.write("{not json")
+    assert tables.load_table(path) is None
+    with open(path, "w") as handle:
+        json.dump({"entries": "nope"}, handle)
+    assert tables.load_table(path) is None
+
+
+def test_get_table_env_gating(tmp_path, monkeypatch):
+    monkeypatch.delenv("DDLB_TPU_TUNING", raising=False)
+    assert tables.get_table() is None
+    path = str(tmp_path / "table.json")
+    monkeypatch.setenv("DDLB_TPU_TUNING", path)
+    assert tables.get_table() is None  # not written yet: a quiet miss
+    spec = chunk_spec()
+    entry = entry_for(spec, {"chunk_count": 4})
+    tables.save_table(tables.make_table({entry.key(): entry}), path)
+    loaded = tables.get_table()
+    assert loaded is not None and entry.key() in loaded.entries
+    # a re-banked table (new mtime) invalidates the (path, mtime) cache
+    other = entry_for(spec, {"chunk_count": 8})
+    tables.save_table(tables.make_table({other.key(): other}), path)
+    bumped = os.stat(path).st_mtime + 2
+    os.utime(path, (bumped, bumped))
+    reloaded = tables.get_table()
+    assert reloaded.version != loaded.version
+
+
+def test_lookup_chip_scope_and_degraded_invalidation(monkeypatch):
+    spec = SearchSpec("dp_allreduce", "jax_spmd_hier", 256, 64, 64,
+                      num_partitions=8, chip="cpu-sim")
+    comp = entry_for(spec, {"composition": "flat"})
+    table = tables.make_table({comp.key(): comp}, chip="cpu-sim")
+    args = (spec.family, spec.impl, spec.m, spec.n, spec.k, spec.dtype,
+            spec.num_partitions)
+    assert table.lookup(*args, chip="tpu-v5e") is None  # cross-chip
+    assert table.lookup(*args, chip="cpu-sim", degraded=False) is comp
+    # a composition winner is invalidated while the world is degraded
+    assert table.lookup(*args, degraded=True) is None
+    monkeypatch.setenv("DDLB_TPU_WORLD_DEGRADED", "rank3")
+    assert table.lookup(*args) is None  # degraded=None consults the signal
+    monkeypatch.delenv("DDLB_TPU_WORLD_DEGRADED")
+    assert table.lookup(*args) is comp
+    # non-composition winners ignore the signal entirely
+    chunked = entry_for(chunk_spec(), {"chunk_count": 4})
+    chunk_table = tables.make_table({chunked.key(): chunked})
+    assert chunk_table.lookup(
+        "dp_allreduce", "overlap", 256, 64, 64, "float32", 8,
+        degraded=True,
+    ) is chunked
+
+
+def test_search_short_circuits_on_a_table_hit(tmp_path, monkeypatch):
+    spec = chunk_spec()
+    entry = entry_for(spec, {"chunk_count": 4})
+    path = str(tmp_path / "table.json")
+    tables.save_table(tables.make_table({entry.key(): entry}), path)
+    monkeypatch.setenv("DDLB_TPU_TUNING", path)
+
+    def exploded(_config):
+        raise AssertionError("a table hit must not measure")
+
+    hit = driver.search(spec, measure=exploded)
+    assert hit.table_hit and not hit.trials and hit.entry == entry
+    # force=True re-searches through the hit
+    forced = driver.search(
+        spec, measure=landscape({c: float(c) for c in (1, 2, 4, 8, 16)}),
+        prior_margin=100.0, force=True,
+    )
+    assert not forced.table_hit and forced.trials
+
+
+# -- consult: members apply the banked winner by default ---------------------
+
+
+def test_member_consults_table_by_default(tmp_path, monkeypatch):
+    from ddlb_tpu.primitives.registry import load_impl_class
+
+    cls = load_impl_class("dp_allreduce", "overlap")
+    spec = SearchSpec("dp_allreduce", "overlap", 256, 64, 96,
+                      num_partitions=8)
+    entry = entry_for(spec, {"chunk_count": 4})
+    path = str(tmp_path / "table.json")
+    table = tables.make_table({entry.key(): entry})
+    tables.save_table(table, path)
+
+    monkeypatch.delenv("DDLB_TPU_TUNING", raising=False)
+    untuned = cls(256, 64, 96, dtype="float32", algorithm="chunked")
+    assert untuned.options["chunk_count"] == 2  # registered default
+    assert untuned.tuning_stamp is None
+
+    monkeypatch.setenv("DDLB_TPU_TUNING", path)
+    tuned = cls(256, 64, 96, dtype="float32", algorithm="chunked")
+    assert tuned.options["chunk_count"] == 4  # the banked winner
+    assert tuned.tuning_stamp == {
+        "tuned": True, "tuning_version": table.version, "prior_rank": 1,
+    }
+    assert tuned.validate(tuned.run())
+
+    # an explicitly passed knob always beats the table
+    pinned = cls(256, 64, 96, dtype="float32", algorithm="chunked",
+                 chunk_count=8)
+    assert pinned.options["chunk_count"] == 8
+    assert pinned.tuning_stamp is None
+
+    # a miss (unknown shape) stays on the registered defaults
+    miss = cls(512, 64, 96, dtype="float32", algorithm="chunked")
+    assert miss.options["chunk_count"] == 2
+    assert miss.tuning_stamp is None
